@@ -1,0 +1,60 @@
+"""Test harness.
+
+* Forces JAX onto a virtual 8-device CPU platform BEFORE jax import, so
+  sharding/scheduler tests run without TPU hardware (SURVEY.md section 4's
+  multi-node strategy: ``xla_force_host_platform_device_count``).
+* Runs ``async def`` tests via a tiny pytest hook (no pytest-asyncio in the
+  image).
+* Resets config + tracing global singletons between tests (reference autouse
+  fixture: tests/conftest.py:242-249).
+"""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run coroutine test functions on a fresh event loop."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    from vgate_tpu import config as config_mod
+    from vgate_tpu import tracing as tracing_mod
+
+    config_mod.reset_config()
+    tracing_mod.reset_tracing()
+    yield
+    config_mod.reset_config()
+    tracing_mod.reset_tracing()
+
+
+@pytest.fixture
+def dry_config():
+    """A config wired for dry-run testing."""
+    from vgate_tpu.config import load_config
+
+    return load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 4, "max_wait_time_ms": 10.0},
+        logging={"level": "WARNING"},
+    )

@@ -1,0 +1,212 @@
+"""In-process gateway integration tests with the dry-run engine
+(reference tier 3: httpx.ASGITransport tests at tests/test_benchmark.py:98-131;
+here via aiohttp's TestClient since the gateway is aiohttp-native)."""
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vgate_tpu.config import load_config
+from vgate_tpu.server.app import create_app
+
+
+async def _client(**overrides):
+    overrides.setdefault("model", {"engine_type": "dry_run"})
+    overrides.setdefault(
+        "batch", {"max_batch_size": 4, "max_wait_time_ms": 5.0}
+    )
+    overrides.setdefault("logging", {"level": "WARNING"})
+    config = load_config(**overrides)
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    return client
+
+
+async def test_health():
+    client = await _client()
+    try:
+        resp = await client.get("/health")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["status"] == "ok"
+        assert body["engine_type"] == "DryRunBackend"
+    finally:
+        await client.close()
+
+
+async def test_chat_completion_roundtrip():
+    client = await _client()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [
+                    {"role": "system", "content": "You are helpful."},
+                    {"role": "user", "content": "Say hi"},
+                ],
+                "max_tokens": 16,
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "chat.completion"
+        content = body["choices"][0]["message"]["content"]
+        # dry-run echoes the flattened prompt (System:/User:/Assistant:)
+        assert "[dry-run] echo:" in content
+        assert "System: You are helpful." in content
+        assert body["usage"]["completion_tokens"] == 8
+        assert "X-Request-ID" in resp.headers
+    finally:
+        await client.close()
+
+
+async def test_chat_completion_validation_error():
+    client = await _client()
+    try:
+        resp = await client.post("/v1/chat/completions", json={"messages": []})
+        assert resp.status == 422
+        resp = await client.post(
+            "/v1/chat/completions", json={"wrong": "shape"}
+        )
+        assert resp.status == 422
+    finally:
+        await client.close()
+
+
+async def test_chat_completion_caching_visible():
+    client = await _client()
+    try:
+        req = {
+            "messages": [{"role": "user", "content": "cache me"}],
+            "temperature": 0.5,
+        }
+        first = await (await client.post("/v1/chat/completions", json=req)).json()
+        second = await (await client.post("/v1/chat/completions", json=req)).json()
+        assert first["cached"] is False
+        assert second["cached"] is True
+    finally:
+        await client.close()
+
+
+async def test_embeddings_endpoint():
+    client = await _client()
+    try:
+        resp = await client.post(
+            "/v1/embeddings", json={"input": ["one", "two"]}
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "list"
+        assert len(body["data"]) == 2
+        assert len(body["data"][0]["embedding"]) == 768
+        assert body["usage"]["prompt_tokens"] >= 2
+    finally:
+        await client.close()
+
+
+async def test_embeddings_single_string():
+    client = await _client()
+    try:
+        resp = await client.post("/v1/embeddings", json={"input": "solo"})
+        body = await resp.json()
+        assert len(body["data"]) == 1
+    finally:
+        await client.close()
+
+
+async def test_models_endpoint():
+    client = await _client()
+    try:
+        body = await (await client.get("/v1/models")).json()
+        ids = [m["id"] for m in body["data"]]
+        assert any("Qwen" in i for i in ids)
+    finally:
+        await client.close()
+
+
+async def test_metrics_endpoint():
+    client = await _client()
+    try:
+        await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "m"}]},
+        )
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        text = await resp.text()
+        assert "vgt_requests" in text
+    finally:
+        await client.close()
+
+
+async def test_stats_endpoint():
+    client = await _client()
+    try:
+        await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "s"}]},
+        )
+        body = await (await client.get("/stats")).json()
+        assert body["batcher"]["total_requests"] >= 1
+        assert "cache" in body and "config" in body
+        assert body["config"]["engine_type"] == "dry_run"
+    finally:
+        await client.close()
+
+
+async def test_benchmark_endpoint():
+    client = await _client()
+    try:
+        resp = await client.post(
+            "/v1/benchmark",
+            json={"prompts": ["bench one", "bench two"], "rounds": 2,
+                  "max_tokens": 8},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["rounds"] == 2
+        assert body["latency_ms"]["p50"] > 0
+        assert body["tokens_per_second"] > 0
+    finally:
+        await client.close()
+
+
+async def test_streaming_chat():
+    client = await _client()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "stream me"}],
+                "stream": True,
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        raw = await resp.text()
+        assert "data: [DONE]" in raw
+        assert "chat.completion.chunk" in raw
+    finally:
+        await client.close()
+
+
+async def test_secured_gateway_end_to_end():
+    client = await _client(
+        security={"enabled": True, "api_keys": ["sk-test"]},
+        rate_limit={"enabled": True, "requests_per_minute": 100},
+    )
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert resp.status == 401
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+            headers={"Authorization": "Bearer sk-test"},
+        )
+        assert resp.status == 200
+        # /health stays exempt
+        assert (await client.get("/health")).status == 200
+    finally:
+        await client.close()

@@ -1,0 +1,235 @@
+"""Batcher semantics: lifecycle, triggers, dedup, per-request params, error
+containment (reference: tests/test_batcher.py:94-242, tests/test_cache.py:261-303)."""
+
+import asyncio
+from typing import List, Sequence
+
+import pytest
+
+from vgate_tpu.backends.base import GenerationResult, SamplingParams
+from vgate_tpu.batcher import RequestBatcher
+from vgate_tpu.config import load_config
+
+
+class CountingBackend:
+    """Instrumented fake backend (reference test pattern:
+    tests/test_batcher.py:29-56)."""
+
+    def __init__(self, delay: float = 0.0, fail: bool = False):
+        self.calls: List[List[str]] = []
+        self.params_seen: List[List[SamplingParams]] = []
+        self.delay = delay
+        self.fail = fail
+
+    def load_model(self, model_config):
+        pass
+
+    def create_sampling_params(self, **kw):
+        return SamplingParams(**kw)
+
+    def generate(self, prompts: Sequence[str], params: Sequence[SamplingParams]):
+        if self.fail:
+            raise RuntimeError("backend exploded")
+        self.calls.append(list(prompts))
+        self.params_seen.append(list(params))
+        return [
+            GenerationResult(
+                text=f"out:{p}",
+                token_ids=[1, 2, 3],
+                num_tokens=3,
+                prompt_tokens=len(p.split()),
+                metrics={"ttft": 0.01, "gen_time": 0.02, "tpot": 0.005},
+            )
+            for p in prompts
+        ]
+
+    def shutdown(self):
+        pass
+
+
+class FakeEngine:
+    def __init__(self, backend, config):
+        self.backend = backend
+        self.config = config
+
+
+def make_batcher(config=None, backend=None):
+    config = config or load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 4, "max_wait_time_ms": 10.0},
+    )
+    backend = backend or CountingBackend()
+    return RequestBatcher(FakeEngine(backend, config), config), backend
+
+
+async def test_lifecycle():
+    batcher, _ = make_batcher()
+    await batcher.start()
+    assert batcher.get_metrics()["running"] is True
+    await batcher.stop()
+    assert batcher.get_metrics()["running"] is False
+
+
+async def test_single_request_via_timer():
+    batcher, backend = make_batcher()
+    await batcher.start()
+    try:
+        result = await batcher.submit("hello world")
+        assert result["text"] == "out:hello world"
+        assert result["cached"] is False
+        assert len(backend.calls) == 1
+    finally:
+        await batcher.stop()
+
+
+async def test_size_trigger_batches_together():
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 4, "max_wait_time_ms": 5000.0},
+    )
+    batcher, backend = make_batcher(config)
+    await batcher.start()
+    try:
+        results = await asyncio.gather(
+            *[batcher.submit(f"p{i}") for i in range(4)]
+        )
+        assert len(results) == 4
+        # one batch of 4, despite the long timer
+        assert len(backend.calls) == 1
+        assert sorted(backend.calls[0]) == ["p0", "p1", "p2", "p3"]
+    finally:
+        await batcher.stop()
+
+
+async def test_in_batch_dedup():
+    """3 identical prompts => 1 inference (reference: tests/test_cache.py:261-279)."""
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 3, "max_wait_time_ms": 5000.0},
+        cache={"enabled": False},
+    )
+    batcher, backend = make_batcher(config)
+    await batcher.start()
+    try:
+        results = await asyncio.gather(
+            *[batcher.submit("same prompt") for _ in range(3)]
+        )
+        assert all(r["text"] == "out:same prompt" for r in results)
+        assert len(backend.calls) == 1
+        assert backend.calls[0] == ["same prompt"]
+        assert batcher.get_metrics()["total_deduplicated"] == 2
+    finally:
+        await batcher.stop()
+
+
+async def test_mixed_dedup():
+    """5 requests, 3 unique => 3 inferences (reference: tests/test_cache.py:281-303)."""
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 5, "max_wait_time_ms": 5000.0},
+        cache={"enabled": False},
+    )
+    batcher, backend = make_batcher(config)
+    await batcher.start()
+    try:
+        prompts = ["a", "b", "a", "c", "b"]
+        await asyncio.gather(*[batcher.submit(p) for p in prompts])
+        assert len(backend.calls) == 1
+        assert sorted(backend.calls[0]) == ["a", "b", "c"]
+    finally:
+        await batcher.stop()
+
+
+async def test_cache_hit_fast_path():
+    batcher, backend = make_batcher()
+    await batcher.start()
+    try:
+        first = await batcher.submit("cached prompt")
+        assert first["cached"] is False
+        second = await batcher.submit("cached prompt")
+        assert second["cached"] is True
+        assert len(backend.calls) == 1
+        assert batcher.get_metrics()["total_cache_hits"] == 1
+    finally:
+        await batcher.stop()
+
+
+async def test_per_request_sampling_params_survive_batching():
+    """The reference quirk (batcher.py:271: first request's temp applies to
+    all) must NOT reproduce: each request keeps its own params."""
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 2, "max_wait_time_ms": 5000.0},
+        cache={"enabled": False},
+    )
+    batcher, backend = make_batcher(config)
+    await batcher.start()
+    try:
+        await asyncio.gather(
+            batcher.submit("x", temperature=0.1),
+            batcher.submit("y", temperature=0.9),
+        )
+        params = backend.params_seen[0]
+        temps = sorted(p.temperature for p in params)
+        assert temps == [0.1, 0.9]
+    finally:
+        await batcher.stop()
+
+
+async def test_batch_error_fails_all_futures():
+    batcher, _ = make_batcher(backend=CountingBackend(fail=True))
+    await batcher.start()
+    try:
+        results = await asyncio.gather(
+            batcher.submit("a"),
+            batcher.submit("b"),
+            return_exceptions=True,
+        )
+        assert all(isinstance(r, RuntimeError) for r in results)
+    finally:
+        await batcher.stop()
+
+
+async def test_server_survives_batch_error():
+    backend = CountingBackend(fail=True)
+    batcher, _ = make_batcher(backend=backend)
+    await batcher.start()
+    try:
+        with pytest.raises(RuntimeError):
+            await batcher.submit("boom")
+        backend.fail = False
+        result = await batcher.submit("recovered")
+        assert result["text"] == "out:recovered"
+    finally:
+        await batcher.stop()
+
+
+async def test_concurrent_load():
+    """20-way concurrency (reference: tests/test_batcher.py:214-229)."""
+    batcher, backend = make_batcher()
+    await batcher.start()
+    try:
+        results = await asyncio.gather(
+            *[batcher.submit(f"p{i % 7}") for i in range(20)]
+        )
+        assert len(results) == 20
+        stats = batcher.get_metrics()
+        assert stats["total_requests"] == 20
+        # batching must have collapsed 20 requests into fewer inferences
+        assert len(backend.calls) < 20
+    finally:
+        await batcher.stop()
+
+
+async def test_graceful_shutdown_drains_queue():
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 64, "max_wait_time_ms": 60000.0},
+    )
+    batcher, backend = make_batcher(config)
+    await batcher.start()
+    task = asyncio.create_task(batcher.submit("pending"))
+    await asyncio.sleep(0.05)  # let it enqueue
+    await batcher.stop()
+    result = await asyncio.wait_for(task, timeout=2)
+    assert result["text"] == "out:pending"

@@ -1,0 +1,65 @@
+"""LRU cache semantics (reference: tests/test_cache.py:45-148)."""
+
+from vgate_tpu.cache import ResultCache
+
+
+def test_key_stability():
+    k1 = ResultCache.make_key("hello", 0.7, 0.95, 100)
+    k2 = ResultCache.make_key("hello", 0.7, 0.95, 100)
+    assert k1 == k2
+    assert len(k1) == 16
+
+
+def test_key_sensitivity():
+    base = ResultCache.make_key("hello", 0.7, 0.95, 100)
+    assert ResultCache.make_key("hello!", 0.7, 0.95, 100) != base
+    assert ResultCache.make_key("hello", 0.8, 0.95, 100) != base
+    assert ResultCache.make_key("hello", 0.7, 0.9, 100) != base
+    assert ResultCache.make_key("hello", 0.7, 0.95, 101) != base
+    assert ResultCache.make_key("hello", 0.7, 0.95, 100, top_k=5) != base
+
+
+async def test_get_put_roundtrip():
+    cache = ResultCache(max_size=4)
+    assert await cache.get("k") is None
+    await cache.put("k", {"text": "v"})
+    assert (await cache.get("k"))["text"] == "v"
+
+
+async def test_lru_eviction_order():
+    cache = ResultCache(max_size=2)
+    await cache.put("a", 1)
+    await cache.put("b", 2)
+    assert await cache.get("a") == 1  # touch a => b becomes LRU
+    await cache.put("c", 3)
+    assert await cache.get("b") is None
+    assert await cache.get("a") == 1
+    assert await cache.get("c") == 3
+
+
+async def test_disabled_cache():
+    cache = ResultCache(max_size=4, enabled=False)
+    await cache.put("k", 1)
+    assert await cache.get("k") is None
+    assert cache.get_stats()["enabled"] is False
+
+
+async def test_stats():
+    cache = ResultCache(max_size=1)
+    await cache.put("a", 1)
+    await cache.get("a")
+    await cache.get("missing")
+    await cache.put("b", 2)  # evicts a
+    stats = cache.get_stats()
+    assert stats["hits"] == 1
+    assert stats["misses"] == 1
+    assert stats["evictions"] == 1
+    assert stats["size"] == 1
+    assert 0 < stats["hit_rate"] < 1
+
+
+async def test_clear():
+    cache = ResultCache(max_size=4)
+    await cache.put("a", 1)
+    await cache.clear()
+    assert await cache.get("a") is None

@@ -1,0 +1,86 @@
+"""Config layering tests (reference: tests/test_config.py:227-272 asserts
+priority env > yaml > default; same matrix here plus the TPU section)."""
+
+import os
+
+import pytest
+
+from vgate_tpu.config import (
+    VGTConfig,
+    get_config,
+    load_config,
+    reset_config,
+    set_config,
+)
+
+
+def test_defaults():
+    cfg = VGTConfig()
+    assert cfg.server.port == 8000
+    assert cfg.model.engine_type == "jax_tpu"
+    assert cfg.batch.max_batch_size == 8
+    assert cfg.batch.max_wait_time_ms == 50.0
+    assert cfg.cache.enabled is True
+    assert cfg.tpu.kv_page_size == 16
+    assert cfg.tpu.max_batch_slots == 32
+
+
+def test_yaml_overrides(tmp_path):
+    path = tmp_path / "config.yaml"
+    path.write_text(
+        "server:\n  port: 9001\nbatch:\n  max_batch_size: 16\n"
+        "tpu:\n  tp: 4\n"
+    )
+    cfg = load_config(str(path))
+    assert cfg.server.port == 9001
+    assert cfg.batch.max_batch_size == 16
+    assert cfg.tpu.tp == 4
+    # untouched defaults survive the merge
+    assert cfg.cache.max_size == 1024
+
+
+def test_env_overrides_beat_yaml(tmp_path, monkeypatch):
+    path = tmp_path / "config.yaml"
+    path.write_text("server:\n  port: 9001\n")
+    monkeypatch.setenv("VGT_SERVER__PORT", "9002")
+    monkeypatch.setenv("VGT_CACHE__ENABLED", "false")
+    monkeypatch.setenv("VGT_TPU__PREFILL_BUCKETS", "[64, 128]")
+    cfg = load_config(str(path))
+    assert cfg.server.port == 9002
+    assert cfg.cache.enabled is False
+    assert cfg.tpu.prefill_buckets == [64, 128]
+
+
+def test_init_overrides_beat_env(monkeypatch):
+    monkeypatch.setenv("VGT_SERVER__PORT", "9002")
+    cfg = load_config(server={"port": 9003})
+    assert cfg.server.port == 9003
+
+
+def test_engine_type_validation():
+    with pytest.raises(ValueError):
+        load_config(model={"engine_type": "cuda"})
+
+
+def test_dtype_validation():
+    with pytest.raises(ValueError):
+        load_config(model={"dtype": "float64"})
+
+
+def test_singleton_and_reset():
+    a = get_config()
+    assert get_config() is a
+    reset_config()
+    b = get_config()
+    assert b is not a
+    custom = load_config(server={"port": 1234})
+    set_config(custom)
+    assert get_config().server.port == 1234
+
+
+def test_config_path_env(tmp_path, monkeypatch):
+    path = tmp_path / "alt.yaml"
+    path.write_text("server:\n  port: 7777\n")
+    monkeypatch.setenv("VGT_CONFIG_PATH", str(path))
+    cfg = load_config()
+    assert cfg.server.port == 7777

@@ -1,0 +1,137 @@
+"""Auth + rate limiting (reference: tests/test_security.py:37-120 window math,
+:169-320 middleware behavior via in-process test client)."""
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from vgate_tpu.config import load_config
+from vgate_tpu.security import RateLimiter, build_security_middleware
+
+
+class TestRateLimiterWindow:
+    def test_allows_under_limit(self):
+        rl = RateLimiter(requests_per_minute=3)
+        for _ in range(3):
+            allowed, _ = rl.check("k", now=100.0)
+            assert allowed
+
+    def test_blocks_over_limit(self):
+        rl = RateLimiter(requests_per_minute=2)
+        rl.check("k", now=100.0)
+        rl.check("k", now=101.0)
+        allowed, headers = rl.check("k", now=102.0)
+        assert not allowed
+        assert headers["X-RateLimit-Remaining"] == "0"
+        assert int(headers["Retry-After"]) >= 1
+
+    def test_window_slides(self):
+        rl = RateLimiter(requests_per_minute=1, window_s=60.0)
+        assert rl.check("k", now=100.0)[0]
+        assert not rl.check("k", now=130.0)[0]
+        assert rl.check("k", now=161.0)[0]  # first entry expired
+
+    def test_per_key_limits(self):
+        rl = RateLimiter(requests_per_minute=1, per_key_limits={"vip": 100})
+        assert rl.limit_for("vip") == 100
+        assert rl.limit_for("other") == 1
+
+    def test_keys_are_independent(self):
+        rl = RateLimiter(requests_per_minute=1)
+        assert rl.check("a", now=1.0)[0]
+        assert rl.check("b", now=1.0)[0]
+        assert not rl.check("a", now=2.0)[0]
+
+    def test_headers_report_remaining(self):
+        rl = RateLimiter(requests_per_minute=5)
+        _, headers = rl.check("k", now=1.0)
+        assert headers["X-RateLimit-Limit"] == "5"
+        assert headers["X-RateLimit-Remaining"] == "4"
+
+
+def _secured_app(config):
+    async def ok(request):
+        return web.json_response({"ok": True})
+
+    app = web.Application(middlewares=[build_security_middleware(config)])
+    app.router.add_get("/v1/thing", ok)
+    app.router.add_get("/health", ok)
+    return app
+
+
+async def _client(config):
+    client = TestClient(TestServer(_secured_app(config)))
+    await client.start_server()
+    return client
+
+
+SEC_CONFIG = dict(
+    security={"enabled": True, "api_keys": ["sk-good"]},
+    rate_limit={"enabled": True, "requests_per_minute": 2},
+)
+
+
+async def test_missing_key_is_401():
+    client = await _client(load_config(**SEC_CONFIG))
+    try:
+        resp = await client.get("/v1/thing")
+        assert resp.status == 401
+        body = await resp.json()
+        assert body["error"]["type"] == "authentication_error"
+    finally:
+        await client.close()
+
+
+async def test_invalid_key_is_401():
+    client = await _client(load_config(**SEC_CONFIG))
+    try:
+        resp = await client.get(
+            "/v1/thing", headers={"Authorization": "Bearer sk-bad"}
+        )
+        assert resp.status == 401
+    finally:
+        await client.close()
+
+
+async def test_valid_key_passes_with_headers():
+    client = await _client(load_config(**SEC_CONFIG))
+    try:
+        resp = await client.get(
+            "/v1/thing", headers={"Authorization": "Bearer sk-good"}
+        )
+        assert resp.status == 200
+        assert resp.headers["X-RateLimit-Limit"] == "2"
+    finally:
+        await client.close()
+
+
+async def test_rate_limit_429_with_retry_after():
+    client = await _client(load_config(**SEC_CONFIG))
+    try:
+        headers = {"Authorization": "Bearer sk-good"}
+        await client.get("/v1/thing", headers=headers)
+        await client.get("/v1/thing", headers=headers)
+        resp = await client.get("/v1/thing", headers=headers)
+        assert resp.status == 429
+        assert "Retry-After" in resp.headers
+        body = await resp.json()
+        assert body["error"]["type"] == "rate_limit_error"
+    finally:
+        await client.close()
+
+
+async def test_exempt_paths_skip_auth():
+    client = await _client(load_config(**SEC_CONFIG))
+    try:
+        resp = await client.get("/health")
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_security_disabled_passes_everything():
+    client = await _client(load_config())
+    try:
+        resp = await client.get("/v1/thing")
+        assert resp.status == 200
+    finally:
+        await client.close()

@@ -1,0 +1,16 @@
+"""vgate-tpu: a TPU-native, OpenAI-compatible model serving framework.
+
+Capabilities mirror the reference gateway (see SURVEY.md): an HTTP API
+(`/v1/chat/completions`, `/v1/embeddings`, `/v1/benchmark`, `/metrics`,
+`/stats`, `/health`), dynamic request batching with in-batch deduplication,
+an LRU result cache, layered YAML/env configuration, Prometheus metrics with
+trace correlation, API-key auth + sliding-window rate limiting and a Python
+client SDK — but inference is served by an in-house JAX/XLA/Pallas engine
+with continuous batching, a paged KV cache and pjit/shard_map parallelism
+instead of delegating to external GPU engines
+(reference seam: vgate/backends/base.py:21-34).
+"""
+
+from vgate_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,15 @@
+"""Pluggable inference backends (reference seam: vgate/backends/base.py:21-34)."""
+
+from vgate_tpu.backends.base import (
+    DryRunBackend,
+    GenerationResult,
+    InferenceBackend,
+    SamplingParams,
+)
+
+__all__ = [
+    "DryRunBackend",
+    "GenerationResult",
+    "InferenceBackend",
+    "SamplingParams",
+]

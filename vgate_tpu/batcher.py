@@ -1,0 +1,281 @@
+"""Dynamic request batcher with in-batch deduplication and result caching.
+
+Reproduces the reference batcher's externally observable semantics
+(vgate/batcher.py:47-411):
+
+* a batch fires when the queue reaches ``max_batch_size`` or every
+  ``max_wait_time_ms`` via a background loop (batcher.py:177-190);
+* identical requests inside a batch collapse to one inference, keyed by the
+  result-cache key (batcher.py:236-266);
+* results fan back through per-request ``asyncio.Future``s (batcher.py:302-308)
+  and one inference failure fails every future in the batch (batcher.py:310-324);
+* cache hits return on a sub-ms fast path before queuing (batcher.py:149-155).
+
+Deliberate departures from the reference:
+
+* **Per-request sampling params survive batching.**  The reference applies the
+  first request's temperature/top_p to the whole batch (batcher.py:271); here
+  every unique request carries its own ``SamplingParams`` into the backend.
+* **No stop-the-world inference lock.**  The reference serializes all batches
+  behind one asyncio lock (batcher.py:79,195) because concurrent
+  ``vLLM.generate`` calls corrupt its engine.  The jax_tpu backend has its own
+  continuous-batching scheduler that admits new sequences between decode
+  steps, so batches here are pushed through ``generate_async`` concurrently;
+  only backends without async support fall back to a serialized thread-pool
+  hop (the reference's run_in_executor pattern, batcher.py:326-361).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from vgate_tpu import metrics
+from vgate_tpu.backends.base import GenerationResult, SamplingParams
+from vgate_tpu.cache import ResultCache
+from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.engine import VGTEngine
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.tracing import get_tracer
+
+logger = get_logger(__name__)
+tracer = get_tracer(__name__)
+
+
+@dataclass
+class BatchRequest:
+    """One queued request (reference: vgate/batcher.py:35-44)."""
+
+    request_id: str
+    prompt: str
+    params: SamplingParams
+    cache_key: str
+    future: "asyncio.Future[Dict[str, Any]]"
+    enqueued_at: float = field(default_factory=time.perf_counter)
+
+
+class RequestBatcher:
+    def __init__(
+        self,
+        engine: VGTEngine,
+        config: Optional[VGTConfig] = None,
+        cache: Optional[ResultCache] = None,
+    ) -> None:
+        self.config = config or get_config()
+        self.engine = engine
+        self.cache = cache or ResultCache(
+            max_size=self.config.cache.max_size,
+            enabled=self.config.cache.enabled,
+        )
+        self._queue: List[BatchRequest] = []
+        self._queue_lock = asyncio.Lock()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._running = False
+        # Backends without generate_async share one worker hop at a time
+        # (the reference's global _inference_lock, batcher.py:79).
+        self._sync_lock = asyncio.Lock()
+        # Stats mirrored by /stats (reference: batcher.py:401-411).
+        self._total_requests = 0
+        self._total_batches = 0
+        self._total_deduped = 0
+        self._total_cache_hits = 0
+
+    # -- lifecycle (reference: vgate/batcher.py:89-114) --
+
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._loop_task = asyncio.create_task(self._batch_loop())
+        logger.info(
+            "batcher started",
+            extra={
+                "extra_data": {
+                    "max_batch_size": self.config.batch.max_batch_size,
+                    "max_wait_time_ms": self.config.batch.max_wait_time_ms,
+                }
+            },
+        )
+
+    async def stop(self) -> None:
+        """Drain the queue, then cancel the loop (reference: batcher.py:103-114)."""
+        self._running = False
+        if self._queue:
+            await self._process_batch()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+
+    # -- submission (reference: vgate/batcher.py:116-182) --
+
+    async def submit(
+        self,
+        prompt: str,
+        max_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        request_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        inf = self.config.inference
+        params = SamplingParams(
+            max_tokens=max_tokens if max_tokens is not None else inf.max_tokens,
+            temperature=(
+                temperature if temperature is not None else inf.temperature
+            ),
+            top_p=top_p if top_p is not None else inf.top_p,
+            top_k=top_k if top_k is not None else inf.top_k,
+        )
+        with tracer.start_as_current_span("batcher.submit"):
+            self._total_requests += 1
+            cache_key = ResultCache.make_key(
+                prompt,
+                params.temperature,
+                params.top_p,
+                params.max_tokens,
+                params.top_k,
+            )
+            cached = await self.cache.get(cache_key)
+            if cached is not None:
+                self._total_cache_hits += 1
+                result = dict(cached)
+                result["cached"] = True
+                return result
+
+            request = BatchRequest(
+                request_id=request_id or uuid.uuid4().hex[:12],
+                prompt=prompt,
+                params=params,
+                cache_key=cache_key,
+                future=asyncio.get_running_loop().create_future(),
+            )
+            async with self._queue_lock:
+                self._queue.append(request)
+                metrics.PENDING_REQUESTS.set(len(self._queue))
+                trigger = len(self._queue) >= self.config.batch.max_batch_size
+            if trigger:
+                asyncio.ensure_future(self._process_batch())
+            return await request.future
+
+    # -- batch firing (reference: vgate/batcher.py:184-324) --
+
+    async def _batch_loop(self) -> None:
+        wait_s = self.config.batch.max_wait_time_ms / 1000.0
+        while self._running:
+            await asyncio.sleep(wait_s)
+            if self._queue:
+                await self._process_batch()
+
+    async def _process_batch(self) -> None:
+        async with self._queue_lock:
+            batch = self._queue[: self.config.batch.max_batch_size]
+            del self._queue[: len(batch)]
+            metrics.PENDING_REQUESTS.set(len(self._queue))
+        if not batch:
+            return
+        with tracer.start_as_current_span("batcher.process_batch") as span:
+            start = time.perf_counter()
+            now = start
+            for req in batch:
+                metrics.QUEUE_TIME.observe(now - req.enqueued_at)
+            # In-batch dedup: group by cache key (reference: batcher.py:236-266).
+            groups: Dict[str, List[BatchRequest]] = {}
+            for req in batch:
+                groups.setdefault(req.cache_key, []).append(req)
+            unique = [reqs[0] for reqs in groups.values()]
+            n_duplicates = len(batch) - len(unique)
+            self._total_deduped += n_duplicates
+            if n_duplicates:
+                metrics.DEDUP_REQUESTS.inc(n_duplicates)
+            metrics.DEDUP_RATIO.set(n_duplicates / len(batch))
+            metrics.BATCH_SIZE.observe(len(batch))
+            metrics.UNIQUE_PROMPTS.observe(len(unique))
+            metrics.BATCHES_TOTAL.inc()
+            self._total_batches += 1
+            span.set_attribute("batch.size", len(batch))
+            span.set_attribute("batch.unique", len(unique))
+
+            try:
+                results = await self._run_batch_inference(unique)
+            except Exception as exc:  # fail the whole batch (batcher.py:310-324)
+                metrics.INFERENCE_ERRORS.labels(
+                    error_type=type(exc).__name__
+                ).inc()
+                logger.error(
+                    "batch inference failed",
+                    extra={"extra_data": {"batch_size": len(batch)}},
+                    exc_info=True,
+                )
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+                return
+
+            elapsed = time.perf_counter() - start
+            metrics.observe_with_exemplar(metrics.BATCH_PROCESSING_TIME, elapsed)
+            for lead, result in zip(unique, results):
+                payload = self._normalize(lead, result)
+                await self.cache.put(lead.cache_key, payload)
+                for req in groups[lead.cache_key]:
+                    if not req.future.done():
+                        out = dict(payload)
+                        out["cached"] = False
+                        req.future.set_result(out)
+
+    async def _run_batch_inference(
+        self, unique: List[BatchRequest]
+    ) -> List[GenerationResult]:
+        """Dispatch to the backend, preferring its async path
+        (reference thread hop: vgate/batcher.py:326-399)."""
+        prompts = [req.prompt for req in unique]
+        params = [req.params for req in unique]
+        backend = self.engine.backend
+        with tracer.start_as_current_span("batcher.inference"):
+            gen_async = getattr(backend, "generate_async", None)
+            if gen_async is not None:
+                return await gen_async(prompts, params)
+            async with self._sync_lock:
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(
+                    None, lambda: backend.generate(prompts, params)
+                )
+
+    @staticmethod
+    def _normalize(req: BatchRequest, result: GenerationResult) -> Dict[str, Any]:
+        out = result.to_dict()
+        m = out.get("metrics", {})
+        if "ttft" in m:
+            metrics.TTFT.observe(m["ttft"])
+        if "tpot" in m:
+            metrics.TPOT.observe(m["tpot"])
+        if result.num_tokens:
+            metrics.GENERATED_TOKENS.inc(result.num_tokens)
+        if result.prompt_tokens:
+            metrics.PROMPT_TOKENS.inc(result.prompt_tokens)
+        out["request_id"] = req.request_id
+        return out
+
+    # -- stats (reference: vgate/batcher.py:401-411) --
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "total_requests": self._total_requests,
+            "total_batches": self._total_batches,
+            "total_deduplicated": self._total_deduped,
+            "total_cache_hits": self._total_cache_hits,
+            "pending_requests": len(self._queue),
+            "avg_batch_size": (
+                (self._total_requests - self._total_cache_hits)
+                / self._total_batches
+                if self._total_batches
+                else 0.0
+            ),
+            "running": self._running,
+        }

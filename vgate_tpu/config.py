@@ -1,0 +1,290 @@
+"""Layered configuration system.
+
+Mirrors the reference's config contract (vgate/config.py:15-27, 174-224):
+priority is explicit init kwargs > environment variables (``VGT_`` prefix with
+``__`` section nesting, e.g. ``VGT_BATCH__MAX_BATCH_SIZE=16``) > YAML file
+(``VGT_CONFIG_PATH`` or ``./config.yaml``) > model defaults.  Implemented on
+plain pydantic v2 (pydantic-settings is not available in this environment).
+
+TPU-specific additions over the reference: a ``tpu`` section describing the
+device mesh, dtype, static-shape buckets and the paged KV cache (SURVEY.md
+section 5.6 calls for exactly this extension).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import yaml
+from pydantic import BaseModel, Field, field_validator
+
+ENV_PREFIX = "VGT_"
+CONFIG_PATH_ENV = "VGT_CONFIG_PATH"
+
+VALID_ENGINE_TYPES = ("dry_run", "jax_tpu")
+
+
+class ServerConfig(BaseModel):
+    """HTTP server settings (reference: vgate/config.py:37-40)."""
+
+    host: str = "0.0.0.0"
+    port: int = 8000
+    request_timeout_s: float = 300.0
+
+
+class ModelConfig(BaseModel):
+    """Model + engine selection (reference: vgate/config.py:42-59)."""
+
+    model_id: str = "Qwen/Qwen2.5-1.5B-Instruct"
+    engine_type: str = "jax_tpu"
+    # Local checkpoint dir with safetensors; None => random-init weights
+    # (this environment has no network egress, so HF downloads are gated).
+    checkpoint_path: Optional[str] = None
+    tokenizer_path: Optional[str] = None
+    dtype: str = "bfloat16"
+    quantization: Optional[str] = None  # None | "int8"
+    max_model_len: int = 2048
+    embedding_model_id: str = "BAAI/bge-base-en-v1.5"
+    embedding_checkpoint_path: Optional[str] = None
+
+    @field_validator("engine_type")
+    @classmethod
+    def _check_engine_type(cls, v: str) -> str:
+        if v not in VALID_ENGINE_TYPES:
+            raise ValueError(
+                f"engine_type must be one of {VALID_ENGINE_TYPES}, got {v!r}"
+            )
+        return v
+
+    @field_validator("dtype")
+    @classmethod
+    def _check_dtype(cls, v: str) -> str:
+        if v not in ("bfloat16", "float32", "float16"):
+            raise ValueError(f"unsupported dtype {v!r}")
+        return v
+
+
+class TPUConfig(BaseModel):
+    """Device mesh + engine shape settings (TPU-only addition, SURVEY.md 5.6).
+
+    Mesh axes follow the scaling-book convention: data (dp), tensor/model
+    (tp), expert (ep) and sequence (sp) parallelism.  ``mesh_shape`` values of
+    0 mean "use all visible devices on this axis" resolved at engine start.
+    """
+
+    dp: int = 1
+    tp: int = 0  # 0 => all devices
+    ep: int = 1
+    sp: int = 1
+    # Paged KV cache geometry.
+    kv_page_size: int = 16  # tokens per page
+    kv_num_pages: int = 0  # 0 => auto-size from free HBM
+    hbm_utilization: float = 0.9
+    # Continuous batching shapes (static for XLA).
+    max_batch_slots: int = 32
+    prefill_buckets: List[int] = Field(
+        default_factory=lambda: [128, 256, 512, 1024, 2048]
+    )
+    # Use Pallas kernels where available; False falls back to jnp reference
+    # implementations (needed on CPU test meshes).
+    use_pallas: bool = True
+    donate_kv: bool = True
+
+
+class BatchConfig(BaseModel):
+    """Gateway-side dynamic batching (reference: vgate/config.py:62-66)."""
+
+    max_batch_size: int = 8
+    max_wait_time_ms: float = 50.0
+
+
+class CacheConfig(BaseModel):
+    """Result cache (reference: vgate/config.py:68-72)."""
+
+    enabled: bool = True
+    max_size: int = 1024
+
+
+class SchedulerConfig(BaseModel):
+    """Continuous-batching scheduler (no reference equivalent; lives inside
+    vLLM in the reference — SURVEY.md section 2.1)."""
+
+    max_num_seqs: int = 32
+    max_queue_size: int = 512
+    admission_deadline_ms: float = 0.0  # 0 => no deadline-based shedding
+    preempt_on_oom: bool = True
+
+
+class InferenceConfig(BaseModel):
+    """Default sampling parameters (reference: vgate/config.py:74-80)."""
+
+    max_tokens: int = 256
+    temperature: float = 0.7
+    top_p: float = 0.95
+    top_k: int = 0  # 0 => disabled
+
+
+class LoggingConfig(BaseModel):
+    level: str = "INFO"
+    format: str = "console"  # "json" | "console"
+
+    @field_validator("format")
+    @classmethod
+    def _check_format(cls, v: str) -> str:
+        if v not in ("json", "console"):
+            raise ValueError("logging.format must be 'json' or 'console'")
+        return v
+
+
+class MetricsConfig(BaseModel):
+    enabled: bool = True
+
+
+class TracingConfig(BaseModel):
+    enabled: bool = False
+    endpoint: str = "localhost:4317"
+    sample_rate: float = 1.0
+    service_name: str = "vgate-tpu"
+
+
+class SecurityConfig(BaseModel):
+    """API-key auth (reference: vgate/config.py:101-115)."""
+
+    enabled: bool = False
+    api_keys: List[str] = Field(default_factory=list)
+    exempt_paths: List[str] = Field(
+        default_factory=lambda: ["/health", "/metrics"]
+    )
+
+
+class RateLimitConfig(BaseModel):
+    """Sliding-window rate limiting (reference: vgate/config.py:117-126)."""
+
+    enabled: bool = False
+    requests_per_minute: int = 60
+    per_key_limits: Dict[str, int] = Field(default_factory=dict)
+
+
+class BenchmarkConfig(BaseModel):
+    prompts: List[str] = Field(
+        default_factory=lambda: [
+            "Explain the benefits of systolic arrays in two sentences.",
+            "Write a haiku about high-bandwidth memory.",
+            "What is sequence parallelism?",
+        ]
+    )
+    rounds: int = 3
+    warmup_rounds: int = 1
+    max_tokens: int = 64
+
+
+class VGTConfig(BaseModel):
+    """Root config object."""
+
+    server: ServerConfig = Field(default_factory=ServerConfig)
+    model: ModelConfig = Field(default_factory=ModelConfig)
+    tpu: TPUConfig = Field(default_factory=TPUConfig)
+    batch: BatchConfig = Field(default_factory=BatchConfig)
+    cache: CacheConfig = Field(default_factory=CacheConfig)
+    scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
+    inference: InferenceConfig = Field(default_factory=InferenceConfig)
+    logging: LoggingConfig = Field(default_factory=LoggingConfig)
+    metrics: MetricsConfig = Field(default_factory=MetricsConfig)
+    tracing: TracingConfig = Field(default_factory=TracingConfig)
+    security: SecurityConfig = Field(default_factory=SecurityConfig)
+    rate_limit: RateLimitConfig = Field(default_factory=RateLimitConfig)
+    benchmark: BenchmarkConfig = Field(default_factory=BenchmarkConfig)
+
+
+def _deep_merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for key, val in override.items():
+        if key in out and isinstance(out[key], dict) and isinstance(val, dict):
+            out[key] = _deep_merge(out[key], val)
+        else:
+            out[key] = val
+    return out
+
+
+def _coerce(raw: str) -> Any:
+    """Parse an env-var string: JSON first, then bool words, else string."""
+    try:
+        return json.loads(raw)
+    except (ValueError, TypeError):
+        lowered = raw.lower()
+        if lowered in ("true", "yes", "on"):
+            return True
+        if lowered in ("false", "no", "off"):
+            return False
+        return raw
+
+
+def _env_overrides(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Collect ``VGT_SECTION__KEY=value`` overrides into a nested dict."""
+    environ = environ if environ is not None else os.environ  # type: ignore[assignment]
+    result: Dict[str, Any] = {}
+    for name, raw in environ.items():
+        if not name.startswith(ENV_PREFIX) or name == CONFIG_PATH_ENV:
+            continue
+        path = name[len(ENV_PREFIX):].lower().split("__")
+        if len(path) < 2:
+            continue  # VGT_DRY_RUN-style flat flags are read directly
+        node = result
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = _coerce(raw)
+    return result
+
+
+def _yaml_values(path: Optional[str]) -> Dict[str, Any]:
+    if path is None:
+        path = os.environ.get(CONFIG_PATH_ENV)
+    if path is None and os.path.exists("config.yaml"):
+        path = "config.yaml"
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = yaml.safe_load(fh) or {}
+    if not isinstance(data, dict):
+        raise ValueError(f"config file {path} must contain a mapping")
+    return data
+
+
+def load_config(
+    config_path: Optional[str] = None, **overrides: Any
+) -> VGTConfig:
+    """Build a config with priority init > env > yaml > defaults
+    (reference semantics: vgate/config.py:174-224)."""
+    merged = _deep_merge(_yaml_values(config_path), _env_overrides())
+    merged = _deep_merge(merged, overrides)
+    return VGTConfig(**merged)
+
+
+_config_lock = threading.Lock()
+_config: Optional[VGTConfig] = None
+
+
+def get_config() -> VGTConfig:
+    """Global config singleton (reference: vgate/config.py:280-304)."""
+    global _config
+    if _config is None:
+        with _config_lock:
+            if _config is None:
+                _config = load_config()
+    return _config
+
+
+def set_config(config: VGTConfig) -> None:
+    global _config
+    with _config_lock:
+        _config = config
+
+
+def reset_config() -> None:
+    """Drop the singleton so tests can re-load (vgate/config.py:307-315)."""
+    global _config
+    with _config_lock:
+        _config = None

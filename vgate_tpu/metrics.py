@@ -1,0 +1,208 @@
+"""Prometheus metrics registry.
+
+Recreates the reference's metric surface (vgate/metrics.py:51-196) under the
+``vgt_`` namespace, plus TPU-engine metrics the reference could not have
+(device step time, KV-page occupancy, prefill/decode token counters).
+``_safe_metric`` keeps re-registration idempotent so test re-imports don't
+blow up (reference: vgate/metrics.py:26-44).  Exemplar attachment (trace-id
+correlation, reference main.py:142-153) is supported through the
+``observe_with_exemplar`` / ``inc_with_exemplar`` helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from prometheus_client import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Info,
+    generate_latest,
+)
+from prometheus_client.openmetrics import exposition as om_exposition
+
+from vgate_tpu.tracing import get_current_trace_id
+
+
+def _safe_metric(cls, name: str, documentation: str, **kwargs: Any):
+    """Return the existing collector when already registered
+    (reference: vgate/metrics.py:26-44)."""
+    try:
+        return cls(name, documentation, **kwargs)
+    except ValueError:
+        collector = REGISTRY._names_to_collectors.get(name)
+        if collector is None:  # pragma: no cover
+            raise
+        return collector
+
+
+# --- HTTP request metrics (reference: vgate/metrics.py:57-77) ---
+REQUEST_COUNT = _safe_metric(
+    Counter,
+    "vgt_requests",
+    "HTTP requests processed",
+    labelnames=("method", "endpoint", "status"),
+)
+REQUEST_LATENCY = _safe_metric(
+    Histogram,
+    "vgt_request_latency_seconds",
+    "HTTP request latency",
+    labelnames=("method", "endpoint"),
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60),
+)
+REQUESTS_IN_PROGRESS = _safe_metric(
+    Gauge, "vgt_requests_in_progress", "In-flight HTTP requests"
+)
+
+# --- batching metrics (reference: vgate/metrics.py:83-114) ---
+BATCH_SIZE = _safe_metric(
+    Histogram,
+    "vgt_batch_size",
+    "Requests per processed batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+BATCH_PROCESSING_TIME = _safe_metric(
+    Histogram,
+    "vgt_batch_processing_seconds",
+    "Wall time to process one batch",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60),
+)
+QUEUE_TIME = _safe_metric(
+    Histogram,
+    "vgt_queue_time_seconds",
+    "Time a request waited in the batch queue",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1),
+)
+PENDING_REQUESTS = _safe_metric(
+    Gauge, "vgt_pending_requests", "Requests waiting in the batch queue"
+)
+BATCHES_TOTAL = _safe_metric(Counter, "vgt_batches", "Batches processed")
+
+# --- inference metrics (reference: vgate/metrics.py:120-152) ---
+TTFT = _safe_metric(
+    Histogram,
+    "vgt_time_to_first_token_seconds",
+    "Time to first token",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1, 2, 5),
+)
+TPOT = _safe_metric(
+    Histogram,
+    "vgt_time_per_output_token_seconds",
+    "Mean time per output token",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25),
+)
+GENERATED_TOKENS = _safe_metric(
+    Counter, "vgt_generated_tokens", "Output tokens generated"
+)
+PROMPT_TOKENS = _safe_metric(
+    Counter, "vgt_prompt_tokens", "Prompt tokens processed"
+)
+INFERENCE_ERRORS = _safe_metric(
+    Counter,
+    "vgt_inference_errors",
+    "Inference failures",
+    labelnames=("error_type",),
+)
+UNIQUE_PROMPTS = _safe_metric(
+    Histogram,
+    "vgt_unique_prompts_per_batch",
+    "Unique prompts per batch after dedup",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+
+# --- cache metrics (reference: vgate/metrics.py:158-180) ---
+CACHE_HITS = _safe_metric(Counter, "vgt_cache_hits", "Result-cache hits")
+CACHE_MISSES = _safe_metric(Counter, "vgt_cache_misses", "Result-cache misses")
+CACHE_SIZE = _safe_metric(Gauge, "vgt_cache_size", "Entries in result cache")
+CACHE_EVICTIONS = _safe_metric(
+    Counter, "vgt_cache_evictions", "Result-cache LRU evictions"
+)
+
+# --- dedup metrics (reference: vgate/metrics.py:186-196) ---
+DEDUP_REQUESTS = _safe_metric(
+    Counter, "vgt_deduplicated_requests", "Requests answered by in-batch dedup"
+)
+DEDUP_RATIO = _safe_metric(
+    Gauge, "vgt_dedup_ratio", "Duplicate fraction of the last batch"
+)
+
+# --- TPU engine metrics (no reference equivalent; engine lives in-house) ---
+ENGINE_STEP_TIME = _safe_metric(
+    Histogram,
+    "vgt_engine_step_seconds",
+    "Device time per continuous-batching step",
+    labelnames=("kind",),  # prefill | decode
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5),
+)
+KV_PAGES_IN_USE = _safe_metric(
+    Gauge, "vgt_kv_pages_in_use", "Allocated KV-cache pages"
+)
+KV_PAGES_TOTAL = _safe_metric(
+    Gauge, "vgt_kv_pages_total", "Total KV-cache pages"
+)
+ACTIVE_SEQUENCES = _safe_metric(
+    Gauge, "vgt_active_sequences", "Sequences resident in decode slots"
+)
+PREEMPTED_SEQUENCES = _safe_metric(
+    Counter, "vgt_preempted_sequences", "Sequences preempted for KV pressure"
+)
+ENGINE_QUEUE_DEPTH = _safe_metric(
+    Gauge, "vgt_engine_queue_depth", "Sequences waiting for engine admission"
+)
+RECOMPILES = _safe_metric(
+    Counter,
+    "vgt_engine_compilations",
+    "XLA compilations triggered",
+    labelnames=("kind",),
+)
+
+INFO = _safe_metric(Info, "vgt_build", "Framework build information")
+
+
+def init_app_info(version: str, model_id: str, engine_type: str) -> None:
+    """Populate the info metric (reference: vgate/metrics.py:199-204)."""
+    INFO.info(
+        {"version": version, "model": model_id, "engine_type": engine_type}
+    )
+
+
+def _exemplar() -> Optional[Dict[str, str]]:
+    trace_id = get_current_trace_id()
+    if trace_id:
+        return {"trace_id": trace_id}
+    return None
+
+
+def observe_with_exemplar(histogram_child, value: float) -> None:
+    """Attach the current trace id as an exemplar when available
+    (reference exemplar wiring: main.py:142-153)."""
+    try:
+        histogram_child.observe(value, exemplar=_exemplar())
+    except (TypeError, ValueError):  # pragma: no cover
+        histogram_child.observe(value)
+
+
+def inc_with_exemplar(counter_child, value: float = 1.0) -> None:
+    try:
+        counter_child.inc(value, exemplar=_exemplar())
+    except (TypeError, ValueError):  # pragma: no cover
+        counter_child.inc(value)
+
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+
+def render_metrics(accept_header: str = "") -> tuple[bytes, str]:
+    """Render the registry, negotiating OpenMetrics when requested
+    (reference: main.py:278-295)."""
+    if "application/openmetrics-text" in (accept_header or ""):
+        return (
+            om_exposition.generate_latest(REGISTRY),
+            OPENMETRICS_CONTENT_TYPE,
+        )
+    return generate_latest(REGISTRY), PROMETHEUS_CONTENT_TYPE

@@ -1,0 +1,5 @@
+"""HTTP gateway (aiohttp) exposing the OpenAI-compatible API."""
+
+from vgate_tpu.server.app import create_app
+
+__all__ = ["create_app"]

@@ -1,0 +1,422 @@
+"""The aiohttp gateway application.
+
+Endpoint surface matches the reference's FastAPI app (main.py:199-386):
+``/health``, ``/v1/chat/completions``, ``/v1/embeddings``, ``/metrics``,
+``/stats``, ``/v1/benchmark`` — plus ``/v1/models`` and SSE streaming for
+chat completions (capability additions).  Engine + batcher construction
+happens in ``on_startup``, not at module import, preserving the reference's
+lifespan lesson (main.py:48-66: engine init must happen inside the app
+lifecycle, after process setup).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from vgate_tpu import metrics
+from vgate_tpu.batcher import RequestBatcher
+from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.engine import VGTEngine
+from vgate_tpu.logging_config import get_logger, setup_logging
+from vgate_tpu.security import build_security_middleware
+from vgate_tpu.server.openai_models import (
+    BenchmarkRequest,
+    ChatCompletion,
+    ChatCompletionRequest,
+    ChatMessage,
+    Choice,
+    EmbeddingData,
+    EmbeddingRequest,
+    EmbeddingResponse,
+    Usage,
+    messages_to_prompt,
+)
+from vgate_tpu.tracing import get_tracer, init_tracing, shutdown_tracing
+from vgate_tpu.version import __version__
+
+logger = get_logger(__name__)
+tracer = get_tracer(__name__)
+
+_QUIET_PATHS = {"/health", "/metrics"}
+
+
+def _error(status: int, message: str, err_type: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": err_type}}, status=status
+    )
+
+
+@web.middleware
+async def observability_middleware(request: web.Request, handler):
+    """Request metrics + latency + X-Request-ID (reference: main.py:118-172)."""
+    request_id = request.headers.get("X-Request-ID", uuid.uuid4().hex[:16])
+    start = time.perf_counter()
+    metrics.REQUESTS_IN_PROGRESS.inc()
+    try:
+        with tracer.start_as_current_span(
+            f"{request.method} {request.path}"
+        ) as span:
+            span.set_attribute("http.method", request.method)
+            span.set_attribute("http.route", request.path)
+            response = await handler(request)
+    except web.HTTPException as exc:
+        metrics.REQUEST_COUNT.labels(
+            method=request.method, endpoint=request.path, status=exc.status
+        ).inc()
+        raise
+    except Exception:
+        metrics.REQUEST_COUNT.labels(
+            method=request.method, endpoint=request.path, status=500
+        ).inc()
+        logger.error("unhandled error", exc_info=True)
+        return _error(500, "Internal server error", "server_error")
+    finally:
+        metrics.REQUESTS_IN_PROGRESS.dec()
+    elapsed = time.perf_counter() - start
+    metrics.inc_with_exemplar(
+        metrics.REQUEST_COUNT.labels(
+            method=request.method,
+            endpoint=request.path,
+            status=response.status,
+        )
+    )
+    metrics.observe_with_exemplar(
+        metrics.REQUEST_LATENCY.labels(
+            method=request.method, endpoint=request.path
+        ),
+        elapsed,
+    )
+    response.headers["X-Request-ID"] = request_id
+    if request.path not in _QUIET_PATHS:
+        logger.info(
+            "request complete",
+            extra={
+                "extra_data": {
+                    "method": request.method,
+                    "path": request.path,
+                    "status": response.status,
+                    "latency_ms": round(elapsed * 1000, 2),
+                    "request_id": request_id,
+                }
+            },
+        )
+    return response
+
+
+async def health(request: web.Request) -> web.Response:
+    """Liveness/readiness (reference: main.py:199-204); additionally reports
+    device liveness per SURVEY.md section 5.3's gap note."""
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    body: Dict[str, Any] = {
+        "status": "ok" if engine is not None else "starting",
+        "version": __version__,
+    }
+    if engine is not None:
+        body["model"] = engine.config.model.model_id
+        body["engine_type"] = type(engine.backend).__name__
+        device_health = getattr(engine.backend, "device_health", None)
+        if device_health is not None:
+            body["device"] = device_health()
+    status = 200 if engine is not None else 503
+    return web.json_response(body, status=status)
+
+
+async def chat_completions(request: web.Request) -> web.Response:
+    """POST /v1/chat/completions (reference: main.py:207-252)."""
+    try:
+        payload = ChatCompletionRequest(**await request.json())
+    except (ValidationError, ValueError) as exc:
+        return _error(422, f"Invalid request: {exc}", "invalid_request_error")
+    if not payload.messages:
+        return _error(422, "messages must be non-empty", "invalid_request_error")
+    prompt = messages_to_prompt(payload.messages)
+    batcher: RequestBatcher = request.app["batcher"]
+    engine: VGTEngine = request.app["engine"]
+
+    if payload.stream:
+        return await _stream_chat(request, payload, prompt)
+
+    try:
+        result = await batcher.submit(
+            prompt,
+            max_tokens=payload.max_tokens,
+            temperature=payload.temperature,
+            top_p=payload.top_p,
+            top_k=payload.top_k,
+        )
+    except Exception as exc:
+        return _error(500, f"Inference failed: {exc}", "server_error")
+    completion = ChatCompletion(
+        model=payload.model or engine.config.model.model_id,
+        choices=[
+            Choice(
+                index=0,
+                message=ChatMessage(role="assistant", content=result["text"]),
+                finish_reason=result.get("finish_reason", "stop"),
+            )
+        ],
+        usage=Usage(
+            prompt_tokens=result.get("prompt_tokens", 0),
+            completion_tokens=result.get("num_tokens", 0),
+            total_tokens=result.get("prompt_tokens", 0)
+            + result.get("num_tokens", 0),
+        ),
+        cached=result.get("cached", False),
+        metrics=result.get("metrics", {}),
+    )
+    return web.json_response(completion.model_dump())
+
+
+async def _stream_chat(
+    request: web.Request, payload: ChatCompletionRequest, prompt: str
+) -> web.StreamResponse:
+    """SSE streaming.  Uses the backend's token stream when it has one;
+    otherwise generates fully and replays in chunks (dry-run path)."""
+    engine: VGTEngine = request.app["engine"]
+    batcher: RequestBatcher = request.app["batcher"]
+    resp = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        },
+    )
+    await resp.prepare(request)
+    completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+    model_id = payload.model or engine.config.model.model_id
+
+    def _chunk(delta: Dict[str, Any], finish: Optional[str] = None) -> bytes:
+        body = {
+            "id": completion_id,
+            "object": "chat.completion.chunk",
+            "created": int(time.time()),
+            "model": model_id,
+            "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+        }
+        return f"data: {json.dumps(body)}\n\n".encode()
+
+    await resp.write(_chunk({"role": "assistant"}))
+    stream_fn = getattr(engine.backend, "stream_async", None)
+    if stream_fn is not None:
+        params = engine.backend.create_sampling_params(
+            max_tokens=payload.max_tokens
+            or engine.config.inference.max_tokens,
+            temperature=(
+                payload.temperature
+                if payload.temperature is not None
+                else engine.config.inference.temperature
+            ),
+            top_p=(
+                payload.top_p
+                if payload.top_p is not None
+                else engine.config.inference.top_p
+            ),
+            top_k=(
+                payload.top_k
+                if payload.top_k is not None
+                else engine.config.inference.top_k
+            ),
+        )
+        async for piece in stream_fn(prompt, params):
+            await resp.write(_chunk({"content": piece}))
+    else:
+        result = await batcher.submit(
+            prompt,
+            max_tokens=payload.max_tokens,
+            temperature=payload.temperature,
+            top_p=payload.top_p,
+            top_k=payload.top_k,
+        )
+        text = result["text"]
+        step = max(1, len(text) // 16)
+        for i in range(0, len(text), step):
+            await resp.write(_chunk({"content": text[i : i + step]}))
+    await resp.write(_chunk({}, finish="stop"))
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+
+async def embeddings(request: web.Request) -> web.Response:
+    """POST /v1/embeddings (reference: main.py:255-275)."""
+    try:
+        payload = EmbeddingRequest(**await request.json())
+    except (ValidationError, ValueError) as exc:
+        return _error(422, f"Invalid request: {exc}", "invalid_request_error")
+    inputs = [payload.input] if isinstance(payload.input, str) else payload.input
+    if not inputs:
+        return _error(422, "input must be non-empty", "invalid_request_error")
+    engine: VGTEngine = request.app["engine"]
+    loop = asyncio.get_running_loop()
+    result = await loop.run_in_executor(None, lambda: engine.embeddings(inputs))
+    response = EmbeddingResponse(
+        data=[
+            EmbeddingData(index=i, embedding=vec)
+            for i, vec in enumerate(result["embeddings"])
+        ],
+        model=result["model"],
+        usage=Usage(**result["usage"], completion_tokens=0),
+    )
+    return web.json_response(response.model_dump())
+
+
+async def list_models(request: web.Request) -> web.Response:
+    engine: VGTEngine = request.app["engine"]
+    cfg = engine.config.model
+    return web.json_response(
+        {
+            "object": "list",
+            "data": [
+                {
+                    "id": cfg.model_id,
+                    "object": "model",
+                    "owned_by": "vgate-tpu",
+                },
+                {
+                    "id": cfg.embedding_model_id,
+                    "object": "model",
+                    "owned_by": "vgate-tpu",
+                },
+            ],
+        }
+    )
+
+
+async def prometheus_metrics(request: web.Request) -> web.Response:
+    """GET /metrics with OpenMetrics negotiation (reference: main.py:278-295)."""
+    body, content_type = metrics.render_metrics(request.headers.get("Accept", ""))
+    return web.Response(body=body, content_type=content_type.split(";")[0],
+                        charset="utf-8")
+
+
+async def get_stats(request: web.Request) -> web.Response:
+    """GET /stats mirroring batcher+cache+config state
+    (reference: main.py:298-334)."""
+    batcher: RequestBatcher = request.app["batcher"]
+    engine: VGTEngine = request.app["engine"]
+    stats = {
+        "batcher": batcher.get_metrics(),
+        "cache": batcher.cache.get_stats(),
+        "config": {
+            "max_batch_size": engine.config.batch.max_batch_size,
+            "max_wait_time_ms": engine.config.batch.max_wait_time_ms,
+            "cache_enabled": engine.config.cache.enabled,
+            "engine_type": engine.config.model.engine_type,
+            "model": engine.config.model.model_id,
+        },
+    }
+    engine_stats = getattr(engine.backend, "get_stats", None)
+    if engine_stats is not None:
+        stats["engine"] = engine_stats()
+    return web.json_response(stats)
+
+
+async def run_benchmark(request: web.Request) -> web.Response:
+    """POST /v1/benchmark through the full pipeline incl. batching + cache
+    (reference: main.py:343-386)."""
+    try:
+        raw = await request.json() if request.can_read_body else {}
+        payload = BenchmarkRequest(**(raw or {}))
+    except (ValidationError, ValueError) as exc:
+        return _error(422, f"Invalid request: {exc}", "invalid_request_error")
+    config = request.app["engine"].config
+    prompts = payload.prompts or config.benchmark.prompts
+    rounds = payload.rounds or config.benchmark.rounds
+    max_tokens = payload.max_tokens or config.benchmark.max_tokens
+    batcher: RequestBatcher = request.app["batcher"]
+
+    latencies: list[float] = []
+    total_tokens = 0
+    bench_start = time.perf_counter()
+    for _ in range(rounds):
+        starts = time.perf_counter()
+        results = await asyncio.gather(
+            *[
+                batcher.submit(prompt, max_tokens=max_tokens)
+                for prompt in prompts
+            ]
+        )
+        latencies.append(time.perf_counter() - starts)
+        total_tokens += sum(r.get("num_tokens", 0) for r in results)
+    wall = time.perf_counter() - bench_start
+    latencies_ms = sorted(l * 1000 for l in latencies)
+    return web.json_response(
+        {
+            "rounds": rounds,
+            "prompts_per_round": len(prompts),
+            "latency_ms": {
+                "mean": statistics.mean(latencies_ms),
+                "p50": latencies_ms[len(latencies_ms) // 2],
+                "p95": latencies_ms[min(len(latencies_ms) - 1,
+                                        int(len(latencies_ms) * 0.95))],
+            },
+            "total_tokens": total_tokens,
+            "tokens_per_second": total_tokens / wall if wall > 0 else 0.0,
+        }
+    )
+
+
+async def _on_startup(app: web.Application) -> None:
+    config: VGTConfig = app["config"]
+    init_tracing(config)
+    loop = asyncio.get_running_loop()
+    # Model load can take minutes; do it off the event loop.
+    engine = await loop.run_in_executor(None, lambda: VGTEngine(config))
+    app["engine"] = engine
+    batcher = RequestBatcher(engine, config)
+    app["batcher"] = batcher
+    metrics.init_app_info(
+        __version__, config.model.model_id, config.model.engine_type
+    )
+    await batcher.start()
+
+
+async def _on_cleanup(app: web.Application) -> None:
+    batcher: Optional[RequestBatcher] = app.get("batcher")
+    if batcher is not None:
+        await batcher.stop()
+    engine: Optional[VGTEngine] = app.get("engine")
+    if engine is not None:
+        engine.shutdown()
+    shutdown_tracing()
+
+
+def create_app(config: Optional[VGTConfig] = None) -> web.Application:
+    config = config or get_config()
+    setup_logging(config)
+    app = web.Application(
+        middlewares=[
+            build_security_middleware(config),
+            observability_middleware,
+        ],
+        client_max_size=32 * 1024 * 1024,
+    )
+    app["config"] = config
+    app.router.add_get("/health", health)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_get("/metrics", prometheus_metrics)
+    app.router.add_get("/stats", get_stats)
+    app.router.add_post("/v1/benchmark", run_benchmark)
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+    return app
+
+
+def main() -> None:
+    config = get_config()
+    app = create_app(config)
+    web.run_app(app, host=config.server.host, port=config.server.port)
+
+
+if __name__ == "__main__":
+    main()

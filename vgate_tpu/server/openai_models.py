@@ -1,0 +1,82 @@
+"""OpenAI-format request/response models (reference shapes:
+vgate-client/vgate_client/models.py:27-97 and main.py:207-275)."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import BaseModel, Field
+
+
+class ChatMessage(BaseModel):
+    role: str
+    content: str
+
+
+class ChatCompletionRequest(BaseModel):
+    model: Optional[str] = None
+    messages: List[ChatMessage]
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    stream: bool = False
+    user: Optional[str] = None
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class Choice(BaseModel):
+    index: int = 0
+    message: ChatMessage
+    finish_reason: str = "stop"
+
+
+class ChatCompletion(BaseModel):
+    id: str = Field(default_factory=lambda: f"chatcmpl-{uuid.uuid4().hex[:24]}")
+    object: str = "chat.completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[Choice] = Field(default_factory=list)
+    usage: Usage = Field(default_factory=Usage)
+    cached: bool = False
+    metrics: Dict[str, float] = Field(default_factory=dict)
+
+
+class EmbeddingRequest(BaseModel):
+    model: Optional[str] = None
+    input: Union[str, List[str]]
+    user: Optional[str] = None
+
+
+class EmbeddingData(BaseModel):
+    object: str = "embedding"
+    index: int = 0
+    embedding: List[float] = Field(default_factory=list)
+
+
+class EmbeddingResponse(BaseModel):
+    object: str = "list"
+    data: List[EmbeddingData] = Field(default_factory=list)
+    model: str = ""
+    usage: Usage = Field(default_factory=Usage)
+
+
+class BenchmarkRequest(BaseModel):
+    prompts: Optional[List[str]] = None
+    rounds: Optional[int] = None
+    max_tokens: Optional[int] = None
+
+
+def messages_to_prompt(messages: List[ChatMessage]) -> str:
+    """Flatten chat messages to a single prompt
+    (reference: main.py:190-196, "Role: content\\n...\\nAssistant:")."""
+    lines = [f"{m.role.capitalize()}: {m.content}" for m in messages]
+    lines.append("Assistant:")
+    return "\n".join(lines)

@@ -1,0 +1,149 @@
+"""Request tracing on the OpenTelemetry API with graceful degradation.
+
+The reference defers all OTel SDK imports so the module loads without the SDK
+installed (vgate/tracing.py:24-26, 97-108); we keep that contract.  In this
+environment only the OTel *API* is present, so when the SDK (or the OTLP
+exporter) is missing, ``init_tracing`` silently leaves the API's built-in
+no-op tracer in place — every span call site stays unconditional.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+_provider: Any = None
+
+try:  # The OTel API is a light dependency; tolerate even its absence.
+    from opentelemetry import trace as _otel_trace
+except ImportError:  # pragma: no cover
+    _otel_trace = None
+
+
+class _NoopSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attribute(self, *a, **k):
+        pass
+
+    def set_attributes(self, *a, **k):
+        pass
+
+    def record_exception(self, *a, **k):
+        pass
+
+    def set_status(self, *a, **k):
+        pass
+
+    def add_event(self, *a, **k):
+        pass
+
+    def is_recording(self):
+        return False
+
+    def end(self, *a, **k):
+        pass
+
+
+class _NoopTracer:
+    def start_as_current_span(self, *a, **k):
+        return _NoopSpan()
+
+    def start_span(self, *a, **k):
+        return _NoopSpan()
+
+
+def init_tracing(config=None) -> bool:
+    """Initialise the tracer provider if the SDK is available and tracing is
+    enabled (reference: vgate/tracing.py:38-94).  Returns True when a real
+    provider was installed."""
+    global _initialized, _provider
+    if config is None:
+        from vgate_tpu.config import get_config
+
+        config = get_config()
+    if _initialized:
+        return _provider is not None
+    _initialized = True
+    if not config.tracing.enabled or _otel_trace is None:
+        return False
+    try:
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        from opentelemetry.sdk.trace.sampling import TraceIdRatioBased
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+    except ImportError:
+        logger.warning(
+            "tracing.enabled=true but the OpenTelemetry SDK is not "
+            "installed; spans will be no-ops"
+        )
+        return False
+
+    resource = Resource.create({"service.name": config.tracing.service_name})
+    provider = TracerProvider(
+        resource=resource,
+        sampler=TraceIdRatioBased(config.tracing.sample_rate),
+    )
+    provider.add_span_processor(
+        BatchSpanProcessor(OTLPSpanExporter(endpoint=config.tracing.endpoint))
+    )
+    _otel_trace.set_tracer_provider(provider)
+    _provider = provider
+    return True
+
+
+def get_tracer(name: str):
+    """Tracer accessor; returns a no-op tracer when OTel is absent
+    (reference: vgate/tracing.py:97-108)."""
+    if _otel_trace is None:
+        return _NoopTracer()
+    return _otel_trace.get_tracer(name)
+
+
+def get_current_trace_id() -> Optional[str]:
+    """Hex trace id of the active span for logs/exemplars
+    (reference: vgate/tracing.py:123-136)."""
+    if _otel_trace is None:
+        return None
+    span = _otel_trace.get_current_span()
+    ctx = span.get_span_context()
+    if ctx is None or not ctx.is_valid:
+        return None
+    return format(ctx.trace_id, "032x")
+
+
+def get_current_span_id() -> Optional[str]:
+    if _otel_trace is None:
+        return None
+    span = _otel_trace.get_current_span()
+    ctx = span.get_span_context()
+    if ctx is None or not ctx.is_valid:
+        return None
+    return format(ctx.span_id, "016x")
+
+
+def shutdown_tracing() -> None:
+    global _initialized, _provider
+    if _provider is not None:
+        try:
+            _provider.shutdown()
+        except Exception:  # pragma: no cover
+            pass
+    _provider = None
+    _initialized = False
+
+
+def reset_tracing() -> None:
+    """Test hook mirroring the reference's autouse reset fixture
+    (tests/conftest.py:242-249 in the reference)."""
+    shutdown_tracing()
