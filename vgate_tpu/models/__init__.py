@@ -1,0 +1,5 @@
+"""Model families served by the TPU engine."""
+
+from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
+
+__all__ = ["ModelSpec", "spec_for_model_id"]

@@ -1,0 +1,268 @@
+"""The decoder family (Qwen2.x dense + Mixtral MoE) as pure JAX functions.
+
+Design (TPU-first, not a torch port):
+
+* **Stacked layer params + ``lax.scan``** — all layers' weights live in one
+  pytree with a leading layer axis, and the forward pass scans over it.  One
+  layer body is traced/compiled regardless of depth, keeping compile times
+  flat (SURVEY.md section 7: recompile-avoidance discipline).
+* **Paged KV cache threaded through the scan as per-layer xs/ys** — the scan
+  consumes ``k_pages[l]`` and emits the updated slice, so XLA sees a clean
+  per-layer in-place update with no cross-layer scatter.  Pages are written
+  with the reserved *trash page 0* trick: padded positions scatter into page
+  0, so no masking is needed on the write path.
+* **Static shapes everywhere** — prompt lengths are bucketed by the caller;
+  decode is a fixed ``[B]`` step.  fp32 softmax/norms, bf16 matmuls on MXU.
+
+Architecture semantics match HF ``Qwen2ForCausalLM`` / ``MixtralForCausalLM``
+(verified against torch in tests/test_model_parity.py), replacing the
+capability the reference delegates to vLLM (vgate/backends/vllm_backend.py:51).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.models.specs import ModelSpec
+from vgate_tpu.ops.attention import causal_prefill_attention, paged_decode_attention
+from vgate_tpu.ops.norms import rms_norm
+from vgate_tpu.ops.rope import apply_rope
+
+Params = Dict[str, Any]
+
+
+def init_params(
+    spec: ModelSpec, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    """Random-init a full parameter pytree (std 0.02 normal).
+
+    Real checkpoints overwrite these via runtime/weights.py; random init is
+    the zero-egress path used for benchmarks (throughput is weight-value
+    independent).
+    """
+    keys = jax.random.split(key, 16)
+    D, L = spec.hidden_size, spec.num_layers
+    H, KV, hd = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    F, V = spec.intermediate_size, spec.vocab_size
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers: Dict[str, Any] = {
+        "input_norm": jnp.ones((L, D), dtype),
+        "post_norm": jnp.ones((L, D), dtype),
+        "q": {"w": normal(keys[0], (L, D, H * hd))},
+        "k": {"w": normal(keys[1], (L, D, KV * hd))},
+        "v": {"w": normal(keys[2], (L, D, KV * hd))},
+        "o": {"w": normal(keys[3], (L, H * hd, D))},
+    }
+    if spec.qkv_bias:
+        layers["q"]["b"] = jnp.zeros((L, H * hd), dtype)
+        layers["k"]["b"] = jnp.zeros((L, KV * hd), dtype)
+        layers["v"]["b"] = jnp.zeros((L, KV * hd), dtype)
+    if spec.is_moe:
+        E = spec.num_experts
+        layers["router"] = normal(keys[4], (L, D, E))
+        layers["gate"] = {"w": normal(keys[5], (L, E, D, F))}
+        layers["up"] = {"w": normal(keys[6], (L, E, D, F))}
+        layers["down"] = {"w": normal(keys[7], (L, E, F, D))}
+    else:
+        layers["gate"] = {"w": normal(keys[5], (L, D, F))}
+        layers["up"] = {"w": normal(keys[6], (L, D, F))}
+        layers["down"] = {"w": normal(keys[7], (L, F, D))}
+
+    params: Params = {
+        "embed": normal(keys[8], (V, D)),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not spec.tie_embeddings:
+        params["lm_head"] = normal(keys[9], (D, V))
+    return params
+
+
+def _project_qkv(x, lp, spec: ModelSpec):
+    """x: [..., D] -> q [..., H, hd], k/v [..., KV, hd]."""
+    q = jnp.einsum("...d,dh->...h", x, lp["q"]["w"])
+    k = jnp.einsum("...d,dh->...h", x, lp["k"]["w"])
+    v = jnp.einsum("...d,dh->...h", x, lp["v"]["w"])
+    if spec.qkv_bias:
+        q = q + lp["q"]["b"]
+        k = k + lp["k"]["b"]
+        v = v + lp["v"]["b"]
+    q = q.reshape(*q.shape[:-1], spec.num_heads, spec.head_dim)
+    k = k.reshape(*k.shape[:-1], spec.num_kv_heads, spec.head_dim)
+    v = v.reshape(*v.shape[:-1], spec.num_kv_heads, spec.head_dim)
+    return q, k, v
+
+
+def _dense_mlp(x, lp):
+    gate = jnp.einsum("...d,df->...f", x, lp["gate"]["w"])
+    up = jnp.einsum("...d,df->...f", x, lp["up"]["w"])
+    return jnp.einsum(
+        "...f,fd->...d", jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up,
+        lp["down"]["w"],
+    )
+
+
+def _moe_mlp(x, lp, spec: ModelSpec, capacity_factor: float = 2.0):
+    """Top-k expert routing with capacity-bounded one-hot dispatch.
+
+    GShard-style dense dispatch: shardable on the ``ep`` mesh axis, where the
+    ``ecd`` tensors are sharded over experts and XLA emits the token
+    all-to-all (SURVEY.md section 2.2: ragged all-to-all dispatch is the
+    TPU-native replacement for the absent reference MoE path).
+    Overflowing tokens beyond capacity are dropped (their residual passes
+    through), the standard serving trade-off.
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    T = 1
+    for s in orig_shape[:-1]:
+        T *= s
+    xt = x.reshape(T, D)
+    E, K = spec.num_experts, spec.experts_per_token
+    capacity = max(4, int((T * K / E) * capacity_factor + 0.5))
+
+    router_logits = jnp.einsum(
+        "td,de->te", xt.astype(jnp.float32), lp["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [T, K]
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.bool_)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    fill = jnp.zeros((E,), jnp.int32)  # tokens already placed per expert
+    for j in range(K):  # K is a small static constant (2)
+        mask_j = jax.nn.one_hot(gate_idx[:, j], E, dtype=jnp.int32)  # [T, E]
+        pos_in_expert = jnp.cumsum(mask_j, axis=0) - mask_j + fill[None, :]
+        within = (pos_in_expert < capacity) & (mask_j > 0)
+        slot_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+        contrib = slot_oh * within[..., None]
+        dispatch = dispatch | (contrib > 0)
+        combine = combine + contrib * gate_vals[:, j, None, None]
+        fill = fill + jnp.sum(mask_j * within, axis=0)
+
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(xt.dtype), xt
+    )  # [E, C, D]
+    gate_h = jnp.einsum("ecd,edf->ecf", expert_in, lp["gate"]["w"])
+    up_h = jnp.einsum("ecd,edf->ecf", expert_in, lp["up"]["w"])
+    act = jax.nn.silu(gate_h.astype(jnp.float32)).astype(xt.dtype) * up_h
+    expert_out = jnp.einsum("ecf,efd->ecd", act, lp["down"]["w"])
+    out = jnp.einsum(
+        "tec,ecd->td", combine.astype(xt.dtype), expert_out
+    )
+    return out.reshape(orig_shape)
+
+
+def _mlp(x, lp, spec: ModelSpec):
+    return _moe_mlp(x, lp, spec) if spec.is_moe else _dense_mlp(x, lp)
+
+
+def _logits(params: Params, spec: ModelSpec, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["final_norm"], spec.rms_eps)
+    if spec.tie_embeddings:
+        return jnp.einsum(
+            "...d,vd->...v", x, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "...d,dv->...v", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+
+
+def prefill_forward(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,  # [B, S] padded to a bucket; S % page_size == 0
+    seq_lens: jnp.ndarray,  # [B]
+    k_pages: jnp.ndarray,  # [L, P, ps, KV, hd]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, S // ps] page ids for this prompt
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the prompt pass: returns (last-token logits [B, V], k_pages, v_pages)."""
+    B, S = tokens.shape
+    ps = k_pages.shape[2]
+    n_pages = S // ps
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    x = params["embed"][tokens]  # [B, S, D]
+
+    def layer_fn(h, per_layer):
+        lp, k_pages_l, v_pages_l = per_layer
+        normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
+        q, k, v = _project_qkv(normed, lp, spec)
+        q = apply_rope(q, positions, spec.rope_theta)
+        k = apply_rope(k, positions, spec.rope_theta)
+        # Write this layer's KV into its pages (trash-page-0 absorbs padding).
+        k_resh = k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim)
+        v_resh = v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim)
+        pt = page_tables[:, :n_pages]
+        k_pages_l = k_pages_l.at[pt].set(k_resh)
+        v_pages_l = v_pages_l.at[pt].set(v_resh)
+        attn = causal_prefill_attention(q, k, v, seq_lens)
+        attn = attn.reshape(B, S, spec.q_dim)
+        h = h + jnp.einsum("...h,hd->...d", attn, lp["o"]["w"])
+        normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
+        h = h + _mlp(normed2, lp, spec)
+        return h, (k_pages_l, v_pages_l)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_pages, v_pages)
+    )
+    last_idx = jnp.clip(seq_lens - 1, 0, S - 1)
+    last_hidden = jnp.take_along_axis(
+        x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1
+    )[:, 0]
+    return _logits(params, spec, last_hidden), k_pages, v_pages
+
+
+def decode_forward(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,  # [B] current token per slot
+    positions: jnp.ndarray,  # [B] 0-indexed position of `tokens`
+    k_pages: jnp.ndarray,  # [L, P, ps, KV, hd]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, pages_per_seq]
+    active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots write page 0
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One continuous-batching decode step: returns (logits [B, V], caches)."""
+    B = tokens.shape[0]
+    ps = k_pages.shape[2]
+    seq_lens = positions + 1
+    batch_idx = jnp.arange(B)
+    page_slot = positions // ps
+    page_off = positions % ps
+    page_ids = page_tables[batch_idx, page_slot]  # [B]
+    if active is not None:
+        page_ids = jnp.where(active, page_ids, 0)  # trash page for idle slots
+
+    x = params["embed"][tokens]  # [B, D]
+
+    def layer_fn(h, per_layer):
+        lp, k_pages_l, v_pages_l = per_layer
+        normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
+        q, k, v = _project_qkv(normed, lp, spec)  # q [B,H,hd], k/v [B,KV,hd]
+        q = apply_rope(q[:, None], positions[:, None], spec.rope_theta)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], spec.rope_theta)[:, 0]
+        k_pages_l = k_pages_l.at[page_ids, page_off].set(k)
+        v_pages_l = v_pages_l.at[page_ids, page_off].set(v)
+        attn = paged_decode_attention(
+            q, k_pages_l, v_pages_l, page_tables, seq_lens
+        )
+        attn = attn.reshape(B, spec.q_dim)
+        h = h + jnp.einsum("bh,hd->bd", attn, lp["o"]["w"])
+        normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
+        h = h + _mlp(normed2, lp, spec)
+        return h, (k_pages_l, v_pages_l)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_pages, v_pages)
+    )
+    return _logits(params, spec, x), k_pages, v_pages

@@ -1,0 +1,191 @@
+"""Architecture specs for the supported model families.
+
+The reference serves whatever vLLM/SGLang can load (opaque to it); here the
+architectures are first-party.  Presets cover the north-star configs in
+BASELINE.json: Qwen2.5 dense chat models, Mixtral-8x7B (MoE / expert
+parallel) and bge-base-en-v1.5 (embeddings).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    rope_theta: float = 1_000_000.0
+    rms_eps: float = 1e-6
+    qkv_bias: bool = True
+    tie_embeddings: bool = False
+    eos_token_id: int = 151645
+    bos_token_id: int = 151643
+    # MoE (0 experts => dense)
+    num_experts: int = 0
+    experts_per_token: int = 0
+    # Encoder-only (embeddings) models
+    is_encoder: bool = False
+    max_position_embeddings: int = 32768
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+# Dims follow the published HF configs for each model id.
+_PRESETS: Dict[str, ModelSpec] = {}
+
+
+def _register(spec: ModelSpec) -> ModelSpec:
+    _PRESETS[spec.name.lower()] = spec
+    return spec
+
+
+QWEN25_05B = _register(
+    ModelSpec(
+        name="Qwen/Qwen2.5-0.5B-Instruct",
+        vocab_size=151936,
+        hidden_size=896,
+        num_layers=24,
+        num_heads=14,
+        num_kv_heads=2,
+        head_dim=64,
+        intermediate_size=4864,
+        tie_embeddings=True,
+    )
+)
+
+QWEN25_15B = _register(
+    ModelSpec(
+        name="Qwen/Qwen2.5-1.5B-Instruct",
+        vocab_size=151936,
+        hidden_size=1536,
+        num_layers=28,
+        num_heads=12,
+        num_kv_heads=2,
+        head_dim=128,
+        intermediate_size=8960,
+        tie_embeddings=True,
+    )
+)
+
+QWEN25_7B = _register(
+    ModelSpec(
+        name="Qwen/Qwen2.5-7B-Instruct",
+        vocab_size=152064,
+        hidden_size=3584,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        intermediate_size=18944,
+        tie_embeddings=False,
+    )
+)
+
+MIXTRAL_8X7B = _register(
+    ModelSpec(
+        name="mistralai/Mixtral-8x7B-Instruct-v0.1",
+        vocab_size=32000,
+        hidden_size=4096,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        rope_theta=1_000_000.0,
+        rms_eps=1e-5,
+        qkv_bias=False,
+        eos_token_id=2,
+        bos_token_id=1,
+        num_experts=8,
+        experts_per_token=2,
+    )
+)
+
+BGE_BASE = _register(
+    ModelSpec(
+        name="BAAI/bge-base-en-v1.5",
+        vocab_size=30522,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        num_kv_heads=12,
+        head_dim=64,
+        intermediate_size=3072,
+        is_encoder=True,
+        qkv_bias=True,
+        eos_token_id=102,
+        bos_token_id=101,
+        max_position_embeddings=512,
+    )
+)
+
+# Tiny variants for CPU tests and compile checks.
+TINY_DENSE = _register(
+    ModelSpec(
+        name="tiny-dense",
+        vocab_size=512,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=128,
+        rope_theta=10000.0,
+        eos_token_id=0,
+        bos_token_id=1,
+        tie_embeddings=False,
+    )
+)
+
+TINY_MOE = _register(
+    replace(
+        TINY_DENSE,
+        name="tiny-moe",
+        num_experts=4,
+        experts_per_token=2,
+        qkv_bias=False,  # mixtral-family attention has no qkv bias
+        rms_eps=1e-5,
+    )
+)
+
+TINY_ENCODER = _register(
+    replace(
+        TINY_DENSE,
+        name="tiny-encoder",
+        is_encoder=True,
+        num_kv_heads=4,
+        max_position_embeddings=512,
+    )
+)
+
+
+def spec_for_model_id(model_id: str) -> ModelSpec:
+    key = model_id.lower()
+    if key in _PRESETS:
+        return _PRESETS[key]
+    # Allow bare names ("qwen2.5-1.5b-instruct") without the org prefix.
+    for name, spec in _PRESETS.items():
+        if name.split("/")[-1] == key:
+            return spec
+    raise KeyError(
+        f"no architecture preset for {model_id!r}; known: "
+        f"{sorted(_PRESETS)}"
+    )

@@ -1,0 +1,6 @@
+"""Numeric ops: jnp reference implementations + Pallas TPU kernels.
+
+Every Pallas kernel has a jnp twin with identical semantics; the engine picks
+via ``tpu.use_pallas`` (kernel unit tests compare the two, per SURVEY.md
+section 4's strategy for kernel testing).
+"""

@@ -1,0 +1,99 @@
+"""Attention reference implementations (jnp).
+
+These are the semantic ground truth the Pallas kernels are tested against
+(SURVEY.md section 4: kernel unit tests compare Pallas outputs vs jnp).  The
+engine uses them directly on CPU test meshes and as the `use_pallas=False`
+fallback on TPU.
+
+Replaces the capability the reference delegates to vLLM's CUDA
+paged-attention (SURVEY.md section 2.1, vllm_backend.py:51 — opaque there,
+first-party here).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Broadcast KV heads across query-head groups (GQA). x: [..., KV, hd]."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def causal_prefill_attention(
+    q: jnp.ndarray,  # [B, S, H, hd]
+    k: jnp.ndarray,  # [B, S, KV, hd]
+    v: jnp.ndarray,  # [B, S, KV, hd]
+    seq_lens: jnp.ndarray,  # [B] real lengths (tokens beyond are padding)
+) -> jnp.ndarray:
+    """Causal self-attention over a padded prompt batch. Returns [B, S, H, hd].
+
+    fp32 softmax accumulation; padded key positions are masked out so garbage
+    in the padding region cannot leak into real tokens.
+    """
+    B, S, H, hd = q.shape
+    n_rep = H // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / (hd ** 0.5)
+    # [B, H, S, S]
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    pos = jnp.arange(S)
+    causal = pos[None, :] <= pos[:, None]  # [S(q), S(k)] keys <= query pos
+    key_valid = pos[None, :] < seq_lens[:, None]  # [B, S]
+    mask = causal[None, None, :, :] & key_valid[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jnp.exp(
+        scores - jnp.max(scores, axis=-1, keepdims=True)
+    )
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bhst,bthd->bshd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, H, hd] one query token per slot
+    k_pages: jnp.ndarray,  # [P, page_size, KV, hd]
+    v_pages: jnp.ndarray,  # [P, page_size, KV, hd]
+    page_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    seq_lens: jnp.ndarray,  # [B] context length per slot (incl. current token)
+) -> jnp.ndarray:
+    """Decode-step attention over the paged KV cache. Returns [B, H, hd].
+
+    Reference semantics for the Pallas paged kernel: gathers each slot's
+    pages into a contiguous [ctx_max] view, masks positions >= seq_len, and
+    runs fp32 softmax.  The Pallas version streams only the live pages
+    through VMEM instead of materializing the gather.
+    """
+    B, H, hd = q.shape
+    page_size = k_pages.shape[1]
+    KV = k_pages.shape[2]
+    n_rep = H // KV
+    ctx_max = page_tables.shape[1] * page_size
+
+    # Gather pages: [B, pages_per_seq, page_size, KV, hd] -> [B, ctx, KV, hd]
+    k = k_pages[page_tables].reshape(B, ctx_max, KV, hd)
+    v = v_pages[page_tables].reshape(B, ctx_max, KV, hd)
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    scale = 1.0 / (hd ** 0.5)
+    scores = jnp.einsum(
+        "bhd,bthd->bht", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    valid = jnp.arange(ctx_max)[None, :] < seq_lens[:, None]  # [B, ctx]
+    scores = jnp.where(valid[:, None, :], scores, -1e30)
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bht,bthd->bhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

@@ -1,0 +1,29 @@
+"""Normalization layers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm in fp32 accumulation, cast back to input dtype (the HF Qwen2
+    convention, so logits match the reference architecture bit-for-bit-ish)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-12
+) -> jnp.ndarray:
+    """Classic LayerNorm (BERT-family encoders, e.g. bge embeddings)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * (var + eps) ** -0.5
+    out = normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(dtype)

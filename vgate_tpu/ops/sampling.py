@@ -1,0 +1,62 @@
+"""Device-side token sampling with *per-request* parameters.
+
+The reference applies the first request's temperature/top_p to the whole
+batch (vgate/batcher.py:271 — a documented quirk); here every slot carries
+its own (temperature, top_p, top_k) vector and sampling happens on device in
+one fused program.
+
+Exactness note: sampling operates on the top ``TRUNC`` logits (lax.top_k)
+rather than a full-vocab sort.  Top-k is exact for k <= TRUNC; top-p is
+exact whenever the top-TRUNC probability mass covers ``top_p`` (true for all
+practical temperatures); both fall back to the best-available distribution
+otherwise.  This keeps the per-step cost O(V + TRUNC log TRUNC) instead of a
+full 150k-vocab sort per slot.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+TRUNC = 256  # logits kept per slot for sampling
+_GREEDY_EPS = 1e-4
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V]
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32, 0 => disabled
+    key: jax.Array,
+) -> jnp.ndarray:
+    """Sample one token per slot honoring per-slot params. Returns [B] int32."""
+    B, V = logits.shape
+    trunc = min(TRUNC, V)
+    logits32 = logits.astype(jnp.float32)
+    top_vals, top_idx = jax.lax.top_k(logits32, trunc)  # [B, trunc] sorted desc
+
+    safe_temp = jnp.maximum(temperature, _GREEDY_EPS)[:, None]
+    scaled = top_vals / safe_temp
+
+    # top-k mask within the truncated, sorted slice
+    ranks = jnp.arange(trunc)[None, :]
+    k = jnp.where(top_k[:, None] > 0, top_k[:, None], trunc)
+    k_mask = ranks < k
+
+    # top-p (nucleus) mask: keep the smallest prefix whose mass >= top_p;
+    # exclusive cumsum guarantees the argmax token always stays eligible.
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    p_mask = cum_excl < jnp.clip(top_p, 0.0, 1.0)[:, None]
+
+    mask = k_mask & p_mask
+    masked = jnp.where(mask, scaled, -1e30)
+
+    gumbel = jax.random.gumbel(key, (B, trunc), dtype=jnp.float32)
+    sampled_pos = jnp.argmax(masked + gumbel, axis=-1)  # [B]
+
+    greedy = temperature <= _GREEDY_EPS
+    pos = jnp.where(greedy, 0, sampled_pos)
+    return jnp.take_along_axis(top_idx, pos[:, None], axis=-1)[:, 0].astype(
+        jnp.int32
+    )

@@ -1,0 +1,5 @@
+"""Device mesh, sharding rules and distributed bring-up."""
+
+from vgate_tpu.parallel.mesh import MeshPlan, build_mesh, initialize_distributed
+
+__all__ = ["MeshPlan", "build_mesh", "initialize_distributed"]

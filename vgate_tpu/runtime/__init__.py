@@ -1,0 +1,1 @@
+"""The TPU engine runtime: weights, KV paging, scheduler, engine core."""

@@ -1,0 +1,178 @@
+"""Checkpoint loading: HF safetensors -> stacked sharded device buffers.
+
+The serving analogue of checkpoint/resume (SURVEY.md section 5.4): the
+reference's only persistence is an HF model-cache volume consumed by vLLM;
+here weights load directly into the engine's stacked-layer pytree, sharded
+per the mesh rules at placement time (safetensors -> jax.device_put per
+shard), so a v5e-8 load never materializes a full replica per host.
+
+Name mapping follows the HF `Qwen2ForCausalLM` / `MixtralForCausalLM` /
+`BertModel` conventions; torch linear weights are [out, in] and transposed
+into the einsum-friendly [in, out] layout used by models/decoder.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.models.specs import ModelSpec
+
+logger = get_logger(__name__)
+
+Params = Dict[str, Any]
+# get(name) -> np.ndarray accessor abstracting safetensors files / state dicts
+TensorGetter = Callable[[str], np.ndarray]
+
+
+def _stack(getter: TensorGetter, template: str, num_layers: int, transpose=False):
+    arrs = []
+    for i in range(num_layers):
+        arr = np.asarray(getter(template.format(i)))
+        arrs.append(arr.T if transpose else arr)
+    return np.stack(arrs)
+
+
+def params_from_getter(
+    spec: ModelSpec, getter: TensorGetter, dtype=jnp.bfloat16
+) -> Params:
+    """Assemble the decoder pytree from HF-named tensors (host numpy)."""
+    L = spec.num_layers
+    pre = "model.layers.{}."
+    layers: Dict[str, Any] = {
+        "input_norm": _stack(getter, pre + "input_layernorm.weight", L),
+        "post_norm": _stack(getter, pre + "post_attention_layernorm.weight", L),
+        "q": {"w": _stack(getter, pre + "self_attn.q_proj.weight", L, True)},
+        "k": {"w": _stack(getter, pre + "self_attn.k_proj.weight", L, True)},
+        "v": {"w": _stack(getter, pre + "self_attn.v_proj.weight", L, True)},
+        "o": {"w": _stack(getter, pre + "self_attn.o_proj.weight", L, True)},
+    }
+    if spec.qkv_bias:
+        layers["q"]["b"] = _stack(getter, pre + "self_attn.q_proj.bias", L)
+        layers["k"]["b"] = _stack(getter, pre + "self_attn.k_proj.bias", L)
+        layers["v"]["b"] = _stack(getter, pre + "self_attn.v_proj.bias", L)
+    if spec.is_moe:
+        E = spec.num_experts
+        layers["router"] = _stack(
+            getter, pre + "block_sparse_moe.gate.weight", L, True
+        )
+        def stack_experts(w_name, transpose):
+            per_layer = []
+            for i in range(L):
+                per_expert = [
+                    np.asarray(
+                        getter(
+                            f"model.layers.{i}.block_sparse_moe.experts."
+                            f"{e}.{w_name}.weight"
+                        )
+                    )
+                    for e in range(E)
+                ]
+                stacked = np.stack(
+                    [w.T if transpose else w for w in per_expert]
+                )
+                per_layer.append(stacked)
+            return np.stack(per_layer)  # [L, E, ...]
+
+        layers["gate"] = {"w": stack_experts("w1", True)}
+        layers["down"] = {"w": stack_experts("w2", True)}
+        layers["up"] = {"w": stack_experts("w3", True)}
+    else:
+        layers["gate"] = {"w": _stack(getter, pre + "mlp.gate_proj.weight", L, True)}
+        layers["up"] = {"w": _stack(getter, pre + "mlp.up_proj.weight", L, True)}
+        layers["down"] = {"w": _stack(getter, pre + "mlp.down_proj.weight", L, True)}
+
+    params: Params = {
+        "embed": np.asarray(getter("model.embed_tokens.weight")),
+        "layers": layers,
+        "final_norm": np.asarray(getter("model.norm.weight")),
+    }
+    if not spec.tie_embeddings:
+        params["lm_head"] = np.asarray(getter("lm_head.weight")).T
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+
+
+def params_from_torch_state_dict(
+    spec: ModelSpec, state_dict, dtype=jnp.float32
+) -> Params:
+    """Build params from an in-memory torch state dict (used by the
+    parity tests against transformers' reference implementation)."""
+
+    def getter(name: str) -> np.ndarray:
+        tensor = state_dict[name]
+        return tensor.detach().to("cpu").float().numpy()
+
+    return params_from_getter(spec, getter, dtype)
+
+
+def params_from_safetensors(
+    spec: ModelSpec,
+    checkpoint_path: str,
+    dtype=jnp.bfloat16,
+    device_put_fn: Optional[Callable[[np.ndarray, str], jax.Array]] = None,
+) -> Params:
+    """Load from a local directory of ``*.safetensors`` shards."""
+    from safetensors import safe_open
+
+    files = sorted(
+        os.path.join(checkpoint_path, f)
+        for f in os.listdir(checkpoint_path)
+        if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(
+            f"no .safetensors files under {checkpoint_path}"
+        )
+    handles = [safe_open(f, framework="np") for f in files]
+    index: Dict[str, Any] = {}
+    for handle in handles:
+        for name in handle.keys():
+            index[name] = handle
+
+    def getter(name: str) -> np.ndarray:
+        if name not in index:
+            # tied-embedding checkpoints omit lm_head
+            raise KeyError(f"tensor {name} missing from checkpoint")
+        return index[name].get_tensor(name)
+
+    params = params_from_getter(spec, getter, dtype)
+    logger.info(
+        "checkpoint loaded",
+        extra={
+            "extra_data": {
+                "path": checkpoint_path,
+                "files": len(files),
+                "params_mb": round(
+                    sum(
+                        x.size * x.dtype.itemsize
+                        for x in jax.tree.leaves(params)
+                    )
+                    / 1e6
+                ),
+            }
+        },
+    )
+    return params
+
+
+def load_or_init_params(
+    spec: ModelSpec,
+    checkpoint_path: Optional[str],
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+) -> Params:
+    """Checkpoint when available, random init otherwise (zero-egress path)."""
+    if checkpoint_path and os.path.isdir(checkpoint_path):
+        return params_from_safetensors(spec, checkpoint_path, dtype)
+    from vgate_tpu.models.decoder import init_params
+
+    logger.warning(
+        "no checkpoint found; using random-init weights",
+        extra={"extra_data": {"model": spec.name, "path": checkpoint_path}},
+    )
+    return init_params(spec, jax.random.PRNGKey(seed), dtype)

@@ -1,0 +1,5 @@
+"""Small shared helpers."""
+
+from vgate_tpu.utils.math import bucket_for, cdiv, round_up
+
+__all__ = ["bucket_for", "cdiv", "round_up"]
