@@ -1,0 +1,199 @@
+"""Op-level unit tests: sampling semantics, rope, norms, attention masks."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.ops.attention import causal_prefill_attention, paged_decode_attention
+from vgate_tpu.ops.norms import layer_norm, rms_norm
+from vgate_tpu.ops.rope import apply_rope
+from vgate_tpu.ops.sampling import sample_tokens
+
+
+def test_rms_norm_matches_formula():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 8)), jnp.float32)
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(8,)), jnp.float32)
+    out = np.asarray(rms_norm(x, w, eps=1e-6))
+    xn = np.asarray(x)
+    expect = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_zero_mean_unit_var():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 16)), jnp.float32)
+    out = np.asarray(
+        layer_norm(x, jnp.ones((16,)), jnp.zeros((16,)))
+    )
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+
+def test_rope_preserves_norm_and_zero_position_identity():
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(1, 4, 2, 16)), jnp.float32
+    )
+    pos = jnp.asarray([[0, 1, 2, 3]])
+    out = apply_rope(x, pos)
+    # rotation preserves norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is identity
+    np.testing.assert_allclose(
+        np.asarray(out[0, 0]), np.asarray(x[0, 0]), atol=1e-6
+    )
+
+
+def test_rope_relative_property():
+    """<rope(q,m), rope(k,n)> depends only on m-n."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, 32)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, 32)), jnp.float32)
+
+    def dot_at(m, n):
+        qm = apply_rope(q, jnp.asarray([[m]]))
+        kn = apply_rope(k, jnp.asarray([[n]]))
+        return float(jnp.sum(qm * kn))
+
+    assert abs(dot_at(5, 3) - dot_at(12, 10)) < 1e-3
+
+
+def test_causal_attention_ignores_padding_and_future():
+    rng = np.random.default_rng(0)
+    B, S, H, hd = 1, 8, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    out1 = causal_prefill_attention(q, k, v, jnp.asarray([5]))
+    # mutating padded keys (>=5) must not change outputs at positions < 5
+    k2 = k.at[:, 5:].set(99.0)
+    v2 = v.at[:, 5:].set(-99.0)
+    out2 = causal_prefill_attention(q, k2, v2, jnp.asarray([5]))
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :5]), np.asarray(out2[:, :5]), atol=1e-5
+    )
+
+
+def test_paged_decode_matches_contiguous_attention():
+    """Paged gather attention == plain attention over the same context."""
+    rng = np.random.default_rng(1)
+    B, H, KV, hd, ps = 2, 4, 2, 16, 4
+    ctx_lens = [6, 3]
+    n_pages_per_seq = 2
+    P = 1 + B * n_pages_per_seq
+    k_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
+    page_tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    out = np.asarray(
+        paged_decode_attention(
+            q, k_pages, v_pages, page_tables, jnp.asarray(ctx_lens)
+        )
+    )
+    # naive per-slot computation
+    for b in range(B):
+        n = ctx_lens[b]
+        k = np.asarray(k_pages[np.asarray(page_tables[b])]).reshape(-1, KV, hd)[:n]
+        v = np.asarray(v_pages[np.asarray(page_tables[b])]).reshape(-1, KV, hd)[:n]
+        k = np.repeat(k, H // KV, axis=1)
+        v = np.repeat(v, H // KV, axis=1)
+        qb = np.asarray(q[b])  # [H, hd]
+        scores = np.einsum("hd,thd->ht", qb, k) / np.sqrt(hd)
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        expect = np.einsum("ht,thd->hd", probs, v)
+        np.testing.assert_allclose(out[b], expect, rtol=1e-4, atol=1e-5)
+
+
+# --- sampling ---
+
+
+def _uniform_logits(v=64):
+    return jnp.zeros((1, v), jnp.float32)
+
+
+def test_greedy_when_temperature_zero():
+    logits = jnp.asarray(
+        np.random.default_rng(0).normal(size=(4, 100)), jnp.float32
+    )
+    tokens = sample_tokens(
+        logits,
+        temperature=jnp.zeros((4,)),
+        top_p=jnp.ones((4,)),
+        top_k=jnp.zeros((4,), jnp.int32),
+        key=jax.random.PRNGKey(0),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tokens), np.asarray(jnp.argmax(logits, -1))
+    )
+
+
+def test_top_k_restricts_support():
+    logits = jnp.asarray([[10.0, 9.0, 8.0] + [0.0] * 61])
+    seen = set()
+    for i in range(50):
+        tok = sample_tokens(
+            logits,
+            temperature=jnp.asarray([5.0]),
+            top_p=jnp.asarray([1.0]),
+            top_k=jnp.asarray([2], jnp.int32),
+            key=jax.random.PRNGKey(i),
+        )
+        seen.add(int(tok[0]))
+    assert seen <= {0, 1}
+
+
+def test_top_p_restricts_support():
+    # one dominant token: top_p=0.5 keeps only it
+    logits = jnp.asarray([[10.0] + [0.0] * 63])
+    for i in range(20):
+        tok = sample_tokens(
+            logits,
+            temperature=jnp.asarray([1.0]),
+            top_p=jnp.asarray([0.5]),
+            top_k=jnp.asarray([0], jnp.int32),
+            key=jax.random.PRNGKey(i),
+        )
+        assert int(tok[0]) == 0
+
+
+def test_per_slot_params_are_independent():
+    """Slot 0 greedy, slot 1 high-temp: slot 0 must stay deterministic."""
+    logits = jnp.asarray(
+        np.tile(np.random.default_rng(2).normal(size=(1, 128)), (2, 1)),
+        jnp.float32,
+    )
+    argmax = int(jnp.argmax(logits[0]))
+    randoms = set()
+    for i in range(30):
+        toks = sample_tokens(
+            logits,
+            temperature=jnp.asarray([0.0, 3.0]),
+            top_p=jnp.asarray([1.0, 1.0]),
+            top_k=jnp.asarray([0, 0], jnp.int32),
+            key=jax.random.PRNGKey(i),
+        )
+        assert int(toks[0]) == argmax
+        randoms.add(int(toks[1]))
+    assert len(randoms) > 3  # slot 1 actually samples
+
+
+def test_sampling_distribution_roughly_matches():
+    probs_target = np.array([0.6, 0.3, 0.1])
+    logits = jnp.asarray([np.log(probs_target)], jnp.float32)
+    counts = np.zeros(3)
+    N = 400
+    for i in range(N):
+        tok = sample_tokens(
+            jnp.tile(logits, (1, 1)),
+            temperature=jnp.asarray([1.0]),
+            top_p=jnp.asarray([1.0]),
+            top_k=jnp.asarray([0], jnp.int32),
+            key=jax.random.PRNGKey(i),
+        )
+        counts[int(tok[0])] += 1
+    freq = counts / N
+    np.testing.assert_allclose(freq, probs_target, atol=0.08)

@@ -1,0 +1,176 @@
+"""Continuous-batching scheduler unit tests (host-only, no JAX)."""
+
+import pytest
+
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.runtime.kv_cache import KVGeometry, PageAllocator
+from vgate_tpu.runtime.scheduler import (
+    DecodePlan,
+    EngineBusyError,
+    PrefillPlan,
+    Scheduler,
+)
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+
+
+def make_sched(num_pages=32, slots=4, page_size=4, buckets=(8, 16), max_len=64,
+               queue=8):
+    alloc = PageAllocator(num_pages)
+    return Scheduler(
+        allocator=alloc,
+        max_slots=slots,
+        page_size=page_size,
+        prefill_buckets=list(buckets),
+        max_model_len=max_len,
+        max_queue_size=queue,
+    ), alloc
+
+
+def seq_of(n_prompt, max_tokens=8):
+    return Sequence(
+        prompt_ids=list(range(2, 2 + n_prompt)),
+        params=SamplingParams(max_tokens=max_tokens),
+    )
+
+
+def test_allocator_all_or_nothing():
+    alloc = PageAllocator(4)  # pages 1..3 usable
+    assert alloc.num_free == 3
+    assert alloc.allocate(4) is None
+    pages = alloc.allocate(3)
+    assert sorted(pages) == [1, 2, 3]
+    alloc.release(pages)
+    assert alloc.num_free == 3
+
+
+def test_allocator_rejects_bad_release():
+    alloc = PageAllocator(4)
+    with pytest.raises(ValueError):
+        alloc.release([0])  # trash page must never be released
+
+
+def test_kv_geometry():
+    geom = KVGeometry(
+        num_layers=2, num_pages=9, page_size=4, kv_heads=2, head_dim=8,
+        max_model_len=32,
+    )
+    assert geom.pages_per_seq == 8
+    assert geom.total_tokens == 32  # trash page excluded
+
+
+def test_prefill_admission_and_bucketing():
+    sched, alloc = make_sched()
+    seq = seq_of(n_prompt=5)
+    sched.add(seq)
+    plan = sched.schedule()
+    assert isinstance(plan, PrefillPlan)
+    assert plan.bucket == 8  # 5 -> bucket 8
+    assert len(seq.pages) == 2  # ceil(5/4)
+    assert seq.status is SeqStatus.RUNNING
+    assert alloc.num_used == 2
+
+
+def test_decode_after_prefill():
+    sched, _ = make_sched()
+    seq = seq_of(4)
+    sched.add(seq)
+    sched.schedule()
+    seq.append_token(9)  # engine appends prefill token
+    plan = sched.schedule()
+    assert isinstance(plan, DecodePlan)
+    assert plan.seqs == [seq]
+
+
+def test_prefill_priority_over_decode():
+    sched, _ = make_sched()
+    a = seq_of(4)
+    sched.add(a)
+    sched.schedule()
+    a.append_token(1)
+    b = seq_of(4)
+    sched.add(b)
+    plan = sched.schedule()
+    assert isinstance(plan, PrefillPlan)
+    assert plan.seq is b
+
+
+def test_page_allocated_on_boundary_crossing():
+    sched, alloc = make_sched(page_size=4)
+    seq = seq_of(4)  # exactly one page
+    sched.add(seq)
+    sched.schedule()
+    assert len(seq.pages) == 1
+    seq.append_token(1)  # position 4 -> needs page 2
+    plan = sched.schedule()
+    assert isinstance(plan, DecodePlan)
+    assert len(seq.pages) == 2
+
+
+def test_queue_full_sheds_load():
+    sched, _ = make_sched(queue=2)
+    sched.add(seq_of(4))
+    sched.add(seq_of(4))
+    with pytest.raises(EngineBusyError):
+        sched.add(seq_of(4))
+
+
+def test_oversized_prompt_rejected():
+    sched, _ = make_sched(max_len=16)
+    with pytest.raises(ValueError):
+        sched.add(seq_of(20))
+
+
+def test_preemption_frees_youngest():
+    # 5 usable pages, two seqs of 2 pages each -> 1 free page
+    sched, alloc = make_sched(num_pages=6, page_size=4)
+    old = seq_of(8)
+    sched.add(old)
+    sched.schedule()
+    old.append_token(1)
+    young = seq_of(8)
+    sched.add(young)
+    sched.schedule()
+    young.append_token(1)
+    assert alloc.num_free == 1
+    # old crosses a page boundary (uses the last page), then young crosses:
+    # allocator is empty -> young (the newest) must be preempted
+    for _ in range(4):
+        old.append_token(1)
+        young.append_token(1)
+        plan = sched.schedule()
+        assert isinstance(plan, (DecodePlan, PrefillPlan))
+        if young.status is SeqStatus.WAITING:
+            break
+    assert young.status is SeqStatus.WAITING
+    assert young.preempt_count == 1
+    assert young.slot is None
+    assert sched.total_preemptions == 1
+    # preempted seq keeps its generated tokens for recompute
+    assert young.num_prompt_tokens > 8
+
+
+def test_remove_releases_everything():
+    sched, alloc = make_sched()
+    seq = seq_of(6)
+    sched.add(seq)
+    sched.schedule()
+    used = alloc.num_used
+    assert used > 0
+    sched.remove(seq)
+    assert alloc.num_used == 0
+    assert sched.slots[0] is None
+
+
+def test_impossible_prompt_fails_instead_of_deadlocking():
+    sched, _ = make_sched(num_pages=2, page_size=4, max_len=64)
+    seq = seq_of(30)  # needs 8 pages, only 1 usable
+    sched.add(seq)
+    plan = sched.schedule()
+    assert plan is None
+    assert seq.status is SeqStatus.FAILED
+
+
+def test_idle_returns_none():
+    sched, _ = make_sched()
+    assert sched.schedule() is None
+    assert not sched.has_work()

@@ -1,0 +1,236 @@
+"""The in-house TPU inference backend (`engine_type: jax_tpu`).
+
+Implements the reference's 4-method backend seam
+(vgate/backends/base.py:21-34) — but where vLLM/SGLang adapters delegate to
+external GPU engines (vllm_backend.py:48-70), this backend owns the whole
+stack: JAX model runner, paged KV cache, continuous-batching scheduler and
+device-side sampling (runtime/engine_core.py).  Additional capabilities the
+gateway exploits when present: ``generate_async`` (sequences join the running
+engine between decode steps), ``stream_async`` (per-token SSE), ``embed``
+(real encoder embeddings) and ``device_health``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import threading
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vgate_tpu.backends.base import GenerationResult, SamplingParams
+from vgate_tpu.config import get_config
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
+from vgate_tpu.runtime.engine_core import EngineCore
+from vgate_tpu.runtime.sequence import SeqStatus
+from vgate_tpu.utils.math import bucket_for, round_up
+
+logger = get_logger(__name__)
+
+
+class Embedder:
+    """Encoder-model wrapper for /v1/embeddings."""
+
+    BUCKETS = (32, 128, 512)
+
+    def __init__(self, model_id: str, checkpoint_path: Optional[str], dtype):
+        from vgate_tpu.models.encoder import (
+            encode_forward,
+            init_encoder_params,
+        )
+        from vgate_tpu.runtime.tokenizer import get_tokenizer
+
+        self.spec = spec_for_model_id(model_id)
+        if not self.spec.is_encoder:
+            raise ValueError(f"{model_id} is not an encoder model")
+        self.tokenizer = get_tokenizer(self.spec, checkpoint_path)
+        self.params = init_encoder_params(
+            self.spec, jax.random.PRNGKey(0), dtype
+        )
+        # TODO(checkpoints): load bge safetensors via
+        # encoder_params_from_torch_state_dict when a local path is configured.
+        self._forward = jax.jit(
+            functools.partial(encode_forward, spec=self.spec)
+        )
+        self._lock = threading.Lock()
+
+    def embed(self, inputs: Sequence[str]) -> List[List[float]]:
+        max_len = self.spec.max_position_embeddings
+        ids = [self.tokenizer.encode(t)[: max_len - 2] for t in inputs]
+        longest = max(1, max(len(i) for i in ids))
+        S = bucket_for(
+            min(longest + 2, max_len),
+            [b for b in self.BUCKETS if b <= max_len] + [max_len],
+        )
+        B = max(1, min(64, 1 << (len(ids) - 1).bit_length()))
+        out: List[List[float]] = []
+        with self._lock:
+            for chunk_start in range(0, len(ids), B):
+                chunk = ids[chunk_start : chunk_start + B]
+                tokens = np.zeros((B, S), np.int32)
+                mask = np.zeros((B, S), np.int32)
+                for row, seq_ids in enumerate(chunk):
+                    full = (
+                        [self.tokenizer.bos_id] + seq_ids + [self.tokenizer.eos_id]
+                    )
+                    tokens[row, : len(full)] = full
+                    mask[row, : len(full)] = 1
+                vecs = self._forward(
+                    self.params,
+                    tokens=jnp.asarray(tokens),
+                    mask=jnp.asarray(mask),
+                )
+                out.extend(
+                    np.asarray(vecs[: len(chunk)], np.float32).tolist()
+                )
+        return out
+
+
+class JaxTPUBackend:
+    """Continuous-batching TPU backend behind the 4-method protocol."""
+
+    def __init__(self) -> None:
+        self.core: Optional[EngineCore] = None
+        self._embedder: Optional[Embedder] = None
+        self._config = None
+
+    # -- protocol --
+
+    def load_model(self, model_config: Any) -> None:
+        self._config = get_config()
+        self.core = EngineCore(self._config)
+        self.core.start()
+        logger.info(
+            "jax_tpu backend ready",
+            extra={
+                "extra_data": {
+                    "model": self.core.spec.name,
+                    "mesh": {
+                        k: int(v) for k, v in self.core.mesh.shape.items()
+                    },
+                    "kv_pages": self.core.geometry.num_pages,
+                }
+            },
+        )
+
+    def create_sampling_params(self, **kwargs: Any) -> SamplingParams:
+        return SamplingParams(**kwargs)
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        sampling_params: Sequence[SamplingParams],
+    ) -> List[GenerationResult]:
+        assert self.core is not None, "load_model not called"
+        raw = self.core.generate(prompts, sampling_params)
+        return [GenerationResult(**r) for r in raw]
+
+    def shutdown(self) -> None:
+        if self.core is not None:
+            self.core.stop()
+            self.core = None
+
+    # -- async extensions used by the gateway --
+
+    async def generate_async(
+        self,
+        prompts: Sequence[str],
+        sampling_params: Sequence[SamplingParams],
+    ) -> List[GenerationResult]:
+        """Submit into the running engine and await completion without
+        blocking the event loop (sequences from concurrent batches share
+        decode steps — this is where continuous batching pays off)."""
+        assert self.core is not None
+        loop = asyncio.get_running_loop()
+        seqs = [
+            self.core.submit_prompt(p, sp)
+            for p, sp in zip(prompts, sampling_params)
+        ]
+
+        def wait_all():
+            for seq in seqs:
+                seq.done_event.wait()
+
+        await loop.run_in_executor(None, wait_all)
+        results = []
+        for seq in seqs:
+            if seq.status is SeqStatus.FAILED:
+                raise seq.error  # type: ignore[misc]
+            text = self.core.tokenizer.decode(seq.generated_ids)
+            results.append(
+                GenerationResult(
+                    text=text,
+                    token_ids=list(seq.generated_ids),
+                    num_tokens=seq.num_output_tokens,
+                    prompt_tokens=seq.orig_prompt_len,
+                    finish_reason=seq.finish_reason,
+                    metrics={
+                        "ttft": seq.ttft or 0.0,
+                        "tpot": seq.tpot or 0.0,
+                        "gen_time": (seq.finish_t or 0.0) - seq.arrival_t,
+                    },
+                )
+            )
+        return results
+
+    async def stream_async(
+        self, prompt: str, params: SamplingParams
+    ) -> AsyncIterator[str]:
+        """Token-by-token text deltas for SSE streaming."""
+        assert self.core is not None
+        loop = asyncio.get_running_loop()
+        q: "asyncio.Queue[Optional[int]]" = asyncio.Queue()
+
+        def on_token(token: int) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, token)
+
+        seq = self.core.submit_prompt(prompt, params, stream_cb=on_token)
+
+        def on_done() -> None:
+            seq.done_event.wait()
+            loop.call_soon_threadsafe(q.put_nowait, None)
+
+        threading.Thread(target=on_done, daemon=True).start()
+
+        emitted = ""
+        ids: List[int] = []
+        while True:
+            token = await q.get()
+            if token is None:
+                break
+            ids.append(token)
+            text = self.core.tokenizer.decode(ids)
+            if len(text) > len(emitted):
+                delta = text[len(emitted):]
+                emitted = text
+                yield delta
+        if seq.status is SeqStatus.FAILED:
+            raise seq.error  # type: ignore[misc]
+
+    # -- embeddings --
+
+    def embed(self, inputs: Sequence[str]) -> List[List[float]]:
+        if self._embedder is None:
+            config = self._config or get_config()
+            self._embedder = Embedder(
+                config.model.embedding_model_id,
+                config.model.embedding_checkpoint_path,
+                jnp.float32,
+            )
+        return self._embedder.embed(inputs)
+
+    # -- introspection --
+
+    def device_health(self) -> Dict[str, Any]:
+        if self.core is None:
+            return {"alive": False, "error": "not loaded"}
+        return self.core.device_health()
+
+    def get_stats(self) -> Dict[str, Any]:
+        if self.core is None:
+            return {}
+        return self.core.get_stats()

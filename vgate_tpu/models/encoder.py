@@ -1,0 +1,180 @@
+"""BERT-family encoder for the embeddings path (bge-base-en-v1.5).
+
+The reference's /v1/embeddings is a hardcoded mock (vgate/engine.py:93-111
+returns a fixed 1536-dim ramp); this is the real encoder it lacked, served
+through the same engine seam (north-star config[3] in BASELINE.json).
+CLS-token pooling + L2 normalization, matching the bge family's usage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.models.specs import ModelSpec
+from vgate_tpu.ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+
+def init_encoder_params(
+    spec: ModelSpec, key: jax.Array, dtype=jnp.float32
+) -> Params:
+    keys = jax.random.split(key, 12)
+    D, L, F, V = (
+        spec.hidden_size,
+        spec.num_layers,
+        spec.intermediate_size,
+        spec.vocab_size,
+    )
+    P = spec.max_position_embeddings
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "word_embed": normal(keys[0], (V, D)),
+        "pos_embed": normal(keys[1], (P, D)),
+        "type_embed": normal(keys[2], (2, D)),
+        "embed_ln": {"w": jnp.ones((D,), dtype), "b": jnp.zeros((D,), dtype)},
+        "layers": {
+            "q": {"w": normal(keys[3], (L, D, D)), "b": jnp.zeros((L, D), dtype)},
+            "k": {"w": normal(keys[4], (L, D, D)), "b": jnp.zeros((L, D), dtype)},
+            "v": {"w": normal(keys[5], (L, D, D)), "b": jnp.zeros((L, D), dtype)},
+            "o": {"w": normal(keys[6], (L, D, D)), "b": jnp.zeros((L, D), dtype)},
+            "attn_ln": {
+                "w": jnp.ones((L, D), dtype),
+                "b": jnp.zeros((L, D), dtype),
+            },
+            "ffn_in": {
+                "w": normal(keys[7], (L, D, F)),
+                "b": jnp.zeros((L, F), dtype),
+            },
+            "ffn_out": {
+                "w": normal(keys[8], (L, F, D)),
+                "b": jnp.zeros((L, D), dtype),
+            },
+            "ffn_ln": {
+                "w": jnp.ones((L, D), dtype),
+                "b": jnp.zeros((L, D), dtype),
+            },
+        },
+    }
+
+
+def encode_forward(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,  # [B, S]
+    mask: jnp.ndarray,  # [B, S] 1 for real tokens
+    normalize: bool = True,
+) -> jnp.ndarray:
+    """Returns pooled sentence embeddings [B, D] (CLS pooling)."""
+    B, S = tokens.shape
+    H, hd = spec.num_heads, spec.head_dim
+    eps = 1e-12
+
+    positions = jnp.arange(S)[None, :]
+    x = (
+        params["word_embed"][tokens]
+        + params["pos_embed"][positions]
+        + params["type_embed"][jnp.zeros_like(tokens)]
+    )
+    x = layer_norm(x, params["embed_ln"]["w"], params["embed_ln"]["b"], eps)
+
+    attn_bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)  # [B,1,1,S]
+
+    def layer_fn(h, lp):
+        def proj(p):
+            return (
+                jnp.einsum("bsd,de->bse", h, p["w"]) + p["b"]
+            ).reshape(B, S, H, hd)
+
+        q, k, v = proj(lp["q"]), proj(lp["k"]), proj(lp["v"])
+        scores = (
+            jnp.einsum("bshd,bthd->bhst", q, k,
+                       preferred_element_type=jnp.float32)
+            / (hd ** 0.5)
+            + attn_bias
+        )
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, H * hd)
+        attn = jnp.einsum("bsh,hd->bsd", attn, lp["o"]["w"]) + lp["o"]["b"]
+        h = layer_norm(h + attn, lp["attn_ln"]["w"], lp["attn_ln"]["b"], eps)
+        ffn = jnp.einsum("bsd,df->bsf", h, lp["ffn_in"]["w"]) + lp["ffn_in"]["b"]
+        ffn = jax.nn.gelu(ffn.astype(jnp.float32), approximate=False).astype(
+            h.dtype
+        )
+        ffn = jnp.einsum("bsf,fd->bsd", ffn, lp["ffn_out"]["w"]) + lp["ffn_out"]["b"]
+        h = layer_norm(h + ffn, lp["ffn_ln"]["w"], lp["ffn_ln"]["b"], eps)
+        return h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    pooled = x[:, 0]  # CLS token
+    if normalize:
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled.astype(jnp.float32), axis=-1, keepdims=True),
+            1e-9,
+        ).astype(pooled.dtype)
+    return pooled
+
+
+def encoder_params_from_torch_state_dict(spec: ModelSpec, state_dict, dtype=jnp.float32):
+    """Map HF BertModel weights into the encoder pytree (parity tests +
+    local bge checkpoints)."""
+    import numpy as np
+
+    def get(name):
+        return state_dict[name].detach().to("cpu").float().numpy()
+
+    def stack(template, transpose=False):
+        arrs = [get(template.format(i)) for i in range(spec.num_layers)]
+        return np.stack([a.T if transpose else a for a in arrs])
+
+    pre = "encoder.layer.{}."
+    params = {
+        "word_embed": get("embeddings.word_embeddings.weight"),
+        "pos_embed": get("embeddings.position_embeddings.weight"),
+        "type_embed": get("embeddings.token_type_embeddings.weight"),
+        "embed_ln": {
+            "w": get("embeddings.LayerNorm.weight"),
+            "b": get("embeddings.LayerNorm.bias"),
+        },
+        "layers": {
+            "q": {
+                "w": stack(pre + "attention.self.query.weight", True),
+                "b": stack(pre + "attention.self.query.bias"),
+            },
+            "k": {
+                "w": stack(pre + "attention.self.key.weight", True),
+                "b": stack(pre + "attention.self.key.bias"),
+            },
+            "v": {
+                "w": stack(pre + "attention.self.value.weight", True),
+                "b": stack(pre + "attention.self.value.bias"),
+            },
+            "o": {
+                "w": stack(pre + "attention.output.dense.weight", True),
+                "b": stack(pre + "attention.output.dense.bias"),
+            },
+            "attn_ln": {
+                "w": stack(pre + "attention.output.LayerNorm.weight"),
+                "b": stack(pre + "attention.output.LayerNorm.bias"),
+            },
+            "ffn_in": {
+                "w": stack(pre + "intermediate.dense.weight", True),
+                "b": stack(pre + "intermediate.dense.bias"),
+            },
+            "ffn_out": {
+                "w": stack(pre + "output.dense.weight", True),
+                "b": stack(pre + "output.dense.bias"),
+            },
+            "ffn_ln": {
+                "w": stack(pre + "output.LayerNorm.weight"),
+                "b": stack(pre + "output.LayerNorm.bias"),
+            },
+        },
+    }
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)

@@ -1,0 +1,434 @@
+"""The TPU engine core: a device-owning continuous-batching loop.
+
+Architecture (SURVEY.md section 7, stages 3-4):
+
+* One **engine thread** owns the device.  Each iteration it asks the
+  scheduler for a plan: admit-and-prefill one waiting prompt, or run one
+  decode step over every active slot.  New sequences therefore join between
+  decode steps — no stop-the-world batch (the reference's design it
+  replaces: vgate/batcher.py:195's global lock around blocking generate).
+* **Two compiled programs** cover all steady-state work: a decode step at
+  the static shape [max_batch_slots], and one prefill program per sequence
+  bucket.  Sampling runs inside both programs with per-slot parameters.
+* KV pages are donated through every call so XLA updates them in place.
+* The async serving world talks to the thread via a submit queue +
+  ``threading.Event`` per sequence; token streaming via per-token callbacks.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vgate_tpu import metrics
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.models.decoder import decode_forward, prefill_forward
+from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
+from vgate_tpu.ops.sampling import sample_tokens
+from vgate_tpu.parallel.mesh import build_mesh
+from vgate_tpu.parallel.sharding import kv_pspec, named, shard_params
+from vgate_tpu.runtime.kv_cache import (
+    KVGeometry,
+    PageAllocator,
+    auto_num_pages,
+    make_kv_buffers,
+)
+from vgate_tpu.runtime.scheduler import DecodePlan, PrefillPlan, Scheduler
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+from vgate_tpu.runtime.tokenizer import get_tokenizer
+from vgate_tpu.runtime.weights import load_or_init_params
+from vgate_tpu.utils.math import cdiv
+
+logger = get_logger(__name__)
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+}
+
+
+@functools.partial(jax.jit, static_argnames=("spec",), donate_argnames=("k_pages", "v_pages"))
+def _prefill_step(
+    params, spec: ModelSpec, tokens, seq_lens, k_pages, v_pages,
+    page_tables, temps, top_ps, top_ks, key,
+):
+    logits, k_pages, v_pages = prefill_forward(
+        params, spec, tokens, seq_lens, k_pages, v_pages, page_tables
+    )
+    next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
+    return next_tokens, k_pages, v_pages
+
+
+@functools.partial(jax.jit, static_argnames=("spec",), donate_argnames=("k_pages", "v_pages"))
+def _decode_step(
+    params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
+    page_tables, active, temps, top_ps, top_ks, key,
+):
+    logits, k_pages, v_pages = decode_forward(
+        params, spec, tokens, positions, k_pages, v_pages, page_tables,
+        active=active,
+    )
+    next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
+    return next_tokens, k_pages, v_pages
+
+
+class EngineCore:
+    """Owns params, KV pages, the mesh and the engine thread."""
+
+    def __init__(
+        self,
+        config: Optional[VGTConfig] = None,
+        spec: Optional[ModelSpec] = None,
+        params: Optional[Any] = None,
+        devices: Optional[list] = None,
+    ) -> None:
+        self.config = config or get_config()
+        self.spec = spec or spec_for_model_id(self.config.model.model_id)
+        tpu_cfg = self.config.tpu
+        self.dtype = _DTYPES[self.config.model.dtype]
+        self.mesh = build_mesh(tpu_cfg, devices)
+        self.tokenizer = get_tokenizer(
+            self.spec,
+            self.config.model.tokenizer_path
+            or self.config.model.checkpoint_path,
+        )
+
+        load_start = time.perf_counter()
+        if params is None:
+            params = load_or_init_params(
+                self.spec, self.config.model.checkpoint_path, self.dtype
+            )
+        self.params = shard_params(params, self.spec, self.mesh)
+        jax.block_until_ready(jax.tree.leaves(self.params)[0])
+        self.load_time_s = time.perf_counter() - load_start
+
+        num_pages = tpu_cfg.kv_num_pages or auto_num_pages(
+            self.spec,
+            tpu_cfg.kv_page_size,
+            tpu_cfg.hbm_utilization,
+            device=self.mesh.devices.flat[0],
+        )
+        self.geometry = KVGeometry(
+            num_layers=self.spec.num_layers,
+            num_pages=num_pages,
+            page_size=tpu_cfg.kv_page_size,
+            kv_heads=self.spec.num_kv_heads,
+            head_dim=self.spec.head_dim,
+            max_model_len=self.config.model.max_model_len,
+        )
+        kv_sharding = named(self.mesh, kv_pspec(self.spec, self.mesh))
+        self.k_pages, self.v_pages = make_kv_buffers(
+            self.geometry, self.dtype, kv_sharding
+        )
+        self.allocator = PageAllocator(num_pages)
+        self.max_slots = tpu_cfg.max_batch_slots
+        self.scheduler = Scheduler(
+            allocator=self.allocator,
+            max_slots=self.max_slots,
+            page_size=tpu_cfg.kv_page_size,
+            prefill_buckets=tpu_cfg.prefill_buckets,
+            max_model_len=self.config.model.max_model_len,
+            max_queue_size=self.config.scheduler.max_queue_size,
+            preempt_on_oom=self.config.scheduler.preempt_on_oom,
+        )
+
+        # host-side mirror of the device page tables, one row per slot
+        self._page_tables_np = np.zeros(
+            (self.max_slots, self.geometry.pages_per_seq), np.int32
+        )
+        self._base_key = jax.random.PRNGKey(self.config.model.max_model_len)
+        self._step_counter = 0
+        self._compiled_buckets: set = set()
+        self._decode_compiled = False
+
+        self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
+        self._wakeup = threading.Event()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._fatal: Optional[BaseException] = None
+        self.total_steps = 0
+        self.total_prefills = 0
+        self.total_decode_tokens = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="vgt-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._wakeup.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # ------------------------------------------------------------ submission
+
+    def submit_tokens(
+        self,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        stream_cb: Optional[Callable[[int], Any]] = None,
+    ) -> Sequence:
+        if self._fatal is not None:
+            raise RuntimeError("engine is dead") from self._fatal
+        seq = Sequence(
+            prompt_ids=list(prompt_ids),
+            params=params,
+            stream_cb=stream_cb,
+        )
+        self._submit_q.put(seq)
+        self._wakeup.set()
+        return seq
+
+    def submit_prompt(
+        self,
+        prompt: str,
+        params: SamplingParams,
+        stream_cb: Optional[Callable[[int], Any]] = None,
+    ) -> Sequence:
+        ids = self.tokenizer.encode(prompt)
+        max_prompt = self.config.model.max_model_len - 1
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]  # keep the suffix (chat-style truncation)
+        return self.submit_tokens(ids or [self.tokenizer.bos_id], params, stream_cb)
+
+    def generate(
+        self, prompts: Seq[str], params: Seq[SamplingParams]
+    ) -> List[Dict[str, Any]]:
+        """Blocking batch API used by the sync backend seam."""
+        seqs = [
+            self.submit_prompt(p, sp) for p, sp in zip(prompts, params)
+        ]
+        results = []
+        for seq in seqs:
+            seq.done_event.wait()
+            if seq.status is SeqStatus.FAILED:
+                raise seq.error  # type: ignore[misc]
+            text = self.tokenizer.decode(seq.generated_ids)
+            gen_time = (seq.finish_t or 0) - seq.arrival_t
+            n = seq.num_output_tokens
+            results.append(
+                {
+                    "text": text,
+                    "token_ids": list(seq.generated_ids),
+                    "num_tokens": n,
+                    "prompt_tokens": seq.orig_prompt_len,
+                    "finish_reason": seq.finish_reason,
+                    "metrics": {
+                        "ttft": seq.ttft or 0.0,
+                        "tpot": seq.tpot or 0.0,
+                        "gen_time": gen_time,
+                    },
+                }
+            )
+        return results
+
+    # ------------------------------------------------------------ the loop
+
+    def _loop(self) -> None:
+        logger.info("engine thread started")
+        while self._running:
+            try:
+                self._drain_submissions()
+                plan = self.scheduler.schedule()
+                if plan is None:
+                    self._wakeup.wait(timeout=0.005)
+                    self._wakeup.clear()
+                    continue
+                if isinstance(plan, PrefillPlan):
+                    self._run_prefill(plan)
+                else:
+                    self._run_decode(plan)
+                self.total_steps += 1
+            except Exception as exc:  # pragma: no cover - engine fatal path
+                logger.error("engine loop fatal error", exc_info=True)
+                self._fatal = exc
+                for seq in list(self.scheduler.running) + list(
+                    self.scheduler.waiting
+                ):
+                    seq.fail(exc)
+                self.scheduler.waiting.clear()
+                for i in range(len(self.scheduler.slots)):
+                    self.scheduler.slots[i] = None
+                self._running = False
+        logger.info("engine thread stopped")
+
+    def _drain_submissions(self) -> None:
+        while True:
+            try:
+                seq = self._submit_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self.scheduler.add(seq)
+            except Exception as exc:
+                seq.fail(exc)
+
+    def _step_key(self):
+        self._step_counter += 1
+        return jax.random.fold_in(self._base_key, self._step_counter)
+
+    def _run_prefill(self, plan: PrefillPlan) -> None:
+        seq, bucket = plan.seq, plan.bucket
+        ps = self.geometry.page_size
+        n_prompt = seq.num_prompt_tokens
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_prompt] = seq.prompt_ids
+        # page table row for this prefill: real pages then trash padding
+        row = np.zeros((self.geometry.pages_per_seq,), np.int32)
+        row[: len(seq.pages)] = seq.pages
+        self._page_tables_np[plan.slot] = row
+        n_bucket_pages = bucket // ps
+        prefill_pt = np.zeros((1, n_bucket_pages), np.int32)
+        prefill_pt[0, : len(seq.pages)] = seq.pages[:n_bucket_pages]
+
+        sp = seq.params
+        if bucket not in self._compiled_buckets:
+            metrics.RECOMPILES.labels(kind="prefill").inc()
+            self._compiled_buckets.add(bucket)
+        start = time.perf_counter()
+        next_tokens, self.k_pages, self.v_pages = _prefill_step(
+            self.params,
+            self.spec,
+            jnp.asarray(tokens),
+            jnp.asarray([n_prompt], jnp.int32),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(prefill_pt),
+            jnp.asarray([sp.temperature], jnp.float32),
+            jnp.asarray([sp.top_p], jnp.float32),
+            jnp.asarray([sp.top_k], jnp.int32),
+            self._step_key(),
+        )
+        token = int(np.asarray(next_tokens)[0])
+        metrics.ENGINE_STEP_TIME.labels(kind="prefill").observe(
+            time.perf_counter() - start
+        )
+        self.total_prefills += 1
+        seq.append_token(token)
+        self._maybe_finish(seq, token)
+
+    def _run_decode(self, plan: DecodePlan) -> None:
+        B = self.max_slots
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        for seq in plan.seqs:
+            slot = seq.slot
+            assert slot is not None
+            row = self._page_tables_np[slot]
+            row[:] = 0
+            row[: len(seq.pages)] = seq.pages
+            tokens[slot] = seq.output_ids[-1]
+            positions[slot] = seq.total_len - 1
+            active[slot] = True
+            temps[slot] = seq.params.temperature
+            top_ps[slot] = seq.params.top_p
+            top_ks[slot] = seq.params.top_k
+
+        if not self._decode_compiled:
+            metrics.RECOMPILES.labels(kind="decode").inc()
+            self._decode_compiled = True
+        start = time.perf_counter()
+        next_tokens, self.k_pages, self.v_pages = _decode_step(
+            self.params,
+            self.spec,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(self._page_tables_np),
+            jnp.asarray(active),
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
+            self._step_key(),
+        )
+        sampled = np.asarray(next_tokens)
+        metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
+            time.perf_counter() - start
+        )
+        for seq in plan.seqs:
+            token = int(sampled[seq.slot])
+            seq.append_token(token)
+            self.total_decode_tokens += 1
+            self._maybe_finish(seq, token)
+
+    def _maybe_finish(self, seq: Sequence, token: int) -> None:
+        reason = None
+        if token == self.tokenizer.eos_id:
+            reason = "stop"
+        elif seq.num_generated >= max(1, seq.params.max_tokens):
+            reason = "length"
+        elif seq.total_len >= self.config.model.max_model_len:
+            reason = "length"
+        if reason is not None:
+            self.scheduler.remove(seq)
+            seq.finish(reason)
+
+    # ------------------------------------------------------------- utilities
+
+    def warmup(self, buckets: Optional[List[int]] = None) -> float:
+        """Pre-compile the decode program and the given (default: smallest)
+        prefill buckets so first requests don't pay XLA compile latency."""
+        start = time.perf_counter()
+        was_running = self._running
+        if not was_running:
+            self.start()
+        sp = SamplingParams(max_tokens=2, temperature=0.0)
+        buckets = buckets or [self.scheduler.prefill_buckets[0]]
+        for bucket in buckets:
+            n = max(1, min(bucket - 1, 8))
+            seq = self.submit_tokens([5] * n, sp)
+            seq.done_event.wait(timeout=600)
+        if not was_running:
+            self.stop()
+        return time.perf_counter() - start
+
+    def device_health(self) -> Dict[str, Any]:
+        try:
+            device = self.mesh.devices.flat[0]
+            value = float(jnp.asarray([1.0]).sum())
+            return {
+                "alive": value == 1.0,
+                "platform": device.platform,
+                "device_kind": getattr(device, "device_kind", "unknown"),
+                "num_devices": int(self.mesh.devices.size),
+            }
+        except Exception as exc:  # pragma: no cover
+            return {"alive": False, "error": str(exc)}
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "scheduler": self.scheduler.get_stats(),
+            "steps": self.total_steps,
+            "prefills": self.total_prefills,
+            "decode_tokens": self.total_decode_tokens,
+            "kv_pages_total": self.geometry.num_pages - 1,
+            "kv_token_capacity": self.geometry.total_tokens,
+            "model": self.spec.name,
+            "mesh": {
+                axis: int(size) for axis, size in self.mesh.shape.items()
+            },
+            "load_time_s": round(self.load_time_s, 2),
+        }

@@ -1,0 +1,134 @@
+"""Paged KV cache: geometry, page allocator and device buffers.
+
+First-party replacement for the paged-KV capability the reference gets
+opaquely from vLLM (SURVEY.md section 2.1 "Paged KV cache + attention
+kernels").  Layout: ``[num_layers, num_pages, page_size, kv_heads, head_dim]``
+per K and V, resident in TPU HBM; **page 0 is a reserved trash page** that
+absorbs writes from padded positions and idle decode slots so device code
+never branches on validity.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu import metrics
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.models.specs import ModelSpec
+from vgate_tpu.utils.math import cdiv
+
+logger = get_logger(__name__)
+
+
+@dataclass(frozen=True)
+class KVGeometry:
+    num_layers: int
+    num_pages: int  # includes trash page 0
+    page_size: int
+    kv_heads: int
+    head_dim: int
+    max_model_len: int
+
+    @property
+    def pages_per_seq(self) -> int:
+        return cdiv(self.max_model_len, self.page_size)
+
+    @property
+    def page_bytes(self) -> int:
+        # K and V, bf16
+        return 2 * self.num_layers * self.page_size * self.kv_heads * self.head_dim * 2
+
+    @property
+    def total_tokens(self) -> int:
+        return (self.num_pages - 1) * self.page_size
+
+
+def auto_num_pages(
+    spec: ModelSpec,
+    page_size: int,
+    hbm_utilization: float,
+    device=None,
+    fallback: int = 512,
+    hard_cap: int = 65536,
+) -> int:
+    """Size the page pool from free device HBM after weights are resident
+    (the serving analogue of vLLM's gpu_memory_utilization knob,
+    reference config: vgate/config.py:47)."""
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats or "bytes_limit" not in stats:
+        return fallback
+    limit = stats["bytes_limit"] * hbm_utilization
+    in_use = stats.get("bytes_in_use", 0)
+    free = max(0, limit - in_use)
+    page_bytes = (
+        2 * spec.num_layers * page_size * spec.num_kv_heads * spec.head_dim * 2
+    )
+    pages = int(free // page_bytes)
+    return max(16, min(pages, hard_cap))
+
+
+class PageAllocator:
+    """Free-list allocator over page ids 1..num_pages-1 (0 is trash)."""
+
+    def __init__(self, num_pages: int) -> None:
+        self.num_pages = num_pages
+        self._free: Deque[int] = deque(range(1, num_pages))
+        metrics.KV_PAGES_TOTAL.set(num_pages - 1)
+        metrics.KV_PAGES_IN_USE.set(0)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing allocation of n pages; None when insufficient."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        metrics.KV_PAGES_IN_USE.set(self.num_used)
+        return pages
+
+    def release(self, pages: List[int]) -> None:
+        for page in pages:
+            if not 1 <= page < self.num_pages:
+                raise ValueError(f"bad page id {page}")
+            self._free.append(page)
+        metrics.KV_PAGES_IN_USE.set(self.num_used)
+
+
+def make_kv_buffers(geometry: KVGeometry, dtype=jnp.bfloat16, sharding=None):
+    """Allocate the K/V page pools (zeros) directly on device."""
+    shape = (
+        geometry.num_layers,
+        geometry.num_pages,
+        geometry.page_size,
+        geometry.kv_heads,
+        geometry.head_dim,
+    )
+    if sharding is not None:
+        k = jax.device_put(jnp.zeros(shape, dtype), sharding)
+        v = jax.device_put(jnp.zeros(shape, dtype), sharding)
+    else:
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+    logger.info(
+        "kv cache allocated",
+        extra={
+            "extra_data": {
+                "pages": geometry.num_pages,
+                "tokens_capacity": geometry.total_tokens,
+                "mb": round(2 * k.size * k.dtype.itemsize / 1e6),
+            }
+        },
+    )
+    return k, v

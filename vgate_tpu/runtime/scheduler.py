@@ -1,0 +1,241 @@
+"""Continuous-batching scheduler.
+
+Replaces the stop-the-world batch lock at the heart of the reference
+(vgate/batcher.py:79,195 serializes every batch behind one asyncio.Lock,
+SURVEY.md section 7 step 4) with per-step admission: the decode loop owns
+the device, and between decode steps the scheduler admits waiting prompts
+into free slots, allocates KV pages on demand, and preempts under memory
+pressure.
+
+Pure host-side policy, no JAX: fully unit-testable (SURVEY.md section 4's
+CPU-only strategy).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, List, Optional, Union
+
+from vgate_tpu import metrics
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.runtime.kv_cache import PageAllocator
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+from vgate_tpu.utils.math import bucket_for, cdiv
+
+logger = get_logger(__name__)
+
+
+class EngineBusyError(RuntimeError):
+    """Raised at admission when the waiting queue is full (load shedding,
+    SURVEY.md section 5.3: 'add deadlines/load-shedding at admission')."""
+
+
+@dataclass
+class PrefillPlan:
+    seq: Sequence
+    slot: int
+    bucket: int  # padded sequence length for this prefill program
+
+
+@dataclass
+class DecodePlan:
+    seqs: List[Sequence]  # active sequences, indexed by slot in .slot
+
+
+Plan = Union[PrefillPlan, DecodePlan]
+
+
+class Scheduler:
+    def __init__(
+        self,
+        allocator: PageAllocator,
+        max_slots: int,
+        page_size: int,
+        prefill_buckets: List[int],
+        max_model_len: int,
+        max_queue_size: int = 512,
+        preempt_on_oom: bool = True,
+    ) -> None:
+        self.allocator = allocator
+        self.page_size = page_size
+        self.prefill_buckets = sorted(
+            b for b in prefill_buckets if b <= max_model_len
+        ) or [max_model_len]
+        self.max_model_len = max_model_len
+        self.max_queue_size = max_queue_size
+        self.preempt_on_oom = preempt_on_oom
+        self.waiting: Deque[Sequence] = deque()
+        self.slots: List[Optional[Sequence]] = [None] * max_slots
+        self.total_preemptions = 0
+        self.total_admitted = 0
+        self.total_finished = 0
+
+    # -- admission --
+
+    def add(self, seq: Sequence) -> None:
+        if len(self.waiting) >= self.max_queue_size:
+            raise EngineBusyError(
+                f"engine queue full ({self.max_queue_size} waiting)"
+            )
+        if seq.num_prompt_tokens >= self.max_model_len:
+            raise ValueError(
+                f"prompt of {seq.num_prompt_tokens} tokens exceeds "
+                f"max_model_len={self.max_model_len}"
+            )
+        self.waiting.append(seq)
+        metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+
+    # -- queries --
+
+    @property
+    def running(self) -> List[Sequence]:
+        return [s for s in self.slots if s is not None]
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(
+            s is not None for s in self.slots
+        )
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    # -- planning --
+
+    def schedule(self) -> Optional[Plan]:
+        """Pick the next device program: prefill-priority admission, else a
+        decode step over the active slots."""
+        plan = self._try_admit()
+        if plan is not None:
+            return plan
+        active = self.running
+        if not active:
+            return None
+        if self._ensure_decode_pages(active):
+            # preemption may have emptied the slots
+            active = self.running
+            if active:
+                return DecodePlan(seqs=active)
+        return self._try_admit()  # everything preempted; try re-admission
+
+    def _try_admit(self) -> Optional[PrefillPlan]:
+        if not self.waiting:
+            return None
+        slot = self._free_slot()
+        if slot is None:
+            return None
+        seq = self.waiting[0]
+        n_pages = cdiv(max(1, seq.num_prompt_tokens), self.page_size)
+        pages = self.allocator.allocate(n_pages)
+        if pages is None:
+            if self.preempt_on_oom and not self.running:
+                # nothing to preempt and still no memory: the prompt can
+                # never fit — fail it rather than deadlock
+                self.waiting.popleft()
+                seq.fail(
+                    RuntimeError(
+                        "KV cache too small for prompt "
+                        f"({seq.num_prompt_tokens} tokens)"
+                    )
+                )
+            return None
+        self.waiting.popleft()
+        metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+        seq.pages = pages
+        seq.slot = slot
+        seq.status = SeqStatus.RUNNING
+        self.slots[slot] = seq
+        self.total_admitted += 1
+        metrics.ACTIVE_SEQUENCES.set(len(self.running))
+        bucket = bucket_for(seq.num_prompt_tokens, self.prefill_buckets)
+        return PrefillPlan(seq=seq, slot=slot, bucket=bucket)
+
+    def _ensure_decode_pages(self, active: List[Sequence]) -> bool:
+        """Allocate a page for every sequence whose next token crosses a page
+        boundary; preempt the youngest sequences on exhaustion.  Returns True
+        when a decode step can proceed."""
+        for seq in sorted(active, key=lambda s: s.seq_id):
+            if seq.status is not SeqStatus.RUNNING:
+                continue  # preempted by an earlier iteration
+            while True:
+                # position of the token fed this step
+                pos = seq.total_len - 1
+                needed = pos // self.page_size + 1
+                if len(seq.pages) >= needed:
+                    break
+                pages = self.allocator.allocate(1)
+                if pages is not None:
+                    seq.pages.extend(pages)
+                    break
+                if not self.preempt_on_oom:
+                    seq.fail(RuntimeError("KV pages exhausted"))
+                    self.remove(seq)
+                    break
+                victim = self._pick_victim()
+                if victim is None or (
+                    victim is seq and len(self.running) == 1
+                ):
+                    # alone and still no memory: the context can never fit
+                    seq.fail(RuntimeError("KV pages exhausted"))
+                    self.remove(seq)
+                    break
+                self._preempt(victim)
+                if victim is seq:
+                    break  # requester preempted itself; skip its decode
+        return any(s is not None for s in self.slots)
+
+    def _pick_victim(self) -> Optional[Sequence]:
+        """Youngest running sequence — possibly the requester itself."""
+        running = self.running
+        if not running:
+            return None
+        return max(running, key=lambda s: s.seq_id)
+
+    def _preempt(self, seq: Sequence) -> None:
+        logger.warning(
+            "preempting sequence for KV pressure",
+            extra={
+                "extra_data": {
+                    "seq_id": seq.seq_id,
+                    "resident_tokens": seq.total_len,
+                }
+            },
+        )
+        slot = seq.slot
+        self.allocator.release(seq.pages)
+        if slot is not None:
+            self.slots[slot] = None
+        seq.reset_for_recompute()
+        self.waiting.appendleft(seq)
+        self.total_preemptions += 1
+        metrics.PREEMPTED_SEQUENCES.inc()
+        metrics.ACTIVE_SEQUENCES.set(len(self.running))
+        metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+
+    # -- completion --
+
+    def remove(self, seq: Sequence) -> None:
+        """Release residency after finish/failure."""
+        if seq.pages:
+            self.allocator.release(seq.pages)
+            seq.pages = []
+        if seq.slot is not None and self.slots[seq.slot] is seq:
+            self.slots[seq.slot] = None
+        seq.slot = None
+        self.total_finished += 1
+        metrics.ACTIVE_SEQUENCES.set(len(self.running))
+
+    def get_stats(self) -> dict:
+        return {
+            "waiting": len(self.waiting),
+            "running": len(self.running),
+            "slots": len(self.slots),
+            "free_pages": self.allocator.num_free,
+            "used_pages": self.allocator.num_used,
+            "admitted": self.total_admitted,
+            "finished": self.total_finished,
+            "preemptions": self.total_preemptions,
+        }

@@ -1,0 +1,112 @@
+"""Per-request sequence state tracked by the continuous-batching scheduler."""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from vgate_tpu.backends.base import SamplingParams
+
+_seq_counter = itertools.count()
+
+
+class SeqStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    FAILED = "failed"
+
+
+@dataclass
+class Sequence:
+    prompt_ids: List[int]
+    params: SamplingParams
+    seq_id: int = field(default_factory=lambda: next(_seq_counter))
+    status: SeqStatus = SeqStatus.WAITING
+    # tokens generated since the last (re-)prefill — the decode feed
+    output_ids: List[int] = field(default_factory=list)
+    # every token ever generated, surviving preemption/recompute — the result
+    generated_ids: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    finish_reason: str = "stop"
+    error: Optional[BaseException] = None
+    # timing
+    arrival_t: float = field(default_factory=time.perf_counter)
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    # delivery
+    done_event: threading.Event = field(default_factory=threading.Event)
+    stream_cb: Optional[Callable[[int], Any]] = None
+    preempt_count: int = 0
+    orig_prompt_len: int = 0
+
+    def __post_init__(self) -> None:
+        if self.orig_prompt_len == 0:
+            self.orig_prompt_len = len(self.prompt_ids)
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self.generated_ids)
+
+    @property
+    def total_len(self) -> int:
+        """Tokens whose KV is (or will be) resident."""
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def num_generated(self) -> int:
+        """Generated tokens across preemptions (output_ids may have been
+        folded into prompt_ids by reset_for_recompute)."""
+        return len(self.generated_ids)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.arrival_t
+
+    @property
+    def tpot(self) -> Optional[float]:
+        if self.finish_t is None or self.first_token_t is None:
+            return None
+        n = max(1, self.num_output_tokens - 1)
+        return (self.finish_t - self.first_token_t) / n
+
+    def append_token(self, token: int) -> None:
+        if self.first_token_t is None:
+            self.first_token_t = time.perf_counter()
+        self.output_ids.append(token)
+        self.generated_ids.append(token)
+        if self.stream_cb is not None:
+            self.stream_cb(token)
+
+    def finish(self, reason: str) -> None:
+        self.status = SeqStatus.FINISHED
+        self.finish_reason = reason
+        self.finish_t = time.perf_counter()
+        self.done_event.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self.status = SeqStatus.FAILED
+        self.error = exc
+        self.finish_t = time.perf_counter()
+        self.done_event.set()
+
+    def reset_for_recompute(self) -> None:
+        """Preemption: drop residency, keep generated tokens in the prompt so
+        decode resumes exactly where it stopped after re-prefill."""
+        self.prompt_ids = self.prompt_ids + self.output_ids
+        self.output_ids = []
+        self.pages = []
+        self.slot = None
+        self.status = SeqStatus.WAITING
+        self.preempt_count += 1
