@@ -1,0 +1,95 @@
+"""Encoder (BERT/bge family) parity vs HF torch + tokenizer unit tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.models.encoder import (
+    encode_forward,
+    encoder_params_from_torch_state_dict,
+    init_encoder_params,
+)
+from vgate_tpu.models.specs import TINY_ENCODER
+from vgate_tpu.runtime.tokenizer import ByteTokenizer, get_tokenizer
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def test_encoder_parity_with_hf_bert():
+    spec = TINY_ENCODER
+    config = transformers.BertConfig(
+        vocab_size=spec.vocab_size,
+        hidden_size=spec.hidden_size,
+        num_hidden_layers=spec.num_layers,
+        num_attention_heads=spec.num_heads,
+        intermediate_size=spec.intermediate_size,
+        max_position_embeddings=spec.max_position_embeddings,
+        hidden_act="gelu",
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(config, add_pooling_layer=False).eval()
+    params = encoder_params_from_torch_state_dict(spec, model.state_dict())
+
+    rng = np.random.default_rng(0)
+    B, S = 2, 12
+    lens = [12, 8]
+    tokens = np.zeros((B, S), np.int64)
+    mask = np.zeros((B, S), np.int64)
+    for b, n in enumerate(lens):
+        tokens[b, :n] = rng.integers(3, spec.vocab_size, size=n)
+        mask[b, :n] = 1
+
+    with torch.no_grad():
+        hf = model(
+            input_ids=torch.tensor(tokens),
+            attention_mask=torch.tensor(mask),
+        ).last_hidden_state.float().numpy()
+
+    ours = encode_forward(
+        params,
+        spec,
+        jnp.asarray(tokens, jnp.int32),
+        jnp.asarray(mask, jnp.int32),
+        normalize=False,
+    )
+    # compare the CLS hidden state (what pooling consumes)
+    np.testing.assert_allclose(
+        np.asarray(ours), hf[:, 0], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_encoder_padding_invariance():
+    """Extending padding must not change real-token outputs."""
+    spec = TINY_ENCODER
+    params = init_encoder_params(spec, jax.random.PRNGKey(0), jnp.float32)
+    ids = np.asarray([[7, 8, 9, 0, 0, 0, 0, 0]], np.int32)
+    mask = np.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], np.int32)
+    short = encode_forward(params, spec, jnp.asarray(ids[:, :4]),
+                           jnp.asarray(mask[:, :4]))
+    long = encode_forward(params, spec, jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_allclose(
+        np.asarray(short), np.asarray(long), atol=1e-5
+    )
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer(TINY_ENCODER)
+        text = "hello wörld! 你好"
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_specials_excluded_from_decode(self):
+        tok = ByteTokenizer(TINY_ENCODER)
+        ids = tok.encode("ab")
+        assert tok.decode([tok.eos_id] + ids + [tok.eos_id, 300]) == "ab"
+
+    def test_fallback_selection(self):
+        tok = get_tokenizer(TINY_ENCODER, tokenizer_path=None)
+        assert isinstance(tok, ByteTokenizer)
+
+    def test_eos_within_vocab(self):
+        tok = ByteTokenizer(TINY_ENCODER)
+        assert 0 <= tok.eos_id < TINY_ENCODER.vocab_size

@@ -1,0 +1,150 @@
+"""Mesh construction + TP sharding semantics on the virtual 8-device CPU
+mesh (SURVEY.md section 4's multi-chip strategy; section 2.2 checklist)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from vgate_tpu.config import load_config
+from vgate_tpu.models.decoder import init_params
+from vgate_tpu.models.specs import TINY_DENSE, TINY_MOE
+from vgate_tpu.parallel.mesh import MESH_AXES, build_mesh, resolve_plan
+from vgate_tpu.parallel.sharding import (
+    kv_pspec,
+    param_pspecs,
+    shard_params,
+)
+
+
+def tpu_cfg(**kw):
+    return load_config(tpu=kw).tpu
+
+
+class TestMeshPlan:
+    def test_auto_axis_absorbs_devices(self):
+        plan = resolve_plan(tpu_cfg(tp=0, dp=1), num_devices=8)
+        assert plan.tp == 8 and plan.num_devices == 8
+
+    def test_mixed_axes(self):
+        plan = resolve_plan(tpu_cfg(dp=2, tp=0), num_devices=8)
+        assert (plan.dp, plan.tp) == (2, 4)
+
+    def test_expert_axis(self):
+        plan = resolve_plan(tpu_cfg(dp=1, ep=4, tp=2), num_devices=8)
+        assert (plan.ep, plan.tp) == (4, 2)
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            resolve_plan(tpu_cfg(dp=3, tp=1), num_devices=8)
+
+    def test_two_auto_axes_raise(self):
+        with pytest.raises(ValueError):
+            resolve_plan(tpu_cfg(dp=0, tp=0), num_devices=8)
+
+    def test_build_mesh_axis_names(self):
+        mesh = build_mesh(tpu_cfg(tp=0))
+        assert mesh.axis_names == MESH_AXES
+        assert mesh.shape["tp"] == 8
+
+    def test_submesh_via_num_devices(self):
+        mesh = build_mesh(tpu_cfg(tp=0, num_devices=4))
+        assert mesh.devices.size == 4
+
+
+class TestParamShardings:
+    def test_attention_heads_shard_on_tp(self):
+        mesh = build_mesh(tpu_cfg(tp=0))  # tp=8
+        pspecs = param_pspecs(TINY_DENSE, mesh)
+        # q_dim=64 divisible by 8 -> sharded on last dim
+        assert pspecs["layers"]["q"]["w"] == P(None, None, "tp")
+        assert pspecs["layers"]["o"]["w"] == P(None, "tp", None)
+        assert pspecs["layers"]["gate"]["w"] == P(None, None, "tp")
+        assert pspecs["layers"]["down"]["w"] == P(None, "tp", None)
+        assert pspecs["embed"] == P("tp", None)
+        assert pspecs["layers"]["input_norm"] == P()
+
+    def test_indivisible_dims_replicate(self):
+        # kv_dim = 2*16 = 32; on tp=8: 32 % 8 == 0 -> sharded. On a mesh of
+        # tp=8 with head count 4 (q_dim=64): fine. Make kv indivisible via
+        # a 3-way check instead: vocab 512 % 8 == 0 -> sharded; so test the
+        # degenerate mesh (tp=1) where nothing shards.
+        mesh = build_mesh(tpu_cfg(tp=1, dp=0))
+        pspecs = param_pspecs(TINY_DENSE, mesh)
+        assert pspecs["layers"]["q"]["w"] == P(None, None, None)
+
+    def test_moe_experts_shard_on_ep(self):
+        mesh = build_mesh(tpu_cfg(ep=4, tp=2))
+        pspecs = param_pspecs(TINY_MOE, mesh)
+        assert pspecs["layers"]["gate"]["w"] == P(None, "ep", None, "tp")
+        assert pspecs["layers"]["down"]["w"] == P(None, "ep", "tp", None)
+        assert pspecs["layers"]["router"] == P()
+
+    def test_kv_pages_shard_only_on_kv_heads(self):
+        mesh = build_mesh(tpu_cfg(tp=2, dp=0))
+        spec = kv_pspec(TINY_DENSE, mesh)  # kv_heads=2 % 2 == 0
+        assert spec == P(None, None, None, "tp", None)
+
+    def test_shard_params_places_on_mesh(self):
+        mesh = build_mesh(tpu_cfg(tp=0))
+        params = init_params(TINY_DENSE, jax.random.PRNGKey(0), jnp.float32)
+        sharded = shard_params(params, TINY_DENSE, mesh)
+        qw = sharded["layers"]["q"]["w"]
+        assert len(qw.sharding.device_set) == 8
+        # sharded dim is split 8 ways
+        shard_shape = qw.sharding.shard_shape(qw.shape)
+        assert shard_shape[-1] == qw.shape[-1] // 8
+
+
+def test_tp8_decode_step_runs_sharded():
+    """One real decode step jitted over a full 8-way tp mesh: XLA must
+    partition and insert collectives, and the result must match tp=1."""
+    from vgate_tpu.models.decoder import decode_forward
+    from vgate_tpu.parallel.sharding import named
+
+    spec = TINY_DENSE
+    params_host = init_params(spec, jax.random.PRNGKey(0), jnp.float32)
+    B, ps, n_pages = 4, 4, 17
+
+    def build_inputs():
+        k = jnp.zeros((spec.num_layers, n_pages, ps, spec.num_kv_heads,
+                       spec.head_dim), jnp.float32)
+        v = jnp.zeros_like(k)
+        pt = jnp.asarray(
+            np.arange(B * 4, dtype=np.int32).reshape(B, 4) + 1
+        )
+        tokens = jnp.asarray([5, 6, 7, 8], jnp.int32)
+        positions = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        return k, v, pt, tokens, positions
+
+    # single-device reference
+    k, v, pt, tokens, positions = build_inputs()
+    ref_logits, _, _ = decode_forward(
+        params_host, spec, tokens, positions, k, v, pt,
+        active=jnp.ones((B,), bool),
+    )
+
+    # 8-way tp
+    mesh = build_mesh(tpu_cfg(tp=0))
+    params = shard_params(params_host, spec, mesh)
+    kv_sharding = named(mesh, kv_pspec(spec, mesh))
+    k, v, pt, tokens, positions = build_inputs()
+    k = jax.device_put(k, kv_sharding)
+    v = jax.device_put(v, kv_sharding)
+
+    import functools
+
+    step = jax.jit(
+        functools.partial(decode_forward, spec=spec),
+    )
+    logits, k_out, _ = step(
+        params, tokens=tokens, positions=positions, k_pages=k, v_pages=v,
+        page_tables=pt, active=jnp.ones((B,), bool),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+    # KV stayed sharded on the kv-head axis
+    assert len(k_out.sharding.device_set) == 8

@@ -80,8 +80,9 @@ class DryRunBackend:
         self.model_id = "dry-run"
         self.calls = 0
 
-    def load_model(self, model_config: Any) -> None:
-        self.model_id = getattr(model_config, "model_id", "dry-run")
+    def load_model(self, config: Any) -> None:
+        model_cfg = getattr(config, "model", config)
+        self.model_id = getattr(model_cfg, "model_id", "dry-run")
 
     def create_sampling_params(self, **kwargs: Any) -> SamplingParams:
         return SamplingParams(**kwargs)

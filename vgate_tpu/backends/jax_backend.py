@@ -100,8 +100,10 @@ class JaxTPUBackend:
 
     # -- protocol --
 
-    def load_model(self, model_config: Any) -> None:
-        self._config = get_config()
+    def load_model(self, config: Any) -> None:
+        # accept the full VGTConfig through the seam; fall back to the global
+        # for callers that still pass only the model section
+        self._config = config if hasattr(config, "tpu") else get_config()
         self.core = EngineCore(self._config)
         self.core.start()
         logger.info(

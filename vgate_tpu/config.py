@@ -79,6 +79,7 @@ class TPUConfig(BaseModel):
     tp: int = 0  # 0 => all devices
     ep: int = 1
     sp: int = 1
+    num_devices: int = 0  # 0 => every visible device; else use a subslice
     # Paged KV cache geometry.
     kv_page_size: int = 16  # tokens per page
     kv_num_pages: int = 0  # 0 => auto-size from free HBM

@@ -50,7 +50,9 @@ class VGTEngine:
     def __init__(self, config: Optional[VGTConfig] = None) -> None:
         self.config = config or get_config()
         self.backend = _create_backend(self.config.model.engine_type)
-        self.backend.load_model(self.config.model)
+        # the full config goes through the seam (the jax_tpu backend needs
+        # the tpu/scheduler sections, not just model identity)
+        self.backend.load_model(self.config)
         logger.info(
             "engine ready",
             extra={

@@ -137,6 +137,9 @@ def build_mesh(tpu_config=None, devices=None) -> Mesh:
 
         tpu_config = get_config().tpu
     devices = devices if devices is not None else jax.devices()
+    limit = getattr(tpu_config, "num_devices", 0)
+    if limit and limit < len(devices):
+        devices = devices[:limit]
     plan = resolve_plan(tpu_config, len(devices))
     try:
         from jax.experimental import mesh_utils
