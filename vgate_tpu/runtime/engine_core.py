@@ -112,11 +112,15 @@ class EngineCore:
         jax.block_until_ready(jax.tree.leaves(self.params)[0])
         self.load_time_s = time.perf_counter() - load_start
 
+        params_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
+        )
         num_pages = tpu_cfg.kv_num_pages or auto_num_pages(
             self.spec,
             tpu_cfg.kv_page_size,
             tpu_cfg.hbm_utilization,
             device=self.mesh.devices.flat[0],
+            params_bytes=params_bytes,
         )
         self.geometry = KVGeometry(
             num_layers=self.spec.num_layers,

@@ -48,27 +48,39 @@ class KVGeometry:
         return (self.num_pages - 1) * self.page_size
 
 
+# Per-chip HBM when the runtime exposes no memory stats (TPU v5e class).
+_DEFAULT_HBM_BYTES = 16 * 1024**3
+
+
 def auto_num_pages(
     spec: ModelSpec,
     page_size: int,
     hbm_utilization: float,
     device=None,
+    params_bytes: int = 0,
     fallback: int = 512,
     hard_cap: int = 65536,
 ) -> int:
     """Size the page pool from free device HBM after weights are resident
     (the serving analogue of vLLM's gpu_memory_utilization knob,
-    reference config: vgate/config.py:47)."""
+    reference config: vgate/config.py:47).
+
+    When the runtime reports memory stats they are authoritative; otherwise
+    on accelerators we budget against a 16 GiB/chip default minus the actual
+    parameter bytes, and on CPU test platforms we return ``fallback``.
+    """
     device = device or jax.devices()[0]
     stats = getattr(device, "memory_stats", lambda: None)()
-    if not stats or "bytes_limit" not in stats:
-        return fallback
-    limit = stats["bytes_limit"] * hbm_utilization
-    in_use = stats.get("bytes_in_use", 0)
-    free = max(0, limit - in_use)
     page_bytes = (
         2 * spec.num_layers * page_size * spec.num_kv_heads * spec.head_dim * 2
     )
+    if stats and "bytes_limit" in stats:
+        limit = stats["bytes_limit"] * hbm_utilization
+        free = max(0, limit - stats.get("bytes_in_use", 0))
+    elif device.platform != "cpu":
+        free = max(0, _DEFAULT_HBM_BYTES * hbm_utilization - params_bytes)
+    else:
+        return fallback
     pages = int(free // page_bytes)
     return max(16, min(pages, hard_cap))
 

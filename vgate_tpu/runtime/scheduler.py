@@ -21,7 +21,7 @@ from vgate_tpu import metrics
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.kv_cache import PageAllocator
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
-from vgate_tpu.utils.math import bucket_for, cdiv
+from vgate_tpu.utils.math import bucket_for, cdiv, round_up
 
 logger = get_logger(__name__)
 
@@ -59,9 +59,17 @@ class Scheduler:
     ) -> None:
         self.allocator = allocator
         self.page_size = page_size
-        self.prefill_buckets = sorted(
-            b for b in prefill_buckets if b <= max_model_len
-        ) or [max_model_len]
+        # buckets: page-aligned, capped at max_model_len, and always
+        # including a top bucket that can hold any admissible prompt
+        # (preempted sequences re-prefill with their grown context)
+        top = round_up(max_model_len, page_size)
+        aligned = {
+            min(round_up(b, page_size), top)
+            for b in prefill_buckets
+            if b > 0
+        }
+        aligned.add(top)
+        self.prefill_buckets = sorted(aligned)
         self.max_model_len = max_model_len
         self.max_queue_size = max_queue_size
         self.preempt_on_oom = preempt_on_oom
