@@ -1,0 +1,82 @@
+"""Pallas kernels vs their jnp twins (interpret mode on CPU — SURVEY.md
+section 4: kernel unit tests comparing Pallas outputs vs jnp reference)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.ops.attention import paged_decode_attention
+from vgate_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
+
+
+def make_case(B=4, H=8, KV=2, hd=128, ps=16, pages_per_seq=16, seed=0,
+              lens=None):
+    rng = np.random.default_rng(seed)
+    P = 1 + B * pages_per_seq
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
+    page_tables = jnp.asarray(
+        rng.permutation(np.arange(1, P))[: B * pages_per_seq].reshape(
+            B, pages_per_seq
+        ),
+        jnp.int32,
+    )
+    if lens is None:
+        lens = rng.integers(1, pages_per_seq * ps, size=B)
+    seq_lens = jnp.asarray(lens, jnp.int32)
+    return q, k_pages, v_pages, page_tables, seq_lens
+
+
+@pytest.mark.parametrize(
+    "lens",
+    [
+        None,  # random lengths
+        [1, 16, 17, 128],  # page-boundary edges
+        [255, 256, 200, 3],  # chunk-boundary edges (chunk=128 tokens)
+    ],
+)
+def test_paged_decode_kernel_matches_jnp(lens):
+    q, k_pages, v_pages, page_tables, seq_lens = make_case(
+        lens=lens, seed=1 if lens is None else 2
+    )
+    expect = paged_decode_attention(q, k_pages, v_pages, page_tables, seq_lens)
+    got = paged_decode_attention_pallas(
+        q, k_pages, v_pages, page_tables, seq_lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_decode_kernel_gqa_group_mapping():
+    """H=8, KV=4 (G=2): each group must read its own kv head."""
+    q, k_pages, v_pages, page_tables, seq_lens = make_case(
+        B=2, H=8, KV=4, pages_per_seq=8, seed=3
+    )
+    expect = paged_decode_attention(q, k_pages, v_pages, page_tables, seq_lens)
+    got = paged_decode_attention_pallas(
+        q, k_pages, v_pages, page_tables, seq_lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_decode_kernel_bf16():
+    q, k_pages, v_pages, page_tables, seq_lens = make_case(seed=4)
+    q = q.astype(jnp.bfloat16)
+    k_pages = k_pages.astype(jnp.bfloat16)
+    v_pages = v_pages.astype(jnp.bfloat16)
+    expect = paged_decode_attention(q, k_pages, v_pages, page_tables, seq_lens)
+    got = paged_decode_attention_pallas(
+        q, k_pages, v_pages, page_tables, seq_lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32),
+        np.asarray(expect, np.float32),
+        rtol=2e-2,
+        atol=2e-2,
+    )

@@ -231,8 +231,17 @@ def decode_forward(
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots write page 0
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One continuous-batching decode step: returns (logits [B, V], caches)."""
+    if use_pallas:
+        from vgate_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas,
+        )
+
+        attn_fn = paged_decode_attention_pallas
+    else:
+        attn_fn = paged_decode_attention
     B = tokens.shape[0]
     ps = k_pages.shape[2]
     seq_lens = positions + 1
@@ -253,9 +262,7 @@ def decode_forward(
         k = apply_rope(k[:, None], positions[:, None], spec.rope_theta)[:, 0]
         k_pages_l = k_pages_l.at[page_ids, page_off].set(k)
         v_pages_l = v_pages_l.at[page_ids, page_off].set(v)
-        attn = paged_decode_attention(
-            q, k_pages_l, v_pages_l, page_tables, seq_lens
-        )
+        attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
         attn = attn.reshape(B, spec.q_dim)
         h = h + jnp.einsum("bh,hd->bd", attn, lp["o"]["w"])
         normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)

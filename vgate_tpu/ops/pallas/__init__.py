@@ -1,0 +1,1 @@
+"""Pallas TPU kernels (Mosaic-compiled); jnp twins live in vgate_tpu.ops."""

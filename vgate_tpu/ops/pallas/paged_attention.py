@@ -1,0 +1,220 @@
+"""Pallas paged-attention decode kernel.
+
+The TPU-native replacement for the CUDA paged-attention the reference gets
+opaquely through vLLM (SURVEY.md section 2.1; technique family: "Ragged
+Paged Attention", PAPERS.md).  Semantics are pinned by the jnp twin
+``vgate_tpu.ops.attention.paged_decode_attention`` (kernel tests compare the
+two); the kernel's advantage is the memory path:
+
+* the jnp twin gathers every slot's full ``pages_per_seq`` window into a
+  contiguous HBM buffer (write + re-read), touching ``ctx_max`` tokens even
+  for short sequences;
+* this kernel DMAs **only the live pages** of each sequence directly from the
+  HBM page pool into VMEM, double-buffered in chunks of
+  ``CHUNK_PAGES`` pages (128 tokens), and runs an online-softmax
+  accumulation entirely in VMEM — no gathered copy, no dead-token traffic.
+
+Grid: one program per (slot, kv_head); each program serves the G = H/KV
+query heads of that group (GQA).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vgate_tpu.utils.math import cdiv
+
+CHUNK_PAGES = 8  # pages DMA'd per double-buffer slot
+
+
+def _kernel(
+    # scalar prefetch
+    page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)
+    seq_lens_ref,  # [B] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, G, hd] VMEM block for (b, g)
+    k_pages_ref,  # [P, ps, KV, hd] in ANY/HBM
+    v_pages_ref,  # [P, ps, KV, hd]
+    # output
+    out_ref,  # [1, G, hd]
+    # scratch
+    k_buf,  # [2, CHUNK*ps, hd] VMEM
+    v_buf,  # [2, CHUNK*ps, hd]
+    acc_ref,  # [G, hd] f32
+    m_ref,  # [G, 128] f32 running max (col-broadcast)
+    l_ref,  # [G, 128] f32 running denom
+    sems,  # DMA semaphores [2, 2, CHUNK]
+    *,
+    page_size: int,
+    num_kv_heads: int,
+    max_pages: int,
+):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    seq_len = seq_lens_ref[b]
+    n_pages = jax.lax.div(seq_len + page_size - 1, page_size)
+    n_chunks = jax.lax.div(n_pages + CHUNK_PAGES - 1, CHUNK_PAGES)
+    chunk_tokens = CHUNK_PAGES * page_size
+
+    def start_chunk(c, slot):
+        """Kick off the DMAs for chunk c into buffer `slot`."""
+        for j in range(CHUNK_PAGES):  # static unroll
+            page_pos = c * CHUNK_PAGES + j
+
+            @pl.when(page_pos < n_pages)
+            def _():
+                page_id = page_tables_ref[b, page_pos]
+                pltpu.make_async_copy(
+                    k_pages_ref.at[page_id, :, g, :],
+                    k_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 0, j],
+                ).start()
+                pltpu.make_async_copy(
+                    v_pages_ref.at[page_id, :, g, :],
+                    v_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 1, j],
+                ).start()
+
+            # zero pages beyond the sequence: stale VMEM could hold NaNs,
+            # and softmax-weight 0 x NaN would poison the accumulator
+            @pl.when(page_pos >= n_pages)
+            def _():
+                k_buf[slot, pl.ds(j * page_size, page_size), :] = jnp.zeros(
+                    (page_size, k_buf.shape[-1]), k_buf.dtype
+                )
+                v_buf[slot, pl.ds(j * page_size, page_size), :] = jnp.zeros(
+                    (page_size, v_buf.shape[-1]), v_buf.dtype
+                )
+
+    def wait_chunk(c, slot):
+        for j in range(CHUNK_PAGES):
+            page_pos = c * CHUNK_PAGES + j
+
+            @pl.when(page_pos < n_pages)
+            def _():
+                pltpu.make_async_copy(
+                    k_pages_ref.at[0, :, g, :],
+                    k_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 0, j],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_pages_ref.at[0, :, g, :],
+                    v_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 1, j],
+                ).wait()
+
+    hd = q_ref.shape[-1]
+    G = q_ref.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+    q = q_ref[0].astype(jnp.float32) * scale  # [G, hd]
+
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    m_ref[...] = jnp.full_like(m_ref, -1e30)
+    l_ref[...] = jnp.zeros_like(l_ref)
+
+    start_chunk(0, 0)
+
+    def body(c, _):
+        slot = jax.lax.rem(c, 2)
+        next_slot = jax.lax.rem(c + 1, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            start_chunk(c + 1, next_slot)
+
+        wait_chunk(c, slot)
+
+        k = jax.lax.cond(
+            slot == 0, lambda: k_buf[0], lambda: k_buf[1]
+        ).astype(jnp.float32)  # [chunk_tokens, hd]
+        v = jax.lax.cond(
+            slot == 0, lambda: v_buf[0], lambda: v_buf[1]
+        ).astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, chunk_tokens]
+        token_pos = c * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        scores = jnp.where(token_pos < seq_len, scores, -1e30)
+
+        m_prev = m_ref[:, :1]  # [G, 1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)  # [G, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [G, 1]
+        p = jnp.exp(scores - m_new)  # [G, chunk_tokens]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        return 0
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[:, :1], 1e-30)
+    out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,  # [B, H, hd]
+    k_pages: jnp.ndarray,  # [P, ps, KV, hd]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, pages_per_seq]
+    seq_lens: jnp.ndarray,  # [B]
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, H, hd = q.shape
+    P, ps, KV, _ = k_pages.shape
+    G = H // KV
+    max_pages = page_tables.shape[1]
+    chunk_tokens = CHUNK_PAGES * ps
+
+    kernel = functools.partial(
+        _kernel,
+        page_size=ps,
+        num_kv_heads=KV,
+        max_pages=max_pages,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV),
+        in_specs=[
+            pl.BlockSpec(
+                (1, G, hd), lambda b, g, *prefetch: (b, g, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, G, hd), lambda b, g, *prefetch: (b, g, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_tokens, hd), k_pages.dtype),
+            pltpu.VMEM((2, chunk_tokens, hd), v_pages.dtype),
+            pltpu.VMEM((G, hd), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, CHUNK_PAGES)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+    )(page_tables, seq_lens, q, k_pages, v_pages)
+    return out

@@ -69,14 +69,18 @@ def _prefill_step(
     return next_tokens, k_pages, v_pages
 
 
-@functools.partial(jax.jit, static_argnames=("spec",), donate_argnames=("k_pages", "v_pages"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("spec", "use_pallas"),
+    donate_argnames=("k_pages", "v_pages"),
+)
 def _decode_step(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
-    page_tables, active, temps, top_ps, top_ks, key,
+    page_tables, active, temps, top_ps, top_ks, key, use_pallas=False,
 ):
     logits, k_pages, v_pages = decode_forward(
         params, spec, tokens, positions, k_pages, v_pages, page_tables,
-        active=active,
+        active=active, use_pallas=use_pallas,
     )
     next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
     return next_tokens, k_pages, v_pages
@@ -155,6 +159,12 @@ class EngineCore:
         self._compiled_buckets: set = set()
         self._decode_compiled = False
 
+        # Pallas kernels require a real TPU backend (tests run interpret-mode
+        # kernels separately; the engine's jnp twins serve CPU meshes)
+        self.use_pallas = bool(
+            tpu_cfg.use_pallas
+            and self.mesh.devices.flat[0].platform == "tpu"
+        )
         self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
         self._wakeup = threading.Event()
         self._running = False
@@ -367,6 +377,7 @@ class EngineCore:
             jnp.asarray(top_ps),
             jnp.asarray(top_ks),
             self._step_key(),
+            use_pallas=self.use_pallas,
         )
         sampled = np.asarray(next_tokens)
         metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
