@@ -1,0 +1,191 @@
+"""Per-engine benchmark CLI.
+
+Parity with the reference's benchmarks/bench_compare.py:42-178 — same stat
+shape (latency mean/p50/p95, TTFT, TPOT, tokens/sec; table or JSON output;
+warmup + timed rounds over a prompt set; engine constructed directly so the
+batcher and cache stay out of the measurement) — plus the per-chip
+normalization BASELINE.md requires (tokens/sec/chip) and a concurrent mode
+that exercises continuous batching, which the reference's blocking engines
+could not express.
+
+Usage:
+  python -m benchmarks.bench_compare --engines dry_run jax_tpu \
+      --rounds 3 --max-tokens 64 --concurrency 8 --output json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+from typing import Any, Dict, List
+
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import get_config, load_config, set_config
+from vgate_tpu.engine import VGTEngine
+
+DEFAULT_PROMPTS = [
+    "Explain the benefits of systolic arrays in two sentences.",
+    "Write a haiku about high-bandwidth memory.",
+    "What is sequence parallelism?",
+]
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, int(len(sorted_vals) * p))
+    return sorted_vals[idx]
+
+
+def run_benchmark(
+    engine_type: str,
+    prompts: List[str],
+    rounds: int,
+    warmup_rounds: int,
+    max_tokens: int,
+    concurrency: int = 1,
+) -> Dict[str, Any]:
+    """Benchmark one engine type (reference: bench_compare.py:42-108)."""
+    config = load_config(model={"engine_type": engine_type})
+    set_config(config)
+    engine = VGTEngine(config)
+    try:
+        import jax
+
+        num_chips = (
+            1
+            if engine_type == "dry_run"
+            else max(1, len(getattr(engine.backend, "core", None).mesh.devices.flat)
+                     if getattr(engine.backend, "core", None) else 1)
+        )
+
+        for _ in range(warmup_rounds):
+            for prompt in prompts:
+                engine.chat_completions(prompt, max_tokens=max_tokens)
+
+        latencies: List[float] = []
+        ttfts: List[float] = []
+        tpots: List[float] = []
+        total_tokens = 0
+        bench_start = time.perf_counter()
+        for _ in range(rounds):
+            if concurrency <= 1:
+                for prompt in prompts:
+                    start = time.perf_counter()
+                    result = engine.chat_completions(
+                        prompt, max_tokens=max_tokens
+                    )
+                    latencies.append(time.perf_counter() - start)
+                    ttfts.append(result["metrics"].get("ttft", 0.0))
+                    tpots.append(result["metrics"].get("tpot", 0.0))
+                    total_tokens += result["num_tokens"]
+            else:
+                # concurrent round: fan prompts through the backend batch API
+                batch = (prompts * ((concurrency // len(prompts)) + 1))[
+                    :concurrency
+                ]
+                params = [
+                    engine.backend.create_sampling_params(
+                        max_tokens=max_tokens,
+                        temperature=config.inference.temperature,
+                        top_p=config.inference.top_p,
+                    )
+                    for _ in batch
+                ]
+                start = time.perf_counter()
+                results = engine.generate_batch(batch, params)
+                wall = time.perf_counter() - start
+                latencies.append(wall)
+                for result in results:
+                    ttfts.append(result.metrics.get("ttft", 0.0))
+                    tpots.append(result.metrics.get("tpot", 0.0))
+                    total_tokens += result.num_tokens
+        bench_wall = time.perf_counter() - bench_start
+
+        lat_ms = sorted(x * 1000 for x in latencies)
+        ttft_ms = sorted(x * 1000 for x in ttfts)
+        tpot_ms = sorted(x * 1000 for x in tpots)
+        toks_per_s = total_tokens / bench_wall if bench_wall else 0.0
+        return {
+            "engine": engine_type,
+            "rounds": rounds,
+            "concurrency": concurrency,
+            "total_tokens": total_tokens,
+            "latency_ms": {
+                "mean": statistics.mean(lat_ms) if lat_ms else 0.0,
+                "p50": _percentile(lat_ms, 0.5),
+                "p95": _percentile(lat_ms, 0.95),
+            },
+            "ttft_ms": {
+                "mean": statistics.mean(ttft_ms) if ttft_ms else 0.0,
+                "p50": _percentile(ttft_ms, 0.5),
+                "p95": _percentile(ttft_ms, 0.95),
+            },
+            "tpot_ms": {
+                "mean": statistics.mean(tpot_ms) if tpot_ms else 0.0,
+                "p50": _percentile(tpot_ms, 0.5),
+            },
+            "tokens_per_second": toks_per_s,
+            "tokens_per_second_per_chip": toks_per_s / num_chips,
+            "num_chips": num_chips,
+        }
+    finally:
+        engine.shutdown()
+
+
+def print_table(results: List[Dict[str, Any]]) -> None:
+    cols = (
+        f"{'engine':<12} {'lat p50 ms':>11} {'lat p95 ms':>11} "
+        f"{'ttft p50 ms':>12} {'tok/s':>9} {'tok/s/chip':>11}"
+    )
+    print(cols)
+    print("-" * len(cols))
+    for r in results:
+        print(
+            f"{r['engine']:<12} {r['latency_ms']['p50']:>11.1f} "
+            f"{r['latency_ms']['p95']:>11.1f} "
+            f"{r['ttft_ms']['p50']:>12.1f} "
+            f"{r['tokens_per_second']:>9.1f} "
+            f"{r['tokens_per_second_per_chip']:>11.1f}"
+        )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="vgate-tpu engine benchmark")
+    parser.add_argument(
+        "--engines", nargs="+", default=["dry_run"],
+        choices=["dry_run", "jax_tpu"],
+    )
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--warmup-rounds", type=int, default=1)
+    parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument("--concurrency", type=int, default=1)
+    parser.add_argument("--prompts", nargs="*", default=None)
+    parser.add_argument(
+        "--output", choices=["table", "json"], default="table"
+    )
+    args = parser.parse_args()
+
+    config = get_config()
+    prompts = args.prompts or config.benchmark.prompts or DEFAULT_PROMPTS
+    results = [
+        run_benchmark(
+            engine,
+            prompts,
+            args.rounds,
+            args.warmup_rounds,
+            args.max_tokens,
+            args.concurrency,
+        )
+        for engine in args.engines
+    ]
+    if args.output == "json":
+        print(json.dumps(results, indent=2))
+    else:
+        print_table(results)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,212 @@
+"""Client SDK tests: httpx.MockTransport contract tests (reference pattern:
+vgate-client/tests/test_client.py monkeypatched responses) plus a live
+in-process round-trip against the dry-run gateway."""
+
+import json
+import sys
+from pathlib import Path
+
+import httpx
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "vgate_tpu_client"))
+
+from vgate_tpu_client import (  # noqa: E402
+    AsyncVGT,
+    AuthenticationError,
+    RateLimitError,
+    ServerError,
+    VGT,
+)
+
+CHAT_RESPONSE = {
+    "id": "chatcmpl-test",
+    "object": "chat.completion",
+    "created": 123,
+    "model": "test-model",
+    "choices": [
+        {
+            "index": 0,
+            "message": {"role": "assistant", "content": "hello there"},
+            "finish_reason": "stop",
+        }
+    ],
+    "usage": {"prompt_tokens": 3, "completion_tokens": 2, "total_tokens": 5},
+    "cached": False,
+    "metrics": {"ttft": 0.01},
+}
+
+
+def make_client(handler, **kwargs) -> VGT:
+    client = VGT(base_url="http://testserver", **kwargs)
+    client._http = httpx.Client(
+        base_url="http://testserver", transport=httpx.MockTransport(handler)
+    )
+    return client
+
+
+def test_chat_create_roundtrip():
+    def handler(request):
+        assert request.url.path == "/v1/chat/completions"
+        body = json.loads(request.content)
+        assert body["messages"][0]["content"] == "hi"
+        return httpx.Response(200, json=CHAT_RESPONSE)
+
+    client = make_client(handler)
+    result = client.chat.create([{"role": "user", "content": "hi"}])
+    assert result.choices[0].message.content == "hello there"
+    assert result.usage.total_tokens == 5
+
+
+def test_api_key_header_sent():
+    seen = {}
+
+    def handler(request):
+        seen["auth"] = request.headers.get("Authorization")
+        return httpx.Response(200, json=CHAT_RESPONSE)
+
+    client = make_client(handler, api_key="sk-secret")
+    client.chat.create([{"role": "user", "content": "x"}])
+    assert seen["auth"] == "Bearer sk-secret"
+
+
+def test_401_raises_authentication_error():
+    def handler(request):
+        return httpx.Response(
+            401,
+            json={"error": {"message": "Missing API key",
+                            "type": "authentication_error"}},
+        )
+
+    client = make_client(handler)
+    with pytest.raises(AuthenticationError) as err:
+        client.chat.create([{"role": "user", "content": "x"}])
+    assert err.value.status_code == 401
+
+
+def test_429_retries_then_succeeds(monkeypatch):
+    calls = {"n": 0}
+
+    def handler(request):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return httpx.Response(
+                429,
+                headers={"Retry-After": "0", "X-RateLimit-Limit": "2",
+                         "X-RateLimit-Remaining": "0"},
+                json={"error": {"message": "limited",
+                                "type": "rate_limit_error"}},
+            )
+        return httpx.Response(200, json=CHAT_RESPONSE)
+
+    client = make_client(handler, max_retries=2)
+    result = client.chat.create([{"role": "user", "content": "x"}])
+    assert calls["n"] == 2
+    assert result.id == "chatcmpl-test"
+
+
+def test_429_exhausted_raises_with_retry_after():
+    def handler(request):
+        return httpx.Response(
+            429,
+            headers={"Retry-After": "0"},
+            json={"error": {"message": "limited", "type": "rate_limit_error"}},
+        )
+
+    client = make_client(handler, max_retries=1)
+    with pytest.raises(RateLimitError) as err:
+        client.chat.create([{"role": "user", "content": "x"}])
+    assert err.value.retry_after == 0.0
+
+
+def test_5xx_retries_then_raises():
+    calls = {"n": 0}
+
+    def handler(request):
+        calls["n"] += 1
+        return httpx.Response(500, json={"error": {"message": "boom"}})
+
+    client = make_client(handler, max_retries=1)
+    with pytest.raises(ServerError):
+        client.chat.create([{"role": "user", "content": "x"}])
+    assert calls["n"] == 2
+
+
+def test_rate_limit_info_recorded():
+    def handler(request):
+        return httpx.Response(
+            200,
+            headers={"X-RateLimit-Limit": "60", "X-RateLimit-Remaining": "41"},
+            json=CHAT_RESPONSE,
+        )
+
+    client = make_client(handler)
+    client.chat.create([{"role": "user", "content": "x"}])
+    assert client.last_rate_limit.limit == 60
+    assert client.last_rate_limit.remaining == 41
+
+
+def test_embeddings_resource():
+    def handler(request):
+        return httpx.Response(
+            200,
+            json={
+                "object": "list",
+                "data": [{"object": "embedding", "index": 0,
+                          "embedding": [0.1, 0.2]}],
+                "model": "bge",
+                "usage": {"prompt_tokens": 2, "completion_tokens": 0,
+                          "total_tokens": 2},
+            },
+        )
+
+    client = make_client(handler)
+    result = client.embeddings.create("hello")
+    assert result.data[0].embedding == [0.1, 0.2]
+
+
+def test_context_manager():
+    with make_client(lambda r: httpx.Response(200, json={"status": "ok",
+                                                         "version": "1"})) as c:
+        assert c.health().status == "ok"
+
+
+async def test_async_client_live_roundtrip():
+    """AsyncVGT against a live in-process dry-run gateway (socket included)."""
+    from aiohttp.test_utils import TestServer
+
+    from vgate_tpu.config import load_config
+    from vgate_tpu.server.app import create_app
+
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},
+        logging={"level": "WARNING"},
+    )
+    server = TestServer(create_app(config))
+    await server.start_server()
+    try:
+        async with AsyncVGT(base_url=str(server.make_url("/"))) as client:
+            health = await client.health()
+            assert health.status == "ok"
+            completion = await client.chat.create(
+                [{"role": "user", "content": "live ping"}], max_tokens=8
+            )
+            assert "[dry-run] echo:" in completion.choices[0].message.content
+            emb = await client.embeddings.create(["a", "b"])
+            assert len(emb.data) == 2
+            stats = await client.stats()
+            assert stats["batcher"]["total_requests"] >= 1
+            # SSE streaming end-to-end
+            chunks = []
+            stream = await client.chat.create(
+                [{"role": "user", "content": "stream"}], stream=True
+            )
+            async for chunk in stream:
+                chunks.append(chunk)
+            assert chunks[0]["object"] == "chat.completion.chunk"
+            assert any(
+                c["choices"][0]["finish_reason"] == "stop" for c in chunks
+            )
+    finally:
+        await server.close()

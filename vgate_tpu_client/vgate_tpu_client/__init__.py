@@ -1,0 +1,40 @@
+"""Python client SDK for a vgate-tpu gateway (sync + async)."""
+
+from vgate_tpu_client.client import AsyncVGT, VGT
+from vgate_tpu_client.exceptions import (
+    AuthenticationError,
+    ConnectionError,
+    RateLimitError,
+    ServerError,
+    VGTError,
+)
+from vgate_tpu_client.models import (
+    ChatCompletion,
+    ChatCompletionRequest,
+    ChatMessage,
+    Choice,
+    EmbeddingResponse,
+    HealthResponse,
+    RateLimitInfo,
+    Usage,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "VGT",
+    "AsyncVGT",
+    "VGTError",
+    "AuthenticationError",
+    "RateLimitError",
+    "ServerError",
+    "ConnectionError",
+    "ChatMessage",
+    "ChatCompletionRequest",
+    "ChatCompletion",
+    "Choice",
+    "Usage",
+    "EmbeddingResponse",
+    "HealthResponse",
+    "RateLimitInfo",
+]

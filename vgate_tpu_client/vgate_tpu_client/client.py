@@ -1,0 +1,324 @@
+"""Sync + async httpx clients with retry/backoff and typed errors.
+
+Feature parity with the reference SDK (vgate-client/vgate_client/client.py):
+namespaced resources (``client.chat`` / ``client.embeddings``), retries with
+exponential backoff honoring ``Retry-After`` on 429 and backoff on 5xx
+(:247-280), ``X-RateLimit-*`` header parsing (:49-64), typed exceptions
+(:67-89), ``health()``/``stats()`` helpers and context managers — plus SSE
+streaming support for ``chat.create(stream=True)``, which the reference
+gateway lacked.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, AsyncIterator, Dict, Iterator, List, Optional, Union
+
+import httpx
+
+from vgate_tpu_client.exceptions import (
+    AuthenticationError,
+    ConnectionError,
+    RateLimitError,
+    ServerError,
+    VGTError,
+)
+from vgate_tpu_client.models import (
+    ChatCompletion,
+    ChatCompletionRequest,
+    ChatMessage,
+    EmbeddingRequest,
+    EmbeddingResponse,
+    HealthResponse,
+    RateLimitInfo,
+)
+
+DEFAULT_TIMEOUT = 120.0
+DEFAULT_MAX_RETRIES = 2
+
+
+def _raise_for_status(response: httpx.Response) -> None:
+    if response.status_code < 400:
+        return
+    try:
+        body = response.json()
+        message = body.get("error", {}).get("message", response.text)
+    except (ValueError, AttributeError):
+        body, message = response.text, response.text
+    if response.status_code == 401:
+        raise AuthenticationError(message, response.status_code, body)
+    if response.status_code == 429:
+        info = RateLimitInfo.from_headers(response.headers)
+        raise RateLimitError(
+            message, response.status_code, body, retry_after=info.retry_after
+        )
+    if response.status_code >= 500:
+        raise ServerError(message, response.status_code, body)
+    raise VGTError(message, response.status_code, body)
+
+
+def _messages_payload(
+    messages: Union[List[ChatMessage], List[Dict[str, str]]],
+) -> List[Dict[str, str]]:
+    return [
+        m.model_dump() if isinstance(m, ChatMessage) else dict(m)
+        for m in messages
+    ]
+
+
+class _ChatResource:
+    def __init__(self, client: "VGT") -> None:
+        self._client = client
+
+    def create(
+        self,
+        messages,
+        model: Optional[str] = None,
+        max_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        stream: bool = False,
+    ):
+        payload = ChatCompletionRequest(
+            model=model,
+            messages=_messages_payload(messages),
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            top_k=top_k,
+            stream=stream,
+        ).model_dump(exclude_none=True)
+        if stream:
+            return self._client._stream("/v1/chat/completions", payload)
+        data = self._client._request("POST", "/v1/chat/completions", payload)
+        return ChatCompletion.model_validate(data)
+
+
+class _EmbeddingsResource:
+    def __init__(self, client: "VGT") -> None:
+        self._client = client
+
+    def create(self, input, model: Optional[str] = None) -> EmbeddingResponse:
+        payload = EmbeddingRequest(model=model, input=input).model_dump(
+            exclude_none=True
+        )
+        data = self._client._request("POST", "/v1/embeddings", payload)
+        return EmbeddingResponse.model_validate(data)
+
+
+class VGT:
+    """Synchronous client (reference: VGate at client.py:102-311)."""
+
+    def __init__(
+        self,
+        base_url: str = "http://localhost:8000",
+        api_key: Optional[str] = None,
+        timeout: float = DEFAULT_TIMEOUT,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.max_retries = max_retries
+        self.last_rate_limit: Optional[RateLimitInfo] = None
+        self._http = httpx.Client(base_url=self.base_url, timeout=timeout)
+        self.chat = _ChatResource(self)
+        self.embeddings = _EmbeddingsResource(self)
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        return headers
+
+    def _request(
+        self, method: str, path: str, payload: Optional[Dict] = None
+    ) -> Any:
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                response = self._http.request(
+                    method, path, json=payload, headers=self._headers()
+                )
+            except httpx.HTTPError as exc:
+                last_exc = ConnectionError(f"connection failed: {exc}")
+                if attempt < self.max_retries:
+                    time.sleep(2 ** attempt)
+                    continue
+                raise last_exc from exc
+            self.last_rate_limit = RateLimitInfo.from_headers(response.headers)
+            if response.status_code == 429 and attempt < self.max_retries:
+                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
+                time.sleep(retry_after)
+                continue
+            if response.status_code >= 500 and attempt < self.max_retries:
+                time.sleep(2 ** attempt)
+                continue
+            _raise_for_status(response)
+            return response.json()
+        raise last_exc or ServerError("retries exhausted")
+
+    def _stream(self, path: str, payload: Dict) -> Iterator[Dict[str, Any]]:
+        with self._http.stream(
+            "POST", path, json=payload, headers=self._headers()
+        ) as response:
+            _raise_for_status(response)
+            for line in response.iter_lines():
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data == "[DONE]":
+                    return
+                yield json.loads(data)
+
+    def health(self) -> HealthResponse:
+        return HealthResponse.model_validate(self._request("GET", "/health"))
+
+    def stats(self) -> Dict[str, Any]:
+        return self._request("GET", "/stats")
+
+    def models(self) -> Dict[str, Any]:
+        return self._request("GET", "/v1/models")
+
+    def benchmark(self, **kwargs: Any) -> Dict[str, Any]:
+        return self._request("POST", "/v1/benchmark", kwargs)
+
+    def close(self) -> None:
+        self._http.close()
+
+    def __enter__(self) -> "VGT":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class _AsyncChatResource:
+    def __init__(self, client: "AsyncVGT") -> None:
+        self._client = client
+
+    async def create(
+        self,
+        messages,
+        model: Optional[str] = None,
+        max_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        stream: bool = False,
+    ):
+        payload = ChatCompletionRequest(
+            model=model,
+            messages=_messages_payload(messages),
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            top_k=top_k,
+            stream=stream,
+        ).model_dump(exclude_none=True)
+        if stream:
+            return self._client._stream("/v1/chat/completions", payload)
+        data = await self._client._request(
+            "POST", "/v1/chat/completions", payload
+        )
+        return ChatCompletion.model_validate(data)
+
+
+class _AsyncEmbeddingsResource:
+    def __init__(self, client: "AsyncVGT") -> None:
+        self._client = client
+
+    async def create(
+        self, input, model: Optional[str] = None
+    ) -> EmbeddingResponse:
+        payload = EmbeddingRequest(model=model, input=input).model_dump(
+            exclude_none=True
+        )
+        data = await self._client._request("POST", "/v1/embeddings", payload)
+        return EmbeddingResponse.model_validate(data)
+
+
+class AsyncVGT:
+    """Async client (reference: AsyncVGate at client.py:317-409)."""
+
+    def __init__(
+        self,
+        base_url: str = "http://localhost:8000",
+        api_key: Optional[str] = None,
+        timeout: float = DEFAULT_TIMEOUT,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.max_retries = max_retries
+        self.last_rate_limit: Optional[RateLimitInfo] = None
+        self._http = httpx.AsyncClient(base_url=self.base_url, timeout=timeout)
+        self.chat = _AsyncChatResource(self)
+        self.embeddings = _AsyncEmbeddingsResource(self)
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        return headers
+
+    async def _request(
+        self, method: str, path: str, payload: Optional[Dict] = None
+    ) -> Any:
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                response = await self._http.request(
+                    method, path, json=payload, headers=self._headers()
+                )
+            except httpx.HTTPError as exc:
+                last_exc = ConnectionError(f"connection failed: {exc}")
+                if attempt < self.max_retries:
+                    await asyncio.sleep(2 ** attempt)
+                    continue
+                raise last_exc from exc
+            self.last_rate_limit = RateLimitInfo.from_headers(response.headers)
+            if response.status_code == 429 and attempt < self.max_retries:
+                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
+                await asyncio.sleep(retry_after)
+                continue
+            if response.status_code >= 500 and attempt < self.max_retries:
+                await asyncio.sleep(2 ** attempt)
+                continue
+            _raise_for_status(response)
+            return response.json()
+        raise last_exc or ServerError("retries exhausted")
+
+    async def _stream(
+        self, path: str, payload: Dict
+    ) -> AsyncIterator[Dict[str, Any]]:
+        async with self._http.stream(
+            "POST", path, json=payload, headers=self._headers()
+        ) as response:
+            _raise_for_status(response)
+            async for line in response.aiter_lines():
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data == "[DONE]":
+                    return
+                yield json.loads(data)
+
+    async def health(self) -> HealthResponse:
+        return HealthResponse.model_validate(
+            await self._request("GET", "/health")
+        )
+
+    async def stats(self) -> Dict[str, Any]:
+        return await self._request("GET", "/stats")
+
+    async def close(self) -> None:
+        await self._http.aclose()
+
+    async def __aenter__(self) -> "AsyncVGT":
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()

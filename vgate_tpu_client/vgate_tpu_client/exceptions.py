@@ -1,0 +1,46 @@
+"""Typed client exceptions mapped from HTTP status codes
+(reference: vgate-client/vgate_client/exceptions.py:22-62)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class VGTError(Exception):
+    """Base error carrying the HTTP status and response body."""
+
+    def __init__(
+        self,
+        message: str,
+        status_code: Optional[int] = None,
+        body: Optional[Any] = None,
+    ) -> None:
+        super().__init__(message)
+        self.status_code = status_code
+        self.body = body
+
+
+class AuthenticationError(VGTError):
+    """401 — missing or invalid API key."""
+
+
+class RateLimitError(VGTError):
+    """429 — over the sliding-window limit; carries Retry-After."""
+
+    def __init__(
+        self,
+        message: str,
+        status_code: Optional[int] = None,
+        body: Optional[Any] = None,
+        retry_after: Optional[float] = None,
+    ) -> None:
+        super().__init__(message, status_code, body)
+        self.retry_after = retry_after
+
+
+class ServerError(VGTError):
+    """5xx — gateway or engine failure."""
+
+
+class ConnectionError(VGTError):
+    """Transport-level failure reaching the gateway."""

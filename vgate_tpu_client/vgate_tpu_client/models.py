@@ -1,0 +1,94 @@
+"""OpenAI-format request/response models
+(reference: vgate-client/vgate_client/models.py:27-97)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from pydantic import BaseModel, Field
+
+
+class ChatMessage(BaseModel):
+    role: str
+    content: str
+
+
+class ChatCompletionRequest(BaseModel):
+    model: Optional[str] = None
+    messages: List[ChatMessage]
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    stream: bool = False
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class Choice(BaseModel):
+    index: int = 0
+    message: ChatMessage
+    finish_reason: str = "stop"
+
+
+class ChatCompletion(BaseModel):
+    id: str
+    object: str = "chat.completion"
+    created: int = 0
+    model: str = ""
+    choices: List[Choice] = Field(default_factory=list)
+    usage: Usage = Field(default_factory=Usage)
+    cached: bool = False
+    metrics: Dict[str, float] = Field(default_factory=dict)
+
+
+class EmbeddingData(BaseModel):
+    object: str = "embedding"
+    index: int = 0
+    embedding: List[float] = Field(default_factory=list)
+
+
+class EmbeddingResponse(BaseModel):
+    object: str = "list"
+    data: List[EmbeddingData] = Field(default_factory=list)
+    model: str = ""
+    usage: Usage = Field(default_factory=Usage)
+
+
+class EmbeddingRequest(BaseModel):
+    model: Optional[str] = None
+    input: Union[str, List[str]]
+
+
+class HealthResponse(BaseModel):
+    status: str
+    version: str = ""
+    model: Optional[str] = None
+    engine_type: Optional[str] = None
+    device: Optional[Dict] = None
+
+
+class RateLimitInfo(BaseModel):
+    """Parsed from X-RateLimit-* headers
+    (reference: vgate-client/vgate_client/client.py:49-64)."""
+
+    limit: Optional[int] = None
+    remaining: Optional[int] = None
+    retry_after: Optional[float] = None
+
+    @classmethod
+    def from_headers(cls, headers) -> "RateLimitInfo":
+        def _int(name):
+            val = headers.get(name)
+            return int(val) if val is not None else None
+
+        retry = headers.get("Retry-After")
+        return cls(
+            limit=_int("X-RateLimit-Limit"),
+            remaining=_int("X-RateLimit-Remaining"),
+            retry_after=float(retry) if retry is not None else None,
+        )
