@@ -1,0 +1,32 @@
+# Dual-target build (reference pattern: Dockerfile:12-57 vgate-gpu/vgate-cpu).
+#
+# vgt-tpu: serving image for TPU VMs (jax[tpu] installed at build time).
+# vgt-cpu: slim CI/dev image running the dry-run engine.
+
+FROM python:3.12-slim AS base
+WORKDIR /app
+COPY requirements.txt .
+RUN pip install --no-cache-dir -r requirements.txt
+COPY vgate_tpu/ vgate_tpu/
+COPY vgate_tpu_client/ vgate_tpu_client/
+COPY benchmarks/ benchmarks/
+COPY main.py config.yaml ./
+
+# ---- TPU serving target ----
+FROM base AS vgt-tpu
+RUN pip install --no-cache-dir "jax[tpu]" \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+ENV VGT_MODEL__ENGINE_TYPE=jax_tpu
+EXPOSE 8000
+HEALTHCHECK --interval=30s --timeout=5s --start-period=300s --retries=3 \
+    CMD python -c "import urllib.request; urllib.request.urlopen('http://localhost:8000/health', timeout=4)"
+CMD ["python", "main.py"]
+
+# ---- CPU / dry-run target ----
+FROM base AS vgt-cpu
+RUN pip install --no-cache-dir jax
+ENV VGT_DRY_RUN=true
+EXPOSE 8000
+HEALTHCHECK --interval=30s --timeout=5s --start-period=30s --retries=3 \
+    CMD python -c "import urllib.request; urllib.request.urlopen('http://localhost:8000/health', timeout=4)"
+CMD ["python", "main.py"]

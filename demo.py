@@ -1,0 +1,32 @@
+"""Smoke test: one chat completion through the engine directly
+(reference: demo.py:17-46)."""
+
+import os
+
+from vgate_tpu.config import get_config
+from vgate_tpu.engine import VGTEngine
+
+
+def smoke_test() -> None:
+    config = get_config()
+    print(f"engine_type={config.model.engine_type} model={config.model.model_id}")
+    engine = VGTEngine(config)
+    try:
+        result = engine.chat_completions(
+            "User: Say hello in five words.\nAssistant:", max_tokens=32
+        )
+        print(f"text: {result['text']!r}")
+        print(f"tokens: {result['num_tokens']}")
+        ttft_ms = result["metrics"].get("ttft", 0) * 1000
+        quality = (
+            "excellent" if ttft_ms < 200 else
+            "good" if ttft_ms < 500 else "needs tuning"
+        )
+        print(f"ttft: {ttft_ms:.1f} ms ({quality})")
+    finally:
+        engine.shutdown()
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("VGT_DRY_RUN", "false")
+    smoke_test()

@@ -1,0 +1,11 @@
+"""Server entrypoint: ``python main.py`` (reference: main.py:389-391).
+
+All app construction lives in vgate_tpu/server/app.py; engine + batcher init
+happens inside the aiohttp startup hooks (the reference's lifespan lesson:
+heavyweight engine init must occur inside the app lifecycle, main.py:48-66).
+"""
+
+from vgate_tpu.server.app import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Live-load verification against a RUNNING gateway (manual, not pytest).
+
+The reference's load scripts assert batching/cache/dedup behavior from
+``/stats`` counter deltas against a live server
+(scripts/test_concurrent.py:43-161); same method here.
+
+Usage: start the server (`python main.py`), then:
+  python scripts/test_concurrent.py --base-url http://localhost:8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+
+import aiohttp
+
+
+async def get_stats(session, base_url):
+    async with session.get(f"{base_url}/stats") as resp:
+        return await resp.json()
+
+
+async def chat(session, base_url, content, max_tokens=32):
+    payload = {
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": max_tokens,
+    }
+    start = time.perf_counter()
+    async with session.post(
+        f"{base_url}/v1/chat/completions", json=payload
+    ) as resp:
+        body = await resp.json()
+        return time.perf_counter() - start, body
+
+
+async def test_batching(session, base_url, n=10):
+    """n concurrent distinct requests must land in far fewer batches."""
+    before = await get_stats(session, base_url)
+    await asyncio.gather(
+        *[chat(session, base_url, f"batch probe {i}") for i in range(n)]
+    )
+    after = await get_stats(session, base_url)
+    batches = (
+        after["batcher"]["total_batches"] - before["batcher"]["total_batches"]
+    )
+    print(f"[batching] {n} concurrent requests -> {batches} batches "
+          f"({'PASS' if batches < n else 'FAIL'})")
+
+
+async def test_cache(session, base_url):
+    """Second identical request must be a sub-ms cache hit."""
+    prompt = f"cache probe {time.time()}"
+    cold, _ = await chat(session, base_url, prompt)
+    warm, body = await chat(session, base_url, prompt)
+    speedup = cold / warm if warm > 0 else float("inf")
+    ok = body.get("cached") is True
+    print(f"[cache] cold={cold*1000:.1f}ms warm={warm*1000:.2f}ms "
+          f"speedup={speedup:.0f}x cached={ok} "
+          f"({'PASS' if ok else 'FAIL'})")
+
+
+async def test_dedup(session, base_url, n=5):
+    """n identical concurrent requests must dedup to one inference."""
+    before = await get_stats(session, base_url)
+    prompt = f"dedup probe {time.time()}"
+    await asyncio.gather(
+        *[chat(session, base_url, prompt) for _ in range(n)]
+    )
+    after = await get_stats(session, base_url)
+    deduped = (
+        after["batcher"]["total_deduplicated"]
+        - before["batcher"]["total_deduplicated"]
+    )
+    print(f"[dedup] {n} identical requests -> {deduped} deduplicated "
+          f"({'PASS' if deduped >= n - 1 else 'FAIL'})")
+
+
+async def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--base-url", default="http://localhost:8000")
+    parser.add_argument("--api-key", default=None)
+    args = parser.parse_args()
+
+    headers = (
+        {"Authorization": f"Bearer {args.api_key}"} if args.api_key else {}
+    )
+    async with aiohttp.ClientSession(headers=headers) as session:
+        async with session.get(f"{args.base_url}/health") as resp:
+            health = await resp.json()
+            print(f"[health] {health}")
+        await test_batching(session, args.base_url)
+        await test_cache(session, args.base_url)
+        await test_dedup(session, args.base_url)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

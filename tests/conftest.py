@@ -42,10 +42,12 @@ def pytest_pyfunc_call(pyfuncitem):
 
 
 @pytest.fixture(autouse=True)
-def _reset_globals():
+def _reset_globals(monkeypatch):
     from vgate_tpu import config as config_mod
     from vgate_tpu import tracing as tracing_mod
 
+    # isolate tests from the repo's sample ./config.yaml
+    monkeypatch.setenv("VGT_CONFIG_PATH", "/nonexistent/vgt-test-config.yaml")
     config_mod.reset_config()
     tracing_mod.reset_tracing()
     yield
