@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from vgate_tpu.models.specs import ModelSpec
 from vgate_tpu.ops.attention import causal_prefill_attention, paged_decode_attention
 from vgate_tpu.ops.norms import rms_norm
+from vgate_tpu.ops.quant import weighted_einsum
 from vgate_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
@@ -86,9 +87,9 @@ def init_params(
 
 def _project_qkv(x, lp, spec: ModelSpec):
     """x: [..., D] -> q [..., H, hd], k/v [..., KV, hd]."""
-    q = jnp.einsum("...d,dh->...h", x, lp["q"]["w"])
-    k = jnp.einsum("...d,dh->...h", x, lp["k"]["w"])
-    v = jnp.einsum("...d,dh->...h", x, lp["v"]["w"])
+    q = weighted_einsum("...d,dh->...h", x, lp["q"]["w"])
+    k = weighted_einsum("...d,dh->...h", x, lp["k"]["w"])
+    v = weighted_einsum("...d,dh->...h", x, lp["v"]["w"])
     if spec.qkv_bias:
         q = q + lp["q"]["b"]
         k = k + lp["k"]["b"]
@@ -100,10 +101,11 @@ def _project_qkv(x, lp, spec: ModelSpec):
 
 
 def _dense_mlp(x, lp):
-    gate = jnp.einsum("...d,df->...f", x, lp["gate"]["w"])
-    up = jnp.einsum("...d,df->...f", x, lp["up"]["w"])
-    return jnp.einsum(
-        "...f,fd->...d", jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up,
+    gate = weighted_einsum("...d,df->...f", x, lp["gate"]["w"])
+    up = weighted_einsum("...d,df->...f", x, lp["up"]["w"])
+    return weighted_einsum(
+        "...f,fd->...d",
+        jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up,
         lp["down"]["w"],
     )
 
@@ -171,9 +173,17 @@ def _logits(params: Params, spec: ModelSpec, x: jnp.ndarray) -> jnp.ndarray:
             "...d,vd->...v", x, params["embed"],
             preferred_element_type=jnp.float32,
         )
+    head = params["lm_head"]
+    from vgate_tpu.ops.quant import QTensor
+
+    if isinstance(head, QTensor):
+        logits = jnp.einsum(
+            "...d,dv->...v", x, head.q.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits * head.scale
     return jnp.einsum(
-        "...d,dv->...v", x, params["lm_head"],
-        preferred_element_type=jnp.float32,
+        "...d,dv->...v", x, head, preferred_element_type=jnp.float32,
     )
 
 
@@ -207,7 +217,7 @@ def prefill_forward(
         v_pages_l = v_pages_l.at[pt].set(v_resh)
         attn = causal_prefill_attention(q, k, v, seq_lens)
         attn = attn.reshape(B, S, spec.q_dim)
-        h = h + jnp.einsum("...h,hd->...d", attn, lp["o"]["w"])
+        h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
         normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
         h = h + _mlp(normed2, lp, spec)
         return h, (k_pages_l, v_pages_l)
@@ -264,7 +274,7 @@ def decode_forward(
         v_pages_l = v_pages_l.at[page_ids, page_off].set(v)
         attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
         attn = attn.reshape(B, spec.q_dim)
-        h = h + jnp.einsum("bh,hd->bd", attn, lp["o"]["w"])
+        h = h + weighted_einsum("bh,hd->bd", attn, lp["o"]["w"])
         normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
         h = h + _mlp(normed2, lp, spec)
         return h, (k_pages_l, v_pages_l)

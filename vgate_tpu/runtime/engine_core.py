@@ -113,6 +113,12 @@ class EngineCore:
                 self.spec, self.config.model.checkpoint_path, self.dtype
             )
         self.params = shard_params(params, self.spec, self.mesh)
+        if self.config.model.quantization == "int8":
+            from vgate_tpu.ops.quant import quantize_decoder_params
+
+            # quantize after sharding: the eager ops run SPMD on the mesh,
+            # so scales inherit the weights' tp layout
+            self.params = quantize_decoder_params(self.params, self.spec)
         jax.block_until_ready(jax.tree.leaves(self.params)[0])
         self.load_time_s = time.perf_counter() - load_start
 
