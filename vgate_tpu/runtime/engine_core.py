@@ -76,14 +76,20 @@ def _prefill_step(
 )
 def _decode_step(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
-    page_tables, active, temps, top_ps, top_ks, key, use_pallas=False,
+    page_tables, active, temps, top_ps, top_ks, base_key, counter,
+    use_pallas=False,
 ):
+    """One decode step.  tokens/positions/counter are device-resident state
+    threaded between steps (the host only re-uploads them when slot
+    membership changes — see EngineCore._run_decode)."""
+    key = jax.random.fold_in(base_key, counter)
     logits, k_pages, v_pages = decode_forward(
         params, spec, tokens, positions, k_pages, v_pages, page_tables,
         active=active, use_pallas=use_pallas,
     )
     next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
-    return next_tokens, k_pages, v_pages
+    positions_next = positions + active.astype(positions.dtype)
+    return next_tokens, positions_next, counter + 1, k_pages, v_pages
 
 
 class EngineCore:
@@ -125,12 +131,21 @@ class EngineCore:
         params_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
         )
-        num_pages = tpu_cfg.kv_num_pages or auto_num_pages(
-            self.spec,
-            tpu_cfg.kv_page_size,
-            tpu_cfg.hbm_utilization,
-            device=self.mesh.devices.flat[0],
-            params_bytes=params_bytes,
+        # more pages than every slot's full context can never be used, and
+        # bounding the pool keeps the page-scatter/gather programs small
+        pages_per_seq = cdiv(
+            self.config.model.max_model_len, tpu_cfg.kv_page_size
+        )
+        max_useful = tpu_cfg.max_batch_slots * pages_per_seq + 1
+        num_pages = tpu_cfg.kv_num_pages or min(
+            max_useful,
+            auto_num_pages(
+                self.spec,
+                tpu_cfg.kv_page_size,
+                tpu_cfg.hbm_utilization,
+                device=self.mesh.devices.flat[0],
+                params_bytes=params_bytes,
+            ),
         )
         self.geometry = KVGeometry(
             num_layers=self.spec.num_layers,
@@ -164,6 +179,8 @@ class EngineCore:
         self._step_counter = 0
         self._compiled_buckets: set = set()
         self._decode_compiled = False
+        self._dec_state: Optional[Dict[str, Any]] = None
+        self._decode_signature_cache: Optional[tuple] = None
 
         # Pallas kernels require a real TPU backend (tests run interpret-mode
         # kernels separately; the engine's jnp twins serve CPU meshes)
@@ -345,7 +362,14 @@ class EngineCore:
         seq.append_token(token)
         self._maybe_finish(seq, token)
 
-    def _run_decode(self, plan: DecodePlan) -> None:
+    def _decode_signature(self, plan: DecodePlan):
+        """Cheap membership signature: when unchanged, every device input
+        except tokens/positions (which flow device→device) is reusable."""
+        return tuple(
+            (seq.seq_id, seq.slot, len(seq.pages)) for seq in plan.seqs
+        )
+
+    def _build_decode_state(self, plan: DecodePlan) -> None:
         B = self.max_slots
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -365,26 +389,52 @@ class EngineCore:
             temps[slot] = seq.params.temperature
             top_ps[slot] = seq.params.top_p
             top_ks[slot] = seq.params.top_k
+        self._dec_state = {
+            "tokens": jnp.asarray(tokens),
+            "positions": jnp.asarray(positions),
+            "page_tables": jnp.asarray(self._page_tables_np),
+            "active": jnp.asarray(active),
+            "temps": jnp.asarray(temps),
+            "top_ps": jnp.asarray(top_ps),
+            "top_ks": jnp.asarray(top_ks),
+            "counter": jnp.asarray(self._step_counter, jnp.uint32),
+        }
+
+    def _run_decode(self, plan: DecodePlan) -> None:
+        signature = self._decode_signature(plan)
+        if signature != self._decode_signature_cache:
+            self._build_decode_state(plan)
+            self._decode_signature_cache = signature
+        state = self._dec_state
 
         if not self._decode_compiled:
             metrics.RECOMPILES.labels(kind="decode").inc()
             self._decode_compiled = True
         start = time.perf_counter()
-        next_tokens, self.k_pages, self.v_pages = _decode_step(
-            self.params,
-            self.spec,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
+        (
+            next_tokens,
+            state["positions"],
+            state["counter"],
             self.k_pages,
             self.v_pages,
-            jnp.asarray(self._page_tables_np),
-            jnp.asarray(active),
-            jnp.asarray(temps),
-            jnp.asarray(top_ps),
-            jnp.asarray(top_ks),
-            self._step_key(),
+        ) = _decode_step(
+            self.params,
+            self.spec,
+            state["tokens"],
+            state["positions"],
+            self.k_pages,
+            self.v_pages,
+            state["page_tables"],
+            state["active"],
+            state["temps"],
+            state["top_ps"],
+            state["top_ks"],
+            self._base_key,
+            state["counter"],
             use_pallas=self.use_pallas,
         )
+        state["tokens"] = next_tokens
+        self._step_counter += 1
         sampled = np.asarray(next_tokens)
         metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
             time.perf_counter() - start
