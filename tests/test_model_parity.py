@@ -61,7 +61,7 @@ def _build_hf_moe():
 
 def _empty_cache(spec, num_pages, pages_per_seq, batch):
     k_pages = jnp.zeros(
-        (spec.num_layers, num_pages, PAGE, spec.num_kv_heads, spec.head_dim),
+        (spec.num_layers, spec.num_kv_heads, num_pages, PAGE, spec.head_dim),
         jnp.float32,
     )
     v_pages = jnp.zeros_like(k_pages)
@@ -175,7 +175,7 @@ def test_decode_inactive_slot_does_not_corrupt_cache():
         params, spec, tokens, jnp.asarray([4, 4], jnp.int32),
         k_pages, v_pages, page_tables,
     )
-    snapshot = np.asarray(k_pages[:, 2])  # slot 1's page
+    snapshot = np.asarray(k_pages[:, :, 2])  # slot 1's page
     # slot 1 inactive: its write must go to trash page 0, not page 2
     _, k_pages, _ = decode_forward(
         params, spec,
@@ -184,5 +184,5 @@ def test_decode_inactive_slot_does_not_corrupt_cache():
         k_pages, v_pages, page_tables,
         active=jnp.asarray([True, False]),
     )
-    after = np.asarray(k_pages[:, 2])
+    after = np.asarray(k_pages[:, :, 2])
     np.testing.assert_array_equal(snapshot, after)

@@ -84,8 +84,8 @@ def test_paged_decode_matches_contiguous_attention():
     ctx_lens = [6, 3]
     n_pages_per_seq = 2
     P = 1 + B * n_pages_per_seq
-    k_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
-    v_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
     page_tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
     q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
     out = np.asarray(
@@ -96,8 +96,10 @@ def test_paged_decode_matches_contiguous_attention():
     # naive per-slot computation
     for b in range(B):
         n = ctx_lens[b]
-        k = np.asarray(k_pages[np.asarray(page_tables[b])]).reshape(-1, KV, hd)[:n]
-        v = np.asarray(v_pages[np.asarray(page_tables[b])]).reshape(-1, KV, hd)[:n]
+        k = np.asarray(k_pages[:, np.asarray(page_tables[b])])
+        k = k.reshape(KV, -1, hd).transpose(1, 0, 2)[:n]
+        v = np.asarray(v_pages[:, np.asarray(page_tables[b])])
+        v = v.reshape(KV, -1, hd).transpose(1, 0, 2)[:n]
         k = np.repeat(k, H // KV, axis=1)
         v = np.repeat(v, H // KV, axis=1)
         qb = np.asarray(q[b])  # [H, hd]

@@ -16,8 +16,8 @@ def make_case(B=4, H=8, KV=2, hd=128, ps=16, pages_per_seq=16, seed=0,
     rng = np.random.default_rng(seed)
     P = 1 + B * pages_per_seq
     q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
-    k_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
-    v_pages = jnp.asarray(rng.normal(size=(P, ps, KV, hd)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
     page_tables = jnp.asarray(
         rng.permutation(np.arange(1, P))[: B * pages_per_seq].reshape(
             B, pages_per_seq

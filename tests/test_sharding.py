@@ -85,7 +85,7 @@ class TestParamShardings:
     def test_kv_pages_shard_only_on_kv_heads(self):
         mesh = build_mesh(tpu_cfg(tp=2, dp=0))
         spec = kv_pspec(TINY_DENSE, mesh)  # kv_heads=2 % 2 == 0
-        assert spec == P(None, None, None, "tp", None)
+        assert spec == P(None, "tp", None, None, None)
 
     def test_shard_params_places_on_mesh(self):
         mesh = build_mesh(tpu_cfg(tp=0))
@@ -109,7 +109,7 @@ def test_tp8_decode_step_runs_sharded():
     B, ps, n_pages = 4, 4, 17
 
     def build_inputs():
-        k = jnp.zeros((spec.num_layers, n_pages, ps, spec.num_kv_heads,
+        k = jnp.zeros((spec.num_layers, spec.num_kv_heads, n_pages, ps,
                        spec.head_dim), jnp.float32)
         v = jnp.zeros_like(k)
         pt = jnp.asarray(

@@ -192,13 +192,13 @@ def prefill_forward(
     spec: ModelSpec,
     tokens: jnp.ndarray,  # [B, S] padded to a bucket; S % page_size == 0
     seq_lens: jnp.ndarray,  # [B]
-    k_pages: jnp.ndarray,  # [L, P, ps, KV, hd]
+    k_pages: jnp.ndarray,  # [L, KV, P, ps, hd] (head-major, kv_cache.py)
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, S // ps] page ids for this prompt
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run the prompt pass: returns (last-token logits [B, V], k_pages, v_pages)."""
     B, S = tokens.shape
-    ps = k_pages.shape[2]
+    ps = k_pages.shape[3]
     n_pages = S // ps
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     x = params["embed"][tokens]  # [B, S, D]
@@ -210,11 +210,19 @@ def prefill_forward(
         q = apply_rope(q, positions, spec.rope_theta)
         k = apply_rope(k, positions, spec.rope_theta)
         # Write this layer's KV into its pages (trash-page-0 absorbs padding).
-        k_resh = k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim)
-        v_resh = v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim)
+        # Pages are head-major [KV, P, ps, hd]: transpose the fresh KV to
+        # [KV, B, n_pages, ps, hd] so each head's pages land contiguously.
+        k_resh = jnp.transpose(
+            k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+            (3, 0, 1, 2, 4),
+        )
+        v_resh = jnp.transpose(
+            v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+            (3, 0, 1, 2, 4),
+        )
         pt = page_tables[:, :n_pages]
-        k_pages_l = k_pages_l.at[pt].set(k_resh)
-        v_pages_l = v_pages_l.at[pt].set(v_resh)
+        k_pages_l = k_pages_l.at[:, pt].set(k_resh)
+        v_pages_l = v_pages_l.at[:, pt].set(v_resh)
         attn = causal_prefill_attention(q, k, v, seq_lens)
         attn = attn.reshape(B, S, spec.q_dim)
         h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
@@ -237,7 +245,7 @@ def decode_forward(
     spec: ModelSpec,
     tokens: jnp.ndarray,  # [B] current token per slot
     positions: jnp.ndarray,  # [B] 0-indexed position of `tokens`
-    k_pages: jnp.ndarray,  # [L, P, ps, KV, hd]
+    k_pages: jnp.ndarray,  # [L, KV, P, ps, hd] (head-major, kv_cache.py)
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots write page 0
@@ -253,7 +261,7 @@ def decode_forward(
     else:
         attn_fn = paged_decode_attention
     B = tokens.shape[0]
-    ps = k_pages.shape[2]
+    ps = k_pages.shape[3]
     seq_lens = positions + 1
     batch_idx = jnp.arange(B)
     page_slot = positions // ps
@@ -270,8 +278,12 @@ def decode_forward(
         q, k, v = _project_qkv(normed, lp, spec)  # q [B,H,hd], k/v [B,KV,hd]
         q = apply_rope(q[:, None], positions[:, None], spec.rope_theta)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], spec.rope_theta)[:, 0]
-        k_pages_l = k_pages_l.at[page_ids, page_off].set(k)
-        v_pages_l = v_pages_l.at[page_ids, page_off].set(v)
+        k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
+            jnp.transpose(k, (1, 0, 2))
+        )
+        v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
+            jnp.transpose(v, (1, 0, 2))
+        )
         attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
         attn = attn.reshape(B, spec.q_dim)
         h = h + weighted_einsum("bh,hd->bd", attn, lp["o"]["w"])

@@ -60,8 +60,8 @@ def causal_prefill_attention(
 
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, H, hd] one query token per slot
-    k_pages: jnp.ndarray,  # [P, page_size, KV, hd]
-    v_pages: jnp.ndarray,  # [P, page_size, KV, hd]
+    k_pages: jnp.ndarray,  # [KV, P, page_size, hd] (head-major, kv_cache.py)
+    v_pages: jnp.ndarray,  # [KV, P, page_size, hd]
     page_tables: jnp.ndarray,  # [B, pages_per_seq] int32
     seq_lens: jnp.ndarray,  # [B] context length per slot (incl. current token)
 ) -> jnp.ndarray:
@@ -73,14 +73,18 @@ def paged_decode_attention(
     through VMEM instead of materializing the gather.
     """
     B, H, hd = q.shape
-    page_size = k_pages.shape[1]
-    KV = k_pages.shape[2]
+    KV = k_pages.shape[0]
+    page_size = k_pages.shape[2]
     n_rep = H // KV
     ctx_max = page_tables.shape[1] * page_size
 
-    # Gather pages: [B, pages_per_seq, page_size, KV, hd] -> [B, ctx, KV, hd]
-    k = k_pages[page_tables].reshape(B, ctx_max, KV, hd)
-    v = v_pages[page_tables].reshape(B, ctx_max, KV, hd)
+    # Gather pages: [KV, B, pages_per_seq, page_size, hd] -> [B, ctx, KV, hd]
+    k = jnp.moveaxis(
+        k_pages[:, page_tables].reshape(KV, B, ctx_max, hd), 0, 2
+    )
+    v = jnp.moveaxis(
+        v_pages[:, page_tables].reshape(KV, B, ctx_max, hd), 0, 2
+    )
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
 

@@ -37,11 +37,11 @@ def _kernel(
     page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)
     seq_lens_ref,  # [B] int32 (SMEM)
     # inputs
-    q_ref,  # [1, G, hd] VMEM block for (b, g)
-    k_pages_ref,  # [P, ps, KV, hd] in ANY/HBM
-    v_pages_ref,  # [P, ps, KV, hd]
+    q_ref,  # [1, 1, G, hd] VMEM block for (b, g)
+    k_pages_ref,  # [KV, P, ps, hd] in ANY/HBM (head-major: one page of one
+    v_pages_ref,  # [KV, P, ps, hd]  head is a contiguous (ps, hd) DMA tile)
     # output
-    out_ref,  # [1, G, hd]
+    out_ref,  # [1, 1, G, hd]
     # scratch
     k_buf,  # [2, CHUNK*ps, hd] VMEM
     v_buf,  # [2, CHUNK*ps, hd]
@@ -70,12 +70,12 @@ def _kernel(
             def _():
                 page_id = page_tables_ref[b, page_pos]
                 pltpu.make_async_copy(
-                    k_pages_ref.at[page_id, :, g, :],
+                    k_pages_ref.at[g, page_id],
                     k_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 0, j],
                 ).start()
                 pltpu.make_async_copy(
-                    v_pages_ref.at[page_id, :, g, :],
+                    v_pages_ref.at[g, page_id],
                     v_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 1, j],
                 ).start()
@@ -98,20 +98,20 @@ def _kernel(
             @pl.when(page_pos < n_pages)
             def _():
                 pltpu.make_async_copy(
-                    k_pages_ref.at[0, :, g, :],
+                    k_pages_ref.at[g, 0],
                     k_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 0, j],
                 ).wait()
                 pltpu.make_async_copy(
-                    v_pages_ref.at[0, :, g, :],
+                    v_pages_ref.at[g, 0],
                     v_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 1, j],
                 ).wait()
 
     hd = q_ref.shape[-1]
-    G = q_ref.shape[1]
+    G = q_ref.shape[-2]
     scale = 1.0 / (hd ** 0.5)
-    q = q_ref[0].astype(jnp.float32) * scale  # [G, hd]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
 
     acc_ref[...] = jnp.zeros_like(acc_ref)
     m_ref[...] = jnp.full_like(m_ref, -1e30)
@@ -161,20 +161,20 @@ def _kernel(
 
     jax.lax.fori_loop(0, n_chunks, body, 0)
     denom = jnp.maximum(l_ref[:, :1], 1e-30)
-    out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
+    out_ref[0, 0] = (acc_ref[...] / denom).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # [B, H, hd]
-    k_pages: jnp.ndarray,  # [P, ps, KV, hd]
+    k_pages: jnp.ndarray,  # [KV, P, ps, hd] (head-major, kv_cache.py)
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     seq_lens: jnp.ndarray,  # [B]
     interpret: bool = False,
 ) -> jnp.ndarray:
     B, H, hd = q.shape
-    P, ps, KV, _ = k_pages.shape
+    KV, P, ps, _ = k_pages.shape
     G = H // KV
     max_pages = page_tables.shape[1]
     chunk_tokens = CHUNK_PAGES * ps
@@ -185,19 +185,24 @@ def paged_decode_attention_pallas(
         num_kv_heads=KV,
         max_pages=max_pages,
     )
+    # q is laid out [B, KV, G, hd] so each program's block covers the FULL
+    # trailing (G, hd) dims — Mosaic requires trailing block dims either
+    # tile-aligned (8, 128) or equal to the array dims, and G (q heads per
+    # kv group, e.g. 6 or 7) is rarely tile-aligned.
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV),
         in_specs=[
             pl.BlockSpec(
-                (1, G, hd), lambda b, g, *prefetch: (b, g, 0),
+                (1, 1, G, hd), lambda b, g, *prefetch: (b, g, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (1, G, hd), lambda b, g, *prefetch: (b, g, 0), memory_space=pltpu.VMEM
+            (1, 1, G, hd), lambda b, g, *prefetch: (b, g, 0, 0),
+            memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
             pltpu.VMEM((2, chunk_tokens, hd), k_pages.dtype),
@@ -211,10 +216,10 @@ def paged_decode_attention_pallas(
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
-    )(page_tables, seq_lens, q, k_pages, v_pages)
-    return out
+    )(page_tables, seq_lens, q.reshape(B, KV, G, hd), k_pages, v_pages)
+    return out.reshape(B, H, hd)

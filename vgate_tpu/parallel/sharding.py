@@ -79,20 +79,20 @@ def param_pspecs(spec: ModelSpec, mesh: Mesh) -> Dict[str, Any]:
 
 
 def kv_pspec(spec: ModelSpec, mesh: Mesh) -> P:
-    """KV pages [L, P, page, KV, hd]: shard KV heads over tp when divisible."""
+    """KV pages [L, KV, P, page, hd]: shard KV heads over tp when divisible."""
     return _spec(
         mesh,
         (
             spec.num_layers,
+            spec.num_kv_heads,
             1 << 30,  # page count always divisible-agnostic -> never sharded
             1 << 30,
-            spec.num_kv_heads,
             spec.head_dim,
         ),
         None,
-        None,
-        None,
         AXIS_TP,
+        None,
+        None,
         None,
     )
 

@@ -2,8 +2,10 @@
 
 First-party replacement for the paged-KV capability the reference gets
 opaquely from vLLM (SURVEY.md section 2.1 "Paged KV cache + attention
-kernels").  Layout: ``[num_layers, num_pages, page_size, kv_heads, head_dim]``
-per K and V, resident in TPU HBM; **page 0 is a reserved trash page** that
+kernels").  Layout: ``[num_layers, kv_heads, num_pages, page_size, head_dim]``
+per K and V (head-major so one page of one head is a contiguous
+``(page_size, head_dim)`` tile — the unit the Pallas decode kernel DMAs),
+resident in TPU HBM; **page 0 is a reserved trash page** that
 absorbs writes from padded positions and idle decode slots so device code
 never branches on validity.
 """
@@ -122,9 +124,9 @@ def make_kv_buffers(geometry: KVGeometry, dtype=jnp.bfloat16, sharding=None):
     """Allocate the K/V page pools (zeros) directly on device."""
     shape = (
         geometry.num_layers,
+        geometry.kv_heads,
         geometry.num_pages,
         geometry.page_size,
-        geometry.kv_heads,
         geometry.head_dim,
     )
     if sharding is not None:
