@@ -57,7 +57,7 @@ def test_quantized_prefill_close_to_fp32():
     B, S = 1, 16
     tokens = jnp.asarray(np.full((B, S), 7, np.int32))
     lens = jnp.asarray([10], jnp.int32)
-    k = jnp.zeros((spec.num_layers, 2, 16, spec.num_kv_heads, spec.head_dim),
+    k = jnp.zeros((spec.num_layers, spec.num_kv_heads, 2, 16, spec.head_dim),
                   jnp.float32)
     v = jnp.zeros_like(k)
     pt = jnp.asarray([[1]], jnp.int32)

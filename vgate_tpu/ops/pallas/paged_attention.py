@@ -51,8 +51,6 @@ def _kernel(
     sems,  # DMA semaphores [2, 2, CHUNK]
     *,
     page_size: int,
-    num_kv_heads: int,
-    max_pages: int,
 ):
     b = pl.program_id(0)
     g = pl.program_id(1)
@@ -176,15 +174,9 @@ def paged_decode_attention_pallas(
     B, H, hd = q.shape
     KV, P, ps, _ = k_pages.shape
     G = H // KV
-    max_pages = page_tables.shape[1]
     chunk_tokens = CHUNK_PAGES * ps
 
-    kernel = functools.partial(
-        _kernel,
-        page_size=ps,
-        num_kv_heads=KV,
-        max_pages=max_pages,
-    )
+    kernel = functools.partial(_kernel, page_size=ps)
     # q is laid out [B, KV, G, hd] so each program's block covers the FULL
     # trailing (G, hd) dims — Mosaic requires trailing block dims either
     # tile-aligned (8, 128) or equal to the array dims, and G (q heads per
