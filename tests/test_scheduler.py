@@ -174,3 +174,32 @@ def test_idle_returns_none():
     sched, _ = make_sched()
     assert sched.schedule() is None
     assert not sched.has_work()
+
+
+def test_prepare_decode_horizon_allocates_ahead():
+    """horizon=k must reserve pages covering positions pos..pos+k-1 (the
+    engine's chunked-decode contract, engine_core.py:_tick)."""
+    sched, alloc = make_sched(page_size=4)
+    seq = seq_of(4, max_tokens=16)  # fills exactly one page
+    sched.add(seq)
+    sched.schedule()  # admit: 1 page for the 4 prompt tokens
+    assert len(seq.pages) == 1
+    seq.append_token(9)  # first (prefill) token -> pos 4, page 2 territory
+    assert sched.prepare_decode([seq], horizon=6)
+    # positions 4..9 span pages 1 and 2 -> 3 pages total... pos 4..9 -> 2 more
+    assert len(seq.pages) == 3  # ceil((4+6)/4)
+
+
+def test_prepare_decode_horizon_capped_by_budget():
+    """A sequence with 1 token of budget left must not allocate horizon
+    pages for steps that will be discarded as overshoot."""
+    sched, alloc = make_sched(page_size=4)
+    seq = seq_of(4, max_tokens=2)
+    sched.add(seq)
+    sched.schedule()
+    seq.append_token(9)  # 1 generated, budget leaves 1 more
+    used_before = alloc.num_used
+    assert sched.prepare_decode([seq], horizon=8)
+    # only the page holding pos 4 (already needed for the kept step) counts
+    assert alloc.num_used == used_before + 1
+    assert len(seq.pages) == 2

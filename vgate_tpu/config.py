@@ -24,6 +24,28 @@ from pydantic import BaseModel, Field, field_validator
 ENV_PREFIX = "VGT_"
 CONFIG_PATH_ENV = "VGT_CONFIG_PATH"
 
+
+def apply_platform(tpu_cfg) -> None:
+    """Pin the JAX platform per ``tpu.platform`` (no-op for "auto").
+
+    Must run before the first JAX backend touch — ``jax.config.update``
+    silently does nothing once backends are initialized, so this verifies
+    the switch actually took and raises otherwise.  Call sites: engine
+    construction and server startup (both before any device use).
+    """
+    if tpu_cfg.platform == "auto":
+        return
+    import jax
+
+    jax.config.update("jax_platforms", tpu_cfg.platform)
+    actual = jax.devices()[0].platform
+    if actual != tpu_cfg.platform:
+        raise RuntimeError(
+            f"tpu.platform={tpu_cfg.platform!r} requested but JAX backends "
+            f"were already initialized on {actual!r}; set the platform "
+            "before any jax.devices()/device computation happens"
+        )
+
 VALID_ENGINE_TYPES = ("dry_run", "jax_tpu")
 
 
@@ -79,6 +101,21 @@ class TPUConfig(BaseModel):
     tp: int = 0  # 0 => all devices
     ep: int = 1
     sp: int = 1
+    # JAX platform to pin before device init: "auto" keeps whatever the
+    # environment selects; "cpu" forces the host platform (the CPU/dry-run
+    # serving target — some TPU plugins override the JAX_PLATFORMS env var,
+    # so an explicit config knob is the only reliable switch).
+    platform: str = "auto"
+
+    @field_validator("platform")
+    @classmethod
+    def _check_platform(cls, v: str) -> str:
+        allowed = {"auto", "cpu", "tpu"}
+        if v not in allowed:
+            raise ValueError(
+                f"tpu.platform must be one of {sorted(allowed)}, got {v!r}"
+            )
+        return v
     num_devices: int = 0  # 0 => every visible device; else use a subslice
     # Paged KV cache geometry.
     kv_page_size: int = 16  # tokens per page
@@ -93,6 +130,14 @@ class TPUConfig(BaseModel):
     # implementations (needed on CPU test meshes).
     use_pallas: bool = True
     donate_kv: bool = True
+    # Decode steps fused into one device program (lax.scan over the step
+    # body).  The host reads tokens back once per chunk, amortizing the
+    # host<->device round-trip over `decode_chunk` tokens per slot; chunk
+    # sizes actually compiled are the powers of two <= this value.
+    decode_chunk: int = 8
+    # Keep up to `decode_pipeline` chunks in flight before blocking on the
+    # oldest readback (overlaps host processing with device execution).
+    decode_pipeline: int = 2
 
 
 class BatchConfig(BaseModel):

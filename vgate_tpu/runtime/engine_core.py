@@ -2,15 +2,25 @@
 
 Architecture (SURVEY.md section 7, stages 3-4):
 
-* One **engine thread** owns the device.  Each iteration it asks the
-  scheduler for a plan: admit-and-prefill one waiting prompt, or run one
-  decode step over every active slot.  New sequences therefore join between
-  decode steps — no stop-the-world batch (the reference's design it
-  replaces: vgate/batcher.py:195's global lock around blocking generate).
-* **Two compiled programs** cover all steady-state work: a decode step at
-  the static shape [max_batch_slots], and one prefill program per sequence
-  bucket.  Sampling runs inside both programs with per-slot parameters.
-* KV pages are donated through every call so XLA updates them in place.
+* One **engine thread** owns the device.  Each tick it admits every
+  waiting prompt it can (prefills dispatched back-to-back, first tokens
+  read in one transfer), then runs decode in **chunks** of up to
+  ``tpu.decode_chunk`` fused steps — no stop-the-world batch (the
+  reference's design it replaces: vgate/batcher.py:195's global lock
+  around blocking generate).
+* **A small set of compiled programs** covers all steady-state work: one
+  decode-chunk program per power-of-two chunk length at the static shape
+  [max_batch_slots], and one prefill program per sequence bucket.
+  Sampling runs inside both with per-slot parameters.
+* **Latency-hiding pipeline**: up to ``tpu.decode_pipeline`` chunks stay
+  in flight before the host blocks on the oldest readback, so host-side
+  token processing (and, over a remote-device tunnel, per-call round-trip
+  latency) overlaps device execution.  EOS/length stops are detected at
+  readback; overshoot steps are discarded and their KV writes land in
+  horizon pages the scheduler reserved (see Scheduler.prepare_decode).
+* KV pages are donated through every call so XLA updates them in place;
+  tokens/positions/rng-counter stay device-resident between chunks and are
+  re-uploaded only when slot membership changes.
 * The async serving world talks to the thread via a submit queue +
   ``threading.Event`` per sequence; token streaming via per-token callbacks.
 """
@@ -29,7 +39,7 @@ import numpy as np
 
 from vgate_tpu import metrics
 from vgate_tpu.backends.base import SamplingParams
-from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.models.decoder import decode_forward, prefill_forward
 from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
@@ -42,7 +52,7 @@ from vgate_tpu.runtime.kv_cache import (
     auto_num_pages,
     make_kv_buffers,
 )
-from vgate_tpu.runtime.scheduler import DecodePlan, PrefillPlan, Scheduler
+from vgate_tpu.runtime.scheduler import PrefillPlan, Scheduler
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.runtime.tokenizer import get_tokenizer
 from vgate_tpu.runtime.weights import load_or_init_params
@@ -69,27 +79,66 @@ def _prefill_step(
     return next_tokens, k_pages, v_pages
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("spec", "use_pallas"),
-    donate_argnames=("k_pages", "v_pages"),
-)
 def _decode_step(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
     page_tables, active, temps, top_ps, top_ks, base_key, counter,
     use_pallas=False,
 ):
-    """One decode step.  tokens/positions/counter are device-resident state
-    threaded between steps (the host only re-uploads them when slot
-    membership changes — see EngineCore._run_decode)."""
-    key = jax.random.fold_in(base_key, counter)
-    logits, k_pages, v_pages = decode_forward(
-        params, spec, tokens, positions, k_pages, v_pages, page_tables,
-        active=active, use_pallas=use_pallas,
+    """One decode step — thin wrapper over ``_decode_chunk(num_steps=1)``
+    kept for single-step callers (e.g. __graft_entry__.dryrun_multichip)."""
+    chunk_tokens, _tokens, positions, counter, k_pages, v_pages = (
+        _decode_chunk(
+            params, spec, tokens, positions, k_pages, v_pages, page_tables,
+            active, temps, top_ps, top_ks, base_key, counter,
+            num_steps=1, use_pallas=use_pallas,
+        )
     )
-    next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
-    positions_next = positions + active.astype(positions.dtype)
-    return next_tokens, positions_next, counter + 1, k_pages, v_pages
+    return chunk_tokens[0], positions, counter, k_pages, v_pages
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("spec", "num_steps", "use_pallas"),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def _decode_chunk(
+    params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
+    page_tables, active, temps, top_ps, top_ks, base_key, counter,
+    num_steps: int = 1, use_pallas=False,
+):
+    """``num_steps`` decode steps fused into one device program.
+
+    The host reads sampled tokens once per *chunk* instead of once per
+    step — essential when the host<->device link has high per-call latency
+    (remote TPU tunnels) and still a win locally (fewer dispatches).  EOS /
+    max_tokens are detected on the host after readback; steps a sequence ran
+    past its stopping point are discarded there, and their KV writes land in
+    pages the scheduler reserved for the horizon (harmless: the sequence is
+    removed and its pages freed).  Returns ``chunk_tokens`` of shape
+    ``[num_steps, B]`` plus the threaded device state.
+    """
+
+    def body(carry, _):
+        tokens, positions, counter, k_pages, v_pages = carry
+        key = jax.random.fold_in(base_key, counter)
+        logits, k_pages, v_pages = decode_forward(
+            params, spec, tokens, positions, k_pages, v_pages, page_tables,
+            active=active, use_pallas=use_pallas,
+        )
+        next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
+        positions = positions + active.astype(positions.dtype)
+        return (next_tokens, positions, counter + 1, k_pages, v_pages), (
+            next_tokens
+        )
+
+    carry, chunk_tokens = jax.lax.scan(
+        body,
+        (tokens, positions, counter, k_pages, v_pages),
+        None,
+        length=num_steps,
+    )
+    tokens, positions, counter, k_pages, v_pages = carry
+    return chunk_tokens, tokens, positions, counter, k_pages, v_pages
 
 
 class EngineCore:
@@ -105,6 +154,7 @@ class EngineCore:
         self.config = config or get_config()
         self.spec = spec or spec_for_model_id(self.config.model.model_id)
         tpu_cfg = self.config.tpu
+        apply_platform(tpu_cfg)
         self.dtype = _DTYPES[self.config.model.dtype]
         self.mesh = build_mesh(tpu_cfg, devices)
         self.tokenizer = get_tokenizer(
@@ -178,9 +228,14 @@ class EngineCore:
         self._base_key = jax.random.PRNGKey(self.config.model.max_model_len)
         self._step_counter = 0
         self._compiled_buckets: set = set()
-        self._decode_compiled = False
+        self._compiled_chunks: set = set()
         self._dec_state: Optional[Dict[str, Any]] = None
         self._decode_signature_cache: Optional[tuple] = None
+        # in-flight decode chunks awaiting host readback:
+        # (seq snapshot, chunk length, [chunk, B] device tokens, start time)
+        self._pending_chunks: list = []
+        self.decode_chunk = max(1, tpu_cfg.decode_chunk)
+        self.pipeline_depth = max(1, tpu_cfg.decode_pipeline)
 
         # Pallas kernels require a real TPU backend (tests run interpret-mode
         # kernels separately; the engine's jnp twins serve CPU meshes)
@@ -283,17 +338,9 @@ class EngineCore:
         logger.info("engine thread started")
         while self._running:
             try:
-                self._drain_submissions()
-                plan = self.scheduler.schedule()
-                if plan is None:
+                if not self._tick():
                     self._wakeup.wait(timeout=0.005)
                     self._wakeup.clear()
-                    continue
-                if isinstance(plan, PrefillPlan):
-                    self._run_prefill(plan)
-                else:
-                    self._run_decode(plan)
-                self.total_steps += 1
             except Exception as exc:  # pragma: no cover - engine fatal path
                 logger.error("engine loop fatal error", exc_info=True)
                 self._fatal = exc
@@ -304,8 +351,89 @@ class EngineCore:
                 self.scheduler.waiting.clear()
                 for i in range(len(self.scheduler.slots)):
                     self.scheduler.slots[i] = None
+                self._pending_chunks.clear()
                 self._running = False
         logger.info("engine thread stopped")
+
+    def _tick(self) -> bool:
+        """One iteration of the engine loop.
+
+        1. Dispatch every admissible prefill asynchronously, then read all
+           their first tokens back in a single transfer.
+        2. Keep up to ``pipeline_depth`` decode chunks in flight: dispatch
+           the next chunk against device-resident state, then block on the
+           *oldest* chunk's readback — host-side token processing overlaps
+           device execution of the newer chunk (and, over a remote device
+           tunnel, the transfer latency of one chunk hides under the
+           execution of the next).
+
+        Returns False when there was no work (the loop then sleeps).
+        """
+        self._drain_submissions()
+        worked = self._admit_and_prefill()
+
+        active = self._running_seqs()
+        if active:
+            signature = self._decode_signature(active)
+            if signature != self._decode_signature_cache:
+                # membership changed: all in-flight chunks must be folded
+                # into host state before rebuilding the device state
+                self._process_chunks(drain=True)
+                active = self._running_seqs()
+                if active:
+                    chunk = self._pick_chunk(active)
+                    if self.scheduler.prepare_decode(active, horizon=chunk):
+                        active = self._running_seqs()  # minus any victims
+                        if active:
+                            self._build_decode_state(active)
+                            self._decode_signature_cache = (
+                                self._decode_signature(active)
+                            )
+                            self._dispatch_chunk(active, chunk)
+                worked = True
+            elif len(self._pending_chunks) < self.pipeline_depth:
+                in_flight = sum(c[1] for c in self._pending_chunks)
+                chunk = self._pick_chunk(active, lead=in_flight)
+                if chunk == 0:
+                    # every sequence's budget is already covered by the
+                    # in-flight steps — a new chunk would be pure overshoot
+                    self._process_chunks()
+                elif self.scheduler.prepare_decode(
+                    active, horizon=in_flight + chunk
+                ):
+                    # preemption changes membership -> handled next tick;
+                    # only dispatch when the slot set survived intact
+                    if (
+                        self._decode_signature(self._running_seqs())
+                        == self._decode_signature_cache
+                    ):
+                        self._dispatch_chunk(active, chunk)
+                worked = True
+
+        if self._pending_chunks and (
+            len(self._pending_chunks) >= self.pipeline_depth
+            or not active
+        ):
+            self._process_chunks(drain=not active)
+            worked = True
+        # re-tick immediately when processing just opened a slot for a
+        # waiting prompt (otherwise the loop would nap 5ms before admitting)
+        return (
+            worked
+            or bool(self._pending_chunks)
+            or (
+                bool(self.scheduler.waiting)
+                and self.scheduler._free_slot() is not None
+            )
+        )
+
+    def _running_seqs(self) -> List[Sequence]:
+        return [
+            s for s in self.scheduler.running
+            if s.status is SeqStatus.RUNNING
+        ]
+
+    # ------------------------------------------------------------- prefill
 
     def _drain_submissions(self) -> None:
         while True:
@@ -322,7 +450,38 @@ class EngineCore:
         self._step_counter += 1
         return jax.random.fold_in(self._base_key, self._step_counter)
 
-    def _run_prefill(self, plan: PrefillPlan) -> None:
+    def _admit_and_prefill(self) -> bool:
+        """Admit every waiting prompt a free slot + pages exist for,
+        dispatching their prefill programs back-to-back WITHOUT blocking,
+        then read all first tokens in one transfer.  The dispatches pipeline
+        on the device queue, so N admissions cost ~one round-trip rather
+        than N."""
+        dispatched = []
+        start = time.perf_counter()
+        while True:
+            plan = self.scheduler.try_admit()
+            if plan is None:
+                break
+            dispatched.append((plan.seq, self._dispatch_prefill(plan)))
+        if not dispatched:
+            return False
+        firsts = jax.device_get([h for _, h in dispatched])
+        # batched admission costs one combined dispatch+readback; attribute
+        # an equal share to each prefill so observation count stays
+        # one-per-prefill and the histogram sum stays the true wall time
+        share = (time.perf_counter() - start) / len(dispatched)
+        for _ in dispatched:
+            metrics.ENGINE_STEP_TIME.labels(kind="prefill").observe(share)
+        for (seq, _), first in zip(dispatched, firsts):
+            token = int(np.asarray(first)[0])
+            self.total_prefills += 1
+            seq.append_token(token)
+            self._maybe_finish(seq, token)
+        return True
+
+    def _dispatch_prefill(self, plan: PrefillPlan):
+        """Launch one prefill program; returns the (async) first-token
+        device array."""
         seq, bucket = plan.seq, plan.bucket
         ps = self.geometry.page_size
         n_prompt = seq.num_prompt_tokens
@@ -340,7 +499,6 @@ class EngineCore:
         if bucket not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(bucket)
-        start = time.perf_counter()
         next_tokens, self.k_pages, self.v_pages = _prefill_step(
             self.params,
             self.spec,
@@ -354,22 +512,19 @@ class EngineCore:
             jnp.asarray([sp.top_k], jnp.int32),
             self._step_key(),
         )
-        token = int(np.asarray(next_tokens)[0])
-        metrics.ENGINE_STEP_TIME.labels(kind="prefill").observe(
-            time.perf_counter() - start
-        )
-        self.total_prefills += 1
-        seq.append_token(token)
-        self._maybe_finish(seq, token)
+        return next_tokens
 
-    def _decode_signature(self, plan: DecodePlan):
+    # ------------------------------------------------------------- decode
+
+    def _decode_signature(self, seqs: List[Sequence]):
         """Cheap membership signature: when unchanged, every device input
-        except tokens/positions (which flow device→device) is reusable."""
+        except tokens/positions/counter (which flow device→device) is
+        reusable, so chunks can be dispatched without any host upload."""
         return tuple(
-            (seq.seq_id, seq.slot, len(seq.pages)) for seq in plan.seqs
+            (seq.seq_id, seq.slot, len(seq.pages)) for seq in seqs
         )
 
-    def _build_decode_state(self, plan: DecodePlan) -> None:
+    def _build_decode_state(self, seqs: List[Sequence]) -> None:
         B = self.max_slots
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -377,7 +532,7 @@ class EngineCore:
         temps = np.zeros((B,), np.float32)
         top_ps = np.ones((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
-        for seq in plan.seqs:
+        for seq in seqs:
             slot = seq.slot
             assert slot is not None
             row = self._page_tables_np[slot]
@@ -400,24 +555,40 @@ class EngineCore:
             "counter": jnp.asarray(self._step_counter, jnp.uint32),
         }
 
-    def _run_decode(self, plan: DecodePlan) -> None:
-        signature = self._decode_signature(plan)
-        if signature != self._decode_signature_cache:
-            self._build_decode_state(plan)
-            self._decode_signature_cache = signature
-        state = self._dec_state
+    def _pick_chunk(self, active: List[Sequence], lead: int = 0) -> int:
+        """Chunk length for the next dispatch: the largest power of two that
+        neither exceeds ``decode_chunk`` nor overshoots every sequence's
+        remaining budget (``lead`` = steps already in flight but not yet
+        folded into host state).  Powers of two bound how many chunk-length
+        program variants XLA ever compiles."""
+        max_len = self.config.model.max_model_len
+        headroom = 0
+        for seq in active:
+            rem_tokens = max(1, seq.params.max_tokens) - seq.num_generated
+            rem_len = max_len - seq.total_len
+            headroom = max(headroom, min(rem_tokens, rem_len) - lead)
+        if headroom <= 0:
+            # in-flight steps already cover every budget: dispatching more
+            # would be pure overshoot (possible only when lead > 0; a
+            # sequence with zero remaining budget is finished at readback)
+            return 0
+        headroom = min(self.decode_chunk, headroom)
+        return 1 << (headroom.bit_length() - 1)
 
-        if not self._decode_compiled:
+    def _dispatch_chunk(self, active: List[Sequence], chunk: int) -> None:
+        state = self._dec_state
+        if chunk not in self._compiled_chunks:
             metrics.RECOMPILES.labels(kind="decode").inc()
-            self._decode_compiled = True
+            self._compiled_chunks.add(chunk)
         start = time.perf_counter()
         (
-            next_tokens,
+            chunk_tokens,
+            state["tokens"],
             state["positions"],
             state["counter"],
             self.k_pages,
             self.v_pages,
-        ) = _decode_step(
+        ) = _decode_chunk(
             self.params,
             self.spec,
             state["tokens"],
@@ -431,19 +602,49 @@ class EngineCore:
             state["top_ks"],
             self._base_key,
             state["counter"],
+            num_steps=chunk,
             use_pallas=self.use_pallas,
         )
-        state["tokens"] = next_tokens
-        self._step_counter += 1
-        sampled = np.asarray(next_tokens)
-        metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
-            time.perf_counter() - start
+        self._step_counter += chunk
+        # snapshot preempt_count as an epoch: a sequence preempted while
+        # this chunk is in flight (and possibly re-admitted before the
+        # readback is processed) must NOT receive the stale tokens
+        self._pending_chunks.append(
+            ([(s, s.preempt_count) for s in active], chunk, chunk_tokens,
+             start)
         )
-        for seq in plan.seqs:
-            token = int(sampled[seq.slot])
-            seq.append_token(token)
-            self.total_decode_tokens += 1
-            self._maybe_finish(seq, token)
+
+    def _process_chunks(self, drain: bool = False) -> None:
+        """Fold the oldest in-flight chunk (all of them when ``drain``) into
+        host state: append tokens in order, detect EOS/length stops, discard
+        steps past a stop."""
+        while self._pending_chunks:
+            seqs, chunk, tokens_dev, _start = self._pending_chunks.pop(0)
+            # observe only the host-blocking readback time (kind="decode"):
+            # dispatch-to-now would double-count deliberate pipeline
+            # queueing when more than one chunk is in flight
+            block_start = time.perf_counter()
+            sampled = np.asarray(tokens_dev)  # [chunk, B]; blocks
+            metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
+                time.perf_counter() - block_start
+            )
+            for seq, epoch in seqs:
+                if (
+                    seq.status is not SeqStatus.RUNNING
+                    or seq.preempt_count != epoch
+                ):
+                    continue  # stopped or preempted since dispatch
+                slot = seq.slot
+                for k in range(chunk):
+                    token = int(sampled[k, slot])
+                    seq.append_token(token)
+                    self.total_decode_tokens += 1
+                    self._maybe_finish(seq, token)
+                    if seq.status is not SeqStatus.RUNNING:
+                        break
+            self.total_steps += chunk
+            if not drain:
+                break
 
     def _maybe_finish(self, seq: Sequence, token: int) -> None:
         reason = None
@@ -460,17 +661,23 @@ class EngineCore:
     # ------------------------------------------------------------- utilities
 
     def warmup(self, buckets: Optional[List[int]] = None) -> float:
-        """Pre-compile the decode program and the given (default: smallest)
-        prefill buckets so first requests don't pay XLA compile latency."""
+        """Pre-compile the decode-chunk ladder and the given (default:
+        smallest) prefill buckets so first requests don't pay XLA compile
+        latency.  The first warmup sequence generates ``2*decode_chunk``
+        tokens, which walks the power-of-two chunk descent (K, ..., 2, 1)
+        that _pick_chunk produces near a budget boundary."""
         start = time.perf_counter()
         was_running = self._running
         if not was_running:
             self.start()
-        sp = SamplingParams(max_tokens=2, temperature=0.0)
+        ladder = SamplingParams(
+            max_tokens=max(1, 2 * self.decode_chunk), temperature=0.0
+        )
+        single = SamplingParams(max_tokens=1, temperature=0.0)
         buckets = buckets or [self.scheduler.prefill_buckets[0]]
-        for bucket in buckets:
+        for i, bucket in enumerate(buckets):
             n = max(1, min(bucket - 1, 8))
-            seq = self.submit_tokens([5] * n, sp)
+            seq = self.submit_tokens([5] * n, ladder if i == 0 else single)
             seq.done_event.wait(timeout=600)
         if not was_running:
             self.stop()

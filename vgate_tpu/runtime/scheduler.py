@@ -115,21 +115,26 @@ class Scheduler:
 
     def schedule(self) -> Optional[Plan]:
         """Pick the next device program: prefill-priority admission, else a
-        decode step over the active slots."""
-        plan = self._try_admit()
+        decode step over the active slots.
+
+        Convenience wrapper composing the two primitives the engine loop
+        calls directly (``try_admit`` for async prefill dispatch and
+        ``prepare_decode`` with a chunk horizon — engine_core.py:_tick);
+        kept for simple single-step drivers and tests."""
+        plan = self.try_admit()
         if plan is not None:
             return plan
         active = self.running
         if not active:
             return None
-        if self._ensure_decode_pages(active):
+        if self.prepare_decode(active):
             # preemption may have emptied the slots
             active = self.running
             if active:
                 return DecodePlan(seqs=active)
-        return self._try_admit()  # everything preempted; try re-admission
+        return self.try_admit()  # everything preempted; try re-admission
 
-    def _try_admit(self) -> Optional[PrefillPlan]:
+    def try_admit(self) -> Optional[PrefillPlan]:
         if not self.waiting:
             return None
         slot = self._free_slot()
@@ -161,23 +166,35 @@ class Scheduler:
         bucket = bucket_for(seq.num_prompt_tokens, self.prefill_buckets)
         return PrefillPlan(seq=seq, slot=slot, bucket=bucket)
 
-    def _ensure_decode_pages(self, active: List[Sequence]) -> bool:
-        """Allocate a page for every sequence whose next token crosses a page
-        boundary; preempt the youngest sequences on exhaustion.  Returns True
-        when a decode step can proceed."""
+    def prepare_decode(
+        self, active: List[Sequence], horizon: int = 1
+    ) -> bool:
+        """Allocate pages so every sequence can decode ``horizon`` steps
+        (KV writes land at positions ``pos .. pos+horizon-1``) without
+        crossing into unowned memory; preempt the youngest sequences on
+        exhaustion.  Returns True when a decode step can proceed."""
+        max_pages = cdiv(self.max_model_len, self.page_size)
         for seq in sorted(active, key=lambda s: s.seq_id):
             if seq.status is not SeqStatus.RUNNING:
                 continue  # preempted by an earlier iteration
+            # pages only need to cover the steps this sequence will KEEP
+            # (overshoot past its budget is discarded at readback; those
+            # writes fall through to the trash page once the page-table row
+            # runs out of real pages)
+            rem = max(1, seq.params.max_tokens) - seq.num_generated
+            steps = max(1, min(horizon, rem))
             while True:
-                # position of the token fed this step
+                # last position written within the horizon (clamped: steps
+                # past max_model_len clip into the final page harmlessly)
                 pos = seq.total_len - 1
-                needed = pos // self.page_size + 1
+                needed = min((pos + steps - 1) // self.page_size + 1,
+                             max_pages)
                 if len(seq.pages) >= needed:
                     break
                 pages = self.allocator.allocate(1)
                 if pages is not None:
                     seq.pages.extend(pages)
-                    break
+                    continue  # horizon may need several pages
                 if not self.preempt_on_oom:
                     seq.fail(RuntimeError("KV pages exhausted"))
                     self.remove(seq)

@@ -23,7 +23,7 @@ from pydantic import ValidationError
 
 from vgate_tpu import metrics
 from vgate_tpu.batcher import RequestBatcher
-from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.engine import VGTEngine
 from vgate_tpu.logging_config import get_logger, setup_logging
 from vgate_tpu.security import build_security_middleware
@@ -367,6 +367,10 @@ async def run_benchmark(request: web.Request) -> web.Response:
 async def _on_startup(app: web.Application) -> None:
     config: VGTConfig = app["config"]
     init_tracing(config)
+    # pin the JAX platform before the first device touch (some TPU plugins
+    # override the JAX_PLATFORMS env var, so the config knob is the only
+    # reliable CPU/dry-run switch)
+    apply_platform(config.tpu)
     loop = asyncio.get_running_loop()
     # Model load can take minutes; do it off the event loop.
     engine = await loop.run_in_executor(None, lambda: VGTEngine(config))
