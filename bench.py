@@ -27,20 +27,26 @@ BASELINE_PROXY_TOKS = 2000.0
 def main() -> None:
     import jax
 
-    on_accelerator = jax.devices()[0].platform != "cpu"
-
     from vgate_tpu.backends.base import SamplingParams
-    from vgate_tpu.config import load_config
+    from vgate_tpu.config import apply_platform, load_config
     from vgate_tpu.runtime.engine_core import EngineCore
+
+    # honor VGT_TPU__PLATFORM (via the config env layer) before the first
+    # device probe — the axon TPU plugin overrides JAX_PLATFORMS, so the
+    # config knob is the only reliable pin
+    apply_platform(load_config().tpu)
+
+    on_accelerator = jax.devices()[0].platform != "cpu"
 
     if on_accelerator:
         model_id = "Qwen/Qwen2.5-1.5B-Instruct"
         dtype = "bfloat16"
-        n_requests, prompt_len, max_tokens = 64, 120, 128
-        slots = 32
+        n_requests, prompt_len, max_tokens = 128, 120, 128
+        slots = 64
         kv_pages = 0  # auto-size from HBM
         buckets = [128]
-        max_model_len = 2048
+        max_model_len = 512  # covers prompt+output; keeps page tables tight
+        decode_chunk = 16
     else:  # CI smoke fallback
         model_id = "tiny-dense"
         dtype = "float32"
@@ -49,6 +55,7 @@ def main() -> None:
         kv_pages = 256
         buckets = [16]
         max_model_len = 64
+        decode_chunk = 8
 
     config = load_config(
         model={
@@ -67,6 +74,8 @@ def main() -> None:
             "kv_page_size": 16 if on_accelerator else 4,
             "max_batch_slots": slots,
             "prefill_buckets": buckets,
+            "decode_chunk": decode_chunk,
+            "decode_pipeline": 2,
         },
         scheduler={"max_queue_size": 4096},
         logging={"level": "ERROR"},

@@ -248,3 +248,22 @@ def test_decode_chunk_ladder_compiles_powers_of_two():
         assert max(core._compiled_chunks) == 8
     finally:
         core.stop()
+
+
+def test_page_growth_does_not_rebuild_state():
+    """Pages growing mid-generation (same membership) must refresh only the
+    page-table upload, not drain the pipeline and rebuild device state —
+    otherwise the depth-2 pipeline collapses at every page boundary."""
+    core = EngineCore(
+        tiny_config(decode_chunk=4, decode_pipeline=2),
+        devices=jax.devices()[:1],
+    )
+    core.start()
+    try:
+        # 40 tokens across page_size=4 -> ~10 page-boundary crossings
+        [r] = core.generate(["rebuild probe"], [greedy(40)])
+        assert r["num_tokens"] >= 30
+        # one rebuild at admission; page growth must not add more
+        assert core.total_state_rebuilds == 1
+    finally:
+        core.stop()

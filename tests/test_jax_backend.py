@@ -148,5 +148,20 @@ async def test_gateway_end_to_end_with_jax_engine():
         # health reports device liveness
         health = await (await client.get("/health")).json()
         assert health["device"]["alive"] is True
+
+        # device profiler capture while serving (SURVEY.md section 5.1)
+        import os
+        import tempfile
+
+        out_dir = tempfile.mkdtemp(prefix="vgt_prof_test_")
+        resp = await client.post(
+            "/v1/profile",
+            json={"duration_ms": 100, "out_dir": out_dir},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["trace_dir"] == out_dir
+        assert body["files"] >= 1  # .xplane.pb trace written
+        assert os.path.isdir(out_dir)
     finally:
         await client.close()

@@ -115,3 +115,60 @@ def test_quantized_engine_end_to_end():
         assert isinstance(core.params["layers"]["q"]["w"], QTensor)
     finally:
         core.stop()
+
+
+def test_int4_roundtrip_and_memory():
+    rng = np.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=(64, 128)) * 0.02, jnp.float32)
+    qt = quantize_tensor(w, bits=4)
+    assert str(qt.q.dtype) == "int4"
+    deq = qt.q.astype(jnp.float32) * qt.scale
+    rel = np.abs(np.asarray(deq - w)).max() / np.abs(np.asarray(w)).max()
+    assert rel < 0.08  # 4-bit: ~1/15 of range per channel
+
+
+def test_int4_weighted_einsum_close():
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 32)) * 0.02, jnp.float32)
+    dense = weighted_einsum("bd,dh->bh", x, w)
+    quant = weighted_einsum("bd,dh->bh", x, quantize_tensor(w, bits=4))
+    err = np.abs(np.asarray(dense - quant)).max()
+    assert err < np.abs(np.asarray(dense)).max() * 0.15
+
+
+def test_int4_engine_end_to_end():
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.config import load_config
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    config = load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+            "quantization": "int4",
+        },
+        tpu={"dp": 1, "tp": 1, "ep": 1, "sp": 1, "kv_num_pages": 64,
+             "kv_page_size": 4, "max_batch_slots": 2,
+             "prefill_buckets": [16]},
+        logging={"level": "WARNING"},
+    )
+    core = EngineCore(config, devices=jax.devices()[:1])
+    core.start()
+    try:
+        [result] = core.generate(
+            ["int4 probe"], [SamplingParams(max_tokens=4, temperature=0.0)]
+        )
+        assert result["num_tokens"] >= 1
+        assert str(core.params["layers"]["q"]["w"].q.dtype) == "int4"
+    finally:
+        core.stop()
+
+
+def test_bad_quantization_value_rejected():
+    from vgate_tpu.config import load_config
+
+    with pytest.raises(Exception):
+        load_config(model={"quantization": "fp8"})

@@ -67,7 +67,16 @@ class ModelConfig(BaseModel):
     checkpoint_path: Optional[str] = None
     tokenizer_path: Optional[str] = None
     dtype: str = "bfloat16"
-    quantization: Optional[str] = None  # None | "int8"
+    quantization: Optional[str] = None  # None | "int8" | "int4"
+
+    @field_validator("quantization")
+    @classmethod
+    def _check_quantization(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None and v not in ("int8", "int4"):
+            raise ValueError(
+                f'model.quantization must be "int8", "int4" or null, got {v!r}'
+            )
+        return v
     max_model_len: int = 2048
     embedding_model_id: str = "BAAI/bge-base-en-v1.5"
     embedding_checkpoint_path: Optional[str] = None

@@ -1,11 +1,12 @@
-"""Weight-only int8 quantization.
+"""Weight-only int8 / int4 quantization.
 
 The TPU-native counterpart of the AWQ 4-bit quantization the reference
 passes through to vLLM (vgate/config.py:46, vllm_backend.py:32 — opaque
-there).  Symmetric per-output-channel int8: weights store as
-``QTensor(q=int8, scale=f32[out])`` and dequantize inside the matmul's
-consumer (XLA fuses the int8→bf16 convert + scale into the surrounding
-computation), halving weight HBM traffic — the resource that bounds decode.
+there).  Symmetric per-output-channel narrow-int: weights store as
+``QTensor(q=int8|int4, scale=f32[out])`` and dequantize inside the matmul's
+consumer (XLA fuses the narrow-int→bf16 convert + scale into the
+surrounding computation), cutting weight HBM traffic 2x (int8) or 4x
+(int4, packed two-per-byte on TPU) — the resource that bounds decode.
 
 Every weight in the decoder layout keeps its output dim LAST, so one
 broadcast rule covers q/k/v/o/gate/up/down and lm_head.  MoE expert weights
@@ -22,35 +23,40 @@ import jax.numpy as jnp
 
 
 class QTensor(NamedTuple):
-    """int8 values + per-output-channel scale (output dim is last)."""
+    """narrow-int values + per-output-channel scale (output dim is last)."""
 
-    q: jnp.ndarray  # int8, same shape as the original weight
+    q: jnp.ndarray  # int8 or int4, same shape as the original weight
     scale: jnp.ndarray  # f32, shape = original.shape[-1:] (or [L, out])
+
+
+_QDTYPES = {8: (jnp.int8, 127), 4: (jnp.int4, 7)}
 
 
 Weight = Union[jnp.ndarray, QTensor]
 
 
-def quantize_tensor(w: jnp.ndarray) -> QTensor:
-    """Symmetric per-channel int8 over the last (output) dim."""
+def quantize_tensor(w: jnp.ndarray, bits: int = 8) -> QTensor:
+    """Symmetric per-channel int8/int4 over the last (output) dim."""
+    dtype, qmax = _QDTYPES[bits]
     w32 = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w32), axis=tuple(range(w.ndim - 1)))
-    scale = jnp.maximum(absmax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.maximum(absmax, 1e-8) / qmax
+    q = jnp.clip(jnp.round(w32 / scale), -qmax, qmax).astype(dtype)
     return QTensor(q=q, scale=scale)
 
 
-def quantize_stacked(w: jnp.ndarray) -> QTensor:
+def quantize_stacked(w: jnp.ndarray, bits: int = 8) -> QTensor:
     """Quantize a stacked-layer weight [L, ..., out]: per (layer, channel)."""
+    dtype, qmax = _QDTYPES[bits]
     w32 = w.astype(jnp.float32)
     reduce_axes = tuple(range(1, w.ndim - 1))
     absmax = jnp.max(jnp.abs(w32), axis=reduce_axes)  # [L, out]
-    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    scale = jnp.maximum(absmax, 1e-8) / qmax
     q = jnp.clip(
         jnp.round(w32 / scale[(slice(None),) + (None,) * (w.ndim - 2)]),
-        -127,
-        127,
-    ).astype(jnp.int8)
+        -qmax,
+        qmax,
+    ).astype(dtype)
     return QTensor(q=q, scale=scale)
 
 
@@ -67,12 +73,12 @@ def weighted_einsum(subscripts: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
     return jnp.einsum(subscripts, x, w)
 
 
-def quantize_decoder_params(params: Any, spec) -> Any:
+def quantize_decoder_params(params: Any, spec, bits: int = 8) -> Any:
     """Quantize the dense projection weights of a loaded (possibly sharded)
     param pytree in place of their bf16 versions."""
     if spec.is_moe:
         raise NotImplementedError(
-            "int8 quantization currently covers dense models; MoE expert "
+            "weight quantization currently covers dense models; MoE expert "
             "weights keep bf16"
         )
     out = {
@@ -82,9 +88,9 @@ def quantize_decoder_params(params: Any, spec) -> Any:
     layers = dict(params["layers"])
     for name in ("q", "k", "v", "o", "gate", "up", "down"):
         entry = dict(layers[name])
-        entry["w"] = quantize_stacked(layers[name]["w"])
+        entry["w"] = quantize_stacked(layers[name]["w"], bits)
         layers[name] = entry
     out["layers"] = layers
     if "lm_head" in params:
-        out["lm_head"] = quantize_tensor(params["lm_head"])
+        out["lm_head"] = quantize_tensor(params["lm_head"], bits)
     return out

@@ -28,7 +28,9 @@ Architecture (SURVEY.md section 7, stages 3-4):
 from __future__ import annotations
 
 import functools
+import os
 import queue
+import tempfile
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
@@ -169,12 +171,16 @@ class EngineCore:
                 self.spec, self.config.model.checkpoint_path, self.dtype
             )
         self.params = shard_params(params, self.spec, self.mesh)
-        if self.config.model.quantization == "int8":
+        if self.config.model.quantization in ("int8", "int4"):
             from vgate_tpu.ops.quant import quantize_decoder_params
 
             # quantize after sharding: the eager ops run SPMD on the mesh,
             # so scales inherit the weights' tp layout
-            self.params = quantize_decoder_params(self.params, self.spec)
+            self.params = quantize_decoder_params(
+                self.params,
+                self.spec,
+                bits=int(self.config.model.quantization[3:]),
+            )
         jax.block_until_ready(jax.tree.leaves(self.params)[0])
         self.load_time_s = time.perf_counter() - load_start
 
@@ -251,6 +257,7 @@ class EngineCore:
         self.total_steps = 0
         self.total_prefills = 0
         self.total_decode_tokens = 0
+        self.total_state_rebuilds = 0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -402,11 +409,22 @@ class EngineCore:
                     active, horizon=in_flight + chunk
                 ):
                     # preemption changes membership -> handled next tick;
-                    # only dispatch when the slot set survived intact
-                    if (
-                        self._decode_signature(self._running_seqs())
-                        == self._decode_signature_cache
-                    ):
+                    # dispatch when the slot set survived intact, refreshing
+                    # only the page-table upload when pages merely grew
+                    # (tokens/positions stay device-resident — a drain here
+                    # would collapse the pipeline at every page boundary)
+                    survivors = self._running_seqs()
+                    new_sig = self._decode_signature(survivors)
+                    if new_sig == self._decode_signature_cache:
+                        self._dispatch_chunk(active, chunk)
+                    elif [
+                        (i, s) for i, s, _ in new_sig
+                    ] == [
+                        (i, s)
+                        for i, s, _ in self._decode_signature_cache or ()
+                    ]:
+                        self._refresh_page_tables(survivors)
+                        self._decode_signature_cache = new_sig
                         self._dispatch_chunk(active, chunk)
                 worked = True
 
@@ -525,6 +543,7 @@ class EngineCore:
         )
 
     def _build_decode_state(self, seqs: List[Sequence]) -> None:
+        self.total_state_rebuilds += 1
         B = self.max_slots
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -554,6 +573,19 @@ class EngineCore:
             "top_ks": jnp.asarray(top_ks),
             "counter": jnp.asarray(self._step_counter, jnp.uint32),
         }
+
+    def _refresh_page_tables(self, seqs: List[Sequence]) -> None:
+        """Re-upload ONLY the page tables after in-place page growth (same
+        sequences, same slots).  In-flight chunks keep their older table,
+        which is valid: the new page is only addressed at positions those
+        chunks never reach."""
+        state = self._dec_state
+        assert state is not None
+        for seq in seqs:
+            row = self._page_tables_np[seq.slot]
+            row[:] = 0
+            row[: len(seq.pages)] = seq.pages
+        state["page_tables"] = jnp.asarray(self._page_tables_np)
 
     def _pick_chunk(self, active: List[Sequence], lead: int = 0) -> int:
         """Chunk length for the next dispatch: the largest power of two that
@@ -683,6 +715,38 @@ class EngineCore:
             self.stop()
         return time.perf_counter() - start
 
+    def capture_profile(
+        self, duration_s: float = 1.0, out_dir: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Capture a ``jax.profiler`` device trace while serving continues
+        (SURVEY.md section 5.1: the reference has request-scoped OTel spans
+        but no low-level profiler; on TPU the device timeline — kernel
+        times, HBM traffic, infeed stalls — comes from the JAX profiler,
+        viewable in TensorBoard/XProf)."""
+        out_dir = out_dir or os.path.join(
+            tempfile.gettempdir(),
+            f"vgt_profile_{int(time.time())}",
+        )
+        duration_s = max(0.05, min(duration_s, 60.0))
+        capture_start = time.time()
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(duration_s)
+        finally:
+            jax.profiler.stop_trace()
+        # count only files this capture wrote (out_dir may be reused)
+        n_files = sum(
+            1
+            for root, _, files in os.walk(out_dir)
+            for f in files
+            if os.path.getmtime(os.path.join(root, f)) >= capture_start - 1
+        )
+        return {
+            "trace_dir": out_dir,
+            "duration_s": duration_s,
+            "files": n_files,
+        }
+
     def device_health(self) -> Dict[str, Any]:
         try:
             device = self.mesh.devices.flat[0]
@@ -697,11 +761,16 @@ class EngineCore:
             return {"alive": False, "error": str(exc)}
 
     def get_stats(self) -> Dict[str, Any]:
+        """Engine counters for /stats.  ``steps`` counts *dispatched decode
+        steps* (chunk lengths summed, including overshoot steps discarded at
+        readback); prefills are reported separately under ``prefills`` and
+        per-request token deliveries under ``decode_tokens``."""
         return {
             "scheduler": self.scheduler.get_stats(),
             "steps": self.total_steps,
             "prefills": self.total_prefills,
             "decode_tokens": self.total_decode_tokens,
+            "state_rebuilds": self.total_state_rebuilds,
             "kv_pages_total": self.geometry.num_pages - 1,
             "kv_token_capacity": self.geometry.total_tokens,
             "model": self.spec.name,

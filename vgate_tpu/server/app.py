@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import statistics
+import tempfile
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -364,8 +366,65 @@ async def run_benchmark(request: web.Request) -> web.Response:
     )
 
 
+async def capture_profile(request: web.Request) -> web.Response:
+    """POST /v1/profile — capture a JAX device-profiler trace while serving
+    continues (SURVEY.md section 5.1: adds the low-level profiler the
+    reference lacks; OTel request tracing stays separate).  Body:
+    ``{"duration_ms": 1000, "out_dir": "/tmp/..."}`` (both optional;
+    out_dir must live under the system temp dir — traces are written as
+    the service user, so arbitrary paths are rejected)."""
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    core = getattr(engine.backend, "core", None) if engine else None
+    if core is None or not hasattr(core, "capture_profile"):
+        return _error(
+            409,
+            "profiling requires the jax_tpu engine",
+            "invalid_request_error",
+        )
+    try:
+        raw = await request.json() if request.can_read_body else {}
+    except ValueError:
+        raw = {}
+    if not isinstance(raw, dict):
+        return _error(
+            422, "body must be a JSON object", "invalid_request_error"
+        )
+    try:
+        duration_s = float(raw.get("duration_ms", 1000)) / 1000.0
+    except (TypeError, ValueError):
+        return _error(
+            422, "duration_ms must be a number", "invalid_request_error"
+        )
+    out_dir = raw.get("out_dir")
+    if out_dir is not None:
+        tmp_root = os.path.realpath(tempfile.gettempdir())
+        resolved = os.path.realpath(str(out_dir))
+        if not resolved.startswith(tmp_root + os.sep):
+            return _error(
+                422,
+                f"out_dir must be under {tmp_root}",
+                "invalid_request_error",
+            )
+        out_dir = resolved
+    # lock lives in app state: a module-level asyncio.Lock would bind to
+    # the first event loop that touches it and break across app restarts
+    lock: asyncio.Lock = request.app["profile_lock"]
+    if lock.locked():
+        return _error(
+            409, "a profile capture is already running",
+            "invalid_request_error",
+        )
+    async with lock:
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(
+            None, lambda: core.capture_profile(duration_s, out_dir)
+        )
+    return web.json_response(result)
+
+
 async def _on_startup(app: web.Application) -> None:
     config: VGTConfig = app["config"]
+    app["profile_lock"] = asyncio.Lock()
     init_tracing(config)
     # pin the JAX platform before the first device touch (some TPU plugins
     # override the JAX_PLATFORMS env var, so the config knob is the only
@@ -411,6 +470,7 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     app.router.add_get("/metrics", prometheus_metrics)
     app.router.add_get("/stats", get_stats)
     app.router.add_post("/v1/benchmark", run_benchmark)
+    app.router.add_post("/v1/profile", capture_profile)
     app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)
     return app
