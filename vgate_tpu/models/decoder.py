@@ -195,9 +195,19 @@ def prefill_forward(
     k_pages: jnp.ndarray,  # [L, KV, P, ps, hd] (head-major, kv_cache.py)
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, S // ps] page ids for this prompt
+    mesh=None,  # jax.sharding.Mesh; sp>1 routes attention through the ring
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Run the prompt pass: returns (last-token logits [B, V], k_pages, v_pages)."""
+    """Run the prompt pass: returns (last-token logits [B, V], k_pages, v_pages).
+
+    With a mesh whose ``sp`` axis is >1, attention runs sequence-parallel:
+    each sp shard computes its query block and KV blocks rotate over ICI
+    (parallel/ring_attention.py) — the long-context path (SURVEY.md
+    section 5.7, absent in the reference).  ``S`` must divide by sp.
+    """
     B, S = tokens.shape
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_ring:
+        from vgate_tpu.parallel.ring_attention import ring_prefill_attention
     ps = k_pages.shape[3]
     n_pages = S // ps
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
@@ -223,7 +233,10 @@ def prefill_forward(
         pt = page_tables[:, :n_pages]
         k_pages_l = k_pages_l.at[:, pt].set(k_resh)
         v_pages_l = v_pages_l.at[:, pt].set(v_resh)
-        attn = causal_prefill_attention(q, k, v, seq_lens)
+        if use_ring:
+            attn = ring_prefill_attention(q, k, v, seq_lens, mesh)
+        else:
+            attn = causal_prefill_attention(q, k, v, seq_lens)
         attn = attn.reshape(B, S, spec.q_dim)
         h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
         normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)

@@ -69,13 +69,18 @@ _DTYPES = {
 }
 
 
-@functools.partial(jax.jit, static_argnames=("spec",), donate_argnames=("k_pages", "v_pages"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("spec", "mesh"),
+    donate_argnames=("k_pages", "v_pages"),
+)
 def _prefill_step(
     params, spec: ModelSpec, tokens, seq_lens, k_pages, v_pages,
-    page_tables, temps, top_ps, top_ks, key,
+    page_tables, temps, top_ps, top_ks, key, mesh=None,
 ):
     logits, k_pages, v_pages = prefill_forward(
-        params, spec, tokens, seq_lens, k_pages, v_pages, page_tables
+        params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
+        mesh=mesh,
     )
     next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
     return next_tokens, k_pages, v_pages
@@ -242,6 +247,20 @@ class EngineCore:
         self._pending_chunks: list = []
         self.decode_chunk = max(1, tpu_cfg.decode_chunk)
         self.pipeline_depth = max(1, tpu_cfg.decode_pipeline)
+
+        # sp>1: prefill attention runs sequence-parallel (ring attention
+        # over the sp axis); buckets must then split evenly across shards
+        sp_size = int(self.mesh.shape.get("sp", 1))
+        self._sp_mesh = self.mesh if sp_size > 1 else None
+        if sp_size > 1:
+            bad = [
+                b for b in self.scheduler.prefill_buckets if b % sp_size
+            ]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} not divisible by sp={sp_size}; "
+                    "ring-attention prefill shards the sequence axis evenly"
+                )
 
         # Pallas kernels require a real TPU backend (tests run interpret-mode
         # kernels separately; the engine's jnp twins serve CPU meshes)
@@ -529,6 +548,7 @@ class EngineCore:
             jnp.asarray([sp.top_p], jnp.float32),
             jnp.asarray([sp.top_k], jnp.int32),
             self._step_key(),
+            mesh=self._sp_mesh,
         )
         return next_tokens
 
