@@ -80,3 +80,73 @@ def test_paged_decode_kernel_bf16():
         rtol=2e-2,
         atol=2e-2,
     )
+
+
+# ---------------------------------------------------------- flash prefill
+
+def _prefill_case(B=2, S=256, H=4, KV=2, hd=128, seed=0, lens=None):
+    from vgate_tpu.ops.attention import causal_prefill_attention
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    if lens is None:
+        lens = rng.integers(1, S + 1, size=B)
+    seq_lens = jnp.asarray(lens, jnp.int32)
+    expect = causal_prefill_attention(q, k, v, seq_lens)
+    return q, k, v, seq_lens, expect
+
+
+@pytest.mark.parametrize("lens", [None, [1, 256], [255, 130]])
+def test_flash_prefill_kernel_matches_oracle(lens):
+    from vgate_tpu.ops.pallas.flash_prefill import (
+        flash_prefill_attention_pallas,
+    )
+
+    q, k, v, seq_lens, expect = _prefill_case(
+        lens=lens, seed=7 if lens is None else 8
+    )
+    got = flash_prefill_attention_pallas(
+        q, k, v, seq_lens, block_q=128, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_prefill_kernel_serving_bucket_1024():
+    """Parity at a serving-sized bucket (VERDICT r1 item 2)."""
+    from vgate_tpu.ops.pallas.flash_prefill import (
+        flash_prefill_attention_pallas,
+    )
+
+    q, k, v, seq_lens, expect = _prefill_case(
+        B=1, S=1024, H=2, KV=1, hd=64, seed=9, lens=[900]
+    )
+    got = flash_prefill_attention_pallas(
+        q, k, v, seq_lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_prefill_kernel_gqa_and_offset():
+    """GQA group mapping + chunked-prefill q_offset: a 128-row query chunk
+    at global offset 128 must reproduce rows [128:256] of the full pass."""
+    from vgate_tpu.ops.pallas.flash_prefill import (
+        flash_prefill_attention_pallas,
+    )
+
+    q, k, v, seq_lens, expect = _prefill_case(
+        B=1, S=256, H=8, KV=4, seed=10, lens=[256]
+    )
+    got = flash_prefill_attention_pallas(
+        q[:, 128:], k, v, seq_lens,
+        q_offsets=jnp.asarray([128], jnp.int32),
+        block_q=128, block_k=128, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect[:, 128:]), rtol=2e-5, atol=2e-5
+    )

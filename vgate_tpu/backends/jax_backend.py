@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import os
 import threading
 from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
 
@@ -48,11 +49,26 @@ class Embedder:
         if not self.spec.is_encoder:
             raise ValueError(f"{model_id} is not an encoder model")
         self.tokenizer = get_tokenizer(self.spec, checkpoint_path)
-        self.params = init_encoder_params(
-            self.spec, jax.random.PRNGKey(0), dtype
-        )
-        # TODO(checkpoints): load bge safetensors via
-        # encoder_params_from_torch_state_dict when a local path is configured.
+        if checkpoint_path and os.path.isdir(checkpoint_path):
+            from vgate_tpu.models.encoder import (
+                encoder_params_from_safetensors,
+            )
+
+            self.params = encoder_params_from_safetensors(
+                self.spec, checkpoint_path, dtype
+            )
+        else:
+            # zero-egress fallback: architecturally real, semantically
+            # meaningless vectors (logged so operators can't mistake them
+            # for bge embeddings)
+            logger.warning(
+                "no embedding checkpoint found; using random-init weights",
+                extra={"extra_data": {"model": model_id,
+                                      "path": checkpoint_path}},
+            )
+            self.params = init_encoder_params(
+                self.spec, jax.random.PRNGKey(0), dtype
+            )
         self._forward = jax.jit(
             functools.partial(encode_forward, spec=self.spec)
         )

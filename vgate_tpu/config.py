@@ -147,6 +147,11 @@ class TPUConfig(BaseModel):
     # Keep up to `decode_pipeline` chunks in flight before blocking on the
     # oldest readback (overlaps host processing with device execution).
     decode_pipeline: int = 2
+    # Max prefills admitted per engine tick WHILE sequences are decoding
+    # (0 = unlimited).  Bounds the decode stall a prefill burst can cause:
+    # resident slots get a decode chunk between every `prefill_admit_limit`
+    # prompt programs instead of waiting out the whole burst.
+    prefill_admit_limit: int = 2
 
 
 class BatchConfig(BaseModel):

@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 
 from vgate_tpu.models.specs import ModelSpec
-from vgate_tpu.ops.attention import causal_prefill_attention, paged_decode_attention
+from vgate_tpu.ops.attention import (
+    flash_prefill_attention,
+    paged_decode_attention,
+)
 from vgate_tpu.ops.norms import rms_norm
 from vgate_tpu.ops.quant import weighted_einsum
 from vgate_tpu.ops.rope import apply_rope
@@ -196,18 +199,27 @@ def prefill_forward(
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, S // ps] page ids for this prompt
     mesh=None,  # jax.sharding.Mesh; sp>1 routes attention through the ring
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run the prompt pass: returns (last-token logits [B, V], k_pages, v_pages).
 
-    With a mesh whose ``sp`` axis is >1, attention runs sequence-parallel:
-    each sp shard computes its query block and KV blocks rotate over ICI
-    (parallel/ring_attention.py) — the long-context path (SURVEY.md
-    section 5.7, absent in the reference).  ``S`` must divide by sp.
+    Attention is flash-style on every path — blockwise online softmax, no
+    [B,H,S,S] score materialization: the Pallas kernel
+    (ops/pallas/flash_prefill.py) when ``use_pallas``, the jnp blockwise
+    twin otherwise.  With a mesh whose ``sp`` axis is >1, attention runs
+    sequence-parallel instead: each sp shard computes its query block and KV
+    blocks rotate over ICI (parallel/ring_attention.py) — the long-context
+    path (SURVEY.md section 5.7, absent in the reference).  ``S`` must
+    divide by sp.
     """
     B, S = tokens.shape
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
         from vgate_tpu.parallel.ring_attention import ring_prefill_attention
+    elif use_pallas:
+        from vgate_tpu.ops.pallas.flash_prefill import (
+            flash_prefill_attention_pallas,
+        )
     ps = k_pages.shape[3]
     n_pages = S // ps
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
@@ -235,8 +247,10 @@ def prefill_forward(
         v_pages_l = v_pages_l.at[:, pt].set(v_resh)
         if use_ring:
             attn = ring_prefill_attention(q, k, v, seq_lens, mesh)
+        elif use_pallas:
+            attn = flash_prefill_attention_pallas(q, k, v, seq_lens)
         else:
-            attn = causal_prefill_attention(q, k, v, seq_lens)
+            attn = flash_prefill_attention(q, k, v, seq_lens)
         attn = attn.reshape(B, S, spec.q_dim)
         h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
         normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)

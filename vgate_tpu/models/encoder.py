@@ -122,12 +122,30 @@ def encode_forward(
 
 
 def encoder_params_from_torch_state_dict(spec: ModelSpec, state_dict, dtype=jnp.float32):
-    """Map HF BertModel weights into the encoder pytree (parity tests +
-    local bge checkpoints)."""
-    import numpy as np
+    """Map HF BertModel weights into the encoder pytree (parity tests)."""
 
     def get(name):
         return state_dict[name].detach().to("cpu").float().numpy()
+
+    return encoder_params_from_getter(spec, get, dtype)
+
+
+def encoder_params_from_safetensors(
+    spec: ModelSpec, checkpoint_path: str, dtype=jnp.float32
+):
+    """Load a local BertModel-family (bge) safetensors checkpoint — the
+    real-weights path for /v1/embeddings (the reference's embeddings are a
+    mock ramp vector, vgate/engine.py:93-111; SURVEY.md section 3.3 calls
+    this out as a capability gap to fill)."""
+    from vgate_tpu.runtime.weights import safetensors_getter
+
+    getter, _files = safetensors_getter(checkpoint_path)
+    return encoder_params_from_getter(spec, getter, dtype)
+
+
+def encoder_params_from_getter(spec: ModelSpec, get, dtype=jnp.float32):
+    """Assemble the encoder pytree from HF ``BertModel``-named tensors."""
+    import numpy as np
 
     def stack(template, transpose=False):
         arrs = [get(template.format(i)) for i in range(spec.num_layers)]

@@ -1,0 +1,187 @@
+"""Pallas flash-attention prefill kernel.
+
+Completes the kernel pair the reference gets opaquely from vLLM (SURVEY.md
+section 2.1): ``paged_attention.py`` covers decode, this kernel covers the
+prompt pass.  Semantics are pinned by the jnp oracle
+``vgate_tpu.ops.attention.causal_prefill_attention`` (and its blockwise twin
+``flash_prefill_attention``); the kernel's advantage is that no score matrix
+ever exists in HBM — each (batch, head, q-block) program streams key/value
+blocks through VMEM with an online-softmax accumulator, so peak memory is
+O(block_q · block_k) per core instead of the O(S²) per-head score
+materialization of the naive path (~200 MB fp32 at the 2048 bucket).
+
+Grid: ``(B, H, n_q_blocks, n_k_blocks)`` with the key-block axis innermost —
+TPU grids execute sequentially over the trailing axis, so the accumulator
+lives in VMEM scratch across the k-sweep of one q-block.  Causally dead
+k-blocks (entirely above the diagonal) skip their compute via ``pl.when``.
+
+Supports chunked prefill via ``q_offsets``: the query rows may start at a
+nonzero global position while keys cover the context from position 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(
+    # scalar prefetch (SMEM)
+    seq_lens_ref,  # [B] int32 — real key length per batch row
+    q_offsets_ref,  # [B] int32 — global position of query row 0
+    # inputs (VMEM blocks)
+    q_ref,  # [1, 1, block_q, hd]
+    k_ref,  # [1, 1, block_k, hd]
+    v_ref,  # [1, 1, block_k, hd]
+    # output
+    out_ref,  # [1, 1, block_q, hd]
+    # scratch
+    acc_ref,  # [block_q, hd] f32
+    m_ref,  # [block_q, 128] f32 running max (column-broadcast)
+    l_ref,  # [block_q, 128] f32 running denom
+    *,
+    block_q: int,
+    block_k: int,
+    n_k: int,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    seq_len = seq_lens_ref[b]
+    q_off = q_offsets_ref[b]
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # global positions of this block's queries and keys
+    q_start = q_off + qi * block_q
+    k_start = ki * block_k
+
+    # a k-block strictly above the causal diagonal contributes nothing
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _():
+        hd = q_ref.shape[-1]
+        scale = 1.0 / (hd ** 0.5)
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0
+        )
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        mask = (k_pos <= q_pos) & (k_pos < seq_len)
+        scores = jnp.where(mask, scores, -1e30)
+
+        m_prev = m_ref[:, :1]  # [block_q, 1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)  # [block_q, block_k]
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        out_ref[0, 0] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_prefill_attention_pallas(
+    q: jnp.ndarray,  # [B, S, H, hd]
+    k: jnp.ndarray,  # [B, Sk, KV, hd]
+    v: jnp.ndarray,  # [B, Sk, KV, hd]
+    seq_lens: jnp.ndarray,  # [B] real key lengths
+    q_offsets: jnp.ndarray | None = None,  # [B] global pos of q[:, 0]
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal (optionally offset) attention. Returns [B, S, H, hd]."""
+    B, S, H, hd = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    if S % block_q or Sk % block_k:
+        raise ValueError(
+            f"S={S}/Sk={Sk} must divide block_q={block_q}/block_k={block_k}"
+        )
+    n_q, n_k = S // block_q, Sk // block_k
+    if q_offsets is None:
+        q_offsets = jnp.zeros((B,), jnp.int32)
+
+    # head-major layout so each block's trailing dims are (seq_block, hd)
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, hd]
+    kt = jnp.transpose(k, (0, 2, 1, 3))  # [B, KV, Sk, hd]
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    kernel = functools.partial(
+        _kernel, block_q=block_q, block_k=block_k, n_k=n_k
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, hd),
+                lambda b, h, qi, ki, *pf: (b, h, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda b, h, qi, ki, *pf: (b, h // G, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda b, h, qi, ki, *pf: (b, h // G, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, hd),
+            lambda b, h, qi, ki, *pf: (b, h, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+    )(seq_lens.astype(jnp.int32), q_offsets.astype(jnp.int32), qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))

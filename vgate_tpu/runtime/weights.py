@@ -94,7 +94,13 @@ def params_from_getter(
     }
     if not spec.tie_embeddings:
         params["lm_head"] = np.asarray(getter("lm_head.weight")).T
-    return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+    # Stay on the HOST: leaves are numpy (bf16 via ml_dtypes), so the single
+    # device placement happens later at parallel/sharding.shard_params —
+    # jax.device_put(np_leaf, NamedSharding) transfers each mesh shard
+    # directly, never materializing a full replica in HBM (a 7B bf16
+    # replica would OOM a 16 GB v5e chip before sharding could fix it).
+    np_dtype = np.dtype(dtype)
+    return jax.tree.map(lambda x: np.asarray(x).astype(np_dtype), params)
 
 
 def params_from_torch_state_dict(
@@ -110,13 +116,12 @@ def params_from_torch_state_dict(
     return params_from_getter(spec, getter, dtype)
 
 
-def params_from_safetensors(
-    spec: ModelSpec,
-    checkpoint_path: str,
-    dtype=jnp.bfloat16,
-    device_put_fn: Optional[Callable[[np.ndarray, str], jax.Array]] = None,
-) -> Params:
-    """Load from a local directory of ``*.safetensors`` shards."""
+def safetensors_getter(checkpoint_path: str):
+    """Index every ``*.safetensors`` shard under a directory.
+
+    Returns ``(getter, files)`` — the getter resolves an HF tensor name to a
+    host numpy array, tolerating an optional model prefix (e.g. ``bert.``)
+    in the stored names."""
     from safetensors import safe_open
 
     files = sorted(
@@ -133,13 +138,32 @@ def params_from_safetensors(
     for handle in handles:
         for name in handle.keys():
             index[name] = handle
+    prefixes = ("", "model.", "bert.")
 
     def getter(name: str) -> np.ndarray:
         if name not in index:
-            # tied-embedding checkpoints omit lm_head
-            raise KeyError(f"tensor {name} missing from checkpoint")
+            for p in prefixes:
+                if p + name in index:
+                    name = p + name
+                    break
+            else:
+                # e.g. tied-embedding checkpoints omit lm_head
+                raise KeyError(f"tensor {name} missing from checkpoint")
         return index[name].get_tensor(name)
 
+    return getter, files
+
+
+def params_from_safetensors(
+    spec: ModelSpec,
+    checkpoint_path: str,
+    dtype=jnp.bfloat16,
+) -> Params:
+    """Load from a local directory of ``*.safetensors`` shards.
+
+    Returns HOST numpy leaves; the engine's ``shard_params`` performs the
+    one and only device placement with each tensor's NamedSharding."""
+    getter, files = safetensors_getter(checkpoint_path)
     params = params_from_getter(spec, getter, dtype)
     logger.info(
         "checkpoint loaded",

@@ -409,16 +409,21 @@ async def capture_profile(request: web.Request) -> web.Response:
     # lock lives in app state: a module-level asyncio.Lock would bind to
     # the first event loop that touches it and break across app restarts
     lock: asyncio.Lock = request.app["profile_lock"]
+    # acquire non-blocking: a concurrent capture must get an immediate 409,
+    # never queue behind a running (up to 60 s) whole-process trace
     if lock.locked():
         return _error(
             409, "a profile capture is already running",
             "invalid_request_error",
         )
-    async with lock:
+    await lock.acquire()
+    try:
         loop = asyncio.get_running_loop()
         result = await loop.run_in_executor(
             None, lambda: core.capture_profile(duration_s, out_dir)
         )
+    finally:
+        lock.release()
     return web.json_response(result)
 
 
