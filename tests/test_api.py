@@ -210,3 +210,36 @@ async def test_secured_gateway_end_to_end():
         assert (await client.get("/health")).status == 200
     finally:
         await client.close()
+
+
+async def test_chat_completion_accepts_stop_and_seed():
+    """`stop` (bare string or list) and `seed` are part of the request
+    schema and of the cache identity — a seeded request must not hit the
+    cache entry of an unseeded one."""
+    client = await _client()
+    try:
+        base = {
+            "messages": [{"role": "user", "content": "stop/seed probe"}],
+            "max_tokens": 8,
+        }
+        r1 = await client.post("/v1/chat/completions", json=base)
+        assert r1.status == 200
+        for extra in (
+            {"stop": "\n"},
+            {"stop": ["\n", "User:"]},
+            {"seed": 42},
+        ):
+            resp = await client.post(
+                "/v1/chat/completions", json={**base, **extra}
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            # different sampling identity => no cache hit from `base`
+            assert body["cached"] is False
+        # identical seeded request does hit the cache
+        resp = await client.post(
+            "/v1/chat/completions", json={**base, "seed": 42}
+        )
+        assert (await resp.json())["cached"] is True
+    finally:
+        await client.close()

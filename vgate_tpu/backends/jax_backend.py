@@ -178,7 +178,7 @@ class JaxTPUBackend:
         for seq in seqs:
             if seq.status is SeqStatus.FAILED:
                 raise seq.error  # type: ignore[misc]
-            text = self.core.tokenizer.decode(seq.generated_ids)
+            text = self.core.final_text(seq)
             results.append(
                 GenerationResult(
                     text=text,
@@ -216,12 +216,31 @@ class JaxTPUBackend:
 
         emitted = ""
         ids: List[int] = []
+        stops = params.stop or []
+        longest_stop = max((len(s) for s in stops), default=0)
         while True:
             token = await q.get()
             if token is None:
+                # flush the held-back tail: the engine's own stop detection
+                # is authoritative (final_text truncates at a stop match)
+                final = self.core.final_text(seq)
+                if len(final) > len(emitted):
+                    yield final[len(emitted):]
                 break
             ids.append(token)
             text = self.core.tokenizer.decode(ids)
+            if stops:
+                cut = min(
+                    (i for i in (text.find(s) for s in stops) if i != -1),
+                    default=-1,
+                )
+                if cut >= 0:
+                    if cut > len(emitted):
+                        yield text[len(emitted):cut]
+                    break
+                # hold back a stop-length tail so a stop string arriving
+                # across several tokens is never partially emitted
+                text = text[: max(len(emitted), len(text) - longest_stop)]
             if len(text) > len(emitted):
                 delta = text[len(emitted):]
                 emitted = text

@@ -122,6 +122,8 @@ class RequestBatcher:
         temperature: Optional[float] = None,
         top_p: Optional[float] = None,
         top_k: Optional[int] = None,
+        stop: Optional[List[str]] = None,
+        seed: Optional[int] = None,
         request_id: Optional[str] = None,
     ) -> Dict[str, Any]:
         inf = self.config.inference
@@ -132,6 +134,8 @@ class RequestBatcher:
             ),
             top_p=top_p if top_p is not None else inf.top_p,
             top_k=top_k if top_k is not None else inf.top_k,
+            stop=stop,
+            seed=seed,
         )
         with tracer.start_as_current_span("batcher.submit"):
             self._total_requests += 1
@@ -141,6 +145,8 @@ class RequestBatcher:
                 params.top_p,
                 params.max_tokens,
                 params.top_k,
+                stop=params.stop,
+                seed=params.seed,
             )
             cached = await self.cache.get(cache_key)
             if cached is not None:

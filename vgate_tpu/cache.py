@@ -12,7 +12,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 from collections import OrderedDict
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from vgate_tpu import metrics
 from vgate_tpu.tracing import get_tracer
@@ -31,16 +31,22 @@ class ResultCache:
         self._evictions = 0
 
     @staticmethod
-    def make_key(
+    def make_key(  # noqa: PLR0913 — mirrors the sampling surface
         prompt: str,
         temperature: float,
         top_p: float,
         max_tokens: int,
         top_k: int = 0,
+        stop: Optional[List[str]] = None,
+        seed: Optional[int] = None,
     ) -> str:
-        """Stable digest over the request-identity fields
-        (reference: vgate/cache.py:48-56; top_k added for the TPU sampler)."""
-        blob = f"{prompt}|{temperature}|{top_p}|{max_tokens}|{top_k}"
+        """Stable digest over the request-identity fields (reference:
+        vgate/cache.py:48-56; top_k/stop/seed added for the TPU sampler —
+        they change the result, so they must change the key)."""
+        blob = (
+            f"{prompt}|{temperature}|{top_p}|{max_tokens}|{top_k}"
+            f"|{stop or []}|{seed}"
+        )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     async def get(self, key: str) -> Optional[Any]:

@@ -28,8 +28,20 @@ def sample_tokens(
     top_p: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32, 0 => disabled
     key: jax.Array,
+    seeds: jnp.ndarray | None = None,  # [B] int32, -1 => unseeded
+    steps: jnp.ndarray | None = None,  # [B] int32 per-seq sample index
 ) -> jnp.ndarray:
-    """Sample one token per slot honoring per-slot params. Returns [B] int32."""
+    """Sample one token per slot honoring per-slot params. Returns [B] int32.
+
+    When ``seeds``/``steps`` are given, a slot with ``seed >= 0`` draws its
+    gumbel noise from ``fold_in(PRNGKey(seed), step)`` — a function of the
+    request's seed and its per-sequence token index only, so the same seed
+    reproduces the same tokens regardless of batch composition, engine step
+    count, or preemption (the reference exposes vLLM's per-request ``seed``,
+    vgate/backends/vllm_backend.py:39-46).  Unseeded slots fold the slot
+    index into the engine's step key.  ``key`` must be a legacy uint32[2]
+    key (``jax.random.PRNGKey``) so keys can be selected with ``where``.
+    """
     B, V = logits.shape
     trunc = min(TRUNC, V)
     logits32 = logits.astype(jnp.float32)
@@ -52,7 +64,24 @@ def sample_tokens(
     mask = k_mask & p_mask
     masked = jnp.where(mask, scaled, -1e30)
 
-    gumbel = jax.random.gumbel(key, (B, trunc), dtype=jnp.float32)
+    if seeds is None:
+        gumbel = jax.random.gumbel(key, (B, trunc), dtype=jnp.float32)
+    else:
+        def slot_key(seed, step, slot):
+            seeded = jax.random.fold_in(
+                jax.random.PRNGKey(seed.astype(jnp.uint32)), step
+            )
+            unseeded = jax.random.fold_in(key, slot)
+            return jnp.where(seed >= 0, seeded, unseeded)
+
+        slot_keys = jax.vmap(slot_key)(
+            seeds,
+            jnp.zeros((B,), jnp.int32) if steps is None else steps,
+            jnp.arange(B, dtype=jnp.int32),
+        )
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (trunc,), dtype=jnp.float32)
+        )(slot_keys)
     sampled_pos = jnp.argmax(masked + gumbel, axis=-1)  # [B]
 
     greedy = temperature <= _GREEDY_EPS

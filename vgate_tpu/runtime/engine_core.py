@@ -77,12 +77,15 @@ _DTYPES = {
 def _prefill_step(
     params, spec: ModelSpec, tokens, seq_lens, k_pages, v_pages,
     page_tables, temps, top_ps, top_ks, key, mesh=None, use_pallas=False,
+    seeds=None, steps=None,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
         mesh=mesh, use_pallas=use_pallas,
     )
-    next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
+    next_tokens = sample_tokens(
+        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
+    )
     return next_tokens, k_pages, v_pages
 
 
@@ -93,7 +96,7 @@ def _decode_step(
 ):
     """One decode step — thin wrapper over ``_decode_chunk(num_steps=1)``
     kept for single-step callers (e.g. __graft_entry__.dryrun_multichip)."""
-    chunk_tokens, _tokens, positions, counter, k_pages, v_pages = (
+    chunk_tokens, _tokens, positions, counter, _steps, k_pages, v_pages = (
         _decode_chunk(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active, temps, top_ps, top_ks, base_key, counter,
@@ -112,6 +115,7 @@ def _decode_chunk(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
     page_tables, active, temps, top_ps, top_ks, base_key, counter,
     num_steps: int = 1, use_pallas=False, max_position: int = 0,
+    seeds=None, steps=None,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -125,33 +129,39 @@ def _decode_chunk(
     ``[num_steps, B]`` plus the threaded device state.
     """
 
+    if steps is None:
+        steps = jnp.zeros_like(positions)
+
     def body(carry, _):
-        tokens, positions, counter, k_pages, v_pages = carry
+        tokens, positions, counter, steps, k_pages, v_pages = carry
         key = jax.random.fold_in(base_key, counter)
         logits, k_pages, v_pages = decode_forward(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active=active, use_pallas=use_pallas,
         )
-        next_tokens = sample_tokens(logits, temps, top_ps, top_ks, key)
+        next_tokens = sample_tokens(
+            logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
+        )
         positions = positions + active.astype(positions.dtype)
+        steps = steps + active.astype(steps.dtype)
         if max_position > 0:
             # overshoot steps (chunk sized by MAX headroom across slots) must
             # stay in-bounds: on the Pallas path seq_len = position+1 drives
             # the page loop, and past max_pages the DMA reads are undefined
             # rather than clamped like XLA gathers
             positions = jnp.minimum(positions, max_position)
-        return (next_tokens, positions, counter + 1, k_pages, v_pages), (
-            next_tokens
-        )
+        return (
+            next_tokens, positions, counter + 1, steps, k_pages, v_pages
+        ), next_tokens
 
     carry, chunk_tokens = jax.lax.scan(
         body,
-        (tokens, positions, counter, k_pages, v_pages),
+        (tokens, positions, counter, steps, k_pages, v_pages),
         None,
         length=num_steps,
     )
-    tokens, positions, counter, k_pages, v_pages = carry
-    return chunk_tokens, tokens, positions, counter, k_pages, v_pages
+    tokens, positions, counter, steps, k_pages, v_pages = carry
+    return chunk_tokens, tokens, positions, counter, steps, k_pages, v_pages
 
 
 class EngineCore:
@@ -345,7 +355,7 @@ class EngineCore:
             seq.done_event.wait()
             if seq.status is SeqStatus.FAILED:
                 raise seq.error  # type: ignore[misc]
-            text = self.tokenizer.decode(seq.generated_ids)
+            text = self.final_text(seq)
             gen_time = (seq.finish_t or 0) - seq.arrival_t
             n = seq.num_output_tokens
             results.append(
@@ -571,6 +581,13 @@ class EngineCore:
             self._step_key(),
             mesh=self._sp_mesh,
             use_pallas=self.use_pallas,
+            # per-request seed: token i always draws from (seed, i) — the
+            # prefill samples token index num_generated (0 fresh, >0 after
+            # a preemption recompute)
+            seeds=jnp.asarray(
+                [sp.seed if sp.seed is not None else -1], jnp.int32
+            ),
+            steps=jnp.asarray([seq.num_generated], jnp.int32),
         )
         return next_tokens
 
@@ -600,6 +617,8 @@ class EngineCore:
         temps = np.zeros((B,), np.float32)
         top_ps = np.ones((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
+        seeds = np.full((B,), -1, np.int32)
+        steps = np.zeros((B,), np.int32)
         for seq in seqs:
             slot = seq.slot
             assert slot is not None
@@ -612,6 +631,9 @@ class EngineCore:
             temps[slot] = seq.params.temperature
             top_ps[slot] = seq.params.top_p
             top_ks[slot] = seq.params.top_k
+            if seq.params.seed is not None:
+                seeds[slot] = seq.params.seed
+            steps[slot] = seq.num_generated
         self._dec_state = {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
@@ -620,6 +642,8 @@ class EngineCore:
             "temps": jnp.asarray(temps),
             "top_ps": jnp.asarray(top_ps),
             "top_ks": jnp.asarray(top_ks),
+            "seeds": jnp.asarray(seeds),
+            "steps": jnp.asarray(steps),
             "counter": jnp.asarray(self._step_counter, jnp.uint32),
         }
 
@@ -667,6 +691,7 @@ class EngineCore:
             state["tokens"],
             state["positions"],
             state["counter"],
+            state["steps"],
             self.k_pages,
             self.v_pages,
         ) = _decode_chunk(
@@ -686,6 +711,8 @@ class EngineCore:
             num_steps=chunk,
             use_pallas=self.use_pallas,
             max_position=self.config.model.max_model_len - 1,
+            seeds=state["seeds"],
+            steps=state["steps"],
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -732,6 +759,8 @@ class EngineCore:
         reason = None
         if token == self.tokenizer.eos_id:
             reason = "stop"
+        elif self._hit_stop_string(seq):
+            reason = "stop"  # text_override truncated at the match
         elif seq.num_generated >= max(1, seq.params.max_tokens):
             reason = "length"
         elif seq.total_len >= self.config.model.max_model_len:
@@ -739,6 +768,45 @@ class EngineCore:
         if reason is not None:
             self.scheduler.remove(seq)
             seq.finish(reason)
+
+    def _hit_stop_string(self, seq: Sequence) -> bool:
+        """Host-side stop-sequence detection at token readback (the
+        reference delegates this to vLLM's ``SamplingParams.stop``,
+        vgate/backends/vllm_backend.py:39-46).
+
+        Cheap path first: decode only a tail window of tokens (a stop of L
+        chars spans at most L tokens plus the just-appended one) and
+        substring-match there; on a hit, decode the full generation once to
+        find the earliest match and truncate ``text_override`` before it.
+        Decode chunks may overshoot a stop; overshoot tokens remain in
+        ``generated_ids`` but never reach the final text.
+        """
+        stops = seq.params.stop
+        if not stops:
+            return False
+        longest = max(len(s) for s in stops)
+        window = min(len(seq.generated_ids), longest + 8)
+        tail = self.tokenizer.decode(seq.generated_ids[-window:])
+        if not any(s in tail for s in stops):
+            return False
+        text = self.tokenizer.decode(seq.generated_ids)
+        cut = min(
+            (i for i in (text.find(s) for s in stops) if i != -1),
+            default=-1,
+        )
+        if cut < 0:
+            # tail decode produced chars the full decode doesn't (BPE
+            # boundary artifact) — not a real match
+            return False
+        seq.text_override = text[:cut]
+        return True
+
+    def final_text(self, seq: Sequence) -> str:
+        """The request's final text: the stop-truncated override when a stop
+        sequence matched, else the full decoded generation."""
+        if seq.text_override is not None:
+            return seq.text_override
+        return self.tokenizer.decode(seq.generated_ids)
 
     # ------------------------------------------------------------- utilities
 

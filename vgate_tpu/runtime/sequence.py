@@ -44,6 +44,9 @@ class Sequence:
     stream_cb: Optional[Callable[[int], Any]] = None
     preempt_count: int = 0
     orig_prompt_len: int = 0
+    # set when a stop string matched: the final text truncated at the match
+    # (the raw generated_ids still contain the overshoot tokens)
+    text_override: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:

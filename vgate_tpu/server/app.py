@@ -153,6 +153,8 @@ async def chat_completions(request: web.Request) -> web.Response:
             temperature=payload.temperature,
             top_p=payload.top_p,
             top_k=payload.top_k,
+            stop=payload.stop_list(),
+            seed=payload.seed,
         )
     except Exception as exc:
         return _error(500, f"Inference failed: {exc}", "server_error")
@@ -226,6 +228,8 @@ async def _stream_chat(
                 if payload.top_k is not None
                 else engine.config.inference.top_k
             ),
+            stop=payload.stop_list(),
+            seed=payload.seed,
         )
         async for piece in stream_fn(prompt, params):
             await resp.write(_chunk({"content": piece}))
@@ -236,6 +240,8 @@ async def _stream_chat(
             temperature=payload.temperature,
             top_p=payload.top_p,
             top_k=payload.top_k,
+            stop=payload.stop_list(),
+            seed=payload.seed,
         )
         text = result["text"]
         step = max(1, len(text) // 16)

@@ -22,8 +22,17 @@ class ChatCompletionRequest(BaseModel):
     temperature: Optional[float] = None
     top_p: Optional[float] = None
     top_k: Optional[int] = None
+    stop: Optional[Union[str, List[str]]] = None
+    seed: Optional[int] = None
     stream: bool = False
     user: Optional[str] = None
+
+    def stop_list(self) -> Optional[List[str]]:
+        """OpenAI accepts a bare string or a list; normalize to a list."""
+        if self.stop is None:
+            return None
+        stops = [self.stop] if isinstance(self.stop, str) else self.stop
+        return [s for s in stops if s] or None
 
 
 class Usage(BaseModel):

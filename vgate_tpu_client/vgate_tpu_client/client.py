@@ -80,6 +80,8 @@ class _ChatResource:
         temperature: Optional[float] = None,
         top_p: Optional[float] = None,
         top_k: Optional[int] = None,
+        stop: Optional[Union[str, List[str]]] = None,
+        seed: Optional[int] = None,
         stream: bool = False,
     ):
         payload = ChatCompletionRequest(
@@ -89,6 +91,8 @@ class _ChatResource:
             temperature=temperature,
             top_p=top_p,
             top_k=top_k,
+            stop=stop,
+            seed=seed,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -207,6 +211,8 @@ class _AsyncChatResource:
         temperature: Optional[float] = None,
         top_p: Optional[float] = None,
         top_k: Optional[int] = None,
+        stop: Optional[Union[str, List[str]]] = None,
+        seed: Optional[int] = None,
         stream: bool = False,
     ):
         payload = ChatCompletionRequest(
@@ -216,6 +222,8 @@ class _AsyncChatResource:
             temperature=temperature,
             top_p=top_p,
             top_k=top_k,
+            stop=stop,
+            seed=seed,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
