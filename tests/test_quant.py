@@ -81,10 +81,39 @@ def test_quantized_weights_halve_memory():
     assert quant_proj < dense_proj * 0.6  # int8 vs bf16 + small scales
 
 
-def test_moe_quantization_rejected():
-    params = init_params(TINY_MOE, jax.random.PRNGKey(0), jnp.float32)
-    with pytest.raises(NotImplementedError):
-        quantize_decoder_params(params, TINY_MOE)
+def test_moe_quantization_close_to_fp32():
+    """MoE expert weights quantize per (layer, expert, out-channel); the
+    int8 logits must stay well within the fp32 logit spread."""
+    import numpy as np
+
+    from vgate_tpu.models.decoder import prefill_forward
+
+    spec = TINY_MOE
+    params = init_params(spec, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_decoder_params(params, spec)
+    # expert weights became QTensor with per-expert scales
+    qw = qparams["layers"]["gate"]["w"]
+    assert qw.scale.shape == (
+        spec.num_layers, spec.num_experts, spec.intermediate_size
+    )
+
+    B, S, ps = 1, 8, 4
+    n_pages = 1 + B * (S // ps)
+    shape = (spec.num_layers, spec.num_kv_heads, n_pages, ps, spec.head_dim)
+    tokens = jnp.asarray(np.arange(S)[None, :] % spec.vocab_size, jnp.int32)
+    seq_lens = jnp.asarray([S], jnp.int32)
+    pt = jnp.asarray(np.arange(S // ps)[None, :] + 1, jnp.int32)
+
+    def run(p):
+        logits, _, _ = prefill_forward(
+            p, spec, tokens, seq_lens,
+            jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32), pt,
+        )
+        return np.asarray(logits)
+
+    ref, quant = run(params), run(qparams)
+    spread = float(ref.max() - ref.min())
+    assert float(np.abs(ref - quant).max()) < 0.1 * spread
 
 
 def test_quantized_engine_end_to_end():

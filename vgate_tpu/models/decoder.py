@@ -113,15 +113,32 @@ def _dense_mlp(x, lp):
     )
 
 
-def _moe_mlp(x, lp, spec: ModelSpec, capacity_factor: float = 2.0):
-    """Top-k expert routing with capacity-bounded one-hot dispatch.
+def _expert_einsum(subscripts, x, w):
+    """Per-expert einsum accepting plain or quantized expert weights
+    (QTensor scale is per (expert, out-channel): [E, out] broadcasts as
+    [E, 1, out] against the [E, C, out] einsum result)."""
+    from vgate_tpu.ops.quant import QTensor
 
-    GShard-style dense dispatch: shardable on the ``ep`` mesh axis, where the
-    ``ecd`` tensors are sharded over experts and XLA emits the token
-    all-to-all (SURVEY.md section 2.2: ragged all-to-all dispatch is the
-    TPU-native replacement for the absent reference MoE path).
-    Overflowing tokens beyond capacity are dropped (their residual passes
-    through), the standard serving trade-off.
+    if isinstance(w, QTensor):
+        out = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
+        return out * w.scale[:, None, :].astype(x.dtype)
+    return jnp.einsum(subscripts, x, w)
+
+
+def _moe_mlp(x, lp, spec: ModelSpec, capacity_factor: float = 2.0):
+    """Top-k expert routing with sort-based ragged dispatch.
+
+    The ``T*K`` (token, expert-choice) assignments are sorted by expert id
+    and scattered into per-expert ``[E, capacity(+1 trash), D]`` buffers at
+    their position within the expert's group — O(T*K) gathers/scatters plus
+    the per-expert GEMMs, with **no [T, E, C] one-hot dispatch/combine
+    tensors** (the TPU-native replacement for the reference's absent MoE
+    path; SURVEY.md section 2.2 ragged dispatch).  The buffers keep a
+    leading E axis so ``ep`` sharding propagates into the expert GEMMs and
+    XLA emits the token all-to-all around the scatter/gather.  Tokens
+    overflowing an expert's capacity land in the trash column and are
+    dropped (their residual passes through), the standard serving
+    trade-off.
     """
     orig_shape = x.shape
     D = orig_shape[-1]
@@ -139,28 +156,36 @@ def _moe_mlp(x, lp, spec: ModelSpec, capacity_factor: float = 2.0):
     gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [T, K]
     gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
 
-    dispatch = jnp.zeros((T, E, capacity), jnp.bool_)
-    combine = jnp.zeros((T, E, capacity), jnp.float32)
-    fill = jnp.zeros((E,), jnp.int32)  # tokens already placed per expert
-    for j in range(K):  # K is a small static constant (2)
-        mask_j = jax.nn.one_hot(gate_idx[:, j], E, dtype=jnp.int32)  # [T, E]
-        pos_in_expert = jnp.cumsum(mask_j, axis=0) - mask_j + fill[None, :]
-        within = (pos_in_expert < capacity) & (mask_j > 0)
-        slot_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
-        contrib = slot_oh * within[..., None]
-        dispatch = dispatch | (contrib > 0)
-        combine = combine + contrib * gate_vals[:, j, None, None]
-        fill = fill + jnp.sum(mask_j * within, axis=0)
+    TK = T * K
+    flat_expert = gate_idx.reshape(TK)
+    flat_gate = gate_vals.reshape(TK)
+    flat_token = jnp.arange(TK, dtype=jnp.int32) // K
+    order = jnp.argsort(flat_expert, stable=True)
+    sorted_expert = flat_expert[order]
+    sorted_token = flat_token[order]
+    sorted_gate = flat_gate[order]
 
-    expert_in = jnp.einsum(
-        "tec,td->ecd", dispatch.astype(xt.dtype), xt
-    )  # [E, C, D]
-    gate_h = jnp.einsum("ecd,edf->ecf", expert_in, lp["gate"]["w"])
-    up_h = jnp.einsum("ecd,edf->ecf", expert_in, lp["up"]["w"])
+    counts = jnp.zeros((E,), jnp.int32).at[sorted_expert].add(1)
+    starts = jnp.cumsum(counts) - counts  # first sorted index per expert
+    pos = jnp.arange(TK, dtype=jnp.int32) - starts[sorted_expert]
+    within = pos < capacity
+
+    buf = jnp.zeros((E, capacity + 1, D), xt.dtype)
+    buf = buf.at[sorted_expert, jnp.minimum(pos, capacity)].set(
+        xt[sorted_token]
+    )
+    expert_in = buf[:, :capacity]  # [E, C, D]
+    gate_h = _expert_einsum("ecd,edf->ecf", expert_in, lp["gate"]["w"])
+    up_h = _expert_einsum("ecd,edf->ecf", expert_in, lp["up"]["w"])
     act = jax.nn.silu(gate_h.astype(jnp.float32)).astype(xt.dtype) * up_h
-    expert_out = jnp.einsum("ecf,efd->ecd", act, lp["down"]["w"])
-    out = jnp.einsum(
-        "tec,ecd->td", combine.astype(xt.dtype), expert_out
+    expert_out = _expert_einsum("ecf,efd->ecd", act, lp["down"]["w"])
+
+    contrib = expert_out[sorted_expert, jnp.minimum(pos, capacity - 1)]
+    contrib = jnp.where(within[:, None], contrib, 0)
+    out = (
+        jnp.zeros((T, D), xt.dtype)
+        .at[sorted_token]
+        .add(contrib * sorted_gate[:, None].astype(xt.dtype))
     )
     return out.reshape(orig_shape)
 

@@ -10,8 +10,9 @@ surrounding computation), cutting weight HBM traffic 2x (int8) or 4x
 
 Every weight in the decoder layout keeps its output dim LAST, so one
 broadcast rule covers q/k/v/o/gate/up/down and lm_head.  MoE expert weights
-keep bf16 for now (per-expert scale broadcasting differs); dense models
-quantize fully.
+[L, E, in, out] quantize per (layer, expert, out-channel) and dequantize
+inside the per-expert GEMMs (models/decoder.py _expert_einsum); the router
+stays fp32 (it is tiny and drives top-k selection).
 """
 
 from __future__ import annotations
@@ -60,6 +61,20 @@ def quantize_stacked(w: jnp.ndarray, bits: int = 8) -> QTensor:
     return QTensor(q=q, scale=scale)
 
 
+def quantize_expert_stacked(w: jnp.ndarray, bits: int = 8) -> QTensor:
+    """Quantize stacked MoE expert weights [L, E, in, out]: the scale is per
+    (layer, expert, out-channel) — reducing only the contracted ``in`` dim —
+    so each expert keeps its own dynamic range."""
+    dtype, qmax = _QDTYPES[bits]
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2)  # [L, E, out]
+    scale = jnp.maximum(absmax, 1e-8) / qmax
+    q = jnp.clip(
+        jnp.round(w32 / scale[..., None, :]), -qmax, qmax
+    ).astype(dtype)
+    return QTensor(q=q, scale=scale)
+
+
 def weighted_einsum(subscripts: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
     """einsum that accepts plain or quantized weights.
 
@@ -74,21 +89,23 @@ def weighted_einsum(subscripts: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
 
 
 def quantize_decoder_params(params: Any, spec, bits: int = 8) -> Any:
-    """Quantize the dense projection weights of a loaded (possibly sharded)
-    param pytree in place of their bf16 versions."""
-    if spec.is_moe:
-        raise NotImplementedError(
-            "weight quantization currently covers dense models; MoE expert "
-            "weights keep bf16"
-        )
+    """Quantize the projection weights of a loaded (possibly sharded) param
+    pytree in place of their bf16 versions.  Dense models quantize all seven
+    projections; MoE models quantize q/k/v/o per-channel and gate/up/down
+    per (expert, channel), leaving the tiny fp32 router exact."""
     out = {
         "embed": params["embed"],  # gathers stay high-precision
         "final_norm": params["final_norm"],
     }
     layers = dict(params["layers"])
-    for name in ("q", "k", "v", "o", "gate", "up", "down"):
+    for name in ("q", "k", "v", "o"):
         entry = dict(layers[name])
         entry["w"] = quantize_stacked(layers[name]["w"], bits)
+        layers[name] = entry
+    expert_quant = quantize_expert_stacked if spec.is_moe else quantize_stacked
+    for name in ("gate", "up", "down"):
+        entry = dict(layers[name])
+        entry["w"] = expert_quant(layers[name]["w"], bits)
         layers[name] = entry
     out["layers"] = layers
     if "lm_head" in params:
