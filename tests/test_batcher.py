@@ -233,3 +233,66 @@ async def test_graceful_shutdown_drains_queue():
     await batcher.stop()
     result = await asyncio.wait_for(task, timeout=2)
     assert result["text"] == "out:pending"
+
+
+class SettledBackend(CountingBackend):
+    """Backend with the settled path: prompts containing "FAIL" return an
+    exception object in place of their result."""
+
+    async def generate_settled_async(self, prompts, params):
+        self.calls.append(list(prompts))
+        out = []
+        for p in prompts:
+            if "FAIL" in p:
+                out.append(RuntimeError(f"shed:{p}"))
+            else:
+                out.append(
+                    GenerationResult(
+                        text=f"out:{p}", token_ids=[1], num_tokens=1,
+                        prompt_tokens=1,
+                        metrics={"ttft": 0.01, "gen_time": 0.02,
+                                 "tpot": 0.005},
+                    )
+                )
+        return out
+
+
+async def test_settled_failure_does_not_poison_batch():
+    """One failed request in a batch (e.g. deadline shed) fails only its
+    own future; co-batched requests keep their completions."""
+    batcher, backend = make_batcher(backend=SettledBackend())
+    await batcher.start()
+    try:
+        ok_task = asyncio.ensure_future(batcher.submit("good prompt"))
+        bad_task = asyncio.ensure_future(batcher.submit("FAIL prompt"))
+        ok2_task = asyncio.ensure_future(batcher.submit("also good"))
+        ok = await ok_task
+        ok2 = await ok2_task
+        with pytest.raises(RuntimeError, match="shed:FAIL prompt"):
+            await bad_task
+        assert ok["text"] == "out:good prompt"
+        assert ok2["text"] == "out:also good"
+        # the failed group was not cached: resubmitting re-runs inference
+        with pytest.raises(RuntimeError):
+            await batcher.submit("FAIL prompt")
+    finally:
+        await batcher.stop()
+
+
+async def test_submit_timeout_dequeues_abandoned_request():
+    """A request that times out while still queued is removed from the
+    queue — abandoned work must not occupy a future batch."""
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        # huge window + batch size: nothing fires without a manual trigger
+        batch={"max_batch_size": 64, "max_wait_time_ms": 60_000.0},
+    )
+    batcher, backend = make_batcher(config=config)
+    await batcher.start()
+    try:
+        with pytest.raises(asyncio.TimeoutError):
+            await batcher.submit("abandoned", timeout_s=0.05)
+        assert len(batcher._queue) == 0
+        assert batcher.get_metrics()["pending_requests"] == 0
+    finally:
+        await batcher.stop()

@@ -203,3 +203,87 @@ def test_prepare_decode_horizon_capped_by_budget():
     # only the page holding pos 4 (already needed for the kept step) counts
     assert alloc.num_used == used_before + 1
     assert len(seq.pages) == 2
+
+
+def test_admission_deadline_sheds_stale_requests():
+    """scheduler.admission_deadline_ms: queued requests older than the
+    deadline are failed with AdmissionDeadlineExceeded instead of admitted
+    (SURVEY.md section 5.3 load shedding); fresh requests still admit."""
+    import time
+
+    from vgate_tpu.runtime.scheduler import AdmissionDeadlineExceeded
+
+    alloc = PageAllocator(32)
+    sched = Scheduler(
+        allocator=alloc,
+        max_slots=4,
+        page_size=4,
+        prefill_buckets=[8],
+        max_model_len=64,
+        max_queue_size=8,
+        admission_deadline_ms=50.0,
+    )
+    stale = seq_of(4)
+    stale.arrival_t = time.perf_counter() - 1.0  # 1s in queue
+    fresh = seq_of(4)
+    sched.add(stale)
+    sched.add(fresh)
+    plan = sched.try_admit()
+    assert stale.status is SeqStatus.FAILED
+    assert isinstance(stale.error, AdmissionDeadlineExceeded)
+    assert isinstance(stale.error, EngineBusyError)  # maps to HTTP 503
+    assert plan is not None and plan.seq is fresh
+    assert sched.total_deadline_shed == 1
+    assert sched.get_stats()["deadline_shed"] == 1
+
+
+def test_admission_deadline_spares_preempted():
+    """A preempted sequence re-queued past the deadline must NOT be shed:
+    it was already admitted once and holds generated tokens."""
+    import time
+
+    alloc = PageAllocator(32)
+    sched = Scheduler(
+        allocator=alloc,
+        max_slots=4,
+        page_size=4,
+        prefill_buckets=[8],
+        max_model_len=64,
+        max_queue_size=8,
+        admission_deadline_ms=50.0,
+    )
+    seq = seq_of(4)
+    sched.add(seq)
+    sched.try_admit()
+    seq.append_token(9)
+    sched._preempt(seq)
+    seq.arrival_t = time.perf_counter() - 1.0
+    plan = sched.try_admit()
+    assert plan is not None and plan.seq is seq
+    assert sched.total_deadline_shed == 0
+
+
+def test_auto_num_pages_dtype_and_hbm_aware():
+    """fp32 KV halves the page budget of bf16; hbm_bytes scales it
+    (VERDICT r1 weak-6)."""
+    from vgate_tpu.models.specs import TINY_DENSE
+    from vgate_tpu.runtime.kv_cache import auto_num_pages
+
+    class FakeTPU:
+        platform = "tpu"
+
+        @staticmethod
+        def memory_stats():
+            return None
+
+    common = dict(
+        spec=TINY_DENSE, page_size=16, hbm_utilization=0.5,
+        device=FakeTPU(), params_bytes=0, hard_cap=1 << 40,
+    )
+    bf16 = auto_num_pages(dtype_bytes=2, **common)
+    fp32 = auto_num_pages(dtype_bytes=4, **common)
+    assert fp32 == bf16 // 2
+    double = auto_num_pages(
+        dtype_bytes=2, hbm_bytes=32 * 1024**3, **common
+    )
+    assert double == bf16 * 2

@@ -154,6 +154,55 @@ class JaxTPUBackend:
 
     # -- async extensions used by the gateway --
 
+    async def generate_settled_async(
+        self,
+        prompts: Sequence[str],
+        sampling_params: Sequence[SamplingParams],
+    ) -> List[Any]:
+        """Like ``generate_async`` but failures are returned per slot (the
+        exception object in place of a GenerationResult) instead of failing
+        the whole batch — one deadline-shed or failed sequence must not
+        discard its co-batched neighbours' completed generations."""
+        assert self.core is not None
+        loop = asyncio.get_running_loop()
+        seqs = []
+        for p, sp in zip(prompts, sampling_params):
+            try:
+                seqs.append(self.core.submit_prompt(p, sp))
+            except Exception as exc:  # queue full / dead engine
+                seqs.append(exc)
+
+        def wait_all():
+            for seq in seqs:
+                if not isinstance(seq, BaseException):
+                    seq.done_event.wait()
+
+        await loop.run_in_executor(None, wait_all)
+        results: List[Any] = []
+        for seq in seqs:
+            if isinstance(seq, BaseException):
+                results.append(seq)
+            elif seq.status is SeqStatus.FAILED:
+                results.append(seq.error)
+            else:
+                results.append(
+                    GenerationResult(
+                        text=self.core.final_text(seq),
+                        token_ids=list(seq.generated_ids),
+                        num_tokens=seq.num_output_tokens,
+                        prompt_tokens=seq.orig_prompt_len,
+                        finish_reason=seq.finish_reason,
+                        metrics={
+                            "ttft": seq.ttft or 0.0,
+                            "tpot": seq.tpot or 0.0,
+                            "gen_time": (
+                                (seq.finish_t or 0.0) - seq.arrival_t
+                            ),
+                        },
+                    )
+                )
+        return results
+
     async def generate_async(
         self,
         prompts: Sequence[str],
@@ -161,39 +210,14 @@ class JaxTPUBackend:
     ) -> List[GenerationResult]:
         """Submit into the running engine and await completion without
         blocking the event loop (sequences from concurrent batches share
-        decode steps — this is where continuous batching pays off)."""
-        assert self.core is not None
-        loop = asyncio.get_running_loop()
-        seqs = [
-            self.core.submit_prompt(p, sp)
-            for p, sp in zip(prompts, sampling_params)
-        ]
-
-        def wait_all():
-            for seq in seqs:
-                seq.done_event.wait()
-
-        await loop.run_in_executor(None, wait_all)
-        results = []
-        for seq in seqs:
-            if seq.status is SeqStatus.FAILED:
-                raise seq.error  # type: ignore[misc]
-            text = self.core.final_text(seq)
-            results.append(
-                GenerationResult(
-                    text=text,
-                    token_ids=list(seq.generated_ids),
-                    num_tokens=seq.num_output_tokens,
-                    prompt_tokens=seq.orig_prompt_len,
-                    finish_reason=seq.finish_reason,
-                    metrics={
-                        "ttft": seq.ttft or 0.0,
-                        "tpot": seq.tpot or 0.0,
-                        "gen_time": (seq.finish_t or 0.0) - seq.arrival_t,
-                    },
-                )
-            )
-        return results
+        decode steps — this is where continuous batching pays off).  Raises
+        the first failure; callers batching unrelated requests should use
+        ``generate_settled_async``."""
+        settled = await self.generate_settled_async(prompts, sampling_params)
+        for item in settled:
+            if isinstance(item, BaseException):
+                raise item
+        return settled
 
     async def stream_async(
         self, prompt: str, params: SamplingParams

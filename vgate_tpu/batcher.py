@@ -125,6 +125,7 @@ class RequestBatcher:
         stop: Optional[List[str]] = None,
         seed: Optional[int] = None,
         request_id: Optional[str] = None,
+        timeout_s: Optional[float] = None,
     ) -> Dict[str, Any]:
         inf = self.config.inference
         params = SamplingParams(
@@ -168,7 +169,20 @@ class RequestBatcher:
                 trigger = len(self._queue) >= self.config.batch.max_batch_size
             if trigger:
                 asyncio.ensure_future(self._process_batch())
-            return await request.future
+            if timeout_s is None:
+                return await request.future
+            try:
+                return await asyncio.wait_for(request.future, timeout_s)
+            except asyncio.TimeoutError:
+                # shed the abandoned work: a still-queued request must not
+                # occupy a future batch (its client is gone — generating
+                # the completion would amplify the overload).  If already
+                # dispatched, the engine finishes it; only the wait ends.
+                async with self._queue_lock:
+                    if request in self._queue:
+                        self._queue.remove(request)
+                        metrics.PENDING_REQUESTS.set(len(self._queue))
+                raise
 
     # -- batch firing (reference: vgate/batcher.py:184-324) --
 
@@ -227,6 +241,16 @@ class RequestBatcher:
             elapsed = time.perf_counter() - start
             metrics.observe_with_exemplar(metrics.BATCH_PROCESSING_TIME, elapsed)
             for lead, result in zip(unique, results):
+                if isinstance(result, BaseException):
+                    # settled path: only THIS group failed (e.g. deadline
+                    # shed); its neighbours keep their completions
+                    metrics.INFERENCE_ERRORS.labels(
+                        error_type=type(result).__name__
+                    ).inc()
+                    for req in groups[lead.cache_key]:
+                        if not req.future.done():
+                            req.future.set_exception(result)
+                    continue
                 payload = self._normalize(lead, result)
                 await self.cache.put(lead.cache_key, payload)
                 for req in groups[lead.cache_key]:
@@ -244,6 +268,11 @@ class RequestBatcher:
         params = [req.params for req in unique]
         backend = self.engine.backend
         with tracer.start_as_current_span("batcher.inference"):
+            # prefer the settled path: per-request failures (deadline shed,
+            # queue full) stay per-request instead of failing the batch
+            gen_settled = getattr(backend, "generate_settled_async", None)
+            if gen_settled is not None:
+                return await gen_settled(prompts, params)
             gen_async = getattr(backend, "generate_async", None)
             if gen_async is not None:
                 return await gen_async(prompts, params)

@@ -138,7 +138,10 @@ class TPUConfig(BaseModel):
     # Use Pallas kernels where available; False falls back to jnp reference
     # implementations (needed on CPU test meshes).
     use_pallas: bool = True
-    donate_kv: bool = True
+    # Per-chip HBM budget in bytes for KV auto-sizing when the runtime
+    # reports no memory stats (0 => 16 GiB, the v5e default; set for other
+    # parts, e.g. 32 GiB for v4/v5p).
+    hbm_bytes: int = 0
     # Decode steps fused into one device program (lax.scan over the step
     # body).  The host reads tokens back once per chunk, amortizing the
     # host<->device round-trip over `decode_chunk` tokens per slot; chunk
@@ -172,9 +175,11 @@ class SchedulerConfig(BaseModel):
     """Continuous-batching scheduler (no reference equivalent; lives inside
     vLLM in the reference — SURVEY.md section 2.1)."""
 
-    max_num_seqs: int = 32
     max_queue_size: int = 512
-    admission_deadline_ms: float = 0.0  # 0 => no deadline-based shedding
+    # Shed a queued request instead of admitting it when it has already
+    # waited longer than this (0 => no deadline-based shedding); the client
+    # gets 503 + Retry-After rather than a late, useless completion.
+    admission_deadline_ms: float = 0.0
     preempt_on_oom: bool = True
 
 

@@ -222,6 +222,8 @@ class EngineCore:
                 tpu_cfg.hbm_utilization,
                 device=self.mesh.devices.flat[0],
                 params_bytes=params_bytes,
+                dtype_bytes=jnp.dtype(self.dtype).itemsize,
+                hbm_bytes=tpu_cfg.hbm_bytes,
             ),
         )
         self.geometry = KVGeometry(
@@ -231,6 +233,7 @@ class EngineCore:
             kv_heads=self.spec.num_kv_heads,
             head_dim=self.spec.head_dim,
             max_model_len=self.config.model.max_model_len,
+            dtype_bytes=jnp.dtype(self.dtype).itemsize,
         )
         kv_sharding = named(self.mesh, kv_pspec(self.spec, self.mesh))
         self.k_pages, self.v_pages = make_kv_buffers(
@@ -246,6 +249,9 @@ class EngineCore:
             max_model_len=self.config.model.max_model_len,
             max_queue_size=self.config.scheduler.max_queue_size,
             preempt_on_oom=self.config.scheduler.preempt_on_oom,
+            admission_deadline_ms=(
+                self.config.scheduler.admission_deadline_ms
+            ),
         )
 
         # host-side mirror of the device page tables, one row per slot

@@ -27,6 +27,16 @@ from vgate_tpu.utils.math import cdiv
 logger = get_logger(__name__)
 
 
+def _page_bytes(
+    num_layers: int, page_size: int, kv_heads: int, head_dim: int,
+    dtype_bytes: int,
+) -> int:
+    """Bytes one page occupies across all layers, K and V together — the
+    single source of truth for page sizing (used by both KVGeometry and
+    auto_num_pages)."""
+    return 2 * num_layers * page_size * kv_heads * head_dim * dtype_bytes
+
+
 @dataclass(frozen=True)
 class KVGeometry:
     num_layers: int
@@ -35,6 +45,7 @@ class KVGeometry:
     kv_heads: int
     head_dim: int
     max_model_len: int
+    dtype_bytes: int = 2  # bf16 default
 
     @property
     def pages_per_seq(self) -> int:
@@ -42,8 +53,10 @@ class KVGeometry:
 
     @property
     def page_bytes(self) -> int:
-        # K and V, bf16
-        return 2 * self.num_layers * self.page_size * self.kv_heads * self.head_dim * 2
+        return _page_bytes(
+            self.num_layers, self.page_size, self.kv_heads, self.head_dim,
+            self.dtype_bytes,
+        )
 
     @property
     def total_tokens(self) -> int:
@@ -62,25 +75,32 @@ def auto_num_pages(
     params_bytes: int = 0,
     fallback: int = 512,
     hard_cap: int = 65536,
+    dtype_bytes: int = 2,
+    hbm_bytes: int = 0,
 ) -> int:
     """Size the page pool from free device HBM after weights are resident
     (the serving analogue of vLLM's gpu_memory_utilization knob,
     reference config: vgate/config.py:47).
 
     When the runtime reports memory stats they are authoritative; otherwise
-    on accelerators we budget against a 16 GiB/chip default minus the actual
+    on accelerators we budget against ``hbm_bytes`` (config
+    ``tpu.hbm_bytes``; default 16 GiB/chip, the v5e part) minus the actual
     parameter bytes, and on CPU test platforms we return ``fallback``.
+    ``dtype_bytes`` is the KV cache element width (fp32 KV needs twice the
+    page budget of bf16).
     """
     device = device or jax.devices()[0]
     stats = getattr(device, "memory_stats", lambda: None)()
-    page_bytes = (
-        2 * spec.num_layers * page_size * spec.num_kv_heads * spec.head_dim * 2
+    page_bytes = _page_bytes(
+        spec.num_layers, page_size, spec.num_kv_heads, spec.head_dim,
+        dtype_bytes,
     )
     if stats and "bytes_limit" in stats:
         limit = stats["bytes_limit"] * hbm_utilization
         free = max(0, limit - stats.get("bytes_in_use", 0))
     elif device.platform != "cpu":
-        free = max(0, _DEFAULT_HBM_BYTES * hbm_utilization - params_bytes)
+        budget = hbm_bytes or _DEFAULT_HBM_BYTES
+        free = max(0, budget * hbm_utilization - params_bytes)
     else:
         return fallback
     pages = int(free // page_bytes)

@@ -13,6 +13,7 @@ CPU-only strategy).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, List, Optional, Union
@@ -29,6 +30,12 @@ logger = get_logger(__name__)
 class EngineBusyError(RuntimeError):
     """Raised at admission when the waiting queue is full (load shedding,
     SURVEY.md section 5.3: 'add deadlines/load-shedding at admission')."""
+
+
+class AdmissionDeadlineExceeded(EngineBusyError):
+    """A queued request waited past ``scheduler.admission_deadline_ms`` and
+    was shed instead of admitted (the completion would arrive too late to
+    be useful; SURVEY.md section 5.3)."""
 
 
 @dataclass
@@ -56,6 +63,7 @@ class Scheduler:
         max_model_len: int,
         max_queue_size: int = 512,
         preempt_on_oom: bool = True,
+        admission_deadline_ms: float = 0.0,
     ) -> None:
         self.allocator = allocator
         self.page_size = page_size
@@ -73,6 +81,8 @@ class Scheduler:
         self.max_model_len = max_model_len
         self.max_queue_size = max_queue_size
         self.preempt_on_oom = preempt_on_oom
+        self.admission_deadline_ms = admission_deadline_ms
+        self.total_deadline_shed = 0
         self.waiting: Deque[Sequence] = deque()
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.total_preemptions = 0
@@ -134,7 +144,43 @@ class Scheduler:
                 return DecodePlan(seqs=active)
         return self.try_admit()  # everything preempted; try re-admission
 
+    def _shed_expired(self) -> None:
+        """Fail queued sequences whose admission deadline has passed (their
+        completion would arrive too late to be useful).  Preempted sequences
+        are exempt: they were already admitted once and hold generated
+        tokens the client is owed."""
+        if not self.admission_deadline_ms:
+            return
+        deadline_s = self.admission_deadline_ms / 1000.0
+        now = time.perf_counter()
+        kept: Deque[Sequence] = deque()
+        shed = 0
+        for seq in self.waiting:
+            if (
+                seq.preempt_count == 0
+                and now - seq.arrival_t > deadline_s
+            ):
+                seq.fail(
+                    AdmissionDeadlineExceeded(
+                        f"request waited {(now - seq.arrival_t) * 1000:.0f}ms "
+                        f"in queue (> {self.admission_deadline_ms:.0f}ms "
+                        "admission deadline)"
+                    )
+                )
+                shed += 1
+            else:
+                kept.append(seq)
+        if shed:
+            self.waiting = kept
+            self.total_deadline_shed += shed
+            metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+            logger.warning(
+                "shed requests past admission deadline",
+                extra={"extra_data": {"shed": shed}},
+            )
+
     def try_admit(self) -> Optional[PrefillPlan]:
+        self._shed_expired()
         if not self.waiting:
             return None
         slot = self._free_slot()
@@ -263,4 +309,5 @@ class Scheduler:
             "admitted": self.total_admitted,
             "finished": self.total_finished,
             "preemptions": self.total_preemptions,
+            "deadline_shed": self.total_deadline_shed,
         }

@@ -28,6 +28,7 @@ from vgate_tpu.batcher import RequestBatcher
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.engine import VGTEngine
 from vgate_tpu.logging_config import get_logger, setup_logging
+from vgate_tpu.runtime.scheduler import EngineBusyError
 from vgate_tpu.security import build_security_middleware
 from vgate_tpu.server.openai_models import (
     BenchmarkRequest,
@@ -155,7 +156,19 @@ async def chat_completions(request: web.Request) -> web.Response:
             top_k=payload.top_k,
             stop=payload.stop_list(),
             seed=payload.seed,
+            timeout_s=engine.config.server.request_timeout_s,
         )
+    except asyncio.TimeoutError:
+        return _error(
+            504,
+            "Request exceeded server.request_timeout_s "
+            f"({engine.config.server.request_timeout_s:.0f}s)",
+            "timeout_error",
+        )
+    except EngineBusyError as exc:
+        resp = _error(503, f"Engine overloaded: {exc}", "overloaded_error")
+        resp.headers["Retry-After"] = "1"
+        return resp
     except Exception as exc:
         return _error(500, f"Inference failed: {exc}", "server_error")
     completion = ChatCompletion(
@@ -231,18 +244,47 @@ async def _stream_chat(
             stop=payload.stop_list(),
             seed=payload.seed,
         )
-        async for piece in stream_fn(prompt, params):
-            await resp.write(_chunk({"content": piece}))
+        try:
+            async with asyncio.timeout(
+                engine.config.server.request_timeout_s
+            ):
+                async for piece in stream_fn(prompt, params):
+                    await resp.write(_chunk({"content": piece}))
+        except TimeoutError:
+            await resp.write(
+                b'data: {"error": {"message": "request timed out", '
+                b'"type": "timeout_error"}}\n\n'
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
     else:
-        result = await batcher.submit(
-            prompt,
-            max_tokens=payload.max_tokens,
-            temperature=payload.temperature,
-            top_p=payload.top_p,
-            top_k=payload.top_k,
-            stop=payload.stop_list(),
-            seed=payload.seed,
-        )
+        try:
+            result = await batcher.submit(
+                prompt,
+                max_tokens=payload.max_tokens,
+                temperature=payload.temperature,
+                top_p=payload.top_p,
+                top_k=payload.top_k,
+                stop=payload.stop_list(),
+                seed=payload.seed,
+                timeout_s=engine.config.server.request_timeout_s,
+            )
+        except (asyncio.TimeoutError, EngineBusyError) as exc:
+            # the 200 + role chunk are already on the wire: deliver the
+            # failure as an SSE error event, not a reset connection
+            err_type = (
+                "timeout_error"
+                if isinstance(exc, asyncio.TimeoutError)
+                else "overloaded_error"
+            )
+            await resp.write(
+                f'data: {{"error": {{"message": "{err_type}", '
+                f'"type": "{err_type}"}}}}\n\n'.encode()
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
         text = result["text"]
         step = max(1, len(text) // 16)
         for i in range(0, len(text), step):
