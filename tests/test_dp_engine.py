@@ -1,0 +1,107 @@
+"""Data-parallel serving: replica engines + router (SURVEY.md section 2.2
+row 1; VERDICT r1 missing-6: dp must do per-replica batch work, not
+replicate compute)."""
+
+import jax
+import pytest
+
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import load_config
+from vgate_tpu.runtime.dp_engine import ReplicatedEngine
+
+
+def dp_config(dp=2, **tpu_overrides):
+    tpu = {
+        "dp": dp,
+        "tp": 1,
+        "ep": 1,
+        "sp": 1,
+        "num_devices": dp,
+        "kv_num_pages": 64,
+        "kv_page_size": 4,
+        "max_batch_slots": 4,
+        "prefill_buckets": [8, 16, 32],
+        "use_pallas": False,
+    }
+    tpu.update(tpu_overrides)
+    return load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu=tpu,
+        scheduler={"max_queue_size": 16},
+        logging={"level": "WARNING"},
+    )
+
+
+@pytest.fixture(scope="module")
+def dp_engine():
+    engine = ReplicatedEngine(dp_config(dp=2), devices=jax.devices()[:2])
+    engine.start()
+    yield engine
+    engine.stop()
+
+
+def greedy(max_tokens=6):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0)
+
+
+def test_dp_engine_builds_disjoint_replicas(dp_engine):
+    assert len(dp_engine.replicas) == 2
+    d0 = set(d.id for d in dp_engine.replicas[0].mesh.devices.flat)
+    d1 = set(d.id for d in dp_engine.replicas[1].mesh.devices.flat)
+    assert d0.isdisjoint(d1)
+    # each replica's mesh is dp=1: its batch is private per-shard work
+    assert dp_engine.replicas[0].mesh.shape["dp"] == 1
+
+
+def test_dp_routing_spreads_load(dp_engine):
+    """Concurrent requests land on BOTH replicas (per-shard batch work)."""
+    prompts = [f"dp probe {i}" for i in range(6)]
+    results = dp_engine.generate(prompts, [greedy()] * 6)
+    assert all(r["num_tokens"] >= 1 for r in results)
+    per_replica = [
+        core.get_stats()["prefills"] for core in dp_engine.replicas
+    ]
+    assert all(n > 0 for n in per_replica), per_replica
+
+
+def test_dp_matches_single_engine_greedy(dp_engine):
+    """Greedy output is replica-independent: identical weights (same init
+    seed), identical decode — routing must not change results."""
+    [a] = dp_engine.generate(["dp determinism"], [greedy()])
+    [b] = dp_engine.generate(["dp determinism"], [greedy()])
+    assert a["token_ids"] == b["token_ids"]
+    # run enough to hit both replicas with the same prompt
+    outs = dp_engine.generate(["dp determinism"] * 4, [greedy()] * 4)
+    assert all(o["token_ids"] == a["token_ids"] for o in outs)
+
+
+def test_dp_stats_aggregate(dp_engine):
+    stats = dp_engine.get_stats()
+    assert stats["dp"] == 2
+    assert len(stats["replicas"]) == 2
+    assert stats["prefills"] == sum(
+        r["prefills"] for r in stats["replicas"]
+    )
+    assert stats["mesh"]["dp"] == 2
+    health = dp_engine.device_health()
+    assert health["alive"] is True
+    assert health["replicas"] == 2
+
+
+def test_dp_backend_integration():
+    """JaxTPUBackend transparently builds the replicated engine at dp>1."""
+    from vgate_tpu.backends.jax_backend import JaxTPUBackend
+
+    backend = JaxTPUBackend()
+    backend.load_model(dp_config(dp=2))
+    try:
+        assert isinstance(backend.core, ReplicatedEngine)
+        [r] = backend.generate(["backend dp"], [greedy(4)])
+        assert r.num_tokens >= 1
+    finally:
+        backend.shutdown()

@@ -110,7 +110,9 @@ class JaxTPUBackend:
     """Continuous-batching TPU backend behind the 4-method protocol."""
 
     def __init__(self) -> None:
-        self.core: Optional[EngineCore] = None
+        # EngineCore (dp=1) or runtime.dp_engine.ReplicatedEngine (dp>1);
+        # both expose the same serving surface
+        self.core: Optional[Any] = None
         self._embedder: Optional[Embedder] = None
         self._config = None
 
@@ -120,7 +122,12 @@ class JaxTPUBackend:
         # accept the full VGTConfig through the seam; fall back to the global
         # for callers that still pass only the model section
         self._config = config if hasattr(config, "tpu") else get_config()
-        self.core = EngineCore(self._config)
+        if self._config.tpu.dp > 1:
+            from vgate_tpu.runtime.dp_engine import ReplicatedEngine
+
+            self.core = ReplicatedEngine(self._config)
+        else:
+            self.core = EngineCore(self._config)
         self.core.start()
         logger.info(
             "jax_tpu backend ready",
