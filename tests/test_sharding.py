@@ -64,7 +64,9 @@ class TestParamShardings:
         assert pspecs["layers"]["gate"]["w"] == P(None, None, "tp")
         assert pspecs["layers"]["down"]["w"] == P(None, "tp", None)
         assert pspecs["embed"] == P("tp", None)
-        assert pspecs["layers"]["input_norm"] == P()
+        # pp=1 -> the layer axis stays unsharded (trailing-None spec is
+        # semantically P())
+        assert pspecs["layers"]["input_norm"] == P(None, None)
 
     def test_indivisible_dims_replicate(self):
         # kv_dim = 2*16 = 32; on tp=8: 32 % 8 == 0 -> sharded. On a mesh of
@@ -80,7 +82,7 @@ class TestParamShardings:
         pspecs = param_pspecs(TINY_MOE, mesh)
         assert pspecs["layers"]["gate"]["w"] == P(None, "ep", None, "tp")
         assert pspecs["layers"]["down"]["w"] == P(None, "ep", "tp", None)
-        assert pspecs["layers"]["router"] == P()
+        assert pspecs["layers"]["router"] == P(None, None, None)
 
     def test_kv_pages_shard_only_on_kv_heads(self):
         mesh = build_mesh(tpu_cfg(tp=2, dp=0))

@@ -107,6 +107,7 @@ class TPUConfig(BaseModel):
     """
 
     dp: int = 1
+    pp: int = 1  # pipeline stages (layer stack split; parallel/pipeline.py)
     tp: int = 0  # 0 => all devices
     ep: int = 1
     sp: int = 1

@@ -21,6 +21,7 @@ capability the reference delegates to vLLM (vgate/backends/vllm_backend.py:51).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -238,48 +239,34 @@ def prefill_forward(
     divide by sp.
     """
     B, S = tokens.shape
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        from vgate_tpu.parallel.pipeline import pp_prefill_forward
+
+        return pp_prefill_forward(
+            params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
+            mesh=mesh, use_pallas=use_pallas,
+        )
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
         from vgate_tpu.parallel.ring_attention import ring_prefill_attention
+
+        attn_fn = functools.partial(ring_prefill_attention, mesh=mesh)
     elif use_pallas:
         from vgate_tpu.ops.pallas.flash_prefill import (
             flash_prefill_attention_pallas,
         )
-    ps = k_pages.shape[3]
-    n_pages = S // ps
-    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+
+        attn_fn = flash_prefill_attention_pallas
+    else:
+        attn_fn = flash_prefill_attention
     x = params["embed"][tokens]  # [B, S, D]
 
     def layer_fn(h, per_layer):
         lp, k_pages_l, v_pages_l = per_layer
-        normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
-        q, k, v = _project_qkv(normed, lp, spec)
-        q = apply_rope(q, positions, spec.rope_theta)
-        k = apply_rope(k, positions, spec.rope_theta)
-        # Write this layer's KV into its pages (trash-page-0 absorbs padding).
-        # Pages are head-major [KV, P, ps, hd]: transpose the fresh KV to
-        # [KV, B, n_pages, ps, hd] so each head's pages land contiguously.
-        k_resh = jnp.transpose(
-            k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
-            (3, 0, 1, 2, 4),
+        h, k_pages_l, v_pages_l = prefill_layer(
+            h, lp, k_pages_l, v_pages_l, spec=spec, seq_lens=seq_lens,
+            page_tables=page_tables, attn_fn=attn_fn,
         )
-        v_resh = jnp.transpose(
-            v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
-            (3, 0, 1, 2, 4),
-        )
-        pt = page_tables[:, :n_pages]
-        k_pages_l = k_pages_l.at[:, pt].set(k_resh)
-        v_pages_l = v_pages_l.at[:, pt].set(v_resh)
-        if use_ring:
-            attn = ring_prefill_attention(q, k, v, seq_lens, mesh)
-        elif use_pallas:
-            attn = flash_prefill_attention_pallas(q, k, v, seq_lens)
-        else:
-            attn = flash_prefill_attention(q, k, v, seq_lens)
-        attn = attn.reshape(B, S, spec.q_dim)
-        h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
-        normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
-        h = h + _mlp(normed2, lp, spec)
         return h, (k_pages_l, v_pages_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(
@@ -292,6 +279,81 @@ def prefill_forward(
     return _logits(params, spec, last_hidden), k_pages, v_pages
 
 
+def prefill_layer(
+    h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, seq_lens, page_tables,
+    attn_fn,
+):
+    """One transformer layer of the prompt pass (shared by the plain scan
+    path above and the pipeline-parallel stage scan)."""
+    B, S = h.shape[:2]
+    ps = k_pages_l.shape[2]
+    n_pages = S // ps
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
+    q, k, v = _project_qkv(normed, lp, spec)
+    q = apply_rope(q, positions, spec.rope_theta)
+    k = apply_rope(k, positions, spec.rope_theta)
+    # Write this layer's KV into its pages (trash-page-0 absorbs padding).
+    # Pages are head-major [KV, P, ps, hd]: transpose the fresh KV to
+    # [KV, B, n_pages, ps, hd] so each head's pages land contiguously.
+    k_resh = jnp.transpose(
+        k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+        (3, 0, 1, 2, 4),
+    )
+    v_resh = jnp.transpose(
+        v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+        (3, 0, 1, 2, 4),
+    )
+    pt = page_tables[:, :n_pages]
+    k_pages_l = k_pages_l.at[:, pt].set(k_resh)
+    v_pages_l = v_pages_l.at[:, pt].set(v_resh)
+    attn = attn_fn(q, k, v, seq_lens)
+    attn = attn.reshape(B, S, spec.q_dim)
+    h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
+    normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
+    h = h + _mlp(normed2, lp, spec)
+    return h, k_pages_l, v_pages_l
+
+
+def decode_layer(
+    h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, positions, page_ids,
+    page_off, page_tables, seq_lens, attn_fn,
+):
+    """One transformer layer of the decode step (shared by the plain scan
+    path below and the pipeline-parallel stage scan,
+    parallel/pipeline.py)."""
+    B = h.shape[0]
+    normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
+    q, k, v = _project_qkv(normed, lp, spec)  # q [B,H,hd], k/v [B,KV,hd]
+    q = apply_rope(q[:, None], positions[:, None], spec.rope_theta)[:, 0]
+    k = apply_rope(k[:, None], positions[:, None], spec.rope_theta)[:, 0]
+    k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
+        jnp.transpose(k, (1, 0, 2))
+    )
+    v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
+        jnp.transpose(v, (1, 0, 2))
+    )
+    attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
+    attn = attn.reshape(B, spec.q_dim)
+    h = h + weighted_einsum("bh,hd->bd", attn, lp["o"]["w"])
+    normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
+    h = h + _mlp(normed2, lp, spec)
+    return h, k_pages_l, v_pages_l
+
+
+def decode_attn_inputs(positions, page_tables, active, page_size):
+    """Derive the per-slot KV write targets for one decode step; inactive
+    slots write the reserved trash page 0."""
+    B = positions.shape[0]
+    seq_lens = positions + 1
+    page_slot = positions // page_size
+    page_off = positions % page_size
+    page_ids = page_tables[jnp.arange(B), page_slot]  # [B]
+    if active is not None:
+        page_ids = jnp.where(active, page_ids, 0)
+    return seq_lens, page_ids, page_off
+
+
 def decode_forward(
     params: Params,
     spec: ModelSpec,
@@ -302,8 +364,16 @@ def decode_forward(
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots write page 0
     use_pallas: bool = False,
+    mesh=None,  # pp>1 routes through the pipeline-parallel stage relay
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One continuous-batching decode step: returns (logits [B, V], caches)."""
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        from vgate_tpu.parallel.pipeline import pp_decode_forward
+
+        return pp_decode_forward(
+            params, spec, tokens, positions, k_pages, v_pages, page_tables,
+            active=active, mesh=mesh, use_pallas=use_pallas,
+        )
     if use_pallas:
         from vgate_tpu.ops.pallas.paged_attention import (
             paged_decode_attention_pallas,
@@ -312,35 +382,20 @@ def decode_forward(
         attn_fn = paged_decode_attention_pallas
     else:
         attn_fn = paged_decode_attention
-    B = tokens.shape[0]
     ps = k_pages.shape[3]
-    seq_lens = positions + 1
-    batch_idx = jnp.arange(B)
-    page_slot = positions // ps
-    page_off = positions % ps
-    page_ids = page_tables[batch_idx, page_slot]  # [B]
-    if active is not None:
-        page_ids = jnp.where(active, page_ids, 0)  # trash page for idle slots
+    seq_lens, page_ids, page_off = decode_attn_inputs(
+        positions, page_tables, active, ps
+    )
 
     x = params["embed"][tokens]  # [B, D]
 
     def layer_fn(h, per_layer):
         lp, k_pages_l, v_pages_l = per_layer
-        normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
-        q, k, v = _project_qkv(normed, lp, spec)  # q [B,H,hd], k/v [B,KV,hd]
-        q = apply_rope(q[:, None], positions[:, None], spec.rope_theta)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], spec.rope_theta)[:, 0]
-        k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
-            jnp.transpose(k, (1, 0, 2))
+        h, k_pages_l, v_pages_l = decode_layer(
+            h, lp, k_pages_l, v_pages_l, spec=spec, positions=positions,
+            page_ids=page_ids, page_off=page_off, page_tables=page_tables,
+            seq_lens=seq_lens, attn_fn=attn_fn,
         )
-        v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
-            jnp.transpose(v, (1, 0, 2))
-        )
-        attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
-        attn = attn.reshape(B, spec.q_dim)
-        h = h + weighted_einsum("bh,hd->bd", attn, lp["o"]["w"])
-        normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
-        h = h + _mlp(normed2, lp, spec)
         return h, (k_pages_l, v_pages_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(

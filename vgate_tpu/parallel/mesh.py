@@ -31,10 +31,14 @@ from vgate_tpu.logging_config import get_logger
 logger = get_logger(__name__)
 
 AXIS_DP = "dp"
+AXIS_PP = "pp"
 AXIS_EP = "ep"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
-MESH_AXES = (AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP)
+# pp outermost after dp (stage boundary crossings are the rarest, smallest
+# transfers: one [mb, D] activation per microbatch step); tp innermost on
+# the fastest ICI loops
+MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 _distributed_initialized = False
 
@@ -81,31 +85,33 @@ class MeshPlan:
     """Resolved mesh geometry."""
 
     dp: int
+    pp: int
     ep: int
     sp: int
     tp: int
 
     @property
-    def shape(self) -> Tuple[int, int, int, int]:
-        return (self.dp, self.ep, self.sp, self.tp)
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.dp, self.pp, self.ep, self.sp, self.tp)
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.ep * self.sp * self.tp
+        return self.dp * self.pp * self.ep * self.sp * self.tp
 
 
 def resolve_plan(tpu_config, num_devices: Optional[int] = None) -> MeshPlan:
     """Resolve config axis sizes (0 = absorb remaining devices) against the
     visible device count."""
     n = num_devices if num_devices is not None else jax.device_count()
-    dp, ep, sp, tp = (
+    dp, pp, ep, sp, tp = (
         tpu_config.dp,
+        getattr(tpu_config, "pp", 1),
         tpu_config.ep,
         tpu_config.sp,
         tpu_config.tp,
     )
-    fixed = [x for x in (dp, ep, sp, tp) if x > 0]
-    free = [x for x in (dp, ep, sp, tp) if x == 0]
+    fixed = [x for x in (dp, pp, ep, sp, tp) if x > 0]
+    free = [x for x in (dp, pp, ep, sp, tp) if x == 0]
     used = int(np.prod(fixed)) if fixed else 1
     if len(free) > 1:
         raise ValueError("at most one mesh axis may be 0 (auto)")
@@ -115,8 +121,10 @@ def resolve_plan(tpu_config, num_devices: Optional[int] = None) -> MeshPlan:
                 f"devices ({n}) not divisible by fixed axes product ({used})"
             )
         auto = n // used
-        dp, ep, sp, tp = [x if x > 0 else auto for x in (dp, ep, sp, tp)]
-    plan = MeshPlan(dp=dp, ep=ep, sp=sp, tp=tp)
+        dp, pp, ep, sp, tp = [
+            x if x > 0 else auto for x in (dp, pp, ep, sp, tp)
+        ]
+    plan = MeshPlan(dp=dp, pp=pp, ep=ep, sp=sp, tp=tp)
     if plan.num_devices != n:
         raise ValueError(
             f"mesh {plan.shape} covers {plan.num_devices} devices but "
@@ -158,6 +166,9 @@ def build_mesh(tpu_config=None, devices=None) -> Mesh:
 
 
 def single_device_mesh(device=None) -> Mesh:
-    """A trivial 1×1×1×1 mesh so single-chip and multi-chip share one code path."""
+    """A trivial all-ones mesh so single-chip and multi-chip share one code
+    path."""
     device = device if device is not None else jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), MESH_AXES)
+    return Mesh(
+        np.asarray([device]).reshape((1,) * len(MESH_AXES)), MESH_AXES
+    )

@@ -19,7 +19,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from vgate_tpu.models.specs import ModelSpec
-from vgate_tpu.parallel.mesh import AXIS_EP, AXIS_TP
+from vgate_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP
 
 
 def _spec(mesh: Mesh, dims, *axes) -> P:
@@ -39,33 +39,35 @@ def param_pspecs(spec: ModelSpec, mesh: Mesh) -> Dict[str, Any]:
     Q, KVD = spec.q_dim, spec.kv_dim
     F, V, E = spec.intermediate_size, spec.vocab_size, spec.num_experts
 
+    # the stacked layer axis L shards over pp: each pipeline stage holds
+    # only its own layers' weights (and KV pages, kv_pspec below)
     layers: Dict[str, Any] = {
-        "input_norm": P(),
-        "post_norm": P(),
-        "q": {"w": _spec(mesh, (L, D, Q), None, None, AXIS_TP)},
-        "k": {"w": _spec(mesh, (L, D, KVD), None, None, AXIS_TP)},
-        "v": {"w": _spec(mesh, (L, D, KVD), None, None, AXIS_TP)},
-        "o": {"w": _spec(mesh, (L, Q, D), None, AXIS_TP, None)},
+        "input_norm": _spec(mesh, (L, D), AXIS_PP, None),
+        "post_norm": _spec(mesh, (L, D), AXIS_PP, None),
+        "q": {"w": _spec(mesh, (L, D, Q), AXIS_PP, None, AXIS_TP)},
+        "k": {"w": _spec(mesh, (L, D, KVD), AXIS_PP, None, AXIS_TP)},
+        "v": {"w": _spec(mesh, (L, D, KVD), AXIS_PP, None, AXIS_TP)},
+        "o": {"w": _spec(mesh, (L, Q, D), AXIS_PP, AXIS_TP, None)},
     }
     if spec.qkv_bias:
-        layers["q"]["b"] = _spec(mesh, (L, Q), None, AXIS_TP)
-        layers["k"]["b"] = _spec(mesh, (L, KVD), None, AXIS_TP)
-        layers["v"]["b"] = _spec(mesh, (L, KVD), None, AXIS_TP)
+        layers["q"]["b"] = _spec(mesh, (L, Q), AXIS_PP, AXIS_TP)
+        layers["k"]["b"] = _spec(mesh, (L, KVD), AXIS_PP, AXIS_TP)
+        layers["v"]["b"] = _spec(mesh, (L, KVD), AXIS_PP, AXIS_TP)
     if spec.is_moe:
-        layers["router"] = P()
+        layers["router"] = _spec(mesh, (L, D, E), AXIS_PP, None, None)
         layers["gate"] = {
-            "w": _spec(mesh, (L, E, D, F), None, AXIS_EP, None, AXIS_TP)
+            "w": _spec(mesh, (L, E, D, F), AXIS_PP, AXIS_EP, None, AXIS_TP)
         }
         layers["up"] = {
-            "w": _spec(mesh, (L, E, D, F), None, AXIS_EP, None, AXIS_TP)
+            "w": _spec(mesh, (L, E, D, F), AXIS_PP, AXIS_EP, None, AXIS_TP)
         }
         layers["down"] = {
-            "w": _spec(mesh, (L, E, F, D), None, AXIS_EP, AXIS_TP, None)
+            "w": _spec(mesh, (L, E, F, D), AXIS_PP, AXIS_EP, AXIS_TP, None)
         }
     else:
-        layers["gate"] = {"w": _spec(mesh, (L, D, F), None, None, AXIS_TP)}
-        layers["up"] = {"w": _spec(mesh, (L, D, F), None, None, AXIS_TP)}
-        layers["down"] = {"w": _spec(mesh, (L, F, D), None, AXIS_TP, None)}
+        layers["gate"] = {"w": _spec(mesh, (L, D, F), AXIS_PP, None, AXIS_TP)}
+        layers["up"] = {"w": _spec(mesh, (L, D, F), AXIS_PP, None, AXIS_TP)}
+        layers["down"] = {"w": _spec(mesh, (L, F, D), AXIS_PP, AXIS_TP, None)}
 
     pspecs: Dict[str, Any] = {
         # vocab-sharded embedding/head: logits all-gather is tiny vs weights
@@ -79,7 +81,8 @@ def param_pspecs(spec: ModelSpec, mesh: Mesh) -> Dict[str, Any]:
 
 
 def kv_pspec(spec: ModelSpec, mesh: Mesh) -> P:
-    """KV pages [L, KV, P, page, hd]: shard KV heads over tp when divisible."""
+    """KV pages [L, KV, P, page, hd]: layers shard over pp (each stage
+    holds its own layers' pages), KV heads over tp when divisible."""
     return _spec(
         mesh,
         (
@@ -89,7 +92,7 @@ def kv_pspec(spec: ModelSpec, mesh: Mesh) -> P:
             1 << 30,
             spec.head_dim,
         ),
-        None,
+        AXIS_PP,
         AXIS_TP,
         None,
         None,

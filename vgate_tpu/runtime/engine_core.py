@@ -92,7 +92,7 @@ def _prefill_step(
 def _decode_step(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
     page_tables, active, temps, top_ps, top_ks, base_key, counter,
-    use_pallas=False,
+    use_pallas=False, mesh=None,
 ):
     """One decode step — thin wrapper over ``_decode_chunk(num_steps=1)``
     kept for single-step callers (e.g. __graft_entry__.dryrun_multichip)."""
@@ -100,7 +100,7 @@ def _decode_step(
         _decode_chunk(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active, temps, top_ps, top_ks, base_key, counter,
-            num_steps=1, use_pallas=use_pallas,
+            num_steps=1, use_pallas=use_pallas, mesh=mesh,
         )
     )
     return chunk_tokens[0], positions, counter, k_pages, v_pages
@@ -108,14 +108,15 @@ def _decode_step(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "num_steps", "use_pallas", "max_position"),
+    static_argnames=("spec", "num_steps", "use_pallas", "max_position",
+                     "mesh"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _decode_chunk(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
     page_tables, active, temps, top_ps, top_ks, base_key, counter,
     num_steps: int = 1, use_pallas=False, max_position: int = 0,
-    seeds=None, steps=None,
+    seeds=None, steps=None, mesh=None,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -137,7 +138,7 @@ def _decode_chunk(
         key = jax.random.fold_in(base_key, counter)
         logits, k_pages, v_pages = decode_forward(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
-            active=active, use_pallas=use_pallas,
+            active=active, use_pallas=use_pallas, mesh=mesh,
         )
         next_tokens = sample_tokens(
             logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
@@ -274,9 +275,26 @@ class EngineCore:
         self.pipeline_depth = max(1, tpu_cfg.decode_pipeline)
 
         # sp>1: prefill attention runs sequence-parallel (ring attention
-        # over the sp axis); buckets must then split evenly across shards
+        # over the sp axis); buckets must then split evenly across shards.
+        # pp>1: prefill AND decode run through the GPipe stage relay
+        # (parallel/pipeline.py).  The two reshape the same forward in
+        # incompatible ways, so they are mutually exclusive.
         sp_size = int(self.mesh.shape.get("sp", 1))
-        self._sp_mesh = self.mesh if sp_size > 1 else None
+        pp_size = int(self.mesh.shape.get("pp", 1))
+        if sp_size > 1 and pp_size > 1:
+            raise ValueError(
+                f"sp={sp_size} and pp={pp_size} cannot combine: ring-"
+                "attention prefill and the pipeline relay are exclusive"
+            )
+        if pp_size > 1 and self.spec.num_layers % pp_size:
+            raise ValueError(
+                f"{self.spec.num_layers} layers not divisible by "
+                f"pp={pp_size}"
+            )
+        self._fwd_mesh = (
+            self.mesh if (sp_size > 1 or pp_size > 1) else None
+        )
+        self._pp = pp_size
         if sp_size > 1:
             bad = [
                 b for b in self.scheduler.prefill_buckets if b % sp_size
@@ -588,7 +606,7 @@ class EngineCore:
             jnp.asarray([sp.top_p], jnp.float32),
             jnp.asarray([sp.top_k], jnp.int32),
             self._step_key(),
-            mesh=self._sp_mesh,
+            mesh=self._fwd_mesh,
             use_pallas=self.use_pallas,
             # per-request seed: token i always draws from (seed, i) — the
             # prefill samples token index num_generated (0 fresh, >0 after
@@ -722,6 +740,7 @@ class EngineCore:
             max_position=self.config.model.max_model_len - 1,
             seeds=state["seeds"],
             steps=state["steps"],
+            mesh=self._fwd_mesh if self._pp > 1 else None,
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
