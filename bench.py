@@ -114,11 +114,12 @@ def main() -> None:
         model_id = "Qwen/Qwen2.5-1.5B-Instruct"
         dtype = "bfloat16"
         n_requests, prompt_len, max_tokens = 128, 120, 128
-        slots = 64
+        # tunables (VGT_BENCH_* env for sweeps; defaults are the tuned best)
+        slots = int(os.environ.get("VGT_BENCH_SLOTS", 64))
         kv_pages = 0  # auto-size from HBM
         buckets = [128]
         max_model_len = 512  # covers prompt+output; keeps page tables tight
-        decode_chunk = 16
+        decode_chunk = int(os.environ.get("VGT_BENCH_CHUNK", 16))
     else:  # CI smoke fallback
         model_id = "tiny-dense"
         dtype = "float32"
@@ -147,7 +148,9 @@ def main() -> None:
             "max_batch_slots": slots,
             "prefill_buckets": buckets,
             "decode_chunk": decode_chunk,
-            "decode_pipeline": 2,
+            "decode_pipeline": int(
+                os.environ.get("VGT_BENCH_PIPE", 2)
+            ),
         },
         scheduler={"max_queue_size": 4096},
         logging={"level": "ERROR"},

@@ -147,11 +147,30 @@ def test_quantized_engine_end_to_end():
 
 
 def test_int4_roundtrip_and_memory():
+    """int4 stores two values per byte (PackedQTensor) — jnp.int4 arrays
+    cannot cross a jit boundary on the TPU runtime — and the pack/unpack
+    pair is exact for values in [-7, 7]."""
+    from vgate_tpu.ops.quant import (
+        PackedQTensor,
+        pack_int4,
+        unpack_int4,
+    )
+
     rng = np.random.default_rng(3)
+    # pack/unpack roundtrip is exact
+    vals = jnp.asarray(
+        rng.integers(-7, 8, size=(6, 10, 32)), jnp.int8
+    )
+    np.testing.assert_array_equal(
+        np.asarray(unpack_int4(pack_int4(vals))), np.asarray(vals)
+    )
+
     w = jnp.asarray(rng.normal(size=(64, 128)) * 0.02, jnp.float32)
     qt = quantize_tensor(w, bits=4)
-    assert str(qt.q.dtype) == "int4"
-    deq = qt.q.astype(jnp.float32) * qt.scale
+    assert isinstance(qt, PackedQTensor)
+    assert str(qt.q_packed.dtype) == "uint8"
+    assert qt.q_packed.shape == (32, 128)  # half the in-dim: 2 per byte
+    deq = unpack_int4(qt.q_packed).astype(jnp.float32) * qt.scale
     rel = np.abs(np.asarray(deq - w)).max() / np.abs(np.asarray(w)).max()
     assert rel < 0.08  # 4-bit: ~1/15 of range per channel
 
@@ -191,7 +210,8 @@ def test_int4_engine_end_to_end():
             ["int4 probe"], [SamplingParams(max_tokens=4, temperature=0.0)]
         )
         assert result["num_tokens"] >= 1
-        assert str(core.params["layers"]["q"]["w"].q.dtype) == "int4"
+        qw = core.params["layers"]["q"]["w"]
+        assert str(qw.q_packed.dtype) == "uint8"
     finally:
         core.stop()
 

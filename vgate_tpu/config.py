@@ -153,9 +153,13 @@ class TPUConfig(BaseModel):
     decode_pipeline: int = 2
     # Max prefills admitted per engine tick WHILE sequences are decoding
     # (0 = unlimited).  Bounds the decode stall a prefill burst can cause:
-    # resident slots get a decode chunk between every `prefill_admit_limit`
-    # prompt programs instead of waiting out the whole burst.
-    prefill_admit_limit: int = 2
+    # resident slots get a decode chunk between every admission wave
+    # instead of waiting out the whole burst.  Defaults to one full
+    # batched-prefill program (prefill_batch_max).
+    prefill_admit_limit: int = 8
+    # Same-bucket prompts prefilled in ONE stacked [B, bucket] program
+    # (B pads to a power of two).  Cuts dispatch count ~B-fold for bursts.
+    prefill_batch_max: int = 8
 
 
 class BatchConfig(BaseModel):

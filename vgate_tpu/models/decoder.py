@@ -118,8 +118,13 @@ def _expert_einsum(subscripts, x, w):
     """Per-expert einsum accepting plain or quantized expert weights
     (QTensor scale is per (expert, out-channel): [E, out] broadcasts as
     [E, 1, out] against the [E, C, out] einsum result)."""
-    from vgate_tpu.ops.quant import QTensor
+    from vgate_tpu.ops.quant import PackedQTensor, QTensor, unpack_int4
 
+    if isinstance(w, PackedQTensor):
+        out = jnp.einsum(
+            subscripts, x, unpack_int4(w.q_packed).astype(x.dtype)
+        )
+        return out * w.scale[:, None, :].astype(x.dtype)
     if isinstance(w, QTensor):
         out = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
         return out * w.scale[:, None, :].astype(x.dtype)
@@ -203,8 +208,14 @@ def _logits(params: Params, spec: ModelSpec, x: jnp.ndarray) -> jnp.ndarray:
             preferred_element_type=jnp.float32,
         )
     head = params["lm_head"]
-    from vgate_tpu.ops.quant import QTensor
+    from vgate_tpu.ops.quant import PackedQTensor, QTensor, unpack_int4
 
+    if isinstance(head, PackedQTensor):
+        logits = jnp.einsum(
+            "...d,dv->...v", x, unpack_int4(head.q_packed).astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits * head.scale
     if isinstance(head, QTensor):
         logits = jnp.einsum(
             "...d,dv->...v", x, head.q.astype(x.dtype),

@@ -26,27 +26,72 @@ import jax.numpy as jnp
 class QTensor(NamedTuple):
     """narrow-int values + per-output-channel scale (output dim is last)."""
 
-    q: jnp.ndarray  # int8 or int4, same shape as the original weight
+    q: jnp.ndarray  # int8, same shape as the original weight
     scale: jnp.ndarray  # f32, shape = original.shape[-1:] (or [L, out])
 
 
-_QDTYPES = {8: (jnp.int8, 127), 4: (jnp.int4, 7)}
+class PackedQTensor(NamedTuple):
+    """int4 weights stored two-per-byte (uint8) along the contracted dim.
+
+    jnp.int4 (``S4``) arrays cannot cross a jit boundary on the TPU runtime
+    (device_put relayout recurses), and packed bytes are the honest 4-bit
+    representation anyway — the same layout AWQ uses on GPU.  ``q_packed``
+    has the original shape with dim -2 (the ``in`` dim) halved; byte
+    ``p[..., i, out]`` holds ``w[..., 2i, out]`` in its low nibble and
+    ``w[..., 2i+1, out]`` in its high nibble, two's-complement.
+    """
+
+    q_packed: jnp.ndarray  # uint8 [..., in/2, out]
+    scale: jnp.ndarray  # f32 [..., out]
 
 
-Weight = Union[jnp.ndarray, QTensor]
+_QDTYPES = {8: (jnp.int8, 127), 4: (jnp.int8, 7)}
 
 
-def quantize_tensor(w: jnp.ndarray, bits: int = 8) -> QTensor:
+Weight = Union[jnp.ndarray, QTensor, PackedQTensor]
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """int8 values in [-7, 7], shape [..., in, out] -> uint8 [..., in/2, out]."""
+    if q.shape[-2] % 2:
+        raise ValueError(f"in-dim {q.shape[-2]} must be even to pack int4")
+    pairs = q.astype(jnp.uint8).reshape(
+        *q.shape[:-2], q.shape[-2] // 2, 2, q.shape[-1]
+    )
+    lo = pairs[..., 0, :] & jnp.uint8(0x0F)
+    hi = pairs[..., 1, :] & jnp.uint8(0x0F)
+    return lo | (hi << jnp.uint8(4))
+
+
+def unpack_int4(p: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., in/2, out] -> sign-extended int8 [..., in, out]."""
+
+    def sext(nibble):  # two's-complement 4-bit -> int8
+        return (nibble.astype(jnp.int8) ^ jnp.int8(8)) - jnp.int8(8)
+
+    lo = sext(p & jnp.uint8(0x0F))
+    hi = sext(p >> jnp.uint8(4))
+    stacked = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
+    return stacked.reshape(*p.shape[:-2], p.shape[-2] * 2, p.shape[-1])
+
+
+def _finish(q: jnp.ndarray, scale: jnp.ndarray, bits: int) -> Weight:
+    if bits == 4:
+        return PackedQTensor(q_packed=pack_int4(q), scale=scale)
+    return QTensor(q=q, scale=scale)
+
+
+def quantize_tensor(w: jnp.ndarray, bits: int = 8) -> Weight:
     """Symmetric per-channel int8/int4 over the last (output) dim."""
     dtype, qmax = _QDTYPES[bits]
     w32 = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w32), axis=tuple(range(w.ndim - 1)))
     scale = jnp.maximum(absmax, 1e-8) / qmax
     q = jnp.clip(jnp.round(w32 / scale), -qmax, qmax).astype(dtype)
-    return QTensor(q=q, scale=scale)
+    return _finish(q, scale, bits)
 
 
-def quantize_stacked(w: jnp.ndarray, bits: int = 8) -> QTensor:
+def quantize_stacked(w: jnp.ndarray, bits: int = 8) -> Weight:
     """Quantize a stacked-layer weight [L, ..., out]: per (layer, channel)."""
     dtype, qmax = _QDTYPES[bits]
     w32 = w.astype(jnp.float32)
@@ -58,10 +103,10 @@ def quantize_stacked(w: jnp.ndarray, bits: int = 8) -> QTensor:
         -qmax,
         qmax,
     ).astype(dtype)
-    return QTensor(q=q, scale=scale)
+    return _finish(q, scale, bits)
 
 
-def quantize_expert_stacked(w: jnp.ndarray, bits: int = 8) -> QTensor:
+def quantize_expert_stacked(w: jnp.ndarray, bits: int = 8) -> Weight:
     """Quantize stacked MoE expert weights [L, E, in, out]: the scale is per
     (layer, expert, out-channel) — reducing only the contracted ``in`` dim —
     so each expert keeps its own dynamic range."""
@@ -72,7 +117,7 @@ def quantize_expert_stacked(w: jnp.ndarray, bits: int = 8) -> QTensor:
     q = jnp.clip(
         jnp.round(w32 / scale[..., None, :]), -qmax, qmax
     ).astype(dtype)
-    return QTensor(q=q, scale=scale)
+    return _finish(q, scale, bits)
 
 
 def weighted_einsum(subscripts: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
@@ -80,8 +125,13 @@ def weighted_einsum(subscripts: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
 
     For QTensor the int8 values enter the einsum cast to the activation
     dtype and the per-channel scale multiplies the output's last dim —
-    valid because every decoder weight keeps out-dim last.
+    valid because every decoder weight keeps out-dim last.  PackedQTensor
+    int4 nibbles unpack in-consumer (XLA fuses the byte ops into the
+    convert; only the packed bytes ever sit in HBM).
     """
+    if isinstance(w, PackedQTensor):
+        out = jnp.einsum(subscripts, x, unpack_int4(w.q_packed).astype(x.dtype))
+        return out * w.scale.astype(x.dtype)
     if isinstance(w, QTensor):
         out = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
         return out * w.scale.astype(x.dtype)

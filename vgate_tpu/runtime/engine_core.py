@@ -536,10 +536,13 @@ class EngineCore:
         return jax.random.fold_in(self._base_key, self._step_counter)
 
     def _admit_and_prefill(self) -> bool:
-        """Admit waiting prompts a free slot + pages exist for, dispatching
-        their prefill programs back-to-back WITHOUT blocking, then read all
-        first tokens in one transfer.  The dispatches pipeline on the device
-        queue, so N admissions cost ~one round-trip rather than N.
+        """Admit waiting prompts a free slot + pages exist for, then prefill
+        them in **batched programs**: same-bucket admissions stack into one
+        ``[B, bucket]`` dispatch (B padded to the next power of two, padding
+        rows writing trash page 0), so a burst of N prompts costs
+        ~N/prefill_batch_max dispatches instead of N — the dominant cost
+        over a high-RTT device tunnel.  First tokens for the whole wave are
+        read back in a single transfer.
 
         While sequences are actively decoding, at most
         ``tpu.prefill_admit_limit`` prompts are admitted per tick, so a
@@ -549,72 +552,103 @@ class EngineCore:
         at the reference's vgate/backends/vllm_backend.py:51)."""
         limit = self.config.tpu.prefill_admit_limit
         decoding = bool(self._running_seqs())
-        dispatched = []
+        plans: List[PrefillPlan] = []
         start = time.perf_counter()
         while True:
-            if decoding and limit and len(dispatched) >= limit:
+            if decoding and limit and len(plans) >= limit:
                 break
             plan = self.scheduler.try_admit()
             if plan is None:
                 break
-            dispatched.append((plan.seq, self._dispatch_prefill(plan)))
-        if not dispatched:
+            plans.append(plan)
+        if not plans:
             return False
+        # group same-bucket plans into batched dispatches
+        by_bucket: Dict[int, List[PrefillPlan]] = {}
+        for plan in plans:
+            by_bucket.setdefault(plan.bucket, []).append(plan)
+        batch_max = max(1, self.config.tpu.prefill_batch_max)
+        dispatched = []  # (group plans, [B] device tokens)
+        for bucket, group in sorted(by_bucket.items()):
+            for i in range(0, len(group), batch_max):
+                chunk = group[i : i + batch_max]
+                dispatched.append(
+                    (chunk, self._dispatch_prefill_group(chunk, bucket))
+                )
         firsts = jax.device_get([h for _, h in dispatched])
         # batched admission costs one combined dispatch+readback; attribute
         # an equal share to each prefill so observation count stays
         # one-per-prefill and the histogram sum stays the true wall time
-        share = (time.perf_counter() - start) / len(dispatched)
-        for _ in dispatched:
+        share = (time.perf_counter() - start) / len(plans)
+        for _ in plans:
             metrics.ENGINE_STEP_TIME.labels(kind="prefill").observe(share)
-        for (seq, _), first in zip(dispatched, firsts):
-            token = int(np.asarray(first)[0])
-            self.total_prefills += 1
-            seq.append_token(token)
-            self._maybe_finish(seq, token)
+        for (group, _), tokens in zip(dispatched, firsts):
+            arr = np.asarray(tokens)
+            for row, plan in enumerate(group):
+                token = int(arr[row])
+                self.total_prefills += 1
+                plan.seq.append_token(token)
+                self._maybe_finish(plan.seq, token)
         return True
 
-    def _dispatch_prefill(self, plan: PrefillPlan):
-        """Launch one prefill program; returns the (async) first-token
-        device array."""
-        seq, bucket = plan.seq, plan.bucket
+    def _dispatch_prefill_group(self, plans: List[PrefillPlan], bucket: int):
+        """Launch ONE prefill program for up to prefill_batch_max same-
+        bucket sequences; returns the (async) [B] first-token device array.
+        B pads to a power of two so the compile ladder stays small
+        ({1,2,4,...,prefill_batch_max} x buckets); padding rows use trash
+        page tables, temp 0 and seq_len 1 — their sampled tokens are
+        discarded at readback."""
+        n = len(plans)
+        B = 1 << (n - 1).bit_length()  # next power of two
         ps = self.geometry.page_size
-        n_prompt = seq.num_prompt_tokens
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n_prompt] = seq.prompt_ids
-        # page table row for this prefill: real pages then trash padding
-        row = np.zeros((self.geometry.pages_per_seq,), np.int32)
-        row[: len(seq.pages)] = seq.pages
-        self._page_tables_np[plan.slot] = row
         n_bucket_pages = bucket // ps
-        prefill_pt = np.zeros((1, n_bucket_pages), np.int32)
-        prefill_pt[0, : len(seq.pages)] = seq.pages[:n_bucket_pages]
-
-        sp = seq.params
-        if bucket not in self._compiled_buckets:
+        tokens = np.zeros((B, bucket), np.int32)
+        seq_lens = np.ones((B,), np.int32)
+        prefill_pt = np.zeros((B, n_bucket_pages), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        seeds = np.full((B,), -1, np.int32)
+        steps = np.zeros((B,), np.int32)
+        for row, plan in enumerate(plans):
+            seq = plan.seq
+            n_prompt = seq.num_prompt_tokens
+            tokens[row, :n_prompt] = seq.prompt_ids
+            seq_lens[row] = n_prompt
+            # decode-side page table row: real pages then trash padding
+            slot_row = self._page_tables_np[plan.slot]
+            slot_row[:] = 0
+            slot_row[: len(seq.pages)] = seq.pages
+            prefill_pt[row, : len(seq.pages)] = seq.pages[:n_bucket_pages]
+            sp = seq.params
+            temps[row] = sp.temperature
+            top_ps[row] = sp.top_p
+            top_ks[row] = sp.top_k
+            if sp.seed is not None:
+                # token i always draws from (seed, i): the prefill samples
+                # token index num_generated (0 fresh, >0 after preemption)
+                seeds[row] = sp.seed
+            steps[row] = seq.num_generated
+        key = (bucket, B)
+        if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
-            self._compiled_buckets.add(bucket)
+            self._compiled_buckets.add(key)
         next_tokens, self.k_pages, self.v_pages = _prefill_step(
             self.params,
             self.spec,
             jnp.asarray(tokens),
-            jnp.asarray([n_prompt], jnp.int32),
+            jnp.asarray(seq_lens),
             self.k_pages,
             self.v_pages,
             jnp.asarray(prefill_pt),
-            jnp.asarray([sp.temperature], jnp.float32),
-            jnp.asarray([sp.top_p], jnp.float32),
-            jnp.asarray([sp.top_k], jnp.int32),
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
             self._step_key(),
             mesh=self._fwd_mesh,
             use_pallas=self.use_pallas,
-            # per-request seed: token i always draws from (seed, i) — the
-            # prefill samples token index num_generated (0 fresh, >0 after
-            # a preemption recompute)
-            seeds=jnp.asarray(
-                [sp.seed if sp.seed is not None else -1], jnp.int32
-            ),
-            steps=jnp.asarray([seq.num_generated], jnp.int32),
+            seeds=jnp.asarray(seeds),
+            steps=jnp.asarray(steps),
         )
         return next_tokens
 
@@ -843,7 +877,10 @@ class EngineCore:
         smallest) prefill buckets so first requests don't pay XLA compile
         latency.  The first warmup sequence generates ``2*decode_chunk``
         tokens, which walks the power-of-two chunk descent (K, ..., 2, 1)
-        that _pick_chunk produces near a budget boundary."""
+        that _pick_chunk produces near a budget boundary.  For the first
+        bucket the batched-prefill ladder (B = batch_max, ..., 2, 1) is
+        also compiled: each group is submitted as one burst so it admits
+        as a single stacked program."""
         start = time.perf_counter()
         was_running = self._running
         if not was_running:
@@ -857,6 +894,16 @@ class EngineCore:
             n = max(1, min(bucket - 1, 8))
             seq = self.submit_tokens([5] * n, ladder if i == 0 else single)
             seq.done_event.wait(timeout=600)
+            if i == 0:
+                B = max(1, self.config.tpu.prefill_batch_max)
+                while B >= 2:
+                    group = [
+                        self.submit_tokens([5] * n, single)
+                        for _ in range(min(B, self.max_slots))
+                    ]
+                    for g in group:
+                        g.done_event.wait(timeout=600)
+                    B //= 2
         if not was_running:
             self.stop()
         return time.perf_counter() - start
