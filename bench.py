@@ -115,11 +115,11 @@ def main() -> None:
         dtype = "bfloat16"
         n_requests, prompt_len, max_tokens = 128, 120, 128
         # tunables (VGT_BENCH_* env for sweeps; defaults are the tuned best)
-        slots = int(os.environ.get("VGT_BENCH_SLOTS", 64))
+        slots = int(os.environ.get("VGT_BENCH_SLOTS", 128))
         kv_pages = 0  # auto-size from HBM
         buckets = [128]
         max_model_len = 512  # covers prompt+output; keeps page tables tight
-        decode_chunk = int(os.environ.get("VGT_BENCH_CHUNK", 16))
+        decode_chunk = int(os.environ.get("VGT_BENCH_CHUNK", 64))
     else:  # CI smoke fallback
         model_id = "tiny-dense"
         dtype = "float32"
