@@ -40,6 +40,54 @@ def _build_hf_dense():
     return model
 
 
+import dataclasses
+
+# Llama-3 family: same decoder skeleton as Qwen2 minus qkv bias, with its
+# own rope/eps; Mistral dense: the Mixtral attention/MLP without experts.
+TINY_LLAMA = dataclasses.replace(
+    TINY_DENSE, name="tiny-llama", qkv_bias=False, rms_eps=1e-5,
+    rope_theta=500_000.0,
+)
+TINY_MISTRAL = dataclasses.replace(
+    TINY_DENSE, name="tiny-mistral", qkv_bias=False, rms_eps=1e-5,
+    rope_theta=1_000_000.0,
+)
+
+
+def _build_hf_llama():
+    config = transformers.LlamaConfig(
+        vocab_size=TINY_LLAMA.vocab_size,
+        hidden_size=TINY_LLAMA.hidden_size,
+        num_hidden_layers=TINY_LLAMA.num_layers,
+        num_attention_heads=TINY_LLAMA.num_heads,
+        num_key_value_heads=TINY_LLAMA.num_kv_heads,
+        intermediate_size=TINY_LLAMA.intermediate_size,
+        rope_theta=TINY_LLAMA.rope_theta,
+        rms_norm_eps=TINY_LLAMA.rms_eps,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(2)
+    return transformers.LlamaForCausalLM(config).eval()
+
+
+def _build_hf_mistral():
+    config = transformers.MistralConfig(
+        vocab_size=TINY_MISTRAL.vocab_size,
+        hidden_size=TINY_MISTRAL.hidden_size,
+        num_hidden_layers=TINY_MISTRAL.num_layers,
+        num_attention_heads=TINY_MISTRAL.num_heads,
+        num_key_value_heads=TINY_MISTRAL.num_kv_heads,
+        intermediate_size=TINY_MISTRAL.intermediate_size,
+        rope_theta=TINY_MISTRAL.rope_theta,
+        rms_norm_eps=TINY_MISTRAL.rms_eps,
+        tie_word_embeddings=False,
+        sliding_window=None,
+    )
+    torch.manual_seed(3)
+    return transformers.MistralForCausalLM(config).eval()
+
+
 def _build_hf_moe():
     config = transformers.MixtralConfig(
         vocab_size=TINY_MOE.vocab_size,
@@ -87,8 +135,13 @@ def _hf_last_logits(model, token_rows):
 
 @pytest.mark.parametrize(
     "spec,builder,seed",
-    [(TINY_DENSE, _build_hf_dense, 0), (TINY_MOE, _build_hf_moe, 1)],
-    ids=["qwen2-dense", "mixtral-moe"],
+    [
+        (TINY_DENSE, _build_hf_dense, 0),
+        (TINY_MOE, _build_hf_moe, 1),
+        (TINY_LLAMA, _build_hf_llama, 2),
+        (TINY_MISTRAL, _build_hf_mistral, 3),
+    ],
+    ids=["qwen2-dense", "mixtral-moe", "llama3", "mistral"],
 )
 def test_prefill_logits_match_hf(spec, builder, seed):
     qkv_bias = spec.qkv_bias

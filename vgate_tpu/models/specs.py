@@ -119,6 +119,45 @@ MIXTRAL_8X7B = _register(
     )
 )
 
+LLAMA3_8B = _register(
+    ModelSpec(
+        name="meta-llama/Meta-Llama-3-8B-Instruct",
+        vocab_size=128256,
+        hidden_size=4096,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        rope_theta=500_000.0,
+        rms_eps=1e-5,
+        qkv_bias=False,
+        tie_embeddings=False,
+        eos_token_id=128009,
+        bos_token_id=128000,
+        max_position_embeddings=8192,
+    )
+)
+
+MISTRAL_7B = _register(
+    ModelSpec(
+        name="mistralai/Mistral-7B-Instruct-v0.3",
+        vocab_size=32768,
+        hidden_size=4096,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        rope_theta=1_000_000.0,
+        rms_eps=1e-5,
+        qkv_bias=False,
+        tie_embeddings=False,
+        eos_token_id=2,
+        bos_token_id=1,
+    )
+)
+
 BGE_BASE = _register(
     ModelSpec(
         name="BAAI/bge-base-en-v1.5",
