@@ -160,6 +160,10 @@ class TPUConfig(BaseModel):
     # Same-bucket prompts prefilled in ONE stacked [B, bucket] program
     # (B pads to a power of two).  Cuts dispatch count ~B-fold for bursts.
     prefill_batch_max: int = 8
+    # Automatic prefix caching: full prompt pages are content-hashed and
+    # shared across requests; a prefix hit prefills only the suffix.
+    # Disabled automatically when sp>1 or pp>1 (those reshape the prefill).
+    prefix_cache: bool = True
 
 
 class BatchConfig(BaseModel):

@@ -31,6 +31,7 @@ from vgate_tpu.models.specs import ModelSpec
 from vgate_tpu.ops.attention import (
     flash_prefill_attention,
     paged_decode_attention,
+    paged_suffix_attention,
 )
 from vgate_tpu.ops.norms import rms_norm
 from vgate_tpu.ops.quant import weighted_einsum
@@ -290,23 +291,21 @@ def prefill_forward(
     return _logits(params, spec, last_hidden), k_pages, v_pages
 
 
-def prefill_layer(
-    h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, seq_lens, page_tables,
-    attn_fn,
+def _prefill_qkv_write(
+    h, lp, spec: ModelSpec, positions, page_tables, k_pages_l, v_pages_l
 ):
-    """One transformer layer of the prompt pass (shared by the plain scan
-    path above and the pipeline-parallel stage scan)."""
+    """Shared prompt-pass front half: norm + qkv projection + rope at the
+    given (possibly offset) positions, then write this layer's KV into its
+    pages (trash-page-0 absorbs padding).  Pages are head-major
+    [KV, P, ps, hd]: the fresh KV transposes to [KV, B, n_pages, ps, hd]
+    so each head's pages land contiguously."""
     B, S = h.shape[:2]
     ps = k_pages_l.shape[2]
     n_pages = S // ps
-    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
     q, k, v = _project_qkv(normed, lp, spec)
     q = apply_rope(q, positions, spec.rope_theta)
     k = apply_rope(k, positions, spec.rope_theta)
-    # Write this layer's KV into its pages (trash-page-0 absorbs padding).
-    # Pages are head-major [KV, P, ps, hd]: transpose the fresh KV to
-    # [KV, B, n_pages, ps, hd] so each head's pages land contiguously.
     k_resh = jnp.transpose(
         k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
         (3, 0, 1, 2, 4),
@@ -318,12 +317,30 @@ def prefill_layer(
     pt = page_tables[:, :n_pages]
     k_pages_l = k_pages_l.at[:, pt].set(k_resh)
     v_pages_l = v_pages_l.at[:, pt].set(v_resh)
-    attn = attn_fn(q, k, v, seq_lens)
-    attn = attn.reshape(B, S, spec.q_dim)
+    return q, k, v, k_pages_l, v_pages_l
+
+
+def _finish_layer(h, attn, lp, spec: ModelSpec):
+    """Shared layer back half: o-projection residual + post-norm MLP."""
+    attn = attn.reshape(*h.shape[:-1], spec.q_dim)
     h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
     normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
-    h = h + _mlp(normed2, lp, spec)
-    return h, k_pages_l, v_pages_l
+    return h + _mlp(normed2, lp, spec)
+
+
+def prefill_layer(
+    h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, seq_lens, page_tables,
+    attn_fn,
+):
+    """One transformer layer of the prompt pass (shared by the plain scan
+    path above and the pipeline-parallel stage scan)."""
+    B, S = h.shape[:2]
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    q, k, v, k_pages_l, v_pages_l = _prefill_qkv_write(
+        h, lp, spec, positions, page_tables, k_pages_l, v_pages_l
+    )
+    attn = attn_fn(q, k, v, seq_lens)
+    return _finish_layer(h, attn, lp, spec), k_pages_l, v_pages_l
 
 
 def decode_layer(
@@ -413,3 +430,53 @@ def decode_forward(
         layer_fn, x, (params["layers"], k_pages, v_pages)
     )
     return _logits(params, spec, x), k_pages, v_pages
+
+
+def prefill_suffix_forward(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,  # [B, S] suffix tokens, S a bucket, S % ps == 0
+    prefix_lens: jnp.ndarray,  # [B] cached tokens already resident (page-aligned)
+    suffix_lens: jnp.ndarray,  # [B] real suffix tokens (<= S)
+    k_pages: jnp.ndarray,  # [L, KV, P, ps, hd]
+    v_pages: jnp.ndarray,
+    suffix_page_tables: jnp.ndarray,  # [B, S // ps] pages the suffix fills
+    ctx_page_tables: jnp.ndarray,  # [B, ctx_pages] window covering prefix+suffix
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prompt pass for only the uncached suffix of a prefix-cache hit.
+
+    The first ``prefix_lens`` tokens' KV is already resident in shared
+    pages (runtime/kv_cache.py prefix caching) — this writes just the
+    suffix KV into its own pages (the suffix starts page-aligned, so it
+    packs pages from offset 0 exactly like a fresh prefill) and attends
+    suffix-queries vs the paged context window (ops/attention.py
+    paged_suffix_attention, blockwise).  The saved work is the whole
+    prefix prompt pass: O(prefix) projections + O(S * prefix) attention
+    FLOPs never run.  Returns (last-token logits [B, V], k_pages,
+    v_pages).
+    """
+    B, S = tokens.shape
+    positions = prefix_lens[:, None] + jnp.arange(S)[None, :]  # absolute
+    total_lens = prefix_lens + suffix_lens
+    x = params["embed"][tokens]  # [B, S, D]
+
+    def layer_fn(h, per_layer):
+        lp, k_pages_l, v_pages_l = per_layer
+        q, _k, _v, k_pages_l, v_pages_l = _prefill_qkv_write(
+            h, lp, spec, positions, suffix_page_tables, k_pages_l,
+            v_pages_l,
+        )
+        attn = paged_suffix_attention(
+            q, k_pages_l, v_pages_l, ctx_page_tables, prefix_lens,
+            total_lens,
+        )
+        return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_pages, v_pages)
+    )
+    last_idx = jnp.clip(suffix_lens - 1, 0, S - 1)
+    last_hidden = jnp.take_along_axis(
+        x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1
+    )[:, 0]
+    return _logits(params, spec, last_hidden), k_pages, v_pages

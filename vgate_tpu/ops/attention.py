@@ -174,3 +174,41 @@ def paged_decode_attention(
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)
+
+
+def paged_suffix_attention(
+    q: jnp.ndarray,  # [B, S, H, hd] suffix queries
+    k_pages: jnp.ndarray,  # [KV, P, page_size, hd] (head-major)
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, ctx_pages] int32 (context window row)
+    prefix_lens: jnp.ndarray,  # [B] global position of q[:, 0]
+    seq_lens: jnp.ndarray,  # [B] total context (prefix + real suffix)
+) -> jnp.ndarray:
+    """Prompt-suffix attention over resident paged KV (prefix caching).
+
+    The suffix tokens' KV has already been written into the page pool; this
+    gathers each slot's page window — shared prefix pages plus the fresh
+    suffix, bounded by the caller-bucketed ``ctx_pages`` — and runs the
+    same blockwise online-softmax as flash_prefill_attention (its
+    ``q_offset`` mode IS the suffix mask: ``k_pos <= prefix + s`` and
+    ``k_pos < seq_len``), so no [B, H, S, ctx] score materialization.
+    A Pallas kernel streaming only live pages is the natural follow-up.
+    Returns [B, S, H, hd].
+    """
+    B = q.shape[0]
+    KV = k_pages.shape[0]
+    hd = k_pages.shape[3]
+    ctx = page_tables.shape[1] * k_pages.shape[2]
+
+    k = jnp.moveaxis(
+        k_pages[:, page_tables].reshape(KV, B, ctx, hd), 0, 2
+    )
+    v = jnp.moveaxis(
+        v_pages[:, page_tables].reshape(KV, B, ctx, hd), 0, 2
+    )
+    # key blocks must divide the window; fall back to page-sized blocks
+    # for windows that aren't a multiple of 256 tokens
+    block_k = 256 if ctx % 256 == 0 else k_pages.shape[2]
+    return flash_prefill_attention(
+        q, k, v, seq_lens, block_k=block_k, q_offset=prefix_lens
+    )

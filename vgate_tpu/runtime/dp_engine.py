@@ -192,10 +192,24 @@ class ReplicatedEngine:
                 "kv_token_capacity",
             )
         }
-        agg["scheduler"] = {
-            key: sum(s["scheduler"][key] for s in per_replica)
-            for key in per_replica[0]["scheduler"]
-        }
+        agg["scheduler"] = {}
+        for key, val in per_replica[0]["scheduler"].items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                agg["scheduler"][key] = sum(
+                    s["scheduler"][key] for s in per_replica
+                )
+            elif isinstance(val, dict):
+                # nested stat groups (e.g. prefix_cache): sum the numeric
+                # sub-keys so DP deployments keep cache observability
+                agg["scheduler"][key] = {
+                    k2: (
+                        sum(s["scheduler"][key][k2] for s in per_replica)
+                        if isinstance(v2, (int, float))
+                        and not isinstance(v2, bool)
+                        else v2
+                    )
+                    for k2, v2 in val.items()
+                }
         agg["model"] = self.spec.name
         agg["dp"] = len(self.replicas)
         agg["mesh"] = dict(per_replica[0]["mesh"], dp=len(self.replicas))

@@ -43,7 +43,11 @@ from vgate_tpu import metrics
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.logging_config import get_logger
-from vgate_tpu.models.decoder import decode_forward, prefill_forward
+from vgate_tpu.models.decoder import (
+    decode_forward,
+    prefill_forward,
+    prefill_suffix_forward,
+)
 from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
 from vgate_tpu.ops.sampling import sample_tokens
 from vgate_tpu.parallel.mesh import build_mesh, initialize_distributed
@@ -82,6 +86,28 @@ def _prefill_step(
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
         mesh=mesh, use_pallas=use_pallas,
+    )
+    next_tokens = sample_tokens(
+        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
+    )
+    return next_tokens, k_pages, v_pages
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("spec",),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def _suffix_prefill_step(
+    params, spec: ModelSpec, tokens, prefix_lens, suffix_lens, k_pages,
+    v_pages, suffix_page_tables, ctx_page_tables, temps, top_ps, top_ks,
+    key, seeds=None, steps=None,
+):
+    """Prompt pass for the uncached suffix of a prefix-cache hit, with
+    fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
+    logits, k_pages, v_pages = prefill_suffix_forward(
+        params, spec, tokens, prefix_lens, suffix_lens, k_pages, v_pages,
+        suffix_page_tables, ctx_page_tables,
     )
     next_tokens = sample_tokens(
         logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
@@ -245,6 +271,13 @@ class EngineCore:
         )
         self.allocator = PageAllocator(num_pages)
         self.max_slots = tpu_cfg.max_batch_slots
+        # prefix caching requires the plain-scan suffix prefill path; the
+        # sp ring and pp relay reshape the prompt pass incompatibly
+        mesh_sp = int(self.mesh.shape.get("sp", 1))
+        mesh_pp = int(self.mesh.shape.get("pp", 1))
+        self.prefix_cache_enabled = bool(
+            tpu_cfg.prefix_cache and mesh_sp == 1 and mesh_pp == 1
+        )
         self.scheduler = Scheduler(
             allocator=self.allocator,
             max_slots=self.max_slots,
@@ -256,6 +289,7 @@ class EngineCore:
             admission_deadline_ms=(
                 self.config.scheduler.admission_deadline_ms
             ),
+            prefix_cache=self.prefix_cache_enabled,
         )
 
         # host-side mirror of the device page tables, one row per slot
@@ -563,18 +597,30 @@ class EngineCore:
             plans.append(plan)
         if not plans:
             return False
-        # group same-bucket plans into batched dispatches
-        by_bucket: Dict[int, List[PrefillPlan]] = {}
+        # group same-bucket plans into batched dispatches; prefix-cache
+        # hits (suffix-only prompt pass) compile a different program and
+        # group separately
+        by_bucket: Dict[tuple, List[PrefillPlan]] = {}
         for plan in plans:
-            by_bucket.setdefault(plan.bucket, []).append(plan)
+            key = (plan.bucket, plan.cached_len > 0)
+            by_bucket.setdefault(key, []).append(plan)
         batch_max = max(1, self.config.tpu.prefill_batch_max)
         dispatched = []  # (group plans, [B] device tokens)
-        for bucket, group in sorted(by_bucket.items()):
+        for (bucket, cached), group in sorted(by_bucket.items()):
             for i in range(0, len(group), batch_max):
                 chunk = group[i : i + batch_max]
-                dispatched.append(
-                    (chunk, self._dispatch_prefill_group(chunk, bucket))
+                fn = (
+                    self._dispatch_suffix_group
+                    if cached
+                    else self._dispatch_prefill_group
                 )
+                dispatched.append((chunk, fn(chunk, bucket)))
+        # index the freshly-filled prompt pages only now, with every
+        # writer program enqueued: a reader admitted in a LATER tick is
+        # guaranteed to dispatch after the writer (device program order)
+        for plan in plans:
+            for page, h in plan.register_hashes or ():
+                self.allocator.register(page, h)
         firsts = jax.device_get([h for _, h in dispatched])
         # batched admission costs one combined dispatch+readback; attribute
         # an equal share to each prefill so observation count stays
@@ -647,6 +693,77 @@ class EngineCore:
             self._step_key(),
             mesh=self._fwd_mesh,
             use_pallas=self.use_pallas,
+            seeds=jnp.asarray(seeds),
+            steps=jnp.asarray(steps),
+        )
+        return next_tokens
+
+    def _dispatch_suffix_group(self, plans: List[PrefillPlan], bucket: int):
+        """Launch ONE suffix-prefill program for up to prefill_batch_max
+        prefix-cache hits whose suffix lengths share a bucket.  The cached
+        prefix pages are read-only shared KV; only the suffix pages are
+        written.  Returns the (async) [B] first-token device array."""
+        n = len(plans)
+        B = 1 << (n - 1).bit_length()
+        ps = self.geometry.page_size
+        n_suffix_pages = bucket // ps
+        # context window bucketed to a power of two of pages: bounds both
+        # the KV gather and the compile-variant count
+        max_ctx_pages = max(
+            cdiv(p.seq.num_prompt_tokens, ps) for p in plans
+        )
+        ctx_pages = min(
+            self.geometry.pages_per_seq,
+            1 << max(0, max_ctx_pages - 1).bit_length(),
+        )
+        tokens = np.zeros((B, bucket), np.int32)
+        prefix_lens = np.zeros((B,), np.int32)
+        suffix_lens = np.ones((B,), np.int32)
+        suffix_pt = np.zeros((B, n_suffix_pages), np.int32)
+        full_pt = np.zeros((B, ctx_pages), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        seeds = np.full((B,), -1, np.int32)
+        steps = np.zeros((B,), np.int32)
+        for row, plan in enumerate(plans):
+            seq = plan.seq
+            cached_pages = plan.cached_len // ps
+            suffix = seq.prompt_ids[plan.cached_len :]
+            tokens[row, : len(suffix)] = suffix
+            prefix_lens[row] = plan.cached_len
+            suffix_lens[row] = len(suffix)
+            own = seq.pages[cached_pages:]
+            suffix_pt[row, : len(own)] = own[:n_suffix_pages]
+            slot_row = self._page_tables_np[plan.slot]
+            slot_row[:] = 0
+            slot_row[: len(seq.pages)] = seq.pages
+            full_pt[row, : len(seq.pages)] = seq.pages[:ctx_pages]
+            sp = seq.params
+            temps[row] = sp.temperature
+            top_ps[row] = sp.top_p
+            top_ks[row] = sp.top_k
+            if sp.seed is not None:
+                seeds[row] = sp.seed
+            steps[row] = seq.num_generated
+        key = ("suffix", bucket, B, ctx_pages)
+        if key not in self._compiled_buckets:
+            metrics.RECOMPILES.labels(kind="prefill").inc()
+            self._compiled_buckets.add(key)
+        next_tokens, self.k_pages, self.v_pages = _suffix_prefill_step(
+            self.params,
+            self.spec,
+            jnp.asarray(tokens),
+            jnp.asarray(prefix_lens),
+            jnp.asarray(suffix_lens),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(suffix_pt),
+            jnp.asarray(full_pt),
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
+            self._step_key(),
             seeds=jnp.asarray(seeds),
             steps=jnp.asarray(steps),
         )

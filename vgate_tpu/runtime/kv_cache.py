@@ -12,9 +12,9 @@ never branches on validity.
 
 from __future__ import annotations
 
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -108,27 +108,60 @@ def auto_num_pages(
 
 
 class PageAllocator:
-    """Free-list allocator over page ids 1..num_pages-1 (0 is trash)."""
+    """Refcounting free-list allocator over page ids 1..num_pages-1 (0 is
+    trash), with a content-hash index for **automatic prefix caching**.
+
+    A page whose content corresponds to a full page of prompt tokens can be
+    ``register``ed under a chain hash; a later prompt with the same prefix
+    ``lookup``s the hash and shares the page (refcount++) instead of
+    recomputing its KV.  Pages released to refcount 0 keep their content and
+    park in an LRU of *evictable* cached pages — reusable until ``allocate``
+    needs the space (vLLM's automatic-prefix-caching capability, which the
+    reference can't reach because vLLM hides it; here it is first-party).
+    """
 
     def __init__(self, num_pages: int) -> None:
         self.num_pages = num_pages
         self._free: Deque[int] = deque(range(1, num_pages))
+        self._refs: Dict[int, int] = {}
+        self._hash_to_page: Dict[int, int] = {}
+        self._page_hash: Dict[int, int] = {}
+        # refcount-0 pages with live cached content, in LRU order
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_evictions = 0
         metrics.KV_PAGES_TOTAL.set(num_pages - 1)
         metrics.KV_PAGES_IN_USE.set(0)
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        """Pages obtainable by allocate(): truly free + evictable cached."""
+        return len(self._free) + len(self._evictable)
 
     @property
     def num_used(self) -> int:
-        return (self.num_pages - 1) - len(self._free)
+        return (self.num_pages - 1) - self.num_free
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._evictable)
 
     def allocate(self, n: int) -> Optional[List[int]]:
-        """All-or-nothing allocation of n pages; None when insufficient."""
-        if n > len(self._free):
+        """All-or-nothing allocation of n pages; None when insufficient.
+        Evicts least-recently-used cached pages when the free list runs
+        short."""
+        if n > self.num_free:
             return None
-        pages = [self._free.popleft() for _ in range(n)]
+        pages = []
+        for _ in range(n):
+            if self._free:
+                page = self._free.popleft()
+            else:  # evict the LRU cached page
+                page, _ = self._evictable.popitem(last=False)
+                self._drop_hash(page)
+                self.prefix_evictions += 1
+            self._refs[page] = 1
+            pages.append(page)
         metrics.KV_PAGES_IN_USE.set(self.num_used)
         return pages
 
@@ -136,8 +169,47 @@ class PageAllocator:
         for page in pages:
             if not 1 <= page < self.num_pages:
                 raise ValueError(f"bad page id {page}")
-            self._free.append(page)
+            refs = self._refs.get(page, 1) - 1
+            if refs > 0:
+                self._refs[page] = refs
+                continue
+            self._refs.pop(page, None)
+            if page in self._page_hash:
+                # content stays reusable until evicted
+                self._evictable[page] = None
+                self._evictable.move_to_end(page)
+            else:
+                self._free.append(page)
         metrics.KV_PAGES_IN_USE.set(self.num_used)
+
+    # ----------------------------------------------------- prefix caching
+
+    def register(self, page: int, content_hash: int) -> None:
+        """Index a page's content under its prefix-chain hash.  On a hash
+        collision with a live mapping, the existing page wins (both hold
+        identical content by construction)."""
+        if content_hash in self._hash_to_page:
+            return
+        self._hash_to_page[content_hash] = page
+        self._page_hash[page] = content_hash
+
+    def lookup(self, content_hash: int) -> Optional[int]:
+        """Find a cached page for this hash and take a reference to it."""
+        page = self._hash_to_page.get(content_hash)
+        if page is None:
+            return None
+        if page in self._evictable:  # revive a parked page
+            del self._evictable[page]
+            self._refs[page] = 1
+        else:
+            self._refs[page] = self._refs.get(page, 0) + 1
+        metrics.KV_PAGES_IN_USE.set(self.num_used)
+        return page
+
+    def _drop_hash(self, page: int) -> None:
+        h = self._page_hash.pop(page, None)
+        if h is not None and self._hash_to_page.get(h) == page:
+            del self._hash_to_page[h]
 
 
 def make_kv_buffers(geometry: KVGeometry, dtype=jnp.bfloat16, sharding=None):

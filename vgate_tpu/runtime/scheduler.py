@@ -18,6 +18,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, List, Optional, Union
 
+import numpy as np
+
 from vgate_tpu import metrics
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.kv_cache import PageAllocator
@@ -43,6 +45,12 @@ class PrefillPlan:
     seq: Sequence
     slot: int
     bucket: int  # padded sequence length for this prefill program
+    # prefix-cache reuse: the first cached_len prompt tokens' KV is already
+    # resident in shared pages; only the suffix needs the prompt pass.
+    # `bucket` then buckets the SUFFIX length, and register_hashes lists
+    # (page, chain_hash) pairs to index once this prefill is dispatched.
+    cached_len: int = 0
+    register_hashes: list = None  # type: ignore[assignment]
 
 
 @dataclass
@@ -64,6 +72,7 @@ class Scheduler:
         max_queue_size: int = 512,
         preempt_on_oom: bool = True,
         admission_deadline_ms: float = 0.0,
+        prefix_cache: bool = False,
     ) -> None:
         self.allocator = allocator
         self.page_size = page_size
@@ -83,6 +92,8 @@ class Scheduler:
         self.preempt_on_oom = preempt_on_oom
         self.admission_deadline_ms = admission_deadline_ms
         self.total_deadline_shed = 0
+        self.prefix_cache = prefix_cache
+        self.total_prefix_hit_tokens = 0
         self.waiting: Deque[Sequence] = deque()
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.total_preemptions = 0
@@ -179,6 +190,38 @@ class Scheduler:
                 extra={"extra_data": {"shed": shed}},
             )
 
+    def _prefix_chain(self, seq: Sequence) -> List[bytes]:
+        """Chain digests, one per full prompt page, cached on the sequence
+        (re-admission attempts under memory pressure must not rehash the
+        prompt every tick).  sha256 over the token bytes — a collision
+        would silently share another request's KV (the weakness behind
+        vLLM's prefix-cache CVE-2025-25183), so the builtin hash() is not
+        acceptable here."""
+        import hashlib
+
+        key = (len(seq.prompt_ids), seq.preempt_count)
+        cached = getattr(seq, "_prefix_chain_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        n_full = seq.num_prompt_tokens // self.page_size
+        # never match the ENTIRE prompt: the prefill program must run at
+        # least one real token to produce the first sampled token
+        if n_full * self.page_size == seq.num_prompt_tokens:
+            n_full -= 1
+        chain: List[bytes] = []
+        h = b""
+        for i in range(n_full):
+            block = np.asarray(
+                seq.prompt_ids[
+                    i * self.page_size : (i + 1) * self.page_size
+                ],
+                np.int64,
+            ).tobytes()
+            h = hashlib.sha256(h + block).digest()
+            chain.append(h)
+        seq._prefix_chain_cache = (key, chain)  # type: ignore[attr-defined]
+        return chain
+
     def try_admit(self) -> Optional[PrefillPlan]:
         self._shed_expired()
         if not self.waiting:
@@ -188,8 +231,22 @@ class Scheduler:
             return None
         seq = self.waiting[0]
         n_pages = cdiv(max(1, seq.num_prompt_tokens), self.page_size)
-        pages = self.allocator.allocate(n_pages)
+
+        # prefix cache: match the longest chain of full prompt pages
+        # already resident; only the remainder allocates + prefills
+        matched: List[int] = []
+        chain: List[bytes] = []
+        if self.prefix_cache:
+            chain = self._prefix_chain(seq)
+            for h in chain:
+                page = self.allocator.lookup(h)
+                if page is None:
+                    break
+                matched.append(page)
+
+        pages = self.allocator.allocate(n_pages - len(matched))
         if pages is None:
+            self.allocator.release(matched)
             if self.preempt_on_oom and not self.running:
                 # nothing to preempt and still no memory: the prompt can
                 # never fit — fail it rather than deadlock
@@ -203,14 +260,32 @@ class Scheduler:
             return None
         self.waiting.popleft()
         metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
-        seq.pages = pages
+        seq.pages = matched + pages
         seq.slot = slot
         seq.status = SeqStatus.RUNNING
         self.slots[slot] = seq
         self.total_admitted += 1
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
-        bucket = bucket_for(seq.num_prompt_tokens, self.prefill_buckets)
-        return PrefillPlan(seq=seq, slot=slot, bucket=bucket)
+        cached_len = len(matched) * self.page_size
+        self.total_prefix_hit_tokens += cached_len
+        # hits count only on successful admission (a failed allocate above
+        # rolls the references back and must not inflate the stat)
+        self.allocator.prefix_hits += len(matched)
+        # pages this prefill will fill (full prompt pages beyond the
+        # matched prefix), for the ENGINE to index AFTER it dispatched the
+        # program — registering here would let a same-tick reader's
+        # program be grouped ahead of this writer's and gather unwritten
+        # pages (same-wave identical prompts are the batcher dedup's job)
+        register_hashes = [
+            (seq.pages[i], chain[i]) for i in range(len(matched), len(chain))
+        ]
+        bucket = bucket_for(
+            seq.num_prompt_tokens - cached_len, self.prefill_buckets
+        )
+        return PrefillPlan(
+            seq=seq, slot=slot, bucket=bucket, cached_len=cached_len,
+            register_hashes=register_hashes,
+        )
 
     def prepare_decode(
         self, active: List[Sequence], horizon: int = 1
@@ -310,4 +385,11 @@ class Scheduler:
             "finished": self.total_finished,
             "preemptions": self.total_preemptions,
             "deadline_shed": self.total_deadline_shed,
+            "prefix_cache": {
+                "enabled": self.prefix_cache,
+                "hit_tokens": self.total_prefix_hit_tokens,
+                "hit_pages": self.allocator.prefix_hits,
+                "cached_pages": self.allocator.num_cached,
+                "evictions": self.allocator.prefix_evictions,
+            },
         }
