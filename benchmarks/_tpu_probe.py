@@ -1,0 +1,43 @@
+"""Shared wedge-aware TPU probe for benchmark scripts.
+
+A killed mid-op process wedges the axon TPU grant for minutes
+(`UNAVAILABLE` at backend init) and an in-process failed probe poisons
+jax's backend cache, so availability is checked in a SUBPROCESS with
+backoff before the benchmark imports jax (same recipe as bench.py's
+_probe_accelerator)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+_PROBE = (
+    "import jax, json; d = jax.devices()[0]; "
+    "print(json.dumps({'platform': d.platform}))"
+)
+
+
+def wait_for_tpu(attempts: int = 5, timeout_s: float = 240.0) -> None:
+    """Block until a TPU backend initializes, or SystemExit."""
+    last = ""
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                info = json.loads(out.stdout.strip().splitlines()[-1])
+                if info.get("platform") == "tpu":
+                    return
+                raise SystemExit(
+                    f"no TPU visible (platform={info.get('platform')})"
+                )
+            last = (out.stderr or out.stdout).strip()[-300:]
+        except subprocess.TimeoutExpired:
+            last = f"probe timed out after {timeout_s}s"
+        if i < attempts - 1:
+            time.sleep(120.0)
+    raise SystemExit(f"TPU unavailable after {attempts} probes: {last}")

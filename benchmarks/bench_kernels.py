@@ -22,12 +22,16 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+from benchmarks._tpu_probe import wait_for_tpu  # noqa: E402
+
+wait_for_tpu()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-LOOP = 32  # op invocations fused into one program
+LOOP = 8  # op invocations fused into one program
 
 
 def _looped(op):

@@ -1,0 +1,102 @@
+"""Prefix-cache benchmark: TTFT for long-shared-prefix workloads.
+
+The chatbot/system-prompt pattern: every request carries the same long
+prefix (system prompt + few-shot examples) plus a short unique tail.  With
+automatic prefix caching the engine prefills only the tail after the first
+request.  Run on hardware:
+
+    python benchmarks/bench_prefix.py
+
+Prints one JSON line comparing mean TTFT with the cache on vs off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from benchmarks._tpu_probe import wait_for_tpu  # noqa: E402
+
+wait_for_tpu()
+
+import jax  # noqa: E402
+
+from vgate_tpu.backends.base import SamplingParams  # noqa: E402
+from vgate_tpu.config import load_config  # noqa: E402
+from vgate_tpu.runtime.engine_core import EngineCore  # noqa: E402
+
+PREFIX_LEN = 1008  # shared tokens (63 full 16-token pages)
+TAIL_LEN = 12  # unique per request
+N_REQUESTS = 16
+
+
+def run(prefix_cache: bool) -> dict:
+    config = load_config(
+        model={
+            "model_id": "Qwen/Qwen2.5-1.5B-Instruct",
+            "engine_type": "jax_tpu",
+            "dtype": "bfloat16",
+            "max_model_len": 2048,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "num_devices": 1,
+            "kv_num_pages": 0, "kv_page_size": 16,
+            "max_batch_slots": 16,
+            "prefill_buckets": [64, 1024],
+            "decode_chunk": 8, "decode_pipeline": 2,
+            "prefix_cache": prefix_cache,
+        },
+        scheduler={"max_queue_size": 256},
+        logging={"level": "ERROR"},
+    )
+    core = EngineCore(config, devices=jax.devices()[:1])
+    core.start()
+    try:
+        core.warmup(buckets=[64, 1024])
+        shared = [3 + (i * 13) % 200 for i in range(PREFIX_LEN)]
+        params = SamplingParams(max_tokens=8, temperature=0.0)
+        # first request warms the prefix into the cache (not measured)
+        seq = core.submit_tokens(shared + [7] * TAIL_LEN, params)
+        seq.done_event.wait(timeout=600)
+        ttfts = []
+        for i in range(N_REQUESTS):
+            tail = [11 + (i * 7 + j) % 150 for j in range(TAIL_LEN)]
+            seq = core.submit_tokens(shared + tail, params)
+            seq.done_event.wait(timeout=600)
+            ttfts.append(seq.ttft)
+        hit_tokens = core.scheduler.total_prefix_hit_tokens
+    finally:
+        core.stop()
+    return {
+        "mean_ttft_ms": round(1000 * sum(ttfts) / len(ttfts), 1),
+        "hit_tokens": hit_tokens,
+    }
+
+
+def main() -> None:
+    if jax.devices()[0].platform != "tpu":
+        raise SystemExit("bench_prefix needs a real TPU")
+    off = run(False)
+    on = run(True)
+    print(json.dumps({
+        "metric": "shared_prefix_ttft_ms",
+        "prefix_len": PREFIX_LEN,
+        "tail_len": TAIL_LEN,
+        "requests": N_REQUESTS,
+        "cache_off_mean_ttft_ms": off["mean_ttft_ms"],
+        "cache_on_mean_ttft_ms": on["mean_ttft_ms"],
+        "speedup": round(
+            off["mean_ttft_ms"] / max(on["mean_ttft_ms"], 1e-9), 2
+        ),
+        "hit_tokens": on["hit_tokens"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

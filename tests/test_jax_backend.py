@@ -165,3 +165,14 @@ async def test_gateway_end_to_end_with_jax_engine():
         assert os.path.isdir(out_dir)
     finally:
         await client.close()
+
+
+async def test_stream_async_reports_finish_reason(backend):
+    """on_finish delivers the true finish reason (max_tokens => length)."""
+    reasons = []
+    params = SamplingParams(max_tokens=3, temperature=0.0)
+    async for _ in backend.stream_async(
+        "finish reason probe", params, on_finish=reasons.append
+    ):
+        pass
+    assert reasons == ["length"]

@@ -227,9 +227,14 @@ class JaxTPUBackend:
         return settled
 
     async def stream_async(
-        self, prompt: str, params: SamplingParams
+        self,
+        prompt: str,
+        params: SamplingParams,
+        on_finish: Optional[Any] = None,
     ) -> AsyncIterator[str]:
-        """Token-by-token text deltas for SSE streaming."""
+        """Token-by-token text deltas for SSE streaming.  ``on_finish`` (if
+        given) is called with the sequence's finish_reason after the last
+        delta, so the gateway can close the stream with the true reason."""
         assert self.core is not None
         loop = asyncio.get_running_loop()
         q: "asyncio.Queue[Optional[int]]" = asyncio.Queue()
@@ -278,6 +283,8 @@ class JaxTPUBackend:
                 yield delta
         if seq.status is SeqStatus.FAILED:
             raise seq.error  # type: ignore[misc]
+        if on_finish is not None:
+            on_finish(seq.finish_reason)
 
     # -- embeddings --
 

@@ -221,6 +221,7 @@ async def _stream_chat(
         return f"data: {json.dumps(body)}\n\n".encode()
 
     await resp.write(_chunk({"role": "assistant"}))
+    finish_reason = {"value": "stop"}
     stream_fn = getattr(engine.backend, "stream_async", None)
     if stream_fn is not None:
         params = engine.backend.create_sampling_params(
@@ -245,10 +246,17 @@ async def _stream_chat(
             seed=payload.seed,
         )
         try:
+            import inspect
+
+            kwargs = {}
+            if "on_finish" in inspect.signature(stream_fn).parameters:
+                kwargs["on_finish"] = (
+                    lambda r: finish_reason.__setitem__("value", r)
+                )
             async with asyncio.timeout(
                 engine.config.server.request_timeout_s
             ):
-                async for piece in stream_fn(prompt, params):
+                async for piece in stream_fn(prompt, params, **kwargs):
                     await resp.write(_chunk({"content": piece}))
         except TimeoutError:
             await resp.write(
@@ -285,11 +293,12 @@ async def _stream_chat(
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
+        finish_reason["value"] = result.get("finish_reason", "stop")
         text = result["text"]
         step = max(1, len(text) // 16)
         for i in range(0, len(text), step):
             await resp.write(_chunk({"content": text[i : i + step]}))
-    await resp.write(_chunk({}, finish="stop"))
+    await resp.write(_chunk({}, finish=finish_reason["value"]))
     await resp.write(b"data: [DONE]\n\n")
     await resp.write_eof()
     return resp
