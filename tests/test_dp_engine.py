@@ -59,8 +59,9 @@ def test_dp_engine_builds_disjoint_replicas(dp_engine):
 
 
 def test_dp_routing_spreads_load(dp_engine):
-    """Concurrent requests land on BOTH replicas (per-shard batch work)."""
-    prompts = [f"dp probe {i}" for i in range(6)]
+    """Concurrent UNRELATED requests (distinct first pages, so prefix
+    affinity doesn't bind them) land on BOTH replicas."""
+    prompts = [f"{i}{i}{i}{i} dp probe {i}" for i in range(6)]
     results = dp_engine.generate(prompts, [greedy()] * 6)
     assert all(r["num_tokens"] >= 1 for r in results)
     per_replica = [
@@ -105,3 +106,32 @@ def test_dp_backend_integration():
         assert r.num_tokens >= 1
     finally:
         backend.shutdown()
+
+
+def test_dp_prefix_affinity_routing():
+    """Requests sharing a prompt prefix stick to one replica (its private
+    prefix cache gets the hits); unrelated prompts still spread."""
+    engine = ReplicatedEngine(
+        dp_config(dp=2, prefix_cache=True), devices=jax.devices()[:2]
+    )
+    engine.start()
+    try:
+        shared = list(range(10, 26))  # 16 tokens, >= 1 full page
+        # sequential submission: same-wave requests can't share (hash
+        # registration is deferred past dispatch), so hits require the
+        # earlier request's prefill to have been dispatched
+        for i in range(4):
+            seq = engine.submit_tokens(shared + [100 + i], greedy(2))
+            assert seq.done_event.wait(timeout=300)
+        hits = [
+            core.scheduler.total_prefix_hit_tokens
+            for core in engine.replicas
+        ]
+        admitted = [
+            core.scheduler.total_admitted for core in engine.replicas
+        ]
+        # all four landed on ONE replica and the later ones hit its cache
+        assert sorted(admitted) == [0, 4]
+        assert max(hits) > 0
+    finally:
+        engine.stop()

@@ -91,18 +91,41 @@ class ReplicatedEngine:
 
     # ------------------------------------------------------------ routing
 
-    def _pick_replica(self) -> EngineCore:
+    @staticmethod
+    def _load(core: EngineCore) -> int:
+        return len(core.scheduler.waiting) + len(core.scheduler.running)
+
+    def _pick_replica(
+        self, prompt_ids: Optional[List[int]] = None
+    ) -> EngineCore:
         """Least-loaded replica (queued + resident sequences), round-robin
-        on ties so idle replicas fill evenly."""
+        on ties so idle replicas fill evenly — with **prefix affinity**:
+        each replica's KV prefix cache is private, so requests sharing a
+        first prompt page stick to the same replica (cache hits) unless
+        that replica is meaningfully more loaded than the best one."""
         with self._route_lock:
             offset = next(self._rr)
             n = len(self.replicas)
             order = [self.replicas[(offset + i) % n] for i in range(n)]
-            return min(
-                order,
-                key=lambda c: len(c.scheduler.waiting)
-                + len(c.scheduler.running),
-            )
+            best = min(order, key=self._load)
+            page = self.config.tpu.kv_page_size
+            if (
+                prompt_ids is not None
+                and len(prompt_ids) >= page
+                and self.replicas[0].prefix_cache_enabled
+            ):
+                import zlib
+
+                block = bytes(
+                    b for t in prompt_ids[:page] for b in t.to_bytes(4, "little")
+                )
+                sticky = self.replicas[zlib.crc32(block) % n]
+                # affinity wins unless it costs real queueing headroom
+                if self._load(sticky) <= self._load(best) + max(
+                    2, self.config.tpu.max_batch_slots // 4
+                ):
+                    return sticky
+            return best
 
     def submit_tokens(
         self,
@@ -110,7 +133,7 @@ class ReplicatedEngine:
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
     ) -> Sequence:
-        return self._pick_replica().submit_tokens(
+        return self._pick_replica(list(prompt_ids)).submit_tokens(
             prompt_ids, params, stream_cb
         )
 
@@ -120,7 +143,13 @@ class ReplicatedEngine:
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
     ) -> Sequence:
-        return self._pick_replica().submit_prompt(prompt, params, stream_cb)
+        ids = self.tokenizer.encode(prompt)
+        max_prompt = self.config.model.max_model_len - 1
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        return self._pick_replica(ids).submit_tokens(
+            ids or [self.tokenizer.bos_id], params, stream_cb
+        )
 
     def generate(
         self, prompts: Seq[str], params: Seq[SamplingParams]
