@@ -240,3 +240,76 @@ def test_engine_same_wave_identical_prompts_correct(engines):
     [ref] = plain.generate([prompt], [greedy()])
     for s in seqs:
         assert list(s.generated_ids) == ref["token_ids"]
+
+
+def test_preemption_of_one_sharer_spares_shared_pages():
+    """Preempting a sequence that shares prefix pages must only drop its
+    reference: the surviving sharer's KV stays resident and its greedy
+    output is unchanged."""
+    sched, alloc = make_sched(num_pages=64)
+    prompt = list(range(2, 2 + 11))
+    a = seq_of(prompt)
+    sched.add(a)
+    register(alloc, sched.try_admit())
+    b = seq_of(prompt)
+    sched.add(b)
+    plan_b = sched.try_admit()
+    assert plan_b.cached_len == 2 * PS
+    shared = list(b.pages[:2])
+
+    used_before = alloc.num_used
+    sched._preempt(a)  # a's refs drop; shared pages must survive for b
+    assert all(p in b.pages for p in shared)
+    # b still holds them: not evictable, not free
+    assert alloc.num_used < used_before
+    got = alloc.allocate(alloc.num_free)  # drain everything allocatable
+    assert got is not None
+    assert not set(got) & set(shared)  # shared pages were never handed out
+    alloc.release(got)
+
+
+def test_prefix_cache_survives_engine_preemption_pressure():
+    """End-to-end: a KV pool small enough to force preemptions, prefix
+    cache on — greedy outputs still match the uncached engine."""
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    def run(prefix_cache):
+        config = load_config(
+            model={
+                "model_id": "tiny-dense",
+                "engine_type": "jax_tpu",
+                "dtype": "float32",
+                "max_model_len": 64,
+            },
+            tpu={
+                "dp": 1, "tp": 1, "ep": 1, "sp": 1, "num_devices": 1,
+                # tight pool: 13 usable pages for 3 sequences needing ~15
+                "kv_num_pages": 14, "kv_page_size": PS,
+                "max_batch_slots": 3, "prefill_buckets": [8, 16, 32],
+                "use_pallas": False, "prefix_cache": prefix_cache,
+            },
+            scheduler={"max_queue_size": 16},
+            logging={"level": "ERROR"},
+        )
+        core = EngineCore(config, devices=jax.devices()[:1])
+        core.start()
+        try:
+            prompts = [
+                "shared long prefix words " + tail
+                for tail in ("alpha", "beta", "gamma")
+            ]
+            out = core.generate(
+                prompts, [SamplingParams(max_tokens=10, temperature=0.0)] * 3
+            )
+            return [r["token_ids"] for r in out], core.get_stats()
+        finally:
+            core.stop()
+
+    cached_out, cached_stats = run(True)
+    plain_out, _ = run(False)
+    assert cached_out == plain_out
+    # the pool really was tight (otherwise the test proves nothing)
+    assert (
+        cached_stats["scheduler"]["preemptions"] > 0
+        or cached_stats["scheduler"]["prefix_cache"]["evictions"] > 0
+    )
