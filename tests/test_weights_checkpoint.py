@@ -267,3 +267,48 @@ async def test_embeddings_http_path_serves_checkpoint(tmp_path):
         np.testing.assert_allclose(vec, hf, rtol=2e-4, atol=2e-4)
     finally:
         await client.close()
+
+
+def test_hf_tokenizer_chat_template(tmp_path):
+    """An HF tokenizer shipping a chat template renders /v1/chat prompts
+    with it; tokenizers without one return None (gateway falls back to
+    Role: content flattening)."""
+    tokenizers = pytest.importorskip("tokenizers")
+
+    vocab = {"<unk>": 0, "<eos>": 1, "hello": 2, "tpu": 3}
+    tok = tokenizers.Tokenizer(
+        tokenizers.models.WordLevel(vocab, unk_token="<unk>")
+    )
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    tok.save(str(tok_dir / "tokenizer.json"))
+    (tok_dir / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "eos_token": "<eos>",
+        "unk_token": "<unk>",
+        "chat_template": (
+            "{% for m in messages %}<|{{ m.role }}|>{{ m.content }}"
+            "{% endfor %}<|assistant|>"
+        ),
+    }))
+
+    from vgate_tpu.runtime.tokenizer import get_tokenizer
+
+    got = get_tokenizer(TINY_DENSE, str(tok_dir))
+    rendered = got.apply_chat_template(
+        [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hello tpu"},
+        ]
+    )
+    assert rendered == "<|system|>be brief<|user|>hello tpu<|assistant|>"
+
+    # no template -> None (the gateway then flattens)
+    (tok_dir / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "eos_token": "<eos>",
+        "unk_token": "<unk>",
+    }))
+    got2 = get_tokenizer(TINY_DENSE, str(tok_dir))
+    assert got2.apply_chat_template([{"role": "user", "content": "x"}]) is None

@@ -70,6 +70,16 @@ class HFTokenizer:
     def decode(self, ids: List[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def apply_chat_template(self, messages: List[dict]) -> Optional[str]:
+        """Render chat messages with the model's own template when the
+        tokenizer ships one (the gateway falls back to the reference's
+        "Role: content" flattening otherwise, main.py:190-196)."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True
+        )
+
 
 def get_tokenizer(spec: ModelSpec, tokenizer_path: Optional[str]) -> Tokenizer:
     if tokenizer_path and os.path.exists(tokenizer_path):

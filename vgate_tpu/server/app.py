@@ -132,6 +132,26 @@ async def health(request: web.Request) -> web.Response:
     return web.json_response(body, status=status)
 
 
+def _build_prompt(engine: VGTEngine, messages) -> str:
+    """Prefer the model tokenizer's own chat template (HF tokenizers ship
+    one); fall back to the reference's "Role: content" flattening
+    (main.py:190-196) for byte/dry-run tokenizers."""
+    core = getattr(engine.backend, "core", None)
+    tokenizer = getattr(core, "tokenizer", None)
+    render = getattr(tokenizer, "apply_chat_template", None)
+    if render is not None:
+        try:
+            rendered = render([m.model_dump() for m in messages])
+            if rendered:
+                return rendered
+        except Exception:
+            logger.warning(
+                "chat template rendering failed; using flattening",
+                exc_info=True,
+            )
+    return messages_to_prompt(messages)
+
+
 async def chat_completions(request: web.Request) -> web.Response:
     """POST /v1/chat/completions (reference: main.py:207-252)."""
     try:
@@ -140,9 +160,9 @@ async def chat_completions(request: web.Request) -> web.Response:
         return _error(422, f"Invalid request: {exc}", "invalid_request_error")
     if not payload.messages:
         return _error(422, "messages must be non-empty", "invalid_request_error")
-    prompt = messages_to_prompt(payload.messages)
     batcher: RequestBatcher = request.app["batcher"]
     engine: VGTEngine = request.app["engine"]
+    prompt = _build_prompt(engine, payload.messages)
 
     if payload.stream:
         return await _stream_chat(request, payload, prompt)
