@@ -541,3 +541,109 @@ def test_different_seeds_diverge(engine):
         )
         outs.append(tuple(r["token_ids"]))
     assert len(set(outs)) > 1
+
+
+def test_gemma2_engine_end_to_end_across_window():
+    """The Gemma-2 family (sliding-window + softcap attention, sandwich
+    norms, tied embeddings) serves through the full continuous-batching
+    engine, generating past the sliding window (8) so decode steps beyond
+    the window exercise the local-attention mask over paged KV."""
+    config = load_config(
+        model={
+            "model_id": "tiny-gemma2",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+            "num_devices": 1,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 2, "prefill_buckets": [8],
+            # use_pallas left ON: the engine must route this family to the
+            # jnp attention twins by itself (spec.uses_local_attention)
+            "use_pallas": True,
+        },
+        scheduler={"max_queue_size": 8},
+        logging={"level": "WARNING"},
+    )
+    core = EngineCore(config, devices=jax.devices()[:1])
+    assert core.use_pallas is False
+    core.start()
+    try:
+        results = core.generate(
+            ["sliding window probe", "second gemma request"],
+            [greedy(16)] * 2,  # prompt+output crosses the 8-token window
+        )
+        for r in results:
+            assert r["num_tokens"] >= 1
+            assert r["finish_reason"] in ("stop", "length")
+            assert np.all(np.isfinite(r.get("ttft", 0.0)))
+    finally:
+        core.stop()
+
+
+def test_local_attention_bypasses_pallas_in_decoder():
+    """The decoder-level gate (not just the engine's platform check) must
+    route sliding-window/softcap specs to the jnp twins: calling the
+    forwards with use_pallas=True on CPU would crash inside the Pallas
+    kernels if the `spec.uses_local_attention` term were dropped."""
+    from vgate_tpu.models.decoder import (
+        decode_forward, init_params, prefill_forward,
+    )
+    from vgate_tpu.models.specs import TINY_GEMMA2 as spec
+
+    import jax.numpy as jnp_
+
+    params = init_params(spec, jax.random.PRNGKey(0), jnp_.float32)
+
+    B, S, ps = 1, 16, 4
+    k_pages = jnp_.zeros(
+        (spec.num_layers, spec.num_kv_heads, 1 + B * S // ps, ps,
+         spec.head_dim),
+        jnp_.float32,
+    )
+    v_pages = jnp_.zeros_like(k_pages)
+    pt = jnp_.asarray(
+        1 + np.arange(B * S // ps, dtype=np.int32).reshape(B, S // ps)
+    )
+    logits, k_pages, v_pages = prefill_forward(
+        params, spec, jnp_.zeros((B, S), jnp_.int32),
+        jnp_.asarray([10], jnp_.int32), k_pages, v_pages, pt,
+        use_pallas=True,
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+    logits, _, _ = decode_forward(
+        params, spec, jnp_.asarray([3], jnp_.int32),
+        jnp_.asarray([10], jnp_.int32), k_pages, v_pages, pt,
+        active=jnp_.asarray([True]), use_pallas=True,
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_gemma2_rejects_sp_and_pp():
+    for axes in ({"sp": 2}, {"pp": 2}):
+        n = min(2, jax.device_count())
+        if n < 2:
+            pytest.skip("needs 2 devices")
+        tpu = {
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "pp": 1,
+            "num_devices": n,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 2, "prefill_buckets": [8],
+            "use_pallas": False,
+        }
+        tpu.update(axes)
+        config = load_config(
+            model={
+                "model_id": "tiny-gemma2",
+                "engine_type": "jax_tpu",
+                "dtype": "float32",
+                "max_model_len": 64,
+            },
+            tpu=tpu,
+            scheduler={"max_queue_size": 8},
+            logging={"level": "WARNING"},
+        )
+        with pytest.raises(ValueError, match="sliding-window"):
+            EngineCore(config, devices=jax.devices()[:n])

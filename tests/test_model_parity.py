@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from vgate_tpu.models.decoder import decode_forward, prefill_forward
-from vgate_tpu.models.specs import TINY_DENSE, TINY_MOE
+from vgate_tpu.models.specs import TINY_DENSE, TINY_GEMMA2, TINY_MOE
 from vgate_tpu.runtime.weights import params_from_torch_state_dict
 
 torch = pytest.importorskip("torch")
@@ -88,6 +88,36 @@ def _build_hf_mistral():
     return transformers.MistralForCausalLM(config).eval()
 
 
+def _build_hf_gemma2():
+    # eager attention: the HF sdpa path skips attention-logit softcapping,
+    # which Gemma-2 parity requires
+    config = transformers.Gemma2Config(
+        vocab_size=TINY_GEMMA2.vocab_size,
+        hidden_size=TINY_GEMMA2.hidden_size,
+        num_hidden_layers=TINY_GEMMA2.num_layers,
+        num_attention_heads=TINY_GEMMA2.num_heads,
+        num_key_value_heads=TINY_GEMMA2.num_kv_heads,
+        head_dim=TINY_GEMMA2.head_dim,
+        intermediate_size=TINY_GEMMA2.intermediate_size,
+        rope_theta=TINY_GEMMA2.rope_theta,
+        rms_norm_eps=TINY_GEMMA2.rms_eps,
+        attn_logit_softcapping=TINY_GEMMA2.attn_softcap,
+        final_logit_softcapping=TINY_GEMMA2.final_softcap,
+        query_pre_attn_scalar=TINY_GEMMA2.query_scale,
+        sliding_window=TINY_GEMMA2.sliding_window,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_bias=False,
+        attn_implementation="eager",
+    )
+    # our spec alternates even=sliding/odd=global; HF must agree
+    assert [t == "sliding_attention" for t in config.layer_types] == [
+        w > 0 for w in TINY_GEMMA2.layer_windows
+    ]
+    torch.manual_seed(4)
+    return transformers.Gemma2ForCausalLM(config).eval()
+
+
 def _build_hf_moe():
     config = transformers.MixtralConfig(
         vocab_size=TINY_MOE.vocab_size,
@@ -140,8 +170,9 @@ def _hf_last_logits(model, token_rows):
         (TINY_MOE, _build_hf_moe, 1),
         (TINY_LLAMA, _build_hf_llama, 2),
         (TINY_MISTRAL, _build_hf_mistral, 3),
+        (TINY_GEMMA2, _build_hf_gemma2, 4),
     ],
-    ids=["qwen2-dense", "mixtral-moe", "llama3", "mistral"],
+    ids=["qwen2-dense", "mixtral-moe", "llama3", "mistral", "gemma2"],
 )
 def test_prefill_logits_match_hf(spec, builder, seed):
     qkv_bias = spec.qkv_bias
@@ -206,6 +237,47 @@ def test_decode_step_matches_hf():
         spec,
         jnp.asarray([extra_token], jnp.int32),
         jnp.asarray([n], jnp.int32),  # position of the new token
+        k_pages,
+        v_pages,
+        page_tables,
+        active=jnp.asarray([True]),
+    )
+    ours = np.asarray(logits, np.float32)
+    theirs = _hf_last_logits(model, [row])
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma2_decode_step_matches_hf():
+    """Decode parity across the sliding-window boundary: the prompt is
+    longer than the window (8), so layer 0's decode attention must drop
+    the oldest tokens exactly like HF's sliding mask."""
+    model = _build_hf_gemma2()
+    spec = TINY_GEMMA2
+    params = params_from_torch_state_dict(spec, model.state_dict())
+
+    rng = np.random.default_rng(11)
+    n = 12  # > sliding_window = 8
+    row = rng.integers(2, spec.vocab_size, size=n + 1).tolist()
+    prompt, extra_token = row[:n], row[n]
+
+    B, S = 1, PAGE
+    tokens = np.zeros((B, S), dtype=np.int32)
+    tokens[0, :n] = prompt
+    k_pages, v_pages, page_tables = _empty_cache(spec, 2, 1, B)
+    _, k_pages, v_pages = prefill_forward(
+        params,
+        spec,
+        jnp.asarray(tokens),
+        jnp.asarray([n], jnp.int32),
+        k_pages,
+        v_pages,
+        page_tables,
+    )
+    logits, k_pages, v_pages = decode_forward(
+        params,
+        spec,
+        jnp.asarray([extra_token], jnp.int32),
+        jnp.asarray([n], jnp.int32),
         k_pages,
         v_pages,
         page_tables,

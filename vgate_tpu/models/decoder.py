@@ -1,4 +1,5 @@
-"""The decoder family (Qwen2.x dense + Mixtral MoE) as pure JAX functions.
+"""The decoder families (Qwen2.x/Llama-3/Mistral dense, Mixtral MoE,
+Gemma-2 sliding-window) as pure JAX functions.
 
 Design (TPU-first, not a torch port):
 
@@ -57,9 +58,12 @@ def init_params(
     def normal(k, shape, scale=0.02):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
+    # Gemma-family RMSNorm stores a delta around 1 (unit_offset_norm), so
+    # identity init is zeros there, ones elsewhere.
+    norm_init = jnp.zeros if spec.unit_offset_norm else jnp.ones
     layers: Dict[str, Any] = {
-        "input_norm": jnp.ones((L, D), dtype),
-        "post_norm": jnp.ones((L, D), dtype),
+        "input_norm": norm_init((L, D), dtype),
+        "post_norm": norm_init((L, D), dtype),
         "q": {"w": normal(keys[0], (L, D, H * hd))},
         "k": {"w": normal(keys[1], (L, D, KV * hd))},
         "v": {"w": normal(keys[2], (L, D, KV * hd))},
@@ -69,6 +73,9 @@ def init_params(
         layers["q"]["b"] = jnp.zeros((L, H * hd), dtype)
         layers["k"]["b"] = jnp.zeros((L, KV * hd), dtype)
         layers["v"]["b"] = jnp.zeros((L, KV * hd), dtype)
+    if spec.ffn_sandwich:
+        layers["pre_ffn_norm"] = norm_init((L, D), dtype)
+        layers["post_ffn_norm"] = norm_init((L, D), dtype)
     if spec.is_moe:
         E = spec.num_experts
         layers["router"] = normal(keys[4], (L, D, E))
@@ -83,7 +90,7 @@ def init_params(
     params: Params = {
         "embed": normal(keys[8], (V, D)),
         "layers": layers,
-        "final_norm": jnp.ones((D,), dtype),
+        "final_norm": norm_init((D,), dtype),
     }
     if not spec.tie_embeddings:
         params["lm_head"] = normal(keys[9], (D, V))
@@ -105,12 +112,20 @@ def _project_qkv(x, lp, spec: ModelSpec):
     return q, k, v
 
 
-def _dense_mlp(x, lp):
+def _act(x32, spec: ModelSpec):
+    """MLP activation in fp32: SiLU (Qwen/Llama/Mixtral) or tanh-approx
+    GELU (Gemma's ``gelu_pytorch_tanh``)."""
+    if spec.act == "gelu_tanh":
+        return jax.nn.gelu(x32, approximate=True)
+    return jax.nn.silu(x32)
+
+
+def _dense_mlp(x, lp, spec: ModelSpec):
     gate = weighted_einsum("...d,df->...f", x, lp["gate"]["w"])
     up = weighted_einsum("...d,df->...f", x, lp["up"]["w"])
     return weighted_einsum(
         "...f,fd->...d",
-        jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up,
+        _act(gate.astype(jnp.float32), spec).astype(x.dtype) * up,
         lp["down"]["w"],
     )
 
@@ -119,12 +134,10 @@ def _expert_einsum(subscripts, x, w):
     """Per-expert einsum accepting plain or quantized expert weights
     (QTensor scale is per (expert, out-channel): [E, out] broadcasts as
     [E, 1, out] against the [E, C, out] einsum result)."""
-    from vgate_tpu.ops.quant import PackedQTensor, QTensor, unpack_int4
+    from vgate_tpu.ops.quant import PackedQTensor, QTensor, packed_einsum
 
     if isinstance(w, PackedQTensor):
-        out = jnp.einsum(
-            subscripts, x, unpack_int4(w.q_packed).astype(x.dtype)
-        )
+        out = packed_einsum(subscripts, x, w)
         return out * w.scale[:, None, :].astype(x.dtype)
     if isinstance(w, QTensor):
         out = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
@@ -184,7 +197,7 @@ def _moe_mlp(x, lp, spec: ModelSpec, capacity_factor: float = 2.0):
     expert_in = buf[:, :capacity]  # [E, C, D]
     gate_h = _expert_einsum("ecd,edf->ecf", expert_in, lp["gate"]["w"])
     up_h = _expert_einsum("ecd,edf->ecf", expert_in, lp["up"]["w"])
-    act = jax.nn.silu(gate_h.astype(jnp.float32)).astype(xt.dtype) * up_h
+    act = _act(gate_h.astype(jnp.float32), spec).astype(xt.dtype) * up_h
     expert_out = _expert_einsum("ecf,efd->ecd", act, lp["down"]["w"])
 
     contrib = expert_out[sorted_expert, jnp.minimum(pos, capacity - 1)]
@@ -198,34 +211,48 @@ def _moe_mlp(x, lp, spec: ModelSpec, capacity_factor: float = 2.0):
 
 
 def _mlp(x, lp, spec: ModelSpec):
-    return _moe_mlp(x, lp, spec) if spec.is_moe else _dense_mlp(x, lp)
+    return _moe_mlp(x, lp, spec) if spec.is_moe else _dense_mlp(x, lp, spec)
 
 
 def _logits(params: Params, spec: ModelSpec, x: jnp.ndarray) -> jnp.ndarray:
-    x = rms_norm(x, params["final_norm"], spec.rms_eps)
+    from vgate_tpu.ops.attention import _softcap
+
+    x = rms_norm(
+        x, params["final_norm"], spec.rms_eps, spec.unit_offset_norm
+    )
     if spec.tie_embeddings:
-        return jnp.einsum(
+        # embeddings are never quantized (gathers stay high-precision)
+        logits = jnp.einsum(
             "...d,vd->...v", x, params["embed"],
             preferred_element_type=jnp.float32,
         )
-    head = params["lm_head"]
-    from vgate_tpu.ops.quant import PackedQTensor, QTensor, unpack_int4
+    else:
+        logits = weighted_einsum(
+            "...d,dv->...v", x, params["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
+    return _softcap(logits, spec.final_softcap)
 
-    if isinstance(head, PackedQTensor):
-        logits = jnp.einsum(
-            "...d,dv->...v", x, unpack_int4(head.q_packed).astype(x.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        return logits * head.scale
-    if isinstance(head, QTensor):
-        logits = jnp.einsum(
-            "...d,dv->...v", x, head.q.astype(x.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        return logits * head.scale
-    return jnp.einsum(
-        "...d,dv->...v", x, head, preferred_element_type=jnp.float32,
-    )
+
+def _query_scale(spec: ModelSpec):
+    """Attention query scale override (Gemma-2's query_pre_attn_scalar);
+    None selects the default head_dim**-0.5 inside the attention ops."""
+    return spec.query_scale ** -0.5 if spec.query_scale > 0 else None
+
+
+def _embed(params: Params, spec: ModelSpec, tokens: jnp.ndarray):
+    x = params["embed"][tokens]
+    if spec.embed_scale:
+        # Gemma scales embeddings by sqrt(hidden), cast to the model dtype
+        # BEFORE the multiply (the HF convention, needed for parity).
+        x = x * jnp.asarray(spec.hidden_size ** 0.5, x.dtype)
+    return x
+
+
+def _layer_windows(spec: ModelSpec) -> jnp.ndarray:
+    """[L] int32 per-layer attention window for the layer scan (all zeros
+    for global-attention families)."""
+    return jnp.asarray(spec.layer_windows, jnp.int32)
 
 
 def prefill_forward(
@@ -252,6 +279,11 @@ def prefill_forward(
     """
     B, S = tokens.shape
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        if spec.uses_local_attention:
+            raise NotImplementedError(
+                "pipeline parallelism does not support "
+                "sliding-window/softcap families yet"
+            )
         from vgate_tpu.parallel.pipeline import pp_prefill_forward
 
         return pp_prefill_forward(
@@ -260,29 +292,40 @@ def prefill_forward(
         )
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
+        if spec.uses_local_attention:
+            raise NotImplementedError(
+                "ring-attention prefill does not support "
+                "sliding-window/softcap families yet"
+            )
         from vgate_tpu.parallel.ring_attention import ring_prefill_attention
 
         attn_fn = functools.partial(ring_prefill_attention, mesh=mesh)
-    elif use_pallas:
+    elif use_pallas and not spec.uses_local_attention:
         from vgate_tpu.ops.pallas.flash_prefill import (
             flash_prefill_attention_pallas,
         )
 
         attn_fn = flash_prefill_attention_pallas
     else:
-        attn_fn = flash_prefill_attention
-    x = params["embed"][tokens]  # [B, S, D]
+        attn_fn = functools.partial(
+            flash_prefill_attention,
+            softcap=spec.attn_softcap,
+            scale=_query_scale(spec),
+        )
+    x = _embed(params, spec, tokens)  # [B, S, D]
+    windows = _layer_windows(spec)
 
     def layer_fn(h, per_layer):
-        lp, k_pages_l, v_pages_l = per_layer
+        lp, win, k_pages_l, v_pages_l = per_layer
         h, k_pages_l, v_pages_l = prefill_layer(
             h, lp, k_pages_l, v_pages_l, spec=spec, seq_lens=seq_lens,
             page_tables=page_tables, attn_fn=attn_fn,
+            window=win if spec.sliding_window > 0 else None,
         )
         return h, (k_pages_l, v_pages_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_pages, v_pages)
+        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
     )
     last_idx = jnp.clip(seq_lens - 1, 0, S - 1)
     last_hidden = jnp.take_along_axis(
@@ -302,7 +345,9 @@ def _prefill_qkv_write(
     B, S = h.shape[:2]
     ps = k_pages_l.shape[2]
     n_pages = S // ps
-    normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
+    normed = rms_norm(
+        h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
+    )
     q, k, v = _project_qkv(normed, lp, spec)
     q = apply_rope(q, positions, spec.rope_theta)
     k = apply_rope(k, positions, spec.rope_theta)
@@ -321,37 +366,57 @@ def _prefill_qkv_write(
 
 
 def _finish_layer(h, attn, lp, spec: ModelSpec):
-    """Shared layer back half: o-projection residual + post-norm MLP."""
+    """Shared layer back half: o-projection residual + post-norm MLP.
+
+    With ``ffn_sandwich`` (Gemma-2) the post-attention norm applies to the
+    attention OUTPUT before the residual add, and the FFN is wrapped in its
+    own pre/post norms (sandwich normalization)."""
     attn = attn.reshape(*h.shape[:-1], spec.q_dim)
-    h = h + weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
-    normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
+    uo = spec.unit_offset_norm
+    attn_out = weighted_einsum("...h,hd->...d", attn, lp["o"]["w"])
+    if spec.ffn_sandwich:
+        attn_out = rms_norm(attn_out, lp["post_norm"], spec.rms_eps, uo)
+        h = h + attn_out
+        normed2 = rms_norm(h, lp["pre_ffn_norm"], spec.rms_eps, uo)
+        mlp_out = rms_norm(
+            _mlp(normed2, lp, spec), lp["post_ffn_norm"], spec.rms_eps, uo
+        )
+        return h + mlp_out
+    h = h + attn_out
+    normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps, uo)
     return h + _mlp(normed2, lp, spec)
 
 
 def prefill_layer(
     h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, seq_lens, page_tables,
-    attn_fn,
+    attn_fn, window=None,
 ):
     """One transformer layer of the prompt pass (shared by the plain scan
-    path above and the pipeline-parallel stage scan)."""
+    path above and the pipeline-parallel stage scan).  ``window`` is this
+    layer's attention window (int32 scalar, 0 = global), threaded only for
+    sliding-window families."""
     B, S = h.shape[:2]
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     q, k, v, k_pages_l, v_pages_l = _prefill_qkv_write(
         h, lp, spec, positions, page_tables, k_pages_l, v_pages_l
     )
-    attn = attn_fn(q, k, v, seq_lens)
+    if window is None:
+        attn = attn_fn(q, k, v, seq_lens)
+    else:
+        attn = attn_fn(q, k, v, seq_lens, window=window)
     return _finish_layer(h, attn, lp, spec), k_pages_l, v_pages_l
 
 
 def decode_layer(
     h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, positions, page_ids,
-    page_off, page_tables, seq_lens, attn_fn,
+    page_off, page_tables, seq_lens, attn_fn, window=None,
 ):
     """One transformer layer of the decode step (shared by the plain scan
     path below and the pipeline-parallel stage scan,
     parallel/pipeline.py)."""
-    B = h.shape[0]
-    normed = rms_norm(h, lp["input_norm"], spec.rms_eps)
+    normed = rms_norm(
+        h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
+    )
     q, k, v = _project_qkv(normed, lp, spec)  # q [B,H,hd], k/v [B,KV,hd]
     q = apply_rope(q[:, None], positions[:, None], spec.rope_theta)[:, 0]
     k = apply_rope(k[:, None], positions[:, None], spec.rope_theta)[:, 0]
@@ -361,12 +426,13 @@ def decode_layer(
     v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
         jnp.transpose(v, (1, 0, 2))
     )
-    attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
-    attn = attn.reshape(B, spec.q_dim)
-    h = h + weighted_einsum("bh,hd->bd", attn, lp["o"]["w"])
-    normed2 = rms_norm(h, lp["post_norm"], spec.rms_eps)
-    h = h + _mlp(normed2, lp, spec)
-    return h, k_pages_l, v_pages_l
+    if window is None:
+        attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
+    else:
+        attn = attn_fn(
+            q, k_pages_l, v_pages_l, page_tables, seq_lens, window=window
+        )
+    return _finish_layer(h, attn, lp, spec), k_pages_l, v_pages_l
 
 
 def decode_attn_inputs(positions, page_tables, active, page_size):
@@ -396,38 +462,49 @@ def decode_forward(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One continuous-batching decode step: returns (logits [B, V], caches)."""
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        if spec.uses_local_attention:
+            raise NotImplementedError(
+                "pipeline parallelism does not support "
+                "sliding-window/softcap families yet"
+            )
         from vgate_tpu.parallel.pipeline import pp_decode_forward
 
         return pp_decode_forward(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active=active, mesh=mesh, use_pallas=use_pallas,
         )
-    if use_pallas:
+    if use_pallas and not spec.uses_local_attention:
         from vgate_tpu.ops.pallas.paged_attention import (
             paged_decode_attention_pallas,
         )
 
         attn_fn = paged_decode_attention_pallas
     else:
-        attn_fn = paged_decode_attention
+        attn_fn = functools.partial(
+            paged_decode_attention,
+            softcap=spec.attn_softcap,
+            scale=_query_scale(spec),
+        )
     ps = k_pages.shape[3]
     seq_lens, page_ids, page_off = decode_attn_inputs(
         positions, page_tables, active, ps
     )
 
-    x = params["embed"][tokens]  # [B, D]
+    x = _embed(params, spec, tokens)  # [B, D]
+    windows = _layer_windows(spec)
 
     def layer_fn(h, per_layer):
-        lp, k_pages_l, v_pages_l = per_layer
+        lp, win, k_pages_l, v_pages_l = per_layer
         h, k_pages_l, v_pages_l = decode_layer(
             h, lp, k_pages_l, v_pages_l, spec=spec, positions=positions,
             page_ids=page_ids, page_off=page_off, page_tables=page_tables,
             seq_lens=seq_lens, attn_fn=attn_fn,
+            window=win if spec.sliding_window > 0 else None,
         )
         return h, (k_pages_l, v_pages_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_pages, v_pages)
+        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
     )
     return _logits(params, spec, x), k_pages, v_pages
 
@@ -458,22 +535,25 @@ def prefill_suffix_forward(
     B, S = tokens.shape
     positions = prefix_lens[:, None] + jnp.arange(S)[None, :]  # absolute
     total_lens = prefix_lens + suffix_lens
-    x = params["embed"][tokens]  # [B, S, D]
+    x = _embed(params, spec, tokens)  # [B, S, D]
+    windows = _layer_windows(spec)
 
     def layer_fn(h, per_layer):
-        lp, k_pages_l, v_pages_l = per_layer
+        lp, win, k_pages_l, v_pages_l = per_layer
         q, _k, _v, k_pages_l, v_pages_l = _prefill_qkv_write(
             h, lp, spec, positions, suffix_page_tables, k_pages_l,
             v_pages_l,
         )
         attn = paged_suffix_attention(
             q, k_pages_l, v_pages_l, ctx_page_tables, prefix_lens,
-            total_lens,
+            total_lens, softcap=spec.attn_softcap,
+            window=win if spec.sliding_window > 0 else None,
+            scale=_query_scale(spec),
         )
         return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_pages, v_pages)
+        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
     )
     last_idx = jnp.clip(suffix_lens - 1, 0, S - 1)
     last_hidden = jnp.take_along_axis(

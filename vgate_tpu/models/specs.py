@@ -2,8 +2,9 @@
 
 The reference serves whatever vLLM/SGLang can load (opaque to it); here the
 architectures are first-party.  Presets cover the north-star configs in
-BASELINE.json: Qwen2.5 dense chat models, Mixtral-8x7B (MoE / expert
-parallel) and bge-base-en-v1.5 (embeddings).
+BASELINE.json — Qwen2.5 dense chat models, Mixtral-8x7B (MoE / expert
+parallel), bge-base-en-v1.5 (embeddings) — plus Llama-3, Mistral and
+Gemma-2 (sliding-window + softcap attention, sandwich norms).
 """
 
 from __future__ import annotations
@@ -34,10 +35,42 @@ class ModelSpec:
     # Encoder-only (embeddings) models
     is_encoder: bool = False
     max_position_embeddings: int = 32768
+    # Gemma-2 family knobs (defaults reproduce the Qwen/Llama behavior)
+    act: str = "silu"  # MLP activation: "silu" | "gelu_tanh"
+    attn_softcap: float = 0.0  # tanh soft-capping of attention scores (0=off)
+    final_softcap: float = 0.0  # tanh soft-capping of final logits (0=off)
+    sliding_window: int = 0  # tokens; >0 => even layers use a local window
+    query_scale: float = 0.0  # if >0: q scaled by query_scale**-0.5, not hd**-0.5
+    embed_scale: bool = False  # multiply embeddings by sqrt(hidden_size)
+    unit_offset_norm: bool = False  # RMSNorm weight convention (1 + w)
+    ffn_sandwich: bool = False  # post-attn norm after o_proj + pre/post-FFN norms
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def layer_windows(self) -> tuple:
+        """Per-layer attention window (0 = global).  Gemma-2 alternates:
+        even-indexed layers are sliding-window, odd layers are global
+        (HF ``Gemma2Config.layer_types``)."""
+        if self.sliding_window <= 0:
+            return tuple(0 for _ in range(self.num_layers))
+        return tuple(
+            self.sliding_window if i % 2 == 0 else 0
+            for i in range(self.num_layers)
+        )
+
+    @property
+    def uses_local_attention(self) -> bool:
+        """True when attention needs features the Pallas kernels don't
+        implement yet (window masks, score softcapping, non-default query
+        scale) — such specs must route through the jnp attention twins."""
+        return (
+            self.sliding_window > 0
+            or self.attn_softcap > 0
+            or self.query_scale > 0
+        )
 
     @property
     def q_dim(self) -> int:
@@ -158,6 +191,62 @@ MISTRAL_7B = _register(
     )
 )
 
+GEMMA2_2B = _register(
+    ModelSpec(
+        name="google/gemma-2-2b-it",
+        vocab_size=256000,
+        hidden_size=2304,
+        num_layers=26,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=256,
+        intermediate_size=9216,
+        rope_theta=10_000.0,
+        rms_eps=1e-6,
+        qkv_bias=False,
+        tie_embeddings=True,
+        eos_token_id=107,  # <end_of_turn> — the -it turn-end token
+        bos_token_id=2,
+        max_position_embeddings=8192,
+        act="gelu_tanh",
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        sliding_window=4096,
+        query_scale=256.0,
+        embed_scale=True,
+        unit_offset_norm=True,
+        ffn_sandwich=True,
+    )
+)
+
+GEMMA2_9B = _register(
+    ModelSpec(
+        name="google/gemma-2-9b-it",
+        vocab_size=256000,
+        hidden_size=3584,
+        num_layers=42,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=256,
+        intermediate_size=14336,
+        rope_theta=10_000.0,
+        rms_eps=1e-6,
+        qkv_bias=False,
+        tie_embeddings=True,
+        eos_token_id=107,  # <end_of_turn> — the -it turn-end token
+        bos_token_id=2,
+        max_position_embeddings=8192,
+        act="gelu_tanh",
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        sliding_window=4096,
+        query_scale=256.0,
+        embed_scale=True,
+        unit_offset_norm=True,
+        ffn_sandwich=True,
+    )
+)
+
 BGE_BASE = _register(
     ModelSpec(
         name="BAAI/bge-base-en-v1.5",
@@ -202,6 +291,33 @@ TINY_MOE = _register(
         experts_per_token=2,
         qkv_bias=False,  # mixtral-family attention has no qkv bias
         rms_eps=1e-5,
+    )
+)
+
+TINY_GEMMA2 = _register(
+    ModelSpec(
+        name="tiny-gemma2",
+        vocab_size=512,
+        hidden_size=64,
+        num_layers=2,  # layer 0 sliding, layer 1 global
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,  # q_dim 128 != hidden 64: exercises decoupled head_dim
+        intermediate_size=128,
+        rope_theta=10_000.0,
+        rms_eps=1e-6,
+        qkv_bias=False,
+        tie_embeddings=True,
+        eos_token_id=0,
+        bos_token_id=1,
+        act="gelu_tanh",
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        sliding_window=8,
+        query_scale=16.0,  # != head_dim: exercises the custom q scale
+        embed_scale=True,
+        unit_offset_norm=True,
+        ffn_sandwich=True,
     )
 )
 

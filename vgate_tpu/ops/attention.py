@@ -23,11 +23,21 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return jnp.repeat(x, n_rep, axis=-2)
 
 
+def _softcap(scores: jnp.ndarray, cap: float) -> jnp.ndarray:
+    """Gemma-2 style tanh soft-capping of attention/logit scores (fp32)."""
+    if not cap:
+        return scores
+    return jnp.tanh(scores / cap) * cap
+
+
 def causal_prefill_attention(
     q: jnp.ndarray,  # [B, S, H, hd]
     k: jnp.ndarray,  # [B, S, KV, hd]
     v: jnp.ndarray,  # [B, S, KV, hd]
     seq_lens: jnp.ndarray,  # [B] real lengths (tokens beyond are padding)
+    softcap: float = 0.0,
+    window=None,  # int32 scalar; >0 => attend only to the last `window` keys
+    scale=None,  # query scale; default hd**-0.5
 ) -> jnp.ndarray:
     """Causal self-attention over a padded prompt batch. Returns [B, S, H, hd].
 
@@ -38,15 +48,21 @@ def causal_prefill_attention(
     n_rep = H // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
-    scale = 1.0 / (hd ** 0.5)
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
     # [B, H, S, S]
     scores = jnp.einsum(
         "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
     ) * scale
+    scores = _softcap(scores, softcap)
     pos = jnp.arange(S)
     causal = pos[None, :] <= pos[:, None]  # [S(q), S(k)] keys <= query pos
     key_valid = pos[None, :] < seq_lens[:, None]  # [B, S]
     mask = causal[None, None, :, :] & key_valid[:, None, None, :]
+    if window is not None:
+        dist = pos[:, None] - pos[None, :]  # q_pos - k_pos, [S, S]
+        win_ok = (window <= 0) | (dist < window)
+        mask = mask & win_ok[None, None, :, :]
     scores = jnp.where(mask, scores, -1e30)
     probs = jnp.exp(
         scores - jnp.max(scores, axis=-1, keepdims=True)
@@ -66,6 +82,9 @@ def flash_prefill_attention(
     seq_lens: jnp.ndarray,  # [B] real lengths (tokens beyond are padding)
     block_k: int = 256,
     q_offset=None,  # [B] int32: global position of q[:, 0] (chunked prefill)
+    softcap: float = 0.0,
+    window=None,  # int32 scalar; >0 => attend only to the last `window` keys
+    scale=None,  # query scale; default hd**-0.5
 ) -> jnp.ndarray:
     """Blockwise causal attention with online softmax. Returns [B, S, H, hd].
 
@@ -83,7 +102,8 @@ def flash_prefill_attention(
     B, S, H, hd = q.shape
     Sk = k.shape[1]
     n_rep = H // k.shape[2]
-    scale = 1.0 / (hd ** 0.5)
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
     q32 = q.astype(jnp.float32) * scale
 
     block_k = min(block_k, Sk)  # buckets are powers of two
@@ -106,10 +126,14 @@ def flash_prefill_attention(
         mask = (k_pos[None, None, :] <= q_pos[:, :, None]) & (
             k_pos[None, None, :] < seq_lens[:, None, None]
         )
+        if window is not None:
+            dist = q_pos[:, :, None] - k_pos[None, None, :]
+            mask = mask & ((window <= 0) | (dist < window))
         scores = jnp.einsum(
             "bshd,bthd->bsth", q32, k_blk,
             preferred_element_type=jnp.float32,
         )  # [B, S, block_k, H]
+        scores = _softcap(scores, softcap)
         scores = jnp.where(mask[..., None], scores, -1e30)
         m_cur = jnp.max(scores, axis=2)  # [B, S, H]
         m_new = jnp.maximum(m, m_cur)
@@ -137,6 +161,9 @@ def paged_decode_attention(
     v_pages: jnp.ndarray,  # [KV, P, page_size, hd]
     page_tables: jnp.ndarray,  # [B, pages_per_seq] int32
     seq_lens: jnp.ndarray,  # [B] context length per slot (incl. current token)
+    softcap: float = 0.0,
+    window=None,  # int32 scalar; >0 => attend only to the last `window` keys
+    scale=None,  # query scale; default hd**-0.5
 ) -> jnp.ndarray:
     """Decode-step attention over the paged KV cache. Returns [B, H, hd].
 
@@ -161,11 +188,20 @@ def paged_decode_attention(
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
 
-    scale = 1.0 / (hd ** 0.5)
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
     scores = jnp.einsum(
         "bhd,bthd->bht", q, k, preferred_element_type=jnp.float32
     ) * scale
-    valid = jnp.arange(ctx_max)[None, :] < seq_lens[:, None]  # [B, ctx]
+    scores = _softcap(scores, softcap)
+    t = jnp.arange(ctx_max)[None, :]
+    valid = t < seq_lens[:, None]  # [B, ctx]
+    if window is not None:
+        # the query sits at position seq_len-1: its window covers
+        # (seq_len-1-window, seq_len-1]
+        valid = valid & (
+            (window <= 0) | (t > seq_lens[:, None] - 1 - window)
+        )
     scores = jnp.where(valid[:, None, :], scores, -1e30)
     probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
@@ -183,6 +219,9 @@ def paged_suffix_attention(
     page_tables: jnp.ndarray,  # [B, ctx_pages] int32 (context window row)
     prefix_lens: jnp.ndarray,  # [B] global position of q[:, 0]
     seq_lens: jnp.ndarray,  # [B] total context (prefix + real suffix)
+    softcap: float = 0.0,
+    window=None,  # int32 scalar; >0 => attend only to the last `window` keys
+    scale=None,  # query scale; default hd**-0.5
 ) -> jnp.ndarray:
     """Prompt-suffix attention over resident paged KV (prefix caching).
 
@@ -210,5 +249,6 @@ def paged_suffix_attention(
     # for windows that aren't a multiple of 256 tokens
     block_k = 256 if ctx % 256 == 0 else k_pages.shape[2]
     return flash_prefill_attention(
-        q, k, v, seq_lens, block_k=block_k, q_offset=prefix_lens
+        q, k, v, seq_lens, block_k=block_k, q_offset=prefix_lens,
+        softcap=softcap, window=window, scale=scale,
     )

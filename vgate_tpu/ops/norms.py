@@ -6,14 +6,25 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-6,
+    unit_offset: bool = False,
+) -> jnp.ndarray:
     """RMSNorm in fp32 accumulation, cast back to input dtype (the HF Qwen2
-    convention, so logits match the reference architecture bit-for-bit-ish)."""
+    convention, so logits match the reference architecture bit-for-bit-ish).
+
+    ``unit_offset`` selects the Gemma convention where the stored weight is
+    a delta around 1 (output scaled by ``1 + w``)."""
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     normed = x32 * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    w32 = weight.astype(jnp.float32)
+    if unit_offset:
+        w32 = w32 + 1.0
+    return (normed * w32).astype(dtype)
 
 
 def layer_norm(

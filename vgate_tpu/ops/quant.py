@@ -36,9 +36,13 @@ class PackedQTensor(NamedTuple):
     jnp.int4 (``S4``) arrays cannot cross a jit boundary on the TPU runtime
     (device_put relayout recurses), and packed bytes are the honest 4-bit
     representation anyway — the same layout AWQ uses on GPU.  ``q_packed``
-    has the original shape with dim -2 (the ``in`` dim) halved; byte
-    ``p[..., i, out]`` holds ``w[..., 2i, out]`` in its low nibble and
-    ``w[..., 2i+1, out]`` in its high nibble, two's-complement.
+    has the original shape with dim -2 (the ``in`` dim) halved, in a
+    **half-split** layout: byte ``p[..., i, out]`` holds
+    ``w[..., i, out]`` in its low nibble and ``w[..., i + in/2, out]`` in
+    its high nibble, two's-complement.  Half-split (not interleaved) so
+    the consumer can contract each nibble plane directly against the
+    matching half of the activations — no interleaving reshape, and the
+    unpacked weight never materializes (see ``packed_einsum``).
     """
 
     q_packed: jnp.ndarray  # uint8 [..., in/2, out]
@@ -52,27 +56,53 @@ Weight = Union[jnp.ndarray, QTensor, PackedQTensor]
 
 
 def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
-    """int8 values in [-7, 7], shape [..., in, out] -> uint8 [..., in/2, out]."""
+    """int8 values in [-7, 7], shape [..., in, out] -> uint8 [..., in/2, out]
+    (half-split layout: low nibbles = first half of ``in``, high = second)."""
     if q.shape[-2] % 2:
         raise ValueError(f"in-dim {q.shape[-2]} must be even to pack int4")
-    pairs = q.astype(jnp.uint8).reshape(
-        *q.shape[:-2], q.shape[-2] // 2, 2, q.shape[-1]
-    )
-    lo = pairs[..., 0, :] & jnp.uint8(0x0F)
-    hi = pairs[..., 1, :] & jnp.uint8(0x0F)
+    half = q.shape[-2] // 2
+    lo = q[..., :half, :].astype(jnp.uint8) & jnp.uint8(0x0F)
+    hi = q[..., half:, :].astype(jnp.uint8) & jnp.uint8(0x0F)
     return lo | (hi << jnp.uint8(4))
+
+
+def _sext4(nibble: jnp.ndarray) -> jnp.ndarray:
+    """two's-complement 4-bit -> int8."""
+    return (nibble.astype(jnp.int8) ^ jnp.int8(8)) - jnp.int8(8)
 
 
 def unpack_int4(p: jnp.ndarray) -> jnp.ndarray:
     """uint8 [..., in/2, out] -> sign-extended int8 [..., in, out]."""
+    lo = _sext4(p & jnp.uint8(0x0F))
+    hi = _sext4(p >> jnp.uint8(4))
+    return jnp.concatenate([lo, hi], axis=-2)
 
-    def sext(nibble):  # two's-complement 4-bit -> int8
-        return (nibble.astype(jnp.int8) ^ jnp.int8(8)) - jnp.int8(8)
 
-    lo = sext(p & jnp.uint8(0x0F))
-    hi = sext(p >> jnp.uint8(4))
-    stacked = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
-    return stacked.reshape(*p.shape[:-2], p.shape[-2] * 2, p.shape[-1])
+def packed_einsum(
+    subscripts: str, x: jnp.ndarray, w: "PackedQTensor",
+    preferred_element_type=None,
+) -> jnp.ndarray:
+    """einsum against packed int4 without materializing the unpacked weight.
+
+    Every decoder einsum contracts x's LAST axis against w's dim -2, so the
+    half-split layout lets each nibble plane multiply the matching half of
+    the activations: two half-size MXU GEMMs whose narrow-int -> bf16
+    converts fuse into the operand feed, with no interleave reshape and no
+    full-size int8 weight tensor in flight.  Output scale is NOT applied
+    (callers broadcast ``w.scale`` themselves — its shape differs between
+    dense and expert weights)."""
+    half = w.q_packed.shape[-2]
+    p = w.q_packed
+    lo = _sext4(p & jnp.uint8(0x0F)).astype(x.dtype)
+    hi = _sext4(p >> jnp.uint8(4)).astype(x.dtype)
+    kw = (
+        {}
+        if preferred_element_type is None
+        else {"preferred_element_type": preferred_element_type}
+    )
+    return jnp.einsum(subscripts, x[..., :half], lo, **kw) + jnp.einsum(
+        subscripts, x[..., half:], hi, **kw
+    )
 
 
 def _finish(q: jnp.ndarray, scale: jnp.ndarray, bits: int) -> Weight:
@@ -120,7 +150,9 @@ def quantize_expert_stacked(w: jnp.ndarray, bits: int = 8) -> Weight:
     return _finish(q, scale, bits)
 
 
-def weighted_einsum(subscripts: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
+def weighted_einsum(
+    subscripts: str, x: jnp.ndarray, w: Weight, preferred_element_type=None
+) -> jnp.ndarray:
     """einsum that accepts plain or quantized weights.
 
     For QTensor the int8 values enter the einsum cast to the activation
@@ -128,14 +160,24 @@ def weighted_einsum(subscripts: str, x: jnp.ndarray, w: Weight) -> jnp.ndarray:
     valid because every decoder weight keeps out-dim last.  PackedQTensor
     int4 nibbles unpack in-consumer (XLA fuses the byte ops into the
     convert; only the packed bytes ever sit in HBM).
+    ``preferred_element_type`` sets the accumulation/output dtype across
+    all three branches (the lm_head path accumulates logits in fp32).
     """
+    kw = (
+        {}
+        if preferred_element_type is None
+        else {"preferred_element_type": preferred_element_type}
+    )
+    out_dtype = preferred_element_type or x.dtype
     if isinstance(w, PackedQTensor):
-        out = jnp.einsum(subscripts, x, unpack_int4(w.q_packed).astype(x.dtype))
-        return out * w.scale.astype(x.dtype)
+        out = packed_einsum(
+            subscripts, x, w, preferred_element_type=preferred_element_type
+        )
+        return out * w.scale.astype(out_dtype)
     if isinstance(w, QTensor):
-        out = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
-        return out * w.scale.astype(x.dtype)
-    return jnp.einsum(subscripts, x, w)
+        out = jnp.einsum(subscripts, x, w.q.astype(x.dtype), **kw)
+        return out * w.scale.astype(out_dtype)
+    return jnp.einsum(subscripts, x, w, **kw)
 
 
 def quantize_decoder_params(params: Any, spec, bits: int = 8) -> Any:

@@ -53,6 +53,9 @@ def param_pspecs(spec: ModelSpec, mesh: Mesh) -> Dict[str, Any]:
         layers["q"]["b"] = _spec(mesh, (L, Q), AXIS_PP, AXIS_TP)
         layers["k"]["b"] = _spec(mesh, (L, KVD), AXIS_PP, AXIS_TP)
         layers["v"]["b"] = _spec(mesh, (L, KVD), AXIS_PP, AXIS_TP)
+    if spec.ffn_sandwich:
+        layers["pre_ffn_norm"] = _spec(mesh, (L, D), AXIS_PP, None)
+        layers["post_ffn_norm"] = _spec(mesh, (L, D), AXIS_PP, None)
     if spec.is_moe:
         layers["router"] = _spec(mesh, (L, D, E), AXIS_PP, None, None)
         layers["gate"] = {

@@ -339,11 +339,20 @@ class EngineCore:
                     "ring-attention prefill shards the sequence axis evenly"
                 )
 
+        if self.spec.uses_local_attention and (sp_size > 1 or pp_size > 1):
+            raise ValueError(
+                f"{self.spec.name} uses sliding-window/softcap attention, "
+                "not yet supported with sp>1 or pp>1"
+            )
+
         # Pallas kernels require a real TPU backend (tests run interpret-mode
-        # kernels separately; the engine's jnp twins serve CPU meshes)
+        # kernels separately; the engine's jnp twins serve CPU meshes).
+        # Sliding-window/softcap families route through the jnp attention
+        # twins until the kernels learn those masks.
         self.use_pallas = bool(
             tpu_cfg.use_pallas
             and self.mesh.devices.flat[0].platform == "tpu"
+            and not self.spec.uses_local_attention
         )
         self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
         self._wakeup = threading.Event()

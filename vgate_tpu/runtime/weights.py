@@ -56,6 +56,14 @@ def params_from_getter(
         layers["q"]["b"] = _stack(getter, pre + "self_attn.q_proj.bias", L)
         layers["k"]["b"] = _stack(getter, pre + "self_attn.k_proj.bias", L)
         layers["v"]["b"] = _stack(getter, pre + "self_attn.v_proj.bias", L)
+    if spec.ffn_sandwich:
+        # Gemma-2 sandwich norms (HF Gemma2ForCausalLM names)
+        layers["pre_ffn_norm"] = _stack(
+            getter, pre + "pre_feedforward_layernorm.weight", L
+        )
+        layers["post_ffn_norm"] = _stack(
+            getter, pre + "post_feedforward_layernorm.weight", L
+        )
     if spec.is_moe:
         E = spec.num_experts
         layers["router"] = _stack(
