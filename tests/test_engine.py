@@ -560,15 +560,17 @@ def test_gemma2_engine_end_to_end_across_window():
             "num_devices": 1,
             "kv_num_pages": 64, "kv_page_size": 4,
             "max_batch_slots": 2, "prefill_buckets": [8],
-            # use_pallas left ON: the engine must route this family to the
-            # jnp attention twins by itself (spec.uses_local_attention)
+            # use_pallas left ON: the kernels take Gemma's
+            # window/softcap/scale natively, so the engine keeps them on
+            # wherever the platform supports Pallas (TPU)
             "use_pallas": True,
         },
         scheduler={"max_queue_size": 8},
         logging={"level": "WARNING"},
     )
     core = EngineCore(config, devices=jax.devices()[:1])
-    assert core.use_pallas is False
+    # kernels on real TPU, jnp twins elsewhere — platform is the only gate
+    assert core.use_pallas == (jax.devices()[0].platform == "tpu")
     core.start()
     try:
         results = core.generate(
@@ -581,44 +583,6 @@ def test_gemma2_engine_end_to_end_across_window():
             assert np.all(np.isfinite(r.get("ttft", 0.0)))
     finally:
         core.stop()
-
-
-def test_local_attention_bypasses_pallas_in_decoder():
-    """The decoder-level gate (not just the engine's platform check) must
-    route sliding-window/softcap specs to the jnp twins: calling the
-    forwards with use_pallas=True on CPU would crash inside the Pallas
-    kernels if the `spec.uses_local_attention` term were dropped."""
-    from vgate_tpu.models.decoder import (
-        decode_forward, init_params, prefill_forward,
-    )
-    from vgate_tpu.models.specs import TINY_GEMMA2 as spec
-
-    import jax.numpy as jnp_
-
-    params = init_params(spec, jax.random.PRNGKey(0), jnp_.float32)
-
-    B, S, ps = 1, 16, 4
-    k_pages = jnp_.zeros(
-        (spec.num_layers, spec.num_kv_heads, 1 + B * S // ps, ps,
-         spec.head_dim),
-        jnp_.float32,
-    )
-    v_pages = jnp_.zeros_like(k_pages)
-    pt = jnp_.asarray(
-        1 + np.arange(B * S // ps, dtype=np.int32).reshape(B, S // ps)
-    )
-    logits, k_pages, v_pages = prefill_forward(
-        params, spec, jnp_.zeros((B, S), jnp_.int32),
-        jnp_.asarray([10], jnp_.int32), k_pages, v_pages, pt,
-        use_pallas=True,
-    )
-    assert np.isfinite(np.asarray(logits)).all()
-    logits, _, _ = decode_forward(
-        params, spec, jnp_.asarray([3], jnp_.int32),
-        jnp_.asarray([10], jnp_.int32), k_pages, v_pages, pt,
-        active=jnp_.asarray([True]), use_pallas=True,
-    )
-    assert np.isfinite(np.asarray(logits)).all()
 
 
 def test_gemma2_rejects_sp_and_pp():

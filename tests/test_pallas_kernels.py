@@ -150,3 +150,97 @@ def test_flash_prefill_kernel_gqa_and_offset():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expect[:, 128:]), rtol=2e-5, atol=2e-5
     )
+
+
+def test_paged_decode_kernel_sliding_window_matches_jnp():
+    """Gemma-2 local attention in the kernel: window mask + below-window
+    chunk skip must equal the jnp twin's windowed gather, including a
+    window that starts mid-chunk and one beyond a chunk boundary."""
+    q, k_pages, v_pages, page_tables, seq_lens = make_case(
+        lens=[200, 255, 64, 3], seed=5
+    )
+    for win in (16, 100, 130):  # mid-page, mid-chunk, cross-chunk
+        w = jnp.asarray(win, jnp.int32)
+        expect = paged_decode_attention(
+            q, k_pages, v_pages, page_tables, seq_lens, window=w
+        )
+        got = paged_decode_attention_pallas(
+            q, k_pages, v_pages, page_tables, seq_lens, window=w,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5,
+            err_msg=f"window={win}",
+        )
+    # window=0 (global layers of a sliding-window model) == no window
+    got0 = paged_decode_attention_pallas(
+        q, k_pages, v_pages, page_tables, seq_lens,
+        window=jnp.asarray(0, jnp.int32), interpret=True,
+    )
+    expect0 = paged_decode_attention(
+        q, k_pages, v_pages, page_tables, seq_lens
+    )
+    np.testing.assert_allclose(
+        np.asarray(got0), np.asarray(expect0), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_decode_kernel_softcap_and_scale_match_jnp():
+    """Score softcapping and the decoupled query scale (Gemma-2's
+    query_pre_attn_scalar) in the kernel vs the jnp twin."""
+    q, k_pages, v_pages, page_tables, seq_lens = make_case(seed=6)
+    expect = paged_decode_attention(
+        q, k_pages, v_pages, page_tables, seq_lens,
+        softcap=50.0, scale=0.25,
+    )
+    got = paged_decode_attention_pallas(
+        q, k_pages, v_pages, page_tables, seq_lens,
+        softcap=50.0, scale=0.25, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_prefill_kernel_window_softcap_scale():
+    """Gemma-2 prefill in the kernel: sliding window (with the dead-block
+    skip), score softcap and the decoupled query scale vs the jnp twin."""
+    from vgate_tpu.ops.attention import flash_prefill_attention
+    from vgate_tpu.ops.pallas.flash_prefill import (
+        flash_prefill_attention_pallas,
+    )
+
+    rng = np.random.default_rng(31)
+    B, S, H, KV, hd = 2, 512, 4, 2, 128
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    lens = jnp.asarray([301, 512], jnp.int32)
+    # window smaller than a k-block (128) AND spanning blocks
+    # compare only rows < seq_len: once a window applies, padding rows
+    # (q_pos >= seq_len + window) have NO valid keys, and fully-masked
+    # rows are garbage-by-design in both implementations (the engine
+    # discards them); real rows must match exactly
+    valid = np.arange(S)[None, :] < np.asarray(lens)[:, None]  # [B, S]
+    for win in (48, 200):
+        w = jnp.asarray(win, jnp.int32)
+        expect = flash_prefill_attention(
+            q, k, v, lens, block_k=128, window=w, softcap=50.0, scale=0.05
+        )
+        got = flash_prefill_attention_pallas(
+            q, k, v, lens, block_q=128, block_k=128, interpret=True,
+            window=w, softcap=50.0, scale=0.05,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got)[valid], np.asarray(expect)[valid],
+            rtol=2e-5, atol=2e-5, err_msg=f"window={win}",
+        )
+    # window=0 == global
+    got0 = flash_prefill_attention_pallas(
+        q, k, v, lens, block_q=128, block_k=128, interpret=True,
+        window=jnp.asarray(0, jnp.int32),
+    )
+    expect0 = flash_prefill_attention(q, k, v, lens, block_k=128)
+    np.testing.assert_allclose(
+        np.asarray(got0), np.asarray(expect0), rtol=2e-5, atol=2e-5
+    )

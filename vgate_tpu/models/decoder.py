@@ -300,12 +300,16 @@ def prefill_forward(
         from vgate_tpu.parallel.ring_attention import ring_prefill_attention
 
         attn_fn = functools.partial(ring_prefill_attention, mesh=mesh)
-    elif use_pallas and not spec.uses_local_attention:
+    elif use_pallas:
         from vgate_tpu.ops.pallas.flash_prefill import (
             flash_prefill_attention_pallas,
         )
 
-        attn_fn = flash_prefill_attention_pallas
+        attn_fn = functools.partial(
+            flash_prefill_attention_pallas,
+            softcap=spec.attn_softcap,
+            scale=_query_scale(spec),
+        )
     else:
         attn_fn = functools.partial(
             flash_prefill_attention,
@@ -473,12 +477,19 @@ def decode_forward(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active=active, mesh=mesh, use_pallas=use_pallas,
         )
-    if use_pallas and not spec.uses_local_attention:
+    if use_pallas:
+        # the decode kernel supports window/softcap/scale natively (and
+        # skips DMA for pages below the window), so local-attention
+        # families ride it too
         from vgate_tpu.ops.pallas.paged_attention import (
             paged_decode_attention_pallas,
         )
 
-        attn_fn = paged_decode_attention_pallas
+        attn_fn = functools.partial(
+            paged_decode_attention_pallas,
+            softcap=spec.attn_softcap,
+            scale=_query_scale(spec),
+        )
     else:
         attn_fn = functools.partial(
             paged_decode_attention,

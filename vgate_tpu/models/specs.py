@@ -63,9 +63,10 @@ class ModelSpec:
 
     @property
     def uses_local_attention(self) -> bool:
-        """True when attention needs features the Pallas kernels don't
-        implement yet (window masks, score softcapping, non-default query
-        scale) — such specs must route through the jnp attention twins."""
+        """True when attention needs window/softcap/scale semantics.  The
+        Pallas prefill+decode kernels implement these natively; the paths
+        that do NOT yet (ring-attention sp prefill, the pipeline-parallel
+        relay) reject such specs at engine init."""
         return (
             self.sliding_window > 0
             or self.attn_softcap > 0

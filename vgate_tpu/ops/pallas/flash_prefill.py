@@ -33,6 +33,7 @@ def _kernel(
     # scalar prefetch (SMEM)
     seq_lens_ref,  # [B] int32 — real key length per batch row
     q_offsets_ref,  # [B] int32 — global position of query row 0
+    window_ref,  # [1] int32; >0 => attend only to the last `window` keys
     # inputs (VMEM blocks)
     q_ref,  # [1, 1, block_q, hd]
     k_ref,  # [1, 1, block_k, hd]
@@ -47,12 +48,15 @@ def _kernel(
     block_q: int,
     block_k: int,
     n_k: int,
+    softcap: float,
+    scale: float,
 ):
     b = pl.program_id(0)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     seq_len = seq_lens_ref[b]
     q_off = q_offsets_ref[b]
+    window = window_ref[0]
 
     @pl.when(ki == 0)
     def _():
@@ -64,11 +68,15 @@ def _kernel(
     q_start = q_off + qi * block_q
     k_start = ki * block_k
 
-    # a k-block strictly above the causal diagonal contributes nothing
-    @pl.when(k_start <= q_start + block_q - 1)
+    # a k-block strictly above the causal diagonal — or entirely below the
+    # sliding window of every query row in the block — contributes nothing
+    causal_live = k_start <= q_start + block_q - 1
+    window_live = (window <= 0) | (
+        k_start + block_k - 1 >= q_start - window + 1
+    )
+
+    @pl.when(causal_live & window_live)
     def _():
-        hd = q_ref.shape[-1]
-        scale = 1.0 / (hd ** 0.5)
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, hd]
         k = k_ref[0, 0].astype(jnp.float32)  # [block_k, hd]
         v = v_ref[0, 0].astype(jnp.float32)
@@ -77,6 +85,8 @@ def _kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
+        if softcap:
+            scores = jnp.tanh(scores / softcap) * softcap
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 0
         )
@@ -84,6 +94,7 @@ def _kernel(
             jnp.int32, scores.shape, 1
         )
         mask = (k_pos <= q_pos) & (k_pos < seq_len)
+        mask = mask & ((window <= 0) | (q_pos - k_pos < window))
         scores = jnp.where(mask, scores, -1e30)
 
         m_prev = m_ref[:, :1]  # [block_q, 1]
@@ -108,7 +119,8 @@ def _kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("block_q", "block_k", "interpret", "softcap", "scale"),
 )
 def flash_prefill_attention_pallas(
     q: jnp.ndarray,  # [B, S, H, hd]
@@ -119,6 +131,9 @@ def flash_prefill_attention_pallas(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = False,
+    softcap: float = 0.0,
+    window=None,  # int32 scalar; >0 => attend only to the last `window`
+    scale=None,  # static query scale; default hd**-0.5
 ) -> jnp.ndarray:
     """Causal (optionally offset) attention. Returns [B, S, H, hd]."""
     B, S, H, hd = q.shape
@@ -133,6 +148,10 @@ def flash_prefill_attention_pallas(
     n_q, n_k = S // block_q, Sk // block_k
     if q_offsets is None:
         q_offsets = jnp.zeros((B,), jnp.int32)
+    if window is None:
+        window_arr = jnp.zeros((1,), jnp.int32)
+    else:
+        window_arr = jnp.asarray(window, jnp.int32).reshape(1)
 
     # head-major layout so each block's trailing dims are (seq_block, hd)
     qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, hd]
@@ -140,10 +159,12 @@ def flash_prefill_attention_pallas(
     vt = jnp.transpose(v, (0, 2, 1, 3))
 
     kernel = functools.partial(
-        _kernel, block_q=block_q, block_k=block_k, n_k=n_k
+        _kernel, block_q=block_q, block_k=block_k, n_k=n_k,
+        softcap=float(softcap),
+        scale=float(scale) if scale is not None else hd ** -0.5,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, H, n_q, n_k),
         in_specs=[
             pl.BlockSpec(
@@ -183,5 +204,8 @@ def flash_prefill_attention_pallas(
                                  "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
-    )(seq_lens.astype(jnp.int32), q_offsets.astype(jnp.int32), qt, kt, vt)
+    )(
+        seq_lens.astype(jnp.int32), q_offsets.astype(jnp.int32),
+        window_arr, qt, kt, vt,
+    )
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -36,6 +36,7 @@ def _kernel(
     # scalar prefetch
     page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)
     seq_lens_ref,  # [B] int32 (SMEM)
+    window_ref,  # [1] int32 (SMEM); >0 => attend only to the last `window`
     # inputs
     q_ref,  # [1, 1, G, hd] VMEM block for (b, g)
     k_pages_ref,  # [KV, P, ps, hd] in ANY/HBM (head-major: one page of one
@@ -51,6 +52,8 @@ def _kernel(
     sems,  # DMA semaphores [2, 2, CHUNK]
     *,
     page_size: int,
+    softcap: float,
+    scale: float,
 ):
     b = pl.program_id(0)
     g = pl.program_id(1)
@@ -58,6 +61,14 @@ def _kernel(
     n_pages = jax.lax.div(seq_len + page_size - 1, page_size)
     n_chunks = jax.lax.div(n_pages + CHUNK_PAGES - 1, CHUNK_PAGES)
     chunk_tokens = CHUNK_PAGES * page_size
+    # Sliding window: tokens below `lo` contribute nothing, so whole chunks
+    # below the window start are never DMA'd at all — the kernel's traffic
+    # is O(window), not O(context), for local-attention layers.
+    window = window_ref[0]
+    lo = jnp.where(
+        window > 0, jnp.maximum(seq_len - window, 0), 0
+    )
+    lo_chunk = jax.lax.div(lo, chunk_tokens)
 
     def start_chunk(c, slot):
         """Kick off the DMAs for chunk c into buffer `slot`."""
@@ -106,16 +117,13 @@ def _kernel(
                     sems.at[slot, 1, j],
                 ).wait()
 
-    hd = q_ref.shape[-1]
-    G = q_ref.shape[-2]
-    scale = 1.0 / (hd ** 0.5)
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
 
     acc_ref[...] = jnp.zeros_like(acc_ref)
     m_ref[...] = jnp.full_like(m_ref, -1e30)
     l_ref[...] = jnp.zeros_like(l_ref)
 
-    start_chunk(0, 0)
+    start_chunk(lo_chunk, jax.lax.rem(lo_chunk, 2))
 
     def body(c, _):
         slot = jax.lax.rem(c, 2)
@@ -138,10 +146,13 @@ def _kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [G, chunk_tokens]
+        if softcap:
+            scores = jnp.tanh(scores / softcap) * softcap
         token_pos = c * chunk_tokens + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1
         )
-        scores = jnp.where(token_pos < seq_len, scores, -1e30)
+        valid = (token_pos >= lo) & (token_pos < seq_len)
+        scores = jnp.where(valid, scores, -1e30)
 
         m_prev = m_ref[:, :1]  # [G, 1]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)  # [G, 1]
@@ -157,32 +168,46 @@ def _kernel(
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
         return 0
 
-    jax.lax.fori_loop(0, n_chunks, body, 0)
+    jax.lax.fori_loop(lo_chunk, n_chunks, body, 0)
     denom = jnp.maximum(l_ref[:, :1], 1e-30)
     out_ref[0, 0] = (acc_ref[...] / denom).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "softcap", "scale")
+)
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # [B, H, hd]
     k_pages: jnp.ndarray,  # [KV, P, ps, hd] (head-major, kv_cache.py)
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     seq_lens: jnp.ndarray,  # [B]
+    window=None,  # int32 scalar; >0 => attend only to the last `window`
     interpret: bool = False,
+    softcap: float = 0.0,
+    scale=None,  # static query scale; default hd**-0.5
 ) -> jnp.ndarray:
     B, H, hd = q.shape
     KV, P, ps, _ = k_pages.shape
     G = H // KV
     chunk_tokens = CHUNK_PAGES * ps
 
-    kernel = functools.partial(_kernel, page_size=ps)
+    if window is None:
+        window_arr = jnp.zeros((1,), jnp.int32)
+    else:
+        window_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    kernel = functools.partial(
+        _kernel,
+        page_size=ps,
+        softcap=float(softcap),
+        scale=float(scale) if scale is not None else hd ** -0.5,
+    )
     # q is laid out [B, KV, G, hd] so each program's block covers the FULL
     # trailing (G, hd) dims — Mosaic requires trailing block dims either
     # tile-aligned (8, 128) or equal to the array dims, and G (q heads per
     # kv group, e.g. 6 or 7) is rarely tile-aligned.
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, KV),
         in_specs=[
             pl.BlockSpec(
@@ -213,5 +238,8 @@ def paged_decode_attention_pallas(
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
-    )(page_tables, seq_lens, q.reshape(B, KV, G, hd), k_pages, v_pages)
+    )(
+        page_tables, seq_lens, window_arr,
+        q.reshape(B, KV, G, hd), k_pages, v_pages,
+    )
     return out.reshape(B, H, hd)

@@ -347,12 +347,12 @@ class EngineCore:
 
         # Pallas kernels require a real TPU backend (tests run interpret-mode
         # kernels separately; the engine's jnp twins serve CPU meshes).
-        # Sliding-window/softcap families route through the jnp attention
-        # twins until the kernels learn those masks.
+        # Local-attention families (Gemma-2) ride both kernels: they take
+        # window/softcap/scale natively, and the decode kernel skips DMA
+        # for pages below the window.
         self.use_pallas = bool(
             tpu_cfg.use_pallas
             and self.mesh.devices.flat[0].platform == "tpu"
-            and not self.spec.uses_local_attention
         )
         self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
         self._wakeup = threading.Event()
