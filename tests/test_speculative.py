@@ -1,0 +1,253 @@
+"""Speculative decoding: drafter, verify-step math, and engine rounds
+(runtime/speculative.py, models/decoder.py spec_verify_forward).
+
+The load-bearing invariant is greedy exactness: with drafts verified
+against the model's own argmax, the emitted tokens are identical to plain
+autoregressive decoding no matter what the drafter proposes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import load_config
+from vgate_tpu.runtime.engine_core import EngineCore
+from vgate_tpu.runtime.speculative import count_accepted, ngram_draft
+
+
+# ------------------------------------------------------------- drafter
+
+def test_ngram_draft_finds_most_recent_repetition():
+    #        0  1  2  3  4  5  6  7
+    ids = [5, 6, 9, 5, 6, 7, 5, 6]
+    # final bigram (5, 6) recurred at 3..4 (recent) and 0..1 (older);
+    # recency wins -> continuation after index 4 is [7, 5, 6]
+    assert ngram_draft(ids, k=3, ngram=2) == [7, 5, 6]
+    assert ngram_draft(ids, k=1, ngram=2) == [7]
+
+
+def test_ngram_draft_no_match_or_short_history():
+    assert ngram_draft([1, 2, 3, 4], k=3, ngram=2) == []
+    assert ngram_draft([1, 2], k=3, ngram=2) == []
+    assert ngram_draft([], k=3, ngram=2) == []
+    assert ngram_draft([1, 2, 3], k=0, ngram=2) == []
+
+
+def test_ngram_draft_truncates_at_history_end():
+    ids = [8, 9, 1, 8, 9]
+    # match at 0..1, only one token follows before the key itself
+    assert ngram_draft(ids, k=4, ngram=2) == [1, 8, 9]
+
+
+# ------------------------------------------------------- accept counting
+
+def test_count_accepted_runs():
+    model = jnp.asarray([[7, 8, 9, 1], [7, 8, 9, 1], [7, 8, 9, 1]])
+    toks = jnp.asarray(
+        [
+            [0, 7, 8, 9],  # all 3 drafts match -> 3
+            [0, 7, 5, 9],  # first matches, second wrong -> 1
+            [0, 1, 8, 9],  # first wrong -> 0
+        ]
+    )
+    lens = jnp.asarray([4, 4, 4], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(count_accepted(model, toks, lens)), [3, 1, 0]
+    )
+
+
+def test_count_accepted_respects_input_len():
+    # same matching drafts, but only 1 is real (input_len 2)
+    model = jnp.asarray([[7, 8, 9, 1]])
+    toks = jnp.asarray([[0, 7, 8, 9]])
+    np.testing.assert_array_equal(
+        np.asarray(
+            count_accepted(model, toks, jnp.asarray([2], jnp.int32))
+        ),
+        [1],
+    )
+    # no drafts at all
+    np.testing.assert_array_equal(
+        np.asarray(
+            count_accepted(model, toks, jnp.asarray([1], jnp.int32))
+        ),
+        [0],
+    )
+
+
+# ------------------------------------------------- verify-forward parity
+
+def test_spec_verify_logits_match_stepwise_decode():
+    """The multi-token verify pass must produce, at every position, the
+    same logits as feeding those tokens one decode step at a time."""
+    from vgate_tpu.models.decoder import (
+        decode_forward, init_params, prefill_forward, spec_verify_forward,
+    )
+    from vgate_tpu.models.specs import TINY_DENSE as spec
+
+    ps, n_pages_per_seq = 4, 8
+    B, S = 2, 4
+    params = init_params(spec, jax.random.PRNGKey(3), jnp.float32)
+    P = 1 + B * n_pages_per_seq
+    k_pages = jnp.zeros(
+        (spec.num_layers, spec.num_kv_heads, P, ps, spec.head_dim),
+        jnp.float32,
+    )
+    v_pages = jnp.zeros_like(k_pages)
+    pt = jnp.asarray(
+        1 + np.arange(B * n_pages_per_seq, dtype=np.int32).reshape(
+            B, n_pages_per_seq
+        )
+    )
+    rng = np.random.default_rng(9)
+    prompt_lens = [6, 9]
+    prompts = np.zeros((B, ps * 4), np.int32)
+    for b, n in enumerate(prompt_lens):
+        prompts[b, :n] = rng.integers(2, spec.vocab_size, size=n)
+    _, k_pages, v_pages = prefill_forward(
+        params, spec, jnp.asarray(prompts),
+        jnp.asarray(prompt_lens, jnp.int32), k_pages, v_pages,
+        pt[:, :4],
+    )
+    cand = rng.integers(2, spec.vocab_size, size=(B, S)).astype(np.int32)
+    positions0 = jnp.asarray(prompt_lens, jnp.int32)  # next position
+    # ---- verify pass over all S candidates at once
+    ver_logits, _, _ = spec_verify_forward(
+        params, spec, jnp.asarray(cand), positions0,
+        jnp.full((B,), S, jnp.int32), k_pages, v_pages, pt,
+        active=jnp.asarray([True, True]),
+    )
+    # ---- oracle: the same tokens stepped one decode at a time
+    kp, vp = k_pages, v_pages
+    for j in range(S):
+        step_logits, kp, vp = decode_forward(
+            params, spec, jnp.asarray(cand[:, j]), positions0 + j,
+            kp, vp, pt, active=jnp.asarray([True, True]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ver_logits[:, j]), np.asarray(step_logits),
+            rtol=2e-4, atol=2e-4, err_msg=f"position {j}",
+        )
+
+
+# --------------------------------------------------------- engine rounds
+
+def spec_config(k=3, **tpu_overrides):
+    tpu = {
+        "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+        "kv_num_pages": 64, "kv_page_size": 4,
+        "max_batch_slots": 4, "prefill_buckets": [8, 16],
+        "use_pallas": False,
+        "speculative_k": k,
+    }
+    tpu.update(tpu_overrides)
+    return load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu=tpu,
+        scheduler={"max_queue_size": 16},
+        logging={"level": "WARNING"},
+    )
+
+
+def greedy(n=10):
+    return SamplingParams(max_tokens=n, temperature=0.0)
+
+
+def test_speculative_engine_matches_plain_greedy():
+    """Whatever the n-gram drafter proposes, greedy output must be
+    token-for-token identical to the non-speculative engine (verified
+    drafts can only accelerate, never change, the sequence)."""
+    prompts = [
+        "repeat repeat repeat repeat",  # n-gram friendly
+        "one two three four",
+        "zzz",
+    ]
+    plain = EngineCore(spec_config(k=0), devices=jax.devices()[:1])
+    plain.start()
+    try:
+        base = plain.generate(prompts, [greedy(12)] * 3)
+    finally:
+        plain.stop()
+
+    spec_core = EngineCore(spec_config(k=3), devices=jax.devices()[:1])
+    spec_core.start()
+    try:
+        got = spec_core.generate(prompts, [greedy(12)] * 3)
+        stats = spec_core.get_stats()
+    finally:
+        spec_core.stop()
+    for b, g in zip(base, got):
+        assert b["token_ids"] == g["token_ids"]
+        assert b["finish_reason"] == g["finish_reason"]
+    assert stats["speculative"]["k"] == 3
+
+
+def test_oracle_drafter_accepts_and_saves_steps():
+    """With a drafter that knows the true continuation, every round
+    accepts k drafts: the run finishes in ~n/(k+1) verify rounds and the
+    stats record full acceptance."""
+    prompts = ["oracle probe"]
+    n = 12
+    plain = EngineCore(spec_config(k=0), devices=jax.devices()[:1])
+    plain.start()
+    try:
+        [base] = plain.generate(prompts, [greedy(n)])
+    finally:
+        plain.stop()
+    truth = base["token_ids"]
+
+    core = EngineCore(spec_config(k=3), devices=jax.devices()[:1])
+
+    def oracle(seq, k):
+        done = seq.num_generated
+        return truth[done : done + k]
+
+    core.drafter = oracle
+    core.start()
+    try:
+        steps_before = core.total_steps
+        [got] = core.generate(prompts, [greedy(n)])
+        rounds = core.total_steps - steps_before
+        stats = core.get_stats()
+    finally:
+        core.stop()
+    assert got["token_ids"] == truth
+    # 12 tokens: prefill gives 1, then ceil(11 / 4) = 3 verify rounds
+    assert rounds <= 4, f"expected <=4 verify rounds, ran {rounds}"
+    assert stats["speculative"]["accepted"] >= 6
+
+
+def test_speculative_respects_exact_budget_and_temperature():
+    """max_tokens is exact under multi-accept rounds, and temperature>0
+    sequences (which never draft) still produce the full budget."""
+    core = EngineCore(spec_config(k=3), devices=jax.devices()[:1])
+    core.start()
+    try:
+        results = core.generate(
+            ["budget probe", "sampled seq"],
+            [greedy(7), SamplingParams(max_tokens=7, temperature=0.8,
+                                       seed=11)],
+        )
+        stats = core.get_stats()["scheduler"]
+    finally:
+        core.stop()
+    for r in results:
+        assert r["num_tokens"] == 7
+        assert r["finish_reason"] == "length"
+    assert stats["running"] == 0
+
+
+def test_speculative_rejects_pp():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    cfg = spec_config(k=2, pp=2, num_devices=2)
+    with pytest.raises(ValueError, match="speculative"):
+        EngineCore(cfg, devices=jax.devices()[:2])

@@ -571,3 +571,73 @@ def prefill_suffix_forward(
         x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1
     )[:, 0]
     return _logits(params, spec, last_hidden), k_pages, v_pages
+
+
+def spec_verify_forward(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,  # [B, S]: [current, draft_1, ..., draft_{S-1}]
+    positions0: jnp.ndarray,  # [B] global position of tokens[:, 0]
+    input_lens: jnp.ndarray,  # [B] 1 + real drafts this row (<= S)
+    k_pages: jnp.ndarray,  # [L, KV, P, ps, hd]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, pages_per_seq]
+    active: Optional[jnp.ndarray] = None,  # [B] bool
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative-decoding verification: score ``S`` candidate tokens per
+    slot in one pass over the paged KV cache (runtime/speculative.py).
+
+    A multi-token decode step: KV for all candidates is written at
+    positions ``p..p+S-1`` (invalid rows and inactive slots scatter to
+    trash page 0), then each candidate attends the context window with the
+    blockwise suffix attention (ops/attention.py paged_suffix_attention —
+    unlike the page-aligned prefix-cache suffix pass, ``positions0`` here
+    is arbitrary, which the per-token scatter handles).  Tokens past the
+    accepted prefix leave garbage KV beyond the sequence's new length;
+    later steps mask it via ``seq_lens`` and overwrite it in place — the
+    paged-KV form of "no rollback needed".  Returns (logits [B, S, V],
+    k_pages, v_pages).
+    """
+    B, S = tokens.shape
+    ps = k_pages.shape[3]
+    width = page_tables.shape[1]
+    positions = positions0[:, None] + jnp.arange(S)[None, :]  # [B, S]
+    # overshoot rows stay in-bounds (same discipline as decode's
+    # max_position clamp); their writes are trashed anyway
+    positions = jnp.minimum(positions, width * ps - 1)
+    valid = jnp.arange(S)[None, :] < input_lens[:, None]  # [B, S]
+    write_ok = valid if active is None else (valid & active[:, None])
+    page_slot = positions // ps
+    page_off = positions % ps
+    page_ids = jnp.take_along_axis(page_tables, page_slot, axis=1)
+    page_ids = jnp.where(write_ok, page_ids, 0)  # trash page 0
+    total_lens = positions0 + input_lens
+    x = _embed(params, spec, tokens)  # [B, S, D]
+    windows = _layer_windows(spec)
+
+    def layer_fn(h, per_layer):
+        lp, win, k_pages_l, v_pages_l = per_layer
+        normed = rms_norm(
+            h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
+        )
+        q, k, v = _project_qkv(normed, lp, spec)
+        q = apply_rope(q, positions, spec.rope_theta)
+        k = apply_rope(k, positions, spec.rope_theta)
+        k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
+            jnp.transpose(k, (2, 0, 1, 3))
+        )
+        v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
+            jnp.transpose(v, (2, 0, 1, 3))
+        )
+        attn = paged_suffix_attention(
+            q, k_pages_l, v_pages_l, page_tables, positions0, total_lens,
+            softcap=spec.attn_softcap,
+            window=win if spec.sliding_window > 0 else None,
+            scale=_query_scale(spec),
+        )
+        return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+    )
+    return _logits(params, spec, x), k_pages, v_pages

@@ -47,6 +47,7 @@ from vgate_tpu.models.decoder import (
     decode_forward,
     prefill_forward,
     prefill_suffix_forward,
+    spec_verify_forward,
 )
 from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
 from vgate_tpu.ops.sampling import sample_tokens
@@ -191,6 +192,50 @@ def _decode_chunk(
     return chunk_tokens, tokens, positions, counter, steps, k_pages, v_pages
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("spec",),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def _spec_verify_step(
+    params, spec: ModelSpec, tokens, positions0, input_lens, k_pages,
+    v_pages, page_tables, active, temps, top_ps, top_ks, base_key, counter,
+    seeds=None, steps=None,
+):
+    """One speculative round: score current token + drafts in a single
+    forward (models/decoder.py spec_verify_forward), sample the model's
+    token at EVERY position with the per-slot sampling params (greedy
+    slots verify drafts; temperature>0 slots have input_len 1, so only
+    their position-0 sample is ever consumed — the plain decode step), and
+    count accepted drafts on device.  Returns (model_toks [B, S],
+    accepted [B], caches)."""
+    from vgate_tpu.runtime.speculative import count_accepted
+
+    logits, k_pages, v_pages = spec_verify_forward(
+        params, spec, tokens, positions0, input_lens, k_pages, v_pages,
+        page_tables, active=active,
+    )  # [B, S, V]
+    B, S = tokens.shape
+    key = jax.random.fold_in(base_key, counter)
+    # one batched sampler over all (slot, position) rows — per-position
+    # step indices keep seeded reproducibility aligned with the token
+    # index, exactly like the decode chunk's per-step `steps` increment
+    rep = functools.partial(jnp.repeat, repeats=S, axis=0)
+    steps_flat = (
+        None
+        if steps is None
+        else (steps[:, None] + jnp.arange(S)[None, :]).reshape(-1)
+    )
+    model_toks = sample_tokens(
+        logits.reshape(B * S, -1),
+        rep(temps), rep(top_ps), rep(top_ks), key,
+        seeds=None if seeds is None else rep(seeds),
+        steps=steps_flat,
+    ).reshape(B, S)
+    accepted = count_accepted(model_toks, tokens, input_lens)
+    return model_toks, accepted, k_pages, v_pages
+
+
 class EngineCore:
     """Owns params, KV pages, the mesh and the engine thread."""
 
@@ -307,6 +352,16 @@ class EngineCore:
         self._pending_chunks: list = []
         self.decode_chunk = max(1, tpu_cfg.decode_chunk)
         self.pipeline_depth = max(1, tpu_cfg.decode_pipeline)
+        # Speculative decoding (runtime/speculative.py): per-sequence
+        # prompt-lookup drafts verified in one multi-token step.  The
+        # drafter is pluggable (tests inject oracles).
+        self.spec_k = max(0, tpu_cfg.speculative_k)
+        self.spec_ngram = max(1, tpu_cfg.speculative_ngram)
+        self.drafter: Callable[[Sequence, int], List[int]] = (
+            self._ngram_drafter
+        )
+        self.total_spec_drafted = 0
+        self.total_spec_accepted = 0
 
         # sp>1: prefill attention runs sequence-parallel (ring attention
         # over the sp axis); buckets must then split evenly across shards.
@@ -343,6 +398,11 @@ class EngineCore:
             raise ValueError(
                 f"{self.spec.name} uses sliding-window/softcap attention, "
                 "not yet supported with sp>1 or pp>1"
+            )
+        if tpu_cfg.speculative_k > 0 and pp_size > 1:
+            raise ValueError(
+                "speculative decoding is not supported with pp>1 (the "
+                "verify step has no pipeline-stage relay)"
             )
 
         # Pallas kernels require a real TPU backend (tests run interpret-mode
@@ -482,6 +542,9 @@ class EngineCore:
         Returns False when there was no work (the loop then sleeps).
         """
         self._drain_submissions()
+        if self.spec_k > 0:
+            worked = self._admit_and_prefill()
+            return self._tick_speculative() or worked
         worked = self._admit_and_prefill()
 
         active = self._running_seqs()
@@ -943,6 +1006,131 @@ class EngineCore:
             if not drain:
                 break
 
+    # --------------------------------------------------------- speculative
+
+    def _ngram_drafter(self, seq: Sequence, k: int) -> List[int]:
+        from vgate_tpu.runtime.speculative import NgramIndex
+
+        index = getattr(seq, "_ngram_index", None)
+        if index is None or index.ngram != self.spec_ngram:
+            index = NgramIndex(self.spec_ngram)
+            seq._ngram_index = index  # incremental; dies with the seq
+        return index.draft(seq.prompt_ids + seq.output_ids, k)
+
+    def _tick_speculative(self) -> bool:
+        """One speculative decode round (tpu.speculative_k > 0): draft up
+        to k tokens per greedy sequence from its own history, verify all
+        of them in ONE forward, and append the accepted run + the model's
+        bonus token.  Per round each sequence advances by 1..k+1 tokens at
+        the cost of a single dispatch; with zero drafts the round is
+        exactly a decode step (runtime/speculative.py for the contract).
+
+        Host-driven (no device-resident chaining, no chunk pipeline):
+        acceptance counts are data-dependent, so positions feed back
+        through the host each round.  That trade targets single-stream
+        latency on local hardware; high-RTT links prefer chunked decode.
+        """
+        active = self._running_seqs()
+        if not active:
+            return False
+        S = self.spec_k + 1
+        if not self.scheduler.prepare_decode(active, horizon=S):
+            return True  # preemption changed membership; retry next tick
+        active = self._running_seqs()
+        if not active:
+            return True
+        B = self.max_slots
+        max_len = self.config.model.max_model_len
+        tokens = np.zeros((B, S), np.int32)
+        positions0 = np.zeros((B,), np.int32)
+        input_lens = np.ones((B,), np.int32)
+        active_mask = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        seeds = np.full((B,), -1, np.int32)
+        steps = np.zeros((B,), np.int32)
+        for seq in active:
+            slot = seq.slot
+            row = self._page_tables_np[slot]
+            row[:] = 0
+            row[: len(seq.pages)] = seq.pages
+            tokens[slot, 0] = seq.output_ids[-1]
+            positions0[slot] = seq.total_len - 1
+            active_mask[slot] = True
+            temps[slot] = seq.params.temperature
+            top_ps[slot] = seq.params.top_p
+            top_ks[slot] = seq.params.top_k
+            if seq.params.seed is not None:
+                seeds[slot] = seq.params.seed
+            steps[slot] = seq.num_generated
+            # acceptance+bonus never exceeds input_len, so capping the
+            # input at the remaining budget/length bounds overshoot
+            room = min(
+                S,
+                max(1, seq.params.max_tokens) - seq.num_generated,
+                max_len - seq.total_len + 1,
+            )
+            if room > 1 and seq.params.temperature == 0.0:
+                draft = self.drafter(seq, room - 1)
+                if draft:
+                    tokens[slot, 1 : 1 + len(draft)] = draft
+                    input_lens[slot] = 1 + len(draft)
+        # bucket the context window to the live maximum (next power of two
+        # in pages): the verify attention gathers the whole passed table
+        # width per layer, so slicing it keeps the gather O(context), not
+        # O(max_model_len) — at the cost of log2(pages_per_seq) compiled
+        # variants
+        w_needed = max(len(seq.pages) for seq in active)
+        width = self._page_tables_np.shape[1]
+        if w_needed < width:
+            width = min(width, 1 << (max(1, w_needed) - 1).bit_length())
+            width = max(width, w_needed)
+        start = time.perf_counter()
+        model_toks, accepted, self.k_pages, self.v_pages = (
+            _spec_verify_step(
+                self.params,
+                self.spec,
+                jnp.asarray(tokens),
+                jnp.asarray(positions0),
+                jnp.asarray(input_lens),
+                self.k_pages,
+                self.v_pages,
+                jnp.asarray(self._page_tables_np[:, :width]),
+                jnp.asarray(active_mask),
+                jnp.asarray(temps),
+                jnp.asarray(top_ps),
+                jnp.asarray(top_ks),
+                self._base_key,
+                jnp.asarray(self._step_counter, jnp.uint32),
+                seeds=jnp.asarray(seeds),
+                steps=jnp.asarray(steps),
+            )
+        )
+        self._step_counter += 1
+        toks_np = np.asarray(model_toks)  # [B, S]; blocks
+        acc_np = np.asarray(accepted)
+        metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
+            time.perf_counter() - start
+        )
+        for seq in active:
+            if seq.status is not SeqStatus.RUNNING:
+                continue
+            slot = seq.slot
+            self.total_spec_drafted += int(input_lens[slot]) - 1
+            self.total_spec_accepted += int(acc_np[slot])
+            # model_toks[:, j] for j < accepted IS draft j+1; position
+            # `accepted` holds the bonus token — one loop covers both
+            for j in range(int(acc_np[slot]) + 1):
+                token = int(toks_np[slot, j])
+                seq.append_token(token)
+                self.total_decode_tokens += 1
+                self._maybe_finish(seq, token)
+                if seq.status is not SeqStatus.RUNNING:
+                    break
+        self.total_steps += 1
+        return True
+
     def _maybe_finish(self, seq: Sequence, token: int) -> None:
         reason = None
         if token == self.tokenizer.eos_id:
@@ -1097,4 +1285,20 @@ class EngineCore:
                 axis: int(size) for axis, size in self.mesh.shape.items()
             },
             "load_time_s": round(self.load_time_s, 2),
+            **(
+                {
+                    "speculative": {
+                        "k": self.spec_k,
+                        "drafted": self.total_spec_drafted,
+                        "accepted": self.total_spec_accepted,
+                        "acceptance_rate": round(
+                            self.total_spec_accepted
+                            / max(1, self.total_spec_drafted),
+                            3,
+                        ),
+                    }
+                }
+                if self.spec_k > 0
+                else {}
+            ),
         }

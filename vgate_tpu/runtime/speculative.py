@@ -1,0 +1,104 @@
+"""Speculative decoding: prompt-lookup drafting + batched verification.
+
+The reference has no speculative path (its decode is vLLM's, consumed
+opaquely at vgate/backends/vllm_backend.py:51); this is a TPU-native
+extra: drafts come from the sequence's own history (prompt-lookup /
+n-gram matching — no draft model, no extra weights in HBM), and one
+``spec_verify_forward`` pass (models/decoder.py) scores all drafts at
+once over the paged KV cache.  Rejected drafts need no KV rollback: the
+tokens past the accepted point sit at positions beyond the sequence's
+length, which every later attention masks out and the next verify step
+overwrites.
+
+Greedy-exact by construction: a draft token is accepted iff it equals
+the model's choice at its position, so the output always follows the
+verify program's own greedy trajectory — drafts can accelerate it but
+never steer it.  The standard program-variant caveat applies (as it
+does to chunked decode): the verify pass and the single-step decode
+pass are different compiled programs, so an ulp-level logit tie can in
+principle break differently between them; the CPU suite pins
+token-identical output against the plain engine in practice
+(tests/test_speculative.py).  Sequences with temperature > 0 simply
+don't draft (their rows run single-token steps inside the same
+program) — distribution-preserving rejection sampling is a possible
+extension, not attempted here.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+
+class NgramIndex:
+    """Incremental prompt-lookup index for one sequence.
+
+    Maps every ``ngram``-window of the history to its most recent start
+    position, extended by only the windows added since the last call —
+    so a draft costs O(new tokens), not a rescan of the whole history
+    (the sequence's identity survives preemption: recompute folds
+    outputs into the prompt but the concatenated token content is
+    unchanged, so ``n_indexed`` stays valid).
+    """
+
+    def __init__(self, ngram: int = 2) -> None:
+        self.ngram = max(1, ngram)
+        self.pos: dict = {}
+        self.n_indexed = 0  # windows with start < n_indexed are indexed
+
+    def draft(self, ids: Sequence[int], k: int) -> List[int]:
+        """Propose up to ``k`` continuation tokens by prompt lookup.
+
+        Finds the most recent earlier occurrence of the final ``ngram``
+        tokens and returns what followed it.  Returns [] when the
+        history is too short or the n-gram never recurred — speculation
+        then degrades to a plain decode step, never to a wrong result
+        (drafts are verified, not trusted).
+        """
+        g = self.ngram
+        n = len(ids)
+        # index every complete window that ends before the final key
+        # window (start <= n - g - 1); later occurrences overwrite
+        # earlier ones, so lookups see the most recent repetition
+        while self.n_indexed <= n - g - 1:
+            i = self.n_indexed
+            self.pos[tuple(ids[i : i + g])] = i
+            self.n_indexed += 1
+        if k <= 0 or n < g + 1:
+            return []
+        start = self.pos.get(tuple(ids[-g:]))
+        if start is None:
+            return []
+        return list(ids[start + g : start + g + k])
+
+
+def ngram_draft(
+    ids: Sequence[int], k: int, ngram: int = 2
+) -> List[int]:
+    """One-shot prompt lookup (see NgramIndex for the incremental form
+    the engine uses)."""
+    return NgramIndex(ngram).draft(ids, k)
+
+
+def count_accepted(
+    model_toks: jnp.ndarray,  # [B, S] the model's token at each position
+    tokens: jnp.ndarray,  # [B, S] input: [current, draft_1, ..., draft_{S-1}]
+    input_lens: jnp.ndarray,  # [B] 1 + number of real drafts per row
+) -> jnp.ndarray:
+    """Leading-match acceptance count per row (jit-safe, [B] int32).
+
+    Draft ``tokens[:, j]`` (j >= 1) is accepted iff it equals the model's
+    choice at the previous position ``model_toks[:, j-1]`` and every
+    earlier draft was accepted; the first mismatch stops the run (the
+    model's token there becomes the bonus token).  Rows with
+    ``input_lens == 1`` (no draft) always return 0.
+    """
+    S = tokens.shape[1]
+    idx = jnp.arange(1, S)
+    ok = (model_toks[:, :-1] == tokens[:, 1:]) & (
+        idx[None, :] < input_lens[:, None]
+    )
+    # cumprod turns the boolean run into 1,1,...,1,0,0 — its sum is the
+    # length of the accepted prefix
+    return jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
