@@ -244,3 +244,66 @@ def test_flash_prefill_kernel_window_softcap_scale():
     np.testing.assert_allclose(
         np.asarray(got0), np.asarray(expect0), rtol=2e-5, atol=2e-5
     )
+
+
+def test_paged_multitok_kernel_matches_suffix_attention():
+    """The speculative-verify kernel vs the jnp suffix path: S candidate
+    rows per slot, varying input_lens, window on/off, softcap+scale."""
+    from vgate_tpu.ops.attention import paged_suffix_attention
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_multitok_attention_pallas,
+    )
+
+    rng = np.random.default_rng(41)
+    B, S, H, KV, hd, ps, n_pages = 3, 4, 4, 2, 32, 4, 16
+    P = 1 + B * n_pages
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    pt = jnp.asarray(
+        1 + np.arange(B * n_pages, dtype=np.int32).reshape(B, n_pages)
+    )
+    positions0 = jnp.asarray([10, 37, 0], jnp.int32)
+    input_lens = jnp.asarray([4, 2, 1], jnp.int32)
+    total = positions0 + input_lens
+
+    cases = [
+        dict(softcap=0.0, window=None, scale=None),
+        dict(softcap=30.0, window=jnp.asarray(16, jnp.int32), scale=0.1),
+        dict(softcap=0.0, window=jnp.asarray(0, jnp.int32), scale=None),
+    ]
+    valid = np.arange(S)[None, :] < np.asarray(input_lens)[:, None]
+    for case in cases:
+        expect = paged_suffix_attention(
+            q, k_pages, v_pages, pt, positions0, total, **case
+        )
+        got = paged_multitok_attention_pallas(
+            q, k_pages, v_pages, pt, positions0, input_lens,
+            interpret=True, **case,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got)[valid], np.asarray(expect)[valid],
+            rtol=2e-5, atol=2e-5, err_msg=str(case),
+        )
+
+
+def test_paged_multitok_kernel_single_row_matches_decode_kernel():
+    """With S=1 the multi-token kernel degenerates to the decode kernel."""
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_multitok_attention_pallas,
+    )
+
+    q, k_pages, v_pages, page_tables, seq_lens = make_case(
+        lens=[9, 33, 64, 128], seed=42
+    )
+    B, H, hd = q.shape
+    expect = paged_decode_attention_pallas(
+        q, k_pages, v_pages, page_tables, seq_lens, interpret=True
+    )
+    got = paged_multitok_attention_pallas(
+        q[:, None], k_pages, v_pages, page_tables, seq_lens - 1,
+        jnp.ones((B,), jnp.int32), interpret=True,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )

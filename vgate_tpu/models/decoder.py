@@ -583,16 +583,19 @@ def spec_verify_forward(
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     active: Optional[jnp.ndarray] = None,  # [B] bool
+    use_pallas: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Speculative-decoding verification: score ``S`` candidate tokens per
     slot in one pass over the paged KV cache (runtime/speculative.py).
 
     A multi-token decode step: KV for all candidates is written at
     positions ``p..p+S-1`` (invalid rows and inactive slots scatter to
-    trash page 0), then each candidate attends the context window with the
-    blockwise suffix attention (ops/attention.py paged_suffix_attention —
-    unlike the page-aligned prefix-cache suffix pass, ``positions0`` here
-    is arbitrary, which the per-token scatter handles).  Tokens past the
+    trash page 0), then each candidate attends the context window — via
+    the multi-token Pallas kernel (ops/pallas/paged_attention.py
+    paged_multitok_attention_pallas: live-page DMA only) when
+    ``use_pallas``, the blockwise jnp suffix attention otherwise (unlike
+    the page-aligned prefix-cache suffix pass, ``positions0`` here is
+    arbitrary, which the per-token scatter handles).  Tokens past the
     accepted prefix leave garbage KV beyond the sequence's new length;
     later steps mask it via ``seq_lens`` and overwrite it in place — the
     paged-KV form of "no rollback needed".  Returns (logits [B, S, V],
@@ -614,6 +617,10 @@ def spec_verify_forward(
     total_lens = positions0 + input_lens
     x = _embed(params, spec, tokens)  # [B, S, D]
     windows = _layer_windows(spec)
+    if use_pallas:
+        from vgate_tpu.ops.pallas.paged_attention import (
+            paged_multitok_attention_pallas,
+        )
 
     def layer_fn(h, per_layer):
         lp, win, k_pages_l, v_pages_l = per_layer
@@ -629,12 +636,19 @@ def spec_verify_forward(
         v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
             jnp.transpose(v, (2, 0, 1, 3))
         )
-        attn = paged_suffix_attention(
-            q, k_pages_l, v_pages_l, page_tables, positions0, total_lens,
-            softcap=spec.attn_softcap,
-            window=win if spec.sliding_window > 0 else None,
-            scale=_query_scale(spec),
-        )
+        window = win if spec.sliding_window > 0 else None
+        if use_pallas:
+            attn = paged_multitok_attention_pallas(
+                q, k_pages_l, v_pages_l, page_tables, positions0,
+                input_lens, window=window,
+                softcap=spec.attn_softcap, scale=_query_scale(spec),
+            )
+        else:
+            attn = paged_suffix_attention(
+                q, k_pages_l, v_pages_l, page_tables, positions0,
+                total_lens, softcap=spec.attn_softcap, window=window,
+                scale=_query_scale(spec),
+            )
         return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(

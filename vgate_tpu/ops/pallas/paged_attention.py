@@ -32,6 +32,66 @@ from vgate_tpu.utils.math import cdiv
 CHUNK_PAGES = 8  # pages DMA'd per double-buffer slot
 
 
+
+def _chunk_dma(
+    page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
+    b, g, n_pages, page_size,
+):
+    """Shared double-buffered page-DMA machinery for the paged kernels.
+
+    Returns ``(start_chunk, wait_chunk)`` closures: ``start_chunk(c, slot)``
+    kicks off the async copies of chunk ``c``'s live pages into buffer
+    ``slot`` (zero-filling pages beyond the sequence — stale VMEM could
+    hold NaNs, and softmax-weight 0 x NaN would poison the accumulator);
+    ``wait_chunk`` blocks on those copies."""
+
+    def start_chunk(c, slot):
+        for j in range(CHUNK_PAGES):  # static unroll
+            page_pos = c * CHUNK_PAGES + j
+
+            @pl.when(page_pos < n_pages)
+            def _():
+                page_id = page_tables_ref[b, page_pos]
+                pltpu.make_async_copy(
+                    k_pages_ref.at[g, page_id],
+                    k_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 0, j],
+                ).start()
+                pltpu.make_async_copy(
+                    v_pages_ref.at[g, page_id],
+                    v_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 1, j],
+                ).start()
+
+            @pl.when(page_pos >= n_pages)
+            def _():
+                k_buf[slot, pl.ds(j * page_size, page_size), :] = jnp.zeros(
+                    (page_size, k_buf.shape[-1]), k_buf.dtype
+                )
+                v_buf[slot, pl.ds(j * page_size, page_size), :] = jnp.zeros(
+                    (page_size, v_buf.shape[-1]), v_buf.dtype
+                )
+
+    def wait_chunk(c, slot):
+        for j in range(CHUNK_PAGES):
+            page_pos = c * CHUNK_PAGES + j
+
+            @pl.when(page_pos < n_pages)
+            def _():
+                pltpu.make_async_copy(
+                    k_pages_ref.at[g, 0],
+                    k_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 0, j],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_pages_ref.at[g, 0],
+                    v_buf.at[slot, pl.ds(j * page_size, page_size), :],
+                    sems.at[slot, 1, j],
+                ).wait()
+
+    return start_chunk, wait_chunk
+
+
 def _kernel(
     # scalar prefetch
     page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)
@@ -70,52 +130,10 @@ def _kernel(
     )
     lo_chunk = jax.lax.div(lo, chunk_tokens)
 
-    def start_chunk(c, slot):
-        """Kick off the DMAs for chunk c into buffer `slot`."""
-        for j in range(CHUNK_PAGES):  # static unroll
-            page_pos = c * CHUNK_PAGES + j
-
-            @pl.when(page_pos < n_pages)
-            def _():
-                page_id = page_tables_ref[b, page_pos]
-                pltpu.make_async_copy(
-                    k_pages_ref.at[g, page_id],
-                    k_buf.at[slot, pl.ds(j * page_size, page_size), :],
-                    sems.at[slot, 0, j],
-                ).start()
-                pltpu.make_async_copy(
-                    v_pages_ref.at[g, page_id],
-                    v_buf.at[slot, pl.ds(j * page_size, page_size), :],
-                    sems.at[slot, 1, j],
-                ).start()
-
-            # zero pages beyond the sequence: stale VMEM could hold NaNs,
-            # and softmax-weight 0 x NaN would poison the accumulator
-            @pl.when(page_pos >= n_pages)
-            def _():
-                k_buf[slot, pl.ds(j * page_size, page_size), :] = jnp.zeros(
-                    (page_size, k_buf.shape[-1]), k_buf.dtype
-                )
-                v_buf[slot, pl.ds(j * page_size, page_size), :] = jnp.zeros(
-                    (page_size, v_buf.shape[-1]), v_buf.dtype
-                )
-
-    def wait_chunk(c, slot):
-        for j in range(CHUNK_PAGES):
-            page_pos = c * CHUNK_PAGES + j
-
-            @pl.when(page_pos < n_pages)
-            def _():
-                pltpu.make_async_copy(
-                    k_pages_ref.at[g, 0],
-                    k_buf.at[slot, pl.ds(j * page_size, page_size), :],
-                    sems.at[slot, 0, j],
-                ).wait()
-                pltpu.make_async_copy(
-                    v_pages_ref.at[g, 0],
-                    v_buf.at[slot, pl.ds(j * page_size, page_size), :],
-                    sems.at[slot, 1, j],
-                ).wait()
+    start_chunk, wait_chunk = _chunk_dma(
+        page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
+        b, g, n_pages, page_size,
+    )
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
 
@@ -243,3 +261,203 @@ def paged_decode_attention_pallas(
         q.reshape(B, KV, G, hd), k_pages, v_pages,
     )
     return out.reshape(B, H, hd)
+
+
+def _mt_kernel(
+    # scalar prefetch
+    page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)
+    positions0_ref,  # [B] int32 — global position of query row 0
+    input_lens_ref,  # [B] int32 — real query rows this slot (<= S)
+    window_ref,  # [1] int32; >0 => attend only to the last `window`
+    # inputs
+    q_ref,  # [1, 1, S, G, hd] VMEM block for (b, g)
+    k_pages_ref,  # [KV, P, ps, hd] ANY/HBM
+    v_pages_ref,
+    # output
+    out_ref,  # [1, 1, S, G, hd]
+    # scratch
+    k_buf,  # [2, CHUNK*ps, hd]
+    v_buf,
+    acc_ref,  # [S*G, hd] f32
+    m_ref,  # [S*G, 128] f32
+    l_ref,  # [S*G, 128] f32
+    sems,
+    *,
+    page_size: int,
+    softcap: float,
+    scale: float,
+):
+    """Multi-token decode attention: S candidate tokens per slot attend
+    the slot's paged context in one program (the speculative-decoding
+    verify step; runtime/speculative.py).  Same double-buffered per-page
+    DMA as the single-token kernel — query row s sees keys up to
+    ``positions0 + s`` (causal within the candidates) intersected with
+    the sliding window when one applies."""
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    pos0 = positions0_ref[b]
+    input_len = input_lens_ref[b]
+    seq_len = pos0 + input_len  # keys written incl. all candidates
+    n_pages = jax.lax.div(seq_len + page_size - 1, page_size)
+    n_chunks = jax.lax.div(n_pages + CHUNK_PAGES - 1, CHUNK_PAGES)
+    chunk_tokens = CHUNK_PAGES * page_size
+    window = window_ref[0]
+    # the LAST query row's window reaches lowest; chunks fully below the
+    # FIRST row's window are dead for every row
+    lo = jnp.where(window > 0, jnp.maximum(pos0 - window + 1, 0), 0)
+    lo_chunk = jax.lax.div(lo, chunk_tokens)
+
+    start_chunk, wait_chunk = _chunk_dma(
+        page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
+        b, g, n_pages, page_size,
+    )
+
+    S, G, hd = q_ref.shape[-3], q_ref.shape[-2], q_ref.shape[-1]
+    q = q_ref[0, 0].astype(jnp.float32).reshape(S * G, hd) * scale
+    # per-row global query position: row r = (s, g') -> pos0 + s
+    row_pos = pos0 + jax.lax.broadcasted_iota(
+        jnp.int32, (S * G, 1), 0
+    ) // G  # [S*G, 1]
+
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    m_ref[...] = jnp.full_like(m_ref, -1e30)
+    l_ref[...] = jnp.zeros_like(l_ref)
+
+    start_chunk(lo_chunk, jax.lax.rem(lo_chunk, 2))
+
+    def body(c, _):
+        slot = jax.lax.rem(c, 2)
+        next_slot = jax.lax.rem(c + 1, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            start_chunk(c + 1, next_slot)
+
+        wait_chunk(c, slot)
+
+        k = jax.lax.cond(
+            slot == 0, lambda: k_buf[0], lambda: k_buf[1]
+        ).astype(jnp.float32)
+        v = jax.lax.cond(
+            slot == 0, lambda: v_buf[0], lambda: v_buf[1]
+        ).astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [S*G, chunk_tokens]
+        if softcap:
+            scores = jnp.tanh(scores / softcap) * softcap
+        token_pos = c * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        valid = (token_pos <= row_pos) & (token_pos < seq_len)
+        valid = valid & (
+            (window <= 0) | (row_pos - token_pos < window)
+        )
+        scores = jnp.where(valid, scores, -1e30)
+
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        # fully-masked chunks (possible for early rows) must not pollute
+        # the accumulator with exp(-1e30 - (-1e30)) = 1 weights
+        p = jnp.where(valid, p, 0.0)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        return 0
+
+    jax.lax.fori_loop(lo_chunk, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[:, :1], 1e-30)
+    out = (acc_ref[...] / denom).astype(out_ref.dtype)
+    out_ref[0, 0] = out.reshape(S, G, hd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "softcap", "scale")
+)
+def paged_multitok_attention_pallas(
+    q: jnp.ndarray,  # [B, S, H, hd] candidate-token queries
+    k_pages: jnp.ndarray,  # [KV, P, ps, hd]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, pages_per_seq]
+    positions0: jnp.ndarray,  # [B] global position of q[:, 0]
+    input_lens: jnp.ndarray,  # [B] real candidate rows (<= S)
+    window=None,
+    interpret: bool = False,
+    softcap: float = 0.0,
+    scale=None,
+) -> jnp.ndarray:
+    """Speculative-verify attention over paged KV. Returns [B, S, H, hd].
+
+    The candidates' KV must already be written into the pages (the
+    verify layer scatters before attending).  Rows past ``input_lens``
+    return unspecified values (their garbage queries attend the real
+    context) — callers must mask by ``input_lens``, as the engine and
+    the tests do."""
+    B, S, H, hd = q.shape
+    KV, P, ps, _ = k_pages.shape
+    G = H // KV
+    chunk_tokens = CHUNK_PAGES * ps
+
+    if window is None:
+        window_arr = jnp.zeros((1,), jnp.int32)
+    else:
+        window_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    kernel = functools.partial(
+        _mt_kernel,
+        page_size=ps,
+        softcap=float(softcap),
+        scale=float(scale) if scale is not None else hd ** -0.5,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, KV),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, S, G, hd),
+                lambda b, g, *pf: (b, g, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, S, G, hd),
+            lambda b, g, *pf: (b, g, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_tokens, hd), k_pages.dtype),
+            pltpu.VMEM((2, chunk_tokens, hd), v_pages.dtype),
+            pltpu.VMEM((S * G, hd), jnp.float32),
+            pltpu.VMEM((S * G, 128), jnp.float32),
+            pltpu.VMEM((S * G, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, CHUNK_PAGES)),
+        ],
+    )
+    # [B, S, H, hd] -> [B, KV, S, G, hd]: KV-major so one program's block
+    # covers its group's rows contiguously
+    qt = jnp.transpose(
+        q.reshape(B, S, KV, G, hd), (0, 2, 1, 3, 4)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, S, G, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+    )(
+        page_tables, positions0, input_lens, window_arr,
+        qt, k_pages, v_pages,
+    )
+    return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, S, H, hd)

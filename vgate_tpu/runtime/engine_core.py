@@ -194,13 +194,13 @@ def _decode_chunk(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec",),
+    static_argnames=("spec", "use_pallas"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _spec_verify_step(
     params, spec: ModelSpec, tokens, positions0, input_lens, k_pages,
     v_pages, page_tables, active, temps, top_ps, top_ks, base_key, counter,
-    seeds=None, steps=None,
+    seeds=None, steps=None, use_pallas=False,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), sample the model's
@@ -213,7 +213,7 @@ def _spec_verify_step(
 
     logits, k_pages, v_pages = spec_verify_forward(
         params, spec, tokens, positions0, input_lens, k_pages, v_pages,
-        page_tables, active=active,
+        page_tables, active=active, use_pallas=use_pallas,
     )  # [B, S, V]
     B, S = tokens.shape
     key = jax.random.fold_in(base_key, counter)
@@ -1076,6 +1076,15 @@ class EngineCore:
                 if draft:
                     tokens[slot, 1 : 1 + len(draft)] = draft
                     input_lens[slot] = 1 + len(draft)
+        # rounds where little/nothing drafted (non-repetitive text, or an
+        # all-sampled batch) run a narrower program variant — a no-draft
+        # round costs a plain decode step, not a k+1-wide verify of
+        # nothing.  Widths are powers of two so the variant count stays
+        # log2(S), mirroring the decode-chunk ladder.
+        S_round = 1 << (max(1, int(input_lens.max())) - 1).bit_length()
+        S_round = max(1, min(S, S_round))
+        if S_round < S:
+            tokens = tokens[:, :S_round]
         # bucket the context window to the live maximum (next power of two
         # in pages): the verify attention gathers the whole passed table
         # width per layer, so slicing it keeps the gather O(context), not
@@ -1105,6 +1114,7 @@ class EngineCore:
                 jnp.asarray(self._step_counter, jnp.uint32),
                 seeds=jnp.asarray(seeds),
                 steps=jnp.asarray(steps),
+                use_pallas=self.use_pallas,
             )
         )
         self._step_counter += 1
