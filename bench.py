@@ -111,7 +111,11 @@ def main() -> None:
         # the axon TPU plugin overrides JAX_PLATFORMS, so the config knob
         # is the only reliable pin for non-default platforms
         apply_platform(base_cfg.tpu)
-        model_id = "Qwen/Qwen2.5-1.5B-Instruct"
+        # VGT_BENCH_MODEL sweeps other registered families (e.g.
+        # google/gemma-2-2b-it exercises the sliding-window kernel path)
+        model_id = os.environ.get(
+            "VGT_BENCH_MODEL", "Qwen/Qwen2.5-1.5B-Instruct"
+        )
         dtype = "bfloat16"
         n_requests, prompt_len, max_tokens = 128, 120, 128
         # tunables (VGT_BENCH_* env for sweeps; defaults are the tuned best)

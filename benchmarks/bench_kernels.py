@@ -13,6 +13,7 @@ speedup.  CPU-safe fallback: refuses to run (the kernels need a TPU).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -150,6 +151,88 @@ def bench_flash_prefill(B=8, S=1024, H=12, KV=2, hd=128):
     }
 
 
+def bench_decode_window(B=128, H=8, KV=4, hd=256, ps=16, ctx=4096,
+                        window=1024):
+    """Sliding-window decode (Gemma-2 local layers): the kernel skips DMA
+    below the window, so its time should track O(window) while the jnp
+    twin still gathers O(ctx)."""
+    from vgate_tpu.ops.attention import paged_decode_attention
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas,
+    )
+
+    pages_per_seq = ctx // ps
+    P = 1 + B * pages_per_seq
+    key = jax.random.PRNGKey(2)
+    q = jax.random.normal(key, (B, H, hd), jnp.bfloat16)
+    k_pages = jax.random.normal(key, (KV, P, ps, hd), jnp.bfloat16)
+    v_pages = jax.random.normal(key, (KV, P, ps, hd), jnp.bfloat16)
+    page_tables = jnp.asarray(
+        np.arange(B * pages_per_seq, dtype=np.int32).reshape(B, -1) + 1
+    )
+    seq_lens = jnp.full((B,), ctx, jnp.int32)  # worst case: full context
+    w = jnp.asarray(window, jnp.int32)
+
+    twin = _looped(
+        functools.partial(paged_decode_attention, window=w)
+    )
+    kern = _looped(
+        functools.partial(paged_decode_attention_pallas, window=w)
+    )
+    t_twin = _median_time(twin, q, k_pages, v_pages, page_tables, seq_lens)
+    t_kern = _median_time(kern, q, k_pages, v_pages, page_tables, seq_lens)
+    return {
+        "kernel": "paged_decode_attention[window]",
+        "shape": f"B{B} H{H} KV{KV} hd{hd} ctx{ctx} win{window}",
+        "jnp_us": round(t_twin * 1e6, 1),
+        "pallas_us": round(t_kern * 1e6, 1),
+        "speedup": round(t_twin / t_kern, 2),
+    }
+
+
+def bench_multitok_verify(B=64, S=4, H=12, KV=2, hd=128, ps=16, ctx=512):
+    """Speculative-verify attention: S candidate rows vs the jnp suffix
+    gather path."""
+    from vgate_tpu.ops.attention import paged_suffix_attention
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_multitok_attention_pallas,
+    )
+
+    pages_per_seq = ctx // ps
+    P = 1 + B * pages_per_seq
+    key = jax.random.PRNGKey(3)
+    q = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    k_pages = jax.random.normal(key, (KV, P, ps, hd), jnp.bfloat16)
+    v_pages = jax.random.normal(key, (KV, P, ps, hd), jnp.bfloat16)
+    page_tables = jnp.asarray(
+        np.arange(B * pages_per_seq, dtype=np.int32).reshape(B, -1) + 1
+    )
+    positions0 = jnp.asarray(
+        (np.arange(B) % (pages_per_seq - 1) + 1) * ps, np.int32
+    )
+    input_lens = jnp.full((B,), S, jnp.int32)
+
+    twin = _looped(
+        lambda q_, kp, vp, pt, p0: paged_suffix_attention(
+            q_, kp, vp, pt, p0, p0 + S
+        )
+    )
+    kern = _looped(
+        lambda q_, kp, vp, pt, p0: paged_multitok_attention_pallas(
+            q_, kp, vp, pt, p0, input_lens
+        )
+    )
+    t_twin = _median_time(twin, q, k_pages, v_pages, page_tables, positions0)
+    t_kern = _median_time(kern, q, k_pages, v_pages, page_tables, positions0)
+    return {
+        "kernel": "spec_verify_attention",
+        "shape": f"B{B} S{S} H{H} KV{KV} hd{hd} ctx{ctx}",
+        "jnp_us": round(t_twin * 1e6, 1),
+        "pallas_us": round(t_kern * 1e6, 1),
+        "speedup": round(t_twin / t_kern, 2),
+    }
+
+
 def main() -> None:
     device = jax.devices()[0]
     if device.platform != "tpu":
@@ -161,6 +244,8 @@ def main() -> None:
     print(json.dumps(bench_paged_decode(ctx=2048)))
     print(json.dumps(bench_flash_prefill()))
     print(json.dumps(bench_flash_prefill(S=2048)))
+    print(json.dumps(bench_decode_window()))
+    print(json.dumps(bench_multitok_verify()))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,278 @@
+"""OpenAI-style logprobs through the sampler, engine, and cache key
+(beyond the reference's API surface — its schema has no logprobs field,
+vgate-client/vgate_client/models.py:32-37)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.cache import ResultCache
+from vgate_tpu.config import load_config
+from vgate_tpu.ops.sampling import sample_tokens, sample_tokens_with_logprobs
+from vgate_tpu.runtime.engine_core import EngineCore
+
+
+def test_sampler_logprobs_are_log_softmax_of_raw_logits():
+    rng = np.random.default_rng(5)
+    B, V = 4, 64
+    logits = jnp.asarray(rng.normal(size=(B, V)) * 3, jnp.float32)
+    temps = jnp.asarray([0.0, 0.0, 0.9, 0.9], jnp.float32)
+    ones = jnp.ones((B,), jnp.float32)
+    zeros = jnp.zeros((B,), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    toks, lp, tids, tlps = sample_tokens_with_logprobs(
+        logits, temps, ones, zeros, key, num_top=5
+    )
+    ref = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    toks_np = np.asarray(toks)
+    for b in range(B):
+        # chosen logprob is the raw log-softmax at the chosen token
+        np.testing.assert_allclose(
+            float(lp[b]), ref[b, toks_np[b]], rtol=1e-5, atol=1e-5
+        )
+        # top list is the top-5 of the raw distribution, sorted desc
+        expect_ids = np.argsort(-ref[b])[:5]
+        np.testing.assert_array_equal(np.asarray(tids[b]), expect_ids)
+        np.testing.assert_allclose(
+            np.asarray(tlps[b]), ref[b, expect_ids], rtol=1e-5, atol=1e-5
+        )
+    # greedy rows choose the argmax == first top entry
+    assert toks_np[0] == int(np.asarray(tids[0, 0]))
+    # and the sampled token matches plain sample_tokens exactly
+    plain = sample_tokens(logits, temps, ones, zeros, key)
+    np.testing.assert_array_equal(toks_np, np.asarray(plain))
+
+
+def test_cache_key_distinguishes_logprob_requests():
+    base = dict(temperature=0.0, top_p=1.0, max_tokens=8)
+    a = ResultCache.make_key("p", **base)
+    b = ResultCache.make_key("p", **base, logprobs=(True, 3))
+    c = ResultCache.make_key("p", **base, logprobs=(True, 0))
+    assert len({a, b, c}) == 3
+
+
+def engine_config(**tpu_overrides):
+    tpu = {
+        "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+        "kv_num_pages": 64, "kv_page_size": 4,
+        "max_batch_slots": 4, "prefill_buckets": [8, 16],
+        "use_pallas": False,
+    }
+    tpu.update(tpu_overrides)
+    return load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu=tpu,
+        scheduler={"max_queue_size": 16},
+        logging={"level": "WARNING"},
+    )
+
+
+@pytest.fixture(scope="module")
+def lp_engine():
+    core = EngineCore(engine_config(), devices=jax.devices()[:1])
+    core.start()
+    yield core
+    core.stop()
+
+
+def test_engine_returns_aligned_logprobs(lp_engine):
+    [r] = lp_engine.generate(
+        ["logprob probe"],
+        [SamplingParams(max_tokens=9, temperature=0.0, logprobs=True,
+                        top_logprobs=3)],
+    )
+    lps = r["logprobs"]
+    assert len(lps) == r["num_tokens"] == len(r["token_ids"])
+    for entry, tid in zip(lps, r["token_ids"]):
+        assert entry["token_id"] == tid
+        assert entry["logprob"] <= 0.0
+        assert len(entry["top_logprobs"]) == 3
+        # greedy: the chosen token IS the most likely alternative
+        assert entry["top_logprobs"][0]["token_id"] == tid
+        # alternatives are sorted descending
+        alt = [t["logprob"] for t in entry["top_logprobs"]]
+        assert alt == sorted(alt, reverse=True)
+        assert isinstance(entry["token"], str)
+
+
+def test_logprobs_do_not_change_tokens(lp_engine):
+    """The logprobs program variant must sample identically to the plain
+    one (same sampler core, same keys)."""
+    prompt = "variant parity probe"
+    [plain] = lp_engine.generate(
+        [prompt], [SamplingParams(max_tokens=8, temperature=0.0)]
+    )
+    [with_lp] = lp_engine.generate(
+        [prompt],
+        [SamplingParams(max_tokens=8, temperature=0.0, logprobs=True)],
+    )
+    assert plain["token_ids"] == with_lp["token_ids"]
+    assert "logprobs" not in plain
+    assert len(with_lp["logprobs"]) == 8
+    # logprobs=True without top_logprobs: empty alternatives list
+    assert with_lp["logprobs"][0]["top_logprobs"] == []
+
+
+def test_mixed_batch_only_requesters_get_logprobs(lp_engine):
+    results = lp_engine.generate(
+        ["mixed one", "mixed two"],
+        [
+            SamplingParams(max_tokens=6, temperature=0.0, logprobs=True,
+                           top_logprobs=2),
+            SamplingParams(max_tokens=6, temperature=0.0),
+        ],
+    )
+    assert len(results[0]["logprobs"]) == 6
+    assert "logprobs" not in results[1]
+
+
+def test_speculative_engine_logprobs_full_length():
+    core = EngineCore(
+        engine_config(speculative_k=3), devices=jax.devices()[:1]
+    )
+    core.start()
+    try:
+        [r] = core.generate(
+            ["spec logprob probe"],
+            [SamplingParams(max_tokens=10, temperature=0.0, logprobs=True,
+                            top_logprobs=2)],
+        )
+        assert len(r["logprobs"]) == r["num_tokens"] == 10
+        for entry, tid in zip(r["logprobs"], r["token_ids"]):
+            assert entry["token_id"] == tid
+            assert entry["top_logprobs"][0]["token_id"] == tid  # greedy
+    finally:
+        core.stop()
+
+
+# ------------------------------------------------------------- HTTP path
+
+async def test_http_logprobs_roundtrip():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    config = load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+            "num_devices": 1,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 4, "prefill_buckets": [8, 16],
+            "use_pallas": False, "platform": "cpu",
+        },
+        batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},
+        logging={"level": "WARNING"},
+    )
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "lp http"}],
+                "max_tokens": 5,
+                "temperature": 0,
+                "logprobs": True,
+                "top_logprobs": 2,
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        content = body["choices"][0]["logprobs"]["content"]
+        assert len(content) == body["usage"]["completion_tokens"]
+        assert content[0]["logprob"] <= 0
+        assert len(content[0]["top_logprobs"]) == 2
+
+        # top_logprobs out of range is a schema error
+        bad = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "x"}],
+                "top_logprobs": 50,
+            },
+        )
+        assert bad.status == 422
+
+        # without the flag: no logprobs block
+        plain = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "lp http"}],
+                "max_tokens": 5,
+                "temperature": 0,
+            },
+        )
+        assert (await plain.json())["choices"][0]["logprobs"] is None
+    finally:
+        await client.close()
+
+
+async def test_http_streaming_logprobs():
+    """SSE chunks carry logprobs entries; their concatenation covers every
+    generated token."""
+    import json as jsonlib
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    config = load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+            "num_devices": 1,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 4, "prefill_buckets": [8, 16],
+            "use_pallas": False, "platform": "cpu",
+        },
+        batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},
+        logging={"level": "WARNING"},
+    )
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "stream lp"}],
+                "max_tokens": 6,
+                "temperature": 0,
+                "stream": True,
+                "logprobs": True,
+                "top_logprobs": 2,
+            },
+        )
+        assert resp.status == 200
+        raw = (await resp.read()).decode()
+        entries = []
+        for line in raw.splitlines():
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            body = jsonlib.loads(line[6:])
+            lp = body["choices"][0].get("logprobs")
+            if lp:
+                entries.extend(lp["content"])
+        assert len(entries) == 6
+        assert all(e["logprob"] <= 0 for e in entries)
+        assert all(len(e["top_logprobs"]) == 2 for e in entries)
+    finally:
+        await client.close()

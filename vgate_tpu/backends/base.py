@@ -31,6 +31,11 @@ class SamplingParams:
     top_k: int = 0  # 0 disables top-k
     stop: Optional[List[str]] = None
     seed: Optional[int] = None
+    # OpenAI-style logprobs: return the chosen token's log-probability
+    # (raw-logit log-softmax) and, when top_logprobs > 0, the top
+    # alternatives per position (clamped to the engine's LOGPROBS_K).
+    logprobs: bool = False
+    top_logprobs: int = 0
 
 
 @dataclass
@@ -43,9 +48,11 @@ class GenerationResult:
     prompt_tokens: int = 0
     metrics: Dict[str, float] = field(default_factory=dict)
     finish_reason: str = "stop"
+    # per-token logprob entries (OpenAI shape) when requested, else None
+    logprobs: Optional[List[Dict[str, Any]]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "text": self.text,
             "token_ids": self.token_ids,
             "num_tokens": self.num_tokens,
@@ -53,6 +60,9 @@ class GenerationResult:
             "metrics": self.metrics,
             "finish_reason": self.finish_reason,
         }
+        if self.logprobs is not None:
+            out["logprobs"] = self.logprobs
+        return out
 
 
 @runtime_checkable

@@ -206,6 +206,11 @@ class JaxTPUBackend:
                                 (seq.finish_t or 0.0) - seq.arrival_t
                             ),
                         },
+                        logprobs=(
+                            self.core.logprob_entries(seq)
+                            if seq.params.logprobs
+                            else None
+                        ),
                     )
                 )
         return results
@@ -234,7 +239,13 @@ class JaxTPUBackend:
     ) -> AsyncIterator[str]:
         """Token-by-token text deltas for SSE streaming.  ``on_finish`` (if
         given) is called with the sequence's finish_reason after the last
-        delta, so the gateway can close the stream with the true reason."""
+        delta, so the gateway can close the stream with the true reason.
+
+        With ``params.logprobs`` each yield is a dict ``{"text": delta,
+        "logprobs": [entries for the tokens consumed since the previous
+        yield]}`` (deltas are text-level, and stop-string holdback means
+        a delta can span several tokens); plain requests yield bare
+        strings, the original contract."""
         assert self.core is not None
         loop = asyncio.get_running_loop()
         q: "asyncio.Queue[Optional[int]]" = asyncio.Queue()
@@ -252,6 +263,15 @@ class JaxTPUBackend:
 
         emitted = ""
         ids: List[int] = []
+        pending_lp: List[Any] = []
+
+        def wrap(delta: str):
+            if not params.logprobs:
+                return delta
+            out = {"text": delta, "logprobs": pending_lp[:]}
+            pending_lp.clear()
+            return out
+
         stops = params.stop or []
         longest_stop = max((len(s) for s in stops), default=0)
         while True:
@@ -260,10 +280,13 @@ class JaxTPUBackend:
                 # flush the held-back tail: the engine's own stop detection
                 # is authoritative (final_text truncates at a stop match)
                 final = self.core.final_text(seq)
-                if len(final) > len(emitted):
-                    yield final[len(emitted):]
+                if len(final) > len(emitted) or pending_lp:
+                    yield wrap(final[len(emitted):])
                 break
             ids.append(token)
+            if params.logprobs and len(seq.logprob_data) >= len(ids):
+                lp, top = seq.logprob_data[len(ids) - 1]
+                pending_lp.append(self.core.lp_entry(token, lp, top))
             text = self.core.tokenizer.decode(ids)
             if stops:
                 cut = min(
@@ -272,7 +295,7 @@ class JaxTPUBackend:
                 )
                 if cut >= 0:
                     if cut > len(emitted):
-                        yield text[len(emitted):cut]
+                        yield wrap(text[len(emitted):cut])
                     break
                 # hold back a stop-length tail so a stop string arriving
                 # across several tokens is never partially emitted
@@ -280,7 +303,7 @@ class JaxTPUBackend:
             if len(text) > len(emitted):
                 delta = text[len(emitted):]
                 emitted = text
-                yield delta
+                yield wrap(delta)
         if seq.status is SeqStatus.FAILED:
             raise seq.error  # type: ignore[misc]
         if on_finish is not None:

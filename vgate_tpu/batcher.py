@@ -126,6 +126,8 @@ class RequestBatcher:
         seed: Optional[int] = None,
         request_id: Optional[str] = None,
         timeout_s: Optional[float] = None,
+        logprobs: bool = False,
+        top_logprobs: int = 0,
     ) -> Dict[str, Any]:
         inf = self.config.inference
         params = SamplingParams(
@@ -137,6 +139,8 @@ class RequestBatcher:
             top_k=top_k if top_k is not None else inf.top_k,
             stop=stop,
             seed=seed,
+            logprobs=logprobs,
+            top_logprobs=top_logprobs,
         )
         with tracer.start_as_current_span("batcher.submit"):
             self._total_requests += 1
@@ -148,6 +152,9 @@ class RequestBatcher:
                 params.top_k,
                 stop=params.stop,
                 seed=params.seed,
+                # responses differ in content, so logprob requests must
+                # not collide with plain ones in the cache/dedup key
+                logprobs=(params.logprobs, params.top_logprobs),
             )
             cached = await self.cache.get(cache_key)
             if cached is not None:

@@ -39,13 +39,14 @@ class ResultCache:
         top_k: int = 0,
         stop: Optional[List[str]] = None,
         seed: Optional[int] = None,
+        logprobs=None,
     ) -> str:
         """Stable digest over the request-identity fields (reference:
-        vgate/cache.py:48-56; top_k/stop/seed added for the TPU sampler —
-        they change the result, so they must change the key)."""
+        vgate/cache.py:48-56; top_k/stop/seed/logprobs added for the TPU
+        sampler — they change the result, so they must change the key)."""
         blob = (
             f"{prompt}|{temperature}|{top_p}|{max_tokens}|{top_k}"
-            f"|{stop or []}|{seed}"
+            f"|{stop or []}|{seed}|{logprobs}"
         )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 

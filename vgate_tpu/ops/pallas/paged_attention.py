@@ -302,8 +302,8 @@ def _mt_kernel(
     n_chunks = jax.lax.div(n_pages + CHUNK_PAGES - 1, CHUNK_PAGES)
     chunk_tokens = CHUNK_PAGES * page_size
     window = window_ref[0]
-    # the LAST query row's window reaches lowest; chunks fully below the
-    # FIRST row's window are dead for every row
+    # the FIRST query row (position pos0) has the lowest window start, so
+    # chunks entirely below ITS window are dead for every row
     lo = jnp.where(window > 0, jnp.maximum(pos0 - window + 1, 0), 0)
     lo_chunk = jax.lax.div(lo, chunk_tokens)
 

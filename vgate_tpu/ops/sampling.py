@@ -22,26 +22,17 @@ TRUNC = 256  # logits kept per slot for sampling
 _GREEDY_EPS = 1e-4
 
 
-def sample_tokens(
+def _topk_and_pos(
     logits: jnp.ndarray,  # [B, V]
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32, 0 => disabled
     key: jax.Array,
-    seeds: jnp.ndarray | None = None,  # [B] int32, -1 => unseeded
-    steps: jnp.ndarray | None = None,  # [B] int32 per-seq sample index
-) -> jnp.ndarray:
-    """Sample one token per slot honoring per-slot params. Returns [B] int32.
-
-    When ``seeds``/``steps`` are given, a slot with ``seed >= 0`` draws its
-    gumbel noise from ``fold_in(PRNGKey(seed), step)`` — a function of the
-    request's seed and its per-sequence token index only, so the same seed
-    reproduces the same tokens regardless of batch composition, engine step
-    count, or preemption (the reference exposes vLLM's per-request ``seed``,
-    vgate/backends/vllm_backend.py:39-46).  Unseeded slots fold the slot
-    index into the engine's step key.  ``key`` must be a legacy uint32[2]
-    key (``jax.random.PRNGKey``) so keys can be selected with ``where``.
-    """
+    seeds: jnp.ndarray | None,
+    steps: jnp.ndarray | None,
+):
+    """Shared sampling core: returns (raw top-trunc logits [B, trunc]
+    sorted desc, their token ids, the chosen position within them)."""
     B, V = logits.shape
     trunc = min(TRUNC, V)
     logits32 = logits.astype(jnp.float32)
@@ -86,6 +77,69 @@ def sample_tokens(
 
     greedy = temperature <= _GREEDY_EPS
     pos = jnp.where(greedy, 0, sampled_pos)
+    return top_vals, top_idx, pos
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V]
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32, 0 => disabled
+    key: jax.Array,
+    seeds: jnp.ndarray | None = None,  # [B] int32, -1 => unseeded
+    steps: jnp.ndarray | None = None,  # [B] int32 per-seq sample index
+) -> jnp.ndarray:
+    """Sample one token per slot honoring per-slot params. Returns [B] int32.
+
+    When ``seeds``/``steps`` are given, a slot with ``seed >= 0`` draws its
+    gumbel noise from ``fold_in(PRNGKey(seed), step)`` — a function of the
+    request's seed and its per-sequence token index only, so the same seed
+    reproduces the same tokens regardless of batch composition, engine step
+    count, or preemption (the reference exposes vLLM's per-request ``seed``,
+    vgate/backends/vllm_backend.py:39-46).  Unseeded slots fold the slot
+    index into the engine's step key.  ``key`` must be a legacy uint32[2]
+    key (``jax.random.PRNGKey``) so keys can be selected with ``where``.
+    """
+    _top_vals, top_idx, pos = _topk_and_pos(
+        logits, temperature, top_p, top_k, key, seeds, steps
+    )
     return jnp.take_along_axis(top_idx, pos[:, None], axis=-1)[:, 0].astype(
         jnp.int32
+    )
+
+
+def sample_tokens_with_logprobs(
+    logits: jnp.ndarray,  # [B, V]
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    key: jax.Array,
+    seeds: jnp.ndarray | None = None,
+    steps: jnp.ndarray | None = None,
+    num_top: int = 8,
+):
+    """``sample_tokens`` plus OpenAI-style logprobs.
+
+    Returns ``(tokens [B], chosen_lp [B], top_ids [B, num_top],
+    top_lps [B, num_top])`` where logprobs are log-softmax of the RAW
+    logits (temperature/top-k/top-p modify only the sampling draw, not
+    the reported distribution — the standard API convention).  The
+    full-vocab logsumexp is the only extra work over plain sampling.
+    """
+    top_vals, top_idx, pos = _topk_and_pos(
+        logits, temperature, top_p, top_k, key, seeds, steps
+    )
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    lps = top_vals - lse  # [B, trunc] raw-logit log-softmax, sorted desc
+    tokens = jnp.take_along_axis(
+        top_idx, pos[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
+    chosen_lp = jnp.take_along_axis(lps, pos[:, None], axis=-1)[:, 0]
+    return (
+        tokens,
+        chosen_lp,
+        top_idx[:, :num_top].astype(jnp.int32),
+        lps[:, :num_top],
     )

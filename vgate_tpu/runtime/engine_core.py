@@ -50,7 +50,10 @@ from vgate_tpu.models.decoder import (
     spec_verify_forward,
 )
 from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
-from vgate_tpu.ops.sampling import sample_tokens
+from vgate_tpu.ops.sampling import (
+    sample_tokens,
+    sample_tokens_with_logprobs,
+)
 from vgate_tpu.parallel.mesh import build_mesh, initialize_distributed
 from vgate_tpu.parallel.sharding import kv_pspec, named, shard_params
 from vgate_tpu.runtime.kv_cache import (
@@ -67,6 +70,10 @@ from vgate_tpu.utils.math import cdiv
 
 logger = get_logger(__name__)
 
+# top-alternatives returned per position when a request asks for
+# logprobs (requests may ask for fewer; the schema clamps to this)
+LOGPROBS_K = 8
+
 _DTYPES = {
     "bfloat16": jnp.bfloat16,
     "float32": jnp.float32,
@@ -76,33 +83,39 @@ _DTYPES = {
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "mesh", "use_pallas"),
+    static_argnames=("spec", "mesh", "use_pallas", "num_logprobs"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _prefill_step(
     params, spec: ModelSpec, tokens, seq_lens, k_pages, v_pages,
     page_tables, temps, top_ps, top_ks, key, mesh=None, use_pallas=False,
-    seeds=None, steps=None,
+    seeds=None, steps=None, num_logprobs: int = 0,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
         mesh=mesh, use_pallas=use_pallas,
     )
+    if num_logprobs > 0:
+        next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
+            logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
+            num_top=num_logprobs,
+        )
+        return (next_tokens, (lp, tids, tlps)), k_pages, v_pages
     next_tokens = sample_tokens(
         logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
     )
-    return next_tokens, k_pages, v_pages
+    return (next_tokens, None), k_pages, v_pages
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec",),
+    static_argnames=("spec", "num_logprobs"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _suffix_prefill_step(
     params, spec: ModelSpec, tokens, prefix_lens, suffix_lens, k_pages,
     v_pages, suffix_page_tables, ctx_page_tables, temps, top_ps, top_ks,
-    key, seeds=None, steps=None,
+    key, seeds=None, steps=None, num_logprobs: int = 0,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
@@ -110,10 +123,16 @@ def _suffix_prefill_step(
         params, spec, tokens, prefix_lens, suffix_lens, k_pages, v_pages,
         suffix_page_tables, ctx_page_tables,
     )
+    if num_logprobs > 0:
+        next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
+            logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
+            num_top=num_logprobs,
+        )
+        return (next_tokens, (lp, tids, tlps)), k_pages, v_pages
     next_tokens = sample_tokens(
         logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
     )
-    return next_tokens, k_pages, v_pages
+    return (next_tokens, None), k_pages, v_pages
 
 
 def _decode_step(
@@ -123,12 +142,13 @@ def _decode_step(
 ):
     """One decode step — thin wrapper over ``_decode_chunk(num_steps=1)``
     kept for single-step callers (e.g. __graft_entry__.dryrun_multichip)."""
-    chunk_tokens, _tokens, positions, counter, _steps, k_pages, v_pages = (
-        _decode_chunk(
-            params, spec, tokens, positions, k_pages, v_pages, page_tables,
-            active, temps, top_ps, top_ks, base_key, counter,
-            num_steps=1, use_pallas=use_pallas, mesh=mesh,
-        )
+    (
+        chunk_tokens, _lp, _tokens, positions, counter, _steps,
+        k_pages, v_pages,
+    ) = _decode_chunk(
+        params, spec, tokens, positions, k_pages, v_pages, page_tables,
+        active, temps, top_ps, top_ks, base_key, counter,
+        num_steps=1, use_pallas=use_pallas, mesh=mesh,
     )
     return chunk_tokens[0], positions, counter, k_pages, v_pages
 
@@ -136,14 +156,14 @@ def _decode_step(
 @functools.partial(
     jax.jit,
     static_argnames=("spec", "num_steps", "use_pallas", "max_position",
-                     "mesh"),
+                     "mesh", "num_logprobs"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _decode_chunk(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
     page_tables, active, temps, top_ps, top_ks, base_key, counter,
     num_steps: int = 1, use_pallas=False, max_position: int = 0,
-    seeds=None, steps=None, mesh=None,
+    seeds=None, steps=None, mesh=None, num_logprobs: int = 0,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -167,9 +187,17 @@ def _decode_chunk(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active=active, use_pallas=use_pallas, mesh=mesh,
         )
-        next_tokens = sample_tokens(
-            logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
-        )
+        if num_logprobs > 0:
+            next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
+                logits, temps, top_ps, top_ks, key, seeds=seeds,
+                steps=steps, num_top=num_logprobs,
+            )
+            ys = (next_tokens, lp, tids, tlps)
+        else:
+            next_tokens = sample_tokens(
+                logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
+            )
+            ys = (next_tokens,)
         positions = positions + active.astype(positions.dtype)
         steps = steps + active.astype(steps.dtype)
         if max_position > 0:
@@ -180,27 +208,33 @@ def _decode_chunk(
             positions = jnp.minimum(positions, max_position)
         return (
             next_tokens, positions, counter + 1, steps, k_pages, v_pages
-        ), next_tokens
+        ), ys
 
-    carry, chunk_tokens = jax.lax.scan(
+    carry, ys = jax.lax.scan(
         body,
         (tokens, positions, counter, steps, k_pages, v_pages),
         None,
         length=num_steps,
     )
     tokens, positions, counter, steps, k_pages, v_pages = carry
-    return chunk_tokens, tokens, positions, counter, steps, k_pages, v_pages
+    chunk_tokens = ys[0]
+    # ([steps, B], [steps, B, K], [steps, B, K]) when logprobs, else None
+    chunk_lp = ys[1:] if num_logprobs > 0 else None
+    return (
+        chunk_tokens, chunk_lp, tokens, positions, counter, steps,
+        k_pages, v_pages,
+    )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "use_pallas"),
+    static_argnames=("spec", "use_pallas", "num_logprobs"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _spec_verify_step(
     params, spec: ModelSpec, tokens, positions0, input_lens, k_pages,
     v_pages, page_tables, active, temps, top_ps, top_ks, base_key, counter,
-    seeds=None, steps=None, use_pallas=False,
+    seeds=None, steps=None, use_pallas=False, num_logprobs: int = 0,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), sample the model's
@@ -226,14 +260,30 @@ def _spec_verify_step(
         if steps is None
         else (steps[:, None] + jnp.arange(S)[None, :]).reshape(-1)
     )
-    model_toks = sample_tokens(
-        logits.reshape(B * S, -1),
-        rep(temps), rep(top_ps), rep(top_ks), key,
-        seeds=None if seeds is None else rep(seeds),
-        steps=steps_flat,
-    ).reshape(B, S)
+    if num_logprobs > 0:
+        flat_toks, lp, tids, tlps = sample_tokens_with_logprobs(
+            logits.reshape(B * S, -1),
+            rep(temps), rep(top_ps), rep(top_ks), key,
+            seeds=None if seeds is None else rep(seeds),
+            steps=steps_flat,
+            num_top=num_logprobs,
+        )
+        model_toks = flat_toks.reshape(B, S)
+        lp_data = (
+            lp.reshape(B, S),
+            tids.reshape(B, S, -1),
+            tlps.reshape(B, S, -1),
+        )
+    else:
+        model_toks = sample_tokens(
+            logits.reshape(B * S, -1),
+            rep(temps), rep(top_ps), rep(top_ks), key,
+            seeds=None if seeds is None else rep(seeds),
+            steps=steps_flat,
+        ).reshape(B, S)
+        lp_data = None
     accepted = count_accepted(model_toks, tokens, input_lens)
-    return model_toks, accepted, k_pages, v_pages
+    return model_toks, accepted, lp_data, k_pages, v_pages
 
 
 class EngineCore:
@@ -488,20 +538,21 @@ class EngineCore:
             text = self.final_text(seq)
             gen_time = (seq.finish_t or 0) - seq.arrival_t
             n = seq.num_output_tokens
-            results.append(
-                {
-                    "text": text,
-                    "token_ids": list(seq.generated_ids),
-                    "num_tokens": n,
-                    "prompt_tokens": seq.orig_prompt_len,
-                    "finish_reason": seq.finish_reason,
-                    "metrics": {
-                        "ttft": seq.ttft or 0.0,
-                        "tpot": seq.tpot or 0.0,
-                        "gen_time": gen_time,
-                    },
-                }
-            )
+            result = {
+                "text": text,
+                "token_ids": list(seq.generated_ids),
+                "num_tokens": n,
+                "prompt_tokens": seq.orig_prompt_len,
+                "finish_reason": seq.finish_reason,
+                "metrics": {
+                    "ttft": seq.ttft or 0.0,
+                    "tpot": seq.tpot or 0.0,
+                    "gen_time": gen_time,
+                },
+            }
+            if seq.params.logprobs:
+                result["logprobs"] = self.logprob_entries(seq)
+            results.append(result)
         return results
 
     # ------------------------------------------------------------ the loop
@@ -693,18 +744,20 @@ class EngineCore:
         for plan in plans:
             for page, h in plan.register_hashes or ():
                 self.allocator.register(page, h)
-        firsts = jax.device_get([h for _, h in dispatched])
+        firsts = jax.device_get([h for _, h in dispatched])  # [(tok, lp)]
         # batched admission costs one combined dispatch+readback; attribute
         # an equal share to each prefill so observation count stays
         # one-per-prefill and the histogram sum stays the true wall time
         share = (time.perf_counter() - start) / len(plans)
         for _ in plans:
             metrics.ENGINE_STEP_TIME.labels(kind="prefill").observe(share)
-        for (group, _), tokens in zip(dispatched, firsts):
+        for (group, _), (tokens, lp) in zip(dispatched, firsts):
             arr = np.asarray(tokens)
             for row, plan in enumerate(group):
                 token = int(arr[row])
                 self.total_prefills += 1
+                if lp is not None and plan.seq.params.logprobs:
+                    self._attach_logprob(plan.seq, lp, 0, row)
                 plan.seq.append_token(token)
                 self._maybe_finish(plan.seq, token)
         return True
@@ -751,7 +804,7 @@ class EngineCore:
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
-        next_tokens, self.k_pages, self.v_pages = _prefill_step(
+        out, self.k_pages, self.v_pages = _prefill_step(
             self.params,
             self.spec,
             jnp.asarray(tokens),
@@ -767,8 +820,13 @@ class EngineCore:
             use_pallas=self.use_pallas,
             seeds=jnp.asarray(seeds),
             steps=jnp.asarray(steps),
+            num_logprobs=(
+                LOGPROBS_K
+                if any(p.seq.params.logprobs for p in plans)
+                else 0
+            ),
         )
-        return next_tokens
+        return out  # (first tokens [B], logprob triple or None)
 
     def _dispatch_suffix_group(self, plans: List[PrefillPlan], bucket: int):
         """Launch ONE suffix-prefill program for up to prefill_batch_max
@@ -822,7 +880,7 @@ class EngineCore:
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
-        next_tokens, self.k_pages, self.v_pages = _suffix_prefill_step(
+        out, self.k_pages, self.v_pages = _suffix_prefill_step(
             self.params,
             self.spec,
             jnp.asarray(tokens),
@@ -838,8 +896,13 @@ class EngineCore:
             self._step_key(),
             seeds=jnp.asarray(seeds),
             steps=jnp.asarray(steps),
+            num_logprobs=(
+                LOGPROBS_K
+                if any(p.seq.params.logprobs for p in plans)
+                else 0
+            ),
         )
-        return next_tokens
+        return out  # (first tokens [B], logprob triple or None)
 
     # ------------------------------------------------------------- decode
 
@@ -936,8 +999,14 @@ class EngineCore:
             metrics.RECOMPILES.labels(kind="decode").inc()
             self._compiled_chunks.add(chunk)
         start = time.perf_counter()
+        num_lp = (
+            LOGPROBS_K
+            if any(s.params.logprobs for s in active)
+            else 0
+        )
         (
             chunk_tokens,
+            chunk_lp,
             state["tokens"],
             state["positions"],
             state["counter"],
@@ -964,6 +1033,7 @@ class EngineCore:
             seeds=state["seeds"],
             steps=state["steps"],
             mesh=self._fwd_mesh if self._pp > 1 else None,
+            num_logprobs=num_lp,
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -971,7 +1041,7 @@ class EngineCore:
         # readback is processed) must NOT receive the stale tokens
         self._pending_chunks.append(
             ([(s, s.preempt_count) for s in active], chunk, chunk_tokens,
-             start)
+             start, chunk_lp)
         )
 
     def _process_chunks(self, drain: bool = False) -> None:
@@ -979,12 +1049,19 @@ class EngineCore:
         host state: append tokens in order, detect EOS/length stops, discard
         steps past a stop."""
         while self._pending_chunks:
-            seqs, chunk, tokens_dev, _start = self._pending_chunks.pop(0)
+            seqs, chunk, tokens_dev, _start, lp_dev = (
+                self._pending_chunks.pop(0)
+            )
             # observe only the host-blocking readback time (kind="decode"):
             # dispatch-to-now would double-count deliberate pipeline
             # queueing when more than one chunk is in flight
             block_start = time.perf_counter()
             sampled = np.asarray(tokens_dev)  # [chunk, B]; blocks
+            lp_np = (
+                None
+                if lp_dev is None
+                else tuple(np.asarray(a) for a in lp_dev)
+            )
             metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
                 time.perf_counter() - block_start
             )
@@ -997,6 +1074,8 @@ class EngineCore:
                 slot = seq.slot
                 for k in range(chunk):
                     token = int(sampled[k, slot])
+                    if lp_np is not None and seq.params.logprobs:
+                        self._attach_logprob(seq, lp_np, k, slot)
                     seq.append_token(token)
                     self.total_decode_tokens += 1
                     self._maybe_finish(seq, token)
@@ -1096,7 +1175,12 @@ class EngineCore:
             width = min(width, 1 << (max(1, w_needed) - 1).bit_length())
             width = max(width, w_needed)
         start = time.perf_counter()
-        model_toks, accepted, self.k_pages, self.v_pages = (
+        num_lp = (
+            LOGPROBS_K
+            if any(s.params.logprobs for s in active)
+            else 0
+        )
+        model_toks, accepted, lp_data, self.k_pages, self.v_pages = (
             _spec_verify_step(
                 self.params,
                 self.spec,
@@ -1115,11 +1199,21 @@ class EngineCore:
                 seeds=jnp.asarray(seeds),
                 steps=jnp.asarray(steps),
                 use_pallas=self.use_pallas,
+                num_logprobs=num_lp,
             )
         )
         self._step_counter += 1
         toks_np = np.asarray(model_toks)  # [B, S]; blocks
         acc_np = np.asarray(accepted)
+        lp_np = None
+        if lp_data is not None:
+            # transpose to step-major so _attach_logprob's [step][slot]
+            # indexing applies
+            lp_np = (
+                np.asarray(lp_data[0]).T,
+                np.transpose(np.asarray(lp_data[1]), (1, 0, 2)),
+                np.transpose(np.asarray(lp_data[2]), (1, 0, 2)),
+            )
         metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
             time.perf_counter() - start
         )
@@ -1133,6 +1227,8 @@ class EngineCore:
             # `accepted` holds the bonus token — one loop covers both
             for j in range(int(acc_np[slot]) + 1):
                 token = int(toks_np[slot, j])
+                if lp_np is not None and seq.params.logprobs:
+                    self._attach_logprob(seq, lp_np, j, slot)
                 seq.append_token(token)
                 self.total_decode_tokens += 1
                 self._maybe_finish(seq, token)
@@ -1140,6 +1236,48 @@ class EngineCore:
                     break
         self.total_steps += 1
         return True
+
+    def lp_entry(self, tid: int, lp: float, top) -> Dict[str, Any]:
+        """One OpenAI-shape logprob entry for a delivered token."""
+        return {
+            "token": self.tokenizer.decode([tid]),
+            "token_id": tid,
+            "logprob": lp,
+            "top_logprobs": [
+                {
+                    "token": self.tokenizer.decode([i]),
+                    "token_id": i,
+                    "logprob": l,
+                }
+                for i, l in top
+            ],
+        }
+
+    def logprob_entries(self, seq: Sequence) -> List[Dict[str, Any]]:
+        """OpenAI-shape logprob content for a finished sequence (one entry
+        per generated token, aligned with ``generated_ids``)."""
+        return [
+            self.lp_entry(tid, lp, top)
+            for tid, (lp, top) in zip(seq.generated_ids, seq.logprob_data)
+        ]
+
+    def _attach_logprob(self, seq: Sequence, lp_np, k, slot) -> None:
+        """Record one delivered token's logprob data from a readback
+        triple ``(lp [.., B], top_ids [.., B, K], top_lps [.., B, K])``
+        (leading step axis optional — prefill readbacks have none)."""
+        lp, tids, tlps = lp_np
+        if lp.ndim == 2:  # [chunk, B]
+            lp, tids, tlps = lp[k], tids[k], tlps[k]
+        n = min(seq.params.top_logprobs, tids.shape[-1])
+        seq.logprob_data.append(
+            (
+                float(lp[slot]),
+                [
+                    (int(tids[slot, j]), float(tlps[slot, j]))
+                    for j in range(n)
+                ],
+            )
+        )
 
     def _maybe_finish(self, seq: Sequence, token: int) -> None:
         reason = None

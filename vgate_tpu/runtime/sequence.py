@@ -47,6 +47,9 @@ class Sequence:
     # set when a stop string matched: the final text truncated at the match
     # (the raw generated_ids still contain the overshoot tokens)
     text_override: Optional[str] = None
+    # per-delivered-token logprob data, aligned with generated_ids (only
+    # filled when params.logprobs): (chosen_lp, [(token_id, lp), ...])
+    logprob_data: List[tuple] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:

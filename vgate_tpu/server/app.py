@@ -177,6 +177,8 @@ async def chat_completions(request: web.Request) -> web.Response:
             stop=payload.stop_list(),
             seed=payload.seed,
             timeout_s=engine.config.server.request_timeout_s,
+            logprobs=payload.logprobs or bool(payload.top_logprobs),
+            top_logprobs=payload.top_logprobs or 0,
         )
     except asyncio.TimeoutError:
         return _error(
@@ -198,6 +200,11 @@ async def chat_completions(request: web.Request) -> web.Response:
                 index=0,
                 message=ChatMessage(role="assistant", content=result["text"]),
                 finish_reason=result.get("finish_reason", "stop"),
+                logprobs=(
+                    {"content": result["logprobs"]}
+                    if result.get("logprobs") is not None
+                    else None
+                ),
             )
         ],
         usage=Usage(
@@ -230,13 +237,22 @@ async def _stream_chat(
     completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
     model_id = payload.model or engine.config.model.model_id
 
-    def _chunk(delta: Dict[str, Any], finish: Optional[str] = None) -> bytes:
+    def _chunk(
+        delta: Dict[str, Any],
+        finish: Optional[str] = None,
+        logprobs: Optional[list] = None,
+    ) -> bytes:
+        choice: Dict[str, Any] = {
+            "index": 0, "delta": delta, "finish_reason": finish,
+        }
+        if logprobs is not None:
+            choice["logprobs"] = {"content": logprobs}
         body = {
             "id": completion_id,
             "object": "chat.completion.chunk",
             "created": int(time.time()),
             "model": model_id,
-            "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+            "choices": [choice],
         }
         return f"data: {json.dumps(body)}\n\n".encode()
 
@@ -264,6 +280,8 @@ async def _stream_chat(
             ),
             stop=payload.stop_list(),
             seed=payload.seed,
+            logprobs=payload.logprobs or bool(payload.top_logprobs),
+            top_logprobs=payload.top_logprobs or 0,
         )
         try:
             import inspect
@@ -277,7 +295,15 @@ async def _stream_chat(
                 engine.config.server.request_timeout_s
             ):
                 async for piece in stream_fn(prompt, params, **kwargs):
-                    await resp.write(_chunk({"content": piece}))
+                    if isinstance(piece, dict):  # logprobs-carrying delta
+                        await resp.write(
+                            _chunk(
+                                {"content": piece["text"]},
+                                logprobs=piece["logprobs"] or None,
+                            )
+                        )
+                    else:
+                        await resp.write(_chunk({"content": piece}))
         except TimeoutError:
             await resp.write(
                 b'data: {"error": {"message": "request timed out", '
@@ -297,6 +323,8 @@ async def _stream_chat(
                 stop=payload.stop_list(),
                 seed=payload.seed,
                 timeout_s=engine.config.server.request_timeout_s,
+                logprobs=payload.logprobs or bool(payload.top_logprobs),
+                top_logprobs=payload.top_logprobs or 0,
             )
         except (asyncio.TimeoutError, EngineBusyError) as exc:
             # the 200 + role chunk are already on the wire: deliver the
@@ -318,6 +346,18 @@ async def _stream_chat(
         step = max(1, len(text) // 16)
         for i in range(0, len(text), step):
             await resp.write(_chunk({"content": text[i : i + step]}))
+        # replayed (non-streaming-backend) path: deliver the whole
+        # logprobs content with the closing chunk
+        if result.get("logprobs") is not None:
+            await resp.write(
+                _chunk(
+                    {}, finish=finish_reason["value"],
+                    logprobs=result["logprobs"],
+                )
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
     await resp.write(_chunk({}, finish=finish_reason["value"]))
     await resp.write(b"data: [DONE]\n\n")
     await resp.write_eof()

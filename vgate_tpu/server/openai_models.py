@@ -26,6 +26,10 @@ class ChatCompletionRequest(BaseModel):
     seed: Optional[int] = None
     stream: bool = False
     user: Optional[str] = None
+    # OpenAI logprobs: chosen-token logprob per position; top_logprobs
+    # (0..8) adds that many alternatives per position
+    logprobs: bool = False
+    top_logprobs: Optional[int] = Field(default=None, ge=0, le=8)
 
     def stop_list(self) -> Optional[List[str]]:
         """OpenAI accepts a bare string or a list; normalize to a list."""
@@ -45,6 +49,8 @@ class Choice(BaseModel):
     index: int = 0
     message: ChatMessage
     finish_reason: str = "stop"
+    # {"content": [{token, token_id, logprob, top_logprobs: [...]}, ...]}
+    logprobs: Optional[Dict[str, Any]] = None
 
 
 class ChatCompletion(BaseModel):

@@ -83,6 +83,8 @@ class _ChatResource:
         stop: Optional[Union[str, List[str]]] = None,
         seed: Optional[int] = None,
         stream: bool = False,
+        logprobs: bool = False,
+        top_logprobs: Optional[int] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -93,6 +95,8 @@ class _ChatResource:
             top_k=top_k,
             stop=stop,
             seed=seed,
+            logprobs=logprobs,
+            top_logprobs=top_logprobs,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -214,6 +218,8 @@ class _AsyncChatResource:
         stop: Optional[Union[str, List[str]]] = None,
         seed: Optional[int] = None,
         stream: bool = False,
+        logprobs: bool = False,
+        top_logprobs: Optional[int] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -224,6 +230,8 @@ class _AsyncChatResource:
             top_k=top_k,
             stop=stop,
             seed=seed,
+            logprobs=logprobs,
+            top_logprobs=top_logprobs,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:

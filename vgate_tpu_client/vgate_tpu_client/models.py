@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from pydantic import BaseModel, Field
 
@@ -23,6 +23,8 @@ class ChatCompletionRequest(BaseModel):
     stop: Optional[Union[str, List[str]]] = None
     seed: Optional[int] = None
     stream: bool = False
+    logprobs: bool = False
+    top_logprobs: Optional[int] = None
 
 
 class Usage(BaseModel):
@@ -35,6 +37,8 @@ class Choice(BaseModel):
     index: int = 0
     message: ChatMessage
     finish_reason: str = "stop"
+    # {"content": [{token, token_id, logprob, top_logprobs: [...]}, ...]}
+    logprobs: Optional[Dict[str, Any]] = None
 
 
 class ChatCompletion(BaseModel):
