@@ -155,29 +155,35 @@ def test_speculative_engine_logprobs_full_length():
 
 # ------------------------------------------------------------- HTTP path
 
-async def test_http_logprobs_roundtrip():
-    from aiohttp.test_utils import TestClient, TestServer
-
-    from vgate_tpu.server.app import create_app
-
-    config = load_config(
+def http_config():
+    """Gateway config for the in-process HTTP tests (the engine half
+    matches engine_config(); num_devices pinned for app-created cores)."""
+    tpu = {
+        "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+        "num_devices": 1,
+        "kv_num_pages": 64, "kv_page_size": 4,
+        "max_batch_slots": 4, "prefill_buckets": [8, 16],
+        "use_pallas": False, "platform": "cpu",
+    }
+    return load_config(
         model={
             "model_id": "tiny-dense",
             "engine_type": "jax_tpu",
             "dtype": "float32",
             "max_model_len": 64,
         },
-        tpu={
-            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
-            "num_devices": 1,
-            "kv_num_pages": 64, "kv_page_size": 4,
-            "max_batch_slots": 4, "prefill_buckets": [8, 16],
-            "use_pallas": False, "platform": "cpu",
-        },
+        tpu=tpu,
         batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},
         logging={"level": "WARNING"},
     )
-    client = TestClient(TestServer(create_app(config)))
+
+
+async def test_http_logprobs_roundtrip():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    client = TestClient(TestServer(create_app(http_config())))
     await client.start_server()
     try:
         resp = await client.post(
@@ -230,24 +236,7 @@ async def test_http_streaming_logprobs():
 
     from vgate_tpu.server.app import create_app
 
-    config = load_config(
-        model={
-            "model_id": "tiny-dense",
-            "engine_type": "jax_tpu",
-            "dtype": "float32",
-            "max_model_len": 64,
-        },
-        tpu={
-            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
-            "num_devices": 1,
-            "kv_num_pages": 64, "kv_page_size": 4,
-            "max_batch_slots": 4, "prefill_buckets": [8, 16],
-            "use_pallas": False, "platform": "cpu",
-        },
-        batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},
-        logging={"level": "WARNING"},
-    )
-    client = TestClient(TestServer(create_app(config)))
+    client = TestClient(TestServer(create_app(http_config())))
     await client.start_server()
     try:
         resp = await client.post(
@@ -274,5 +263,52 @@ async def test_http_streaming_logprobs():
         assert len(entries) == 6
         assert all(e["logprob"] <= 0 for e in entries)
         assert all(len(e["top_logprobs"]) == 2 for e in entries)
+    finally:
+        await client.close()
+
+
+async def test_http_n_choices():
+    """n>1 returns n independent choices (the variant salt defeats
+    dedup/caching); greedy choices coincide, seeded sampled ones use
+    seed+i and may diverge; n>1 + stream is rejected."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    client = TestClient(TestServer(create_app(http_config())))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "n choices"}],
+                "max_tokens": 5,
+                "temperature": 0,
+                "n": 3,
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        choices = body["choices"]
+        assert [c["index"] for c in choices] == [0, 1, 2]
+        # greedy: all three identical
+        assert len({c["message"]["content"] for c in choices}) == 1
+        assert body["usage"]["completion_tokens"] == 15  # summed
+
+        bad = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "x"}],
+                "n": 2,
+                "stream": True,
+            },
+        )
+        assert bad.status == 422
+
+        over = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}], "n": 20},
+        )
+        assert over.status == 422
     finally:
         await client.close()

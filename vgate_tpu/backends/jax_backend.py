@@ -294,7 +294,9 @@ class JaxTPUBackend:
                     default=-1,
                 )
                 if cut >= 0:
-                    if cut > len(emitted):
+                    if cut > len(emitted) or pending_lp:
+                        # flush even a zero-length delta: the entries for
+                        # the stop-completing tokens must not vanish
                         yield wrap(text[len(emitted):cut])
                     break
                 # hold back a stop-length tail so a stop string arriving

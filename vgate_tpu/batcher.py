@@ -128,6 +128,7 @@ class RequestBatcher:
         timeout_s: Optional[float] = None,
         logprobs: bool = False,
         top_logprobs: int = 0,
+        variant: int = 0,
     ) -> Dict[str, Any]:
         inf = self.config.inference
         params = SamplingParams(
@@ -155,6 +156,7 @@ class RequestBatcher:
                 # responses differ in content, so logprob requests must
                 # not collide with plain ones in the cache/dedup key
                 logprobs=(params.logprobs, params.top_logprobs),
+                variant=variant,
             )
             cached = await self.cache.get(cache_key)
             if cached is not None:

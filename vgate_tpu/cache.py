@@ -40,13 +40,16 @@ class ResultCache:
         stop: Optional[List[str]] = None,
         seed: Optional[int] = None,
         logprobs=None,
+        variant: int = 0,
     ) -> str:
         """Stable digest over the request-identity fields (reference:
         vgate/cache.py:48-56; top_k/stop/seed/logprobs added for the TPU
-        sampler — they change the result, so they must change the key)."""
+        sampler — they change the result, so they must change the key;
+        ``variant`` salts the i-th of an n-choices request so the n
+        submissions don't dedup into one generation)."""
         blob = (
             f"{prompt}|{temperature}|{top_p}|{max_tokens}|{top_k}"
-            f"|{stop or []}|{seed}|{logprobs}"
+            f"|{stop or []}|{seed}|{logprobs}|{variant}"
         )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 

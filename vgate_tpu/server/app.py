@@ -165,21 +165,59 @@ async def chat_completions(request: web.Request) -> web.Response:
     prompt = _build_prompt(engine, payload.messages)
 
     if payload.stream:
+        if payload.n > 1:
+            return _error(
+                422, "n > 1 is not supported with stream=true",
+                "invalid_request_error",
+            )
         return await _stream_chat(request, payload, prompt)
 
     try:
-        result = await batcher.submit(
-            prompt,
-            max_tokens=payload.max_tokens,
-            temperature=payload.temperature,
-            top_p=payload.top_p,
-            top_k=payload.top_k,
-            stop=payload.stop_list(),
-            seed=payload.seed,
-            timeout_s=engine.config.server.request_timeout_s,
-            logprobs=payload.logprobs or bool(payload.top_logprobs),
-            top_logprobs=payload.top_logprobs or 0,
+        # n choices run as n engine requests sampled concurrently (the
+        # variant salt keeps them from deduping; prefix caching shares
+        # their prompt KV); seeded requests use seed+i per choice.
+        # Greedy unseeded requests are deterministic, so ONE generation
+        # serves all n choices.
+        eff_temp = (
+            payload.temperature
+            if payload.temperature is not None
+            else engine.config.inference.temperature
         )
+        deterministic = eff_temp <= 0.0 and payload.seed is None
+        n_submits = 1 if deterministic else payload.n
+        settled = await asyncio.gather(
+            *(
+                batcher.submit(
+                    prompt,
+                    max_tokens=payload.max_tokens,
+                    temperature=payload.temperature,
+                    top_p=payload.top_p,
+                    top_k=payload.top_k,
+                    stop=payload.stop_list(),
+                    seed=(
+                        payload.seed + i
+                        if payload.seed is not None
+                        else None
+                    ),
+                    timeout_s=engine.config.server.request_timeout_s,
+                    logprobs=payload.logprobs
+                    or bool(payload.top_logprobs),
+                    top_logprobs=payload.top_logprobs or 0,
+                    variant=i,
+                )
+                for i in range(n_submits)
+            ),
+            # settle everything: plain gather would propagate the first
+            # failure while sibling generations keep running unobserved
+            # on an engine that may already be overloaded
+            return_exceptions=True,
+        )
+        for item in settled:
+            if isinstance(item, BaseException):
+                raise item
+        results = list(settled) * (payload.n if deterministic else 1)
+        results = results[: payload.n]
+        result = results[0]
     except asyncio.TimeoutError:
         return _error(
             504,
@@ -193,25 +231,27 @@ async def chat_completions(request: web.Request) -> web.Response:
         return resp
     except Exception as exc:
         return _error(500, f"Inference failed: {exc}", "server_error")
+    completion_tokens = sum(r.get("num_tokens", 0) for r in results)
     completion = ChatCompletion(
         model=payload.model or engine.config.model.model_id,
         choices=[
             Choice(
-                index=0,
-                message=ChatMessage(role="assistant", content=result["text"]),
-                finish_reason=result.get("finish_reason", "stop"),
+                index=i,
+                message=ChatMessage(role="assistant", content=r["text"]),
+                finish_reason=r.get("finish_reason", "stop"),
                 logprobs=(
-                    {"content": result["logprobs"]}
-                    if result.get("logprobs") is not None
+                    {"content": r["logprobs"]}
+                    if r.get("logprobs") is not None
                     else None
                 ),
             )
+            for i, r in enumerate(results)
         ],
         usage=Usage(
             prompt_tokens=result.get("prompt_tokens", 0),
-            completion_tokens=result.get("num_tokens", 0),
+            completion_tokens=completion_tokens,
             total_tokens=result.get("prompt_tokens", 0)
-            + result.get("num_tokens", 0),
+            + completion_tokens,
         ),
         cached=result.get("cached", False),
         metrics=result.get("metrics", {}),

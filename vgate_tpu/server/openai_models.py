@@ -30,6 +30,9 @@ class ChatCompletionRequest(BaseModel):
     # (0..8) adds that many alternatives per position
     logprobs: bool = False
     top_logprobs: Optional[int] = Field(default=None, ge=0, le=8)
+    # number of choices to generate (sampled independently; seeded
+    # requests use seed+i per choice).  n>1 is non-streaming only.
+    n: int = Field(default=1, ge=1, le=8)
 
     def stop_list(self) -> Optional[List[str]]:
         """OpenAI accepts a bare string or a list; normalize to a list."""

@@ -85,6 +85,7 @@ class _ChatResource:
         stream: bool = False,
         logprobs: bool = False,
         top_logprobs: Optional[int] = None,
+        n: int = 1,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -97,6 +98,7 @@ class _ChatResource:
             seed=seed,
             logprobs=logprobs,
             top_logprobs=top_logprobs,
+            n=n,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -220,6 +222,7 @@ class _AsyncChatResource:
         stream: bool = False,
         logprobs: bool = False,
         top_logprobs: Optional[int] = None,
+        n: int = 1,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -232,6 +235,7 @@ class _AsyncChatResource:
             seed=seed,
             logprobs=logprobs,
             top_logprobs=top_logprobs,
+            n=n,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:

@@ -25,6 +25,7 @@ class ChatCompletionRequest(BaseModel):
     stream: bool = False
     logprobs: bool = False
     top_logprobs: Optional[int] = None
+    n: int = 1
 
 
 class Usage(BaseModel):
