@@ -312,3 +312,68 @@ async def test_http_n_choices():
         assert over.status == 422
     finally:
         await client.close()
+
+
+async def test_http_text_completions():
+    """Legacy /v1/completions: string and list prompts, echo, n, and
+    token-level logprobs via the integer `logprobs` field."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    client = TestClient(TestServer(create_app(http_config())))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/completions",
+            json={"prompt": "complete me", "max_tokens": 5,
+                  "temperature": 0, "logprobs": 2},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "text_completion"
+        [choice] = body["choices"]
+        assert choice["finish_reason"] in ("stop", "length")
+        lp = choice["logprobs"]  # the LEGACY schema, not chat's content[]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 5
+        # legacy top_logprobs is {token_string: lp}; the byte-fallback
+        # tokenizer can decode distinct ids to the same string, so the
+        # dict may collapse below the requested 2 but never exceed it
+        assert 1 <= len(lp["top_logprobs"][0]) <= 2
+        assert lp["text_offset"][0] == 0
+        assert lp["text_offset"][1] == len(lp["tokens"][0])
+
+        # logprobs=0: per-token logprobs with zero alternatives (legacy
+        # semantics -- 0 is not "off")
+        resp0 = await client.post(
+            "/v1/completions",
+            json={"prompt": "complete me", "max_tokens": 3,
+                  "temperature": 0, "logprobs": 0},
+        )
+        lp0 = (await resp0.json())["choices"][0]["logprobs"]
+        assert len(lp0["token_logprobs"]) == 3
+        assert lp0["top_logprobs"] == [{}, {}, {}]
+
+        # stream is explicitly rejected on the legacy endpoint
+        bad_stream = await client.post(
+            "/v1/completions",
+            json={"prompt": "x", "stream": True},
+        )
+        assert bad_stream.status == 422
+
+        # list prompt + n>1 + echo
+        resp = await client.post(
+            "/v1/completions",
+            json={"prompt": ["alpha", "beta"], "max_tokens": 3,
+                  "temperature": 0, "n": 2, "echo": True},
+        )
+        body = await resp.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2, 3]
+        assert body["choices"][0]["text"].startswith("alpha")
+        assert body["choices"][2]["text"].startswith("beta")
+        assert body["usage"]["completion_tokens"] == 12
+
+        bad = await client.post("/v1/completions", json={"prompt": []})
+        assert bad.status == 422
+    finally:
+        await client.close()

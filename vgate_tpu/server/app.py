@@ -34,9 +34,12 @@ from vgate_tpu.server.openai_models import (
     BenchmarkRequest,
     ChatCompletion,
     ChatCompletionRequest,
+    Completion,
+    CompletionRequest,
     ChatMessage,
     Choice,
     EmbeddingData,
+    TextChoice,
     EmbeddingRequest,
     EmbeddingResponse,
     Usage,
@@ -152,6 +155,45 @@ def _build_prompt(engine: VGTEngine, messages) -> str:
     return messages_to_prompt(messages)
 
 
+
+def _n_plan(engine: VGTEngine, temperature, seed, n: int):
+    """(n_submits, deterministic): greedy unseeded requests are
+    deterministic, so one generation serves all n choices."""
+    eff = (
+        temperature
+        if temperature is not None
+        else engine.config.inference.temperature
+    )
+    deterministic = eff <= 0.0 and seed is None
+    return (1 if deterministic else n), deterministic
+
+
+async def _settle_submits(engine: VGTEngine, coros):
+    """Gather submissions (settling everything — a plain gather would
+    propagate the first failure while sibling generations keep running
+    unobserved) and map failures to the standard HTTP responses.
+    Returns (results, None) or (None, error_response)."""
+    try:
+        settled = await asyncio.gather(*coros, return_exceptions=True)
+        for item in settled:
+            if isinstance(item, BaseException):
+                raise item
+        return list(settled), None
+    except asyncio.TimeoutError:
+        return None, _error(
+            504,
+            "Request exceeded server.request_timeout_s "
+            f"({engine.config.server.request_timeout_s:.0f}s)",
+            "timeout_error",
+        )
+    except EngineBusyError as exc:
+        resp = _error(503, f"Engine overloaded: {exc}", "overloaded_error")
+        resp.headers["Retry-After"] = "1"
+        return None, resp
+    except Exception as exc:
+        return None, _error(500, f"Inference failed: {exc}", "server_error")
+
+
 async def chat_completions(request: web.Request) -> web.Response:
     """POST /v1/chat/completions (reference: main.py:207-252)."""
     try:
@@ -172,65 +214,37 @@ async def chat_completions(request: web.Request) -> web.Response:
             )
         return await _stream_chat(request, payload, prompt)
 
-    try:
-        # n choices run as n engine requests sampled concurrently (the
-        # variant salt keeps them from deduping; prefix caching shares
-        # their prompt KV); seeded requests use seed+i per choice.
-        # Greedy unseeded requests are deterministic, so ONE generation
-        # serves all n choices.
-        eff_temp = (
-            payload.temperature
-            if payload.temperature is not None
-            else engine.config.inference.temperature
-        )
-        deterministic = eff_temp <= 0.0 and payload.seed is None
-        n_submits = 1 if deterministic else payload.n
-        settled = await asyncio.gather(
-            *(
-                batcher.submit(
-                    prompt,
-                    max_tokens=payload.max_tokens,
-                    temperature=payload.temperature,
-                    top_p=payload.top_p,
-                    top_k=payload.top_k,
-                    stop=payload.stop_list(),
-                    seed=(
-                        payload.seed + i
-                        if payload.seed is not None
-                        else None
-                    ),
-                    timeout_s=engine.config.server.request_timeout_s,
-                    logprobs=payload.logprobs
-                    or bool(payload.top_logprobs),
-                    top_logprobs=payload.top_logprobs or 0,
-                    variant=i,
-                )
-                for i in range(n_submits)
-            ),
-            # settle everything: plain gather would propagate the first
-            # failure while sibling generations keep running unobserved
-            # on an engine that may already be overloaded
-            return_exceptions=True,
-        )
-        for item in settled:
-            if isinstance(item, BaseException):
-                raise item
-        results = list(settled) * (payload.n if deterministic else 1)
-        results = results[: payload.n]
-        result = results[0]
-    except asyncio.TimeoutError:
-        return _error(
-            504,
-            "Request exceeded server.request_timeout_s "
-            f"({engine.config.server.request_timeout_s:.0f}s)",
-            "timeout_error",
-        )
-    except EngineBusyError as exc:
-        resp = _error(503, f"Engine overloaded: {exc}", "overloaded_error")
-        resp.headers["Retry-After"] = "1"
-        return resp
-    except Exception as exc:
-        return _error(500, f"Inference failed: {exc}", "server_error")
+    # n choices run as n engine requests sampled concurrently (the
+    # variant salt keeps them from deduping; prefix caching shares
+    # their prompt KV); seeded requests use seed+i per choice.
+    n_submits, deterministic = _n_plan(
+        engine, payload.temperature, payload.seed, payload.n
+    )
+    settled, err = await _settle_submits(
+        engine,
+        (
+            batcher.submit(
+                prompt,
+                max_tokens=payload.max_tokens,
+                temperature=payload.temperature,
+                top_p=payload.top_p,
+                top_k=payload.top_k,
+                stop=payload.stop_list(),
+                seed=(
+                    payload.seed + i if payload.seed is not None else None
+                ),
+                timeout_s=engine.config.server.request_timeout_s,
+                logprobs=payload.logprobs or bool(payload.top_logprobs),
+                top_logprobs=payload.top_logprobs or 0,
+                variant=i,
+            )
+            for i in range(n_submits)
+        ),
+    )
+    if err is not None:
+        return err
+    results = (settled * (payload.n if deterministic else 1))[: payload.n]
+    result = results[0]
     completion_tokens = sum(r.get("num_tokens", 0) for r in results)
     completion = ChatCompletion(
         model=payload.model or engine.config.model.model_id,
@@ -402,6 +416,119 @@ async def _stream_chat(
     await resp.write(b"data: [DONE]\n\n")
     await resp.write_eof()
     return resp
+
+
+def _legacy_logprobs(entries, offset0: int = 0):
+    """Chat-shape logprob entries -> the legacy /v1/completions schema
+    ({tokens, token_logprobs, top_logprobs, text_offset}) that legacy
+    consumers (e.g. eval harnesses) read."""
+    if entries is None:
+        return None
+    tokens, token_lps, tops, offsets = [], [], [], []
+    pos = offset0
+    for e in entries:
+        tokens.append(e["token"])
+        token_lps.append(e["logprob"])
+        tops.append({t["token"]: t["logprob"] for t in e["top_logprobs"]})
+        offsets.append(pos)
+        pos += len(e["token"])
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_lps,
+        "top_logprobs": tops,
+        "text_offset": offsets,
+    }
+
+
+async def completions(request: web.Request) -> web.Response:
+    """POST /v1/completions — the legacy text-completion surface (no chat
+    template; the prompt goes to the engine verbatim).  Supports string or
+    list-of-strings prompts, n choices per prompt, stop/seed/logprobs with
+    the same semantics as chat."""
+    try:
+        payload = CompletionRequest(**await request.json())
+    except (ValidationError, ValueError) as exc:
+        return _error(422, f"Invalid request: {exc}", "invalid_request_error")
+    if payload.stream:
+        return _error(
+            422, "stream is not supported on /v1/completions "
+            "(use /v1/chat/completions for SSE)", "invalid_request_error",
+        )
+    prompts = payload.prompt_list()
+    if not prompts:
+        return _error(422, "prompt must be non-empty", "invalid_request_error")
+    batcher: RequestBatcher = request.app["batcher"]
+    engine: VGTEngine = request.app["engine"]
+    n_submits, deterministic = _n_plan(
+        engine, payload.temperature, payload.seed, payload.n
+    )
+    # legacy semantics: logprobs=0 still returns per-token logprobs, with
+    # zero alternatives
+    want_lp = payload.logprobs is not None
+
+    settled, err = await _settle_submits(
+        engine,
+        (
+            batcher.submit(
+                p,
+                max_tokens=payload.max_tokens,
+                temperature=payload.temperature,
+                top_p=payload.top_p,
+                top_k=payload.top_k,
+                stop=payload.stop_list(),
+                seed=(
+                    payload.seed + i if payload.seed is not None else None
+                ),
+                timeout_s=engine.config.server.request_timeout_s,
+                logprobs=want_lp,
+                top_logprobs=payload.logprobs or 0,
+                # globally unique salt: duplicate prompts in the list must
+                # not dedup into one sample
+                variant=pi * payload.n + i,
+            )
+            for pi, p in enumerate(prompts)
+            for i in range(n_submits)
+        ),
+    )
+    if err is not None:
+        return err
+
+    choices = []
+    prompt_tokens = 0
+    completion_tokens = 0
+    idx = 0
+    for pi, p in enumerate(prompts):
+        per_prompt = settled[pi * n_submits : (pi + 1) * n_submits]
+        per_prompt = (list(per_prompt) * payload.n)[: payload.n]
+        prompt_tokens += per_prompt[0].get("prompt_tokens", 0)
+        for r in per_prompt:
+            text = r["text"]
+            offset0 = 0
+            if payload.echo:
+                text = p + text
+                offset0 = len(p)
+            choices.append(
+                TextChoice(
+                    index=idx,
+                    text=text,
+                    finish_reason=r.get("finish_reason", "stop"),
+                    logprobs=_legacy_logprobs(
+                        r.get("logprobs"), offset0
+                    ),
+                )
+            )
+            completion_tokens += r.get("num_tokens", 0)
+            idx += 1
+    completion = Completion(
+        model=payload.model or engine.config.model.model_id,
+        choices=choices,
+        usage=Usage(
+            prompt_tokens=prompt_tokens,
+            completion_tokens=completion_tokens,
+            total_tokens=prompt_tokens + completion_tokens,
+        ),
+    )
+    return web.json_response(completion.model_dump())
 
 
 async def embeddings(request: web.Request) -> web.Response:
@@ -627,6 +754,7 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     app["config"] = config
     app.router.add_get("/health", health)
     app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/metrics", prometheus_metrics)

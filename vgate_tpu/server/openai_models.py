@@ -67,6 +67,52 @@ class ChatCompletion(BaseModel):
     metrics: Dict[str, float] = Field(default_factory=dict)
 
 
+class CompletionRequest(BaseModel):
+    """Legacy /v1/completions (text in, text out — no chat template);
+    the prompt may be a string or a list of strings."""
+
+    model: Optional[str] = None
+    prompt: Union[str, List[str]]
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    stop: Optional[Union[str, List[str]]] = None
+    seed: Optional[int] = None
+    logprobs: Optional[int] = Field(default=None, ge=0, le=8)
+    n: int = Field(default=1, ge=1, le=8)
+    echo: bool = False
+    stream: bool = False  # declared so stream=true can be rejected, not
+    # silently ignored (SSE is the chat endpoint's surface)
+
+    def stop_list(self) -> Optional[List[str]]:
+        if self.stop is None:
+            return None
+        stops = [self.stop] if isinstance(self.stop, str) else self.stop
+        return [s for s in stops if s] or None
+
+    def prompt_list(self) -> List[str]:
+        return [self.prompt] if isinstance(self.prompt, str) else list(
+            self.prompt
+        )
+
+
+class TextChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: str = "stop"
+    logprobs: Optional[Dict[str, Any]] = None
+
+
+class Completion(BaseModel):
+    id: str = Field(default_factory=lambda: f"cmpl-{uuid.uuid4().hex[:24]}")
+    object: str = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[TextChoice] = Field(default_factory=list)
+    usage: Usage = Field(default_factory=Usage)
+
+
 class EmbeddingRequest(BaseModel):
     model: Optional[str] = None
     input: Union[str, List[str]]

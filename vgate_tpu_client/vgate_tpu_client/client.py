@@ -107,6 +107,18 @@ class _ChatResource:
         return ChatCompletion.model_validate(data)
 
 
+class _CompletionsResource:
+    """Legacy text completions (POST /v1/completions)."""
+
+    def __init__(self, client: "VGT") -> None:
+        self._client = client
+
+    def create(self, prompt, model: Optional[str] = None, **kwargs):
+        payload = {"prompt": prompt, "model": model, **kwargs}
+        payload = {k: v for k, v in payload.items() if v is not None}
+        return self._client._request("POST", "/v1/completions", payload)
+
+
 class _EmbeddingsResource:
     def __init__(self, client: "VGT") -> None:
         self._client = client
@@ -135,6 +147,7 @@ class VGT:
         self.last_rate_limit: Optional[RateLimitInfo] = None
         self._http = httpx.Client(base_url=self.base_url, timeout=timeout)
         self.chat = _ChatResource(self)
+        self.completions = _CompletionsResource(self)
         self.embeddings = _EmbeddingsResource(self)
 
     def _headers(self) -> Dict[str, str]:
@@ -246,6 +259,18 @@ class _AsyncChatResource:
         return ChatCompletion.model_validate(data)
 
 
+class _AsyncCompletionsResource:
+    def __init__(self, client: "AsyncVGT") -> None:
+        self._client = client
+
+    async def create(self, prompt, model: Optional[str] = None, **kwargs):
+        payload = {"prompt": prompt, "model": model, **kwargs}
+        payload = {k: v for k, v in payload.items() if v is not None}
+        return await self._client._request(
+            "POST", "/v1/completions", payload
+        )
+
+
 class _AsyncEmbeddingsResource:
     def __init__(self, client: "AsyncVGT") -> None:
         self._client = client
@@ -276,6 +301,7 @@ class AsyncVGT:
         self.last_rate_limit: Optional[RateLimitInfo] = None
         self._http = httpx.AsyncClient(base_url=self.base_url, timeout=timeout)
         self.chat = _AsyncChatResource(self)
+        self.completions = _AsyncCompletionsResource(self)
         self.embeddings = _AsyncEmbeddingsResource(self)
 
     def _headers(self) -> Dict[str, str]:
