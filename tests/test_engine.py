@@ -350,8 +350,11 @@ def test_decode_chunk_ladder_compiles_powers_of_two():
     core.start()
     try:
         core.generate(["ladder probe"], [greedy(16)])
-        assert core._compiled_chunks <= {1, 2, 4, 8}
-        assert max(core._compiled_chunks) == 8
+        # keys are (chunk_len, penalties_active)
+        lens = {k[0] for k in core._compiled_chunks}
+        assert lens <= {1, 2, 4, 8}
+        assert max(lens) == 8
+        assert all(pen is False for _, pen in core._compiled_chunks)
     finally:
         core.stop()
 

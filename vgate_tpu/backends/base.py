@@ -36,6 +36,14 @@ class SamplingParams:
     # alternatives per position (clamped to the engine's LOGPROBS_K).
     logprobs: bool = False
     top_logprobs: int = 0
+    # OpenAI penalties over generated tokens (-2..2): frequency scales
+    # with the count, presence is a flat once-seen offset.
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+
+    @property
+    def has_penalties(self) -> bool:
+        return bool(self.frequency_penalty or self.presence_penalty)
 
 
 @dataclass

@@ -129,6 +129,8 @@ class RequestBatcher:
         logprobs: bool = False,
         top_logprobs: int = 0,
         variant: int = 0,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
     ) -> Dict[str, Any]:
         inf = self.config.inference
         params = SamplingParams(
@@ -142,6 +144,8 @@ class RequestBatcher:
             seed=seed,
             logprobs=logprobs,
             top_logprobs=top_logprobs,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty,
         )
         with tracer.start_as_current_span("batcher.submit"):
             self._total_requests += 1
@@ -157,6 +161,9 @@ class RequestBatcher:
                 # not collide with plain ones in the cache/dedup key
                 logprobs=(params.logprobs, params.top_logprobs),
                 variant=variant,
+                penalties=(
+                    params.frequency_penalty, params.presence_penalty
+                ),
             )
             cached = await self.cache.get(cache_key)
             if cached is not None:

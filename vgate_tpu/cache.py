@@ -41,6 +41,7 @@ class ResultCache:
         seed: Optional[int] = None,
         logprobs=None,
         variant: int = 0,
+        penalties=None,
     ) -> str:
         """Stable digest over the request-identity fields (reference:
         vgate/cache.py:48-56; top_k/stop/seed/logprobs added for the TPU
@@ -49,7 +50,7 @@ class ResultCache:
         submissions don't dedup into one generation)."""
         blob = (
             f"{prompt}|{temperature}|{top_p}|{max_tokens}|{top_k}"
-            f"|{stop or []}|{seed}|{logprobs}|{variant}"
+            f"|{stop or []}|{seed}|{logprobs}|{variant}|{penalties}"
         )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 

@@ -143,3 +143,25 @@ def sample_tokens_with_logprobs(
         top_idx[:, :num_top].astype(jnp.int32),
         lps[:, :num_top],
     )
+
+
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V]
+    counts: jnp.ndarray,  # [B, V] per-slot output-token counts (uint16/int32)
+    frequency_penalty: jnp.ndarray,  # [B]
+    presence_penalty: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """OpenAI frequency/presence penalties over the full vocabulary.
+
+    ``logits[b, v] -= freq[b] * counts[b, v] + pres[b] * (counts[b, v] > 0)``
+    — counts cover the tokens the request has GENERATED so far (not the
+    prompt), matching the OpenAI definition.  Applied before temperature/
+    top-k/top-p; when a request also asks for logprobs they are computed
+    from these penalized logits (the distribution actually sampled).
+    """
+    c = counts.astype(jnp.float32)
+    return (
+        logits.astype(jnp.float32)
+        - frequency_penalty[:, None] * c
+        - presence_penalty[:, None] * (c > 0).astype(jnp.float32)
+    )

@@ -51,6 +51,7 @@ from vgate_tpu.models.decoder import (
 )
 from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
 from vgate_tpu.ops.sampling import (
+    apply_penalties,
     sample_tokens,
     sample_tokens_with_logprobs,
 )
@@ -90,11 +91,16 @@ def _prefill_step(
     params, spec: ModelSpec, tokens, seq_lens, k_pages, v_pages,
     page_tables, temps, top_ps, top_ks, key, mesh=None, use_pallas=False,
     seeds=None, steps=None, num_logprobs: int = 0,
+    counts=None, freq_pens=None, pres_pens=None,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
         mesh=mesh, use_pallas=use_pallas,
     )
+    if counts is not None:
+        # post-preemption re-prefill: folded outputs still count toward
+        # the penalties of the re-sampled first token
+        logits = apply_penalties(logits, counts, freq_pens, pres_pens)
     if num_logprobs > 0:
         next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
             logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
@@ -116,6 +122,7 @@ def _suffix_prefill_step(
     params, spec: ModelSpec, tokens, prefix_lens, suffix_lens, k_pages,
     v_pages, suffix_page_tables, ctx_page_tables, temps, top_ps, top_ks,
     key, seeds=None, steps=None, num_logprobs: int = 0,
+    counts=None, freq_pens=None, pres_pens=None,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
@@ -123,6 +130,8 @@ def _suffix_prefill_step(
         params, spec, tokens, prefix_lens, suffix_lens, k_pages, v_pages,
         suffix_page_tables, ctx_page_tables,
     )
+    if counts is not None:
+        logits = apply_penalties(logits, counts, freq_pens, pres_pens)
     if num_logprobs > 0:
         next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
             logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
@@ -143,7 +152,7 @@ def _decode_step(
     """One decode step — thin wrapper over ``_decode_chunk(num_steps=1)``
     kept for single-step callers (e.g. __graft_entry__.dryrun_multichip)."""
     (
-        chunk_tokens, _lp, _tokens, positions, counter, _steps,
+        chunk_tokens, _lp, _tokens, positions, counter, _steps, _counts,
         k_pages, v_pages,
     ) = _decode_chunk(
         params, spec, tokens, positions, k_pages, v_pages, page_tables,
@@ -157,13 +166,14 @@ def _decode_step(
     jax.jit,
     static_argnames=("spec", "num_steps", "use_pallas", "max_position",
                      "mesh", "num_logprobs"),
-    donate_argnames=("k_pages", "v_pages"),
+    donate_argnames=("k_pages", "v_pages", "counts"),
 )
 def _decode_chunk(
     params, spec: ModelSpec, tokens, positions, k_pages, v_pages,
     page_tables, active, temps, top_ps, top_ks, base_key, counter,
     num_steps: int = 1, use_pallas=False, max_position: int = 0,
     seeds=None, steps=None, mesh=None, num_logprobs: int = 0,
+    counts=None, freq_pens=None, pres_pens=None,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -181,12 +191,16 @@ def _decode_chunk(
         steps = jnp.zeros_like(positions)
 
     def body(carry, _):
-        tokens, positions, counter, steps, k_pages, v_pages = carry
+        tokens, positions, counter, steps, counts, k_pages, v_pages = carry
         key = jax.random.fold_in(base_key, counter)
         logits, k_pages, v_pages = decode_forward(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active=active, use_pallas=use_pallas, mesh=mesh,
         )
+        if counts is not None:
+            # frequency/presence penalties over the generated-token
+            # histogram (ops/sampling.py apply_penalties)
+            logits = apply_penalties(logits, counts, freq_pens, pres_pens)
         if num_logprobs > 0:
             next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
                 logits, temps, top_ps, top_ks, key, seeds=seeds,
@@ -200,6 +214,10 @@ def _decode_chunk(
             ys = (next_tokens,)
         positions = positions + active.astype(positions.dtype)
         steps = steps + active.astype(steps.dtype)
+        if counts is not None:
+            counts = counts.at[
+                jnp.arange(counts.shape[0]), next_tokens
+            ].add(active.astype(counts.dtype))
         if max_position > 0:
             # overshoot steps (chunk sized by MAX headroom across slots) must
             # stay in-bounds: on the Pallas path seq_len = position+1 drives
@@ -207,21 +225,22 @@ def _decode_chunk(
             # rather than clamped like XLA gathers
             positions = jnp.minimum(positions, max_position)
         return (
-            next_tokens, positions, counter + 1, steps, k_pages, v_pages
+            next_tokens, positions, counter + 1, steps, counts,
+            k_pages, v_pages,
         ), ys
 
     carry, ys = jax.lax.scan(
         body,
-        (tokens, positions, counter, steps, k_pages, v_pages),
+        (tokens, positions, counter, steps, counts, k_pages, v_pages),
         None,
         length=num_steps,
     )
-    tokens, positions, counter, steps, k_pages, v_pages = carry
+    tokens, positions, counter, steps, counts, k_pages, v_pages = carry
     chunk_tokens = ys[0]
     # ([steps, B], [steps, B, K], [steps, B, K]) when logprobs, else None
     chunk_lp = ys[1:] if num_logprobs > 0 else None
     return (
-        chunk_tokens, chunk_lp, tokens, positions, counter, steps,
+        chunk_tokens, chunk_lp, tokens, positions, counter, steps, counts,
         k_pages, v_pages,
     )
 
@@ -235,6 +254,7 @@ def _spec_verify_step(
     params, spec: ModelSpec, tokens, positions0, input_lens, k_pages,
     v_pages, page_tables, active, temps, top_ps, top_ks, base_key, counter,
     seeds=None, steps=None, use_pallas=False, num_logprobs: int = 0,
+    counts=None, freq_pens=None, pres_pens=None,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), sample the model's
@@ -250,6 +270,23 @@ def _spec_verify_step(
         page_tables, active=active, use_pallas=use_pallas,
     )  # [B, S, V]
     B, S = tokens.shape
+    if counts is not None:
+        # position j's penalties include the drafts accepted before it
+        # (run 1..j); if draft j+1 is later rejected, position j+1's
+        # output is discarded anyway, so exactness holds for every token
+        # actually appended
+        run = counts
+        pen = []
+        for j in range(S):
+            pen.append(
+                apply_penalties(logits[:, j], run, freq_pens, pres_pens)
+            )
+            if j + 1 < S:
+                inc = ((j + 1) < input_lens) & active
+                run = run.at[jnp.arange(B), tokens[:, j + 1]].add(
+                    inc.astype(run.dtype)
+                )
+        logits = jnp.stack(pen, axis=1)
     key = jax.random.fold_in(base_key, counter)
     # one batched sampler over all (slot, position) rows — per-position
     # step indices keep seeded reproducibility aligned with the token
@@ -283,7 +320,16 @@ def _spec_verify_step(
         ).reshape(B, S)
         lp_data = None
     accepted = count_accepted(model_toks, tokens, input_lens)
-    return model_toks, accepted, lp_data, k_pages, v_pages
+    if counts is not None:
+        # fold the tokens this round actually appends (accepted run +
+        # bonus) into the histogram on device
+        app = (
+            (jnp.arange(S)[None, :] <= accepted[:, None])
+            & active[:, None]
+        )
+        b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, S))
+        counts = counts.at[b_idx, model_toks].add(app.astype(counts.dtype))
+    return model_toks, accepted, lp_data, counts, k_pages, v_pages
 
 
 class EngineCore:
@@ -412,6 +458,10 @@ class EngineCore:
         )
         self.total_spec_drafted = 0
         self.total_spec_accepted = 0
+        # device-resident penalty histogram for speculative mode, keyed
+        # by a membership signature (rebuilt from host token lists when
+        # membership changes; updated in-program otherwise)
+        self._spec_pen: Optional[Dict[str, Any]] = None
 
         # sp>1: prefill attention runs sequence-parallel (ring attention
         # over the sp axis); buckets must then split evenly across shards.
@@ -762,6 +812,41 @@ class EngineCore:
                 self._maybe_finish(plan.seq, token)
         return True
 
+    def _penalty_arrays(self, B: int, rows):
+        """Build (counts [B, V] uint16, freq [B], pres [B]) device arrays
+        from ``rows`` = iterable of (row_index, Sequence) — the one
+        histogram constructor shared by prefill groups, the decode state
+        and the speculative round (callers decide gating/row mapping)."""
+        counts = np.zeros((B, self.spec.vocab_size), np.uint16)
+        freq = np.zeros((B,), np.float32)
+        pres = np.zeros((B,), np.float32)
+        for row, seq in rows:
+            freq[row] = seq.params.frequency_penalty
+            pres[row] = seq.params.presence_penalty
+            if seq.generated_ids:
+                # histogram over everything generated (generated_ids
+                # survives preemption folds, matching OpenAI's "tokens
+                # generated so far")
+                np.add.at(
+                    counts[row], np.asarray(seq.generated_ids, np.int64), 1
+                )
+        return jnp.asarray(counts), jnp.asarray(freq), jnp.asarray(pres)
+
+    def _group_penalties(self, plans: List[PrefillPlan], B: int):
+        """Penalty arrays for a prefill group, or (None, None, None).
+        Counts only matter when a penalized plan already generated tokens
+        (post-preemption re-prefill) — an all-zero histogram is a
+        mathematical no-op, so fresh prompts skip the upload and the
+        counts program variant entirely."""
+        if not any(
+            p.seq.params.has_penalties and p.seq.generated_ids
+            for p in plans
+        ):
+            return None, None, None
+        return self._penalty_arrays(
+            B, ((row, p.seq) for row, p in enumerate(plans))
+        )
+
     def _dispatch_prefill_group(self, plans: List[PrefillPlan], bucket: int):
         """Launch ONE prefill program for up to prefill_batch_max same-
         bucket sequences; returns the (async) [B] first-token device array.
@@ -800,7 +885,8 @@ class EngineCore:
                 # token index num_generated (0 fresh, >0 after preemption)
                 seeds[row] = sp.seed
             steps[row] = seq.num_generated
-        key = (bucket, B)
+        pen_counts, pen_freq, pen_pres = self._group_penalties(plans, B)
+        key = (bucket, B, pen_counts is not None)
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
@@ -825,6 +911,9 @@ class EngineCore:
                 if any(p.seq.params.logprobs for p in plans)
                 else 0
             ),
+            counts=pen_counts,
+            freq_pens=pen_freq,
+            pres_pens=pen_pres,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -876,7 +965,8 @@ class EngineCore:
             if sp.seed is not None:
                 seeds[row] = sp.seed
             steps[row] = seq.num_generated
-        key = ("suffix", bucket, B, ctx_pages)
+        pen_counts, pen_freq, pen_pres = self._group_penalties(plans, B)
+        key = ("suffix", bucket, B, ctx_pages, pen_counts is not None)
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
@@ -901,6 +991,9 @@ class EngineCore:
                 if any(p.seq.params.logprobs for p in plans)
                 else 0
             ),
+            counts=pen_counts,
+            freq_pens=pen_freq,
+            pres_pens=pen_pres,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -932,6 +1025,7 @@ class EngineCore:
         top_ks = np.zeros((B,), np.int32)
         seeds = np.full((B,), -1, np.int32)
         steps = np.zeros((B,), np.int32)
+        want_pen = any(s.params.has_penalties for s in seqs)
         for seq in seqs:
             slot = seq.slot
             assert slot is not None
@@ -947,6 +1041,12 @@ class EngineCore:
             if seq.params.seed is not None:
                 seeds[slot] = seq.params.seed
             steps[slot] = seq.num_generated
+        if want_pen:
+            counts_j, freq_j, pres_j = self._penalty_arrays(
+                B, ((s.slot, s) for s in seqs)
+            )
+        else:
+            counts_j, freq_j, pres_j = None, jnp.zeros((B,)), jnp.zeros((B,))
         self._dec_state = {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
@@ -958,6 +1058,9 @@ class EngineCore:
             "seeds": jnp.asarray(seeds),
             "steps": jnp.asarray(steps),
             "counter": jnp.asarray(self._step_counter, jnp.uint32),
+            "counts": counts_j,
+            "freq_pens": freq_j,
+            "pres_pens": pres_j,
         }
 
     def _refresh_page_tables(self, seqs: List[Sequence]) -> None:
@@ -995,9 +1098,10 @@ class EngineCore:
 
     def _dispatch_chunk(self, active: List[Sequence], chunk: int) -> None:
         state = self._dec_state
-        if chunk not in self._compiled_chunks:
+        chunk_key = (chunk, state["counts"] is not None)
+        if chunk_key not in self._compiled_chunks:
             metrics.RECOMPILES.labels(kind="decode").inc()
-            self._compiled_chunks.add(chunk)
+            self._compiled_chunks.add(chunk_key)
         start = time.perf_counter()
         num_lp = (
             LOGPROBS_K
@@ -1011,6 +1115,7 @@ class EngineCore:
             state["positions"],
             state["counter"],
             state["steps"],
+            state["counts"],
             self.k_pages,
             self.v_pages,
         ) = _decode_chunk(
@@ -1034,6 +1139,9 @@ class EngineCore:
             steps=state["steps"],
             mesh=self._fwd_mesh if self._pp > 1 else None,
             num_logprobs=num_lp,
+            counts=state["counts"],
+            freq_pens=state["freq_pens"],
+            pres_pens=state["pres_pens"],
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -1174,13 +1282,33 @@ class EngineCore:
         if w_needed < width:
             width = min(width, 1 << (max(1, w_needed) - 1).bit_length())
             width = max(width, w_needed)
+        want_pen = any(s.params.has_penalties for s in active)
+        if want_pen:
+            sig = tuple(
+                (s.seq_id, s.slot, s.preempt_count) for s in active
+            )
+            if self._spec_pen is None or self._spec_pen["sig"] != sig:
+                counts_j, freq_j, pres_j = self._penalty_arrays(
+                    B, ((s.slot, s) for s in active)
+                )
+                self._spec_pen = {
+                    "sig": sig,
+                    "counts": counts_j,
+                    "freq": freq_j,
+                    "pres": pres_j,
+                }
+        else:
+            self._spec_pen = None
         start = time.perf_counter()
         num_lp = (
             LOGPROBS_K
             if any(s.params.logprobs for s in active)
             else 0
         )
-        model_toks, accepted, lp_data, self.k_pages, self.v_pages = (
+        (
+            model_toks, accepted, lp_data, counts_out,
+            self.k_pages, self.v_pages,
+        ) = (
             _spec_verify_step(
                 self.params,
                 self.spec,
@@ -1200,8 +1328,19 @@ class EngineCore:
                 steps=jnp.asarray(steps),
                 use_pallas=self.use_pallas,
                 num_logprobs=num_lp,
+                counts=(
+                    self._spec_pen["counts"] if want_pen else None
+                ),
+                freq_pens=(
+                    self._spec_pen["freq"] if want_pen else None
+                ),
+                pres_pens=(
+                    self._spec_pen["pres"] if want_pen else None
+                ),
             )
         )
+        if want_pen:
+            self._spec_pen["counts"] = counts_out
         self._step_counter += 1
         toks_np = np.asarray(model_toks)  # [B, S]; blocks
         acc_np = np.asarray(accepted)

@@ -237,6 +237,8 @@ async def chat_completions(request: web.Request) -> web.Response:
                 logprobs=payload.logprobs or bool(payload.top_logprobs),
                 top_logprobs=payload.top_logprobs or 0,
                 variant=i,
+                frequency_penalty=payload.frequency_penalty or 0.0,
+                presence_penalty=payload.presence_penalty or 0.0,
             )
             for i in range(n_submits)
         ),
@@ -336,6 +338,8 @@ async def _stream_chat(
             seed=payload.seed,
             logprobs=payload.logprobs or bool(payload.top_logprobs),
             top_logprobs=payload.top_logprobs or 0,
+            frequency_penalty=payload.frequency_penalty or 0.0,
+            presence_penalty=payload.presence_penalty or 0.0,
         )
         try:
             import inspect
@@ -379,6 +383,8 @@ async def _stream_chat(
                 timeout_s=engine.config.server.request_timeout_s,
                 logprobs=payload.logprobs or bool(payload.top_logprobs),
                 top_logprobs=payload.top_logprobs or 0,
+                frequency_penalty=payload.frequency_penalty or 0.0,
+                presence_penalty=payload.presence_penalty or 0.0,
             )
         except (asyncio.TimeoutError, EngineBusyError) as exc:
             # the 200 + role chunk are already on the wire: deliver the
@@ -485,6 +491,8 @@ async def completions(request: web.Request) -> web.Response:
                 # globally unique salt: duplicate prompts in the list must
                 # not dedup into one sample
                 variant=pi * payload.n + i,
+                frequency_penalty=payload.frequency_penalty or 0.0,
+                presence_penalty=payload.presence_penalty or 0.0,
             )
             for pi, p in enumerate(prompts)
             for i in range(n_submits)

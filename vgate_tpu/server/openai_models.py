@@ -33,6 +33,12 @@ class ChatCompletionRequest(BaseModel):
     # number of choices to generate (sampled independently; seeded
     # requests use seed+i per choice).  n>1 is non-streaming only.
     n: int = Field(default=1, ge=1, le=8)
+    frequency_penalty: Optional[float] = Field(
+        default=None, ge=-2.0, le=2.0
+    )
+    presence_penalty: Optional[float] = Field(
+        default=None, ge=-2.0, le=2.0
+    )
 
     def stop_list(self) -> Optional[List[str]]:
         """OpenAI accepts a bare string or a list; normalize to a list."""
@@ -84,6 +90,12 @@ class CompletionRequest(BaseModel):
     echo: bool = False
     stream: bool = False  # declared so stream=true can be rejected, not
     # silently ignored (SSE is the chat endpoint's surface)
+    frequency_penalty: Optional[float] = Field(
+        default=None, ge=-2.0, le=2.0
+    )
+    presence_penalty: Optional[float] = Field(
+        default=None, ge=-2.0, le=2.0
+    )
 
     def stop_list(self) -> Optional[List[str]]:
         if self.stop is None:

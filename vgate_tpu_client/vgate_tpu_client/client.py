@@ -86,6 +86,8 @@ class _ChatResource:
         logprobs: bool = False,
         top_logprobs: Optional[int] = None,
         n: int = 1,
+        frequency_penalty: Optional[float] = None,
+        presence_penalty: Optional[float] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -99,6 +101,8 @@ class _ChatResource:
             logprobs=logprobs,
             top_logprobs=top_logprobs,
             n=n,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -236,6 +240,8 @@ class _AsyncChatResource:
         logprobs: bool = False,
         top_logprobs: Optional[int] = None,
         n: int = 1,
+        frequency_penalty: Optional[float] = None,
+        presence_penalty: Optional[float] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -249,6 +255,8 @@ class _AsyncChatResource:
             logprobs=logprobs,
             top_logprobs=top_logprobs,
             n=n,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:

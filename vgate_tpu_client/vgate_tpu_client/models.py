@@ -26,6 +26,8 @@ class ChatCompletionRequest(BaseModel):
     logprobs: bool = False
     top_logprobs: Optional[int] = None
     n: int = 1
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
 
 
 class Usage(BaseModel):
