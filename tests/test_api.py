@@ -267,3 +267,24 @@ async def test_request_timeout_returns_504():
         assert body["error"]["type"] == "timeout_error"
     finally:
         await client.close()
+
+
+async def test_max_completion_tokens_alias():
+    """The current OpenAI name wins over the legacy max_tokens."""
+    client = await _client()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "alias"}],
+                "max_tokens": 99,
+                "max_completion_tokens": 4,
+            },
+        )
+        assert resp.status == 200
+        # dry-run backend always emits 8 fake tokens; what we assert is
+        # that the alias parses and the request round-trips
+        body = await resp.json()
+        assert body["choices"][0]["message"]["content"]
+    finally:
+        await client.close()

@@ -614,3 +614,20 @@ def test_gemma2_rejects_sp_and_pp():
         )
         with pytest.raises(ValueError, match="sliding-window"):
             EngineCore(config, devices=jax.devices()[:n])
+
+
+def test_stop_token_ids_finish(engine):
+    """A token in stop_token_ids ends the sequence with finish_reason
+    "stop" (the id-level sibling of stop strings)."""
+    # discover what the model greedily emits, then stop on its 3rd token
+    [base] = engine.generate(["stop id probe"], [greedy(8)])
+    assert len(base["token_ids"]) >= 4
+    target = base["token_ids"][2]
+    [stopped] = engine.generate(
+        ["stop id probe"],
+        [SamplingParams(max_tokens=8, temperature=0.0,
+                        stop_token_ids=[target])],
+    )
+    assert stopped["finish_reason"] == "stop"
+    assert stopped["token_ids"][: 3] == base["token_ids"][: 3]
+    assert len(stopped["token_ids"]) == 3

@@ -377,3 +377,37 @@ async def test_http_text_completions():
         assert bad.status == 422
     finally:
         await client.close()
+
+
+async def test_max_completion_tokens_precedence():
+    """max_completion_tokens wins over max_tokens on an engine that
+    actually honors the budget."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    client = TestClient(TestServer(create_app(http_config())))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "alias wins"}],
+                "max_tokens": 40,
+                "max_completion_tokens": 3,
+                "temperature": 0,
+            },
+        )
+        body = await resp.json()
+        assert body["usage"]["completion_tokens"] == 3
+
+        zero = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "x"}],
+                "max_completion_tokens": 0,
+            },
+        )
+        assert zero.status == 422  # ge=1: rejected, not silently coerced
+    finally:
+        await client.close()

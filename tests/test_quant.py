@@ -221,3 +221,42 @@ def test_bad_quantization_value_rejected():
 
     with pytest.raises(Exception):
         load_config(model={"quantization": "fp8"})
+
+
+def test_quantized_gemma2_engine_smoke():
+    """int8 weight-only quantization composes with the Gemma-2 family
+    (sandwich norms pass through untouched; GeGLU/q-scale/softcap run on
+    dequantized projections)."""
+    import jax
+
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.config import load_config
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    config = load_config(
+        model={
+            "model_id": "tiny-gemma2",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+            "quantization": "int8",
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 2, "prefill_buckets": [8],
+            "use_pallas": False,
+        },
+        scheduler={"max_queue_size": 8},
+        logging={"level": "WARNING"},
+    )
+    core = EngineCore(config, devices=jax.devices()[:1])
+    core.start()
+    try:
+        [r] = core.generate(
+            ["quantized gemma probe"],
+            [SamplingParams(max_tokens=12, temperature=0.0)],
+        )
+        assert r["num_tokens"] == 12 or r["finish_reason"] == "stop"
+    finally:
+        core.stop()

@@ -30,6 +30,9 @@ class SamplingParams:
     top_p: float = 0.95
     top_k: int = 0  # 0 disables top-k
     stop: Optional[List[str]] = None
+    # extra token ids that end generation with finish_reason "stop"
+    # (beyond the model's eos) — the id-level sibling of `stop` strings
+    stop_token_ids: Optional[List[int]] = None
     seed: Optional[int] = None
     # OpenAI-style logprobs: return the chosen token's log-probability
     # (raw-logit log-softmax) and, when top_logprobs > 0, the top

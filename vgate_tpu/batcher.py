@@ -123,6 +123,7 @@ class RequestBatcher:
         top_p: Optional[float] = None,
         top_k: Optional[int] = None,
         stop: Optional[List[str]] = None,
+        stop_token_ids: Optional[List[int]] = None,
         seed: Optional[int] = None,
         request_id: Optional[str] = None,
         timeout_s: Optional[float] = None,
@@ -141,6 +142,7 @@ class RequestBatcher:
             top_p=top_p if top_p is not None else inf.top_p,
             top_k=top_k if top_k is not None else inf.top_k,
             stop=stop,
+            stop_token_ids=stop_token_ids,
             seed=seed,
             logprobs=logprobs,
             top_logprobs=top_logprobs,
@@ -156,6 +158,7 @@ class RequestBatcher:
                 params.max_tokens,
                 params.top_k,
                 stop=params.stop,
+                stop_token_ids=params.stop_token_ids,
                 seed=params.seed,
                 # responses differ in content, so logprob requests must
                 # not collide with plain ones in the cache/dedup key

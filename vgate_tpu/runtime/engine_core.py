@@ -1422,6 +1422,11 @@ class EngineCore:
         reason = None
         if token == self.tokenizer.eos_id:
             reason = "stop"
+        elif (
+            seq.params.stop_token_ids
+            and token in seq.params.stop_token_ids
+        ):
+            reason = "stop"
         elif self._hit_stop_string(seq):
             reason = "stop"  # text_override truncated at the match
         elif seq.num_generated >= max(1, seq.params.max_tokens):

@@ -225,11 +225,12 @@ async def chat_completions(request: web.Request) -> web.Response:
         (
             batcher.submit(
                 prompt,
-                max_tokens=payload.max_tokens,
+                max_tokens=payload.effective_max_tokens(),
                 temperature=payload.temperature,
                 top_p=payload.top_p,
                 top_k=payload.top_k,
                 stop=payload.stop_list(),
+                stop_token_ids=payload.stop_token_ids,
                 seed=(
                     payload.seed + i if payload.seed is not None else None
                 ),
@@ -317,7 +318,7 @@ async def _stream_chat(
     stream_fn = getattr(engine.backend, "stream_async", None)
     if stream_fn is not None:
         params = engine.backend.create_sampling_params(
-            max_tokens=payload.max_tokens
+            max_tokens=payload.effective_max_tokens()
             or engine.config.inference.max_tokens,
             temperature=(
                 payload.temperature
@@ -335,6 +336,7 @@ async def _stream_chat(
                 else engine.config.inference.top_k
             ),
             stop=payload.stop_list(),
+            stop_token_ids=payload.stop_token_ids,
             seed=payload.seed,
             logprobs=payload.logprobs or bool(payload.top_logprobs),
             top_logprobs=payload.top_logprobs or 0,
@@ -374,11 +376,12 @@ async def _stream_chat(
         try:
             result = await batcher.submit(
                 prompt,
-                max_tokens=payload.max_tokens,
+                max_tokens=payload.effective_max_tokens(),
                 temperature=payload.temperature,
                 top_p=payload.top_p,
                 top_k=payload.top_k,
                 stop=payload.stop_list(),
+                stop_token_ids=payload.stop_token_ids,
                 seed=payload.seed,
                 timeout_s=engine.config.server.request_timeout_s,
                 logprobs=payload.logprobs or bool(payload.top_logprobs),
@@ -482,6 +485,7 @@ async def completions(request: web.Request) -> web.Response:
                 top_p=payload.top_p,
                 top_k=payload.top_k,
                 stop=payload.stop_list(),
+                stop_token_ids=payload.stop_token_ids,
                 seed=(
                     payload.seed + i if payload.seed is not None else None
                 ),

@@ -18,11 +18,14 @@ class ChatMessage(BaseModel):
 class ChatCompletionRequest(BaseModel):
     model: Optional[str] = None
     messages: List[ChatMessage]
-    max_tokens: Optional[int] = None
+    max_tokens: Optional[int] = Field(default=None, ge=1)
+    # the current OpenAI name for the same knob; wins when both are set
+    max_completion_tokens: Optional[int] = Field(default=None, ge=1)
     temperature: Optional[float] = None
     top_p: Optional[float] = None
     top_k: Optional[int] = None
     stop: Optional[Union[str, List[str]]] = None
+    stop_token_ids: Optional[List[int]] = None
     seed: Optional[int] = None
     stream: bool = False
     user: Optional[str] = None
@@ -46,6 +49,11 @@ class ChatCompletionRequest(BaseModel):
             return None
         stops = [self.stop] if isinstance(self.stop, str) else self.stop
         return [s for s in stops if s] or None
+
+    def effective_max_tokens(self) -> Optional[int]:
+        if self.max_completion_tokens is not None:
+            return self.max_completion_tokens
+        return self.max_tokens
 
 
 class Usage(BaseModel):
@@ -79,11 +87,12 @@ class CompletionRequest(BaseModel):
 
     model: Optional[str] = None
     prompt: Union[str, List[str]]
-    max_tokens: Optional[int] = None
+    max_tokens: Optional[int] = Field(default=None, ge=1)
     temperature: Optional[float] = None
     top_p: Optional[float] = None
     top_k: Optional[int] = None
     stop: Optional[Union[str, List[str]]] = None
+    stop_token_ids: Optional[List[int]] = None
     seed: Optional[int] = None
     logprobs: Optional[int] = Field(default=None, ge=0, le=8)
     n: int = Field(default=1, ge=1, le=8)
