@@ -52,6 +52,18 @@ TINY_MISTRAL = dataclasses.replace(
     TINY_DENSE, name="tiny-mistral", qkv_bias=False, rms_eps=1e-5,
     rope_theta=1_000_000.0,
 )
+# Llama-3.1 family: Llama-3 plus the long-context rope frequency scaling
+TINY_LLAMA31 = dataclasses.replace(
+    TINY_DENSE, name="tiny-llama31", qkv_bias=False, rms_eps=1e-5,
+    rope_theta=500_000.0, max_position_embeddings=256,
+    rope_scaling_factor=8.0, rope_low_freq_factor=1.0,
+    rope_high_freq_factor=4.0,
+    # orig_max=64 places the 32.4-wavelength frequency pair inside the
+    # interpolation band (high=16, low=64), so the smoothed branch of
+    # the llama3 rule is exercised against HF, not just the two
+    # keep//factor extremes
+    rope_original_max_pos=64,
+)
 
 
 def _build_hf_llama():
@@ -68,6 +80,33 @@ def _build_hf_llama():
         attention_bias=False,
     )
     torch.manual_seed(2)
+    return transformers.LlamaForCausalLM(config).eval()
+
+
+def _build_hf_llama31():
+    config = transformers.LlamaConfig(
+        vocab_size=TINY_LLAMA31.vocab_size,
+        hidden_size=TINY_LLAMA31.hidden_size,
+        num_hidden_layers=TINY_LLAMA31.num_layers,
+        num_attention_heads=TINY_LLAMA31.num_heads,
+        num_key_value_heads=TINY_LLAMA31.num_kv_heads,
+        intermediate_size=TINY_LLAMA31.intermediate_size,
+        rope_theta=TINY_LLAMA31.rope_theta,
+        rms_norm_eps=TINY_LLAMA31.rms_eps,
+        max_position_embeddings=TINY_LLAMA31.max_position_embeddings,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": TINY_LLAMA31.rope_scaling_factor,
+            "low_freq_factor": TINY_LLAMA31.rope_low_freq_factor,
+            "high_freq_factor": TINY_LLAMA31.rope_high_freq_factor,
+            "original_max_position_embeddings": (
+                TINY_LLAMA31.rope_original_max_pos
+            ),
+        },
+    )
+    torch.manual_seed(5)
     return transformers.LlamaForCausalLM(config).eval()
 
 
@@ -171,8 +210,12 @@ def _hf_last_logits(model, token_rows):
         (TINY_LLAMA, _build_hf_llama, 2),
         (TINY_MISTRAL, _build_hf_mistral, 3),
         (TINY_GEMMA2, _build_hf_gemma2, 4),
+        (TINY_LLAMA31, _build_hf_llama31, 5),
     ],
-    ids=["qwen2-dense", "mixtral-moe", "llama3", "mistral", "gemma2"],
+    ids=[
+        "qwen2-dense", "mixtral-moe", "llama3", "mistral", "gemma2",
+        "llama31-rope-scaled",
+    ],
 )
 def test_prefill_logits_match_hf(spec, builder, seed):
     qkv_bias = spec.qkv_bias

@@ -353,8 +353,8 @@ def _prefill_qkv_write(
         h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
     )
     q, k, v = _project_qkv(normed, lp, spec)
-    q = apply_rope(q, positions, spec.rope_theta)
-    k = apply_rope(k, positions, spec.rope_theta)
+    q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
+    k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
     k_resh = jnp.transpose(
         k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
         (3, 0, 1, 2, 4),
@@ -422,8 +422,14 @@ def decode_layer(
         h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
     )
     q, k, v = _project_qkv(normed, lp, spec)  # q [B,H,hd], k/v [B,KV,hd]
-    q = apply_rope(q[:, None], positions[:, None], spec.rope_theta)[:, 0]
-    k = apply_rope(k[:, None], positions[:, None], spec.rope_theta)[:, 0]
+    q = apply_rope(
+        q[:, None], positions[:, None], spec.rope_theta,
+        spec.rope_scaling,
+    )[:, 0]
+    k = apply_rope(
+        k[:, None], positions[:, None], spec.rope_theta,
+        spec.rope_scaling,
+    )[:, 0]
     k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
         jnp.transpose(k, (1, 0, 2))
     )
@@ -628,8 +634,8 @@ def spec_verify_forward(
             h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
         )
         q, k, v = _project_qkv(normed, lp, spec)
-        q = apply_rope(q, positions, spec.rope_theta)
-        k = apply_rope(k, positions, spec.rope_theta)
+        q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
+        k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
         k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
             jnp.transpose(k, (2, 0, 1, 3))
         )

@@ -29,6 +29,9 @@ class ModelSpec:
     tie_embeddings: bool = False
     eos_token_id: int = 151645
     bos_token_id: int = 151643
+    # additional model-level stop ids (generation_config eos lists — e.g.
+    # Llama-3.1's <|end_of_text|>/<|eom_id|>, Qwen's <|endoftext|>)
+    extra_stop_ids: tuple = ()
     # MoE (0 experts => dense)
     num_experts: int = 0
     experts_per_token: int = 0
@@ -44,6 +47,12 @@ class ModelSpec:
     embed_scale: bool = False  # multiply embeddings by sqrt(hidden_size)
     unit_offset_norm: bool = False  # RMSNorm weight convention (1 + w)
     ffn_sandwich: bool = False  # post-attn norm after o_proj + pre/post-FFN norms
+    # Llama-3.1 rope scaling (0 = off): low-frequency components slowed by
+    # `rope_scaling_factor`, interpolated between the low/high bands.
+    rope_scaling_factor: float = 0.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_pos: int = 8192
 
     @property
     def is_moe(self) -> bool:
@@ -59,6 +68,18 @@ class ModelSpec:
         return tuple(
             self.sliding_window if i % 2 == 0 else 0
             for i in range(self.num_layers)
+        )
+
+    @property
+    def rope_scaling(self):
+        """Tuple for ops/rope.py (None when scaling is off)."""
+        if self.rope_scaling_factor <= 0:
+            return None
+        return (
+            self.rope_scaling_factor,
+            self.rope_low_freq_factor,
+            self.rope_high_freq_factor,
+            self.rope_original_max_pos,
         )
 
     @property
@@ -94,6 +115,7 @@ def _register(spec: ModelSpec) -> ModelSpec:
 QWEN25_05B = _register(
     ModelSpec(
         name="Qwen/Qwen2.5-0.5B-Instruct",
+        extra_stop_ids=(151643,),  # <|endoftext|>
         vocab_size=151936,
         hidden_size=896,
         num_layers=24,
@@ -108,6 +130,7 @@ QWEN25_05B = _register(
 QWEN25_15B = _register(
     ModelSpec(
         name="Qwen/Qwen2.5-1.5B-Instruct",
+        extra_stop_ids=(151643,),  # <|endoftext|>
         vocab_size=151936,
         hidden_size=1536,
         num_layers=28,
@@ -122,6 +145,7 @@ QWEN25_15B = _register(
 QWEN25_7B = _register(
     ModelSpec(
         name="Qwen/Qwen2.5-7B-Instruct",
+        extra_stop_ids=(151643,),  # <|endoftext|>
         vocab_size=152064,
         hidden_size=3584,
         num_layers=28,
@@ -169,7 +193,58 @@ LLAMA3_8B = _register(
         tie_embeddings=False,
         eos_token_id=128009,
         bos_token_id=128000,
+        extra_stop_ids=(128001,),  # <|end_of_text|>
         max_position_embeddings=8192,
+    )
+)
+
+LLAMA31_8B = _register(
+    ModelSpec(
+        name="meta-llama/Llama-3.1-8B-Instruct",
+        vocab_size=128256,
+        hidden_size=4096,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=14336,
+        rope_theta=500_000.0,
+        rms_eps=1e-5,
+        qkv_bias=False,
+        tie_embeddings=False,
+        eos_token_id=128009,
+        bos_token_id=128000,
+        extra_stop_ids=(128001, 128008),  # <|end_of_text|>, <|eom_id|>
+        max_position_embeddings=131072,
+        rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_pos=8192,
+    )
+)
+
+LLAMA32_1B = _register(
+    ModelSpec(
+        name="meta-llama/Llama-3.2-1B-Instruct",
+        vocab_size=128256,
+        hidden_size=2048,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        intermediate_size=8192,
+        rope_theta=500_000.0,
+        rms_eps=1e-5,
+        qkv_bias=False,
+        tie_embeddings=True,
+        eos_token_id=128009,
+        bos_token_id=128000,
+        extra_stop_ids=(128001, 128008),  # <|end_of_text|>, <|eom_id|>
+        max_position_embeddings=131072,
+        rope_scaling_factor=32.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_pos=8192,
     )
 )
 
@@ -208,6 +283,7 @@ GEMMA2_2B = _register(
         tie_embeddings=True,
         eos_token_id=107,  # <end_of_turn> — the -it turn-end token
         bos_token_id=2,
+        extra_stop_ids=(1,),  # <eos>
         max_position_embeddings=8192,
         act="gelu_tanh",
         attn_softcap=50.0,
@@ -236,6 +312,7 @@ GEMMA2_9B = _register(
         tie_embeddings=True,
         eos_token_id=107,  # <end_of_turn> — the -it turn-end token
         bos_token_id=2,
+        extra_stop_ids=(1,),  # <eos>
         max_position_embeddings=8192,
         act="gelu_tanh",
         attn_softcap=50.0,

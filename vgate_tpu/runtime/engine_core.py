@@ -351,6 +351,9 @@ class EngineCore:
         initialize_distributed()
         self.dtype = _DTYPES[self.config.model.dtype]
         self.mesh = build_mesh(tpu_cfg, devices)
+        # model-level stop set: the tokenizer's eos plus the spec's extra
+        # generation_config stops (e.g. Llama-3.1's end_of_text/eom)
+        self._stop_ids = frozenset(self.spec.extra_stop_ids)
         self.tokenizer = get_tokenizer(
             self.spec,
             self.config.model.tokenizer_path
@@ -1420,7 +1423,7 @@ class EngineCore:
 
     def _maybe_finish(self, seq: Sequence, token: int) -> None:
         reason = None
-        if token == self.tokenizer.eos_id:
+        if token == self.tokenizer.eos_id or token in self._stop_ids:
             reason = "stop"
         elif (
             seq.params.stop_token_ids
