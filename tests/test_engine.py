@@ -350,11 +350,12 @@ def test_decode_chunk_ladder_compiles_powers_of_two():
     core.start()
     try:
         core.generate(["ladder probe"], [greedy(16)])
-        # keys are (chunk_len, penalties_active)
+        # keys are (chunk_len, penalties_active, min_tokens_width)
         lens = {k[0] for k in core._compiled_chunks}
         assert lens <= {1, 2, 4, 8}
         assert max(lens) == 8
-        assert all(pen is False for _, pen in core._compiled_chunks)
+        assert all(k[1] is False and k[2] is None
+                   for k in core._compiled_chunks)
     finally:
         core.stop()
 

@@ -141,3 +141,87 @@ async def test_http_penalties_roundtrip():
         assert bad.status == 422
     finally:
         await client.close()
+
+
+def test_min_tokens_suppresses_model_stops():
+    """With min_tokens set, a sequence that would stop early (forced by
+    stop_token_ids on its own greedy output) keeps generating to the
+    floor; without it, it stops immediately."""
+    core = EngineCore(engine_config(), devices=jax.devices()[:1])
+    core.start()
+    try:
+        [base] = core.generate(
+            ["min tokens probe"],
+            [SamplingParams(max_tokens=12, temperature=0.0)],
+        )
+        first = base["token_ids"][0]
+        # stopping on the very first token => 1-token completion
+        [short] = core.generate(
+            ["min tokens probe"],
+            [SamplingParams(max_tokens=12, temperature=0.0,
+                            stop_token_ids=[first])],
+        )
+        assert short["num_tokens"] == 1
+        # with min_tokens=6 the stop id is suppressed until 6 tokens exist
+        [floored] = core.generate(
+            ["min tokens probe"],
+            [SamplingParams(max_tokens=12, temperature=0.0,
+                            stop_token_ids=[first], min_tokens=6)],
+        )
+        assert floored["num_tokens"] >= 6
+        assert first not in floored["token_ids"][:6]
+    finally:
+        core.stop()
+
+
+def test_min_tokens_speculative_equivalence():
+    """min_tokens composes with draft-and-verify: same output as the
+    plain engine."""
+    params = [SamplingParams(max_tokens=10, temperature=0.0, min_tokens=8)]
+    plain = EngineCore(engine_config(), devices=jax.devices()[:1])
+    plain.start()
+    try:
+        base = plain.generate(["spec min probe"], params)
+    finally:
+        plain.stop()
+    spec = EngineCore(
+        engine_config(speculative_k=3), devices=jax.devices()[:1]
+    )
+    spec.start()
+    try:
+        got = spec.generate(["spec min probe"], params)
+    finally:
+        spec.stop()
+    assert base[0]["token_ids"] == got[0]["token_ids"]
+
+
+async def test_http_min_tokens_passthrough():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    client = TestClient(TestServer(create_app(http_config())))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "floor"}],
+                "max_tokens": 10,
+                "min_tokens": 5,
+                "temperature": 0,
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["usage"]["completion_tokens"] >= 5
+        bad = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "x"}],
+                "min_tokens": -1,
+            },
+        )
+        assert bad.status == 422
+    finally:
+        await client.close()

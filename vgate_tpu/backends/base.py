@@ -26,6 +26,8 @@ class SamplingParams:
     """Per-request sampling controls, honored per sequence inside a batch."""
 
     max_tokens: int = 256
+    # suppress eos/stop tokens on device until this many tokens exist
+    min_tokens: int = 0
     temperature: float = 0.7
     top_p: float = 0.95
     top_k: int = 0  # 0 disables top-k

@@ -119,6 +119,7 @@ class RequestBatcher:
         self,
         prompt: str,
         max_tokens: Optional[int] = None,
+        min_tokens: int = 0,
         temperature: Optional[float] = None,
         top_p: Optional[float] = None,
         top_k: Optional[int] = None,
@@ -136,6 +137,7 @@ class RequestBatcher:
         inf = self.config.inference
         params = SamplingParams(
             max_tokens=max_tokens if max_tokens is not None else inf.max_tokens,
+            min_tokens=min_tokens,
             temperature=(
                 temperature if temperature is not None else inf.temperature
             ),
@@ -159,6 +161,7 @@ class RequestBatcher:
                 params.top_k,
                 stop=params.stop,
                 stop_token_ids=params.stop_token_ids,
+                min_tokens=params.min_tokens,
                 seed=params.seed,
                 # responses differ in content, so logprob requests must
                 # not collide with plain ones in the cache/dedup key

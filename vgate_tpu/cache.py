@@ -43,6 +43,7 @@ class ResultCache:
         variant: int = 0,
         penalties=None,
         stop_token_ids: Optional[List[int]] = None,
+        min_tokens: int = 0,
     ) -> str:
         """Stable digest over the request-identity fields (reference:
         vgate/cache.py:48-56; top_k/stop/seed/logprobs added for the TPU
@@ -52,7 +53,7 @@ class ResultCache:
         blob = (
             f"{prompt}|{temperature}|{top_p}|{max_tokens}|{top_k}"
             f"|{stop or []}|{seed}|{logprobs}|{variant}|{penalties}"
-            f"|{stop_token_ids or []}"
+            f"|{stop_token_ids or []}|{min_tokens}"
         )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 

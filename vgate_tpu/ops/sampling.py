@@ -165,3 +165,25 @@ def apply_penalties(
         - frequency_penalty[:, None] * c
         - presence_penalty[:, None] * (c > 0).astype(jnp.float32)
     )
+
+
+def suppress_stop_tokens(
+    logits: jnp.ndarray,  # [B, V]
+    steps: jnp.ndarray,  # [B] tokens generated so far
+    min_tokens: jnp.ndarray,  # [B] per-slot floor (0 = off)
+    stop_ids: jnp.ndarray,  # [B, K] int32 stop ids; >= V entries are padding
+) -> jnp.ndarray:
+    """min_tokens: slots below their floor cannot sample a stop token.
+
+    Padding entries use an out-of-vocab id — XLA scatter drops
+    out-of-bounds updates, so they are no-ops by construction.
+    """
+    B = logits.shape[0]
+    suppress = (steps < min_tokens)[:, None]  # [B, 1]
+    b_idx = jnp.broadcast_to(
+        jnp.arange(B)[:, None], stop_ids.shape
+    )
+    masked = logits.at[b_idx, stop_ids].set(
+        -1e30, mode="drop"
+    )
+    return jnp.where(suppress, masked, logits)

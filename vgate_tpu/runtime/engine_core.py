@@ -54,6 +54,7 @@ from vgate_tpu.ops.sampling import (
     apply_penalties,
     sample_tokens,
     sample_tokens_with_logprobs,
+    suppress_stop_tokens,
 )
 from vgate_tpu.parallel.mesh import build_mesh, initialize_distributed
 from vgate_tpu.parallel.sharding import kv_pspec, named, shard_params
@@ -92,6 +93,7 @@ def _prefill_step(
     page_tables, temps, top_ps, top_ks, key, mesh=None, use_pallas=False,
     seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
+    min_toks=None, stop_id_mat=None,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
@@ -101,6 +103,8 @@ def _prefill_step(
         # post-preemption re-prefill: folded outputs still count toward
         # the penalties of the re-sampled first token
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
+    if min_toks is not None:
+        logits = suppress_stop_tokens(logits, steps, min_toks, stop_id_mat)
     if num_logprobs > 0:
         next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
             logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
@@ -123,6 +127,7 @@ def _suffix_prefill_step(
     v_pages, suffix_page_tables, ctx_page_tables, temps, top_ps, top_ks,
     key, seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
+    min_toks=None, stop_id_mat=None,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
@@ -132,6 +137,8 @@ def _suffix_prefill_step(
     )
     if counts is not None:
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
+    if min_toks is not None:
+        logits = suppress_stop_tokens(logits, steps, min_toks, stop_id_mat)
     if num_logprobs > 0:
         next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
             logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
@@ -174,6 +181,7 @@ def _decode_chunk(
     num_steps: int = 1, use_pallas=False, max_position: int = 0,
     seeds=None, steps=None, mesh=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
+    min_toks=None, stop_id_mat=None,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -201,6 +209,10 @@ def _decode_chunk(
             # frequency/presence penalties over the generated-token
             # histogram (ops/sampling.py apply_penalties)
             logits = apply_penalties(logits, counts, freq_pens, pres_pens)
+        if min_toks is not None:
+            logits = suppress_stop_tokens(
+                logits, steps, min_toks, stop_id_mat
+            )
         if num_logprobs > 0:
             next_tokens, lp, tids, tlps = sample_tokens_with_logprobs(
                 logits, temps, top_ps, top_ks, key, seeds=seeds,
@@ -255,6 +267,7 @@ def _spec_verify_step(
     v_pages, page_tables, active, temps, top_ps, top_ks, base_key, counter,
     seeds=None, steps=None, use_pallas=False, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
+    min_toks=None, stop_id_mat=None,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), sample the model's
@@ -297,6 +310,15 @@ def _spec_verify_step(
         if steps is None
         else (steps[:, None] + jnp.arange(S)[None, :]).reshape(-1)
     )
+    if min_toks is not None:
+        assert steps_flat is not None, "min_tokens requires steps"
+        flat = suppress_stop_tokens(
+            logits.reshape(B * S, -1),
+            steps_flat,
+            rep(min_toks),
+            rep(stop_id_mat),
+        )
+        logits = flat.reshape(logits.shape)
     if num_logprobs > 0:
         flat_toks, lp, tids, tlps = sample_tokens_with_logprobs(
             logits.reshape(B * S, -1),
@@ -465,6 +487,8 @@ class EngineCore:
         # by a membership signature (rebuilt from host token lists when
         # membership changes; updated in-program otherwise)
         self._spec_pen: Optional[Dict[str, Any]] = None
+        # membership-cached min-token arrays (immutable per sequence)
+        self._spec_mt: Optional[Dict[str, Any]] = None
 
         # sp>1: prefill attention runs sequence-parallel (ring attention
         # over the sp axis); buckets must then split evenly across shards.
@@ -835,6 +859,31 @@ class EngineCore:
                 )
         return jnp.asarray(counts), jnp.asarray(freq), jnp.asarray(pres)
 
+    def _min_token_arrays(self, B: int, rows):
+        """(min_toks [B], stop_id_mat [B, K]) device arrays, or
+        (None, None) when no row sets min_tokens.  Each row's stop set is
+        the model stop set plus its request stop_token_ids; padding uses
+        an out-of-vocab id (scatter drops it).  K buckets to a power of
+        two so the program-variant count stays bounded."""
+        rows = list(rows)
+        if not any(seq.params.min_tokens > 0 for _, seq in rows):
+            return None, None
+        base = [self.tokenizer.eos_id, *self.spec.extra_stop_ids]
+        per = {
+            row: base + list(seq.params.stop_token_ids or [])
+            for row, seq in rows
+        }
+        K = max(len(v) for v in per.values())
+        K = 1 << (max(1, K) - 1).bit_length()
+        V = self.spec.vocab_size
+        mat = np.full((B, K), V, np.int32)
+        min_toks = np.zeros((B,), np.int32)
+        for row, seq in rows:
+            ids = per[row]  # K = next_pow2(max len) — never truncates
+            mat[row, : len(ids)] = ids
+            min_toks[row] = seq.params.min_tokens
+        return jnp.asarray(min_toks), jnp.asarray(mat)
+
     def _group_penalties(self, plans: List[PrefillPlan], B: int):
         """Penalty arrays for a prefill group, or (None, None, None).
         Counts only matter when a penalized plan already generated tokens
@@ -889,7 +938,13 @@ class EngineCore:
                 seeds[row] = sp.seed
             steps[row] = seq.num_generated
         pen_counts, pen_freq, pen_pres = self._group_penalties(plans, B)
-        key = (bucket, B, pen_counts is not None)
+        mt, mt_ids = self._min_token_arrays(
+            B, ((row, p.seq) for row, p in enumerate(plans))
+        )
+        key = (
+            bucket, B, pen_counts is not None,
+            None if mt is None else mt_ids.shape[1],
+        )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
@@ -917,6 +972,8 @@ class EngineCore:
             counts=pen_counts,
             freq_pens=pen_freq,
             pres_pens=pen_pres,
+            min_toks=mt,
+            stop_id_mat=mt_ids,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -969,7 +1026,13 @@ class EngineCore:
                 seeds[row] = sp.seed
             steps[row] = seq.num_generated
         pen_counts, pen_freq, pen_pres = self._group_penalties(plans, B)
-        key = ("suffix", bucket, B, ctx_pages, pen_counts is not None)
+        mt, mt_ids = self._min_token_arrays(
+            B, ((row, p.seq) for row, p in enumerate(plans))
+        )
+        key = (
+            "suffix", bucket, B, ctx_pages, pen_counts is not None,
+            None if mt is None else mt_ids.shape[1],
+        )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
@@ -997,6 +1060,8 @@ class EngineCore:
             counts=pen_counts,
             freq_pens=pen_freq,
             pres_pens=pen_pres,
+            min_toks=mt,
+            stop_id_mat=mt_ids,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1050,6 +1115,9 @@ class EngineCore:
             )
         else:
             counts_j, freq_j, pres_j = None, jnp.zeros((B,)), jnp.zeros((B,))
+        mt_j, mt_ids_j = self._min_token_arrays(
+            B, ((s.slot, s) for s in seqs)
+        )
         self._dec_state = {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
@@ -1064,6 +1132,8 @@ class EngineCore:
             "counts": counts_j,
             "freq_pens": freq_j,
             "pres_pens": pres_j,
+            "min_toks": mt_j,
+            "stop_id_mat": mt_ids_j,
         }
 
     def _refresh_page_tables(self, seqs: List[Sequence]) -> None:
@@ -1101,7 +1171,13 @@ class EngineCore:
 
     def _dispatch_chunk(self, active: List[Sequence], chunk: int) -> None:
         state = self._dec_state
-        chunk_key = (chunk, state["counts"] is not None)
+        chunk_key = (
+            chunk,
+            state["counts"] is not None,
+            None
+            if state["min_toks"] is None
+            else state["stop_id_mat"].shape[1],
+        )
         if chunk_key not in self._compiled_chunks:
             metrics.RECOMPILES.labels(kind="decode").inc()
             self._compiled_chunks.add(chunk_key)
@@ -1145,6 +1221,8 @@ class EngineCore:
             counts=state["counts"],
             freq_pens=state["freq_pens"],
             pres_pens=state["pres_pens"],
+            min_toks=state["min_toks"],
+            stop_id_mat=state["stop_id_mat"],
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -1302,6 +1380,14 @@ class EngineCore:
                 }
         else:
             self._spec_pen = None
+        mt_sig = tuple((s.seq_id, s.slot) for s in active)
+        if self._spec_mt is None or self._spec_mt["sig"] != mt_sig:
+            mt, mt_ids = self._min_token_arrays(
+                B, ((s.slot, s) for s in active)
+            )
+            self._spec_mt = {"sig": mt_sig, "mt": mt, "ids": mt_ids}
+        spec_mt = self._spec_mt["mt"]
+        spec_mt_ids = self._spec_mt["ids"]
         start = time.perf_counter()
         num_lp = (
             LOGPROBS_K
@@ -1340,6 +1426,8 @@ class EngineCore:
                 pres_pens=(
                     self._spec_pen["pres"] if want_pen else None
                 ),
+                min_toks=spec_mt,
+                stop_id_mat=spec_mt_ids,
             )
         )
         if want_pen:
@@ -1423,7 +1511,12 @@ class EngineCore:
 
     def _maybe_finish(self, seq: Sequence, token: int) -> None:
         reason = None
-        if token == self.tokenizer.eos_id or token in self._stop_ids:
+        below_floor = seq.num_generated < seq.params.min_tokens
+        if below_floor:
+            # min_tokens gates every stop kind (device masking already
+            # prevents stop TOKENS; this also holds back stop STRINGS)
+            pass
+        elif token == self.tokenizer.eos_id or token in self._stop_ids:
             reason = "stop"
         elif (
             seq.params.stop_token_ids

@@ -226,6 +226,7 @@ async def chat_completions(request: web.Request) -> web.Response:
             batcher.submit(
                 prompt,
                 max_tokens=payload.effective_max_tokens(),
+                min_tokens=payload.min_tokens,
                 temperature=payload.temperature,
                 top_p=payload.top_p,
                 top_k=payload.top_k,
@@ -320,6 +321,7 @@ async def _stream_chat(
         params = engine.backend.create_sampling_params(
             max_tokens=payload.effective_max_tokens()
             or engine.config.inference.max_tokens,
+            min_tokens=payload.min_tokens,
             temperature=(
                 payload.temperature
                 if payload.temperature is not None
@@ -377,6 +379,7 @@ async def _stream_chat(
             result = await batcher.submit(
                 prompt,
                 max_tokens=payload.effective_max_tokens(),
+                min_tokens=payload.min_tokens,
                 temperature=payload.temperature,
                 top_p=payload.top_p,
                 top_k=payload.top_k,
@@ -481,6 +484,7 @@ async def completions(request: web.Request) -> web.Response:
             batcher.submit(
                 p,
                 max_tokens=payload.max_tokens,
+                min_tokens=payload.min_tokens,
                 temperature=payload.temperature,
                 top_p=payload.top_p,
                 top_k=payload.top_k,

@@ -26,6 +26,8 @@ class ChatCompletionRequest(BaseModel):
     top_k: Optional[int] = None
     stop: Optional[Union[str, List[str]]] = None
     stop_token_ids: Optional[List[int]] = None
+    # suppress eos/stop tokens until this many are generated
+    min_tokens: int = Field(default=0, ge=0)
     seed: Optional[int] = None
     stream: bool = False
     user: Optional[str] = None
@@ -93,6 +95,8 @@ class CompletionRequest(BaseModel):
     top_k: Optional[int] = None
     stop: Optional[Union[str, List[str]]] = None
     stop_token_ids: Optional[List[int]] = None
+    # suppress eos/stop tokens until this many are generated
+    min_tokens: int = Field(default=0, ge=0)
     seed: Optional[int] = None
     logprobs: Optional[int] = Field(default=None, ge=0, le=8)
     n: int = Field(default=1, ge=1, le=8)
