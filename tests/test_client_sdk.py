@@ -210,3 +210,71 @@ async def test_async_client_live_roundtrip():
             )
     finally:
         await server.close()
+
+
+def test_chat_create_sends_new_sampling_fields():
+    """The SDK forwards the full sampling surface: logprobs, n,
+    penalties, min_tokens, stop_token_ids."""
+    seen = {}
+
+    def handler(request):
+        seen.update(json.loads(request.content))
+        resp = dict(CHAT_RESPONSE)
+        resp["choices"] = [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": "x"},
+                "finish_reason": "stop",
+                "logprobs": {"content": [{"token": "x", "logprob": -0.5}]},
+            }
+        ]
+        return httpx.Response(200, json=resp)
+
+    client = make_client(handler)
+    result = client.chat.create(
+        [{"role": "user", "content": "hi"}],
+        logprobs=True,
+        top_logprobs=3,
+        n=2,
+        frequency_penalty=0.5,
+        presence_penalty=0.25,
+        min_tokens=4,
+        stop_token_ids=[7, 9],
+    )
+    assert seen["logprobs"] is True
+    assert seen["top_logprobs"] == 3
+    assert seen["n"] == 2
+    assert seen["frequency_penalty"] == 0.5
+    assert seen["presence_penalty"] == 0.25
+    assert seen["min_tokens"] == 4
+    assert seen["stop_token_ids"] == [7, 9]
+    assert result.choices[0].logprobs["content"][0]["logprob"] == -0.5
+
+
+def test_completions_resource_roundtrip():
+    def handler(request):
+        assert request.url.path == "/v1/completions"
+        body = json.loads(request.content)
+        assert body["prompt"] == "complete this"
+        assert body["echo"] is True
+        return httpx.Response(
+            200,
+            json={
+                "id": "cmpl-1",
+                "object": "text_completion",
+                "created": 1,
+                "model": "m",
+                "choices": [
+                    {"index": 0, "text": "complete this — done",
+                     "finish_reason": "stop"}
+                ],
+                "usage": {"prompt_tokens": 2, "completion_tokens": 3,
+                          "total_tokens": 5},
+            },
+        )
+
+    client = make_client(handler)
+    result = client.completions.create(
+        "complete this", echo=True, max_tokens=3
+    )
+    assert result["choices"][0]["text"].startswith("complete this")

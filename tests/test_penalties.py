@@ -225,3 +225,19 @@ async def test_http_min_tokens_passthrough():
         assert bad.status == 422
     finally:
         await client.close()
+
+
+def test_min_tokens_above_budget_still_finishes_by_length():
+    """min_tokens > max_tokens must not hang: the length finish stays
+    live below the floor (review finding — the floor gates only stops)."""
+    core = EngineCore(engine_config(), devices=jax.devices()[:1])
+    core.start()
+    try:
+        [r] = core.generate(
+            ["over floor probe"],
+            [SamplingParams(max_tokens=4, temperature=0.0, min_tokens=50)],
+        )
+        assert r["finish_reason"] == "length"
+        assert r["num_tokens"] == 4
+    finally:
+        core.stop()

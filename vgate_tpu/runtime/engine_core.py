@@ -869,9 +869,13 @@ class EngineCore:
         if not any(seq.params.min_tokens > 0 for _, seq in rows):
             return None, None
         base = [self.tokenizer.eos_id, *self.spec.extra_stop_ids]
+        # only floor rows ever have their ids scattered, so only they
+        # size K (a zero-floor neighbour with many stop_token_ids must
+        # not widen the matrix and fork extra compiled variants)
         per = {
             row: base + list(seq.params.stop_token_ids or [])
             for row, seq in rows
+            if seq.params.min_tokens > 0
         }
         K = max(len(v) for v in per.values())
         K = 1 << (max(1, K) - 1).bit_length()
@@ -879,7 +883,9 @@ class EngineCore:
         mat = np.full((B, K), V, np.int32)
         min_toks = np.zeros((B,), np.int32)
         for row, seq in rows:
-            ids = per[row]  # K = next_pow2(max len) — never truncates
+            if row not in per:
+                continue  # zero floor: never suppressed, ids irrelevant
+            ids = per[row]  # K = next_pow2(max floor-row len)
             mat[row, : len(ids)] = ids
             min_toks[row] = seq.params.min_tokens
         return jnp.asarray(min_toks), jnp.asarray(mat)
@@ -1511,24 +1517,27 @@ class EngineCore:
 
     def _maybe_finish(self, seq: Sequence, token: int) -> None:
         reason = None
+        # min_tokens gates STOP kinds only (device masking already
+        # prevents stop tokens; this also holds back stop strings).  The
+        # length finishes below must stay live: a floor above the budget
+        # would otherwise leave the sequence RUNNING forever with zero
+        # decode headroom.
         below_floor = seq.num_generated < seq.params.min_tokens
-        if below_floor:
-            # min_tokens gates every stop kind (device masking already
-            # prevents stop TOKENS; this also holds back stop STRINGS)
-            pass
-        elif token == self.tokenizer.eos_id or token in self._stop_ids:
-            reason = "stop"
-        elif (
-            seq.params.stop_token_ids
-            and token in seq.params.stop_token_ids
-        ):
-            reason = "stop"
-        elif self._hit_stop_string(seq):
-            reason = "stop"  # text_override truncated at the match
-        elif seq.num_generated >= max(1, seq.params.max_tokens):
-            reason = "length"
-        elif seq.total_len >= self.config.model.max_model_len:
-            reason = "length"
+        if not below_floor:
+            if token == self.tokenizer.eos_id or token in self._stop_ids:
+                reason = "stop"
+            elif (
+                seq.params.stop_token_ids
+                and token in seq.params.stop_token_ids
+            ):
+                reason = "stop"
+            elif self._hit_stop_string(seq):
+                reason = "stop"  # text_override truncated at the match
+        if reason is None:
+            if seq.num_generated >= max(1, seq.params.max_tokens):
+                reason = "length"
+            elif seq.total_len >= self.config.model.max_model_len:
+                reason = "length"
         if reason is not None:
             self.scheduler.remove(seq)
             seq.finish(reason)
@@ -1554,15 +1563,28 @@ class EngineCore:
         if not any(s in tail for s in stops):
             return False
         text = self.tokenizer.decode(seq.generated_ids)
-        cut = min(
-            (i for i in (text.find(s) for s in stops) if i != -1),
-            default=-1,
-        )
-        if cut < 0:
+        # min_tokens: only matches ENDING past the floor's text count —
+        # a match wholly inside the floor (its stop check was skipped
+        # while below the floor) must not retroactively truncate the
+        # guaranteed prefix
+        floor_chars = 0
+        if seq.params.min_tokens > 0:
+            floor_chars = len(
+                self.tokenizer.decode(
+                    seq.generated_ids[: seq.params.min_tokens]
+                )
+            )
+        cuts = []
+        for s in stops:
+            idx = text.find(s, max(0, floor_chars - len(s) + 1))
+            if idx != -1:
+                cuts.append(idx)
+        if not cuts:
             # tail decode produced chars the full decode doesn't (BPE
-            # boundary artifact) — not a real match
+            # boundary artifact), or the only matches sit inside the
+            # min_tokens floor — not a real stop
             return False
-        seq.text_override = text[:cut]
+        seq.text_override = text[: min(cuts)]
         return True
 
     def final_text(self, seq: Sequence) -> str:

@@ -88,6 +88,8 @@ class _ChatResource:
         n: int = 1,
         frequency_penalty: Optional[float] = None,
         presence_penalty: Optional[float] = None,
+        min_tokens: Optional[int] = None,
+        stop_token_ids: Optional[List[int]] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -103,6 +105,8 @@ class _ChatResource:
             n=n,
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
+            min_tokens=min_tokens,
+            stop_token_ids=stop_token_ids,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -242,6 +246,8 @@ class _AsyncChatResource:
         n: int = 1,
         frequency_penalty: Optional[float] = None,
         presence_penalty: Optional[float] = None,
+        min_tokens: Optional[int] = None,
+        stop_token_ids: Optional[List[int]] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -257,6 +263,8 @@ class _AsyncChatResource:
             n=n,
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
+            min_tokens=min_tokens,
+            stop_token_ids=stop_token_ids,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:

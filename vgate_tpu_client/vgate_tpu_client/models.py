@@ -28,6 +28,8 @@ class ChatCompletionRequest(BaseModel):
     n: int = 1
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
+    min_tokens: Optional[int] = None
+    stop_token_ids: Optional[List[int]] = None
 
 
 class Usage(BaseModel):
