@@ -79,6 +79,42 @@ def _probe_accelerator(
     return False, last
 
 
+
+def _last_recorded_tpu_result():
+    """Parse the newest benchmarks/RESULTS_*.md for the last recorded
+    real-TPU serving line (kept fresh by appending measurements there —
+    no hardcoded snapshot to go stale)."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(here, "benchmarks", "RESULTS_*.md"))):
+        try:
+            body = open(path).read()
+        except OSError:
+            continue
+        for m in re.finditer(r"^\{.*\}", body, re.M):
+            try:
+                entry = json.loads(m.group(0))
+            except ValueError:
+                continue
+            if (
+                entry.get("platform") == "tpu"
+                and entry.get("metric") == "output_tokens_per_sec_per_chip"
+            ):
+                best = {
+                    k: entry[k]
+                    for k in (
+                        "value", "unit", "vs_baseline", "p50_ttft_ms",
+                        "model", "device",
+                    )
+                    if k in entry
+                }
+                best["recorded_in"] = os.path.basename(path)
+    return best
+
+
 def main() -> None:
     from vgate_tpu.config import apply_platform, load_config
 
@@ -214,6 +250,12 @@ def main() -> None:
             result["diagnostic"] = (
                 f"ran on CPU fallback, not TPU — {diag}"
             )
+            last = _last_recorded_tpu_result()
+            if last is not None:
+                # NOT this run's measurement: the most recent hardware
+                # line from benchmarks/RESULTS_*.md, so a wedged-grant
+                # fallback still points at the recorded TPU numbers
+                result["last_recorded_tpu"] = last
         print(json.dumps(result))
     finally:
         core.stop()

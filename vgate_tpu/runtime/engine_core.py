@@ -1563,10 +1563,14 @@ class EngineCore:
         if not any(s in tail for s in stops):
             return False
         text = self.tokenizer.decode(seq.generated_ids)
-        # min_tokens: only matches ENDING past the floor's text count —
-        # a match wholly inside the floor (its stop check was skipped
-        # while below the floor) must not retroactively truncate the
-        # guaranteed prefix
+        # min_tokens rule: matches ENDING inside the floor are ignored
+        # (their stop checks were skipped while below the floor); a match
+        # straddling the boundary still stops the sequence and truncates
+        # at its start — the floor guarantees GENERATED tokens, not
+        # post-truncation text length (vLLM semantics).  floor_chars has
+        # the same +-few-chars BPE-boundary fuzz the tail-window check
+        # tolerates (decoding a token prefix in isolation can render
+        # replacement chars at a split multi-byte glyph).
         floor_chars = 0
         if seq.params.min_tokens > 0:
             floor_chars = len(
