@@ -1,0 +1,265 @@
+"""Decode-step ablation: where does the time go? (run on real TPU)
+
+Times each component of the serving decode step with amortized in-jit
+loops (one dispatch per measurement, N iterations inside), so the ~70 ms
+tunnel round-trip does not pollute per-step numbers the way the r2
+per-dispatch kernel bench did (benchmarks/RESULTS_r2.md:54-60).
+
+Components:
+  chunk-pallas   full _decode_chunk (the serving program), Pallas attention
+  chunk-jnp      full _decode_chunk, jnp gather-twin attention
+  fwd-pallas     decode_forward only (argmax feedback, no sampler)
+  fwd-jnp        same, jnp twin
+  sample         sample_tokens alone on random logits (top-k path)
+  argmax         plain argmax on the same logits (greedy floor)
+  lmhead         final-norm + lm_head einsum alone
+  attn-pallas    28x paged_decode_attention_pallas per iteration
+  attn-jnp       28x jnp twin per iteration
+
+Prints one JSON line per component: {"component", "ms_per_step", ...}.
+Results land in benchmarks/RESULTS_r3.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, iters_inside: int, reps: int = 3) -> float:
+    """ms per inner iteration: best of ``reps`` timed dispatches."""
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best / iters_inside * 1e3
+
+
+def main() -> None:
+    from vgate_tpu.models.decoder import decode_forward, init_params
+    from vgate_tpu.models.specs import spec_for_model_id
+    from vgate_tpu.ops.sampling import sample_tokens
+    from vgate_tpu.runtime.engine_core import _decode_chunk
+
+    model_id = os.environ.get("VGT_BENCH_MODEL", "Qwen/Qwen2.5-1.5B-Instruct")
+    only = set(sys.argv[1:])  # optional component filter
+    spec = spec_for_model_id(model_id)
+    dtype = jnp.bfloat16
+    B = int(os.environ.get("VGT_ABLATE_SLOTS", 128))
+    ctx = int(os.environ.get("VGT_ABLATE_CTX", 512))
+    ps = 16
+    pages_per_seq = ctx // ps
+    P = B * pages_per_seq + 1
+    STEPS = 32
+
+    platform = jax.devices()[0].platform
+    base = {"model": spec.name, "B": B, "ctx": ctx, "platform": platform}
+    print(json.dumps({**base, "event": "start"}), flush=True)
+
+    params = init_params(spec, jax.random.PRNGKey(0), dtype)
+    kv_shape = (spec.num_layers, spec.num_kv_heads, P, ps, spec.head_dim)
+    k_pages = jnp.zeros(kv_shape, dtype)
+    v_pages = jnp.zeros(kv_shape, dtype)
+    page_tables = jnp.asarray(
+        (np.arange(B * pages_per_seq, dtype=np.int32) % (P - 1) + 1)
+        .reshape(B, pages_per_seq)
+    )
+    tokens = jnp.zeros((B,), jnp.int32)
+    positions = jnp.full((B,), ctx // 2, jnp.int32)
+    active = jnp.ones((B,), bool)
+    temps = jnp.zeros((B,), jnp.float32)
+    top_ps = jnp.ones((B,), jnp.float32)
+    top_ks = jnp.zeros((B,), jnp.int32)
+    seeds = jnp.full((B,), -1, jnp.int32)
+    steps0 = jnp.zeros((B,), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    counter = jnp.asarray(0, jnp.uint32)
+
+    def report(component, ms):
+        print(json.dumps({**base, "component": component,
+                          "ms_per_step": round(ms, 3)}), flush=True)
+
+    # --- full serving chunk (pallas / jnp) --------------------------------
+    for name, use_pallas in (("chunk-pallas", True), ("chunk-jnp", False)):
+        if only and name not in only:
+            continue
+        if use_pallas and platform != "tpu":
+            continue
+
+        def run(k_pages, v_pages, up=use_pallas):
+            return _decode_chunk(
+                params, spec, tokens, positions, k_pages, v_pages,
+                page_tables, active, temps, top_ps, top_ks, key, counter,
+                num_steps=STEPS, use_pallas=up, max_position=ctx - 1,
+                seeds=seeds, steps=steps0,
+            )[0]
+
+        # donation consumes the caches: rebuild per call outside timing is
+        # wrong; instead keep two fresh copies and let XLA alias — simplest
+        # correct form: pass non-donated copies each rep via device_put
+        kp = jnp.zeros(kv_shape, dtype)
+        vp = jnp.zeros(kv_shape, dtype)
+        out = run(kp, vp)
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(3):
+            kp = jnp.zeros(kv_shape, dtype)
+            vp = jnp.zeros(kv_shape, dtype)
+            jax.block_until_ready((kp, vp))
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(kp, vp))
+            best = min(best, time.perf_counter() - t0)
+        report(name, best / STEPS * 1e3)
+
+    # --- model forward only (argmax feedback, no sampler) -----------------
+    for name, use_pallas in (("fwd-pallas", True), ("fwd-jnp", False)):
+        if only and name not in only:
+            continue
+        if use_pallas and platform != "tpu":
+            continue
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnums=(2,))
+        def fwd_loop(k_pages, v_pages, up):
+            def body(carry, _):
+                toks, pos, kp, vp = carry
+                logits, kp, vp = decode_forward(
+                    params, spec, toks, pos, kp, vp, page_tables,
+                    active=active, use_pallas=up,
+                )
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                pos = jnp.minimum(pos + 1, ctx - 1)
+                return (toks, pos, kp, vp), toks
+
+            (_, _, kp, vp), ys = jax.lax.scan(
+                body, (tokens, positions, k_pages, v_pages), None,
+                length=STEPS,
+            )
+            return ys
+
+        kp = jnp.zeros(kv_shape, dtype)
+        vp = jnp.zeros(kv_shape, dtype)
+        jax.block_until_ready(fwd_loop(kp, vp, use_pallas))
+        best = float("inf")
+        for _ in range(3):
+            kp = jnp.zeros(kv_shape, dtype)
+            vp = jnp.zeros(kv_shape, dtype)
+            jax.block_until_ready((kp, vp))
+            t0 = time.perf_counter()
+            jax.block_until_ready(fwd_loop(kp, vp, use_pallas))
+            best = min(best, time.perf_counter() - t0)
+        report(name, best / STEPS * 1e3)
+
+    # --- sampling / lm_head in isolation ----------------------------------
+    V = spec.vocab_size
+    logits = jax.random.normal(jax.random.PRNGKey(1), (B, V), jnp.float32)
+
+    if not only or "sample" in only:
+        @jax.jit
+        def sample_loop(logits):
+            def body(c, i):
+                k = jax.random.fold_in(key, i)
+                t = sample_tokens(logits + c[:, None].astype(jnp.float32),
+                                  temps, top_ps, top_ks, k,
+                                  seeds=seeds, steps=steps0)
+                return t, ()
+            out, _ = jax.lax.scan(body, tokens, jnp.arange(STEPS))
+            return out
+
+        report("sample", timed(sample_loop, logits, iters_inside=STEPS))
+
+    if not only or "argmax" in only:
+        @jax.jit
+        def argmax_loop(logits):
+            def body(c, _):
+                t = jnp.argmax(
+                    logits + c[:, None].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+                return t, ()
+            out, _ = jax.lax.scan(body, tokens, None, length=STEPS)
+            return out
+
+        report("argmax", timed(argmax_loop, logits, iters_inside=STEPS))
+
+    if not only or "lmhead" in only:
+        from vgate_tpu.models.decoder import _logits as logits_fn
+
+        x = jax.random.normal(
+            jax.random.PRNGKey(2), (B, spec.hidden_size), dtype
+        )
+
+        @jax.jit
+        def lmhead_loop(x):
+            def body(c, _):
+                lg = logits_fn(params, spec, x + c)
+                return lg[:, 0].astype(dtype)[:, None] * 0 + c, ()
+            out, _ = jax.lax.scan(
+                body, jnp.zeros((B, 1), dtype), None, length=STEPS
+            )
+            return out
+
+        report("lmhead", timed(lmhead_loop, x, iters_inside=STEPS))
+
+    # --- attention only (28 layer calls per iteration) --------------------
+    q = jax.random.normal(
+        jax.random.PRNGKey(3), (B, spec.num_heads, spec.head_dim), dtype
+    )
+    kp1 = jax.random.normal(
+        jax.random.PRNGKey(4),
+        (spec.num_kv_heads, P, ps, spec.head_dim), dtype,
+    ) * 0.1
+    # independent V buffer: aliasing K/V would let XLA CSE the twin's two
+    # page gathers and halve its apparent memory traffic
+    vp1 = jax.random.normal(
+        jax.random.PRNGKey(5),
+        (spec.num_kv_heads, P, ps, spec.head_dim), dtype,
+    ) * 0.1
+    seq_lens = positions + 1
+    L = spec.num_layers
+
+    for name in ("attn-pallas", "attn-jnp"):
+        if only and name not in only:
+            continue
+        if name == "attn-pallas":
+            if platform != "tpu":
+                continue
+            from vgate_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_pallas as attn,
+            )
+        else:
+            from vgate_tpu.ops.attention import (
+                paged_decode_attention as attn,
+            )
+
+        @jax.jit
+        def attn_loop(q):
+            # outer scan amortizes the dispatch round-trip over STEPS
+            # decode-steps; each step runs all L layer calls
+            def step(c, _):
+                def body(h, _):
+                    o = attn(h, kp1, vp1, page_tables, seq_lens)
+                    return o.astype(h.dtype), ()
+                h, _ = jax.lax.scan(body, c, None, length=L)
+                return h, ()
+            out, _ = jax.lax.scan(step, q, None, length=STEPS)
+            return out
+
+        report(name, timed(attn_loop, q, iters_inside=STEPS))
+
+    print(json.dumps({**base, "event": "done"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
