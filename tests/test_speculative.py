@@ -227,7 +227,8 @@ def test_oracle_drafter_accepts_and_saves_steps():
 
 def test_speculative_respects_exact_budget_and_temperature():
     """max_tokens is exact under multi-accept rounds, and temperature>0
-    sequences (which never draft) still produce the full budget."""
+    sequences (now drafting + rejection-verifying) still produce the
+    full budget."""
     core = EngineCore(spec_config(k=3), devices=jax.devices()[:1])
     core.start()
     try:
@@ -271,6 +272,149 @@ def test_speculative_with_prefix_cache_sharing():
         assert stats["running"] == 0
     finally:
         core.stop()
+
+
+# ------------------------------------------- rejection sampling (temp>0)
+
+def _tv_distance(counts: np.ndarray, p: np.ndarray) -> float:
+    emp = counts / counts.sum()
+    return 0.5 * float(np.abs(emp - p).sum())
+
+
+def test_verify_and_sample_preserves_distribution():
+    """The load-bearing exactness property: at a draft-verification
+    position the emitted token must be EXACTLY p-distributed —
+    P(emit = t) = p(t) (accept) and P(emit = x != t)
+    = (1 - p(t)) * p(x) / (1 - p(t)) = p(x) (reject + residual
+    resample).  Checked empirically by total-variation distance over a
+    12-token vocab with every row drawing from its own key."""
+    from vgate_tpu.ops.sampling import verify_and_sample
+
+    V, R = 12, 8192
+    base = np.linspace(1.0, -1.5, V).astype(np.float32)
+    logits = jnp.broadcast_to(jnp.asarray(base), (R, V))
+    p = np.exp(base) / np.exp(base).sum()
+    draft_tok = 3
+    ones = jnp.ones((R,), jnp.float32)
+    zeros_i = jnp.zeros((R,), jnp.int32)
+
+    toks, accept, _ = verify_and_sample(
+        logits,
+        jnp.full((R,), draft_tok, jnp.int32),
+        jnp.zeros((R,), bool),
+        ones, ones, zeros_i,
+        jax.random.PRNGKey(7),
+    )
+    counts = np.bincount(np.asarray(toks), minlength=V)
+    assert _tv_distance(counts, p) < 0.035
+    # acceptance rate must match p(draft)
+    acc_rate = float(np.asarray(accept).mean())
+    assert abs(acc_rate - p[draft_tok]) < 0.03
+    # every rejection emitted something OTHER than the draft
+    rejected_draws = np.asarray(toks)[~np.asarray(accept)]
+    assert not (rejected_draws == draft_tok).any()
+
+    # bonus rows (no draft): plain p-distributed sample, never "accepted"
+    toks_b, accept_b, _ = verify_and_sample(
+        logits,
+        jnp.full((R,), draft_tok, jnp.int32),
+        jnp.ones((R,), bool),
+        ones, ones, zeros_i,
+        jax.random.PRNGKey(8),
+    )
+    assert not np.asarray(accept_b).any()
+    counts_b = np.bincount(np.asarray(toks_b), minlength=V)
+    assert _tv_distance(counts_b, p) < 0.035
+
+
+def test_verify_and_sample_respects_topk_mask():
+    """With top_k=2 the sampling distribution is the renormalized top-2;
+    verification must be exact w.r.t. THAT distribution: a draft outside
+    the mask is never accepted, and emissions stay inside the mask."""
+    from vgate_tpu.ops.sampling import verify_and_sample
+
+    V, R = 10, 4096
+    base = np.linspace(2.0, -2.0, V).astype(np.float32)
+    logits = jnp.broadcast_to(jnp.asarray(base), (R, V))
+    masked_p = np.exp(base[:2]) / np.exp(base[:2]).sum()
+    ones = jnp.ones((R,), jnp.float32)
+    top_k2 = jnp.full((R,), 2, jnp.int32)
+
+    # draft token 5 is outside top-2: always rejected, emission ~ top-2
+    toks, accept, _ = verify_and_sample(
+        logits, jnp.full((R,), 5, jnp.int32), jnp.zeros((R,), bool),
+        ones, ones, top_k2, jax.random.PRNGKey(9),
+    )
+    assert not np.asarray(accept).any()
+    arr = np.asarray(toks)
+    assert set(np.unique(arr)) <= {0, 1}
+    counts = np.bincount(arr, minlength=2)[:2]
+    assert _tv_distance(counts, masked_p) < 0.04
+
+    # draft token 1 (inside the mask): acceptance rate = masked p(1)
+    _, accept1, _ = verify_and_sample(
+        logits, jnp.full((R,), 1, jnp.int32), jnp.zeros((R,), bool),
+        ones, ones, top_k2, jax.random.PRNGKey(10),
+    )
+    assert abs(float(np.asarray(accept1).mean()) - masked_p[1]) < 0.03
+
+
+def test_verify_and_sample_greedy_rows_match_argmax():
+    from vgate_tpu.ops.sampling import verify_and_sample
+
+    V, R = 8, 16
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.normal(size=(R, V)).astype(np.float32))
+    am = np.asarray(jnp.argmax(logits, axis=-1))
+    draft = jnp.asarray((am + np.arange(R) % 2) % V, jnp.int32)  # half match
+    toks, accept, _ = verify_and_sample(
+        logits, draft, jnp.zeros((R,), bool),
+        jnp.zeros((R,), jnp.float32), jnp.ones((R,), jnp.float32),
+        jnp.zeros((R,), jnp.int32), jax.random.PRNGKey(11),
+    )
+    np.testing.assert_array_equal(np.asarray(toks), am)
+    np.testing.assert_array_equal(
+        np.asarray(accept), np.asarray(draft) == am
+    )
+
+
+def test_sampled_requests_draft_through_engine():
+    """temperature>0 sequences now draft (the r2 engine silently skipped
+    them): with an always-proposing drafter the drafted counter must
+    grow for a sampled request, and the run completes with the exact
+    budget (acceptance is probabilistic; drafting is not)."""
+    core = EngineCore(spec_config(k=3), devices=jax.devices()[:1])
+    core.drafter = lambda seq, k: [7] * k
+    core.start()
+    try:
+        seq = core.submit_tokens(
+            [1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 2, 3],
+            SamplingParams(max_tokens=10, temperature=0.9, seed=5),
+        )
+        assert seq.done_event.wait(300)
+        assert core.total_spec_drafted > 0
+        assert seq.num_output_tokens == 10
+    finally:
+        core.stop()
+
+
+def test_seeded_sampled_reproducible_under_speculation():
+    """A seeded sampled request reproduces token-for-token across runs
+    of the speculative engine (acceptance + resample noise derive from
+    (seed, step) only)."""
+    outs = []
+    for _ in range(2):
+        core = EngineCore(spec_config(k=3), devices=jax.devices()[:1])
+        core.start()
+        try:
+            [r] = core.generate(
+                ["seeded spec repro probe probe probe"],
+                [SamplingParams(max_tokens=12, temperature=0.8, seed=42)],
+            )
+            outs.append(r["token_ids"])
+        finally:
+            core.stop()
+    assert outs[0] == outs[1]
 
 
 def test_builtin_drafter_proposes_through_engine():

@@ -22,17 +22,15 @@ TRUNC = 256  # logits kept per slot for sampling
 _GREEDY_EPS = 1e-4
 
 
-def _topk_and_pos(
+def _masked_scaled(
     logits: jnp.ndarray,  # [B, V]
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32, 0 => disabled
-    key: jax.Array,
-    seeds: jnp.ndarray | None,
-    steps: jnp.ndarray | None,
 ):
-    """Shared sampling core: returns (raw top-trunc logits [B, trunc]
-    sorted desc, their token ids, the chosen position within them)."""
+    """Truncate + scale + apply top-k/top-p masks.  Returns
+    (raw top-trunc logits [B, trunc] sorted desc, their token ids,
+    the temperature-scaled logits with ineligible entries at -1e30)."""
     B, V = logits.shape
     trunc = min(TRUNC, V)
     logits32 = logits.astype(jnp.float32)
@@ -54,22 +52,54 @@ def _topk_and_pos(
 
     mask = k_mask & p_mask
     masked = jnp.where(mask, scaled, -1e30)
+    return top_vals, top_idx, masked
+
+
+def _row_keys(
+    key: jax.Array,
+    seeds: jnp.ndarray,  # [B] int32, -1 => unseeded
+    steps: jnp.ndarray | None,  # [B] int32 per-seq sample index
+    B: int,
+):
+    """Per-row PRNG keys: a row with ``seed >= 0`` derives from
+    ``fold_in(PRNGKey(seed), step)`` — reproducible regardless of batch
+    composition or engine step — else from the engine key + row index."""
+
+    def slot_key(seed, step, slot):
+        seeded = jax.random.fold_in(
+            jax.random.PRNGKey(seed.astype(jnp.uint32)), step
+        )
+        unseeded = jax.random.fold_in(key, slot)
+        return jnp.where(seed >= 0, seeded, unseeded)
+
+    return jax.vmap(slot_key)(
+        seeds,
+        jnp.zeros((B,), jnp.int32) if steps is None else steps,
+        jnp.arange(B, dtype=jnp.int32),
+    )
+
+
+def _topk_and_pos(
+    logits: jnp.ndarray,  # [B, V]
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32, 0 => disabled
+    key: jax.Array,
+    seeds: jnp.ndarray | None,
+    steps: jnp.ndarray | None,
+):
+    """Shared sampling core: returns (raw top-trunc logits [B, trunc]
+    sorted desc, their token ids, the chosen position within them)."""
+    B, V = logits.shape
+    top_vals, top_idx, masked = _masked_scaled(
+        logits, temperature, top_p, top_k
+    )
+    trunc = top_idx.shape[1]
 
     if seeds is None:
         gumbel = jax.random.gumbel(key, (B, trunc), dtype=jnp.float32)
     else:
-        def slot_key(seed, step, slot):
-            seeded = jax.random.fold_in(
-                jax.random.PRNGKey(seed.astype(jnp.uint32)), step
-            )
-            unseeded = jax.random.fold_in(key, slot)
-            return jnp.where(seed >= 0, seeded, unseeded)
-
-        slot_keys = jax.vmap(slot_key)(
-            seeds,
-            jnp.zeros((B,), jnp.int32) if steps is None else steps,
-            jnp.arange(B, dtype=jnp.int32),
-        )
+        slot_keys = _row_keys(key, seeds, steps, B)
         gumbel = jax.vmap(
             lambda k: jax.random.gumbel(k, (trunc,), dtype=jnp.float32)
         )(slot_keys)
@@ -78,6 +108,100 @@ def _topk_and_pos(
     greedy = temperature <= _GREEDY_EPS
     pos = jnp.where(greedy, 0, sampled_pos)
     return top_vals, top_idx, pos
+
+
+def verify_and_sample(
+    logits: jnp.ndarray,  # [R, V] processed (penalized/suppressed) logits
+    draft_next: jnp.ndarray,  # [R] int32 draft token this row verifies
+    is_bonus: jnp.ndarray,  # [R] bool: no draft to verify at this row
+    temperature: jnp.ndarray,  # [R]
+    top_p: jnp.ndarray,  # [R]
+    top_k: jnp.ndarray,  # [R] int32, 0 => disabled
+    key: jax.Array,
+    seeds: jnp.ndarray | None = None,  # [R] int32, -1 => unseeded
+    steps: jnp.ndarray | None = None,  # [R] int32 per-seq sample index
+    num_top: int = 0,
+):
+    """Distribution-preserving speculative verification (rejection
+    sampling with a deterministic proposal).
+
+    Each row holds the model's logits at one candidate position and the
+    draft token proposed there.  With the prompt-lookup drafter the
+    proposal q is a point mass at the draft t, so the standard
+    accept-with-min(1, p/q), resample-from-(p-q)+ rule (Leviathan et al.;
+    the scheme vLLM's rejection sampler implements on GPU) reduces to:
+
+      * accept t with probability p(t) — p being the row's actual
+        sampling distribution: temperature-scaled, top-k/top-p-masked,
+        over the top-``TRUNC`` slice (the distribution ``sample_tokens``
+        draws from, so the guarantee is exact w.r.t. the engine, not an
+        idealized full-vocab softmax);
+      * on rejection, resample from p with t excluded (the normalized
+        residual max(0, p - q)).
+
+    The emitted token is then exactly p-distributed at every position,
+    whatever the drafter proposed.  Greedy rows (temperature <= eps)
+    reduce to exact argmax matching — the pre-existing greedy-exact
+    contract.  ``is_bonus`` rows skip verification and draw a plain
+    sample (the bonus token at the end of an all-accepted run).
+
+    Returns ``(model_toks [R] int32, accept [R] bool, lp_data)`` where
+    ``lp_data`` is ``(chosen_lp [R], top_ids [R, num_top], top_lps
+    [R, num_top])`` when ``num_top > 0`` else None.
+    """
+    R, V = logits.shape
+    top_vals, top_idx, masked = _masked_scaled(
+        logits, temperature, top_p, top_k
+    )
+    trunc = top_idx.shape[1]
+
+    seeds_eff = (
+        jnp.full((R,), -1, jnp.int32) if seeds is None else seeds
+    )
+    base_keys = _row_keys(key, seeds_eff, steps, R)
+    sub = jax.vmap(lambda k: jax.random.split(k, 2))(base_keys)  # [R,2,2]
+    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(sub[:, 0])
+    gumbel = jax.vmap(
+        lambda k: jax.random.gumbel(k, (trunc,), dtype=jnp.float32)
+    )(sub[:, 1])
+
+    probs = jax.nn.softmax(masked, axis=-1)  # ineligible entries ~0
+    is_draft = top_idx == draft_next[:, None]  # [R, trunc]
+    p_draft = jnp.sum(jnp.where(is_draft, probs, 0.0), axis=-1)
+    greedy = temperature <= _GREEDY_EPS
+    accept = (
+        jnp.where(greedy, top_idx[:, 0] == draft_next, u < p_draft)
+        & ~is_bonus
+    )
+
+    # One gumbel draw serves both the rejection-resample (draft token
+    # excluded — argmax-gumbel over the residual support renormalizes
+    # implicitly) and the plain bonus sample (no exclusion): the two are
+    # mutually exclusive per row.  A rejected row always has other
+    # eligible entries: p_draft == 1 makes rejection impossible
+    # (u ~ U[0,1) < 1).
+    exclude = is_draft & ~is_bonus[:, None]
+    pos_rs = jnp.argmax(
+        jnp.where(exclude, -jnp.inf, masked) + gumbel, axis=-1
+    )
+    pos_draft = jnp.argmax(is_draft, axis=-1)
+    pos = jnp.where(greedy, 0, jnp.where(accept, pos_draft, pos_rs))
+    model_toks = jnp.take_along_axis(
+        top_idx, pos[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
+
+    if num_top > 0:
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1, keepdims=True
+        )
+        lps = top_vals - lse
+        chosen_lp = jnp.take_along_axis(lps, pos[:, None], axis=-1)[:, 0]
+        return model_toks, accept, (
+            chosen_lp,
+            top_idx[:, :num_top].astype(jnp.int32),
+            lps[:, :num_top],
+        )
+    return model_toks, accept, None
 
 
 def sample_tokens(

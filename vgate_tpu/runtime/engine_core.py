@@ -55,6 +55,7 @@ from vgate_tpu.ops.sampling import (
     sample_tokens,
     sample_tokens_with_logprobs,
     suppress_stop_tokens,
+    verify_and_sample,
 )
 from vgate_tpu.parallel.mesh import build_mesh, initialize_distributed
 from vgate_tpu.parallel.sharding import kv_pspec, named, shard_params
@@ -270,12 +271,13 @@ def _spec_verify_step(
     min_toks=None, stop_id_mat=None,
 ):
     """One speculative round: score current token + drafts in a single
-    forward (models/decoder.py spec_verify_forward), sample the model's
-    token at EVERY position with the per-slot sampling params (greedy
-    slots verify drafts; temperature>0 slots have input_len 1, so only
-    their position-0 sample is ever consumed — the plain decode step), and
-    count accepted drafts on device.  Returns (model_toks [B, S],
-    accepted [B], caches)."""
+    forward (models/decoder.py spec_verify_forward), then verify every
+    draft position with the per-slot sampling params — greedy slots by
+    exact argmax match, temperature>0 slots by distribution-preserving
+    rejection sampling (ops/sampling.py verify_and_sample: accept draft
+    t with prob p(t), resample from p minus t on rejection) — and count
+    accepted drafts on device.  Returns (model_toks [B, S], accepted
+    [B], caches)."""
     from vgate_tpu.runtime.speculative import count_accepted
 
     logits, k_pages, v_pages = spec_verify_forward(
@@ -319,27 +321,30 @@ def _spec_verify_step(
             rep(stop_id_mat),
         )
         logits = flat.reshape(logits.shape)
+    # row (b, j) verifies draft tokens[b, j+1]; the row at input_len-1
+    # (and any garbage row past it) draws the plain bonus sample instead
+    draft_next = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1
+    )
+    is_bonus = jnp.arange(S)[None, :] >= (input_lens[:, None] - 1)
+    flat_toks, _accept, lp_flat = verify_and_sample(
+        logits.reshape(B * S, -1),
+        draft_next.reshape(-1),
+        is_bonus.reshape(-1),
+        rep(temps), rep(top_ps), rep(top_ks), key,
+        seeds=None if seeds is None else rep(seeds),
+        steps=steps_flat,
+        num_top=num_logprobs,
+    )
+    model_toks = flat_toks.reshape(B, S)
     if num_logprobs > 0:
-        flat_toks, lp, tids, tlps = sample_tokens_with_logprobs(
-            logits.reshape(B * S, -1),
-            rep(temps), rep(top_ps), rep(top_ks), key,
-            seeds=None if seeds is None else rep(seeds),
-            steps=steps_flat,
-            num_top=num_logprobs,
-        )
-        model_toks = flat_toks.reshape(B, S)
+        lp, tids, tlps = lp_flat
         lp_data = (
             lp.reshape(B, S),
             tids.reshape(B, S, -1),
             tlps.reshape(B, S, -1),
         )
     else:
-        model_toks = sample_tokens(
-            logits.reshape(B * S, -1),
-            rep(temps), rep(top_ps), rep(top_ks), key,
-            seeds=None if seeds is None else rep(seeds),
-            steps=steps_flat,
-        ).reshape(B, S)
         lp_data = None
     accepted = count_accepted(model_toks, tokens, input_lens)
     if counts is not None:
@@ -1345,7 +1350,10 @@ class EngineCore:
                 max(1, seq.params.max_tokens) - seq.num_generated,
                 max_len - seq.total_len + 1,
             )
-            if room > 1 and seq.params.temperature == 0.0:
+            if room > 1:
+                # greedy AND sampled sequences draft: greedy rows verify
+                # by argmax match, sampled rows by rejection sampling
+                # (verify_and_sample), both distribution-exact
                 draft = self.drafter(seq, room - 1)
                 if draft:
                     tokens[slot, 1 : 1 + len(draft)] = draft

@@ -10,18 +10,28 @@ tokens past the accepted point sit at positions beyond the sequence's
 length, which every later attention masks out and the next verify step
 overwrites.
 
-Greedy-exact by construction: a draft token is accepted iff it equals
-the model's choice at its position, so the output always follows the
-verify program's own greedy trajectory — drafts can accelerate it but
-never steer it.  The standard program-variant caveat applies (as it
-does to chunked decode): the verify pass and the single-step decode
-pass are different compiled programs, so an ulp-level logit tie can in
-principle break differently between them; the CPU suite pins
-token-identical output against the plain engine in practice
-(tests/test_speculative.py).  Sequences with temperature > 0 simply
-don't draft (their rows run single-token steps inside the same
-program) — distribution-preserving rejection sampling is a possible
-extension, not attempted here.
+Distribution-exact for every request:
+
+* **Greedy** (temperature 0): a draft token is accepted iff it equals
+  the model's argmax at its position, so the output always follows the
+  verify program's own greedy trajectory — drafts can accelerate it
+  but never steer it.  The standard program-variant caveat applies (as
+  it does to chunked decode): the verify pass and the single-step
+  decode pass are different compiled programs, so an ulp-level logit
+  tie can in principle break differently between them; the CPU suite
+  pins token-identical output against the plain engine in practice
+  (tests/test_speculative.py).
+* **Sampled** (temperature > 0): standard rejection-sampling
+  verification (ops/sampling.py verify_and_sample) — accept draft t
+  with probability p(t) under the row's masked sampling distribution,
+  resample from the residual on rejection — so every emitted token is
+  exactly p-distributed whatever the drafter proposed (the scheme the
+  reference's vLLM backend applies on GPU, consumed opaquely at
+  vgate/backends/vllm_backend.py:51; here first-party).  A seeded
+  sampled request remains run-to-run reproducible (acceptance and
+  resample noise derive from (seed, step)), but its trajectory differs
+  from the non-speculative engine's — equality holds in distribution,
+  not token-for-token (tests/test_speculative.py pins both).
 """
 
 from __future__ import annotations
