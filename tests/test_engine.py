@@ -451,6 +451,49 @@ def test_sp_engine_long_prefill_end_to_end():
         core.stop()
 
 
+def test_sp_engine_gemma2_sliding_window():
+    """Gemma-2 (sliding-window + softcap + sandwich norms) under sp=2:
+    ring prefill composes the per-layer window mask with the block-
+    position masks, so greedy output must be token-identical to the
+    sp=1 engine (VERDICT r2 next-10: the guard is gone)."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >=2 devices")
+
+    def gemma_cfg(sp, n_dev):
+        return load_config(
+            model={
+                "model_id": "tiny-gemma2",
+                "engine_type": "jax_tpu",
+                "dtype": "float32",
+                "max_model_len": 64,
+            },
+            tpu={
+                "dp": 1, "tp": 1, "ep": 1, "sp": sp,
+                "num_devices": n_dev,
+                "kv_num_pages": 64, "kv_page_size": 4,
+                "max_batch_slots": 2, "prefill_buckets": [16, 32],
+                "use_pallas": False,
+            },
+            scheduler={"max_queue_size": 8},
+            logging={"level": "WARNING"},
+        )
+
+    # prompt long enough to cross the 8-token sliding window AND span
+    # both sp shards of the 32 bucket
+    prompt_ids = [2 + (i % 37) for i in range(30)]
+    outs = []
+    for sp, n_dev in ((1, 1), (2, 2)):
+        core = EngineCore(gemma_cfg(sp, n_dev), devices=jax.devices()[:n_dev])
+        core.start()
+        try:
+            seq = core.submit_tokens(prompt_ids, greedy(10))
+            assert seq.done_event.wait(300)
+            outs.append(list(seq.generated_ids))
+        finally:
+            core.stop()
+    assert outs[0] == outs[1]
+
+
 def test_sp_bucket_divisibility_enforced():
     config = load_config(
         model={"model_id": "tiny-dense", "engine_type": "jax_tpu",

@@ -292,14 +292,15 @@ def prefill_forward(
         )
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
-        if spec.uses_local_attention:
-            raise NotImplementedError(
-                "ring-attention prefill does not support "
-                "sliding-window/softcap families yet"
-            )
+        # sliding-window/softcap families (Gemma-2) ride the ring too:
+        # per-layer window masks compose with the ring's global block-
+        # position masks (parallel/ring_attention.py ring_attention_shard)
         from vgate_tpu.parallel.ring_attention import ring_prefill_attention
 
-        attn_fn = functools.partial(ring_prefill_attention, mesh=mesh)
+        attn_fn = functools.partial(
+            ring_prefill_attention, mesh=mesh, softcap=spec.attn_softcap,
+            scale=_query_scale(spec),
+        )
     elif use_pallas:
         from vgate_tpu.ops.pallas.flash_prefill import (
             flash_prefill_attention_pallas,

@@ -85,9 +85,9 @@ class ModelSpec:
     @property
     def uses_local_attention(self) -> bool:
         """True when attention needs window/softcap/scale semantics.  The
-        Pallas prefill+decode kernels implement these natively; the paths
-        that do NOT yet (ring-attention sp prefill, the pipeline-parallel
-        relay) reject such specs at engine init."""
+        Pallas prefill+decode kernels and ring-attention sp prefill
+        implement these natively; the one path that does NOT yet (the
+        pipeline-parallel relay) rejects such specs at engine init."""
         return (
             self.sliding_window > 0
             or self.attn_softcap > 0

@@ -33,10 +33,13 @@ def _block_attention_update(
     acc: jnp.ndarray,  # [B, Sq, H, hd] fp32
     m: jnp.ndarray,  # [B, Sq, H] running max
     l: jnp.ndarray,  # [B, Sq, H] running denom
+    softcap: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     scores = jnp.einsum(
         "bshd,bthd->bsth", q, k, preferred_element_type=jnp.float32
     )  # [B, Sq, Sk, H]
+    if softcap:
+        scores = jnp.tanh(scores / softcap) * softcap
     scores = jnp.where(mask[..., None], scores, -1e30)
     m_cur = jnp.max(scores, axis=2)  # [B, Sq, H]
     m_new = jnp.maximum(m, m_cur)
@@ -54,14 +57,24 @@ def ring_attention_shard(
     k: jnp.ndarray,  # [B, S_local, H, hd] — this device's KV block (GQA
     v: jnp.ndarray,  #                      already expanded by the caller)
     seq_lens: jnp.ndarray,  # [B] global real lengths
+    window: jnp.ndarray,  # [] int32; >0 => attend only to the last `window`
     axis_name: str = AXIS_SP,
+    softcap: float = 0.0,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Per-shard body; call under shard_map with the sequence dim sharded on
-    ``axis_name``.  Returns this device's output block [B, S_local, H, hd]."""
+    ``axis_name``.  Returns this device's output block [B, S_local, H, hd].
+
+    ``window``/``softcap``/``scale`` carry the sliding-window families
+    (Gemma-2): window masking composes with the global block-position
+    masks, so local-attention layers ride the same ring — blocks wholly
+    outside a query's window contribute only masked (-1e30) scores, which
+    the online softmax absorbs."""
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S_local, H, hd = q.shape
-    scale = 1.0 / (hd ** 0.5)
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
 
     q32 = q.astype(jnp.float32) * scale
     local_pos = jnp.arange(S_local)
@@ -77,8 +90,10 @@ def ring_attention_shard(
         src = (idx - step) % sp  # owner of the block we currently hold
         k_pos = src * S_local + local_pos  # [S_local]
         causal = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+        dist = q_pos[:, None] - k_pos[None, :]
+        win_ok = (window <= 0) | (dist < window)
         valid = k_pos[None, :] < seq_lens[:, None]  # [B, Sk]
-        mask = causal[None] & valid[:, None, :]
+        mask = (causal & win_ok)[None] & valid[:, None, :]
         acc, m, l = _block_attention_update(
             q32,
             k_blk.astype(jnp.float32),
@@ -87,6 +102,7 @@ def ring_attention_shard(
             acc,
             m,
             l,
+            softcap=softcap,
         )
         if step != sp - 1:
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
@@ -102,11 +118,17 @@ def ring_prefill_attention(
     v: jnp.ndarray,
     seq_lens: jnp.ndarray,  # [B]
     mesh: Mesh,
+    window=None,  # int32 scalar; >0 => attend only to the last `window`
+    softcap: float = 0.0,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel causal attention over the mesh's sp axis.
 
     Drop-in equivalent of ops.attention.causal_prefill_attention for
     prompts too long for one device's HBM; S must divide by mesh.shape[sp].
+    ``window``/``softcap``/``scale`` make the sliding-window/softcap
+    families (Gemma-2) ring-capable (window may be a traced per-layer
+    scalar; 0 means global attention).
     """
     sp = mesh.shape[AXIS_SP]
     B, S, H, hd = q.shape
@@ -116,15 +138,21 @@ def ring_prefill_attention(
     if n_rep > 1:  # expand GQA before sharding so all blocks line up
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
+    window_arr = jnp.asarray(
+        0 if window is None else window, jnp.int32
+    )
 
     from jax.experimental.shard_map import shard_map
 
     seq_sharded = P(None, AXIS_SP, None, None)
     fn = shard_map(
-        functools.partial(ring_attention_shard, axis_name=AXIS_SP),
+        functools.partial(
+            ring_attention_shard, axis_name=AXIS_SP, softcap=softcap,
+            scale=scale,
+        ),
         mesh=mesh,
-        in_specs=(seq_sharded, seq_sharded, seq_sharded, P()),
+        in_specs=(seq_sharded, seq_sharded, seq_sharded, P(), P()),
         out_specs=seq_sharded,
         check_rep=False,
     )
-    return fn(q, k, v, seq_lens)
+    return fn(q, k, v, seq_lens, window_arr)

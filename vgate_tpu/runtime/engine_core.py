@@ -526,10 +526,13 @@ class EngineCore:
                     "ring-attention prefill shards the sequence axis evenly"
                 )
 
-        if self.spec.uses_local_attention and (sp_size > 1 or pp_size > 1):
+        if self.spec.uses_local_attention and pp_size > 1:
+            # sp>1 works: ring prefill takes window/softcap natively
+            # (parallel/ring_attention.py); only the pipeline stage relay
+            # still lacks the window plumbing
             raise ValueError(
                 f"{self.spec.name} uses sliding-window/softcap attention, "
-                "not yet supported with sp>1 or pp>1"
+                "not yet supported with pp>1"
             )
         if tpu_cfg.speculative_k > 0 and pp_size > 1:
             raise ValueError(
@@ -952,9 +955,14 @@ class EngineCore:
         mt, mt_ids = self._min_token_arrays(
             B, ((row, p.seq) for row, p in enumerate(plans))
         )
+        num_lp = (
+            LOGPROBS_K
+            if any(p.seq.params.logprobs for p in plans)
+            else 0
+        )
         key = (
             bucket, B, pen_counts is not None,
-            None if mt is None else mt_ids.shape[1],
+            None if mt is None else mt_ids.shape[1], num_lp,
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -975,11 +983,7 @@ class EngineCore:
             use_pallas=self.use_pallas,
             seeds=jnp.asarray(seeds),
             steps=jnp.asarray(steps),
-            num_logprobs=(
-                LOGPROBS_K
-                if any(p.seq.params.logprobs for p in plans)
-                else 0
-            ),
+            num_logprobs=num_lp,
             counts=pen_counts,
             freq_pens=pen_freq,
             pres_pens=pen_pres,
@@ -1040,9 +1044,14 @@ class EngineCore:
         mt, mt_ids = self._min_token_arrays(
             B, ((row, p.seq) for row, p in enumerate(plans))
         )
+        num_lp = (
+            LOGPROBS_K
+            if any(p.seq.params.logprobs for p in plans)
+            else 0
+        )
         key = (
             "suffix", bucket, B, ctx_pages, pen_counts is not None,
-            None if mt is None else mt_ids.shape[1],
+            None if mt is None else mt_ids.shape[1], num_lp,
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -1063,11 +1072,7 @@ class EngineCore:
             self._step_key(),
             seeds=jnp.asarray(seeds),
             steps=jnp.asarray(steps),
-            num_logprobs=(
-                LOGPROBS_K
-                if any(p.seq.params.logprobs for p in plans)
-                else 0
-            ),
+            num_logprobs=num_lp,
             counts=pen_counts,
             freq_pens=pen_freq,
             pres_pens=pen_pres,
@@ -1188,16 +1193,15 @@ class EngineCore:
             None
             if state["min_toks"] is None
             else state["stop_id_mat"].shape[1],
+            LOGPROBS_K
+            if any(s.params.logprobs for s in active)
+            else 0,
         )
         if chunk_key not in self._compiled_chunks:
             metrics.RECOMPILES.labels(kind="decode").inc()
             self._compiled_chunks.add(chunk_key)
         start = time.perf_counter()
-        num_lp = (
-            LOGPROBS_K
-            if any(s.params.logprobs for s in active)
-            else 0
-        )
+        num_lp = chunk_key[-1]
         (
             chunk_tokens,
             chunk_lp,
@@ -1358,11 +1362,12 @@ class EngineCore:
                 if draft:
                     tokens[slot, 1 : 1 + len(draft)] = draft
                     input_lens[slot] = 1 + len(draft)
-        # rounds where little/nothing drafted (non-repetitive text, or an
-        # all-sampled batch) run a narrower program variant — a no-draft
-        # round costs a plain decode step, not a k+1-wide verify of
-        # nothing.  Widths are powers of two so the variant count stays
-        # log2(S), mirroring the decode-chunk ladder.
+        # rounds where little/nothing drafted (non-repetitive text — the
+        # n-gram drafter found no match for greedy OR sampled rows) run a
+        # narrower program variant — a no-draft round costs a plain
+        # decode step, not a k+1-wide verify of nothing.  Widths are
+        # powers of two so the variant count stays log2(S), mirroring
+        # the decode-chunk ladder.
         S_round = 1 << (max(1, int(input_lens.max())) - 1).bit_length()
         S_round = max(1, min(S, S_round))
         if S_round < S:

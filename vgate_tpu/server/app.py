@@ -249,6 +249,11 @@ async def chat_completions(request: web.Request) -> web.Response:
         return err
     results = (settled * (payload.n if deterministic else 1))[: payload.n]
     result = results[0]
+    # usage is PER-CHOICE: n deterministic (temperature 0) choices share
+    # one generation but still bill n x its tokens, exactly like n
+    # sampled choices — clients see uniform accounting regardless of
+    # whether the engine deduped the compute (ADVICE r2: documented
+    # decision, per-choice semantics over actual-compute semantics)
     completion_tokens = sum(r.get("num_tokens", 0) for r in results)
     completion = ChatCompletion(
         model=payload.model or engine.config.model.model_id,
@@ -456,7 +461,16 @@ async def completions(request: web.Request) -> web.Response:
     """POST /v1/completions — the legacy text-completion surface (no chat
     template; the prompt goes to the engine verbatim).  Supports string or
     list-of-strings prompts, n choices per prompt, stop/seed/logprobs with
-    the same semantics as chat."""
+    the same semantics as chat.
+
+    ``echo`` limitation (documented, ADVICE r2): echo=true prepends the
+    prompt TEXT but logprobs arrays cover COMPLETION tokens only — there
+    are no prompt-token entries, and max_tokens >= 1 is enforced, so the
+    max_tokens=0 echo+logprobs loglikelihood-scoring idiom some eval
+    harnesses use is not supported (the engine's prompt pass computes
+    last-position logits only; scoring all prompt positions is a
+    different device program).  ``text_offset`` still accounts for the
+    echoed prompt, so completion-token offsets are correct."""
     try:
         payload = CompletionRequest(**await request.json())
     except (ValidationError, ValueError) as exc:
