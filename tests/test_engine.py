@@ -632,32 +632,32 @@ def test_gemma2_engine_end_to_end_across_window():
         core.stop()
 
 
-def test_gemma2_rejects_sp_and_pp():
-    for axes in ({"sp": 2}, {"pp": 2}):
-        n = min(2, jax.device_count())
-        if n < 2:
-            pytest.skip("needs 2 devices")
-        tpu = {
-            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "pp": 1,
+def test_gemma2_rejects_pp_only():
+    """sp x Gemma-2 now works (ring prefill takes window/softcap
+    natively — see test_sp_engine_gemma2_sliding_window); only the
+    pipeline-parallel relay still rejects local-attention specs."""
+    n = min(2, jax.device_count())
+    if n < 2:
+        pytest.skip("needs 2 devices")
+    config = load_config(
+        model={
+            "model_id": "tiny-gemma2",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "pp": 2,
             "num_devices": n,
             "kv_num_pages": 64, "kv_page_size": 4,
             "max_batch_slots": 2, "prefill_buckets": [8],
             "use_pallas": False,
-        }
-        tpu.update(axes)
-        config = load_config(
-            model={
-                "model_id": "tiny-gemma2",
-                "engine_type": "jax_tpu",
-                "dtype": "float32",
-                "max_model_len": 64,
-            },
-            tpu=tpu,
-            scheduler={"max_queue_size": 8},
-            logging={"level": "WARNING"},
-        )
-        with pytest.raises(ValueError, match="sliding-window"):
-            EngineCore(config, devices=jax.devices()[:n])
+        },
+        scheduler={"max_queue_size": 8},
+        logging={"level": "WARNING"},
+    )
+    with pytest.raises(ValueError, match="sliding-window"):
+        EngineCore(config, devices=jax.devices()[:n])
 
 
 def test_stop_token_ids_finish(engine):

@@ -307,3 +307,103 @@ def test_paged_multitok_kernel_single_row_matches_decode_kernel():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
     )
+
+
+# ------------------------------------------------- fused int4 dequant GEMM
+
+def _int4_case(lead, in_dim, out, seed=0):
+    from vgate_tpu.ops.quant import quantize_tensor
+
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.normal(size=(in_dim, out)), jnp.float32)
+    qt = quantize_tensor(w, bits=4)  # PackedQTensor
+    x = jnp.asarray(rng.normal(size=(*lead, in_dim)), jnp.float32)
+    return x, qt
+
+
+@pytest.mark.parametrize(
+    "lead,in_dim,out",
+    [
+        ((4,), 64, 128),       # tiny decode-shaped
+        ((2, 8), 64, 64),      # prefill-shaped leading dims
+        ((12,), 256, 128),     # multi-in-tile accumulation (T_in=128 x 2)
+    ],
+)
+def test_int4_matmul_kernel_matches_packed_einsum(lead, in_dim, out):
+    from vgate_tpu.ops.pallas.quant_matmul import int4_matmul_pallas
+    from vgate_tpu.ops.quant import packed_einsum
+
+    x, qt = _int4_case(lead, in_dim, out)
+    expect = packed_einsum("...d,dh->...h", x, qt) * qt.scale
+    got = int4_matmul_pallas(
+        x, qt.q_packed, qt.scale, interpret=True
+    )
+    assert got.shape == (*lead, out)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_int4_matmul_kernel_f32_out_and_ragged_rows():
+    """lm_head shape class: f32 accumulation/output and a row count that
+    is not a multiple of the row tile (padding path)."""
+    from vgate_tpu.ops.pallas.quant_matmul import int4_matmul_pallas
+    from vgate_tpu.ops.quant import packed_einsum
+
+    x, qt = _int4_case((5,), 64, 128, seed=3)
+    xb = x.astype(jnp.bfloat16)
+    expect = (
+        packed_einsum(
+            "...d,dv->...v", xb, qt,
+            preferred_element_type=jnp.float32,
+        )
+        * qt.scale
+    )
+    got = int4_matmul_pallas(
+        xb, qt.q_packed, qt.scale, out_dtype=jnp.float32,
+        interpret=True,
+    )
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_int4_kernel_gate_dispatch(monkeypatch):
+    """weighted_einsum routes 2D packed weights through the kernel when
+    the per-call ``int4_kernel`` flag (threaded from
+    ModelSpec.int4_kernel) is on, and the results agree with the jnp
+    path."""
+    from vgate_tpu.ops import quant
+
+    x, qt = _int4_case((4,), 64, 128, seed=5)
+    base = quant.weighted_einsum("...d,dh->...h", x, qt)
+    called = {}
+
+    import vgate_tpu.ops.pallas.quant_matmul as qm
+
+    real_kernel = qm.int4_matmul_pallas
+
+    def fake_kernel(xx, qp, sc, out_dtype=None):
+        called["yes"] = True
+        return real_kernel(
+            xx, qp, sc, out_dtype=out_dtype, interpret=True
+        )
+
+    monkeypatch.setattr(qm, "int4_matmul_pallas", fake_kernel)
+    got = quant.weighted_einsum("...d,dh->...h", x, qt, int4_kernel=True)
+    assert called.get("yes")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(base), rtol=2e-4, atol=2e-4
+    )
+    # default-off: no kernel call without the flag
+    called.clear()
+    quant.weighted_einsum("...d,dh->...h", x, qt)
+    assert not called
+    # expert (3D) weights never take the kernel, flag or not
+    from vgate_tpu.ops.quant import quantize_expert_stacked
+
+    rng = np.random.default_rng(6)
+    we = jnp.asarray(rng.normal(size=(2, 3, 16, 32)), jnp.float32)
+    qe = quantize_expert_stacked(we, bits=4)
+    assert not quant._use_int4_kernel("ecd,edf->ecf", qe)

@@ -53,10 +53,38 @@ class ModelSpec:
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_pos: int = 8192
+    # Route 2D packed-int4 weights through the fused dequant Pallas
+    # kernel (ops/pallas/quant_matmul.py).  Set per-ENGINE via
+    # dataclasses.replace at EngineCore init — the spec rides every
+    # forward as a static jit arg, so two engines with different
+    # meshes in one process get separate compile caches instead of
+    # fighting over a module global.
+    int4_kernel: bool = False
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def num_params(self) -> int:
+        """Analytic parameter count (embeddings + decoder stack), used
+        for MFU accounting in bench.py.  Matches init_params' layout:
+        q/k/v/o (+bias), gate/up/down (per expert for MoE, + router),
+        norms, embed, united or separate lm_head."""
+        D, L, F = self.hidden_size, self.num_layers, self.intermediate_size
+        q_dim = self.num_heads * self.head_dim
+        kv_dim = self.num_kv_heads * self.head_dim
+        attn = D * q_dim + 2 * D * kv_dim + q_dim * D
+        if self.qkv_bias:
+            attn += q_dim + 2 * kv_dim
+        if self.is_moe:
+            mlp = self.num_experts * 3 * D * F + D * self.num_experts
+        else:
+            mlp = 3 * D * F
+        norms = 2 * D + (2 * D if self.ffn_sandwich else 0)
+        embed = self.vocab_size * D
+        head = 0 if self.tie_embeddings else self.vocab_size * D
+        return L * (attn + mlp + norms) + embed + head + D
 
     @property
     def layer_windows(self) -> tuple:

@@ -54,6 +54,23 @@ _QDTYPES = {8: (jnp.int8, 127), 4: (jnp.int8, 7)}
 
 Weight = Union[jnp.ndarray, QTensor, PackedQTensor]
 
+def _use_int4_kernel(subscripts: str, w: "PackedQTensor") -> bool:
+    """Shape eligibility for the fused int4 dequant kernel
+    (ops/pallas/quant_matmul.py): 2D per-layer packed weights in a plain
+    [..., in] @ [in, out] contraction ("...d,dh->...h" etc.).  Stacked/
+    expert weights and exotic einsums keep the jnp path.  Whether the
+    kernel actually runs is the caller's ``int4_kernel`` flag (threaded
+    per-engine via ModelSpec.int4_kernel — the engine enables it only on
+    TPU with no model-parallel axes, since pallas_call does not
+    auto-partition under jit sharding)."""
+    if w.q_packed.ndim != 2:
+        return False
+    ins, out = subscripts.split("->")
+    a, b = ins.split(",")
+    if not (a.startswith("...") and len(a) == 4 and len(b) == 2):
+        return False
+    return a[3] == b[0] and out == "..." + b[1]
+
 
 def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
     """int8 values in [-7, 7], shape [..., in, out] -> uint8 [..., in/2, out]
@@ -151,7 +168,8 @@ def quantize_expert_stacked(w: jnp.ndarray, bits: int = 8) -> Weight:
 
 
 def weighted_einsum(
-    subscripts: str, x: jnp.ndarray, w: Weight, preferred_element_type=None
+    subscripts: str, x: jnp.ndarray, w: Weight, preferred_element_type=None,
+    int4_kernel: bool = False,
 ) -> jnp.ndarray:
     """einsum that accepts plain or quantized weights.
 
@@ -170,6 +188,14 @@ def weighted_einsum(
     )
     out_dtype = preferred_element_type or x.dtype
     if isinstance(w, PackedQTensor):
+        if int4_kernel and _use_int4_kernel(subscripts, w):
+            from vgate_tpu.ops.pallas.quant_matmul import (
+                int4_matmul_pallas,
+            )
+
+            return int4_matmul_pallas(
+                x, w.q_packed, w.scale, out_dtype=out_dtype
+            )
         out = packed_einsum(
             subscripts, x, w, preferred_element_type=preferred_element_type
         )

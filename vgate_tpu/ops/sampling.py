@@ -121,6 +121,7 @@ def verify_and_sample(
     seeds: jnp.ndarray | None = None,  # [R] int32, -1 => unseeded
     steps: jnp.ndarray | None = None,  # [R] int32 per-seq sample index
     num_top: int = 0,
+    all_greedy: bool = False,  # static: every row is temperature 0
 ):
     """Distribution-preserving speculative verification (rejection
     sampling with a deterministic proposal).
@@ -150,6 +151,11 @@ def verify_and_sample(
     [R, num_top])`` when ``num_top > 0`` else None.
     """
     R, V = logits.shape
+    if all_greedy and num_top == 0:
+        # one-pass argmax verification: accept iff the draft IS the
+        # argmax (same semantics as the general greedy branch below)
+        am = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return am, (am == draft_next) & ~is_bonus, None
     top_vals, top_idx, masked = _masked_scaled(
         logits, temperature, top_p, top_k
     )
@@ -212,6 +218,7 @@ def sample_tokens(
     key: jax.Array,
     seeds: jnp.ndarray | None = None,  # [B] int32, -1 => unseeded
     steps: jnp.ndarray | None = None,  # [B] int32 per-seq sample index
+    all_greedy: bool = False,  # static: every row is temperature 0
 ) -> jnp.ndarray:
     """Sample one token per slot honoring per-slot params. Returns [B] int32.
 
@@ -223,7 +230,14 @@ def sample_tokens(
     vgate/backends/vllm_backend.py:39-46).  Unseeded slots fold the slot
     index into the engine's step key.  ``key`` must be a legacy uint32[2]
     key (``jax.random.PRNGKey``) so keys can be selected with ``where``.
+
+    ``all_greedy`` (a STATIC flag the engine sets when every active
+    request has temperature 0) takes a one-pass argmax instead of the
+    top-``TRUNC`` ``lax.top_k`` — on TPU the top-k over a ~150k vocab
+    lowers to an expensive sort, pure waste when nothing samples.
     """
+    if all_greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     _top_vals, top_idx, pos = _topk_and_pos(
         logits, temperature, top_p, top_k, key, seeds, steps
     )

@@ -86,7 +86,9 @@ _DTYPES = {
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "mesh", "use_pallas", "num_logprobs"),
+    static_argnames=(
+        "spec", "mesh", "use_pallas", "num_logprobs", "all_greedy"
+    ),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _prefill_step(
@@ -94,7 +96,7 @@ def _prefill_step(
     page_tables, temps, top_ps, top_ks, key, mesh=None, use_pallas=False,
     seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None,
+    min_toks=None, stop_id_mat=None, all_greedy: bool = False,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
@@ -113,14 +115,15 @@ def _prefill_step(
         )
         return (next_tokens, (lp, tids, tlps)), k_pages, v_pages
     next_tokens = sample_tokens(
-        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
+        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
+        all_greedy=all_greedy,
     )
     return (next_tokens, None), k_pages, v_pages
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "num_logprobs"),
+    static_argnames=("spec", "num_logprobs", "all_greedy"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _suffix_prefill_step(
@@ -128,7 +131,7 @@ def _suffix_prefill_step(
     v_pages, suffix_page_tables, ctx_page_tables, temps, top_ps, top_ks,
     key, seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None,
+    min_toks=None, stop_id_mat=None, all_greedy: bool = False,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
@@ -147,7 +150,8 @@ def _suffix_prefill_step(
         )
         return (next_tokens, (lp, tids, tlps)), k_pages, v_pages
     next_tokens = sample_tokens(
-        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
+        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
+        all_greedy=all_greedy,
     )
     return (next_tokens, None), k_pages, v_pages
 
@@ -173,7 +177,7 @@ def _decode_step(
 @functools.partial(
     jax.jit,
     static_argnames=("spec", "num_steps", "use_pallas", "max_position",
-                     "mesh", "num_logprobs"),
+                     "mesh", "num_logprobs", "all_greedy"),
     donate_argnames=("k_pages", "v_pages", "counts"),
 )
 def _decode_chunk(
@@ -182,7 +186,7 @@ def _decode_chunk(
     num_steps: int = 1, use_pallas=False, max_position: int = 0,
     seeds=None, steps=None, mesh=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None,
+    min_toks=None, stop_id_mat=None, all_greedy: bool = False,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -222,7 +226,8 @@ def _decode_chunk(
             ys = (next_tokens, lp, tids, tlps)
         else:
             next_tokens = sample_tokens(
-                logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
+                logits, temps, top_ps, top_ks, key, seeds=seeds,
+                steps=steps, all_greedy=all_greedy,
             )
             ys = (next_tokens,)
         positions = positions + active.astype(positions.dtype)
@@ -260,7 +265,7 @@ def _decode_chunk(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "use_pallas", "num_logprobs"),
+    static_argnames=("spec", "use_pallas", "num_logprobs", "all_greedy"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _spec_verify_step(
@@ -268,7 +273,7 @@ def _spec_verify_step(
     v_pages, page_tables, active, temps, top_ps, top_ks, base_key, counter,
     seeds=None, steps=None, use_pallas=False, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None,
+    min_toks=None, stop_id_mat=None, all_greedy: bool = False,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), then verify every
@@ -335,6 +340,7 @@ def _spec_verify_step(
         seeds=None if seeds is None else rep(seeds),
         steps=steps_flat,
         num_top=num_logprobs,
+        all_greedy=all_greedy,
     )
     model_toks = flat_toks.reshape(B, S)
     if num_logprobs > 0:
@@ -549,6 +555,21 @@ class EngineCore:
             tpu_cfg.use_pallas
             and self.mesh.devices.flat[0].platform == "tpu"
         )
+        if self.config.model.quantization == "int4":
+            import dataclasses
+
+            # the fused dequant kernel doesn't auto-partition under jit
+            # sharding; model-parallel meshes keep the jnp einsum path.
+            # Threaded on the spec (a static jit arg) so engines with
+            # different meshes in one process never share the setting.
+            self.spec = dataclasses.replace(
+                self.spec,
+                int4_kernel=self.use_pallas
+                and all(
+                    int(self.mesh.shape.get(a, 1)) == 1
+                    for a in ("tp", "pp", "sp", "ep")
+                ),
+            )
         self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
         self._wakeup = threading.Event()
         self._running = False
@@ -960,9 +981,12 @@ class EngineCore:
             if any(p.seq.params.logprobs for p in plans)
             else 0
         )
+        all_greedy = num_lp == 0 and all(
+            p.seq.params.temperature == 0.0 for p in plans
+        )
         key = (
             bucket, B, pen_counts is not None,
-            None if mt is None else mt_ids.shape[1], num_lp,
+            None if mt is None else mt_ids.shape[1], num_lp, all_greedy,
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -989,6 +1013,7 @@ class EngineCore:
             pres_pens=pen_pres,
             min_toks=mt,
             stop_id_mat=mt_ids,
+            all_greedy=all_greedy,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1049,9 +1074,12 @@ class EngineCore:
             if any(p.seq.params.logprobs for p in plans)
             else 0
         )
+        all_greedy = num_lp == 0 and all(
+            p.seq.params.temperature == 0.0 for p in plans
+        )
         key = (
             "suffix", bucket, B, ctx_pages, pen_counts is not None,
-            None if mt is None else mt_ids.shape[1], num_lp,
+            None if mt is None else mt_ids.shape[1], num_lp, all_greedy,
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -1078,6 +1106,7 @@ class EngineCore:
             pres_pens=pen_pres,
             min_toks=mt,
             stop_id_mat=mt_ids,
+            all_greedy=all_greedy,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1187,21 +1216,27 @@ class EngineCore:
 
     def _dispatch_chunk(self, active: List[Sequence], chunk: int) -> None:
         state = self._dec_state
+        num_lp = (
+            LOGPROBS_K
+            if any(s.params.logprobs for s in active)
+            else 0
+        )
+        all_greedy = num_lp == 0 and all(
+            s.params.temperature == 0.0 for s in active
+        )
         chunk_key = (
             chunk,
             state["counts"] is not None,
             None
             if state["min_toks"] is None
             else state["stop_id_mat"].shape[1],
-            LOGPROBS_K
-            if any(s.params.logprobs for s in active)
-            else 0,
+            num_lp,
+            all_greedy,
         )
         if chunk_key not in self._compiled_chunks:
             metrics.RECOMPILES.labels(kind="decode").inc()
             self._compiled_chunks.add(chunk_key)
         start = time.perf_counter()
-        num_lp = chunk_key[-1]
         (
             chunk_tokens,
             chunk_lp,
@@ -1238,6 +1273,7 @@ class EngineCore:
             pres_pens=state["pres_pens"],
             min_toks=state["min_toks"],
             stop_id_mat=state["stop_id_mat"],
+            all_greedy=all_greedy,
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -1413,6 +1449,9 @@ class EngineCore:
             if any(s.params.logprobs for s in active)
             else 0
         )
+        all_greedy = num_lp == 0 and all(
+            s.params.temperature == 0.0 for s in active
+        )
         (
             model_toks, accepted, lp_data, counts_out,
             self.k_pages, self.v_pages,
@@ -1447,6 +1486,7 @@ class EngineCore:
                 ),
                 min_toks=spec_mt,
                 stop_id_mat=spec_mt_ids,
+                all_greedy=all_greedy,
             )
         )
         if want_pen:
