@@ -254,6 +254,13 @@ def main() -> None:
                 x.size * x.dtype.itemsize
                 for x in jax.tree.leaves(core.params)
             )
+            if not core.spec.tie_embeddings:
+                # an untied embed table is GATHERED (one row per token),
+                # not streamed; only tied models read it fully as lm_head
+                weight_bytes -= sum(
+                    x.size * x.dtype.itemsize
+                    for x in jax.tree.leaves(core.params["embed"])
+                )
             # steps/s at effective concurrency; roofline steps/s =
             # HBM_BW / weight_bytes (KV traffic excluded: optimistic)
             occupancy = min(slots, n_requests)

@@ -98,6 +98,135 @@ _WORKER = textwrap.dedent(
 )
 
 
+_TP_WORKER = textwrap.dedent(
+    """
+    import functools, os, sys
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from vgate_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert jax.process_count() == 2
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from vgate_tpu.models.decoder import decode_forward, init_params
+    from vgate_tpu.models.specs import TINY_DENSE as spec
+    from vgate_tpu.parallel.mesh import MESH_AXES
+    from vgate_tpu.parallel.sharding import kv_pspec, named, shard_params
+
+    # tp axis strides ACROSS the two processes: global order is
+    # [p0d0, p0d1, p1d0, p1d1]; transposing makes each tp pair
+    # (p0di, p1di), so every tp collective crosses the gloo transport.
+    devs = np.array(jax.devices()).reshape(2, 2).T
+    mesh = Mesh(devs.reshape(2, 1, 1, 1, 2), MESH_AXES)  # dp=2, tp=2
+
+    params = init_params(spec, jax.random.PRNGKey(0), jnp.float32)
+    sharded = shard_params(params, spec, mesh)
+
+    B, ps, pages_per_seq = 2, 4, 4
+    P_pages = 1 + B * pages_per_seq
+    kv_shape = (
+        spec.num_layers, spec.num_kv_heads, P_pages, ps, spec.head_dim
+    )
+    kv_shard = named(mesh, kv_pspec(spec, mesh))
+    repl = NamedSharding(mesh, P())
+
+    def put(x):
+        return jax.device_put(x, repl)
+
+    k_pages = jax.device_put(jnp.zeros(kv_shape, jnp.float32), kv_shard)
+    v_pages = jax.device_put(jnp.zeros(kv_shape, jnp.float32), kv_shard)
+    page_tables = put(
+        jnp.asarray(
+            1 + np.arange(B * pages_per_seq).reshape(B, pages_per_seq),
+            jnp.int32,
+        )
+    )
+    tokens = put(jnp.asarray([7, 11], jnp.int32))
+    positions = put(jnp.asarray([3, 5], jnp.int32))
+    active = put(jnp.ones((B,), bool))
+
+    @jax.jit
+    def sharded_step(p, t, pos, kp, vp, pt, a):
+        logits, kp, vp = decode_forward(p, spec, t, pos, kp, vp, pt, active=a)
+        return jax.lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P())
+        )
+
+    got = np.asarray(
+        sharded_step(
+            sharded, tokens, positions, k_pages, v_pages, page_tables,
+            active,
+        )
+    )
+
+    # single-device local oracle (no mesh, unsharded)
+    ref, _, _ = decode_forward(
+        params, spec, jnp.asarray([7, 11], jnp.int32),
+        jnp.asarray([3, 5], jnp.int32),
+        jnp.zeros(kv_shape, jnp.float32), jnp.zeros(kv_shape, jnp.float32),
+        jnp.asarray(
+            1 + np.arange(B * pages_per_seq).reshape(B, pages_per_seq),
+            jnp.int32,
+        ),
+        active=jnp.ones((B,), bool),
+    )
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4, atol=1e-4)
+    print(f"TP_DECODE_OK pid={pid} argmax={np.argmax(got, -1).tolist()}")
+    """
+)
+
+
+def test_two_process_tp_sharded_decode_step(tmp_path):
+    """The VERDICT r2 next-9 gap: not just a bare psum, but the engine's
+    own decode_forward running tp=2-sharded ACROSS two gloo processes
+    (2 virtual CPU devices each), logits pinned to the single-device
+    oracle.  This is the numerical core of multi-host serving: Megatron
+    pspecs + XLA-inserted cross-process collectives through the real
+    model code path (KV page write + paged attention + lm_head)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = tmp_path / "tp_worker.py"
+    worker.write_text(_TP_WORKER)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo_root,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+        assert "TP_DECODE_OK" in out
+
+
 def test_two_process_cpu_distributed_psum(tmp_path):
     """Two real processes join one jax.distributed coordinator and run a
     cross-process psum over the global device mesh."""

@@ -86,9 +86,7 @@ _DTYPES = {
 
 @functools.partial(
     jax.jit,
-    static_argnames=(
-        "spec", "mesh", "use_pallas", "num_logprobs", "all_greedy"
-    ),
+    static_argnames=("spec", "mesh", "use_pallas", "num_logprobs"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _prefill_step(
@@ -96,7 +94,7 @@ def _prefill_step(
     page_tables, temps, top_ps, top_ks, key, mesh=None, use_pallas=False,
     seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None, all_greedy: bool = False,
+    min_toks=None, stop_id_mat=None,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
@@ -114,16 +112,18 @@ def _prefill_step(
             num_top=num_logprobs,
         )
         return (next_tokens, (lp, tids, tlps)), k_pages, v_pages
+    # NOTE: no all_greedy fast path in prefill programs — one sample per
+    # PROMPT makes the top-k cost negligible, and skipping the variant
+    # split halves the (expensive) batched-prefill compile ladder
     next_tokens = sample_tokens(
-        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
-        all_greedy=all_greedy,
+        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
     )
     return (next_tokens, None), k_pages, v_pages
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "num_logprobs", "all_greedy"),
+    static_argnames=("spec", "num_logprobs"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _suffix_prefill_step(
@@ -131,7 +131,7 @@ def _suffix_prefill_step(
     v_pages, suffix_page_tables, ctx_page_tables, temps, top_ps, top_ks,
     key, seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None, all_greedy: bool = False,
+    min_toks=None, stop_id_mat=None,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
@@ -150,8 +150,7 @@ def _suffix_prefill_step(
         )
         return (next_tokens, (lp, tids, tlps)), k_pages, v_pages
     next_tokens = sample_tokens(
-        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps,
-        all_greedy=all_greedy,
+        logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
     )
     return (next_tokens, None), k_pages, v_pages
 
@@ -781,6 +780,15 @@ class EngineCore:
             if s.status is SeqStatus.RUNNING
         ]
 
+    @staticmethod
+    def _all_greedy(seqs, num_lp: int) -> bool:
+        """Static all-greedy program-variant predicate, shared by the
+        decode-chunk and spec-verify dispatches (one definition so the
+        compile-cache split can never diverge between paths)."""
+        return num_lp == 0 and all(
+            s.params.temperature == 0.0 for s in seqs
+        )
+
     # ------------------------------------------------------------- prefill
 
     def _drain_submissions(self) -> None:
@@ -981,12 +989,9 @@ class EngineCore:
             if any(p.seq.params.logprobs for p in plans)
             else 0
         )
-        all_greedy = num_lp == 0 and all(
-            p.seq.params.temperature == 0.0 for p in plans
-        )
         key = (
             bucket, B, pen_counts is not None,
-            None if mt is None else mt_ids.shape[1], num_lp, all_greedy,
+            None if mt is None else mt_ids.shape[1], num_lp,
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -1013,7 +1018,6 @@ class EngineCore:
             pres_pens=pen_pres,
             min_toks=mt,
             stop_id_mat=mt_ids,
-            all_greedy=all_greedy,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1074,12 +1078,9 @@ class EngineCore:
             if any(p.seq.params.logprobs for p in plans)
             else 0
         )
-        all_greedy = num_lp == 0 and all(
-            p.seq.params.temperature == 0.0 for p in plans
-        )
         key = (
             "suffix", bucket, B, ctx_pages, pen_counts is not None,
-            None if mt is None else mt_ids.shape[1], num_lp, all_greedy,
+            None if mt is None else mt_ids.shape[1], num_lp,
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -1106,7 +1107,6 @@ class EngineCore:
             pres_pens=pen_pres,
             min_toks=mt,
             stop_id_mat=mt_ids,
-            all_greedy=all_greedy,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1221,9 +1221,7 @@ class EngineCore:
             if any(s.params.logprobs for s in active)
             else 0
         )
-        all_greedy = num_lp == 0 and all(
-            s.params.temperature == 0.0 for s in active
-        )
+        all_greedy = self._all_greedy(active, num_lp)
         chunk_key = (
             chunk,
             state["counts"] is not None,
@@ -1449,9 +1447,7 @@ class EngineCore:
             if any(s.params.logprobs for s in active)
             else 0
         )
-        all_greedy = num_lp == 0 and all(
-            s.params.temperature == 0.0 for s in active
-        )
+        all_greedy = self._all_greedy(active, num_lp)
         (
             model_toks, accepted, lp_data, counts_out,
             self.k_pages, self.v_pages,
@@ -1669,12 +1665,22 @@ class EngineCore:
         ladder = SamplingParams(
             max_tokens=max(1, 2 * self.decode_chunk), temperature=0.0
         )
+        # the decode-chunk/spec-verify programs split on all_greedy; a
+        # second sampled ladder walk compiles those variants so the
+        # first temperature>0 request doesn't pay them at serve time
+        # (prefill programs don't split, so one bucket walk suffices)
+        ladder_sampled = SamplingParams(
+            max_tokens=max(1, 2 * self.decode_chunk), temperature=0.7
+        )
         single = SamplingParams(max_tokens=1, temperature=0.0)
         buckets = buckets or [self.scheduler.prefill_buckets[0]]
         for i, bucket in enumerate(buckets):
             n = max(1, min(bucket - 1, 8))
             seq = self.submit_tokens([5] * n, ladder if i == 0 else single)
             seq.done_event.wait(timeout=600)
+            if i == 0:
+                seq = self.submit_tokens([5] * n, ladder_sampled)
+                seq.done_event.wait(timeout=600)
             if i == 0:
                 B = max(1, self.config.tpu.prefill_batch_max)
                 while B >= 2:
