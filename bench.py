@@ -153,12 +153,18 @@ def main() -> None:
             "VGT_BENCH_MODEL", "Qwen/Qwen2.5-1.5B-Instruct"
         )
         dtype = "bfloat16"
-        n_requests, prompt_len, max_tokens = 128, 120, 128
-        # tunables (VGT_BENCH_* env for sweeps; defaults are the tuned best)
+        # tunables (VGT_BENCH_* env for sweeps; defaults are the tuned
+        # best for the 1.5B serving shape).  Long-context runs override
+        # e.g. CTX=8192 PROMPT=7900 MAXTOK=128 REQUESTS=8 SLOTS=8;
+        # 7B runs override MODEL + QUANT=int8.
+        n_requests = int(os.environ.get("VGT_BENCH_REQUESTS", 128))
+        prompt_len = int(os.environ.get("VGT_BENCH_PROMPT", 120))
+        max_tokens = int(os.environ.get("VGT_BENCH_MAXTOK", 128))
         slots = int(os.environ.get("VGT_BENCH_SLOTS", 128))
         kv_pages = 0  # auto-size from HBM
-        buckets = [128]
-        max_model_len = 512  # covers prompt+output; keeps page tables tight
+        max_model_len = int(os.environ.get("VGT_BENCH_CTX", 512))
+        # one prefill bucket: the smallest power of two >= the prompt
+        buckets = [max(128, 1 << (prompt_len - 1).bit_length())]
         decode_chunk = int(os.environ.get("VGT_BENCH_CHUNK", 64))
     else:  # CI smoke fallback
         model_id = "tiny-dense"

@@ -56,6 +56,10 @@ SLOW_FILES = {
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        if item.get_closest_marker("slow") or item.get_closest_marker(
+            "fast"
+        ):
+            continue  # explicit per-test tier wins over the file default
         tier = "slow" if item.module.__name__ in SLOW_FILES else "fast"
         item.add_marker(getattr(pytest.mark, tier))
 

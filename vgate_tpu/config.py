@@ -37,7 +37,13 @@ def apply_platform(tpu_cfg) -> None:
         return
     import jax
 
-    jax.config.update("jax_platforms", tpu_cfg.platform)
+    # keep the cpu backend registered behind the pinned platform: the
+    # quantized-load host staging (engine_core) needs jax.devices("cpu")
+    # even when the compute platform is tpu
+    platforms = tpu_cfg.platform
+    if platforms != "cpu" and "cpu" not in platforms.split(","):
+        platforms = f"{platforms},cpu"
+    jax.config.update("jax_platforms", platforms)
     actual = jax.devices()[0].platform
     if actual != tpu_cfg.platform:
         raise RuntimeError(

@@ -393,21 +393,56 @@ class EngineCore:
         )
 
         load_start = time.perf_counter()
-        if params is None:
-            params = load_or_init_params(
-                self.spec, self.config.model.checkpoint_path, self.dtype
-            )
-        self.params = shard_params(params, self.spec, self.mesh)
-        if self.config.model.quantization in ("int8", "int4"):
+        quant = self.config.model.quantization
+        quant_bits = int(quant[3:]) if quant in ("int8", "int4") else None
+        # Single-device quantized loads stage on the HOST: a 7B-class
+        # model's bf16 tree (~15 GB) would OOM a 16 GB chip before
+        # quantization could ever run, so init/load and quantize on the
+        # CPU backend and place only the narrow-int tree (the same shape
+        # a real AWQ-style pre-quantized load has).  Multi-device meshes
+        # keep the place-then-quantize order so the eager quantize ops
+        # run SPMD and scales inherit the tp layout.
+        host_stage = None
+        if quant_bits and self.mesh.devices.size == 1:
+            try:
+                host_stage = jax.devices("cpu")[0]
+            except RuntimeError:  # pragma: no cover - cpu backend absent
+                host_stage = None
+                logger.warning(
+                    "no cpu backend for host-staged quantized load; "
+                    "falling back to on-device quantization (a 7B-class "
+                    "bf16 tree may OOM the chip) — pin tpu.platform so "
+                    "apply_platform keeps cpu registered"
+                )
+        if host_stage is not None:
             from vgate_tpu.ops.quant import quantize_decoder_params
 
-            # quantize after sharding: the eager ops run SPMD on the mesh,
-            # so scales inherit the weights' tp layout
-            self.params = quantize_decoder_params(
-                self.params,
-                self.spec,
-                bits=int(self.config.model.quantization[3:]),
+            with jax.default_device(host_stage):
+                if params is None:
+                    params = load_or_init_params(
+                        self.spec,
+                        self.config.model.checkpoint_path,
+                        self.dtype,
+                    )
+                params = quantize_decoder_params(
+                    params, self.spec, bits=quant_bits
+                )
+            device = self.mesh.devices.flat[0]
+            self.params = jax.tree.map(
+                lambda x: jax.device_put(x, device), params
             )
+        else:
+            if params is None:
+                params = load_or_init_params(
+                    self.spec, self.config.model.checkpoint_path, self.dtype
+                )
+            self.params = shard_params(params, self.spec, self.mesh)
+            if quant_bits:
+                from vgate_tpu.ops.quant import quantize_decoder_params
+
+                self.params = quantize_decoder_params(
+                    self.params, self.spec, bits=quant_bits
+                )
         jax.block_until_ready(jax.tree.leaves(self.params)[0])
         self.load_time_s = time.perf_counter() - load_start
 
