@@ -162,6 +162,10 @@ def main() -> None:
         max_tokens = int(os.environ.get("VGT_BENCH_MAXTOK", 128))
         slots = int(os.environ.get("VGT_BENCH_SLOTS", 128))
         kv_pages = 0  # auto-size from HBM
+        # page size trades paged-KV granularity against DMA width: a
+        # 16-token page is a 4 KB transfer per kv head — small for HBM;
+        # 32/64 halve/quarter the per-page overhead (VGT_BENCH_PAGE sweeps)
+        page_size = int(os.environ.get("VGT_BENCH_PAGE", 16))
         max_model_len = int(os.environ.get("VGT_BENCH_CTX", 512))
         # one prefill bucket: the smallest power of two >= the prompt
         buckets = [max(128, 1 << (prompt_len - 1).bit_length())]
@@ -192,7 +196,7 @@ def main() -> None:
             "sp": 1,
             "num_devices": 1,
             "kv_num_pages": kv_pages,
-            "kv_page_size": 16 if on_accelerator else 4,
+            "kv_page_size": page_size if on_accelerator else 4,
             "max_batch_slots": slots,
             "prefill_buckets": buckets,
             # 32 measured best on v5e (2646 tok/s, TTFT 406 ms): 4 prefill

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Single-prober TPU watch: every CYCLE seconds, run `python bench.py`
+# (whose subprocess probe is wedge-aware and never hangs the parent).
+# On the first platform:"tpu" result: append the JSON line to
+# benchmarks/RESULTS_r3.md, save it as BENCH_r03_candidate.json, and
+# STOP — further exploration is interactive.  A lockfile keeps this the
+# only TPU toucher; remove the lockfile to let manual runs take over.
+set -u
+cd "$(dirname "$0")/.."
+LOCK=/tmp/vgt_tpu.lock
+CYCLE=${CYCLE:-1800}
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "lock $LOCK held; another TPU job is running" >&2
+  exit 1
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+
+for attempt in $(seq 1 40); do
+  echo "[tpu_watch] attempt $attempt at $(date -u +%H:%M:%S)" >&2
+  out=$(python bench.py 2>/dev/null | tail -1)
+  echo "$out" >> /tmp/vgt_tpu_watch.jsonl
+  if echo "$out" | grep -q '"platform": "tpu"'; then
+    {
+      echo ""
+      echo "## tpu_watch first healthy-grant bench ($(date -u +%FT%TZ))"
+      echo ""
+      echo '```'
+      echo "$out"
+      echo '```'
+    } >> benchmarks/RESULTS_r3.md
+    echo "$out" > BENCH_r03_candidate.json
+    echo "[tpu_watch] TPU HEALTHY — recorded and stopping" >&2
+    exit 0
+  fi
+  sleep "$CYCLE"
+done
+echo "[tpu_watch] gave up after 40 cycles" >&2
+exit 2

@@ -11,7 +11,7 @@ two); the kernel's advantage is the memory path:
   for short sequences;
 * this kernel DMAs **only the live pages** of each sequence directly from the
   HBM page pool into VMEM, double-buffered in chunks of
-  ``CHUNK_PAGES`` pages (128 tokens), and runs an online-softmax
+  ``CHUNK_PAGES`` pages, and runs an online-softmax
   accumulation entirely in VMEM — no gathered copy, no dead-token traffic.
 
 Grid: one program per (slot, kv_head); each program serves the G = H/KV
@@ -21,6 +21,7 @@ query heads of that group (GQA).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +30,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from vgate_tpu.utils.math import cdiv
 
-CHUNK_PAGES = 8  # pages DMA'd per double-buffer slot
+# pages DMA'd per double-buffer slot (VGT_CHUNK_PAGES sweeps on-device:
+# wider chunks amortize per-page DMA issue overhead for long contexts)
+CHUNK_PAGES = int(os.environ.get("VGT_CHUNK_PAGES", 8))
+if CHUNK_PAGES <= 0:
+    raise ValueError(
+        f"VGT_CHUNK_PAGES must be a positive integer, got {CHUNK_PAGES}"
+    )
 
 
 

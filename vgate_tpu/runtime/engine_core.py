@@ -427,9 +427,8 @@ class EngineCore:
                 params = quantize_decoder_params(
                     params, self.spec, bits=quant_bits
                 )
-            device = self.mesh.devices.flat[0]
-            self.params = jax.tree.map(
-                lambda x: jax.device_put(x, device), params
+            self.params = jax.device_put(
+                params, self.mesh.devices.flat[0]
             )
         else:
             if params is None:
