@@ -90,6 +90,64 @@ def test_ring_sliding_window_softcap_scale(sp):
     )
 
 
+@pytest.mark.parametrize("sp", [2, 4])
+def test_sp_decode_attention_matches_oracle(sp):
+    """sp-sharded decode attention (parallel/sp_decode.py): partial
+    flash attention per pool shard + LSE merge must equal the
+    single-device paged oracle, with the token write landing on the
+    owning shard and every other shard writing its local trash."""
+    from vgate_tpu.ops.attention import paged_decode_attention
+    from vgate_tpu.parallel.sp_decode import sp_decode_attention_and_write
+
+    rng = np.random.default_rng(31 + sp)
+    B, H, KV, hd, ps = 3, 4, 2, 32, 4
+    P = 16 * sp  # divisible pool with room for 3x6 distinct pages
+    pages_per_seq = 6
+    k_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    # page tables draw from NON-reserved ids spread across shards
+    shard = P // sp
+    reserved = {i * shard for i in range(sp)}
+    candidates = [p for p in range(P) if p not in reserved]
+    pt = jnp.asarray(
+        rng.choice(candidates, size=(B, pages_per_seq), replace=False),
+        jnp.int32,
+    )
+    positions = jnp.asarray([5, 11, 21], jnp.int32)
+    seq_lens = positions + 1
+    page_ids = pt[jnp.arange(B), positions // ps]
+    page_off = positions % ps
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    k_t = jnp.asarray(rng.normal(size=(B, KV, hd)), jnp.float32)
+    v_t = jnp.asarray(rng.normal(size=(B, KV, hd)), jnp.float32)
+
+    # oracle: plain write + single-device paged attention
+    ko = k_pages.at[:, page_ids, page_off].set(
+        jnp.transpose(k_t, (1, 0, 2))
+    )
+    vo = v_pages.at[:, page_ids, page_off].set(
+        jnp.transpose(v_t, (1, 0, 2))
+    )
+    expect = paged_decode_attention(q, ko, vo, pt, seq_lens)
+
+    got, k_out, v_out = sp_decode_attention_and_write(
+        q, k_t, v_t, k_pages, v_pages, page_ids, page_off, pt, seq_lens,
+        sp_mesh(sp),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+    # the owning shard's page holds the token; non-reserved other pages
+    # are untouched
+    for b in range(B):
+        gp, off = int(page_ids[b]), int(page_off[b])
+        np.testing.assert_allclose(
+            np.asarray(k_out[:, gp, off]),
+            np.asarray(k_t[b].astype(jnp.float32)),
+            rtol=1e-6, atol=1e-6,
+        )
+
+
 def test_ring_rejects_indivisible_seq():
     mesh = sp_mesh(4)
     q = jnp.zeros((1, 30, 4, 16))

@@ -421,11 +421,13 @@ def prefill_layer(
 
 def decode_layer(
     h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, positions, page_ids,
-    page_off, page_tables, seq_lens, attn_fn, window=None,
+    page_off, page_tables, seq_lens, attn_fn, window=None, sp_mesh=None,
 ):
     """One transformer layer of the decode step (shared by the plain scan
     path below and the pipeline-parallel stage scan,
-    parallel/pipeline.py)."""
+    parallel/pipeline.py).  With ``sp_mesh`` the KV write and attention
+    run sequence-parallel over the sp-sharded page pool
+    (parallel/sp_decode.py) — the long-context decode path."""
     normed = rms_norm(
         h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
     )
@@ -438,6 +440,17 @@ def decode_layer(
         k[:, None], positions[:, None], spec.rope_theta,
         spec.rope_scaling,
     )[:, 0]
+    if sp_mesh is not None:
+        from vgate_tpu.parallel.sp_decode import (
+            sp_decode_attention_and_write,
+        )
+
+        attn, k_pages_l, v_pages_l = sp_decode_attention_and_write(
+            q, k, v, k_pages_l, v_pages_l, page_ids, page_off,
+            page_tables, seq_lens, sp_mesh, window=window,
+            softcap=spec.attn_softcap, scale=_query_scale(spec),
+        )
+        return _finish_layer(h, attn, lp, spec), k_pages_l, v_pages_l
     k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
         jnp.transpose(k, (1, 0, 2))
     )
@@ -491,6 +504,37 @@ def decode_forward(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active=active, mesh=mesh, use_pallas=use_pallas,
         )
+    sp_mesh = (
+        mesh
+        if mesh is not None and mesh.shape.get("sp", 1) > 1
+        else None
+    )
+    if sp_mesh is not None:
+        # sequence-parallel decode: attention + KV write run per-shard
+        # over the sp-sharded page pool (parallel/sp_decode.py)
+        ps = k_pages.shape[3]
+        seq_lens, page_ids, page_off = decode_attn_inputs(
+            positions, page_tables, active, ps
+        )
+        x = _embed(params, spec, tokens)  # [B, D]
+        windows = _layer_windows(spec)
+
+        def sp_layer_fn(h, per_layer):
+            lp, win, k_pages_l, v_pages_l = per_layer
+            h, k_pages_l, v_pages_l = decode_layer(
+                h, lp, k_pages_l, v_pages_l, spec=spec,
+                positions=positions, page_ids=page_ids,
+                page_off=page_off, page_tables=page_tables,
+                seq_lens=seq_lens, attn_fn=None,
+                window=win if spec.sliding_window > 0 else None,
+                sp_mesh=sp_mesh,
+            )
+            return h, (k_pages_l, v_pages_l)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            sp_layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+        )
+        return _logits(params, spec, x), k_pages, v_pages
     if use_pallas:
         # the decode kernel supports window/softcap/scale natively (and
         # skips DMA for pages below the window), so local-attention

@@ -19,7 +19,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from vgate_tpu.models.specs import ModelSpec
-from vgate_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_TP
+from vgate_tpu.parallel.mesh import AXIS_EP, AXIS_PP, AXIS_SP, AXIS_TP
 
 
 def _spec(mesh: Mesh, dims, *axes) -> P:
@@ -83,21 +83,28 @@ def param_pspecs(spec: ModelSpec, mesh: Mesh) -> Dict[str, Any]:
     return pspecs
 
 
-def kv_pspec(spec: ModelSpec, mesh: Mesh) -> P:
+def kv_pspec(
+    spec: ModelSpec, mesh: Mesh, num_pages: int = 0
+) -> P:
     """KV pages [L, KV, P, page, hd]: layers shard over pp (each stage
-    holds its own layers' pages), KV heads over tp when divisible."""
+    holds its own layers' pages), KV heads over tp when divisible, and —
+    when the caller passes a pool size divisible by sp — the page POOL
+    over sp (parallel/sp_decode.py: per-chip KV capacity scales with sp,
+    the long-context decode path)."""
     return _spec(
         mesh,
         (
             spec.num_layers,
             spec.num_kv_heads,
-            1 << 30,  # page count always divisible-agnostic -> never sharded
+            # pool shards over sp only for an explicitly divisible size
+            # (callers that don't size for sp pass 0 -> replicated)
+            num_pages if num_pages else 1,
             1 << 30,
             spec.head_dim,
         ),
         AXIS_PP,
         AXIS_TP,
-        None,
+        AXIS_SP,
         None,
         None,
     )

@@ -453,7 +453,10 @@ class EngineCore:
         pages_per_seq = cdiv(
             self.config.model.max_model_len, tpu_cfg.kv_page_size
         )
-        max_useful = tpu_cfg.max_batch_slots * pages_per_seq + 1
+        sp_shards = int(self.mesh.shape.get("sp", 1))
+        max_useful = (
+            tpu_cfg.max_batch_slots * pages_per_seq + sp_shards
+        )
         num_pages = tpu_cfg.kv_num_pages or min(
             max_useful,
             auto_num_pages(
@@ -466,6 +469,11 @@ class EngineCore:
                 hbm_bytes=tpu_cfg.hbm_bytes,
             ),
         )
+        if sp_shards > 1:
+            # the pool shards contiguously over sp (parallel/sp_decode.py);
+            # round UP so the computed capacity is preserved (at most
+            # sp-1 extra pages, noise next to the pool)
+            num_pages = num_pages + (-num_pages) % sp_shards
         self.geometry = KVGeometry(
             num_layers=self.spec.num_layers,
             num_pages=num_pages,
@@ -474,12 +482,15 @@ class EngineCore:
             head_dim=self.spec.head_dim,
             max_model_len=self.config.model.max_model_len,
             dtype_bytes=jnp.dtype(self.dtype).itemsize,
+            num_reserved=sp_shards,
         )
-        kv_sharding = named(self.mesh, kv_pspec(self.spec, self.mesh))
+        kv_sharding = named(
+            self.mesh, kv_pspec(self.spec, self.mesh, num_pages)
+        )
         self.k_pages, self.v_pages = make_kv_buffers(
             self.geometry, self.dtype, kv_sharding
         )
-        self.allocator = PageAllocator(num_pages)
+        self.allocator = PageAllocator(num_pages, num_shards=sp_shards)
         self.max_slots = tpu_cfg.max_batch_slots
         # prefix caching requires the plain-scan suffix prefill path; the
         # sp ring and pp relay reshape the prompt pass incompatibly
@@ -555,6 +566,7 @@ class EngineCore:
             self.mesh if (sp_size > 1 or pp_size > 1) else None
         )
         self._pp = pp_size
+        self._sp = sp_size
         if sp_size > 1:
             bad = [
                 b for b in self.scheduler.prefill_buckets if b % sp_size
@@ -577,6 +589,13 @@ class EngineCore:
             raise ValueError(
                 "speculative decoding is not supported with pp>1 (the "
                 "verify step has no pipeline-stage relay)"
+            )
+        if tpu_cfg.speculative_k > 0 and sp_size > 1:
+            raise ValueError(
+                "speculative decoding is not supported with sp>1 (the "
+                "multi-token verify step has no sp-sharded attention "
+                "path; chunked decode over the sp-sharded pool is the "
+                "long-context mode)"
             )
 
         # Pallas kernels require a real TPU backend (tests run interpret-mode
@@ -1298,7 +1317,11 @@ class EngineCore:
             max_position=self.config.model.max_model_len - 1,
             seeds=state["seeds"],
             steps=state["steps"],
-            mesh=self._fwd_mesh if self._pp > 1 else None,
+            mesh=(
+                self._fwd_mesh
+                if (self._pp > 1 or self._sp > 1)
+                else None
+            ),
             num_logprobs=num_lp,
             counts=state["counts"],
             freq_pens=state["freq_pens"],
@@ -1785,7 +1808,7 @@ class EngineCore:
             "prefills": self.total_prefills,
             "decode_tokens": self.total_decode_tokens,
             "state_rebuilds": self.total_state_rebuilds,
-            "kv_pages_total": self.geometry.num_pages - 1,
+            "kv_pages_total": self.allocator.num_allocatable,
             "kv_token_capacity": self.geometry.total_tokens,
             "model": self.spec.name,
             "mesh": {

@@ -40,12 +40,15 @@ def _page_bytes(
 @dataclass(frozen=True)
 class KVGeometry:
     num_layers: int
-    num_pages: int  # includes trash page 0
+    num_pages: int  # includes the reserved trash page(s)
     page_size: int
     kv_heads: int
     head_dim: int
     max_model_len: int
     dtype_bytes: int = 2  # bf16 default
+    # reserved trash pages: 1 normally, sp under sequence-parallel decode
+    # (one local trash per pool shard, parallel/sp_decode.py)
+    num_reserved: int = 1
 
     @property
     def pages_per_seq(self) -> int:
@@ -60,7 +63,7 @@ class KVGeometry:
 
     @property
     def total_tokens(self) -> int:
-        return (self.num_pages - 1) * self.page_size
+        return (self.num_pages - self.num_reserved) * self.page_size
 
 
 # Per-chip HBM when the runtime exposes no memory stats (TPU v5e class).
@@ -108,8 +111,14 @@ def auto_num_pages(
 
 
 class PageAllocator:
-    """Refcounting free-list allocator over page ids 1..num_pages-1 (0 is
-    trash), with a content-hash index for **automatic prefix caching**.
+    """Refcounting free-list allocator with a content-hash index for
+    **automatic prefix caching**.
+
+    Page 0 is the reserved trash page; with ``num_shards`` (sp) > 1 the
+    first page of each contiguous pool shard ``{i * num_pages/sp}`` is
+    reserved instead, so every sp shard has a LOCAL trash page
+    (parallel/sp_decode.py) — the degenerate num_shards=1 case reserves
+    exactly {0}.
 
     A page whose content corresponds to a full page of prompt tokens can be
     ``register``ed under a chain hash; a later prompt with the same prefix
@@ -120,9 +129,14 @@ class PageAllocator:
     reference can't reach because vLLM hides it; here it is first-party).
     """
 
-    def __init__(self, num_pages: int) -> None:
+    def __init__(self, num_pages: int, num_shards: int = 1) -> None:
+        from vgate_tpu.parallel.sp_decode import reserved_page_ids
+
         self.num_pages = num_pages
-        self._free: Deque[int] = deque(range(1, num_pages))
+        self.reserved = frozenset(reserved_page_ids(num_pages, num_shards))
+        self._free: Deque[int] = deque(
+            p for p in range(num_pages) if p not in self.reserved
+        )
         self._refs: Dict[int, int] = {}
         self._hash_to_page: Dict[int, int] = {}
         self._page_hash: Dict[int, int] = {}
@@ -130,8 +144,14 @@ class PageAllocator:
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
         self.prefix_hits = 0
         self.prefix_evictions = 0
-        metrics.KV_PAGES_TOTAL.set(num_pages - 1)
+        self._allocatable = num_pages - len(self.reserved)
+        metrics.KV_PAGES_TOTAL.set(self._allocatable)
         metrics.KV_PAGES_IN_USE.set(0)
+
+    @property
+    def num_allocatable(self) -> int:
+        """Total non-reserved pages (the pool size stats should report)."""
+        return self._allocatable
 
     @property
     def num_free(self) -> int:
@@ -140,7 +160,7 @@ class PageAllocator:
 
     @property
     def num_used(self) -> int:
-        return (self.num_pages - 1) - self.num_free
+        return self._allocatable - self.num_free
 
     @property
     def num_cached(self) -> int:
@@ -167,7 +187,7 @@ class PageAllocator:
 
     def release(self, pages: List[int]) -> None:
         for page in pages:
-            if not 1 <= page < self.num_pages:
+            if not 0 <= page < self.num_pages or page in self.reserved:
                 raise ValueError(f"bad page id {page}")
             refs = self._refs.get(page, 1) - 1
             if refs > 0:
