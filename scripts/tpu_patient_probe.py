@@ -1,0 +1,26 @@
+"""Patient TPU probe: wait for the grant WITHOUT ever killing a device
+process (a killed mid-init process is what wedges the axon grant —
+memory: tpu-grant-discipline).  Backend init simply blocks until the
+grant heals; when it does, write one status line and exit.  Run under
+nohup and poll the status file.
+"""
+
+import json
+import sys
+import time
+
+STATUS = sys.argv[1] if len(sys.argv) > 1 else "/tmp/vgt_tpu_status.json"
+
+start = time.time()
+import jax  # noqa: E402  (may block for a long time on a wedged grant)
+
+d = jax.devices()[0]
+result = {
+    "platform": d.platform,
+    "kind": getattr(d, "device_kind", "unknown"),
+    "wait_s": round(time.time() - start, 1),
+    "ts": time.strftime("%FT%TZ", time.gmtime()),
+}
+with open(STATUS, "w") as f:
+    f.write(json.dumps(result) + "\n")
+print(json.dumps(result))

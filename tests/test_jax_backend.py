@@ -176,3 +176,30 @@ async def test_stream_async_reports_finish_reason(backend):
     ):
         pass
     assert reasons == ["length"]
+
+
+def test_vllm_backend_selectable_and_fails_clearly_without_wheel():
+    """The optional comparison backend (reference: vLLM/SGLang side by
+    side in one bench table) is a first-class engine_type that fails
+    with an actionable error in images without a vllm wheel."""
+    import pytest
+
+    from vgate_tpu.config import load_config
+    from vgate_tpu.engine import _create_backend
+
+    backend = _create_backend("vllm")
+    assert type(backend).__name__ == "VLLMBackend"
+    cfg = load_config(
+        model={"engine_type": "vllm", "model_id": "tiny-dense"},
+        logging={"level": "WARNING"},
+    )
+    assert cfg.model.engine_type == "vllm"
+    try:
+        import vllm  # noqa: F401
+
+        has_vllm = True
+    except ImportError:
+        has_vllm = False
+    if not has_vllm:
+        with pytest.raises(RuntimeError, match="vllm"):
+            backend.load_model(cfg)

@@ -52,7 +52,10 @@ def apply_platform(tpu_cfg) -> None:
             "before any jax.devices()/device computation happens"
         )
 
-VALID_ENGINE_TYPES = ("dry_run", "jax_tpu")
+# "vllm" is the optional comparison backend (backends/vllm_backend.py):
+# selectable everywhere, fails with a clear error unless a vllm wheel is
+# installed (the reference benchmarks vLLM/SGLang side by side)
+VALID_ENGINE_TYPES = ("dry_run", "jax_tpu", "vllm")
 
 
 class ServerConfig(BaseModel):

@@ -41,6 +41,12 @@ def _create_backend(engine_type: str) -> InferenceBackend:
         from vgate_tpu.backends.jax_backend import JaxTPUBackend
 
         return JaxTPUBackend()
+    if engine_type == "vllm":
+        # optional comparison backend (reference benchmarks vLLM and
+        # SGLang side by side); raises a clear error without a vllm wheel
+        from vgate_tpu.backends.vllm_backend import VLLMBackend
+
+        return VLLMBackend()
     raise ValueError(f"Unknown engine_type: {engine_type!r}")
 
 
