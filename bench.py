@@ -167,8 +167,20 @@ def main() -> None:
         # 32/64 halve/quarter the per-page overhead (VGT_BENCH_PAGE sweeps)
         page_size = int(os.environ.get("VGT_BENCH_PAGE", 16))
         max_model_len = int(os.environ.get("VGT_BENCH_CTX", 512))
-        # one prefill bucket: the smallest power of two >= the prompt
-        buckets = [max(128, 1 << (prompt_len - 1).bit_length())]
+        # long contexts prefill in chunks (serial suffix passes) instead
+        # of compiling a max_model_len-wide program
+        prefill_chunk = int(
+            os.environ.get(
+                "VGT_BENCH_PREFILL_CHUNK",
+                1024 if max_model_len > 2048 else 0,
+            )
+        )
+        # one prefill bucket: the smallest power of two >= the prompt,
+        # capped at the chunk size when chunking
+        bucket = max(128, 1 << (prompt_len - 1).bit_length())
+        if prefill_chunk:
+            bucket = min(bucket, prefill_chunk)
+        buckets = [bucket]
         decode_chunk = int(os.environ.get("VGT_BENCH_CHUNK", 64))
     else:  # CI smoke fallback
         model_id = "tiny-dense"
@@ -179,6 +191,7 @@ def main() -> None:
         buckets = [16]
         max_model_len = 64
         decode_chunk = 8
+        prefill_chunk = 0
 
     config = load_config(
         model={
@@ -205,6 +218,7 @@ def main() -> None:
             "prefill_batch_max": int(
                 os.environ.get("VGT_BENCH_PREFILL_BATCH", 32)
             ),
+            "prefill_chunk": prefill_chunk,
             "decode_chunk": decode_chunk,
             "decode_pipeline": int(
                 os.environ.get("VGT_BENCH_PIPE", 2)

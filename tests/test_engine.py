@@ -726,3 +726,94 @@ def test_stop_token_ids_finish(engine):
     assert stopped["finish_reason"] == "stop"
     assert stopped["token_ids"][: 3] == base["token_ids"][: 3]
     assert len(stopped["token_ids"]) == 3
+
+
+# ----------------------------------------------------- chunked prefill
+
+def chunked_cfg(prefill_chunk, **model_overrides):
+    model = {
+        "model_id": "tiny-dense",
+        "engine_type": "jax_tpu",
+        "dtype": "float32",
+        "max_model_len": 64,
+    }
+    model.update(model_overrides)
+    return load_config(
+        model=model,
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "num_devices": 1,
+            "kv_num_pages": 128, "kv_page_size": 4,
+            "max_batch_slots": 2, "prefill_buckets": [8, 16],
+            "use_pallas": False,
+            "prefill_chunk": prefill_chunk,
+        },
+        scheduler={"max_queue_size": 8},
+        logging={"level": "WARNING"},
+    )
+
+
+def test_chunked_prefill_token_identical_to_whole_prompt():
+    """A 40-token prompt with a 16-token chunk cap runs three serial
+    suffix passes (16+16+8); greedy output must be token-identical to
+    the unchunked engine, seeded sampled output too (the final chunk
+    carries the real sampling params)."""
+    prompt_ids = [3 + (i % 31) for i in range(40)]
+    outs = []
+    for chunk in (0, 16):
+        core = EngineCore(chunked_cfg(chunk), devices=jax.devices()[:1])
+        if chunk:
+            # ladder capped at the chunk size
+            assert core.scheduler.prefill_buckets[-1] == chunk
+        core.start()
+        try:
+            g = core.submit_tokens(prompt_ids, greedy(10))
+            s = core.submit_tokens(
+                prompt_ids[::-1],
+                SamplingParams(max_tokens=8, temperature=0.8, seed=13),
+            )
+            assert g.done_event.wait(300) and s.done_event.wait(300)
+            outs.append(
+                (list(g.generated_ids), list(s.generated_ids))
+            )
+        finally:
+            core.stop()
+    assert outs[0] == outs[1]
+
+
+def test_chunked_prefill_with_prefix_cache_hit():
+    """Chunked prefill composes with automatic prefix caching: the
+    second identical prompt starts its chunks after the cached pages
+    and produces identical greedy output."""
+    cfg = chunked_cfg(16)
+    assert cfg.tpu.prefix_cache
+    core = EngineCore(cfg, devices=jax.devices()[:1])
+    core.start()
+    try:
+        prompt_ids = [5 + (i % 17) for i in range(40)]
+        a = core.submit_tokens(prompt_ids, greedy(8))
+        assert a.done_event.wait(300)
+        hits_before = core.scheduler.total_prefix_hit_tokens
+        b = core.submit_tokens(prompt_ids, greedy(8))
+        assert b.done_event.wait(300)
+        assert list(a.generated_ids) == list(b.generated_ids)
+        assert core.scheduler.total_prefix_hit_tokens > hits_before
+        stats = core.scheduler.get_stats()
+        assert stats["running"] == 0
+    finally:
+        core.stop()
+
+
+def test_chunked_prefill_rejects_sp_pp():
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    cfg = load_config(
+        model={"model_id": "tiny-dense", "engine_type": "jax_tpu",
+               "dtype": "float32", "max_model_len": 64},
+        tpu={"dp": 1, "tp": 1, "ep": 1, "sp": 2, "num_devices": 2,
+             "kv_num_pages": 64, "kv_page_size": 4,
+             "max_batch_slots": 2, "prefill_buckets": [16],
+             "use_pallas": False, "prefill_chunk": 16},
+        logging={"level": "WARNING"},
+    )
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        EngineCore(cfg, devices=jax.devices()[:2])

@@ -169,6 +169,14 @@ class TPUConfig(BaseModel):
     # Same-bucket prompts prefilled in ONE stacked [B, bucket] program
     # (B pads to a power of two).  Cuts dispatch count ~B-fold for bursts.
     prefill_batch_max: int = 8
+    # Chunked prefill: cap the prefill-bucket ladder at this many tokens
+    # and run longer prompts as serial page-aligned passes through the
+    # suffix-prefill program (each chunk attends the resident context).
+    # Long contexts then never compile a max_model_len-wide program —
+    # an 8k prompt is e.g. eight 1k-chunk dispatches.  0 disables
+    # (the top bucket covers max_model_len, the r2 behavior).  Requires
+    # sp == 1 and pp == 1 (those reshape the prompt pass).
+    prefill_chunk: int = 0
     # Automatic prefix caching: full prompt pages are content-hashed and
     # shared across requests; a prefix hit prefills only the suffix.
     # Disabled automatically when sp>1 or pp>1 (those reshape the prefill).

@@ -69,7 +69,7 @@ from vgate_tpu.runtime.scheduler import PrefillPlan, Scheduler
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.runtime.tokenizer import get_tokenizer
 from vgate_tpu.runtime.weights import load_or_init_params
-from vgate_tpu.utils.math import cdiv
+from vgate_tpu.utils.math import bucket_for, cdiv
 
 logger = get_logger(__name__)
 
@@ -499,6 +499,12 @@ class EngineCore:
         self.prefix_cache_enabled = bool(
             tpu_cfg.prefix_cache and mesh_sp == 1 and mesh_pp == 1
         )
+        if tpu_cfg.prefill_chunk > 0 and (mesh_sp > 1 or mesh_pp > 1):
+            raise ValueError(
+                "prefill_chunk (chunked prefill) requires sp == 1 and "
+                "pp == 1 — the ring/relay prompt passes reshape the "
+                "program incompatibly"
+            )
         self.scheduler = Scheduler(
             allocator=self.allocator,
             max_slots=self.max_slots,
@@ -511,6 +517,7 @@ class EngineCore:
                 self.config.scheduler.admission_deadline_ms
             ),
             prefix_cache=self.prefix_cache_enabled,
+            prefill_chunk=tpu_cfg.prefill_chunk,
         )
 
         # host-side mirror of the device page tables, one row per slot
@@ -889,13 +896,19 @@ class EngineCore:
             return False
         # group same-bucket plans into batched dispatches; prefix-cache
         # hits (suffix-only prompt pass) compile a different program and
-        # group separately
+        # group separately.  Chunked plans (prompt > the bucket cap) run
+        # serial suffix passes and never batch with others.
         by_bucket: Dict[tuple, List[PrefillPlan]] = {}
+        dispatched = []  # (group plans, [B] device tokens)
         for plan in plans:
+            if plan.chunked:
+                dispatched.append(
+                    ([plan], self._dispatch_chunked_prefill(plan))
+                )
+                continue
             key = (plan.bucket, plan.cached_len > 0)
             by_bucket.setdefault(key, []).append(plan)
         batch_max = max(1, self.config.tpu.prefill_batch_max)
-        dispatched = []  # (group plans, [B] device tokens)
         for (bucket, cached), group in sorted(by_bucket.items()):
             for i in range(0, len(group), batch_max):
                 chunk = group[i : i + batch_max]
@@ -1162,6 +1175,83 @@ class EngineCore:
             stop_id_mat=mt_ids,
         )
         return out  # (first tokens [B], logprob triple or None)
+
+    def _dispatch_chunked_prefill(self, plan: PrefillPlan):
+        """Serial chunked prefill for a (suffix-)prompt longer than the
+        bucket cap (scheduler.prefill_chunk): page-aligned passes of up
+        to ``plan.bucket`` tokens through the suffix-prefill program,
+        each attending the full resident context.  Long prompts never
+        compile a max_model_len-wide program — an 8k prompt at a 1k cap
+        is eight dispatches of the SAME compiled 1k-suffix program.
+        Only the final chunk's sampled token is real (earlier chunks'
+        samples are discarded); the final chunk carries the request's
+        sampling extras.  Returns the (async) ([1] tokens, lp) handle of
+        the final chunk."""
+        seq = plan.seq
+        ps = self.geometry.page_size
+        chunk = plan.bucket  # page-aligned (scheduler buckets are)
+        total = seq.num_prompt_tokens
+        slot_row = self._page_tables_np[plan.slot]
+        slot_row[:] = 0
+        slot_row[: len(seq.pages)] = seq.pages
+        start = plan.cached_len  # page-aligned (full cached pages)
+        # non-final chunks: lean suffix dispatches (temp 0, no sampling
+        # extras — every sampled token here is discarded)
+        while total - start > chunk:
+            n = chunk
+            start_page = start // ps
+            tokens = np.zeros((1, chunk), np.int32)
+            tokens[0] = seq.prompt_ids[start : start + n]
+            suffix_pt = np.asarray(
+                seq.pages[start_page : start_page + chunk // ps],
+                np.int32,
+            )[None]
+            # context window bucketed to the next power of two of pages
+            # (bounds compile variants exactly like _dispatch_suffix_group)
+            ctx_pages = min(
+                self.geometry.pages_per_seq,
+                1 << max(0, cdiv(start + n, ps) - 1).bit_length(),
+            )
+            full_pt = np.zeros((1, ctx_pages), np.int32)
+            full_pt[0, : min(len(seq.pages), ctx_pages)] = seq.pages[
+                :ctx_pages
+            ]
+            key = ("suffix", chunk, 1, ctx_pages, False, None, 0)
+            if key not in self._compiled_buckets:
+                metrics.RECOMPILES.labels(kind="prefill").inc()
+                self._compiled_buckets.add(key)
+            _out, self.k_pages, self.v_pages = _suffix_prefill_step(
+                self.params,
+                self.spec,
+                jnp.asarray(tokens),
+                jnp.asarray([start], jnp.int32),
+                jnp.asarray([n], jnp.int32),
+                self.k_pages,
+                self.v_pages,
+                jnp.asarray(suffix_pt),
+                jnp.asarray(full_pt),
+                jnp.zeros((1,), jnp.float32),
+                jnp.ones((1,), jnp.float32),
+                jnp.zeros((1,), jnp.int32),
+                self._step_key(),
+                seeds=jnp.full((1,), -1, jnp.int32),
+                steps=jnp.zeros((1,), jnp.int32),
+            )
+            start += n
+        # final chunk: exactly a B=1 suffix-group dispatch with
+        # cached_len=start — delegate so the full sampling surface
+        # (seeds/penalties/min_tokens/logprobs) can never drift from the
+        # unchunked path
+        final = PrefillPlan(
+            seq=seq,
+            slot=plan.slot,
+            bucket=bucket_for(
+                total - start, self.scheduler.prefill_buckets
+            ),
+            cached_len=start,
+            register_hashes=None,
+        )
+        return self._dispatch_suffix_group([final], final.bucket)
 
     # ------------------------------------------------------------- decode
 
@@ -1737,6 +1827,15 @@ class EngineCore:
             seq.done_event.wait(timeout=600)
             if i == 0:
                 seq = self.submit_tokens([5] * n, ladder_sampled)
+                seq.done_event.wait(timeout=600)
+        if self.scheduler.prefill_chunk > 0:
+            # chunked prefill compiles suffix programs (one per pow2
+            # context width) the bucket walk above never touches; one
+            # max-length prompt hits every width so the first long
+            # request doesn't pay serial compiles at serve time
+            n = self.config.model.max_model_len - 2
+            if n > self.scheduler.prefill_buckets[-1]:
+                seq = self.submit_tokens([5] * n, single)
                 seq.done_event.wait(timeout=600)
             if i == 0:
                 B = max(1, self.config.tpu.prefill_batch_max)

@@ -51,6 +51,10 @@ class PrefillPlan:
     # (page, chain_hash) pairs to index once this prefill is dispatched.
     cached_len: int = 0
     register_hashes: list = None  # type: ignore[assignment]
+    # chunked prefill: the (suffix) prompt exceeds the bucket cap and
+    # runs as SERIAL suffix passes of `bucket` tokens each
+    # (engine_core._dispatch_chunked_prefill)
+    chunked: bool = False
 
 
 @dataclass
@@ -73,13 +77,20 @@ class Scheduler:
         preempt_on_oom: bool = True,
         admission_deadline_ms: float = 0.0,
         prefix_cache: bool = False,
+        prefill_chunk: int = 0,
     ) -> None:
         self.allocator = allocator
         self.page_size = page_size
         # buckets: page-aligned, capped at max_model_len, and always
         # including a top bucket that can hold any admissible prompt
-        # (preempted sequences re-prefill with their grown context)
+        # (preempted sequences re-prefill with their grown context).
+        # With chunked prefill (prefill_chunk > 0) the ladder caps at the
+        # chunk size instead, and longer prompts run serial suffix passes
+        # of top-bucket tokens each.
         top = round_up(max_model_len, page_size)
+        if prefill_chunk > 0:
+            top = min(top, round_up(prefill_chunk, page_size))
+        self.prefill_chunk = prefill_chunk
         aligned = {
             min(round_up(b, page_size), top)
             for b in prefill_buckets
@@ -279,9 +290,15 @@ class Scheduler:
         register_hashes = [
             (seq.pages[i], chain[i]) for i in range(len(matched), len(chain))
         ]
-        bucket = bucket_for(
-            seq.num_prompt_tokens - cached_len, self.prefill_buckets
-        )
+        suffix_len = seq.num_prompt_tokens - cached_len
+        top = self.prefill_buckets[-1]
+        if suffix_len > top:
+            # chunked prefill: serial suffix passes of `top` tokens
+            return PrefillPlan(
+                seq=seq, slot=slot, bucket=top, cached_len=cached_len,
+                register_hashes=register_hashes, chunked=True,
+            )
+        bucket = bucket_for(suffix_len, self.prefill_buckets)
         return PrefillPlan(
             seq=seq, slot=slot, bucket=bucket, cached_len=cached_len,
             register_hashes=register_hashes,
