@@ -407,3 +407,34 @@ def test_int4_kernel_gate_dispatch(monkeypatch):
     we = jnp.asarray(rng.normal(size=(2, 3, 16, 32)), jnp.float32)
     qe = quantize_expert_stacked(we, bits=4)
     assert not quant._use_int4_kernel("ecd,edf->ecf", qe)
+
+
+def test_paged_decode_kernel_layer_indexed():
+    """Carry-threaded decode passes the FULL stacked [L, KV, P, ps, hd]
+    pool plus a layer index; the kernel's layer-indexed DMA must match
+    slicing that layer out first (interpret mode)."""
+    L = 3
+    q, k_pages, v_pages, page_tables, seq_lens = make_case(
+        B=2, H=4, KV=2, hd=128, ps=16, pages_per_seq=4, seed=12,
+        lens=[17, 55],
+    )
+    rng = np.random.default_rng(13)
+    stacked_k = jnp.asarray(
+        rng.normal(size=(L, *k_pages.shape)), jnp.float32
+    )
+    stacked_v = jnp.asarray(
+        rng.normal(size=(L, *v_pages.shape)), jnp.float32
+    )
+    for layer in range(L):
+        expect = paged_decode_attention_pallas(
+            q, stacked_k[layer], stacked_v[layer], page_tables, seq_lens,
+            interpret=True,
+        )
+        got = paged_decode_attention_pallas(
+            q, stacked_k, stacked_v, page_tables, seq_lens,
+            layer=jnp.asarray(layer, jnp.int32), interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(expect), rtol=1e-5, atol=1e-5,
+            err_msg=f"layer {layer}",
+        )

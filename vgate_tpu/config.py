@@ -148,6 +148,13 @@ class TPUConfig(BaseModel):
     # Use Pallas kernels where available; False falls back to jnp reference
     # implementations (needed on CPU test meshes).
     use_pallas: bool = True
+    # Thread the FULL [L, ...] KV pools through the decode scan as carry
+    # (layer-indexed in-place updates + layer-indexed attention reads)
+    # instead of per-layer xs/ys slices — the xs form materializes each
+    # layer's whole page pool (~2x67 MB at serving sizes) to feed the
+    # attention op every step.  False restores the r2 xs/ys layout for
+    # A/B measurement.
+    kv_carry_decode: bool = True
     # Per-chip HBM budget in bytes for KV auto-sizing when the runtime
     # reports no memory stats (0 => 16 GiB, the v5e default; set for other
     # parts, e.g. 32 GiB for v4/v5p).

@@ -419,6 +419,25 @@ def prefill_layer(
     return _finish_layer(h, attn, lp, spec), k_pages_l, v_pages_l
 
 
+def _decode_qkv(h, lp, spec: ModelSpec, positions):
+    """Per-layer decode prologue shared by every decode path (xs/ys
+    scan, carry scan, sp shard, pp relay): input norm + qkv projection +
+    rope at the step positions.  q [B,H,hd], k/v [B,KV,hd]."""
+    normed = rms_norm(
+        h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
+    )
+    q, k, v = _project_qkv(normed, lp, spec)
+    q = apply_rope(
+        q[:, None], positions[:, None], spec.rope_theta,
+        spec.rope_scaling,
+    )[:, 0]
+    k = apply_rope(
+        k[:, None], positions[:, None], spec.rope_theta,
+        spec.rope_scaling,
+    )[:, 0]
+    return q, k, v
+
+
 def decode_layer(
     h, lp, k_pages_l, v_pages_l, *, spec: ModelSpec, positions, page_ids,
     page_off, page_tables, seq_lens, attn_fn, window=None, sp_mesh=None,
@@ -428,18 +447,7 @@ def decode_layer(
     parallel/pipeline.py).  With ``sp_mesh`` the KV write and attention
     run sequence-parallel over the sp-sharded page pool
     (parallel/sp_decode.py) — the long-context decode path."""
-    normed = rms_norm(
-        h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
-    )
-    q, k, v = _project_qkv(normed, lp, spec)  # q [B,H,hd], k/v [B,KV,hd]
-    q = apply_rope(
-        q[:, None], positions[:, None], spec.rope_theta,
-        spec.rope_scaling,
-    )[:, 0]
-    k = apply_rope(
-        k[:, None], positions[:, None], spec.rope_theta,
-        spec.rope_scaling,
-    )[:, 0]
+    q, k, v = _decode_qkv(h, lp, spec, positions)
     if sp_mesh is not None:
         from vgate_tpu.parallel.sp_decode import (
             sp_decode_attention_and_write,
@@ -490,6 +498,7 @@ def decode_forward(
     active: Optional[jnp.ndarray] = None,  # [B] bool; inactive slots write page 0
     use_pallas: bool = False,
     mesh=None,  # pp>1 routes through the pipeline-parallel stage relay
+    kv_carry: bool = False,  # thread FULL KV buffers as scan carry
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One continuous-batching decode step: returns (logits [B, V], caches)."""
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
@@ -561,6 +570,41 @@ def decode_forward(
 
     x = _embed(params, spec, tokens)  # [B, D]
     windows = _layer_windows(spec)
+
+    if kv_carry:
+        # Carry-threaded KV: the FULL [L, ...] pools ride the scan carry
+        # with layer-indexed in-place updates, and attention reads the
+        # pool at layer l directly (Pallas: layer-indexed DMA; jnp: one
+        # composed gather).  The xs/ys form below dynamic-slices each
+        # layer's whole [KV, P, ps, hd] pool into a fresh buffer per
+        # layer to feed the attention op — at serving pool sizes that is
+        # ~2x67 MB of pure copy per layer per step, larger than the live
+        # KV itself.  Carry threading eliminates it.
+        def carry_layer_fn(carry, per_layer):
+            h, kp, vp = carry
+            lp, win, l = per_layer
+            q, k, v = _decode_qkv(h, lp, spec, positions)
+            # NB mixed scalar/slice/array indexing: the broadcast (batch)
+            # dim moves to the FRONT, so the update shape is [B, KV, hd]
+            # — k/v as projected, no transpose
+            kp = kp.at[l, :, page_ids, page_off].set(k)
+            vp = vp.at[l, :, page_ids, page_off].set(v)
+            attn = attn_fn(
+                q, kp, vp, page_tables, seq_lens, layer=l,
+                window=win if spec.sliding_window > 0 else None,
+            )
+            return (_finish_layer(h, attn, lp, spec), kp, vp), None
+
+        (x, k_pages, v_pages), _ = jax.lax.scan(
+            carry_layer_fn,
+            (x, k_pages, v_pages),
+            (
+                params["layers"],
+                windows,
+                jnp.arange(spec.num_layers, dtype=jnp.int32),
+            ),
+        )
+        return _logits(params, spec, x), k_pages, v_pages
 
     def layer_fn(h, per_layer):
         lp, win, k_pages_l, v_pages_l = per_layer

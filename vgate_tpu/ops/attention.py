@@ -164,6 +164,8 @@ def paged_decode_attention(
     softcap: float = 0.0,
     window=None,  # int32 scalar; >0 => attend only to the last `window` keys
     scale=None,  # query scale; default hd**-0.5
+    layer=None,  # int32 scalar: pool layer index — k/v_pages then carry a
+    #              leading [L] dim (the carry-threaded decode path)
 ) -> jnp.ndarray:
     """Decode-step attention over the paged KV cache. Returns [B, H, hd].
 
@@ -173,18 +175,27 @@ def paged_decode_attention(
     through VMEM instead of materializing the gather.
     """
     B, H, hd = q.shape
-    KV = k_pages.shape[0]
-    page_size = k_pages.shape[2]
+    KV = k_pages.shape[1] if layer is not None else k_pages.shape[0]
+    page_size = k_pages.shape[-2]
     n_rep = H // KV
     ctx_max = page_tables.shape[1] * page_size
 
-    # Gather pages: [KV, B, pages_per_seq, page_size, hd] -> [B, ctx, KV, hd]
-    k = jnp.moveaxis(
-        k_pages[:, page_tables].reshape(KV, B, ctx_max, hd), 0, 2
-    )
-    v = jnp.moveaxis(
-        v_pages[:, page_tables].reshape(KV, B, ctx_max, hd), 0, 2
-    )
+    if layer is not None:
+        # one gather composing (layer, head, page) — reads only the live
+        # pages of layer `layer`, never a full [KV, P, ps, hd] slice
+        L = k_pages.shape[0]
+        head_idx = (layer * KV + jnp.arange(KV))[:, None, None]  # [KV,1,1]
+        k_flat = k_pages.reshape(L * KV, *k_pages.shape[2:])
+        v_flat = v_pages.reshape(L * KV, *v_pages.shape[2:])
+        k_sel = k_flat[head_idx, page_tables[None]]  # [KV, B, pages, ps, hd]
+        v_sel = v_flat[head_idx, page_tables[None]]
+    else:
+        k_sel = k_pages[:, page_tables]
+        v_sel = v_pages[:, page_tables]
+
+    # [KV, B, pages_per_seq, page_size, hd] -> [B, ctx, KV, hd]
+    k = jnp.moveaxis(k_sel.reshape(KV, B, ctx_max, hd), 0, 2)
+    v = jnp.moveaxis(v_sel.reshape(KV, B, ctx_max, hd), 0, 2)
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
 

@@ -42,7 +42,7 @@ if CHUNK_PAGES <= 0:
 
 def _chunk_dma(
     page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
-    b, g, n_pages, page_size,
+    b, g, n_pages, page_size, layer=None,
 ):
     """Shared double-buffered page-DMA machinery for the paged kernels.
 
@@ -50,7 +50,18 @@ def _chunk_dma(
     kicks off the async copies of chunk ``c``'s live pages into buffer
     ``slot`` (zero-filling pages beyond the sequence — stale VMEM could
     hold NaNs, and softmax-weight 0 x NaN would poison the accumulator);
-    ``wait_chunk`` blocks on those copies."""
+    ``wait_chunk`` blocks on those copies.
+
+    With ``layer`` (a traced scalar) the page pools carry a leading
+    layer dim ``[L, KV, P, ps, hd]`` and the DMA indexes it — the
+    carry-threaded decode path (models/decoder.py) passes the FULL
+    stacked buffer instead of a per-layer slice, so no 2x67MB slice
+    materialization per layer feeds the kernel."""
+
+    def src(ref, page_id):
+        if layer is None:
+            return ref.at[g, page_id]
+        return ref.at[layer, g, page_id]
 
     def start_chunk(c, slot):
         for j in range(CHUNK_PAGES):  # static unroll
@@ -60,12 +71,12 @@ def _chunk_dma(
             def _():
                 page_id = page_tables_ref[b, page_pos]
                 pltpu.make_async_copy(
-                    k_pages_ref.at[g, page_id],
+                    src(k_pages_ref, page_id),
                     k_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 0, j],
                 ).start()
                 pltpu.make_async_copy(
-                    v_pages_ref.at[g, page_id],
+                    src(v_pages_ref, page_id),
                     v_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 1, j],
                 ).start()
@@ -86,12 +97,12 @@ def _chunk_dma(
             @pl.when(page_pos < n_pages)
             def _():
                 pltpu.make_async_copy(
-                    k_pages_ref.at[g, 0],
+                    src(k_pages_ref, 0),
                     k_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 0, j],
                 ).wait()
                 pltpu.make_async_copy(
-                    v_pages_ref.at[g, 0],
+                    src(v_pages_ref, 0),
                     v_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 1, j],
                 ).wait()
@@ -104,10 +115,12 @@ def _kernel(
     page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)
     seq_lens_ref,  # [B] int32 (SMEM)
     window_ref,  # [1] int32 (SMEM); >0 => attend only to the last `window`
+    layer_ref,  # [1] int32 (SMEM); pool layer index (-1 => no layer dim)
     # inputs
     q_ref,  # [1, 1, G, hd] VMEM block for (b, g)
     k_pages_ref,  # [KV, P, ps, hd] in ANY/HBM (head-major: one page of one
     v_pages_ref,  # [KV, P, ps, hd]  head is a contiguous (ps, hd) DMA tile)
+    #                or [L, KV, P, ps, hd] when has_layer (carry decode)
     # output
     out_ref,  # [1, 1, G, hd]
     # scratch
@@ -121,6 +134,7 @@ def _kernel(
     page_size: int,
     softcap: float,
     scale: float,
+    has_layer: bool = False,
 ):
     b = pl.program_id(0)
     g = pl.program_id(1)
@@ -140,6 +154,7 @@ def _kernel(
     start_chunk, wait_chunk = _chunk_dma(
         page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
         b, g, n_pages, page_size,
+        layer=layer_ref[0] if has_layer else None,
     )
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
@@ -204,16 +219,18 @@ def _kernel(
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # [B, H, hd]
     k_pages: jnp.ndarray,  # [KV, P, ps, hd] (head-major, kv_cache.py)
-    v_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,  # or [L, KV, P, ps, hd] with `layer` given
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     seq_lens: jnp.ndarray,  # [B]
     window=None,  # int32 scalar; >0 => attend only to the last `window`
+    layer=None,  # int32 scalar: pool layer index (carry-threaded decode)
     interpret: bool = False,
     softcap: float = 0.0,
     scale=None,  # static query scale; default hd**-0.5
 ) -> jnp.ndarray:
     B, H, hd = q.shape
-    KV, P, ps, _ = k_pages.shape
+    has_layer = layer is not None
+    KV, P, ps, _ = k_pages.shape[1:] if has_layer else k_pages.shape
     G = H // KV
     chunk_tokens = CHUNK_PAGES * ps
 
@@ -221,18 +238,24 @@ def paged_decode_attention_pallas(
         window_arr = jnp.zeros((1,), jnp.int32)
     else:
         window_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    layer_arr = (
+        jnp.asarray(layer, jnp.int32).reshape(1)
+        if has_layer
+        else jnp.full((1,), -1, jnp.int32)
+    )
     kernel = functools.partial(
         _kernel,
         page_size=ps,
         softcap=float(softcap),
         scale=float(scale) if scale is not None else hd ** -0.5,
+        has_layer=has_layer,
     )
     # q is laid out [B, KV, G, hd] so each program's block covers the FULL
     # trailing (G, hd) dims — Mosaic requires trailing block dims either
     # tile-aligned (8, 128) or equal to the array dims, and G (q heads per
     # kv group, e.g. 6 or 7) is rarely tile-aligned.
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, KV),
         in_specs=[
             pl.BlockSpec(
@@ -264,7 +287,7 @@ def paged_decode_attention_pallas(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
     )(
-        page_tables, seq_lens, window_arr,
+        page_tables, seq_lens, window_arr, layer_arr,
         q.reshape(B, KV, G, hd), k_pages, v_pages,
     )
     return out.reshape(B, H, hd)

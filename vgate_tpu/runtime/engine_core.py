@@ -176,7 +176,7 @@ def _decode_step(
 @functools.partial(
     jax.jit,
     static_argnames=("spec", "num_steps", "use_pallas", "max_position",
-                     "mesh", "num_logprobs", "all_greedy"),
+                     "mesh", "num_logprobs", "all_greedy", "kv_carry"),
     donate_argnames=("k_pages", "v_pages", "counts"),
 )
 def _decode_chunk(
@@ -186,6 +186,7 @@ def _decode_chunk(
     seeds=None, steps=None, mesh=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, all_greedy: bool = False,
+    kv_carry: bool = False,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -208,6 +209,7 @@ def _decode_chunk(
         logits, k_pages, v_pages = decode_forward(
             params, spec, tokens, positions, k_pages, v_pages, page_tables,
             active=active, use_pallas=use_pallas, mesh=mesh,
+            kv_carry=kv_carry,
         )
         if counts is not None:
             # frequency/presence penalties over the generated-token
@@ -1087,6 +1089,13 @@ class EngineCore:
         )
         return out  # (first tokens [B], logprob triple or None)
 
+    @staticmethod
+    def _suffix_key(bucket, B, ctx_pages, has_pen, mt_width, num_lp):
+        """Compile-variant key for one _suffix_prefill_step shape — the
+        single definition both the batched suffix-group dispatch and
+        the chunked-prefill loop count RECOMPILES against."""
+        return ("suffix", bucket, B, ctx_pages, has_pen, mt_width, num_lp)
+
     def _dispatch_suffix_group(self, plans: List[PrefillPlan], bucket: int):
         """Launch ONE suffix-prefill program for up to prefill_batch_max
         prefix-cache hits whose suffix lengths share a bucket.  The cached
@@ -1144,8 +1153,8 @@ class EngineCore:
             if any(p.seq.params.logprobs for p in plans)
             else 0
         )
-        key = (
-            "suffix", bucket, B, ctx_pages, pen_counts is not None,
+        key = self._suffix_key(
+            bucket, B, ctx_pages, pen_counts is not None,
             None if mt is None else mt_ids.shape[1], num_lp,
         )
         if key not in self._compiled_buckets:
@@ -1216,7 +1225,7 @@ class EngineCore:
             full_pt[0, : min(len(seq.pages), ctx_pages)] = seq.pages[
                 :ctx_pages
             ]
-            key = ("suffix", chunk, 1, ctx_pages, False, None, 0)
+            key = self._suffix_key(chunk, 1, ctx_pages, False, None, 0)
             if key not in self._compiled_buckets:
                 metrics.RECOMPILES.labels(kind="prefill").inc()
                 self._compiled_buckets.add(key)
@@ -1419,6 +1428,7 @@ class EngineCore:
             min_toks=state["min_toks"],
             stop_id_mat=state["stop_id_mat"],
             all_greedy=all_greedy,
+            kv_carry=self.config.tpu.kv_carry_decode,
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -1828,16 +1838,6 @@ class EngineCore:
             if i == 0:
                 seq = self.submit_tokens([5] * n, ladder_sampled)
                 seq.done_event.wait(timeout=600)
-        if self.scheduler.prefill_chunk > 0:
-            # chunked prefill compiles suffix programs (one per pow2
-            # context width) the bucket walk above never touches; one
-            # max-length prompt hits every width so the first long
-            # request doesn't pay serial compiles at serve time
-            n = self.config.model.max_model_len - 2
-            if n > self.scheduler.prefill_buckets[-1]:
-                seq = self.submit_tokens([5] * n, single)
-                seq.done_event.wait(timeout=600)
-            if i == 0:
                 B = max(1, self.config.tpu.prefill_batch_max)
                 while B >= 2:
                     group = [
@@ -1847,6 +1847,15 @@ class EngineCore:
                     for g in group:
                         g.done_event.wait(timeout=600)
                     B //= 2
+        if self.scheduler.prefill_chunk > 0:
+            # chunked prefill compiles suffix programs (one per pow2
+            # context width) the bucket walk above never touches; one
+            # max-length prompt hits every width so the first long
+            # request doesn't pay serial compiles at serve time
+            n_long = self.config.model.max_model_len - 2
+            if n_long > self.scheduler.prefill_buckets[-1]:
+                seq = self.submit_tokens([5] * n_long, single)
+                seq.done_event.wait(timeout=600)
         if not was_running:
             self.stop()
         return time.perf_counter() - start
