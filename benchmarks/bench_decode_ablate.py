@@ -97,19 +97,24 @@ def main() -> None:
         print(json.dumps({**base, "component": component,
                           "ms_per_step": round(ms, 3)}), flush=True)
 
-    # --- full serving chunk (pallas / jnp) --------------------------------
-    for name, use_pallas in (("chunk-pallas", True), ("chunk-jnp", False)):
+    # --- full serving chunk (pallas / jnp x xs-ys / carry KV) -------------
+    for name, use_pallas, kv_carry in (
+        ("chunk-pallas", True, False),
+        ("chunk-pallas-carry", True, True),
+        ("chunk-jnp", False, False),
+        ("chunk-jnp-carry", False, True),
+    ):
         if only and name not in only:
             continue
         if use_pallas and platform != "tpu":
             continue
 
-        def run(k_pages, v_pages, up=use_pallas):
+        def run(k_pages, v_pages, up=use_pallas, kc=kv_carry):
             return _decode_chunk(
                 params, spec, tokens, positions, k_pages, v_pages,
                 page_tables, active, temps, top_ps, top_ks, key, counter,
                 num_steps=STEPS, use_pallas=up, max_position=ctx - 1,
-                seeds=seeds, steps=steps0,
+                seeds=seeds, steps=steps0, kv_carry=kc,
             )[0]
 
         # donation consumes the caches: rebuild per call outside timing is
