@@ -173,6 +173,51 @@ def main() -> None:
             best = min(best, time.perf_counter() - t0)
         report(name, best / STEPS * 1e3)
 
+    # --- prefill: xs/ys vs carry KV threading -----------------------------
+    PB, PS_LEN = 32, 128  # the bench serving prefill shape
+    if B >= PB and pages_per_seq >= PS_LEN // ps:
+        ptokens = jnp.asarray(
+            (np.arange(PB * PS_LEN, dtype=np.int32) % 199 + 3).reshape(
+                PB, PS_LEN
+            )
+        )
+        plens = jnp.full((PB,), PS_LEN - 5, jnp.int32)
+        ppt = page_tables[:PB, : PS_LEN // ps]
+        for name, kc in (("prefill-xs", False), ("prefill-carry", True)):
+            if only and name not in only:
+                continue
+            from vgate_tpu.models.decoder import prefill_forward
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1),
+                               static_argnums=(2,))
+            def prefill_loop(kp, vp, kc):
+                def body(c, _):
+                    kp, vp = c
+                    logits, kp, vp = prefill_forward(
+                        params, spec, ptokens, plens, kp, vp, ppt,
+                        kv_carry=kc,
+                    )
+                    return (kp, vp), logits[0, 0]
+
+                (kp, vp), ys = jax.lax.scan(
+                    body, (kp, vp), None, length=4
+                )
+                return ys
+
+            kp = jnp.zeros(kv_shape, dtype)
+            vp = jnp.zeros(kv_shape, dtype)
+            jax.block_until_ready(prefill_loop(kp, vp, kc))
+            best = float("inf")
+            for _ in range(3):
+                kp = jnp.zeros(kv_shape, dtype)
+                vp = jnp.zeros(kv_shape, dtype)
+                jax.block_until_ready((kp, vp))
+                t0 = time.perf_counter()
+                jax.block_until_ready(prefill_loop(kp, vp, kc))
+                best = min(best, time.perf_counter() - t0)
+            # ms per prefill DISPATCH (B=32 x 128-token bucket)
+            report(name, best / 4 * 1e3)
+
     # --- sampling / lm_head in isolation ----------------------------------
     V = spec.vocab_size
     logits = jax.random.normal(jax.random.PRNGKey(1), (B, V), jnp.float32)

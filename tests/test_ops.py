@@ -395,3 +395,85 @@ def test_paged_decode_window_matches_truncated_context():
         np.testing.assert_allclose(
             np.asarray(got)[b], expect_b, rtol=2e-5, atol=2e-5
         )
+
+
+# ------------------------------------------- carry-threaded KV parity
+
+def test_kv_carry_parity_all_forwards():
+    """tpu.kv_carry (default ON for plain meshes) must be numerically
+    identical to the r2 xs/ys threading across decode, prefill and
+    suffix-prefill, for a global-attention family AND the sliding-window
+    /softcap family (the carry paths use mixed scalar/slice/array
+    indexed writes and layer-flattened gathers — this pins them)."""
+    import numpy as np
+
+    from vgate_tpu.models.decoder import (
+        decode_forward, init_params, prefill_forward,
+        prefill_suffix_forward,
+    )
+    from vgate_tpu.models.specs import TINY_DENSE, TINY_GEMMA2
+
+    for spec in (TINY_DENSE, TINY_GEMMA2):
+        ps, pps, B, S = 4, 8, 2, 16
+        params = init_params(spec, jax.random.PRNGKey(3), jnp.float32)
+        P = 1 + B * pps
+        shape = (spec.num_layers, spec.num_kv_heads, P, ps, spec.head_dim)
+        k0 = jnp.zeros(shape, jnp.float32)
+        v0 = jnp.zeros(shape, jnp.float32)
+        pt = jnp.asarray(
+            1 + np.arange(B * pps).reshape(B, pps), jnp.int32
+        )
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(
+            rng.integers(2, spec.vocab_size, (B, S)), jnp.int32
+        )
+        lens = jnp.asarray([14, 9], jnp.int32)
+
+        def pin(a, b, msg):
+            for x, y, nm in zip(a, b, ("logits", "k", "v")):
+                np.testing.assert_allclose(
+                    np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-5,
+                    err_msg=f"{spec.name} {msg} {nm}",
+                )
+
+        pin(
+            prefill_forward(
+                params, spec, toks, lens, k0, v0, pt[:, : S // ps],
+                kv_carry=False,
+            ),
+            prefill_forward(
+                params, spec, toks, lens, k0, v0, pt[:, : S // ps],
+                kv_carry=True,
+            ),
+            "prefill",
+        )
+
+        # resident prefix of 8 tokens, then the suffix pass both ways
+        _, kf, vf = prefill_forward(
+            params, spec, toks[:, :8], jnp.asarray([8, 8], jnp.int32),
+            k0, v0, pt[:, :2],
+        )
+        args = (
+            params, spec, toks[:, 8:], jnp.asarray([8, 8], jnp.int32),
+            jnp.asarray([6, 4], jnp.int32), kf, vf, pt[:, 2:4],
+            pt[:, :4],
+        )
+        pin(
+            prefill_suffix_forward(*args, kv_carry=False),
+            prefill_suffix_forward(*args, kv_carry=True),
+            "suffix",
+        )
+
+        dargs = (
+            params, spec, jnp.asarray([7, 11], jnp.int32),
+            jnp.asarray([8, 8], jnp.int32), kf, vf, pt,
+        )
+        pin(
+            decode_forward(
+                *dargs, active=jnp.asarray([True, True]), kv_carry=False
+            ),
+            decode_forward(
+                *dargs, active=jnp.asarray([True, True]), kv_carry=True
+            ),
+            "decode",
+        )

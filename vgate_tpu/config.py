@@ -19,7 +19,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 import yaml
-from pydantic import BaseModel, Field, field_validator
+from pydantic import BaseModel, Field, field_validator, model_validator
 
 ENV_PREFIX = "VGT_"
 CONFIG_PATH_ENV = "VGT_CONFIG_PATH"
@@ -148,13 +148,27 @@ class TPUConfig(BaseModel):
     # Use Pallas kernels where available; False falls back to jnp reference
     # implementations (needed on CPU test meshes).
     use_pallas: bool = True
-    # Thread the FULL [L, ...] KV pools through the decode scan as carry
-    # (layer-indexed in-place updates + layer-indexed attention reads)
-    # instead of per-layer xs/ys slices — the xs form materializes each
-    # layer's whole page pool (~2x67 MB at serving sizes) to feed the
-    # attention op every step.  False restores the r2 xs/ys layout for
-    # A/B measurement.
-    kv_carry_decode: bool = True
+    # Thread the FULL [L, ...] KV pools through the decode AND prefill
+    # scans as carry (layer-indexed in-place updates + layer-indexed
+    # attention reads) instead of per-layer xs/ys slices — the xs form
+    # materializes each layer's whole page pool (~2x67 MB at serving
+    # sizes) per layer per program to feed the attention/write ops.
+    # False restores the r2 xs/ys layout for A/B measurement.  Applies
+    # to plain (sp=1, pp=1) meshes; the ring/relay paths keep xs/ys.
+    kv_carry: bool = True
+
+    @model_validator(mode="before")
+    @classmethod
+    def _reject_renamed_kv_carry(cls, values):
+        # the knob briefly shipped as kv_carry_decode; extra="ignore"
+        # would silently drop the old name and re-enable carry under an
+        # operator who pinned it off — fail loudly instead
+        if isinstance(values, dict) and "kv_carry_decode" in values:
+            raise ValueError(
+                "tpu.kv_carry_decode was renamed to tpu.kv_carry "
+                "(it now covers prefill too); update the config"
+            )
+        return values
     # Per-chip HBM budget in bytes for KV auto-sizing when the runtime
     # reports no memory stats (0 => 16 GiB, the v5e default; set for other
     # parts, e.g. 32 GiB for v4/v5p).

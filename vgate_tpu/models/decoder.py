@@ -270,6 +270,7 @@ def prefill_forward(
     page_tables: jnp.ndarray,  # [B, S // ps] page ids for this prompt
     mesh=None,  # jax.sharding.Mesh; sp>1 routes attention through the ring
     use_pallas: bool = False,
+    kv_carry: bool = False,  # thread FULL KV buffers as scan carry
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run the prompt pass: returns (last-token logits [B, V], k_pages, v_pages).
 
@@ -325,18 +326,49 @@ def prefill_forward(
     x = _embed(params, spec, tokens)  # [B, S, D]
     windows = _layer_windows(spec)
 
-    def layer_fn(h, per_layer):
-        lp, win, k_pages_l, v_pages_l = per_layer
-        h, k_pages_l, v_pages_l = prefill_layer(
-            h, lp, k_pages_l, v_pages_l, spec=spec, seq_lens=seq_lens,
-            page_tables=page_tables, attn_fn=attn_fn,
-            window=win if spec.sliding_window > 0 else None,
+    if kv_carry:
+        # carry-threaded pools: the prompt pass only WRITES pages
+        # (attention runs over the fresh k/v), so the carry form just
+        # swaps xs/ys slice threading for layer-indexed in-place writes
+        positions = jnp.broadcast_to(
+            jnp.arange(S)[None, :], (B, S)
         )
-        return h, (k_pages_l, v_pages_l)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
-    )
+        def carry_layer_fn(carry, per_layer):
+            h, kp, vp = carry
+            lp, win, l = per_layer
+            q, k, v, kp, vp = _prefill_qkv_write(
+                h, lp, spec, positions, page_tables, kp, vp, layer=l
+            )
+            win_arg = win if spec.sliding_window > 0 else None
+            if win_arg is None:
+                attn = attn_fn(q, k, v, seq_lens)
+            else:
+                attn = attn_fn(q, k, v, seq_lens, window=win_arg)
+            return (_finish_layer(h, attn, lp, spec), kp, vp), None
+
+        (x, k_pages, v_pages), _ = jax.lax.scan(
+            carry_layer_fn,
+            (x, k_pages, v_pages),
+            (
+                params["layers"],
+                windows,
+                jnp.arange(spec.num_layers, dtype=jnp.int32),
+            ),
+        )
+    else:
+        def layer_fn(h, per_layer):
+            lp, win, k_pages_l, v_pages_l = per_layer
+            h, k_pages_l, v_pages_l = prefill_layer(
+                h, lp, k_pages_l, v_pages_l, spec=spec, seq_lens=seq_lens,
+                page_tables=page_tables, attn_fn=attn_fn,
+                window=win if spec.sliding_window > 0 else None,
+            )
+            return h, (k_pages_l, v_pages_l)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+        )
     last_idx = jnp.clip(seq_lens - 1, 0, S - 1)
     last_hidden = jnp.take_along_axis(
         x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1
@@ -345,15 +377,18 @@ def prefill_forward(
 
 
 def _prefill_qkv_write(
-    h, lp, spec: ModelSpec, positions, page_tables, k_pages_l, v_pages_l
+    h, lp, spec: ModelSpec, positions, page_tables, k_pages_l, v_pages_l,
+    layer=None,
 ):
     """Shared prompt-pass front half: norm + qkv projection + rope at the
     given (possibly offset) positions, then write this layer's KV into its
     pages (trash-page-0 absorbs padding).  Pages are head-major
     [KV, P, ps, hd]: the fresh KV transposes to [KV, B, n_pages, ps, hd]
-    so each head's pages land contiguously."""
+    so each head's pages land contiguously.  With ``layer`` (a traced
+    scalar) the pools carry a leading [L] dim and the write is a
+    layer-indexed in-place update — the carry-threaded prompt pass."""
     B, S = h.shape[:2]
-    ps = k_pages_l.shape[2]
+    ps = k_pages_l.shape[-2]
     n_pages = S // ps
     normed = rms_norm(
         h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
@@ -361,17 +396,31 @@ def _prefill_qkv_write(
     q, k, v = _project_qkv(normed, lp, spec)
     q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
     k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
-    k_resh = jnp.transpose(
-        k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
-        (3, 0, 1, 2, 4),
-    )
-    v_resh = jnp.transpose(
-        v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
-        (3, 0, 1, 2, 4),
-    )
     pt = page_tables[:, :n_pages]
-    k_pages_l = k_pages_l.at[:, pt].set(k_resh)
-    v_pages_l = v_pages_l.at[:, pt].set(v_resh)
+    if layer is None:
+        k_resh = jnp.transpose(
+            k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+            (3, 0, 1, 2, 4),
+        )
+        v_resh = jnp.transpose(
+            v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+            (3, 0, 1, 2, 4),
+        )
+        k_pages_l = k_pages_l.at[:, pt].set(k_resh)
+        v_pages_l = v_pages_l.at[:, pt].set(v_resh)
+    else:
+        # mixed scalar/slice/array indexing moves the broadcast (B,
+        # n_pages) dims to the FRONT: update shape [B, n_pages, KV, ps, hd]
+        k_resh = jnp.transpose(
+            k.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+            (0, 1, 3, 2, 4),
+        )
+        v_resh = jnp.transpose(
+            v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
+            (0, 1, 3, 2, 4),
+        )
+        k_pages_l = k_pages_l.at[layer, :, pt].set(k_resh)
+        v_pages_l = v_pages_l.at[layer, :, pt].set(v_resh)
     return q, k, v, k_pages_l, v_pages_l
 
 
@@ -632,6 +681,7 @@ def prefill_suffix_forward(
     v_pages: jnp.ndarray,
     suffix_page_tables: jnp.ndarray,  # [B, S // ps] pages the suffix fills
     ctx_page_tables: jnp.ndarray,  # [B, ctx_pages] window covering prefix+suffix
+    kv_carry: bool = False,  # thread FULL KV buffers as scan carry
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prompt pass for only the uncached suffix of a prefix-cache hit.
 
@@ -651,23 +701,53 @@ def prefill_suffix_forward(
     x = _embed(params, spec, tokens)  # [B, S, D]
     windows = _layer_windows(spec)
 
-    def layer_fn(h, per_layer):
-        lp, win, k_pages_l, v_pages_l = per_layer
-        q, _k, _v, k_pages_l, v_pages_l = _prefill_qkv_write(
-            h, lp, spec, positions, suffix_page_tables, k_pages_l,
-            v_pages_l,
-        )
-        attn = paged_suffix_attention(
-            q, k_pages_l, v_pages_l, ctx_page_tables, prefix_lens,
-            total_lens, softcap=spec.attn_softcap,
-            window=win if spec.sliding_window > 0 else None,
-            scale=_query_scale(spec),
-        )
-        return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
+    if kv_carry:
+        # carry-threaded pools: both the suffix write AND the paged
+        # context read are layer-indexed on the full [L, ...] buffers —
+        # no per-layer pool slice ever materializes (the chunked-prefill
+        # hot path runs this once per chunk)
+        def carry_layer_fn(carry, per_layer):
+            h, kp, vp = carry
+            lp, win, l = per_layer
+            q, _k, _v, kp, vp = _prefill_qkv_write(
+                h, lp, spec, positions, suffix_page_tables, kp, vp,
+                layer=l,
+            )
+            attn = paged_suffix_attention(
+                q, kp, vp, ctx_page_tables, prefix_lens,
+                total_lens, softcap=spec.attn_softcap,
+                window=win if spec.sliding_window > 0 else None,
+                scale=_query_scale(spec), layer=l,
+            )
+            return (_finish_layer(h, attn, lp, spec), kp, vp), None
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
-    )
+        (x, k_pages, v_pages), _ = jax.lax.scan(
+            carry_layer_fn,
+            (x, k_pages, v_pages),
+            (
+                params["layers"],
+                windows,
+                jnp.arange(spec.num_layers, dtype=jnp.int32),
+            ),
+        )
+    else:
+        def layer_fn(h, per_layer):
+            lp, win, k_pages_l, v_pages_l = per_layer
+            q, _k, _v, k_pages_l, v_pages_l = _prefill_qkv_write(
+                h, lp, spec, positions, suffix_page_tables, k_pages_l,
+                v_pages_l,
+            )
+            attn = paged_suffix_attention(
+                q, k_pages_l, v_pages_l, ctx_page_tables, prefix_lens,
+                total_lens, softcap=spec.attn_softcap,
+                window=win if spec.sliding_window > 0 else None,
+                scale=_query_scale(spec),
+            )
+            return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+        )
     last_idx = jnp.clip(suffix_lens - 1, 0, S - 1)
     last_hidden = jnp.take_along_axis(
         x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1

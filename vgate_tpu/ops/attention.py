@@ -233,6 +233,7 @@ def paged_suffix_attention(
     softcap: float = 0.0,
     window=None,  # int32 scalar; >0 => attend only to the last `window` keys
     scale=None,  # query scale; default hd**-0.5
+    layer=None,  # int32 scalar: pool layer index (carry-threaded prefill)
 ) -> jnp.ndarray:
     """Prompt-suffix attention over resident paged KV (prefix caching).
 
@@ -246,19 +247,26 @@ def paged_suffix_attention(
     Returns [B, S, H, hd].
     """
     B = q.shape[0]
-    KV = k_pages.shape[0]
-    hd = k_pages.shape[3]
-    ctx = page_tables.shape[1] * k_pages.shape[2]
+    KV = k_pages.shape[1] if layer is not None else k_pages.shape[0]
+    hd = k_pages.shape[-1]
+    page_size = k_pages.shape[-2]
+    ctx = page_tables.shape[1] * page_size
 
-    k = jnp.moveaxis(
-        k_pages[:, page_tables].reshape(KV, B, ctx, hd), 0, 2
-    )
-    v = jnp.moveaxis(
-        v_pages[:, page_tables].reshape(KV, B, ctx, hd), 0, 2
-    )
+    if layer is not None:
+        L = k_pages.shape[0]
+        head_idx = (layer * KV + jnp.arange(KV))[:, None, None]
+        k_flat = k_pages.reshape(L * KV, *k_pages.shape[2:])
+        v_flat = v_pages.reshape(L * KV, *v_pages.shape[2:])
+        k_sel = k_flat[head_idx, page_tables[None]]
+        v_sel = v_flat[head_idx, page_tables[None]]
+    else:
+        k_sel = k_pages[:, page_tables]
+        v_sel = v_pages[:, page_tables]
+    k = jnp.moveaxis(k_sel.reshape(KV, B, ctx, hd), 0, 2)
+    v = jnp.moveaxis(v_sel.reshape(KV, B, ctx, hd), 0, 2)
     # key blocks must divide the window; fall back to page-sized blocks
     # for windows that aren't a multiple of 256 tokens
-    block_k = 256 if ctx % 256 == 0 else k_pages.shape[2]
+    block_k = 256 if ctx % 256 == 0 else page_size
     return flash_prefill_attention(
         q, k, v, seq_lens, block_k=block_k, q_offset=prefix_lens,
         softcap=softcap, window=window, scale=scale,

@@ -86,7 +86,9 @@ _DTYPES = {
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "mesh", "use_pallas", "num_logprobs"),
+    static_argnames=(
+        "spec", "mesh", "use_pallas", "num_logprobs", "kv_carry"
+    ),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _prefill_step(
@@ -94,11 +96,11 @@ def _prefill_step(
     page_tables, temps, top_ps, top_ks, key, mesh=None, use_pallas=False,
     seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None,
+    min_toks=None, stop_id_mat=None, kv_carry: bool = False,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
-        mesh=mesh, use_pallas=use_pallas,
+        mesh=mesh, use_pallas=use_pallas, kv_carry=kv_carry,
     )
     if counts is not None:
         # post-preemption re-prefill: folded outputs still count toward
@@ -123,7 +125,7 @@ def _prefill_step(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "num_logprobs"),
+    static_argnames=("spec", "num_logprobs", "kv_carry"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _suffix_prefill_step(
@@ -131,13 +133,13 @@ def _suffix_prefill_step(
     v_pages, suffix_page_tables, ctx_page_tables, temps, top_ps, top_ks,
     key, seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
-    min_toks=None, stop_id_mat=None,
+    min_toks=None, stop_id_mat=None, kv_carry: bool = False,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
     logits, k_pages, v_pages = prefill_suffix_forward(
         params, spec, tokens, prefix_lens, suffix_lens, k_pages, v_pages,
-        suffix_page_tables, ctx_page_tables,
+        suffix_page_tables, ctx_page_tables, kv_carry=kv_carry,
     )
     if counts is not None:
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
@@ -576,6 +578,11 @@ class EngineCore:
         )
         self._pp = pp_size
         self._sp = sp_size
+        # carry-threaded KV pools (config.tpu.kv_carry): plain meshes
+        # only — the sp/pp forwards keep their own threading
+        self._kv_carry = bool(
+            tpu_cfg.kv_carry and self._fwd_mesh is None
+        )
         if sp_size > 1:
             bad = [
                 b for b in self.scheduler.prefill_buckets if b % sp_size
@@ -1086,6 +1093,7 @@ class EngineCore:
             pres_pens=pen_pres,
             min_toks=mt,
             stop_id_mat=mt_ids,
+            kv_carry=self._kv_carry,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1182,6 +1190,7 @@ class EngineCore:
             pres_pens=pen_pres,
             min_toks=mt,
             stop_id_mat=mt_ids,
+            kv_carry=self._kv_carry,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1245,6 +1254,7 @@ class EngineCore:
                 self._step_key(),
                 seeds=jnp.full((1,), -1, jnp.int32),
                 steps=jnp.zeros((1,), jnp.int32),
+                kv_carry=self._kv_carry,
             )
             start += n
         # final chunk: exactly a B=1 suffix-group dispatch with
@@ -1428,7 +1438,7 @@ class EngineCore:
             min_toks=state["min_toks"],
             stop_id_mat=state["stop_id_mat"],
             all_greedy=all_greedy,
-            kv_carry=self.config.tpu.kv_carry_decode,
+            kv_carry=self._kv_carry,
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
