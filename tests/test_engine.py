@@ -817,3 +817,98 @@ def test_chunked_prefill_rejects_sp_pp():
     )
     with pytest.raises(ValueError, match="prefill_chunk"):
         EngineCore(cfg, devices=jax.devices()[:2])
+
+
+# ------------------------------------------------------ client aborts
+
+def test_abort_running_sequence_frees_resources():
+    """request_abort on a RUNNING sequence: the engine finishes it with
+    reason "abort" at its next tick, frees slot+pages, and co-resident
+    sequences complete untouched."""
+    core = EngineCore(
+        tiny_config(decode_chunk=1), devices=jax.devices()[:1]
+    )
+    core.start()
+    try:
+        victim = core.submit_tokens([3] * 12, greedy(40))
+        mate = core.submit_tokens([9] * 12, greedy(10))
+        # cancel as soon as the first token lands (decode_chunk=1 on the
+        # CPU-pinned test mesh steps in milliseconds, so the remaining
+        # 39-token budget cannot complete inside this tight poll)
+        import time as _t
+
+        for _ in range(2000):
+            if victim.num_output_tokens >= 1:
+                break
+            _t.sleep(0.005)
+        assert victim.num_output_tokens >= 1
+        victim.request_abort()
+        assert victim.done_event.wait(120)
+        assert victim.finish_reason == "abort"
+        assert victim.num_output_tokens < 40  # stopped early
+        assert mate.done_event.wait(300)
+        assert mate.num_output_tokens == 10
+        stats = core.scheduler.get_stats()
+        assert stats["aborted"] == 1
+        assert stats["running"] == 0
+        assert stats["used_pages"] == 0
+    finally:
+        core.stop()
+
+
+def test_abort_waiting_sequence_drops_at_queue_head():
+    """A queued (not yet admitted) sequence whose client cancelled is
+    dropped when it reaches the queue head, never prefilled."""
+    core = EngineCore(
+        tiny_config(max_batch_slots=1), devices=jax.devices()[:1]
+    )
+    core.start()
+    try:
+        runner = core.submit_tokens([3] * 8, greedy(8))
+        queued = core.submit_tokens([5] * 8, greedy(8))
+        queued.request_abort()
+        assert queued.done_event.wait(300)
+        assert queued.finish_reason == "abort"
+        assert queued.num_output_tokens == 0
+        assert runner.done_event.wait(300)
+        assert runner.num_output_tokens == 8
+        assert core.scheduler.get_stats()["aborted"] == 1
+    finally:
+        core.stop()
+
+
+def test_stream_disconnect_aborts_sequence():
+    """Closing the SSE token stream mid-generation (client disconnect)
+    aborts the underlying sequence instead of decoding to completion."""
+    import asyncio
+
+    from vgate_tpu.backends.jax_backend import JaxTPUBackend
+
+    backend = JaxTPUBackend()
+    backend.load_model(tiny_config(decode_chunk=1, num_devices=1))
+    try:
+        async def run():
+            agen = backend.stream_async(
+                "stream abort probe",
+                SamplingParams(max_tokens=40, temperature=0.0),
+            )
+            await agen.__anext__()  # first delta arrived
+            await agen.aclose()  # client went away
+
+        asyncio.run(run())
+        core = backend.core
+        deadline = 120
+        import time as _t
+
+        t0 = _t.perf_counter()
+        while (
+            core.scheduler.get_stats()["running"] > 0
+            and _t.perf_counter() - t0 < deadline
+        ):
+            _t.sleep(0.05)
+        stats = core.scheduler.get_stats()
+        assert stats["running"] == 0
+        assert stats["used_pages"] == 0
+        assert stats["aborted"] == 1
+    finally:
+        backend.shutdown()

@@ -184,7 +184,18 @@ class JaxTPUBackend:
                 if not isinstance(seq, BaseException):
                     seq.done_event.wait()
 
-        await loop.run_in_executor(None, wait_all)
+        try:
+            await loop.run_in_executor(None, wait_all)
+        except asyncio.CancelledError:
+            # the awaiting task died (client disconnect on a direct
+            # caller) — release the engine work it was waiting on.
+            # NB batched gateway traffic runs under the batcher's own
+            # task, which client disconnects do NOT cancel; per-request
+            # cancellation there would need request-scoped plumbing.
+            for seq in seqs:
+                if not isinstance(seq, BaseException):
+                    seq.request_abort()
+            raise
         results: List[Any] = []
         for seq in seqs:
             if isinstance(seq, BaseException):
@@ -251,13 +262,19 @@ class JaxTPUBackend:
         q: "asyncio.Queue[Optional[int]]" = asyncio.Queue()
 
         def on_token(token: int) -> None:
-            loop.call_soon_threadsafe(q.put_nowait, token)
+            try:
+                loop.call_soon_threadsafe(q.put_nowait, token)
+            except RuntimeError:
+                pass  # loop closed: consumer disconnected, abort follows
 
         seq = self.core.submit_prompt(prompt, params, stream_cb=on_token)
 
         def on_done() -> None:
             seq.done_event.wait()
-            loop.call_soon_threadsafe(q.put_nowait, None)
+            try:
+                loop.call_soon_threadsafe(q.put_nowait, None)
+            except RuntimeError:
+                pass  # loop closed: nothing left to notify
 
         threading.Thread(target=on_done, daemon=True).start()
 
@@ -274,38 +291,54 @@ class JaxTPUBackend:
 
         stops = params.stop or []
         longest_stop = max((len(s) for s in stops), default=0)
-        while True:
-            token = await q.get()
-            if token is None:
-                # flush the held-back tail: the engine's own stop detection
-                # is authoritative (final_text truncates at a stop match)
-                final = self.core.final_text(seq)
-                if len(final) > len(emitted) or pending_lp:
-                    yield wrap(final[len(emitted):])
-                break
-            ids.append(token)
-            if params.logprobs and len(seq.logprob_data) >= len(ids):
-                lp, top = seq.logprob_data[len(ids) - 1]
-                pending_lp.append(self.core.lp_entry(token, lp, top))
-            text = self.core.tokenizer.decode(ids)
-            if stops:
-                cut = min(
-                    (i for i in (text.find(s) for s in stops) if i != -1),
-                    default=-1,
-                )
-                if cut >= 0:
-                    if cut > len(emitted) or pending_lp:
-                        # flush even a zero-length delta: the entries for
-                        # the stop-completing tokens must not vanish
-                        yield wrap(text[len(emitted):cut])
+        completed = False
+        try:
+            while True:
+                token = await q.get()
+                if token is None:
+                    # flush the held-back tail: the engine's own stop
+                    # detection is authoritative (final_text truncates
+                    # at a stop match)
+                    final = self.core.final_text(seq)
+                    if len(final) > len(emitted) or pending_lp:
+                        yield wrap(final[len(emitted):])
                     break
-                # hold back a stop-length tail so a stop string arriving
-                # across several tokens is never partially emitted
-                text = text[: max(len(emitted), len(text) - longest_stop)]
-            if len(text) > len(emitted):
-                delta = text[len(emitted):]
-                emitted = text
-                yield wrap(delta)
+                ids.append(token)
+                if params.logprobs and len(seq.logprob_data) >= len(ids):
+                    lp, top = seq.logprob_data[len(ids) - 1]
+                    pending_lp.append(self.core.lp_entry(token, lp, top))
+                text = self.core.tokenizer.decode(ids)
+                if stops:
+                    cut = min(
+                        (
+                            i
+                            for i in (text.find(s) for s in stops)
+                            if i != -1
+                        ),
+                        default=-1,
+                    )
+                    if cut >= 0:
+                        if cut > len(emitted) or pending_lp:
+                            # flush even a zero-length delta: the entries
+                            # for the stop-completing tokens must not
+                            # vanish
+                            yield wrap(text[len(emitted):cut])
+                        break
+                    # hold back a stop-length tail so a stop string
+                    # arriving across several tokens is never partially
+                    # emitted
+                    text = text[: max(len(emitted), len(text) - longest_stop)]
+                if len(text) > len(emitted):
+                    delta = text[len(emitted):]
+                    emitted = text
+                    yield wrap(delta)
+            completed = True
+        finally:
+            if not completed and not seq.done_event.is_set():
+                # the consumer went away mid-stream (SSE client
+                # disconnect cancels the handler, closing this
+                # generator) — stop burning decode steps on it
+                seq.request_abort()
         if seq.status is SeqStatus.FAILED:
             raise seq.error  # type: ignore[misc]
         if on_finish is not None:

@@ -767,6 +767,7 @@ class EngineCore:
         Returns False when there was no work (the loop then sleeps).
         """
         self._drain_submissions()
+        self._handle_aborts()
         if self.spec_k > 0:
             worked = self._admit_and_prefill()
             return self._tick_speculative() or worked
@@ -848,6 +849,17 @@ class EngineCore:
             s for s in self.scheduler.running
             if s.status is SeqStatus.RUNNING
         ]
+
+    def _handle_aborts(self) -> None:
+        """Drop RUNNING sequences whose client cancelled (SSE disconnect
+        etc.): slot + pages free immediately, finish_reason "abort".
+        In-flight chunks may still hold the sequence — the per-chunk
+        epoch/status check discards their tokens at readback.  Waiting-
+        queue aborts drop when they reach the queue head
+        (scheduler.try_admit)."""
+        for seq in self._running_seqs():
+            if seq.abort_requested:
+                self.scheduler.abort(seq)
 
     @staticmethod
     def _all_greedy(seqs, num_lp: int) -> bool:

@@ -110,6 +110,7 @@ class Scheduler:
         self.total_preemptions = 0
         self.total_admitted = 0
         self.total_finished = 0
+        self.total_aborted = 0
 
     # -- admission --
 
@@ -235,6 +236,11 @@ class Scheduler:
 
     def try_admit(self) -> Optional[PrefillPlan]:
         self._shed_expired()
+        # client-cancelled requests drop as they reach the queue head
+        # (head-only keeps this race-free vs. concurrent add())
+        while self.waiting and self.waiting[0].abort_requested:
+            self.abort(self.waiting.popleft())
+            metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
         if not self.waiting:
             return None
         slot = self._free_slot()
@@ -380,16 +386,28 @@ class Scheduler:
 
     # -- completion --
 
-    def remove(self, seq: Sequence) -> None:
-        """Release residency after finish/failure."""
+    def _release_residency(self, seq: Sequence) -> None:
         if seq.pages:
             self.allocator.release(seq.pages)
             seq.pages = []
         if seq.slot is not None and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
         seq.slot = None
-        self.total_finished += 1
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
+
+    def remove(self, seq: Sequence) -> None:
+        """Release residency after finish/failure."""
+        self._release_residency(seq)
+        self.total_finished += 1
+
+    def abort(self, seq: Sequence) -> None:
+        """Client cancellation: release any residency, account it as
+        aborted (NOT finished — the two are disjoint outcomes), and
+        finish the sequence with reason "abort".  The single owner of
+        abort bookkeeping for both the running and queued paths."""
+        self._release_residency(seq)
+        self.total_aborted += 1
+        seq.finish("abort")
 
     def get_stats(self) -> dict:
         return {
@@ -402,6 +420,7 @@ class Scheduler:
             "finished": self.total_finished,
             "preemptions": self.total_preemptions,
             "deadline_shed": self.total_deadline_shed,
+            "aborted": self.total_aborted,
             "prefix_cache": {
                 "enabled": self.prefix_cache,
                 "hit_tokens": self.total_prefix_hit_tokens,

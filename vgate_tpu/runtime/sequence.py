@@ -50,6 +50,11 @@ class Sequence:
     # per-delivered-token logprob data, aligned with generated_ids (only
     # filled when params.logprobs): (chosen_lp, [(token_id, lp), ...])
     logprob_data: List[tuple] = field(default_factory=list)
+    # client-side cancellation (e.g. SSE disconnect): set from ANY
+    # thread; the engine thread honors it at its next tick, finishing
+    # the sequence with reason "abort" and freeing its slot/pages —
+    # the capability vLLM exposes as abort_request, first-party here
+    abort_requested: bool = False
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -94,6 +99,12 @@ class Sequence:
         self.generated_ids.append(token)
         if self.stream_cb is not None:
             self.stream_cb(token)
+
+    def request_abort(self) -> None:
+        """Ask the engine to drop this sequence (thread-safe, advisory:
+        tokens already in flight may still append before the engine
+        processes the abort)."""
+        self.abort_requested = True
 
     def finish(self, reason: str) -> None:
         self.status = SeqStatus.FINISHED
