@@ -477,3 +477,50 @@ def test_kv_carry_parity_all_forwards():
             ),
             "decode",
         )
+
+
+def test_kv_carry_parity_spec_verify():
+    """Carry vs xs/ys parity for the speculative verify forward (valid
+    candidate rows only — rows past input_lens are unspecified)."""
+    import numpy as np
+
+    from vgate_tpu.models.decoder import (
+        init_params, prefill_forward, spec_verify_forward,
+    )
+    from vgate_tpu.models.specs import TINY_DENSE as spec
+
+    ps, pps, B, S = 4, 8, 2, 4
+    params = init_params(spec, jax.random.PRNGKey(3), jnp.float32)
+    P = 1 + B * pps
+    shape = (spec.num_layers, spec.num_kv_heads, P, ps, spec.head_dim)
+    k0 = jnp.zeros(shape, jnp.float32)
+    v0 = jnp.zeros(shape, jnp.float32)
+    pt = jnp.asarray(1 + np.arange(B * pps).reshape(B, pps), jnp.int32)
+    rng = np.random.default_rng(2)
+    prompts = jnp.asarray(
+        rng.integers(2, spec.vocab_size, (B, 8)), jnp.int32
+    )
+    _, kf, vf = prefill_forward(
+        params, spec, prompts, jnp.asarray([8, 6], jnp.int32), k0, v0,
+        pt[:, :2],
+    )
+    cand = jnp.asarray(
+        rng.integers(2, spec.vocab_size, (B, S)), jnp.int32
+    )
+    args = (
+        params, spec, cand, jnp.asarray([8, 6], jnp.int32),
+        jnp.asarray([4, 2], jnp.int32), kf, vf, pt,
+    )
+    a = spec_verify_forward(
+        *args, active=jnp.asarray([True, True]), kv_carry=False
+    )
+    b = spec_verify_forward(
+        *args, active=jnp.asarray([True, True]), kv_carry=True
+    )
+    in_lens = [4, 2]
+    for bb in range(B):
+        n = in_lens[bb]
+        np.testing.assert_allclose(
+            np.asarray(a[0][bb, :n]), np.asarray(b[0][bb, :n]),
+            rtol=1e-5, atol=1e-5,
+        )

@@ -438,3 +438,43 @@ def test_paged_decode_kernel_layer_indexed():
             np.asarray(got), np.asarray(expect), rtol=1e-5, atol=1e-5,
             err_msg=f"layer {layer}",
         )
+
+
+def test_multitok_kernel_layer_indexed():
+    """Carry-threaded spec verify: the multitok kernel with the stacked
+    pool + layer index must match slicing the layer out first."""
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_multitok_attention_pallas,
+    )
+
+    L, B, S, H, KV, hd, ps, pps = 2, 2, 4, 4, 2, 128, 16, 4
+    rng = np.random.default_rng(21)
+    P = 1 + B * pps
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    stacked_k = jnp.asarray(
+        rng.normal(size=(L, KV, P, ps, hd)), jnp.float32
+    )
+    stacked_v = jnp.asarray(
+        rng.normal(size=(L, KV, P, ps, hd)), jnp.float32
+    )
+    pt = jnp.asarray(
+        1 + np.arange(B * pps).reshape(B, pps), jnp.int32
+    )
+    pos0 = jnp.asarray([9, 30], jnp.int32)
+    in_lens = jnp.asarray([4, 2], jnp.int32)
+    for layer in range(L):
+        expect = paged_multitok_attention_pallas(
+            q, stacked_k[layer], stacked_v[layer], pt, pos0, in_lens,
+            interpret=True,
+        )
+        got = paged_multitok_attention_pallas(
+            q, stacked_k, stacked_v, pt, pos0, in_lens,
+            layer=jnp.asarray(layer, jnp.int32), interpret=True,
+        )
+        # rows past input_lens are unspecified; compare valid rows only
+        for b in range(B):
+            n = int(in_lens[b])
+            np.testing.assert_allclose(
+                np.asarray(got[b, :n]), np.asarray(expect[b, :n]),
+                rtol=1e-5, atol=1e-5, err_msg=f"layer {layer} b {b}",
+            )

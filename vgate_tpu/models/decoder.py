@@ -766,6 +766,7 @@ def spec_verify_forward(
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     active: Optional[jnp.ndarray] = None,  # [B] bool
     use_pallas: bool = False,
+    kv_carry: bool = False,  # thread FULL KV buffers as scan carry
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Speculative-decoding verification: score ``S`` candidate tokens per
     slot in one pass over the paged KV cache (runtime/speculative.py).
@@ -804,36 +805,68 @@ def spec_verify_forward(
             paged_multitok_attention_pallas,
         )
 
-    def layer_fn(h, per_layer):
-        lp, win, k_pages_l, v_pages_l = per_layer
+    def verify_layer(h, lp, win, kp, vp, layer=None):
+        """One verify layer against either a per-layer pool slice
+        (layer=None; xs/ys threading) or the full stacked pools with a
+        layer index (carry threading)."""
         normed = rms_norm(
             h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
         )
         q, k, v = _project_qkv(normed, lp, spec)
         q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
         k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
-        k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
-            jnp.transpose(k, (2, 0, 1, 3))
-        )
-        v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
-            jnp.transpose(v, (2, 0, 1, 3))
-        )
+        if layer is None:
+            kp = kp.at[:, page_ids, page_off].set(
+                jnp.transpose(k, (2, 0, 1, 3))
+            )
+            vp = vp.at[:, page_ids, page_off].set(
+                jnp.transpose(v, (2, 0, 1, 3))
+            )
+        else:
+            # mixed scalar/slice/array indexing: broadcast (B, S) dims
+            # move to the front — update shape [B, S, KV, hd], k/v as-is
+            kp = kp.at[layer, :, page_ids, page_off].set(k)
+            vp = vp.at[layer, :, page_ids, page_off].set(v)
         window = win if spec.sliding_window > 0 else None
         if use_pallas:
             attn = paged_multitok_attention_pallas(
-                q, k_pages_l, v_pages_l, page_tables, positions0,
-                input_lens, window=window,
+                q, kp, vp, page_tables, positions0,
+                input_lens, window=window, layer=layer,
                 softcap=spec.attn_softcap, scale=_query_scale(spec),
             )
         else:
             attn = paged_suffix_attention(
-                q, k_pages_l, v_pages_l, page_tables, positions0,
+                q, kp, vp, page_tables, positions0,
                 total_lens, softcap=spec.attn_softcap, window=window,
-                scale=_query_scale(spec),
+                scale=_query_scale(spec), layer=layer,
             )
-        return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
+        return _finish_layer(h, attn, lp, spec), kp, vp
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
-    )
+    if kv_carry:
+        def carry_layer_fn(carry, per_layer):
+            h, kp, vp = carry
+            lp, win, l = per_layer
+            h, kp, vp = verify_layer(h, lp, win, kp, vp, layer=l)
+            return (h, kp, vp), None
+
+        (x, k_pages, v_pages), _ = jax.lax.scan(
+            carry_layer_fn,
+            (x, k_pages, v_pages),
+            (
+                params["layers"],
+                windows,
+                jnp.arange(spec.num_layers, dtype=jnp.int32),
+            ),
+        )
+    else:
+        def layer_fn(h, per_layer):
+            lp, win, k_pages_l, v_pages_l = per_layer
+            h, k_pages_l, v_pages_l = verify_layer(
+                h, lp, win, k_pages_l, v_pages_l
+            )
+            return h, (k_pages_l, v_pages_l)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+        )
     return _logits(params, spec, x), k_pages, v_pages

@@ -299,9 +299,10 @@ def _mt_kernel(
     positions0_ref,  # [B] int32 — global position of query row 0
     input_lens_ref,  # [B] int32 — real query rows this slot (<= S)
     window_ref,  # [1] int32; >0 => attend only to the last `window`
+    layer_ref,  # [1] int32; pool layer index (-1 => no layer dim)
     # inputs
     q_ref,  # [1, 1, S, G, hd] VMEM block for (b, g)
-    k_pages_ref,  # [KV, P, ps, hd] ANY/HBM
+    k_pages_ref,  # [KV, P, ps, hd] ANY/HBM ([L, KV, ...] when has_layer)
     v_pages_ref,
     # output
     out_ref,  # [1, 1, S, G, hd]
@@ -316,6 +317,7 @@ def _mt_kernel(
     page_size: int,
     softcap: float,
     scale: float,
+    has_layer: bool = False,
 ):
     """Multi-token decode attention: S candidate tokens per slot attend
     the slot's paged context in one program (the speculative-decoding
@@ -340,6 +342,7 @@ def _mt_kernel(
     start_chunk, wait_chunk = _chunk_dma(
         page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
         b, g, n_pages, page_size,
+        layer=layer_ref[0] if has_layer else None,
     )
 
     S, G, hd = q_ref.shape[-3], q_ref.shape[-2], q_ref.shape[-1]
@@ -415,12 +418,13 @@ def _mt_kernel(
 )
 def paged_multitok_attention_pallas(
     q: jnp.ndarray,  # [B, S, H, hd] candidate-token queries
-    k_pages: jnp.ndarray,  # [KV, P, ps, hd]
+    k_pages: jnp.ndarray,  # [KV, P, ps, hd] ([L, KV, ...] with `layer`)
     v_pages: jnp.ndarray,
     page_tables: jnp.ndarray,  # [B, pages_per_seq]
     positions0: jnp.ndarray,  # [B] global position of q[:, 0]
     input_lens: jnp.ndarray,  # [B] real candidate rows (<= S)
     window=None,
+    layer=None,  # int32 scalar: pool layer index (carry-threaded verify)
     interpret: bool = False,
     softcap: float = 0.0,
     scale=None,
@@ -433,7 +437,8 @@ def paged_multitok_attention_pallas(
     context) — callers must mask by ``input_lens``, as the engine and
     the tests do."""
     B, S, H, hd = q.shape
-    KV, P, ps, _ = k_pages.shape
+    has_layer = layer is not None
+    KV, P, ps, _ = k_pages.shape[1:] if has_layer else k_pages.shape
     G = H // KV
     chunk_tokens = CHUNK_PAGES * ps
 
@@ -441,14 +446,20 @@ def paged_multitok_attention_pallas(
         window_arr = jnp.zeros((1,), jnp.int32)
     else:
         window_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    layer_arr = (
+        jnp.asarray(layer, jnp.int32).reshape(1)
+        if has_layer
+        else jnp.full((1,), -1, jnp.int32)
+    )
     kernel = functools.partial(
         _mt_kernel,
         page_size=ps,
         softcap=float(softcap),
         scale=float(scale) if scale is not None else hd ** -0.5,
+        has_layer=has_layer,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(B, KV),
         in_specs=[
             pl.BlockSpec(
@@ -487,7 +498,7 @@ def paged_multitok_attention_pallas(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
     )(
-        page_tables, positions0, input_lens, window_arr,
+        page_tables, positions0, input_lens, window_arr, layer_arr,
         qt, k_pages, v_pages,
     )
     return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, S, H, hd)

@@ -268,7 +268,9 @@ def _decode_chunk(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "use_pallas", "num_logprobs", "all_greedy"),
+    static_argnames=(
+        "spec", "use_pallas", "num_logprobs", "all_greedy", "kv_carry"
+    ),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _spec_verify_step(
@@ -277,6 +279,7 @@ def _spec_verify_step(
     seeds=None, steps=None, use_pallas=False, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, all_greedy: bool = False,
+    kv_carry: bool = False,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), then verify every
@@ -291,6 +294,7 @@ def _spec_verify_step(
     logits, k_pages, v_pages = spec_verify_forward(
         params, spec, tokens, positions0, input_lens, k_pages, v_pages,
         page_tables, active=active, use_pallas=use_pallas,
+        kv_carry=kv_carry,
     )  # [B, S, V]
     B, S = tokens.shape
     if counts is not None:
@@ -1662,6 +1666,7 @@ class EngineCore:
                 min_toks=spec_mt,
                 stop_id_mat=spec_mt_ids,
                 all_greedy=all_greedy,
+                kv_carry=self._kv_carry,
             )
         )
         if want_pen:
