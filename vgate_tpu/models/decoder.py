@@ -260,6 +260,44 @@ def _layer_windows(spec: ModelSpec) -> jnp.ndarray:
     return jnp.asarray(spec.layer_windows, jnp.int32)
 
 
+def _kv_layer_scan(params, spec: ModelSpec, body, x0, k_pages, v_pages,
+                   kv_carry: bool):
+    """The one layer-scan scaffold every forward shares.
+
+    ``body(h, lp, win, kp, vp, layer)`` runs one transformer layer and
+    returns ``(h, kp, vp)``; ``layer`` is ``None`` under xs/ys threading
+    (kp/vp are that layer's pool slices) and a traced layer index under
+    carry threading (kp/vp are the FULL stacked pools, updated in place).
+    Returns ``(x, k_pages, v_pages)``."""
+    windows = _layer_windows(spec)
+    if kv_carry:
+        def fn(carry, per_layer):
+            h, kp, vp = carry
+            lp, win, l = per_layer
+            h, kp, vp = body(h, lp, win, kp, vp, l)
+            return (h, kp, vp), None
+
+        (x, k_pages, v_pages), _ = jax.lax.scan(
+            fn,
+            (x0, k_pages, v_pages),
+            (
+                params["layers"],
+                windows,
+                jnp.arange(spec.num_layers, dtype=jnp.int32),
+            ),
+        )
+    else:
+        def fn(h, per_layer):
+            lp, win, kp, vp = per_layer
+            h, kp, vp = body(h, lp, win, kp, vp, None)
+            return h, (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            fn, x0, (params["layers"], windows, k_pages, v_pages)
+        )
+    return x, k_pages, v_pages
+
+
 def prefill_forward(
     params: Params,
     spec: ModelSpec,
@@ -324,51 +362,25 @@ def prefill_forward(
             scale=_query_scale(spec),
         )
     x = _embed(params, spec, tokens)  # [B, S, D]
-    windows = _layer_windows(spec)
+    # the prompt pass only WRITES pages (attention runs over the fresh
+    # k/v), so carry threading just swaps xs/ys slice threading for
+    # layer-indexed in-place writes
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
 
-    if kv_carry:
-        # carry-threaded pools: the prompt pass only WRITES pages
-        # (attention runs over the fresh k/v), so the carry form just
-        # swaps xs/ys slice threading for layer-indexed in-place writes
-        positions = jnp.broadcast_to(
-            jnp.arange(S)[None, :], (B, S)
+    def body(h, lp, win, kp, vp, layer):
+        q, k, v, kp, vp = _prefill_qkv_write(
+            h, lp, spec, positions, page_tables, kp, vp, layer=layer
         )
+        win_arg = win if spec.sliding_window > 0 else None
+        if win_arg is None:
+            attn = attn_fn(q, k, v, seq_lens)
+        else:
+            attn = attn_fn(q, k, v, seq_lens, window=win_arg)
+        return _finish_layer(h, attn, lp, spec), kp, vp
 
-        def carry_layer_fn(carry, per_layer):
-            h, kp, vp = carry
-            lp, win, l = per_layer
-            q, k, v, kp, vp = _prefill_qkv_write(
-                h, lp, spec, positions, page_tables, kp, vp, layer=l
-            )
-            win_arg = win if spec.sliding_window > 0 else None
-            if win_arg is None:
-                attn = attn_fn(q, k, v, seq_lens)
-            else:
-                attn = attn_fn(q, k, v, seq_lens, window=win_arg)
-            return (_finish_layer(h, attn, lp, spec), kp, vp), None
-
-        (x, k_pages, v_pages), _ = jax.lax.scan(
-            carry_layer_fn,
-            (x, k_pages, v_pages),
-            (
-                params["layers"],
-                windows,
-                jnp.arange(spec.num_layers, dtype=jnp.int32),
-            ),
-        )
-    else:
-        def layer_fn(h, per_layer):
-            lp, win, k_pages_l, v_pages_l = per_layer
-            h, k_pages_l, v_pages_l = prefill_layer(
-                h, lp, k_pages_l, v_pages_l, spec=spec, seq_lens=seq_lens,
-                page_tables=page_tables, attn_fn=attn_fn,
-                window=win if spec.sliding_window > 0 else None,
-            )
-            return h, (k_pages_l, v_pages_l)
-
-        x, (k_pages, v_pages) = jax.lax.scan(
-            layer_fn, x, (params["layers"], windows, k_pages, v_pages)
-        )
+    x, k_pages, v_pages = _kv_layer_scan(
+        params, spec, body, x, k_pages, v_pages, kv_carry
+    )
     last_idx = jnp.clip(seq_lens - 1, 0, S - 1)
     last_hidden = jnp.take_along_axis(
         x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1
@@ -618,55 +630,37 @@ def decode_forward(
     )
 
     x = _embed(params, spec, tokens)  # [B, D]
-    windows = _layer_windows(spec)
 
-    if kv_carry:
-        # Carry-threaded KV: the FULL [L, ...] pools ride the scan carry
-        # with layer-indexed in-place updates, and attention reads the
-        # pool at layer l directly (Pallas: layer-indexed DMA; jnp: one
-        # composed gather).  The xs/ys form below dynamic-slices each
-        # layer's whole [KV, P, ps, hd] pool into a fresh buffer per
-        # layer to feed the attention op — at serving pool sizes that is
-        # ~2x67 MB of pure copy per layer per step, larger than the live
-        # KV itself.  Carry threading eliminates it.
-        def carry_layer_fn(carry, per_layer):
-            h, kp, vp = carry
-            lp, win, l = per_layer
-            q, k, v = _decode_qkv(h, lp, spec, positions)
-            # NB mixed scalar/slice/array indexing: the broadcast (batch)
+    # Carry threading (kv_carry=True): the FULL [L, ...] pools ride the
+    # scan carry with layer-indexed in-place updates, and attention reads
+    # the pool at layer l directly (Pallas: layer-indexed DMA; jnp: one
+    # composed gather).  The xs/ys form dynamic-slices each layer's whole
+    # [KV, P, ps, hd] pool into a fresh buffer per layer to feed the
+    # attention op — at serving pool sizes that is ~2x67 MB of pure copy
+    # per layer per step, larger than the live KV itself.
+    def body(h, lp, win, kp, vp, layer):
+        q, k, v = _decode_qkv(h, lp, spec, positions)
+        if layer is None:
+            kp = kp.at[:, page_ids, page_off].set(
+                jnp.transpose(k, (1, 0, 2))
+            )
+            vp = vp.at[:, page_ids, page_off].set(
+                jnp.transpose(v, (1, 0, 2))
+            )
+        else:
+            # mixed scalar/slice/array indexing: the broadcast (batch)
             # dim moves to the FRONT, so the update shape is [B, KV, hd]
             # — k/v as projected, no transpose
-            kp = kp.at[l, :, page_ids, page_off].set(k)
-            vp = vp.at[l, :, page_ids, page_off].set(v)
-            attn = attn_fn(
-                q, kp, vp, page_tables, seq_lens, layer=l,
-                window=win if spec.sliding_window > 0 else None,
-            )
-            return (_finish_layer(h, attn, lp, spec), kp, vp), None
-
-        (x, k_pages, v_pages), _ = jax.lax.scan(
-            carry_layer_fn,
-            (x, k_pages, v_pages),
-            (
-                params["layers"],
-                windows,
-                jnp.arange(spec.num_layers, dtype=jnp.int32),
-            ),
-        )
-        return _logits(params, spec, x), k_pages, v_pages
-
-    def layer_fn(h, per_layer):
-        lp, win, k_pages_l, v_pages_l = per_layer
-        h, k_pages_l, v_pages_l = decode_layer(
-            h, lp, k_pages_l, v_pages_l, spec=spec, positions=positions,
-            page_ids=page_ids, page_off=page_off, page_tables=page_tables,
-            seq_lens=seq_lens, attn_fn=attn_fn,
+            kp = kp.at[layer, :, page_ids, page_off].set(k)
+            vp = vp.at[layer, :, page_ids, page_off].set(v)
+        attn = attn_fn(
+            q, kp, vp, page_tables, seq_lens, layer=layer,
             window=win if spec.sliding_window > 0 else None,
         )
-        return h, (k_pages_l, v_pages_l)
+        return _finish_layer(h, attn, lp, spec), kp, vp
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+    x, k_pages, v_pages = _kv_layer_scan(
+        params, spec, body, x, k_pages, v_pages, kv_carry
     )
     return _logits(params, spec, x), k_pages, v_pages
 
@@ -699,55 +693,27 @@ def prefill_suffix_forward(
     positions = prefix_lens[:, None] + jnp.arange(S)[None, :]  # absolute
     total_lens = prefix_lens + suffix_lens
     x = _embed(params, spec, tokens)  # [B, S, D]
-    windows = _layer_windows(spec)
 
-    if kv_carry:
-        # carry-threaded pools: both the suffix write AND the paged
-        # context read are layer-indexed on the full [L, ...] buffers —
-        # no per-layer pool slice ever materializes (the chunked-prefill
-        # hot path runs this once per chunk)
-        def carry_layer_fn(carry, per_layer):
-            h, kp, vp = carry
-            lp, win, l = per_layer
-            q, _k, _v, kp, vp = _prefill_qkv_write(
-                h, lp, spec, positions, suffix_page_tables, kp, vp,
-                layer=l,
-            )
-            attn = paged_suffix_attention(
-                q, kp, vp, ctx_page_tables, prefix_lens,
-                total_lens, softcap=spec.attn_softcap,
-                window=win if spec.sliding_window > 0 else None,
-                scale=_query_scale(spec), layer=l,
-            )
-            return (_finish_layer(h, attn, lp, spec), kp, vp), None
-
-        (x, k_pages, v_pages), _ = jax.lax.scan(
-            carry_layer_fn,
-            (x, k_pages, v_pages),
-            (
-                params["layers"],
-                windows,
-                jnp.arange(spec.num_layers, dtype=jnp.int32),
-            ),
+    # carry threading: both the suffix write AND the paged context read
+    # are layer-indexed on the full [L, ...] buffers — no per-layer pool
+    # slice ever materializes (the chunked-prefill hot path runs this
+    # once per chunk)
+    def body(h, lp, win, kp, vp, layer):
+        q, _k, _v, kp, vp = _prefill_qkv_write(
+            h, lp, spec, positions, suffix_page_tables, kp, vp,
+            layer=layer,
         )
-    else:
-        def layer_fn(h, per_layer):
-            lp, win, k_pages_l, v_pages_l = per_layer
-            q, _k, _v, k_pages_l, v_pages_l = _prefill_qkv_write(
-                h, lp, spec, positions, suffix_page_tables, k_pages_l,
-                v_pages_l,
-            )
-            attn = paged_suffix_attention(
-                q, k_pages_l, v_pages_l, ctx_page_tables, prefix_lens,
-                total_lens, softcap=spec.attn_softcap,
-                window=win if spec.sliding_window > 0 else None,
-                scale=_query_scale(spec),
-            )
-            return _finish_layer(h, attn, lp, spec), (k_pages_l, v_pages_l)
-
-        x, (k_pages, v_pages) = jax.lax.scan(
-            layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+        attn = paged_suffix_attention(
+            q, kp, vp, ctx_page_tables, prefix_lens,
+            total_lens, softcap=spec.attn_softcap,
+            window=win if spec.sliding_window > 0 else None,
+            scale=_query_scale(spec), layer=layer,
         )
+        return _finish_layer(h, attn, lp, spec), kp, vp
+
+    x, k_pages, v_pages = _kv_layer_scan(
+        params, spec, body, x, k_pages, v_pages, kv_carry
+    )
     last_idx = jnp.clip(suffix_lens - 1, 0, S - 1)
     last_hidden = jnp.take_along_axis(
         x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1
@@ -799,13 +765,12 @@ def spec_verify_forward(
     page_ids = jnp.where(write_ok, page_ids, 0)  # trash page 0
     total_lens = positions0 + input_lens
     x = _embed(params, spec, tokens)  # [B, S, D]
-    windows = _layer_windows(spec)
     if use_pallas:
         from vgate_tpu.ops.pallas.paged_attention import (
             paged_multitok_attention_pallas,
         )
 
-    def verify_layer(h, lp, win, kp, vp, layer=None):
+    def body(h, lp, win, kp, vp, layer):
         """One verify layer against either a per-layer pool slice
         (layer=None; xs/ys threading) or the full stacked pools with a
         layer index (carry threading)."""
@@ -842,31 +807,7 @@ def spec_verify_forward(
             )
         return _finish_layer(h, attn, lp, spec), kp, vp
 
-    if kv_carry:
-        def carry_layer_fn(carry, per_layer):
-            h, kp, vp = carry
-            lp, win, l = per_layer
-            h, kp, vp = verify_layer(h, lp, win, kp, vp, layer=l)
-            return (h, kp, vp), None
-
-        (x, k_pages, v_pages), _ = jax.lax.scan(
-            carry_layer_fn,
-            (x, k_pages, v_pages),
-            (
-                params["layers"],
-                windows,
-                jnp.arange(spec.num_layers, dtype=jnp.int32),
-            ),
-        )
-    else:
-        def layer_fn(h, per_layer):
-            lp, win, k_pages_l, v_pages_l = per_layer
-            h, k_pages_l, v_pages_l = verify_layer(
-                h, lp, win, k_pages_l, v_pages_l
-            )
-            return h, (k_pages_l, v_pages_l)
-
-        x, (k_pages, v_pages) = jax.lax.scan(
-            layer_fn, x, (params["layers"], windows, k_pages, v_pages)
-        )
+    x, k_pages, v_pages = _kv_layer_scan(
+        params, spec, body, x, k_pages, v_pages, kv_carry
+    )
     return _logits(params, spec, x), k_pages, v_pages
