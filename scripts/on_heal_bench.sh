@@ -1,0 +1,28 @@
+#!/bin/bash
+# Companion to tpu_patient_probe.py: when the probe reports a healthy
+# grant, run the headline bench ONCE, record it, and stop.  Serialized
+# behind the same lockfile discipline as tpu_watch.sh.
+set -u
+cd "$(dirname "$0")/.."
+STATUS=/tmp/vgt_tpu_status.json
+for i in $(seq 1 720); do  # up to 12h of minute-polls
+  if [ -s "$STATUS" ]; then
+    if mkdir /tmp/vgt_tpu.lock 2>/dev/null; then
+      trap 'rmdir /tmp/vgt_tpu.lock 2>/dev/null' EXIT
+      echo "[on_heal] grant healthy at $(date -u +%FT%TZ); running bench" >&2
+      out=$(python bench.py 2>/dev/null | tail -1)
+      {
+        echo ""
+        echo "### first healthy-grant bench ($(date -u +%FT%TZ), auto)"
+        echo '```'
+        echo "$out"
+        echo '```'
+      } >> benchmarks/RESULTS_r3.md
+      echo "$out" > BENCH_r03_candidate.json
+      echo "[on_heal] recorded; exiting" >&2
+      exit 0
+    fi
+  fi
+  sleep 60
+done
+exit 2
