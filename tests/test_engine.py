@@ -912,3 +912,34 @@ def test_stream_disconnect_aborts_sequence():
         assert stats["aborted"] == 1
     finally:
         backend.shutdown()
+
+
+def test_pick_chunk_caps_under_admission_pressure():
+    """With prompts waiting AND a free slot, the next decode chunk caps
+    at decode_chunk/8 so the loop returns to admission quickly; with no
+    free slot (or an empty queue) full-size chunks are kept."""
+    from vgate_tpu.runtime.sequence import Sequence
+
+    core = EngineCore(
+        tiny_config(decode_chunk=32, max_batch_slots=2),
+        devices=jax.devices()[:1],
+    )
+    try:
+        seq = Sequence(prompt_ids=[1, 2, 3], params=greedy(40))
+        seq.output_ids = [5]
+        seq.generated_ids = [5]
+        # idle queue: full chunk
+        assert core._pick_chunk([seq]) == 32
+        # waiting prompt + free slot: capped to decode_chunk/8 = 4
+        core.scheduler.waiting.append(
+            Sequence(prompt_ids=[7], params=greedy(4))
+        )
+        assert core._pick_chunk([seq]) == 4
+        # waiting prompt but slots saturated: full chunk again
+        core.scheduler.slots[0] = seq
+        core.scheduler.slots[1] = Sequence(
+            prompt_ids=[8], params=greedy(4)
+        )
+        assert core._pick_chunk([seq]) == 32
+    finally:
+        core.stop()

@@ -287,3 +287,52 @@ def test_auto_num_pages_dtype_and_hbm_aware():
         dtype_bytes=2, hbm_bytes=32 * 1024**3, **common
     )
     assert double == bf16 * 2
+
+
+def _pressure_sched(num_pages=32, max_slots=2, page_size=4):
+    from vgate_tpu.runtime.kv_cache import PageAllocator
+    from vgate_tpu.runtime.scheduler import Scheduler
+
+    return Scheduler(
+        allocator=PageAllocator(num_pages),
+        max_slots=max_slots,
+        page_size=page_size,
+        prefill_buckets=[8, 16],
+        max_model_len=32,
+    )
+
+
+def test_has_admissible_waiting_distinguishes_blockers():
+    """The admission-pressure predicate is true only when the head of
+    the queue could ACTUALLY be admitted: free slot AND allocatable
+    pages.  Page exhaustion must read as not-admissible (the engine
+    keys chunk shrinking off this — shrinking buys nothing when
+    admission is blocked on pages)."""
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.runtime.sequence import Sequence
+
+    sched = _pressure_sched(num_pages=9, max_slots=2, page_size=4)
+    assert not sched.has_admissible_waiting()  # empty queue
+
+    sp = SamplingParams(max_tokens=4, temperature=0.0)
+    sched.add(Sequence(prompt_ids=[1] * 8, params=sp))  # needs 2 pages
+    assert sched.has_admissible_waiting()
+
+    # drain the pool: 8 allocatable pages (1 reserved) -> take 7
+    held = sched.allocator.allocate(7)
+    assert held is not None
+    assert not sched.has_admissible_waiting()  # pages exhausted
+    sched.allocator.release(held)
+    assert sched.has_admissible_waiting()
+
+    # saturate slots
+    sched.slots[0] = object()
+    sched.slots[1] = object()
+    assert not sched.has_admissible_waiting()
+    sched.slots[0] = sched.slots[1] = None
+
+    # an aborted head is skipped; the next live prompt decides
+    sched.waiting[0].abort_requested = True
+    assert not sched.has_admissible_waiting()  # only entry is aborted
+    sched.add(Sequence(prompt_ids=[2] * 4, params=sp))
+    assert sched.has_admissible_waiting()

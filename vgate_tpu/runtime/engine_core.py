@@ -842,10 +842,7 @@ class EngineCore:
         return (
             worked
             or bool(self._pending_chunks)
-            or (
-                bool(self.scheduler.waiting)
-                and self.scheduler._free_slot() is not None
-            )
+            or self.scheduler.has_admissible_waiting()
         )
 
     def _running_seqs(self) -> List[Sequence]:
@@ -1377,7 +1374,14 @@ class EngineCore:
         neither exceeds ``decode_chunk`` nor overshoots every sequence's
         remaining budget (``lead`` = steps already in flight but not yet
         folded into host state).  Powers of two bound how many chunk-length
-        program variants XLA ever compiles."""
+        program variants XLA ever compiles.
+
+        Admission pressure: when prompts are WAITING and a free slot
+        exists, the chunk caps at decode_chunk/8 so the loop returns to
+        admission within a fraction of a full chunk — a mid-serving
+        arrival's TTFT is then bounded by a short chunk, not up to
+        ``decode_pipeline`` full ones.  With no free slot (or an empty
+        queue) full-size chunks keep throughput maximal."""
         max_len = self.config.model.max_model_len
         headroom = 0
         for seq in active:
@@ -1390,6 +1394,8 @@ class EngineCore:
             # sequence with zero remaining budget is finished at readback)
             return 0
         headroom = min(self.decode_chunk, headroom)
+        if self.scheduler.has_admissible_waiting():
+            headroom = min(headroom, max(1, self.decode_chunk // 8))
         return 1 << (headroom.bit_length() - 1)
 
     def _dispatch_chunk(self, active: List[Sequence], chunk: int) -> None:

@@ -144,6 +144,23 @@ class Scheduler:
                 return i
         return None
 
+    def has_admissible_waiting(self) -> bool:
+        """True when the head-of-queue prompt could actually be admitted
+        right now: a free slot exists AND its pages are allocatable.
+        The engine's admission-pressure signals (re-tick without napping,
+        decode-chunk cap) key off this — page-exhausted queues must NOT
+        shrink chunks or spin, since admission is blocked on a sequence
+        finishing, not on loop latency."""
+        head = None
+        for seq in self.waiting:
+            if not seq.abort_requested:
+                head = seq
+                break
+        if head is None or self._free_slot() is None:
+            return False
+        n_pages = cdiv(max(1, head.num_prompt_tokens), self.page_size)
+        return self.allocator.num_free >= n_pages
+
     # -- planning --
 
     def schedule(self) -> Optional[Plan]:
