@@ -369,10 +369,10 @@ def test_int4_matmul_kernel_f32_out_and_ragged_rows():
     )
 
 
-def test_int4_kernel_gate_dispatch(monkeypatch):
+def test_quant_kernel_gate_dispatch(monkeypatch):
     """weighted_einsum routes 2D packed weights through the kernel when
-    the per-call ``int4_kernel`` flag (threaded from
-    ModelSpec.int4_kernel) is on, and the results agree with the jnp
+    the per-call ``quant_kernel`` flag (threaded from
+    ModelSpec.quant_kernel) is on, and the results agree with the jnp
     path."""
     from vgate_tpu.ops import quant
 
@@ -391,7 +391,7 @@ def test_int4_kernel_gate_dispatch(monkeypatch):
         )
 
     monkeypatch.setattr(qm, "int4_matmul_pallas", fake_kernel)
-    got = quant.weighted_einsum("...d,dh->...h", x, qt, int4_kernel=True)
+    got = quant.weighted_einsum("...d,dh->...h", x, qt, quant_kernel=True)
     assert called.get("yes")
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(base), rtol=2e-4, atol=2e-4
@@ -406,7 +406,7 @@ def test_int4_kernel_gate_dispatch(monkeypatch):
     rng = np.random.default_rng(6)
     we = jnp.asarray(rng.normal(size=(2, 3, 16, 32)), jnp.float32)
     qe = quantize_expert_stacked(we, bits=4)
-    assert not quant._use_int4_kernel("ecd,edf->ecf", qe)
+    assert not quant._use_quant_kernel("ecd,edf->ecf", qe)
 
 
 def test_paged_decode_kernel_layer_indexed():
@@ -478,3 +478,56 @@ def test_multitok_kernel_layer_indexed():
                 np.asarray(got[b, :n]), np.asarray(expect[b, :n]),
                 rtol=1e-5, atol=1e-5, err_msg=f"layer {layer} b {b}",
             )
+
+
+@pytest.mark.parametrize(
+    "lead,in_dim,out",
+    [((4,), 64, 128), ((2, 8), 128, 64), ((5,), 256, 128)],
+)
+def test_int8_matmul_kernel_matches_einsum(lead, in_dim, out):
+    """int8 fused-dequant kernel vs the jnp QTensor einsum path."""
+    from vgate_tpu.ops.pallas.quant_matmul import int8_matmul_pallas
+    from vgate_tpu.ops.quant import quantize_tensor
+
+    rng = np.random.default_rng(31)
+    w = jnp.asarray(rng.normal(size=(in_dim, out)), jnp.float32)
+    qt = quantize_tensor(w, bits=8)
+    x = jnp.asarray(rng.normal(size=(*lead, in_dim)), jnp.float32)
+    expect = jnp.einsum("...d,dh->...h", x, qt.q.astype(x.dtype)) * qt.scale
+    got = int8_matmul_pallas(x, qt.q, qt.scale, interpret=True)
+    assert got.shape == (*lead, out)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_int8_kernel_gate_dispatch(monkeypatch):
+    """weighted_einsum routes 2D int8 QTensors through the kernel when
+    quant_kernel is set, and never for stacked (3D) weights."""
+    from vgate_tpu.ops import quant
+
+    rng = np.random.default_rng(32)
+    w = jnp.asarray(rng.normal(size=(64, 128)), jnp.float32)
+    qt = quant.quantize_tensor(w, bits=8)
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    base = quant.weighted_einsum("...d,dh->...h", x, qt)
+    called = {}
+
+    import vgate_tpu.ops.pallas.quant_matmul as qm
+
+    real = qm.int8_matmul_pallas
+
+    def fake(xx, qq, sc, out_dtype=None):
+        called["yes"] = True
+        return real(xx, qq, sc, out_dtype=out_dtype, interpret=True)
+
+    monkeypatch.setattr(qm, "int8_matmul_pallas", fake)
+    got = quant.weighted_einsum("...d,dh->...h", x, qt, quant_kernel=True)
+    assert called.get("yes")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(base), rtol=2e-4, atol=2e-4
+    )
+    ws = quant.quantize_stacked(
+        jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32), bits=8
+    )
+    assert not quant._use_quant_kernel("...d,dh->...h", ws)

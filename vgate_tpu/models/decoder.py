@@ -99,10 +99,10 @@ def init_params(
 
 def _project_qkv(x, lp, spec: ModelSpec):
     """x: [..., D] -> q [..., H, hd], k/v [..., KV, hd]."""
-    ik = spec.int4_kernel
-    q = weighted_einsum("...d,dh->...h", x, lp["q"]["w"], int4_kernel=ik)
-    k = weighted_einsum("...d,dh->...h", x, lp["k"]["w"], int4_kernel=ik)
-    v = weighted_einsum("...d,dh->...h", x, lp["v"]["w"], int4_kernel=ik)
+    ik = spec.quant_kernel
+    q = weighted_einsum("...d,dh->...h", x, lp["q"]["w"], quant_kernel=ik)
+    k = weighted_einsum("...d,dh->...h", x, lp["k"]["w"], quant_kernel=ik)
+    v = weighted_einsum("...d,dh->...h", x, lp["v"]["w"], quant_kernel=ik)
     if spec.qkv_bias:
         q = q + lp["q"]["b"]
         k = k + lp["k"]["b"]
@@ -122,15 +122,15 @@ def _act(x32, spec: ModelSpec):
 
 
 def _dense_mlp(x, lp, spec: ModelSpec):
-    ik = spec.int4_kernel
+    ik = spec.quant_kernel
     gate = weighted_einsum("...d,df->...f", x, lp["gate"]["w"],
-                           int4_kernel=ik)
-    up = weighted_einsum("...d,df->...f", x, lp["up"]["w"], int4_kernel=ik)
+                           quant_kernel=ik)
+    up = weighted_einsum("...d,df->...f", x, lp["up"]["w"], quant_kernel=ik)
     return weighted_einsum(
         "...f,fd->...d",
         _act(gate.astype(jnp.float32), spec).astype(x.dtype) * up,
         lp["down"]["w"],
-        int4_kernel=ik,
+        quant_kernel=ik,
     )
 
 
@@ -234,7 +234,7 @@ def _logits(params: Params, spec: ModelSpec, x: jnp.ndarray) -> jnp.ndarray:
         logits = weighted_einsum(
             "...d,dv->...v", x, params["lm_head"],
             preferred_element_type=jnp.float32,
-            int4_kernel=spec.int4_kernel,
+            quant_kernel=spec.quant_kernel,
         )
     return _softcap(logits, spec.final_softcap)
 
@@ -445,7 +445,7 @@ def _finish_layer(h, attn, lp, spec: ModelSpec):
     attn = attn.reshape(*h.shape[:-1], spec.q_dim)
     uo = spec.unit_offset_norm
     attn_out = weighted_einsum(
-        "...h,hd->...d", attn, lp["o"]["w"], int4_kernel=spec.int4_kernel
+        "...h,hd->...d", attn, lp["o"]["w"], quant_kernel=spec.quant_kernel
     )
     if spec.ffn_sandwich:
         attn_out = rms_norm(attn_out, lp["post_norm"], spec.rms_eps, uo)

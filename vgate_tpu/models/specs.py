@@ -59,7 +59,7 @@ class ModelSpec:
     # forward as a static jit arg, so two engines with different
     # meshes in one process get separate compile caches instead of
     # fighting over a module global.
-    int4_kernel: bool = False
+    quant_kernel: bool = False
 
     @property
     def is_moe(self) -> bool:

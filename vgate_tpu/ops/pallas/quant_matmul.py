@@ -79,6 +79,110 @@ def _kernel(
         )
 
 
+def _int8_kernel(
+    x_ref,  # [T_r, T_in] VMEM
+    w_ref,  # [T_in, T_out] int8 VMEM
+    scale_ref,  # [1, T_out] f32 VMEM
+    out_ref,  # [T_r, T_out]
+    acc_ref,  # [T_r, T_out] f32 scratch
+    *,
+    n_in_tiles: int,
+):
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot(
+        x_ref[...], w_ref[...].astype(x_ref.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == n_in_tiles - 1)
+    def _():
+        out_ref[...] = (acc_ref[...] * scale_ref[...]).astype(
+            out_ref.dtype
+        )
+
+
+def _tiled_matmul(
+    x, tile_in_dim: int, out: int, out_dtype, interpret: bool, build
+):
+    """Shared host-side wrapper for the fused-dequant kernels: flatten
+    the lead dims, pad rows to an MXU-friendly tile, size the grid, run,
+    unpad.  ``build(xf, T_r, T_in, T_out, n_in_tiles)`` returns
+    ``(kernel_fn, in_specs, operands)`` — the only parts that differ
+    between the int8 and packed-int4 variants."""
+    *lead, in_dim = x.shape
+    R = 1
+    for s in lead:
+        R *= s
+    xf = x.reshape(R, in_dim)
+
+    T_in = _pick_tile(tile_in_dim)
+    T_out = _pick_tile(out)
+    # rows tile at 128 (the MXU sublane sweet spot); small batches pad
+    # to one 8-aligned tile
+    T_r = 128 if R >= 128 else max(8, cdiv(R, 8) * 8)
+    Rp = cdiv(R, T_r) * T_r
+    if Rp != R:
+        xf = jnp.pad(xf, ((0, Rp - R), (0, 0)))
+    n_in_tiles = tile_in_dim // T_in
+
+    kernel, in_specs, operands = build(xf, T_r, T_in, T_out, n_in_tiles)
+    out_mat = pl.pallas_call(
+        kernel,
+        grid=(Rp // T_r, out // T_out, n_in_tiles),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((T_r, T_out), lambda r, o, i: (r, o)),
+        out_shape=jax.ShapeDtypeStruct((Rp, out), out_dtype),
+        scratch_shapes=[pltpu.VMEM((T_r, T_out), jnp.float32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(*operands)
+    if Rp != R:
+        out_mat = out_mat[:R]
+    return out_mat.reshape(*lead, out)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_dtype", "interpret")
+)
+def int8_matmul_pallas(
+    x: jnp.ndarray,  # [..., in]
+    q: jnp.ndarray,  # [in, out] int8
+    scale: jnp.ndarray,  # [out] f32 per-output-channel scale
+    out_dtype=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``x @ q.astype * scale`` with the int8->activation convert inside
+    the matmul tiles: HBM weight traffic is the int8 bytes.  The int8
+    sibling of ``int4_matmul_pallas`` (XLA usually fuses the convert on
+    its own; this kernel removes the 'usually' and gives the A/B handle).
+    """
+    in_dim, out = q.shape
+    if x.shape[-1] != in_dim:
+        raise ValueError(f"x in-dim {x.shape[-1]} != weight rows {in_dim}")
+
+    def build(xf, T_r, T_in, T_out, n_in_tiles):
+        return (
+            functools.partial(_int8_kernel, n_in_tiles=n_in_tiles),
+            [
+                pl.BlockSpec((T_r, T_in), lambda r, o, i: (r, i)),
+                pl.BlockSpec((T_in, T_out), lambda r, o, i: (i, o)),
+                pl.BlockSpec((1, T_out), lambda r, o, i: (0, o)),
+            ],
+            (xf, q, scale.reshape(1, out).astype(jnp.float32)),
+        )
+
+    return _tiled_matmul(
+        x, in_dim, out, out_dtype or x.dtype, interpret, build
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("out_dtype", "interpret")
 )
@@ -96,49 +200,27 @@ def int4_matmul_pallas(
     output cast, so it is the numerically stronger of the two.
     Returns [..., out] in ``out_dtype`` (default: x.dtype).
     """
-    *lead, in_dim = x.shape
     half, out = q_packed.shape
-    if in_dim != 2 * half:
+    if x.shape[-1] != 2 * half:
         raise ValueError(
-            f"x in-dim {in_dim} != 2 * packed rows {half}"
+            f"x in-dim {x.shape[-1]} != 2 * packed rows {half}"
         )
-    out_dtype = out_dtype or x.dtype
-    R = 1
-    for s in lead:
-        R *= s
-    xf = x.reshape(R, in_dim)
 
-    T_in = _pick_tile(half)
-    T_out = _pick_tile(out)
-    # rows tile at 128 (the MXU sublane sweet spot); small batches pad
-    # to one 8-aligned tile
-    T_r = 128 if R >= 128 else max(8, cdiv(R, 8) * 8)
-    Rp = cdiv(R, T_r) * T_r
-    if Rp != R:
-        xf = jnp.pad(xf, ((0, Rp - R), (0, 0)))
-    n_in_tiles = half // T_in
+    def build(xf, T_r, T_in, T_out, n_in_tiles):
+        return (
+            functools.partial(_kernel, n_in_tiles=n_in_tiles),
+            [
+                pl.BlockSpec((T_r, T_in), lambda r, o, i: (r, i)),
+                pl.BlockSpec(
+                    (T_r, T_in),
+                    lambda r, o, i, n=n_in_tiles: (r, i + n),
+                ),
+                pl.BlockSpec((T_in, T_out), lambda r, o, i: (i, o)),
+                pl.BlockSpec((1, T_out), lambda r, o, i: (0, o)),
+            ],
+            (xf, xf, q_packed, scale.reshape(1, out).astype(jnp.float32)),
+        )
 
-    grid = (Rp // T_r, out // T_out, n_in_tiles)
-    out_mat = pl.pallas_call(
-        functools.partial(_kernel, n_in_tiles=n_in_tiles),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((T_r, T_in), lambda r, o, i: (r, i)),
-            pl.BlockSpec(
-                (T_r, T_in),
-                lambda r, o, i, n=n_in_tiles: (r, i + n),
-            ),
-            pl.BlockSpec((T_in, T_out), lambda r, o, i: (i, o)),
-            pl.BlockSpec((1, T_out), lambda r, o, i: (0, o)),
-        ],
-        out_specs=pl.BlockSpec((T_r, T_out), lambda r, o, i: (r, o)),
-        out_shape=jax.ShapeDtypeStruct((Rp, out), out_dtype),
-        scratch_shapes=[pltpu.VMEM((T_r, T_out), jnp.float32)],
-        interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-    )(xf, xf, q_packed, scale.reshape(1, out).astype(jnp.float32))
-    if Rp != R:
-        out_mat = out_mat[:R]
-    return out_mat.reshape(*lead, out)
+    return _tiled_matmul(
+        x, half, out, out_dtype or x.dtype, interpret, build
+    )

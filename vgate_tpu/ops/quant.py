@@ -54,16 +54,17 @@ _QDTYPES = {8: (jnp.int8, 127), 4: (jnp.int8, 7)}
 
 Weight = Union[jnp.ndarray, QTensor, PackedQTensor]
 
-def _use_int4_kernel(subscripts: str, w: "PackedQTensor") -> bool:
-    """Shape eligibility for the fused int4 dequant kernel
-    (ops/pallas/quant_matmul.py): 2D per-layer packed weights in a plain
-    [..., in] @ [in, out] contraction ("...d,dh->...h" etc.).  Stacked/
-    expert weights and exotic einsums keep the jnp path.  Whether the
-    kernel actually runs is the caller's ``int4_kernel`` flag (threaded
-    per-engine via ModelSpec.int4_kernel — the engine enables it only on
-    TPU with no model-parallel axes, since pallas_call does not
-    auto-partition under jit sharding)."""
-    if w.q_packed.ndim != 2:
+def _use_quant_kernel(subscripts: str, w: Weight) -> bool:
+    """Shape eligibility for the fused dequant kernels
+    (ops/pallas/quant_matmul.py): 2D per-layer weights (packed int4 or
+    int8) in a plain [..., in] @ [in, out] contraction ("...d,dh->...h"
+    etc.).  Stacked/expert weights and exotic einsums keep the jnp path.
+    Whether a kernel actually runs is the caller's ``quant_kernel`` flag
+    (threaded per-engine via ModelSpec.quant_kernel — the engine enables
+    it only on TPU with no model-parallel axes, since pallas_call does
+    not auto-partition under jit sharding)."""
+    vals = w.q_packed if isinstance(w, PackedQTensor) else w.q
+    if vals.ndim != 2:
         return False
     ins, out = subscripts.split("->")
     a, b = ins.split(",")
@@ -169,7 +170,7 @@ def quantize_expert_stacked(w: jnp.ndarray, bits: int = 8) -> Weight:
 
 def weighted_einsum(
     subscripts: str, x: jnp.ndarray, w: Weight, preferred_element_type=None,
-    int4_kernel: bool = False,
+    quant_kernel: bool = False,
 ) -> jnp.ndarray:
     """einsum that accepts plain or quantized weights.
 
@@ -188,7 +189,7 @@ def weighted_einsum(
     )
     out_dtype = preferred_element_type or x.dtype
     if isinstance(w, PackedQTensor):
-        if int4_kernel and _use_int4_kernel(subscripts, w):
+        if quant_kernel and _use_quant_kernel(subscripts, w):
             from vgate_tpu.ops.pallas.quant_matmul import (
                 int4_matmul_pallas,
             )
@@ -201,6 +202,14 @@ def weighted_einsum(
         )
         return out * w.scale.astype(out_dtype)
     if isinstance(w, QTensor):
+        if quant_kernel and _use_quant_kernel(subscripts, w):
+            from vgate_tpu.ops.pallas.quant_matmul import (
+                int8_matmul_pallas,
+            )
+
+            return int8_matmul_pallas(
+                x, w.q, w.scale, out_dtype=out_dtype
+            )
         out = jnp.einsum(subscripts, x, w.q.astype(x.dtype), **kw)
         return out * w.scale.astype(out_dtype)
     return jnp.einsum(subscripts, x, w, **kw)

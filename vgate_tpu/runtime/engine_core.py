@@ -627,16 +627,16 @@ class EngineCore:
             tpu_cfg.use_pallas
             and self.mesh.devices.flat[0].platform == "tpu"
         )
-        if self.config.model.quantization == "int4":
+        if self.config.model.quantization in ("int8", "int4"):
             import dataclasses
 
-            # the fused dequant kernel doesn't auto-partition under jit
+            # the fused dequant kernels don't auto-partition under jit
             # sharding; model-parallel meshes keep the jnp einsum path.
             # Threaded on the spec (a static jit arg) so engines with
             # different meshes in one process never share the setting.
             self.spec = dataclasses.replace(
                 self.spec,
-                int4_kernel=self.use_pallas
+                quant_kernel=self.use_pallas
                 and all(
                     int(self.mesh.shape.get(a, 1)) == 1
                     for a in ("tp", "pp", "sp", "ep")

@@ -213,6 +213,12 @@ class PageAllocator:
         self._hash_to_page[content_hash] = page
         self._page_hash[page] = content_hash
 
+    def peek(self, content_hash: int) -> Optional[int]:
+        """Check whether a page is cached for this hash WITHOUT taking a
+        reference (scheduler admissibility probes must not mutate
+        refcounts)."""
+        return self._hash_to_page.get(content_hash)
+
     def lookup(self, content_hash: int) -> Optional[int]:
         """Find a cached page for this hash and take a reference to it."""
         page = self._hash_to_page.get(content_hash)

@@ -159,6 +159,13 @@ class Scheduler:
         if head is None or self._free_slot() is None:
             return False
         n_pages = cdiv(max(1, head.num_prompt_tokens), self.page_size)
+        if self.prefix_cache:
+            # mirror try_admit's accounting: resident prefix pages are
+            # shared, not allocated (peek — no refcount mutation here)
+            for h in self._prefix_chain(head):
+                if self.allocator.peek(h) is None:
+                    break
+                n_pages -= 1
         return self.allocator.num_free >= n_pages
 
     # -- planning --
