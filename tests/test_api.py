@@ -288,3 +288,32 @@ async def test_max_completion_tokens_alias():
         assert body["choices"][0]["message"]["content"]
     finally:
         await client.close()
+
+
+async def test_chat_logit_bias_accepted_and_validated():
+    """logit_bias rides the OpenAI schema (stringified token-id keys);
+    non-numeric keys 422 instead of 500."""
+    client = await _client()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "logit_bias": {"42": 50.0, "7": -100.0},
+            },
+        )
+        assert resp.status == 200
+
+        bad = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "logit_bias": {"not-a-token": 1.0},
+            },
+        )
+        assert bad.status == 422
+        body = await bad.json()
+        assert "logit_bias" in body["error"]["message"]
+    finally:
+        await client.close()

@@ -45,6 +45,9 @@ class SamplingParams:
     # with the count, presence is a flat once-seen offset.
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # OpenAI logit_bias: {token_id: additive bias in [-100, 100]},
+    # applied to the logits before sampling at every position.
+    logit_bias: Optional[Dict[int, float]] = None
 
     @property
     def has_penalties(self) -> bool:

@@ -133,6 +133,7 @@ class RequestBatcher:
         variant: int = 0,
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
+        logit_bias: Optional[Dict[int, float]] = None,
     ) -> Dict[str, Any]:
         inf = self.config.inference
         params = SamplingParams(
@@ -150,6 +151,7 @@ class RequestBatcher:
             top_logprobs=top_logprobs,
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
+            logit_bias=logit_bias,
         )
         with tracer.start_as_current_span("batcher.submit"):
             self._total_requests += 1
@@ -169,6 +171,13 @@ class RequestBatcher:
                 variant=variant,
                 penalties=(
                     params.frequency_penalty, params.presence_penalty
+                ),
+                # biased requests must not dedup/cache-hit against
+                # unbiased ones (sorted for key stability)
+                logit_bias=(
+                    tuple(sorted(params.logit_bias.items()))
+                    if params.logit_bias
+                    else None
                 ),
             )
             cached = await self.cache.get(cache_key)

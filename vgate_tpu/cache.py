@@ -44,16 +44,17 @@ class ResultCache:
         penalties=None,
         stop_token_ids: Optional[List[int]] = None,
         min_tokens: int = 0,
+        logit_bias=None,
     ) -> str:
         """Stable digest over the request-identity fields (reference:
-        vgate/cache.py:48-56; top_k/stop/seed/logprobs added for the TPU
-        sampler — they change the result, so they must change the key;
-        ``variant`` salts the i-th of an n-choices request so the n
-        submissions don't dedup into one generation)."""
+        vgate/cache.py:48-56; top_k/stop/seed/logprobs/logit_bias added
+        for the TPU sampler — they change the result, so they must
+        change the key; ``variant`` salts the i-th of an n-choices
+        request so the n submissions don't dedup into one generation)."""
         blob = (
             f"{prompt}|{temperature}|{top_p}|{max_tokens}|{top_k}"
             f"|{stop or []}|{seed}|{logprobs}|{variant}|{penalties}"
-            f"|{stop_token_ids or []}|{min_tokens}"
+            f"|{stop_token_ids or []}|{min_tokens}|{logit_bias}"
         )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 

@@ -305,6 +305,23 @@ def apply_penalties(
     )
 
 
+def apply_logit_bias(
+    logits: jnp.ndarray,  # [B, V]
+    bias_ids: jnp.ndarray,  # [B, K] int32 token ids; >= V entries pad
+    bias_vals: jnp.ndarray,  # [B, K] f32 additive biases
+) -> jnp.ndarray:
+    """OpenAI ``logit_bias``: add per-request biases to selected token
+    logits before sampling (-100 effectively bans a token, +100
+    effectively forces it).  Padding entries use an out-of-vocab id —
+    XLA scatter-add drops out-of-bounds updates, so they are no-ops by
+    construction (the same trick as suppress_stop_tokens)."""
+    B = logits.shape[0]
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], bias_ids.shape)
+    return logits.astype(jnp.float32).at[b_idx, bias_ids].add(
+        bias_vals, mode="drop"
+    )
+
+
 def suppress_stop_tokens(
     logits: jnp.ndarray,  # [B, V]
     steps: jnp.ndarray,  # [B] tokens generated so far

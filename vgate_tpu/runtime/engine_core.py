@@ -51,6 +51,7 @@ from vgate_tpu.models.decoder import (
 )
 from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
 from vgate_tpu.ops.sampling import (
+    apply_logit_bias,
     apply_penalties,
     sample_tokens,
     sample_tokens_with_logprobs,
@@ -97,6 +98,7 @@ def _prefill_step(
     seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, kv_carry: bool = False,
+    bias_ids=None, bias_vals=None,
 ):
     logits, k_pages, v_pages = prefill_forward(
         params, spec, tokens, seq_lens, k_pages, v_pages, page_tables,
@@ -106,6 +108,8 @@ def _prefill_step(
         # post-preemption re-prefill: folded outputs still count toward
         # the penalties of the re-sampled first token
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
+    if bias_ids is not None:
+        logits = apply_logit_bias(logits, bias_ids, bias_vals)
     if min_toks is not None:
         logits = suppress_stop_tokens(logits, steps, min_toks, stop_id_mat)
     if num_logprobs > 0:
@@ -134,6 +138,7 @@ def _suffix_prefill_step(
     key, seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, kv_carry: bool = False,
+    bias_ids=None, bias_vals=None,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
@@ -143,6 +148,8 @@ def _suffix_prefill_step(
     )
     if counts is not None:
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
+    if bias_ids is not None:
+        logits = apply_logit_bias(logits, bias_ids, bias_vals)
     if min_toks is not None:
         logits = suppress_stop_tokens(logits, steps, min_toks, stop_id_mat)
     if num_logprobs > 0:
@@ -188,7 +195,7 @@ def _decode_chunk(
     seeds=None, steps=None, mesh=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, all_greedy: bool = False,
-    kv_carry: bool = False,
+    kv_carry: bool = False, bias_ids=None, bias_vals=None,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -217,6 +224,8 @@ def _decode_chunk(
             # frequency/presence penalties over the generated-token
             # histogram (ops/sampling.py apply_penalties)
             logits = apply_penalties(logits, counts, freq_pens, pres_pens)
+        if bias_ids is not None:
+            logits = apply_logit_bias(logits, bias_ids, bias_vals)
         if min_toks is not None:
             logits = suppress_stop_tokens(
                 logits, steps, min_toks, stop_id_mat
@@ -279,7 +288,7 @@ def _spec_verify_step(
     seeds=None, steps=None, use_pallas=False, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, all_greedy: bool = False,
-    kv_carry: bool = False,
+    kv_carry: bool = False, bias_ids=None, bias_vals=None,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), then verify every
@@ -324,6 +333,12 @@ def _spec_verify_step(
         if steps is None
         else (steps[:, None] + jnp.arange(S)[None, :]).reshape(-1)
     )
+    if bias_ids is not None:
+        # per-slot biases apply at every candidate position
+        flat = apply_logit_bias(
+            logits.reshape(B * S, -1), rep(bias_ids), rep(bias_vals)
+        )
+        logits = flat.reshape(logits.shape)
     if min_toks is not None:
         assert steps_flat is not None, "min_tokens requires steps"
         flat = suppress_stop_tokens(
@@ -1015,6 +1030,29 @@ class EngineCore:
             min_toks[row] = seq.params.min_tokens
         return jnp.asarray(min_toks), jnp.asarray(mat)
 
+    def _logit_bias_arrays(self, B: int, rows):
+        """(bias_ids [B, K] int32, bias_vals [B, K] f32) device arrays,
+        or (None, None) when no row carries a logit_bias.  Padding uses
+        an out-of-vocab id (scatter-add drops it); K buckets to a power
+        of two so the program-variant count stays bounded — the same
+        discipline as _min_token_arrays."""
+        per = {
+            row: seq.params.logit_bias
+            for row, seq in rows
+            if seq.params.logit_bias
+        }
+        if not per:
+            return None, None
+        K = 1 << (max(len(v) for v in per.values()) - 1).bit_length()
+        V = self.spec.vocab_size
+        ids = np.full((B, K), V, np.int32)
+        vals = np.zeros((B, K), np.float32)
+        for row, items in per.items():
+            for j, (tid, b) in enumerate(sorted(items.items())):
+                ids[row, j] = tid
+                vals[row, j] = b
+        return jnp.asarray(ids), jnp.asarray(vals)
+
     def _group_penalties(self, plans: List[PrefillPlan], B: int):
         """Penalty arrays for a prefill group, or (None, None, None).
         Counts only matter when a penalized plan already generated tokens
@@ -1072,6 +1110,9 @@ class EngineCore:
         mt, mt_ids = self._min_token_arrays(
             B, ((row, p.seq) for row, p in enumerate(plans))
         )
+        lb_ids, lb_vals = self._logit_bias_arrays(
+            B, ((row, p.seq) for row, p in enumerate(plans))
+        )
         num_lp = (
             LOGPROBS_K
             if any(p.seq.params.logprobs for p in plans)
@@ -1080,6 +1121,7 @@ class EngineCore:
         key = (
             bucket, B, pen_counts is not None,
             None if mt is None else mt_ids.shape[1], num_lp,
+            None if lb_ids is None else lb_ids.shape[1],
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -1107,15 +1149,22 @@ class EngineCore:
             min_toks=mt,
             stop_id_mat=mt_ids,
             kv_carry=self._kv_carry,
+            bias_ids=lb_ids,
+            bias_vals=lb_vals,
         )
         return out  # (first tokens [B], logprob triple or None)
 
     @staticmethod
-    def _suffix_key(bucket, B, ctx_pages, has_pen, mt_width, num_lp):
+    def _suffix_key(
+        bucket, B, ctx_pages, has_pen, mt_width, num_lp, lb_width
+    ):
         """Compile-variant key for one _suffix_prefill_step shape — the
         single definition both the batched suffix-group dispatch and
         the chunked-prefill loop count RECOMPILES against."""
-        return ("suffix", bucket, B, ctx_pages, has_pen, mt_width, num_lp)
+        return (
+            "suffix", bucket, B, ctx_pages, has_pen, mt_width, num_lp,
+            lb_width,
+        )
 
     def _dispatch_suffix_group(self, plans: List[PrefillPlan], bucket: int):
         """Launch ONE suffix-prefill program for up to prefill_batch_max
@@ -1169,6 +1218,9 @@ class EngineCore:
         mt, mt_ids = self._min_token_arrays(
             B, ((row, p.seq) for row, p in enumerate(plans))
         )
+        lb_ids, lb_vals = self._logit_bias_arrays(
+            B, ((row, p.seq) for row, p in enumerate(plans))
+        )
         num_lp = (
             LOGPROBS_K
             if any(p.seq.params.logprobs for p in plans)
@@ -1177,6 +1229,7 @@ class EngineCore:
         key = self._suffix_key(
             bucket, B, ctx_pages, pen_counts is not None,
             None if mt is None else mt_ids.shape[1], num_lp,
+            None if lb_ids is None else lb_ids.shape[1],
         )
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
@@ -1204,6 +1257,8 @@ class EngineCore:
             min_toks=mt,
             stop_id_mat=mt_ids,
             kv_carry=self._kv_carry,
+            bias_ids=lb_ids,
+            bias_vals=lb_vals,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1247,7 +1302,9 @@ class EngineCore:
             full_pt[0, : min(len(seq.pages), ctx_pages)] = seq.pages[
                 :ctx_pages
             ]
-            key = self._suffix_key(chunk, 1, ctx_pages, False, None, 0)
+            key = self._suffix_key(
+                chunk, 1, ctx_pages, False, None, 0, None
+            )
             if key not in self._compiled_buckets:
                 metrics.RECOMPILES.labels(kind="prefill").inc()
                 self._compiled_buckets.add(key)
@@ -1338,6 +1395,9 @@ class EngineCore:
         mt_j, mt_ids_j = self._min_token_arrays(
             B, ((s.slot, s) for s in seqs)
         )
+        lb_j, lb_vals_j = self._logit_bias_arrays(
+            B, ((s.slot, s) for s in seqs)
+        )
         self._dec_state = {
             "tokens": jnp.asarray(tokens),
             "positions": jnp.asarray(positions),
@@ -1354,6 +1414,8 @@ class EngineCore:
             "pres_pens": pres_j,
             "min_toks": mt_j,
             "stop_id_mat": mt_ids_j,
+            "bias_ids": lb_j,
+            "bias_vals": lb_vals_j,
         }
 
     def _refresh_page_tables(self, seqs: List[Sequence]) -> None:
@@ -1414,6 +1476,9 @@ class EngineCore:
             else state["stop_id_mat"].shape[1],
             num_lp,
             all_greedy,
+            None
+            if state["bias_ids"] is None
+            else state["bias_ids"].shape[1],
         )
         if chunk_key not in self._compiled_chunks:
             metrics.RECOMPILES.labels(kind="decode").inc()
@@ -1461,6 +1526,8 @@ class EngineCore:
             stop_id_mat=state["stop_id_mat"],
             all_greedy=all_greedy,
             kv_carry=self._kv_carry,
+            bias_ids=state["bias_ids"],
+            bias_vals=state["bias_vals"],
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -1627,9 +1694,17 @@ class EngineCore:
             mt, mt_ids = self._min_token_arrays(
                 B, ((s.slot, s) for s in active)
             )
-            self._spec_mt = {"sig": mt_sig, "mt": mt, "ids": mt_ids}
+            lb, lb_vals = self._logit_bias_arrays(
+                B, ((s.slot, s) for s in active)
+            )
+            self._spec_mt = {
+                "sig": mt_sig, "mt": mt, "ids": mt_ids,
+                "lb": lb, "lb_vals": lb_vals,
+            }
         spec_mt = self._spec_mt["mt"]
         spec_mt_ids = self._spec_mt["ids"]
+        spec_lb = self._spec_mt["lb"]
+        spec_lb_vals = self._spec_mt["lb_vals"]
         start = time.perf_counter()
         num_lp = (
             LOGPROBS_K
@@ -1673,6 +1748,8 @@ class EngineCore:
                 stop_id_mat=spec_mt_ids,
                 all_greedy=all_greedy,
                 kv_carry=self._kv_carry,
+                bias_ids=spec_lb,
+                bias_vals=spec_lb_vals,
             )
         )
         if want_pen:

@@ -202,6 +202,14 @@ async def chat_completions(request: web.Request) -> web.Response:
         return _error(422, f"Invalid request: {exc}", "invalid_request_error")
     if not payload.messages:
         return _error(422, "messages must be non-empty", "invalid_request_error")
+    try:
+        # bind once: invalid keys -> 422 (not a 500), and the submit
+        # fan-out below reuses the normalized dict per choice
+        logit_bias = payload.logit_bias_ints()
+    except ValueError as exc:
+        return _error(
+            422, f"Invalid logit_bias: {exc}", "invalid_request_error"
+        )
     batcher: RequestBatcher = request.app["batcher"]
     engine: VGTEngine = request.app["engine"]
     prompt = _build_prompt(engine, payload.messages)
@@ -241,6 +249,7 @@ async def chat_completions(request: web.Request) -> web.Response:
                 variant=i,
                 frequency_penalty=payload.frequency_penalty or 0.0,
                 presence_penalty=payload.presence_penalty or 0.0,
+                logit_bias=logit_bias,
             )
             for i in range(n_submits)
         ),
@@ -349,6 +358,7 @@ async def _stream_chat(
             top_logprobs=payload.top_logprobs or 0,
             frequency_penalty=payload.frequency_penalty or 0.0,
             presence_penalty=payload.presence_penalty or 0.0,
+            logit_bias=payload.logit_bias_ints(),
         )
         try:
             import inspect
@@ -396,6 +406,7 @@ async def _stream_chat(
                 top_logprobs=payload.top_logprobs or 0,
                 frequency_penalty=payload.frequency_penalty or 0.0,
                 presence_penalty=payload.presence_penalty or 0.0,
+                logit_bias=payload.logit_bias_ints(),
             )
         except (asyncio.TimeoutError, EngineBusyError) as exc:
             # the 200 + role chunk are already on the wire: deliver the
@@ -480,6 +491,12 @@ async def completions(request: web.Request) -> web.Response:
             422, "stream is not supported on /v1/completions "
             "(use /v1/chat/completions for SSE)", "invalid_request_error",
         )
+    try:
+        logit_bias = payload.logit_bias_ints()  # invalid -> 422
+    except ValueError as exc:
+        return _error(
+            422, f"Invalid logit_bias: {exc}", "invalid_request_error"
+        )
     prompts = payload.prompt_list()
     if not prompts:
         return _error(422, "prompt must be non-empty", "invalid_request_error")
@@ -515,6 +532,7 @@ async def completions(request: web.Request) -> web.Response:
                 variant=pi * payload.n + i,
                 frequency_penalty=payload.frequency_penalty or 0.0,
                 presence_penalty=payload.presence_penalty or 0.0,
+                logit_bias=logit_bias,
             )
             for pi, p in enumerate(prompts)
             for i in range(n_submits)

@@ -10,6 +10,31 @@ from typing import Any, Dict, List, Optional, Union
 from pydantic import BaseModel, Field
 
 
+def _logit_bias_ints(
+    raw: Optional[Dict[str, float]],
+) -> Optional[Dict[int, float]]:
+    """OpenAI logit_bias uses stringified token-id keys; normalize to
+    int keys with biases clamped to the documented [-100, 100] range.
+    Non-numeric or NEGATIVE keys raise ValueError (surfaced as a 422 —
+    a negative id would wrap to the end of the vocab in the device
+    scatter instead of being dropped), and the entry count caps at 300
+    (the OpenAI limit): K sizes device arrays and compiled program
+    variants, so it must not be client-controlled without bound."""
+    if not raw:
+        return None
+    if len(raw) > 300:
+        raise ValueError(
+            f"at most 300 logit_bias entries allowed, got {len(raw)}"
+        )
+    out: Dict[int, float] = {}
+    for k, v in raw.items():
+        tid = int(k)
+        if tid < 0:
+            raise ValueError(f"token id must be >= 0, got {tid}")
+        out[tid] = max(-100.0, min(100.0, float(v)))
+    return out
+
+
 class ChatMessage(BaseModel):
     role: str
     content: str
@@ -44,6 +69,13 @@ class ChatCompletionRequest(BaseModel):
     presence_penalty: Optional[float] = Field(
         default=None, ge=-2.0, le=2.0
     )
+    # OpenAI logit_bias: token-id (stringified, per the OpenAI schema)
+    # -> additive bias in [-100, 100]
+    logit_bias: Optional[Dict[str, float]] = None
+
+    def logit_bias_ints(self) -> Optional[Dict[int, float]]:
+        """OpenAI sends string token-id keys; normalize + clamp."""
+        return _logit_bias_ints(self.logit_bias)
 
     def stop_list(self) -> Optional[List[str]]:
         """OpenAI accepts a bare string or a list; normalize to a list."""
@@ -109,6 +141,10 @@ class CompletionRequest(BaseModel):
     presence_penalty: Optional[float] = Field(
         default=None, ge=-2.0, le=2.0
     )
+    logit_bias: Optional[Dict[str, float]] = None
+
+    def logit_bias_ints(self) -> Optional[Dict[int, float]]:
+        return _logit_bias_ints(self.logit_bias)
 
     def stop_list(self) -> Optional[List[str]]:
         if self.stop is None:

@@ -90,6 +90,7 @@ class _ChatResource:
         presence_penalty: Optional[float] = None,
         min_tokens: Optional[int] = None,
         stop_token_ids: Optional[List[int]] = None,
+        logit_bias: Optional[Dict[str, float]] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -107,6 +108,7 @@ class _ChatResource:
             presence_penalty=presence_penalty,
             min_tokens=min_tokens,
             stop_token_ids=stop_token_ids,
+            logit_bias=logit_bias,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -248,6 +250,7 @@ class _AsyncChatResource:
         presence_penalty: Optional[float] = None,
         min_tokens: Optional[int] = None,
         stop_token_ids: Optional[List[int]] = None,
+        logit_bias: Optional[Dict[str, float]] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -265,6 +268,7 @@ class _AsyncChatResource:
             presence_penalty=presence_penalty,
             min_tokens=min_tokens,
             stop_token_ids=stop_token_ids,
+            logit_bias=logit_bias,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:

@@ -30,6 +30,8 @@ class ChatCompletionRequest(BaseModel):
     presence_penalty: Optional[float] = None
     min_tokens: Optional[int] = None
     stop_token_ids: Optional[List[int]] = None
+    # OpenAI logit_bias: stringified token-id -> bias in [-100, 100]
+    logit_bias: Optional[Dict[str, float]] = None
 
 
 class Usage(BaseModel):
