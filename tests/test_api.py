@@ -2,6 +2,8 @@
 (reference tier 3: httpx.ASGITransport tests at tests/test_benchmark.py:98-131;
 here via aiohttp's TestClient since the gateway is aiohttp-native)."""
 
+import json
+
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
@@ -315,5 +317,51 @@ async def test_chat_logit_bias_accepted_and_validated():
         assert bad.status == 422
         body = await bad.json()
         assert "logit_bias" in body["error"]["message"]
+    finally:
+        await client.close()
+
+
+async def test_stream_options_include_usage():
+    """stream_options.include_usage adds a final pre-[DONE] chunk with
+    an empty choices list and the request's token usage."""
+    client = await _client()
+    try:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "usage probe"}],
+                "max_tokens": 6,
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            },
+        )
+        assert resp.status == 200
+        body = (await resp.read()).decode()
+        chunks = [
+            json.loads(line[len("data: "):])
+            for line in body.splitlines()
+            if line.startswith("data: ") and line != "data: [DONE]"
+        ]
+        usage_chunks = [c for c in chunks if c.get("usage")]
+        assert len(usage_chunks) == 1
+        u = usage_chunks[0]
+        assert u["choices"] == []
+        assert u["usage"]["completion_tokens"] >= 1
+        assert (
+            u["usage"]["total_tokens"]
+            == u["usage"]["prompt_tokens"]
+            + u["usage"]["completion_tokens"]
+        )
+        # without the option, no usage chunk appears
+        resp2 = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "usage probe"}],
+                "max_tokens": 6,
+                "stream": True,
+            },
+        )
+        body2 = (await resp2.read()).decode()
+        assert '"usage"' not in body2
     finally:
         await client.close()

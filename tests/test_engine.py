@@ -943,3 +943,34 @@ def test_pick_chunk_caps_under_admission_pressure():
         assert core._pick_chunk([seq]) == 32
     finally:
         core.stop()
+
+
+def test_stream_async_reports_usage():
+    """The real engine's token stream delivers usage through on_usage
+    (the OpenAI stream_options.include_usage plumbing)."""
+    import asyncio
+
+    from vgate_tpu.backends.jax_backend import JaxTPUBackend
+
+    backend = JaxTPUBackend()
+    backend.load_model(tiny_config(num_devices=1))
+    try:
+        seen = {}
+
+        async def run():
+            agen = backend.stream_async(
+                "usage stream probe",
+                SamplingParams(max_tokens=5, temperature=0.0),
+                on_usage=lambda u: seen.update(u),
+            )
+            async for _ in agen:
+                pass
+
+        asyncio.run(run())
+        assert seen["completion_tokens"] >= 1
+        assert (
+            seen["total_tokens"]
+            == seen["prompt_tokens"] + seen["completion_tokens"]
+        )
+    finally:
+        backend.shutdown()

@@ -247,10 +247,13 @@ class JaxTPUBackend:
         prompt: str,
         params: SamplingParams,
         on_finish: Optional[Any] = None,
+        on_usage: Optional[Any] = None,
     ) -> AsyncIterator[str]:
         """Token-by-token text deltas for SSE streaming.  ``on_finish`` (if
         given) is called with the sequence's finish_reason after the last
-        delta, so the gateway can close the stream with the true reason.
+        delta, so the gateway can close the stream with the true reason;
+        ``on_usage`` (if given) receives the request's token usage dict
+        just before that (OpenAI stream_options.include_usage).
 
         With ``params.logprobs`` each yield is a dict ``{"text": delta,
         "logprobs": [entries for the tokens consumed since the previous
@@ -341,6 +344,14 @@ class JaxTPUBackend:
                 seq.request_abort()
         if seq.status is SeqStatus.FAILED:
             raise seq.error  # type: ignore[misc]
+        if on_usage is not None:
+            on_usage({
+                "prompt_tokens": seq.orig_prompt_len,
+                "completion_tokens": seq.num_output_tokens,
+                "total_tokens": (
+                    seq.orig_prompt_len + seq.num_output_tokens
+                ),
+            })
         if on_finish is not None:
             on_finish(seq.finish_reason)
 

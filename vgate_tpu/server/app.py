@@ -220,7 +220,7 @@ async def chat_completions(request: web.Request) -> web.Response:
                 422, "n > 1 is not supported with stream=true",
                 "invalid_request_error",
             )
-        return await _stream_chat(request, payload, prompt)
+        return await _stream_chat(request, payload, prompt, logit_bias)
 
     # n choices run as n engine requests sampled concurrently (the
     # variant salt keeps them from deduping; prefix caching shares
@@ -292,7 +292,8 @@ async def chat_completions(request: web.Request) -> web.Response:
 
 
 async def _stream_chat(
-    request: web.Request, payload: ChatCompletionRequest, prompt: str
+    request: web.Request, payload: ChatCompletionRequest, prompt: str,
+    logit_bias=None,
 ) -> web.StreamResponse:
     """SSE streaming.  Uses the backend's token stream when it has one;
     otherwise generates fully and replays in chunks (dry-run path)."""
@@ -330,6 +331,24 @@ async def _stream_chat(
 
     await resp.write(_chunk({"role": "assistant"}))
     finish_reason = {"value": "stop"}
+    want_usage = bool(
+        payload.stream_options and payload.stream_options.include_usage
+    )
+    usage_box: Dict[str, Any] = {"value": None}
+
+    def _usage_chunk() -> bytes:
+        # OpenAI stream_options.include_usage: a final pre-[DONE] chunk
+        # with an EMPTY choices list carrying the usage
+        body = {
+            "id": completion_id,
+            "object": "chat.completion.chunk",
+            "created": int(time.time()),
+            "model": model_id,
+            "choices": [],
+            "usage": usage_box["value"],
+        }
+        return f"data: {json.dumps(body)}\n\n".encode()
+
     stream_fn = getattr(engine.backend, "stream_async", None)
     if stream_fn is not None:
         params = engine.backend.create_sampling_params(
@@ -358,15 +377,20 @@ async def _stream_chat(
             top_logprobs=payload.top_logprobs or 0,
             frequency_penalty=payload.frequency_penalty or 0.0,
             presence_penalty=payload.presence_penalty or 0.0,
-            logit_bias=payload.logit_bias_ints(),
+            logit_bias=logit_bias,
         )
         try:
             import inspect
 
             kwargs = {}
-            if "on_finish" in inspect.signature(stream_fn).parameters:
+            stream_params = inspect.signature(stream_fn).parameters
+            if "on_finish" in stream_params:
                 kwargs["on_finish"] = (
                     lambda r: finish_reason.__setitem__("value", r)
+                )
+            if want_usage and "on_usage" in stream_params:
+                kwargs["on_usage"] = (
+                    lambda u: usage_box.__setitem__("value", u)
                 )
             async with asyncio.timeout(
                 engine.config.server.request_timeout_s
@@ -406,7 +430,7 @@ async def _stream_chat(
                 top_logprobs=payload.top_logprobs or 0,
                 frequency_penalty=payload.frequency_penalty or 0.0,
                 presence_penalty=payload.presence_penalty or 0.0,
-                logit_bias=payload.logit_bias_ints(),
+                logit_bias=logit_bias,
             )
         except (asyncio.TimeoutError, EngineBusyError) as exc:
             # the 200 + role chunk are already on the wire: deliver the
@@ -424,6 +448,14 @@ async def _stream_chat(
             await resp.write_eof()
             return resp
         finish_reason["value"] = result.get("finish_reason", "stop")
+        if want_usage:
+            pt = result.get("prompt_tokens", 0)
+            ct = result.get("num_tokens", 0)
+            usage_box["value"] = {
+                "prompt_tokens": pt,
+                "completion_tokens": ct,
+                "total_tokens": pt + ct,
+            }
         text = result["text"]
         step = max(1, len(text) // 16)
         for i in range(0, len(text), step):
@@ -437,10 +469,14 @@ async def _stream_chat(
                     logprobs=result["logprobs"],
                 )
             )
+            if want_usage and usage_box["value"] is not None:
+                await resp.write(_usage_chunk())
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
     await resp.write(_chunk({}, finish=finish_reason["value"]))
+    if want_usage and usage_box["value"] is not None:
+        await resp.write(_usage_chunk())
     await resp.write(b"data: [DONE]\n\n")
     await resp.write_eof()
     return resp

@@ -3,6 +3,7 @@ vgate-client/vgate_client/models.py:27-97 and main.py:207-275)."""
 
 from __future__ import annotations
 
+import math
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Union
@@ -29,15 +30,31 @@ def _logit_bias_ints(
     out: Dict[int, float] = {}
     for k, v in raw.items():
         tid = int(k)
-        if tid < 0:
-            raise ValueError(f"token id must be >= 0, got {tid}")
-        out[tid] = max(-100.0, min(100.0, float(v)))
+        if not 0 <= tid <= 2**31 - 1:
+            # negative ids would WRAP in the device scatter; ids past
+            # int32 would overflow the device arrays (ids merely >= the
+            # vocab size drop harmlessly on device)
+            raise ValueError(
+                f"token id must be in [0, 2**31-1], got {tid}"
+            )
+        val = float(v)
+        if not math.isfinite(val):
+            # NaN would silently clamp to +100 (a hard force) — reject
+            raise ValueError(f"bias for token {tid} must be finite")
+        out[tid] = max(-100.0, min(100.0, val))
     return out
 
 
 class ChatMessage(BaseModel):
     role: str
     content: str
+
+
+class StreamOptions(BaseModel):
+    """OpenAI stream_options: include_usage adds a final pre-[DONE]
+    chunk carrying the request's token usage (empty choices list)."""
+
+    include_usage: bool = False
 
 
 class ChatCompletionRequest(BaseModel):
@@ -55,6 +72,7 @@ class ChatCompletionRequest(BaseModel):
     min_tokens: int = Field(default=0, ge=0)
     seed: Optional[int] = None
     stream: bool = False
+    stream_options: Optional[StreamOptions] = None
     user: Optional[str] = None
     # OpenAI logprobs: chosen-token logprob per position; top_logprobs
     # (0..8) adds that many alternatives per position
