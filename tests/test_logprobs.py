@@ -411,3 +411,103 @@ async def test_max_completion_tokens_precedence():
         assert zero.status == 422  # ge=1: rejected, not silently coerced
     finally:
         await client.close()
+
+
+async def test_best_of_returns_highest_mean_logprob():
+    """Legacy best_of: the server generates best_of candidates and
+    returns the n with the highest mean token logprob.  Seeded sampling
+    makes the candidate set reproducible, so the best_of=4,n=1 answer
+    must be the argmax of the best_of=4,n=4 candidates."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    client = TestClient(TestServer(create_app(http_config())))
+    await client.start_server()
+    try:
+        base = {
+            "prompt": "best of probe",
+            "max_tokens": 5,
+            "min_tokens": 5,
+            "temperature": 1.0,
+            "seed": 11,
+            "logprobs": 0,
+        }
+        all4 = await client.post(
+            "/v1/completions", json={**base, "n": 4, "best_of": 4}
+        )
+        assert all4.status == 200
+        cands = (await all4.json())["choices"]
+        assert len(cands) == 4
+
+        def mean_lp(c):
+            lps = c["logprobs"]["token_logprobs"]
+            return sum(lps) / len(lps)
+
+        best_text = max(cands, key=mean_lp)["text"]
+
+        picked = await client.post(
+            "/v1/completions", json={**base, "n": 1, "best_of": 4}
+        )
+        assert picked.status == 200
+        body = await picked.json()
+        assert len(body["choices"]) == 1
+        assert body["choices"][0]["text"] == best_text
+
+        # the client did ask for logprobs here, so they must survive
+        assert body["choices"][0]["logprobs"] is not None
+
+        # best_of < n is invalid
+        bad = await client.post(
+            "/v1/completions", json={**base, "n": 4, "best_of": 2}
+        )
+        assert bad.status == 422
+
+        # without logprobs requested, ranking stays internal
+        quiet = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": "best of quiet",
+                "max_tokens": 4,
+                "temperature": 1.0,
+                "seed": 3,
+                "n": 1,
+                "best_of": 3,
+            },
+        )
+        assert quiet.status == 200
+        qbody = await quiet.json()
+        assert qbody["choices"][0].get("logprobs") is None
+    finally:
+        await client.close()
+
+
+async def test_best_of_usage_counts_discarded_candidates():
+    """usage.completion_tokens covers ALL best_of generations, not just
+    the returned choices (the discarded candidates burned compute)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from vgate_tpu.server.app import create_app
+
+    client = TestClient(TestServer(create_app(http_config())))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": "usage of the discarded",
+                "max_tokens": 4,
+                "min_tokens": 4,
+                "temperature": 1.0,
+                "seed": 9,
+                "n": 1,
+                "best_of": 3,
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert len(body["choices"]) == 1
+        # 3 candidates x exactly 4 tokens each (min_tokens pins it)
+        assert body["usage"]["completion_tokens"] == 12
+    finally:
+        await client.close()

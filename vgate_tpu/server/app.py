@@ -536,14 +536,23 @@ async def completions(request: web.Request) -> web.Response:
     prompts = payload.prompt_list()
     if not prompts:
         return _error(422, "prompt must be non-empty", "invalid_request_error")
+    if payload.best_of is not None and payload.best_of < payload.n:
+        return _error(
+            422, f"best_of ({payload.best_of}) must be >= n ({payload.n})",
+            "invalid_request_error",
+        )
+    best_of = payload.best_of or payload.n
     batcher: RequestBatcher = request.app["batcher"]
     engine: VGTEngine = request.app["engine"]
     n_submits, deterministic = _n_plan(
-        engine, payload.temperature, payload.seed, payload.n
+        engine, payload.temperature, payload.seed, best_of
     )
     # legacy semantics: logprobs=0 still returns per-token logprobs, with
     # zero alternatives
     want_lp = payload.logprobs is not None
+    # best_of > n ranks candidates by mean token logprob server-side, so
+    # logprobs are requested internally even when the client didn't ask
+    ranking = not deterministic and best_of > payload.n
 
     settled, err = await _settle_submits(
         engine,
@@ -561,11 +570,11 @@ async def completions(request: web.Request) -> web.Response:
                     payload.seed + i if payload.seed is not None else None
                 ),
                 timeout_s=engine.config.server.request_timeout_s,
-                logprobs=want_lp,
+                logprobs=want_lp or ranking,
                 top_logprobs=payload.logprobs or 0,
                 # globally unique salt: duplicate prompts in the list must
                 # not dedup into one sample
-                variant=pi * payload.n + i,
+                variant=pi * best_of + i,
                 frequency_penalty=payload.frequency_penalty or 0.0,
                 presence_penalty=payload.presence_penalty or 0.0,
                 logit_bias=logit_bias,
@@ -577,12 +586,33 @@ async def completions(request: web.Request) -> web.Response:
     if err is not None:
         return err
 
+    def mean_logprob(r) -> float:
+        entries = r.get("logprobs") or []
+        if not entries:
+            return float("-inf")
+        return sum(e["logprob"] for e in entries) / len(entries)
+
     choices = []
     prompt_tokens = 0
     completion_tokens = 0
     idx = 0
     for pi, p in enumerate(prompts):
         per_prompt = settled[pi * n_submits : (pi + 1) * n_submits]
+        if ranking:
+            # keep the n best candidates (OpenAI legacy: "the one with
+            # the highest log probability per token"); the discarded
+            # ones still burned decode steps, so usage counts ALL
+            # best_of generations (the OpenAI accounting)
+            ranked = sorted(per_prompt, key=mean_logprob, reverse=True)
+            per_prompt = ranked[: payload.n]
+            completion_tokens += sum(
+                r.get("num_tokens", 0) for r in ranked[payload.n :]
+            )
+            if not want_lp:  # internal-only logprobs: strip from output
+                per_prompt = [
+                    {k: v for k, v in r.items() if k != "logprobs"}
+                    for r in per_prompt
+                ]
         per_prompt = (list(per_prompt) * payload.n)[: payload.n]
         prompt_tokens += per_prompt[0].get("prompt_tokens", 0)
         for r in per_prompt:

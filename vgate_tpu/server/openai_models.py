@@ -150,6 +150,9 @@ class CompletionRequest(BaseModel):
     seed: Optional[int] = None
     logprobs: Optional[int] = Field(default=None, ge=0, le=8)
     n: int = Field(default=1, ge=1, le=8)
+    # legacy best_of: generate this many candidates server-side and
+    # return the n with the highest mean token logprob (must be >= n)
+    best_of: Optional[int] = Field(default=None, ge=1, le=16)
     echo: bool = False
     stream: bool = False  # declared so stream=true can be rejected, not
     # silently ignored (SSE is the chat endpoint's surface)
