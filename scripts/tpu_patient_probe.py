@@ -1,8 +1,17 @@
-"""Patient TPU probe: wait for the grant WITHOUT ever killing a device
-process (a killed mid-init process is what wedges the axon grant —
-memory: tpu-grant-discipline).  Backend init simply blocks until the
-grant heals; when it does, write one status line and exit.  Run under
-nohup and poll the status file.
+"""Patient TPU probe: ONE kill-free backend-init attempt per process.
+
+Two failure modes exist and both are handled without ever killing a
+device process (a killed mid-init process is what wedges the axon
+grant — memory: tpu-grant-discipline):
+
+* backend init BLOCKS (wedged grant): this process simply blocks with
+  it and reports whenever it completes;
+* backend init fails fast with UNAVAILABLE: exit 1, and the shell loop
+  in scripts/tpu_probe_loop.sh retries with a fresh process (a failed
+  init poisons jax's in-process backend cache, so retrying in-process
+  is unreliable).
+
+On success, write one status line to the status file and exit 0.
 """
 
 import json
@@ -12,9 +21,20 @@ import time
 STATUS = sys.argv[1] if len(sys.argv) > 1 else "/tmp/vgt_tpu_status.json"
 
 start = time.time()
-import jax  # noqa: E402  (may block for a long time on a wedged grant)
+try:
+    import jax  # noqa: E402  (may block on a wedged grant)
 
-d = jax.devices()[0]
+    d = jax.devices()[0]
+    if d.platform == "cpu":
+        raise RuntimeError("only cpu devices visible")
+except Exception as exc:  # noqa: BLE001
+    print(
+        f"[probe] failed after {time.time() - start:.0f}s: "
+        f"{type(exc).__name__}: {str(exc)[:200]}",
+        flush=True,
+    )
+    sys.exit(1)
+
 result = {
     "platform": d.platform,
     "kind": getattr(d, "device_kind", "unknown"),
@@ -23,4 +43,4 @@ result = {
 }
 with open(STATUS, "w") as f:
     f.write(json.dumps(result) + "\n")
-print(json.dumps(result))
+print(json.dumps(result), flush=True)

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Retry the kill-free patient probe in a fresh process every CYCLE
+# seconds until it reports a healthy grant (fast-UNAVAILABLE failures
+# need a fresh process: a failed init poisons jax's backend cache).
+set -u
+cd "$(dirname "$0")/.."
+STATUS=${1:-/tmp/vgt_tpu_status.json}
+CYCLE=${CYCLE:-120}
+for i in $(seq 1 500); do
+  if python scripts/tpu_patient_probe.py "$STATUS"; then
+    echo "[probe_loop] healthy after $i attempts" >&2
+    exit 0
+  fi
+  sleep "$CYCLE"
+done
+exit 1
