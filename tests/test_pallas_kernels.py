@@ -531,3 +531,62 @@ def test_int8_kernel_gate_dispatch(monkeypatch):
         jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32), bits=8
     )
     assert not quant._use_quant_kernel("...d,dh->...h", ws)
+
+
+def test_suffix_prefill_pallas_matches_jnp():
+    """prefill_suffix_forward(use_pallas=True) routes the context
+    attention through the multitok kernel (the chunked/long-context
+    prefill hot path); logits and KV must match the jnp suffix path
+    for both page-aligned prefixes and varying suffix lengths."""
+    from vgate_tpu.models.decoder import (
+        init_params, prefill_forward, prefill_suffix_forward,
+    )
+    from vgate_tpu.models.specs import TINY_DENSE as spec
+
+    ps, pps, B = 16, 4, 2  # kernel-friendly page size
+    params = init_params(spec, jax.random.PRNGKey(5), jnp.float32)
+    P = 1 + B * pps
+    shape = (spec.num_layers, spec.num_kv_heads, P, ps, spec.head_dim)
+    k0 = jnp.zeros(shape, jnp.float32)
+    v0 = jnp.zeros(shape, jnp.float32)
+    pt = jnp.asarray(
+        1 + np.arange(B * pps).reshape(B, pps), jnp.int32
+    )
+    rng = np.random.default_rng(6)
+    # resident prefix: one full page per row
+    prefix = jnp.asarray(
+        rng.integers(2, spec.vocab_size, (B, ps)), jnp.int32
+    )
+    _, kf, vf = prefill_forward(
+        params, spec, prefix, jnp.full((B,), ps, jnp.int32), k0, v0,
+        pt[:, :1],
+    )
+    S = 16  # suffix bucket
+    sfx = jnp.asarray(
+        rng.integers(2, spec.vocab_size, (B, S)), jnp.int32
+    )
+    args = (
+        params, spec, sfx, jnp.full((B,), ps, jnp.int32),
+        jnp.asarray([S, 5], jnp.int32), kf, vf, pt[:, 1:2], pt[:, :2],
+    )
+    import unittest.mock as mock
+
+    from vgate_tpu.ops.pallas import paged_attention as pa
+
+    real = pa.paged_multitok_attention_pallas
+
+    def interp(*a, **kw):
+        kw["interpret"] = True
+        return real(*a, **kw)
+
+    expect = prefill_suffix_forward(*args, use_pallas=False)
+    with mock.patch.object(
+        pa, "paged_multitok_attention_pallas", side_effect=interp
+    ):
+        got = prefill_suffix_forward(*args, use_pallas=True)
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(expect[0]), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[1]), np.asarray(expect[1]), rtol=1e-5, atol=1e-5
+    )

@@ -676,6 +676,7 @@ def prefill_suffix_forward(
     suffix_page_tables: jnp.ndarray,  # [B, S // ps] pages the suffix fills
     ctx_page_tables: jnp.ndarray,  # [B, ctx_pages] window covering prefix+suffix
     kv_carry: bool = False,  # thread FULL KV buffers as scan carry
+    use_pallas: bool = False,  # multitok kernel for the context attention
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prompt pass for only the uncached suffix of a prefix-cache hit.
 
@@ -694,6 +695,18 @@ def prefill_suffix_forward(
     total_lens = prefix_lens + suffix_lens
     x = _embed(params, spec, tokens)  # [B, S, D]
 
+    # The multitok kernel holds all S query rows in VMEM (it was sized
+    # for speculative verify): at S=1024, G=6, hd=128 the f32
+    # acc/m/l/scores blocks total ~15 MB — comfortable; S=2048 doubles
+    # that and serializes huge per-program dots.  Cap the kernel route
+    # at the default chunked-prefill width and keep the blockwise jnp
+    # path beyond (row-tiling the kernel is the future fix).
+    use_pallas = use_pallas and S <= 1024
+    if use_pallas:
+        from vgate_tpu.ops.pallas.paged_attention import (
+            paged_multitok_attention_pallas,
+        )
+
     # carry threading: both the suffix write AND the paged context read
     # are layer-indexed on the full [L, ...] buffers — no per-layer pool
     # slice ever materializes (the chunked-prefill hot path runs this
@@ -703,12 +716,22 @@ def prefill_suffix_forward(
             h, lp, spec, positions, suffix_page_tables, kp, vp,
             layer=layer,
         )
-        attn = paged_suffix_attention(
-            q, kp, vp, ctx_page_tables, prefix_lens,
-            total_lens, softcap=spec.attn_softcap,
-            window=win if spec.sliding_window > 0 else None,
-            scale=_query_scale(spec), layer=layer,
-        )
+        window = win if spec.sliding_window > 0 else None
+        if use_pallas:
+            # the multitok kernel IS suffix attention: S query rows
+            # starting at an arbitrary position, causal within the
+            # rows, live-page DMA only (the suffix KV was just written)
+            attn = paged_multitok_attention_pallas(
+                q, kp, vp, ctx_page_tables, prefix_lens, suffix_lens,
+                window=window, layer=layer,
+                softcap=spec.attn_softcap, scale=_query_scale(spec),
+            )
+        else:
+            attn = paged_suffix_attention(
+                q, kp, vp, ctx_page_tables, prefix_lens,
+                total_lens, softcap=spec.attn_softcap,
+                window=window, scale=_query_scale(spec), layer=layer,
+            )
         return _finish_layer(h, attn, lp, spec), kp, vp
 
     x, k_pages, v_pages = _kv_layer_scan(

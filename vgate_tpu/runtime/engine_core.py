@@ -129,7 +129,7 @@ def _prefill_step(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "num_logprobs", "kv_carry"),
+    static_argnames=("spec", "num_logprobs", "kv_carry", "use_pallas"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _suffix_prefill_step(
@@ -138,13 +138,14 @@ def _suffix_prefill_step(
     key, seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, kv_carry: bool = False,
-    bias_ids=None, bias_vals=None,
+    bias_ids=None, bias_vals=None, use_pallas: bool = False,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
     logits, k_pages, v_pages = prefill_suffix_forward(
         params, spec, tokens, prefix_lens, suffix_lens, k_pages, v_pages,
         suffix_page_tables, ctx_page_tables, kv_carry=kv_carry,
+        use_pallas=use_pallas,
     )
     if counts is not None:
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
@@ -1259,6 +1260,7 @@ class EngineCore:
             kv_carry=self._kv_carry,
             bias_ids=lb_ids,
             bias_vals=lb_vals,
+            use_pallas=self.use_pallas,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1325,6 +1327,7 @@ class EngineCore:
                 seeds=jnp.full((1,), -1, jnp.int32),
                 steps=jnp.zeros((1,), jnp.int32),
                 kv_carry=self._kv_carry,
+                use_pallas=self.use_pallas,
             )
             start += n
         # final chunk: exactly a B=1 suffix-group dispatch with
