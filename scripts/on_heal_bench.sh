@@ -1,24 +1,29 @@
 #!/bin/bash
-# Companion to tpu_patient_probe.py: when the probe reports a healthy
-# grant, run the headline bench ONCE, record it, and stop.  Serialized
-# behind the same lockfile discipline as tpu_watch.sh.
+# Companion to scripts/tpu_probe_loop.sh: when the probe reports a
+# healthy grant, run the SHORT high-value measurement list (serialized,
+# kill-free, ~15 min) and stop — deliberately brief so a driver-run
+# bench near round end never finds the chip held.
 set -u
 cd "$(dirname "$0")/.."
 STATUS=/tmp/vgt_tpu_status.json
+R=benchmarks/RESULTS_r3.md
 for i in $(seq 1 720); do  # up to 12h of minute-polls
   if [ -s "$STATUS" ]; then
     if mkdir /tmp/vgt_tpu.lock 2>/dev/null; then
       trap 'rmdir /tmp/vgt_tpu.lock 2>/dev/null' EXIT
-      echo "[on_heal] grant healthy at $(date -u +%FT%TZ); running bench" >&2
-      out=$(python bench.py 2>/dev/null | tail -1)
+      echo "[on_heal] grant healthy at $(date -u +%FT%TZ)" >&2
       {
         echo ""
-        echo "### first healthy-grant bench ($(date -u +%FT%TZ), auto)"
+        echo "### healthy-grant auto-capture ($(date -u +%FT%TZ))"
         echo '```'
-        echo "$out"
-        echo '```'
-      } >> benchmarks/RESULTS_r3.md
+      } >> "$R"
+      out=$(python bench.py 2>/dev/null | tail -1)
+      echo "$out" >> "$R"
       echo "$out" > BENCH_r03_candidate.json
+      python benchmarks/bench_decode_ablate.py 2>/dev/null >> "$R"
+      VGT_BENCH_QUANT=int4 python bench.py 2>/dev/null | tail -1 >> "$R"
+      VGT_BENCH_PAGE=32 python bench.py 2>/dev/null | tail -1 >> "$R"
+      echo '```' >> "$R"
       echo "[on_heal] recorded; exiting" >&2
       exit 0
     fi
