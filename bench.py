@@ -285,9 +285,20 @@ def main() -> None:
                     x.size * x.dtype.itemsize
                     for x in jax.tree.leaves(core.params["embed"])
                 )
-            # steps/s at effective concurrency; roofline steps/s =
-            # HBM_BW / weight_bytes (KV traffic excluded: optimistic)
-            occupancy = min(slots, n_requests)
+            # steps/s at MEASURED average decode concurrency (live
+            # decoding slot-seconds over the wall), not the configured
+            # slot count — staggered finishes would otherwise understate
+            # the fraction.  Roofline steps/s = HBM_BW / weight_bytes
+            # (KV traffic excluded: optimistic bound).
+            live_s = sum(
+                (s.finish_t - s.first_token_t)
+                for s in seqs
+                if s.finish_t is not None and s.first_token_t is not None
+            )
+            occupancy = min(
+                float(min(slots, n_requests)),
+                max(1e-6, live_s / wall),
+            )
             hbm_frac = (
                 (toks_per_s / occupancy)
                 / (hbm_gbps * 1e9 / weight_bytes)

@@ -33,6 +33,8 @@ def main() -> None:
     ap.add_argument("--ctx", type=int, default=512)
     ap.add_argument("--greedy", action="store_true",
                     help="all-greedy sampling variant (argmax fast path)")
+    ap.add_argument("--kv-carry", action="store_true",
+                    help="carry-threaded KV variant (the serving default)")
     args = ap.parse_args()
 
     from vgate_tpu.models.decoder import init_params
@@ -70,6 +72,7 @@ def main() -> None:
         active, temps, top_ps, top_ks, key, counter,
         num_steps=args.steps, use_pallas=False,
         max_position=args.ctx - 1, seeds=seeds, steps=steps_arr,
+        all_greedy=args.greedy, kv_carry=args.kv_carry,
     )
     compiled = lowered.compile()
     ca = compiled.cost_analysis()

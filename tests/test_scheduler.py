@@ -336,3 +336,43 @@ def test_has_admissible_waiting_distinguishes_blockers():
     assert not sched.has_admissible_waiting()  # only entry is aborted
     sched.add(Sequence(prompt_ids=[2] * 4, params=sp))
     assert sched.has_admissible_waiting()
+
+
+def test_has_admissible_waiting_counts_evictable_matched_pages():
+    """A matched prefix page parked in the evictable LRU counts toward
+    num_free, but admission would REVIVE it out of that pool — the
+    predicate must not double-count it as both free and matched."""
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.runtime.kv_cache import PageAllocator
+    from vgate_tpu.runtime.scheduler import Scheduler
+
+    alloc = PageAllocator(8)  # pages 1..7 allocatable
+    sched = Scheduler(
+        allocator=alloc, max_slots=2, page_size=4,
+        prefill_buckets=[8, 16], max_model_len=32, prefix_cache=True,
+    )
+    sp = SamplingParams(max_tokens=4, temperature=0.0)
+    from vgate_tpu.runtime.sequence import Sequence
+
+    seq = Sequence(prompt_ids=[3] * 12, params=sp)  # needs 3 pages
+    # make its first full page resident-and-evictable: register a page
+    # under the prompt's first chain hash, then release it to refcount 0
+    chain = sched._prefix_chain(seq)
+    [page] = alloc.allocate(1)
+    alloc.register(page, chain[0])
+    alloc.release([page])
+    assert alloc.is_evictable(page)
+
+    sched.add(seq)
+    # pool state: 7 allocatable, 6 truly free + 1 evictable-matched.
+    # needs 3 pages total, 1 matched -> allocate(2) vs 6 free: fine
+    assert sched.has_admissible_waiting()
+
+    # drain free pages so only the evictable matched page + 1 remain:
+    # allocate(5) leaves num_free = 2 (1 free + 1 evictable-matched);
+    # naive math says needed 2 <= 2, but admission revives the matched
+    # page first, leaving just 1 allocatable for the 2-page remainder
+    held = alloc.allocate(5)
+    assert held is not None
+    assert alloc.num_free == 2
+    assert not sched.has_admissible_waiting()

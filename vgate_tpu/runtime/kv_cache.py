@@ -219,6 +219,12 @@ class PageAllocator:
         refcounts)."""
         return self._hash_to_page.get(content_hash)
 
+    def is_evictable(self, page: int) -> bool:
+        """True when the page is parked in the refcount-0 LRU: a prefix
+        lookup() would revive it OUT of the allocatable pool, so
+        admissibility math must not count it as free AND matched."""
+        return page in self._evictable
+
     def lookup(self, content_hash: int) -> Optional[int]:
         """Find a cached page for this hash and take a reference to it."""
         page = self._hash_to_page.get(content_hash)

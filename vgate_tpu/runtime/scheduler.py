@@ -161,11 +161,23 @@ class Scheduler:
         n_pages = cdiv(max(1, head.num_prompt_tokens), self.page_size)
         if self.prefix_cache:
             # mirror try_admit's accounting: resident prefix pages are
-            # shared, not allocated (peek — no refcount mutation here)
+            # shared, not allocated (peek — no refcount mutation).  A
+            # matched page that is currently EVICTABLE counts toward
+            # num_free, but try_admit's lookup() would revive it out of
+            # that pool — subtract those or this predicate would say
+            # "admissible" where allocate() then fails (busy-spin +
+            # needless decode-chunk shrink).
+            matched_evictable = 0
             for h in self._prefix_chain(head):
-                if self.allocator.peek(h) is None:
+                page = self.allocator.peek(h)
+                if page is None:
                     break
                 n_pages -= 1
+                if self.allocator.is_evictable(page):
+                    matched_evictable += 1
+            return (
+                self.allocator.num_free - matched_evictable >= n_pages
+            )
         return self.allocator.num_free >= n_pages
 
     # -- planning --
