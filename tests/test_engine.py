@@ -974,3 +974,28 @@ def test_stream_async_reports_usage():
         )
     finally:
         backend.shutdown()
+
+
+def test_chunked_prefill_carries_logprobs():
+    """The final chunk of a chunked prefill delegates to the suffix
+    group, so a long prompt's request-level logprobs must come back
+    aligned with every generated token."""
+    core = EngineCore(chunked_cfg(16), devices=jax.devices()[:1])
+    core.start()
+    try:
+        seq = core.submit_tokens(
+            [3 + (i % 13) for i in range(40)],
+            SamplingParams(
+                max_tokens=6, temperature=0.0, logprobs=True,
+                top_logprobs=3,
+            ),
+        )
+        assert seq.done_event.wait(300)
+        assert seq.num_output_tokens == len(seq.logprob_data) == 6
+        entries = core.logprob_entries(seq)
+        assert len(entries) == 6
+        for e in entries:
+            assert e["logprob"] <= 0.0
+            assert len(e["top_logprobs"]) == 3
+    finally:
+        core.stop()
