@@ -41,14 +41,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _sync(out):
+    """Force completion via a HOST TRANSFER of (a leaf of) the output.
+
+    On the remote-tunnel axon platform ``block_until_ready`` returned
+    instantly for multi-GB programs in the r4 session (ms_per_step
+    0.002-0.004 for a full 128-slot decode chunk — physically
+    impossible), so timing trusts only an explicit device->host copy of
+    real output bytes, the same sync the serving engine does.
+    """
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "shape")]
+    small = min(leaves, key=lambda x: x.size)
+    np.asarray(small)
+
+
 def timed(fn, *args, iters_inside: int, reps: int = 3) -> float:
     """ms per inner iteration: best of ``reps`` timed dispatches."""
-    out = fn(*args)
-    jax.block_until_ready(out)  # compile + warm
+    _sync(fn(*args))  # compile + warm
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        _sync(fn(*args))
         best = min(best, time.perf_counter() - t0)
     return best / iters_inside * 1e3
 
@@ -97,6 +110,15 @@ def main() -> None:
         print(json.dumps({**base, "component": component,
                           "ms_per_step": round(ms, 3)}), flush=True)
 
+    # bare dispatch + host-readback round-trip (NOT divided by STEPS):
+    # subtract this from `* 32` totals when comparing absolute floors
+    if not only or "rtt" in only:
+        @jax.jit
+        def rtt_fn(t):
+            return t + 1
+
+        report("rtt", timed(rtt_fn, tokens, iters_inside=1))
+
     # --- full serving chunk (pallas / jnp x xs-ys / carry KV) -------------
     for name, use_pallas, kv_carry in (
         ("chunk-pallas", True, False),
@@ -117,20 +139,17 @@ def main() -> None:
                 seeds=seeds, steps=steps0, kv_carry=kc,
             )[0]
 
-        # donation consumes the caches: rebuild per call outside timing is
-        # wrong; instead keep two fresh copies and let XLA alias — simplest
-        # correct form: pass non-donated copies each rep via device_put
+        # donation consumes the caches: rebuild fresh copies per rep
         kp = jnp.zeros(kv_shape, dtype)
         vp = jnp.zeros(kv_shape, dtype)
-        out = run(kp, vp)
-        jax.block_until_ready(out)
+        _sync(run(kp, vp))  # compile + warm
         best = float("inf")
         for _ in range(3):
             kp = jnp.zeros(kv_shape, dtype)
             vp = jnp.zeros(kv_shape, dtype)
             jax.block_until_ready((kp, vp))
             t0 = time.perf_counter()
-            jax.block_until_ready(run(kp, vp))
+            _sync(run(kp, vp))
             best = min(best, time.perf_counter() - t0)
         report(name, best / STEPS * 1e3)
 
@@ -141,9 +160,12 @@ def main() -> None:
         if use_pallas and platform != "tpu":
             continue
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1),
-                           static_argnums=(2,))
-        def fwd_loop(k_pages, v_pages, up):
+        # params passed explicitly: closing over them captures multi-GB
+        # constants into the lowered program (3.09 GB observed r4), which
+        # the tunnel then re-uploads per executable
+        @functools.partial(jax.jit, donate_argnums=(1, 2),
+                           static_argnums=(3,))
+        def fwd_loop(params, k_pages, v_pages, up):
             def body(carry, _):
                 toks, pos, kp, vp = carry
                 logits, kp, vp = decode_forward(
@@ -162,14 +184,14 @@ def main() -> None:
 
         kp = jnp.zeros(kv_shape, dtype)
         vp = jnp.zeros(kv_shape, dtype)
-        jax.block_until_ready(fwd_loop(kp, vp, use_pallas))
+        _sync(fwd_loop(params, kp, vp, use_pallas))
         best = float("inf")
         for _ in range(3):
             kp = jnp.zeros(kv_shape, dtype)
             vp = jnp.zeros(kv_shape, dtype)
             jax.block_until_ready((kp, vp))
             t0 = time.perf_counter()
-            jax.block_until_ready(fwd_loop(kp, vp, use_pallas))
+            _sync(fwd_loop(params, kp, vp, use_pallas))
             best = min(best, time.perf_counter() - t0)
         report(name, best / STEPS * 1e3)
 
@@ -188,9 +210,9 @@ def main() -> None:
                 continue
             from vgate_tpu.models.decoder import prefill_forward
 
-            @functools.partial(jax.jit, donate_argnums=(0, 1),
-                               static_argnums=(2,))
-            def prefill_loop(kp, vp, kc):
+            @functools.partial(jax.jit, donate_argnums=(1, 2),
+                               static_argnums=(3,))
+            def prefill_loop(params, kp, vp, kc):
                 def body(c, _):
                     kp, vp = c
                     logits, kp, vp = prefill_forward(
@@ -206,14 +228,14 @@ def main() -> None:
 
             kp = jnp.zeros(kv_shape, dtype)
             vp = jnp.zeros(kv_shape, dtype)
-            jax.block_until_ready(prefill_loop(kp, vp, kc))
+            _sync(prefill_loop(params, kp, vp, kc))
             best = float("inf")
             for _ in range(3):
                 kp = jnp.zeros(kv_shape, dtype)
                 vp = jnp.zeros(kv_shape, dtype)
                 jax.block_until_ready((kp, vp))
                 t0 = time.perf_counter()
-                jax.block_until_ready(prefill_loop(kp, vp, kc))
+                _sync(prefill_loop(params, kp, vp, kc))
                 best = min(best, time.perf_counter() - t0)
             # ms per prefill DISPATCH (B=32 x 128-token bucket)
             report(name, best / 4 * 1e3)
@@ -257,7 +279,7 @@ def main() -> None:
         )
 
         @jax.jit
-        def lmhead_loop(x):
+        def lmhead_loop(params, x):
             def body(c, _):
                 lg = logits_fn(params, spec, x + c)
                 return lg[:, 0].astype(dtype)[:, None] * 0 + c, ()
@@ -266,7 +288,7 @@ def main() -> None:
             )
             return out
 
-        report("lmhead", timed(lmhead_loop, x, iters_inside=STEPS))
+        report("lmhead", timed(lmhead_loop, params, x, iters_inside=STEPS))
 
     # --- attention only (28 layer calls per iteration) --------------------
     q = jax.random.normal(
@@ -300,7 +322,7 @@ def main() -> None:
             )
 
         @jax.jit
-        def attn_loop(q):
+        def attn_loop(q, kp1, vp1):
             # outer scan amortizes the dispatch round-trip over STEPS
             # decode-steps; each step runs all L layer calls
             def step(c, _):
@@ -312,7 +334,7 @@ def main() -> None:
             out, _ = jax.lax.scan(step, q, None, length=STEPS)
             return out
 
-        report(name, timed(attn_loop, q, iters_inside=STEPS))
+        report(name, timed(attn_loop, q, kp1, vp1, iters_inside=STEPS))
 
     print(json.dumps({**base, "event": "done"}), flush=True)
 
