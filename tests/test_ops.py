@@ -400,7 +400,8 @@ def test_paged_decode_window_matches_truncated_context():
 # ------------------------------------------- carry-threaded KV parity
 
 def test_kv_carry_parity_all_forwards():
-    """tpu.kv_carry (default ON for plain meshes) must be numerically
+    """tpu.kv_carry (A/B handle; default OFF — measured 5.2x decode
+    regression on v5e, RESULTS_r4.md) must be numerically
     identical to the r2 xs/ys threading across decode, prefill and
     suffix-prefill, for a global-attention family AND the sliding-window
     /softcap family (the carry paths use mixed scalar/slice/array

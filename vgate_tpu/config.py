@@ -150,12 +150,14 @@ class TPUConfig(BaseModel):
     use_pallas: bool = True
     # Thread the FULL [L, ...] KV pools through the decode AND prefill
     # scans as carry (layer-indexed in-place updates + layer-indexed
-    # attention reads) instead of per-layer xs/ys slices — the xs form
-    # materializes each layer's whole page pool (~2x67 MB at serving
-    # sizes) per layer per program to feed the attention/write ops.
-    # False restores the r2 xs/ys layout for A/B measurement.  Applies
-    # to plain (sp=1, pp=1) meshes; the ring/relay paths keep xs/ys.
-    kv_carry: bool = True
+    # attention reads) instead of per-layer xs/ys slices.  MEASURED ON
+    # TPU v5e (r4, benchmarks/RESULTS_r4.md): carry is a 5.2x decode
+    # REGRESSION at the 1.5B serving shape (719 vs 3729 tok/s/chip) —
+    # XLA handles the xs/ys slice threading without materializing the
+    # pools, while the layer-indexed dynamic reads/writes on the full
+    # [L,...] carry defeat its aliasing.  Default OFF; kept as an A/B
+    # handle.  Applies to plain (sp=1, pp=1) meshes only.
+    kv_carry: bool = False
 
     @model_validator(mode="before")
     @classmethod
