@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 measurement session 1 (serialized; one TPU process at a time).
+# Each run appends its JSON line to /tmp/r4_session1.log with a tag.
+cd /root/repo
+log=/tmp/r4_session1.log
+run() {
+  tag="$1"; shift
+  echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
+  env "$@" python bench.py >> "$log" 2>/tmp/r4_${tag}.err
+  echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+  sleep 20
+}
+
+run page32 VGT_BENCH_PAGE=32
+run page64 VGT_BENCH_PAGE=64
+run int8   VGT_BENCH_QUANT=int8
+run int4   VGT_BENCH_QUANT=int4
+echo "### ablate start $(date -u +%H:%M:%S)" >> "$log"
+python benchmarks/bench_decode_ablate.py >> "$log" 2>/tmp/r4_ablate.err
+echo "### ablate rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+echo "### SESSION DONE $(date -u +%H:%M:%S)" >> "$log"

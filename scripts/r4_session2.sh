@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-4 measurement session 2: flagship 7B, long context, realistic
+# arrivals, prefix/speculative/kernel benches.  Serialized.
+cd /root/repo
+log=/tmp/r4_session2.log
+run() {
+  tag="$1"; shift
+  echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
+  env "$@" python bench.py >> "$log" 2>/tmp/r4_${tag}.err
+  echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+  sleep 20
+}
+aux() {
+  tag="$1"; script="$2"; shift 2
+  echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
+  env "$@" python "$script" >> "$log" 2>/tmp/r4_${tag}.err
+  echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+  sleep 20
+}
+
+# 1. north star: Qwen2.5-7B int8 on one chip (host-staged load)
+run 7b_int8 VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct VGT_BENCH_QUANT=int8 \
+    VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
+# 2. long context >= 8k with chunked prefill
+run ctx8k VGT_BENCH_CTX=8192 VGT_BENCH_PROMPT=7900 VGT_BENCH_MAXTOK=128 \
+    VGT_BENCH_REQUESTS=8 VGT_BENCH_SLOTS=8 VGT_BENCH_PREFILL_BATCH=1 \
+    VGT_BENCH_PAGE=32
+# 3. TTFT under Poisson arrivals: below and above the service knee
+run poisson25 VGT_BENCH_RATE=25 VGT_BENCH_PAGE=32
+run poisson40 VGT_BENCH_RATE=40 VGT_BENCH_PAGE=32
+# 4. shared-prefix TTFT + speculative + kernels
+aux prefix benchmarks/bench_prefix.py
+aux spec benchmarks/bench_speculative.py
+aux kernels benchmarks/bench_kernels.py
+echo "### SESSION2 DONE $(date -u +%H:%M:%S)" >> "$log"

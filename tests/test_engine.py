@@ -803,13 +803,16 @@ def test_chunked_prefill_with_prefix_cache_hit():
         core.stop()
 
 
-def test_chunked_prefill_rejects_sp_pp():
+def test_chunked_prefill_rejects_pp():
+    """pp still reshapes the prompt pass incompatibly (sp no longer
+    does: chunks ride the sp-capable suffix program, RESULTS_r4)."""
     if jax.device_count() < 2:
         pytest.skip("needs 2 devices")
     cfg = load_config(
         model={"model_id": "tiny-dense", "engine_type": "jax_tpu",
                "dtype": "float32", "max_model_len": 64},
-        tpu={"dp": 1, "tp": 1, "ep": 1, "sp": 2, "num_devices": 2,
+        tpu={"dp": 1, "tp": 1, "ep": 1, "sp": 1, "pp": 2,
+             "num_devices": 2,
              "kv_num_pages": 64, "kv_page_size": 4,
              "max_batch_slots": 2, "prefill_buckets": [16],
              "use_pallas": False, "prefill_chunk": 16},
@@ -817,6 +820,70 @@ def test_chunked_prefill_rejects_sp_pp():
     )
     with pytest.raises(ValueError, match="prefill_chunk"):
         EngineCore(cfg, devices=jax.devices()[:2])
+
+
+def _sp_prefix_cfg(sp, n_dev, prefill_chunk=0):
+    return load_config(
+        model={"model_id": "tiny-dense", "engine_type": "jax_tpu",
+               "dtype": "float32", "max_model_len": 64},
+        tpu={"dp": 1, "tp": 1, "ep": 1, "sp": sp, "num_devices": n_dev,
+             "kv_num_pages": 64, "kv_page_size": 4,
+             "max_batch_slots": 2, "prefill_buckets": [16, 32],
+             "use_pallas": False, "prefill_chunk": prefill_chunk},
+        scheduler={"max_queue_size": 8},
+        logging={"level": "WARNING"},
+    )
+
+
+def test_sp_prefix_cache_hit_end_to_end():
+    """Prefix caching now composes with sp (VERDICT r3 next-7): on an
+    sp=2 pool the second identical prompt rides the sp-sharded suffix
+    program (sp_suffix_attention_and_write), records a prefix hit, and
+    produces output token-identical to the sp=1 engine."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >=2 devices")
+    prompt_ids = [7 + (i % 23) for i in range(28)]
+    outs = []
+    for sp, n_dev in ((1, 1), (2, 2)):
+        cfg = _sp_prefix_cfg(sp, n_dev)
+        core = EngineCore(cfg, devices=jax.devices()[:n_dev])
+        assert core.prefix_cache_enabled
+        core.start()
+        try:
+            a = core.submit_tokens(prompt_ids, greedy(8))
+            assert a.done_event.wait(300)
+            hits_before = core.scheduler.total_prefix_hit_tokens
+            b = core.submit_tokens(prompt_ids, greedy(8))
+            assert b.done_event.wait(300)
+            assert list(a.generated_ids) == list(b.generated_ids)
+            assert core.scheduler.total_prefix_hit_tokens > hits_before
+            outs.append(list(b.generated_ids))
+        finally:
+            core.stop()
+    assert outs[0] == outs[1]
+
+
+def test_sp_chunked_prefill_end_to_end():
+    """Chunked prefill under sp=2: long prompts run page-aligned suffix
+    chunks through the sp-sharded suffix program; greedy output is
+    token-identical to the sp=1 chunked engine."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >=2 devices")
+    prompt_ids = [3 + (i % 29) for i in range(44)]
+    outs = []
+    for sp, n_dev in ((1, 1), (2, 2)):
+        core = EngineCore(
+            _sp_prefix_cfg(sp, n_dev, prefill_chunk=16),
+            devices=jax.devices()[:n_dev],
+        )
+        core.start()
+        try:
+            seq = core.submit_tokens(prompt_ids, greedy(8))
+            assert seq.done_event.wait(300)
+            outs.append(list(seq.generated_ids))
+        finally:
+            core.stop()
+    assert outs[0] == outs[1]
 
 
 # ------------------------------------------------------ client aborts

@@ -153,3 +153,117 @@ def test_ring_rejects_indivisible_seq():
     q = jnp.zeros((1, 30, 4, 16))
     with pytest.raises(ValueError):
         ring_prefill_attention(q, q, q, jnp.asarray([30]), mesh)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_sp_suffix_attention_matches_oracle(sp):
+    """sp-sharded suffix prefill (prefix caching on an sp pool): each
+    shard writes its owned suffix pages + computes blockwise partials
+    over its resident ctx slice; the LSE merge must equal the
+    single-device paged_suffix_attention oracle after a plain write."""
+    from vgate_tpu.ops.attention import paged_suffix_attention
+    from vgate_tpu.parallel.sp_decode import sp_suffix_attention_and_write
+
+    rng = np.random.default_rng(47 + sp)
+    B, S, H, KV, hd, ps = 2, 16, 4, 2, 32, 4
+    n_suffix_pages = S // ps
+    P = 24 * sp
+    k_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    shard = P // sp
+    reserved = {i * shard for i in range(sp)}
+    candidates = [p for p in range(P) if p not in reserved]
+    # prefix: 2 pages resident; suffix: up to n_suffix_pages fresh pages
+    prefix_pages = 2
+    ctx_pages = prefix_pages + n_suffix_pages
+    all_pages = rng.choice(
+        candidates, size=(B, ctx_pages), replace=False
+    ).astype(np.int32)
+    ctx_pt = jnp.asarray(all_pages)
+    suffix_pt = jnp.asarray(all_pages[:, prefix_pages:])
+    prefix_lens = jnp.asarray([prefix_pages * ps] * B, jnp.int32)
+    suffix_lens = jnp.asarray([S, S - 5], jnp.int32)
+    total_lens = prefix_lens + suffix_lens
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k_s = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    v_s = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+
+    # oracle: plain suffix write + single-device suffix attention
+    k_w = jnp.transpose(
+        k_s.reshape(B, n_suffix_pages, ps, KV, hd), (3, 0, 1, 2, 4)
+    )
+    v_w = jnp.transpose(
+        v_s.reshape(B, n_suffix_pages, ps, KV, hd), (3, 0, 1, 2, 4)
+    )
+    ko = k_pages.at[:, suffix_pt].set(k_w)
+    vo = v_pages.at[:, suffix_pt].set(v_w)
+    expect = paged_suffix_attention(
+        q, ko, vo, ctx_pt, prefix_lens, total_lens
+    )
+
+    got, k_out, v_out = sp_suffix_attention_and_write(
+        q, k_s, v_s, k_pages, v_pages, suffix_pt, ctx_pt,
+        prefix_lens, total_lens, sp_mesh(sp),
+    )
+    for b in range(B):
+        n = int(suffix_lens[b])
+        np.testing.assert_allclose(
+            np.asarray(got[b, :n]), np.asarray(expect[b, :n]),
+            rtol=2e-5, atol=2e-5,
+        )
+    # suffix pages hold the fresh KV on their owners
+    np.testing.assert_allclose(
+        np.asarray(k_out[:, suffix_pt]), np.asarray(k_w),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("sp", [2])
+def test_sp_suffix_window_softcap_matches_oracle(sp):
+    """Sliding-window + softcap (Gemma-2 shape) through the sp suffix
+    path must match the single-device oracle."""
+    from vgate_tpu.ops.attention import paged_suffix_attention
+    from vgate_tpu.parallel.sp_decode import sp_suffix_attention_and_write
+
+    rng = np.random.default_rng(99)
+    B, S, H, KV, hd, ps = 1, 8, 2, 1, 16, 4
+    n_suffix_pages = S // ps
+    P = 16 * sp
+    window, softcap, scale = 6, 30.0, 0.3
+    k_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(KV, P, ps, hd)), jnp.float32)
+    shard = P // sp
+    candidates = [p for p in range(P) if p not in {i * shard for i in range(sp)}]
+    prefix_pages = 1
+    all_pages = rng.choice(
+        candidates, size=(B, prefix_pages + n_suffix_pages), replace=False
+    ).astype(np.int32)
+    ctx_pt = jnp.asarray(all_pages)
+    suffix_pt = jnp.asarray(all_pages[:, prefix_pages:])
+    prefix_lens = jnp.asarray([prefix_pages * ps], jnp.int32)
+    suffix_lens = jnp.asarray([S], jnp.int32)
+    total_lens = prefix_lens + suffix_lens
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k_s = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    v_s = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    k_w = jnp.transpose(
+        k_s.reshape(B, n_suffix_pages, ps, KV, hd), (3, 0, 1, 2, 4)
+    )
+    v_w = jnp.transpose(
+        v_s.reshape(B, n_suffix_pages, ps, KV, hd), (3, 0, 1, 2, 4)
+    )
+    ko = k_pages.at[:, suffix_pt].set(k_w)
+    vo = v_pages.at[:, suffix_pt].set(v_w)
+    win = jnp.asarray(window, jnp.int32)
+    expect = paged_suffix_attention(
+        q, ko, vo, ctx_pt, prefix_lens, total_lens,
+        softcap=softcap, window=win, scale=scale,
+    )
+    got, _, _ = sp_suffix_attention_and_write(
+        q, k_s, v_s, k_pages, v_pages, suffix_pt, ctx_pt,
+        prefix_lens, total_lens, sp_mesh(sp),
+        window=win, softcap=softcap, scale=scale,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(expect[0]), rtol=2e-5, atol=2e-5
+    )

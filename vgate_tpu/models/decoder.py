@@ -677,6 +677,7 @@ def prefill_suffix_forward(
     ctx_page_tables: jnp.ndarray,  # [B, ctx_pages] window covering prefix+suffix
     kv_carry: bool = False,  # thread FULL KV buffers as scan carry
     use_pallas: bool = False,  # multitok kernel for the context attention
+    mesh=None,  # sp>1 routes write+attention through the sp shard path
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prompt pass for only the uncached suffix of a prefix-cache hit.
 
@@ -694,6 +695,46 @@ def prefill_suffix_forward(
     positions = prefix_lens[:, None] + jnp.arange(S)[None, :]  # absolute
     total_lens = prefix_lens + suffix_lens
     x = _embed(params, spec, tokens)  # [B, S, D]
+
+    sp_mesh = (
+        mesh
+        if mesh is not None and mesh.shape.get("sp", 1) > 1
+        else None
+    )
+    if sp_mesh is not None:
+        # prefix caching on the sp-sharded pool: per-layer write +
+        # blockwise partial attention run per shard, partials LSE-merge
+        # over sp (parallel/sp_decode.py sp_suffix_attention_and_write)
+        from vgate_tpu.parallel.sp_decode import (
+            sp_suffix_attention_and_write,
+        )
+
+        windows = _layer_windows(spec)
+
+        def sp_layer_fn(h, per_layer):
+            lp, win, kp, vp = per_layer
+            normed = rms_norm(
+                h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
+            )
+            q, k, v = _project_qkv(normed, lp, spec)
+            q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
+            k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
+            attn, kp, vp = sp_suffix_attention_and_write(
+                q, k, v, kp, vp, suffix_page_tables, ctx_page_tables,
+                prefix_lens, total_lens, sp_mesh,
+                window=win if spec.sliding_window > 0 else None,
+                softcap=spec.attn_softcap, scale=_query_scale(spec),
+            )
+            return _finish_layer(h, attn, lp, spec), (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            sp_layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+        )
+        last_idx = jnp.clip(suffix_lens - 1, 0, S - 1)
+        last_hidden = jnp.take_along_axis(
+            x, last_idx[:, None, None].repeat(x.shape[-1], axis=-1), axis=1
+        )[:, 0]
+        return _logits(params, spec, last_hidden), k_pages, v_pages
 
     # The multitok kernel holds all S query rows in VMEM (it was sized
     # for speculative verify): at S=1024, G=6, hd=128 the f32

@@ -129,7 +129,8 @@ def _prefill_step(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("spec", "num_logprobs", "kv_carry", "use_pallas"),
+    static_argnames=("spec", "num_logprobs", "kv_carry", "use_pallas",
+                     "mesh"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _suffix_prefill_step(
@@ -138,14 +139,14 @@ def _suffix_prefill_step(
     key, seeds=None, steps=None, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, kv_carry: bool = False,
-    bias_ids=None, bias_vals=None, use_pallas: bool = False,
+    bias_ids=None, bias_vals=None, use_pallas: bool = False, mesh=None,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
     fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
     logits, k_pages, v_pages = prefill_suffix_forward(
         params, spec, tokens, prefix_lens, suffix_lens, k_pages, v_pages,
         suffix_page_tables, ctx_page_tables, kv_carry=kv_carry,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, mesh=mesh,
     )
     if counts is not None:
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
@@ -516,18 +517,21 @@ class EngineCore:
         )
         self.allocator = PageAllocator(num_pages, num_shards=sp_shards)
         self.max_slots = tpu_cfg.max_batch_slots
-        # prefix caching requires the plain-scan suffix prefill path; the
-        # sp ring and pp relay reshape the prompt pass incompatibly
+        # prefix caching rides the suffix prefill program, which runs on
+        # plain meshes AND sp-sharded pools (parallel/sp_decode.py
+        # sp_suffix_attention_and_write — long-context serving is
+        # exactly where shared-prefix reuse pays); only the pp relay
+        # still reshapes the prompt pass incompatibly
         mesh_sp = int(self.mesh.shape.get("sp", 1))
         mesh_pp = int(self.mesh.shape.get("pp", 1))
         self.prefix_cache_enabled = bool(
-            tpu_cfg.prefix_cache and mesh_sp == 1 and mesh_pp == 1
+            tpu_cfg.prefix_cache and mesh_pp == 1
         )
-        if tpu_cfg.prefill_chunk > 0 and (mesh_sp > 1 or mesh_pp > 1):
+        if tpu_cfg.prefill_chunk > 0 and mesh_pp > 1:
             raise ValueError(
-                "prefill_chunk (chunked prefill) requires sp == 1 and "
-                "pp == 1 — the ring/relay prompt passes reshape the "
-                "program incompatibly"
+                "prefill_chunk (chunked prefill) requires pp == 1 — the "
+                "relay prompt pass reshapes the program incompatibly "
+                "(sp is fine: chunks ride the sp-capable suffix program)"
             )
         self.scheduler = Scheduler(
             allocator=self.allocator,
@@ -1261,6 +1265,7 @@ class EngineCore:
             bias_ids=lb_ids,
             bias_vals=lb_vals,
             use_pallas=self.use_pallas,
+            mesh=self._fwd_mesh if self._sp > 1 else None,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1328,6 +1333,7 @@ class EngineCore:
                 steps=jnp.zeros((1,), jnp.int32),
                 kv_carry=self._kv_carry,
                 use_pallas=self.use_pallas,
+                mesh=self._fwd_mesh if self._sp > 1 else None,
             )
             start += n
         # final chunk: exactly a B=1 suffix-group dispatch with
