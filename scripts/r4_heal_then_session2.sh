@@ -1,0 +1,16 @@
+#!/bin/bash
+# Kill-free heal watcher: probe with fresh processes (each blocks until
+# the wedge releases or fails fast UNAVAILABLE), then run session 2.
+cd /root/repo
+STATUS=/tmp/vgt_tpu_status_r4.json
+rm -f "$STATUS"
+for i in $(seq 1 200); do
+  if python scripts/tpu_patient_probe.py "$STATUS" \
+      >> /tmp/r4_heal_probe.log 2>&1; then
+    echo "[heal] grant healthy at $(date -u +%FT%TZ)" >> /tmp/r4_heal_probe.log
+    bash scripts/r4_session2.sh
+    exit 0
+  fi
+  sleep 60
+done
+echo "[heal] gave up after 200 probes" >> /tmp/r4_heal_probe.log

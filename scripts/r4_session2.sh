@@ -1,6 +1,9 @@
 #!/bin/bash
 # Round-4 measurement session 2: flagship 7B, long context, realistic
-# arrivals, prefix/speculative/kernel benches.  Serialized.
+# arrivals, prefix/speculative/kernel benches.  Serialized, kill-free.
+# Quantized runs use VGT_TPU__QUANT_KERNEL=false (jnp dequant path):
+# the fused int8 kernel hung >19 min in compile earlier this round; its
+# unbounded standalone probe runs LAST so a hang cannot cost the rest.
 cd /root/repo
 log=/tmp/r4_session2.log
 run() {
@@ -18,8 +21,9 @@ aux() {
   sleep 20
 }
 
-# 1. north star: Qwen2.5-7B int8 on one chip (host-staged load)
+# 1. north star: Qwen2.5-7B int8 on one chip (host-staged load, jnp dequant)
 run 7b_int8 VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct VGT_BENCH_QUANT=int8 \
+    VGT_TPU__QUANT_KERNEL=false \
     VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
 # 2. long context >= 8k with chunked prefill
 run ctx8k VGT_BENCH_CTX=8192 VGT_BENCH_PROMPT=7900 VGT_BENCH_MAXTOK=128 \
@@ -28,8 +32,29 @@ run ctx8k VGT_BENCH_CTX=8192 VGT_BENCH_PROMPT=7900 VGT_BENCH_MAXTOK=128 \
 # 3. TTFT under Poisson arrivals: below and above the service knee
 run poisson25 VGT_BENCH_RATE=25 VGT_BENCH_PAGE=32
 run poisson40 VGT_BENCH_RATE=40 VGT_BENCH_PAGE=32
-# 4. shared-prefix TTFT + speculative + kernels
+# 4. component ablation (fixed harness: readback timing, no const capture)
+aux ablate benchmarks/bench_decode_ablate.py
+# 5. shared-prefix TTFT + speculative + kernel microbench
 aux prefix benchmarks/bench_prefix.py
 aux spec benchmarks/bench_speculative.py
 aux kernels benchmarks/bench_kernels.py
+# 6. 1.5B int8 via jnp dequant (quant delta vs bf16 without the kernel)
+run int8_jnp VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false \
+    VGT_BENCH_PAGE=32
+run int4_jnp VGT_BENCH_QUANT=int4 VGT_TPU__QUANT_KERNEL=false \
+    VGT_BENCH_PAGE=32
+# 7. LAST: unbounded fused-kernel compile probe (diagnostic)
+echo "### kernelprobe start $(date -u +%H:%M:%S)" >> "$log"
+python - >> "$log" 2>/tmp/r4_kernelprobe.err <<'EOF'
+import time, jax, jax.numpy as jnp, numpy as np
+from vgate_tpu.ops.pallas.quant_matmul import int8_matmul_pallas
+t0 = time.time()
+x = jnp.asarray(np.random.randn(128, 1536), jnp.bfloat16)
+wq = jnp.asarray(np.random.randint(-127, 127, (1536, 8960)), jnp.int8)
+scale = jnp.ones((1, 8960), jnp.float32)
+out = int8_matmul_pallas(x, wq, scale)
+np.asarray(out)
+print(f'{{"probe": "int8_kernel_compile", "seconds": {time.time()-t0:.1f}}}')
+EOF
+echo "### kernelprobe rc=$? end $(date -u +%H:%M:%S)" >> "$log"
 echo "### SESSION2 DONE $(date -u +%H:%M:%S)" >> "$log"

@@ -148,6 +148,11 @@ class TPUConfig(BaseModel):
     # Use Pallas kernels where available; False falls back to jnp reference
     # implementations (needed on CPU test meshes).
     use_pallas: bool = True
+    # Fused dequant-matmul Pallas kernels for int8/int4 weights (r4: the
+    # int8 serving warmup hung >19 min in compile on v5e — gate them
+    # independently of the attention kernels so quantized serving can
+    # still ride the jnp dequant path while this is diagnosed).
+    quant_kernel: bool = True
     # Thread the FULL [L, ...] KV pools through the decode AND prefill
     # scans as carry (layer-indexed in-place updates + layer-indexed
     # attention reads) instead of per-layer xs/ys slices.  MEASURED ON

@@ -654,9 +654,12 @@ class EngineCore:
             # sharding; model-parallel meshes keep the jnp einsum path.
             # Threaded on the spec (a static jit arg) so engines with
             # different meshes in one process never share the setting.
+            # tpu.quant_kernel gates them independently of the attention
+            # kernels (r4: int8 serving warmup hung in kernel compile).
             self.spec = dataclasses.replace(
                 self.spec,
                 quant_kernel=self.use_pallas
+                and bool(tpu_cfg.quant_kernel)
                 and all(
                     int(self.mesh.shape.get(a, 1)) == 1
                     for a in ("tp", "pp", "sp", "ep")
