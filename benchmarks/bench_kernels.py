@@ -54,14 +54,24 @@ def _looped(op):
     return run
 
 
+def _sync(out):
+    """Force completion via a host transfer of (a small leaf of) the
+    output — on the axon tunnel ``block_until_ready`` returned instantly
+    for multi-GB programs (r4 session), so only a device->host copy of
+    real output bytes is a trustworthy sync."""
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "shape")]
+    small = min(leaves, key=lambda x: x.size)
+    np.asarray(small)
+
+
 def _median_time(fn, *args, iters: int = 10, warmup: int = 2) -> float:
     """Median per-op time of the looped program."""
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        _sync(fn(*args))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        _sync(fn(*args))
         times.append(time.perf_counter() - t0)
     return float(np.median(times)) / LOOP
 
