@@ -683,32 +683,48 @@ def test_gemma2_engine_end_to_end_across_window():
         core.stop()
 
 
-def test_gemma2_rejects_pp_only():
-    """sp x Gemma-2 now works (ring prefill takes window/softcap
-    natively — see test_sp_engine_gemma2_sliding_window); only the
-    pipeline-parallel relay still rejects local-attention specs."""
-    n = min(2, jax.device_count())
-    if n < 2:
+def test_pp_engine_gemma2_sliding_window():
+    """Gemma-2 (sliding-window + softcap + embed scale) through the
+    pipeline relay: per-layer windows thread the stage scan and
+    softcap/scale ride the attention partials (parallel/pipeline.py,
+    r4 — the r3 rejection is gone).  Greedy output must be
+    token-identical to the pp=1 engine."""
+    if jax.device_count() < 2:
         pytest.skip("needs 2 devices")
-    config = load_config(
-        model={
-            "model_id": "tiny-gemma2",
-            "engine_type": "jax_tpu",
-            "dtype": "float32",
-            "max_model_len": 64,
-        },
-        tpu={
-            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "pp": 2,
-            "num_devices": n,
-            "kv_num_pages": 64, "kv_page_size": 4,
-            "max_batch_slots": 2, "prefill_buckets": [8],
-            "use_pallas": False,
-        },
-        scheduler={"max_queue_size": 8},
-        logging={"level": "WARNING"},
-    )
-    with pytest.raises(ValueError, match="sliding-window"):
-        EngineCore(config, devices=jax.devices()[:n])
+
+    def cfg(pp, n_dev):
+        return load_config(
+            model={
+                "model_id": "tiny-gemma2",
+                "engine_type": "jax_tpu",
+                "dtype": "float32",
+                "max_model_len": 64,
+            },
+            tpu={
+                "dp": 1, "tp": 1, "ep": 1, "sp": 1, "pp": pp,
+                "num_devices": n_dev,
+                "kv_num_pages": 64, "kv_page_size": 4,
+                "max_batch_slots": 2, "prefill_buckets": [8, 32],
+                "use_pallas": False,
+            },
+            scheduler={"max_queue_size": 8},
+            logging={"level": "WARNING"},
+        )
+
+    # prompt crosses the tiny-gemma2 sliding window so the local-layer
+    # masks matter, and decode runs well past it
+    prompt_ids = [2 + (i % 37) for i in range(30)]
+    outs = []
+    for pp, n_dev in ((1, 1), (2, 2)):
+        core = EngineCore(cfg(pp, n_dev), devices=jax.devices()[:n_dev])
+        core.start()
+        try:
+            seq = core.submit_tokens(prompt_ids, greedy(10))
+            assert seq.done_event.wait(300)
+            outs.append(list(seq.generated_ids))
+        finally:
+            core.stop()
+    assert outs[0] == outs[1]
 
 
 def test_stop_token_ids_finish(engine):

@@ -112,6 +112,52 @@ def test_pp_prefill_then_decode_matches_single_device(pp, tp):
     )
 
 
+def test_pp_gemma2_matches_single_device():
+    """Gemma-2 through the relay (r4: per-layer windows + softcap/scale
+    + embed scale threaded into the stage scan).  tiny-gemma2's 2 layers
+    split one-per-stage at pp=2: stage 0 holds the SLIDING layer, stage
+    1 the global one — exactly the per-stage window plumbing under
+    test."""
+    from vgate_tpu.models.specs import TINY_GEMMA2
+
+    mesh = pp_mesh(2, 1)
+    B, ps, pages_per_seq = 4, 4, 4
+    S = 16  # crosses the 8-token sliding window
+    spec, params, k, v, pt = setup(
+        mesh, B, ps, pages_per_seq, spec=TINY_GEMMA2
+    )
+    tokens = jnp.asarray(
+        (np.arange(B * S).reshape(B, S) * 7 + 3) % spec.vocab_size,
+        jnp.int32,
+    )
+    seq_lens = jnp.asarray([S, S - 1, S - 5, 10], jnp.int32)
+
+    def run(p, kk, vv, ptab):
+        logits, kk, vv = prefill_forward(
+            p, spec, tokens, seq_lens, kk, vv, ptab[:, : S // ps],
+            mesh=mesh if p is params else None,
+        )
+        next_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        d_logits, kk, vv = decode_forward(
+            p, spec, next_tok, seq_lens, kk, vv, ptab,
+            active=jnp.ones((B,), bool),
+            mesh=mesh if p is params else None,
+        )
+        return logits, d_logits
+
+    got_p, got_d = run(params, k, v, pt)
+    want_p, want_d = reference_single(
+        spec, B, ps, pages_per_seq,
+        lambda p, kk, vv, ptab: run(p, kk, vv, ptab),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_p), np.asarray(want_p), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_d), np.asarray(want_d), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_pp_microbatch_fallback_indivisible_batch():
     """B=3 with pp=2 falls back to M=1 (single microbatch relay)."""
     mesh = pp_mesh(2, 1)

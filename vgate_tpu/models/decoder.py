@@ -323,11 +323,6 @@ def prefill_forward(
     """
     B, S = tokens.shape
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
-        if spec.uses_local_attention:
-            raise NotImplementedError(
-                "pipeline parallelism does not support "
-                "sliding-window/softcap families yet"
-            )
         from vgate_tpu.parallel.pipeline import pp_prefill_forward
 
         return pp_prefill_forward(
@@ -563,11 +558,6 @@ def decode_forward(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One continuous-batching decode step: returns (logits [B, V], caches)."""
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
-        if spec.uses_local_attention:
-            raise NotImplementedError(
-                "pipeline parallelism does not support "
-                "sliding-window/softcap families yet"
-            )
         from vgate_tpu.parallel.pipeline import pp_decode_forward
 
         return pp_decode_forward(

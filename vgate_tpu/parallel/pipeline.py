@@ -38,7 +38,10 @@ from jax.sharding import PartitionSpec as P
 
 from vgate_tpu.models.decoder import (
     Params,
+    _embed,
+    _layer_windows,
     _logits,
+    _query_scale,
     decode_attn_inputs,
     decode_layer,
     prefill_layer,
@@ -65,24 +68,31 @@ def _check_divisible(spec: ModelSpec, pp: int) -> None:
         )
 
 
-def _decode_attn_fn(use_pallas: bool):
+def _decode_attn_fn(use_pallas: bool, spec: ModelSpec):
     if use_pallas:
         from vgate_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention_pallas,
+            paged_decode_attention_pallas as fn,
         )
+    else:
+        fn = paged_decode_attention
+    # softcap/scale ride the partial exactly like the plain-mesh path
+    # (models/decoder.py decode_forward) — without them Gemma-2 through
+    # the relay would silently drop its attn softcap and query scale
+    return functools.partial(
+        fn, softcap=spec.attn_softcap, scale=_query_scale(spec)
+    )
 
-        return paged_decode_attention_pallas
-    return paged_decode_attention
 
-
-def _prefill_attn_fn(use_pallas: bool):
+def _prefill_attn_fn(use_pallas: bool, spec: ModelSpec):
     if use_pallas:
         from vgate_tpu.ops.pallas.flash_prefill import (
-            flash_prefill_attention_pallas,
+            flash_prefill_attention_pallas as fn,
         )
-
-        return flash_prefill_attention_pallas
-    return flash_prefill_attention
+    else:
+        fn = flash_prefill_attention
+    return functools.partial(
+        fn, softcap=spec.attn_softcap, scale=_query_scale(spec)
+    )
 
 
 def _ring(pp: int):
@@ -99,10 +109,10 @@ def _layer_in_specs(layers_treedef):
 def _decode_staged_fn(mesh, spec, M, mb, use_pallas, layers_treedef):
     """Build (once per geometry) the jitted decode stage-relay program."""
     pp = mesh.shape[AXIS_PP]
-    attn_fn = _decode_attn_fn(use_pallas)
+    attn_fn = _decode_attn_fn(use_pallas, spec)
 
-    def staged(layers, k_loc, v_loc, xs, pos_mb, pid_mb, poff_mb, pt_mb,
-               slen_mb):
+    def staged(layers, windows, k_loc, v_loc, xs, pos_mb, pid_mb,
+               poff_mb, pt_mb, slen_mb):
         s = jax.lax.axis_index(AXIS_PP)
 
         def gpipe_step(carry, t):
@@ -115,17 +125,18 @@ def _decode_staged_fn(mesh, spec, M, mb, use_pallas, layers_treedef):
             pid = jnp.where(valid, pid_mb[idx], 0)
 
             def body(h, per_layer):
-                lp, k_l, v_l = per_layer
+                lp, win, k_l, v_l = per_layer
                 h, k_l, v_l = decode_layer(
                     h, lp, k_l, v_l, spec=spec, positions=pos_mb[idx],
                     page_ids=pid, page_off=poff_mb[idx],
                     page_tables=pt_mb[idx], seq_lens=slen_mb[idx],
                     attn_fn=attn_fn,
+                    window=win if spec.sliding_window > 0 else None,
                 )
                 return h, (k_l, v_l)
 
             h_out, (k_loc, v_loc) = jax.lax.scan(
-                body, h_in, (layers, k_loc, v_loc)
+                body, h_in, (layers, windows, k_loc, v_loc)
             )
             out_acc = jnp.where(
                 valid & (s == pp - 1),
@@ -154,6 +165,7 @@ def _decode_staged_fn(mesh, spec, M, mb, use_pallas, layers_treedef):
         mesh=mesh,
         in_specs=(
             _layer_in_specs(layers_treedef),
+            P(AXIS_PP),  # per-layer windows: local layer slice
             P(AXIS_PP), P(AXIS_PP),  # KV pools: local layer slices
             P(), P(), P(), P(), P(), P(),
         ),
@@ -187,7 +199,7 @@ def pp_decode_forward(
     seq_lens, page_ids, page_off = decode_attn_inputs(
         positions, page_tables, active, ps
     )
-    x = params["embed"][tokens]  # [B, D]
+    x = _embed(params, spec, tokens)  # [B, D] (incl. Gemma embed scale)
     D = x.shape[-1]
 
     staged_fn = _decode_staged_fn(
@@ -195,7 +207,7 @@ def pp_decode_forward(
         jax.tree.structure(params["layers"]),
     )
     out, k_pages, v_pages = staged_fn(
-        params["layers"], k_pages, v_pages,
+        params["layers"], _layer_windows(spec), k_pages, v_pages,
         x.reshape(M, mb, D),
         positions.reshape(M, mb),
         page_ids.reshape(M, mb),
@@ -211,9 +223,9 @@ def pp_decode_forward(
 def _prefill_staged_fn(mesh, spec, M, mb, use_pallas, layers_treedef):
     """Build (once per geometry) the jitted prefill stage-relay program."""
     pp = mesh.shape[AXIS_PP]
-    attn_fn = _prefill_attn_fn(use_pallas)
+    attn_fn = _prefill_attn_fn(use_pallas, spec)
 
-    def staged(layers, k_loc, v_loc, xs, pt_mb, slen_mb):
+    def staged(layers, windows, k_loc, v_loc, xs, pt_mb, slen_mb):
         s = jax.lax.axis_index(AXIS_PP)
         S, D = xs.shape[-2], xs.shape[-1]
 
@@ -227,15 +239,16 @@ def _prefill_staged_fn(mesh, spec, M, mb, use_pallas, layers_treedef):
             pt = jnp.where(valid, pt_mb[idx], 0)
 
             def body(h, per_layer):
-                lp, k_l, v_l = per_layer
+                lp, win, k_l, v_l = per_layer
                 h, k_l, v_l = prefill_layer(
                     h, lp, k_l, v_l, spec=spec, seq_lens=slen_mb[idx],
                     page_tables=pt, attn_fn=attn_fn,
+                    window=win if spec.sliding_window > 0 else None,
                 )
                 return h, (k_l, v_l)
 
             h_out, (k_loc, v_loc) = jax.lax.scan(
-                body, h_in, (layers, k_loc, v_loc)
+                body, h_in, (layers, windows, k_loc, v_loc)
             )
             # collect only the last-token hidden [mb, D]
             last_idx = jnp.clip(slen_mb[idx] - 1, 0, S - 1)
@@ -267,6 +280,7 @@ def _prefill_staged_fn(mesh, spec, M, mb, use_pallas, layers_treedef):
         mesh=mesh,
         in_specs=(
             _layer_in_specs(layers_treedef),
+            P(AXIS_PP),  # per-layer windows
             P(AXIS_PP), P(AXIS_PP),
             P(), P(), P(),
         ),
@@ -297,7 +311,7 @@ def pp_prefill_forward(
     M = _microbatches(B, pp)
     mb = B // M
 
-    x = params["embed"][tokens]  # [B, S, D]
+    x = _embed(params, spec, tokens)  # [B, S, D] (incl. Gemma embed scale)
     D = x.shape[-1]
 
     staged_fn = _prefill_staged_fn(
@@ -305,7 +319,7 @@ def pp_prefill_forward(
         jax.tree.structure(params["layers"]),
     )
     out, k_pages, v_pages = staged_fn(
-        params["layers"], k_pages, v_pages,
+        params["layers"], _layer_windows(spec), k_pages, v_pages,
         x.reshape(M, mb, S, D),
         page_tables.reshape(M, mb, -1),
         seq_lens.reshape(M, mb),

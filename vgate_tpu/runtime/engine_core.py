@@ -617,14 +617,10 @@ class EngineCore:
                     "ring-attention prefill shards the sequence axis evenly"
                 )
 
-        if self.spec.uses_local_attention and pp_size > 1:
-            # sp>1 works: ring prefill takes window/softcap natively
-            # (parallel/ring_attention.py); only the pipeline stage relay
-            # still lacks the window plumbing
-            raise ValueError(
-                f"{self.spec.name} uses sliding-window/softcap attention, "
-                "not yet supported with pp>1"
-            )
+        # sliding-window/softcap families (Gemma-2) ride every mesh: the
+        # ring prefill takes window/softcap natively, and the pp relay
+        # threads per-layer windows + softcap/scale through the stage
+        # scan (parallel/pipeline.py, r4 — the r3 gate is gone)
         if tpu_cfg.speculative_k > 0 and pp_size > 1:
             raise ValueError(
                 "speculative decoding is not supported with pp>1 (the "
