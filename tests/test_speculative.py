@@ -254,6 +254,38 @@ def test_speculative_rejects_pp():
         EngineCore(cfg, devices=jax.devices()[:2])
 
 
+def test_speculative_sp_matches_plain_greedy():
+    """Speculation on an sp=2 sharded pool (r4: the verify step rides
+    sp_multitok_attention_and_write; the r3 gate is gone).  Greedy
+    output must be token-identical to the plain sp=2 engine AND the
+    sp=1 speculative engine, no matter what the drafter proposes — an
+    injected fixed drafter guarantees the sp verify program runs with
+    real (mostly wrong) drafts every round."""
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    prompt = [4, 9, 2, 4, 9, 2, 4, 9, 2, 4, 9, 2]
+    outs = {}
+    for label, k, sp, n_dev in (
+        ("plain-sp2", 0, 2, 2),
+        ("spec-sp1", 3, 1, 1),
+        ("spec-sp2", 3, 2, 2),
+    ):
+        cfg = spec_config(k=k, sp=sp, num_devices=n_dev)
+        core = EngineCore(cfg, devices=jax.devices()[:n_dev])
+        if k:
+            core.drafter = lambda seq, kk: [4, 9, 2][:kk]
+        core.start()
+        try:
+            seq = core.submit_tokens(prompt, greedy(12))
+            assert seq.done_event.wait(300)
+            outs[label] = list(seq.generated_ids)
+            if k:
+                assert core.total_spec_drafted > 0
+        finally:
+            core.stop()
+    assert outs["plain-sp2"] == outs["spec-sp2"] == outs["spec-sp1"]
+
+
 def test_speculative_with_prefix_cache_sharing():
     """Speculation and automatic prefix caching compose: the second
     request prefix-hits the first one's pages, then decodes

@@ -787,6 +787,7 @@ def spec_verify_forward(
     active: Optional[jnp.ndarray] = None,  # [B] bool
     use_pallas: bool = False,
     kv_carry: bool = False,  # thread FULL KV buffers as scan carry
+    mesh=None,  # sp>1 routes write+attention through the sp shard path
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Speculative-decoding verification: score ``S`` candidate tokens per
     slot in one pass over the paged KV cache (runtime/speculative.py).
@@ -819,6 +820,44 @@ def spec_verify_forward(
     page_ids = jnp.where(write_ok, page_ids, 0)  # trash page 0
     total_lens = positions0 + input_lens
     x = _embed(params, spec, tokens)  # [B, S, D]
+
+    sp_mesh = (
+        mesh
+        if mesh is not None and mesh.shape.get("sp", 1) > 1
+        else None
+    )
+    if sp_mesh is not None:
+        # speculative verify on an sp-sharded pool: per-token scatter
+        # writes + blockwise partials per shard, LSE merge over sp
+        # (parallel/sp_decode.py sp_multitok_attention_and_write; the
+        # r3 spec x sp gate is gone, r4)
+        from vgate_tpu.parallel.sp_decode import (
+            sp_multitok_attention_and_write,
+        )
+
+        windows = _layer_windows(spec)
+
+        def sp_layer_fn(h, per_layer):
+            lp, win, kp, vp = per_layer
+            normed = rms_norm(
+                h, lp["input_norm"], spec.rms_eps, spec.unit_offset_norm
+            )
+            q, k, v = _project_qkv(normed, lp, spec)
+            q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
+            k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
+            attn, kp, vp = sp_multitok_attention_and_write(
+                q, k, v, kp, vp, page_ids, page_off, page_tables,
+                positions0, total_lens, sp_mesh,
+                window=win if spec.sliding_window > 0 else None,
+                softcap=spec.attn_softcap, scale=_query_scale(spec),
+            )
+            return _finish_layer(h, attn, lp, spec), (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            sp_layer_fn, x, (params["layers"], windows, k_pages, v_pages)
+        )
+        return _logits(params, spec, x), k_pages, v_pages
+
     if use_pallas:
         from vgate_tpu.ops.pallas.paged_attention import (
             paged_multitok_attention_pallas,

@@ -358,8 +358,90 @@ def sp_suffix_attention_and_write(
     )
 
 
+def sp_multitok_attention_and_write(
+    q,  # [B, S, H, hd] roped candidate queries
+    k_t,  # [B, S, KV, hd] roped candidate keys
+    v_t,  # [B, S, KV, hd]
+    k_pages_l,  # [KV, P, ps, hd] (sp-sharded on the pool dim under jit)
+    v_pages_l,
+    page_ids,  # [B, S] GLOBAL page id per candidate (0 = trash)
+    page_off,  # [B, S] offset within the page
+    ctx_page_tables,  # [B, ctx_pages] GLOBAL ids covering the window
+    positions0,  # [B] global position of q[:, 0] (NOT page-aligned)
+    total_lens,  # [B] positions0 + real candidates
+    mesh: Mesh,
+    window=None,
+    softcap: float = 0.0,
+    scale=None,
+):
+    """One speculative-verify layer's KV write + attention on an
+    sp-sharded pool (the r3 spec x sp gate's replacement).
+
+    Differs from ``sp_suffix_attention_and_write`` only in the write:
+    candidates start at an arbitrary position, so each token scatters
+    individually to its (page, offset) — owners take their tokens,
+    everything else lands in the shard's local trash page 0.  The
+    blockwise partial attention + LSE merge are shared.  Returns
+    ``(attn [B, S, H, hd] replicated, k_pages_l, v_pages_l)``.
+    """
+    sp = mesh.shape[AXIS_SP]
+    B, S, H, hd = q.shape
+    P_total = k_pages_l.shape[1]
+    if P_total % sp:
+        raise ValueError(f"page pool {P_total} not divisible by sp={sp}")
+    shard = P_total // sp
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+    window_arr = jnp.asarray(
+        0 if window is None else window, jnp.int32
+    )
+
+    def body(kp, vp, q, k_t, v_t, page_ids, page_off, ctx_pt,
+             positions0, total_lens, window_arr):
+        idx = jax.lax.axis_index(AXIS_SP)
+        base = idx * shard
+        mine = (page_ids >= base) & (page_ids < base + shard)
+        local_ids = jnp.where(mine, page_ids - base, 0)  # [B, S]
+        # [B, S, KV, hd] -> [KV, B, S, hd] per-token scatter
+        kp = kp.at[:, local_ids, page_off].set(
+            jnp.transpose(k_t, (2, 0, 1, 3))
+        )
+        vp = vp.at[:, local_ids, page_off].set(
+            jnp.transpose(v_t, (2, 0, 1, 3))
+        )
+        owned = (ctx_pt >= base) & (ctx_pt < base + shard)
+        local_ct = jnp.where(owned, ctx_pt - base, 0)
+        acc, m, l = _partial_suffix_attention(
+            q, kp, vp, local_ct, owned, positions0, total_lens,
+            window_arr[0], softcap, scale,
+        )
+        m_g = jax.lax.pmax(m, AXIS_SP)
+        corr = jnp.exp(m - m_g)[..., None]
+        acc_g = jax.lax.psum(acc * corr, AXIS_SP)
+        l_g = jax.lax.psum(l * jnp.exp(m - m_g), AXIS_SP)
+        out = acc_g / jnp.maximum(l_g, 1e-30)[..., None]
+        return out.astype(q.dtype), kp, vp
+
+    from jax.experimental.shard_map import shard_map
+
+    pool = P(None, AXIS_SP, None, None)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pool, pool, P(), P(), P(), P(), P(), P(), P(), P(),
+                  P()),
+        out_specs=(P(), pool, pool),
+        check_rep=False,
+    )
+    return fn(
+        k_pages_l, v_pages_l, q, k_t, v_t, page_ids, page_off,
+        ctx_page_tables, positions0, total_lens, window_arr.reshape(1),
+    )
+
+
 __all__ = [
     "reserved_page_ids",
     "sp_decode_attention_and_write",
     "sp_suffix_attention_and_write",
+    "sp_multitok_attention_and_write",
 ]

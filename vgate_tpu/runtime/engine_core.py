@@ -280,7 +280,8 @@ def _decode_chunk(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "spec", "use_pallas", "num_logprobs", "all_greedy", "kv_carry"
+        "spec", "use_pallas", "num_logprobs", "all_greedy", "kv_carry",
+        "mesh",
     ),
     donate_argnames=("k_pages", "v_pages"),
 )
@@ -290,7 +291,7 @@ def _spec_verify_step(
     seeds=None, steps=None, use_pallas=False, num_logprobs: int = 0,
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, all_greedy: bool = False,
-    kv_carry: bool = False, bias_ids=None, bias_vals=None,
+    kv_carry: bool = False, bias_ids=None, bias_vals=None, mesh=None,
 ):
     """One speculative round: score current token + drafts in a single
     forward (models/decoder.py spec_verify_forward), then verify every
@@ -305,7 +306,7 @@ def _spec_verify_step(
     logits, k_pages, v_pages = spec_verify_forward(
         params, spec, tokens, positions0, input_lens, k_pages, v_pages,
         page_tables, active=active, use_pallas=use_pallas,
-        kv_carry=kv_carry,
+        kv_carry=kv_carry, mesh=mesh,
     )  # [B, S, V]
     B, S = tokens.shape
     if counts is not None:
@@ -626,13 +627,9 @@ class EngineCore:
                 "speculative decoding is not supported with pp>1 (the "
                 "verify step has no pipeline-stage relay)"
             )
-        if tpu_cfg.speculative_k > 0 and sp_size > 1:
-            raise ValueError(
-                "speculative decoding is not supported with sp>1 (the "
-                "multi-token verify step has no sp-sharded attention "
-                "path; chunked decode over the sp-sharded pool is the "
-                "long-context mode)"
-            )
+        # speculative x sp composes (r4): the verify step rides
+        # sp_multitok_attention_and_write on the sharded pool — the
+        # long-context single-stream case is speculation's home turf
 
         # Pallas kernels require a real TPU backend (tests run interpret-mode
         # kernels separately; the engine's jnp twins serve CPU meshes).
@@ -1758,6 +1755,7 @@ class EngineCore:
                 kv_carry=self._kv_carry,
                 bias_ids=spec_lb,
                 bias_vals=spec_lb_vals,
+                mesh=self._fwd_mesh if self._sp > 1 else None,
             )
         )
         if want_pen:
