@@ -156,7 +156,7 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="vgate-tpu engine benchmark")
     parser.add_argument(
         "--engines", nargs="+", default=["dry_run"],
-        choices=["dry_run", "jax_tpu"],
+        choices=["dry_run", "jax_tpu", "vllm", "sglang"],
     )
     parser.add_argument("--rounds", type=int, default=3)
     parser.add_argument("--warmup-rounds", type=int, default=1)

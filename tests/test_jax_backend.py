@@ -203,3 +203,30 @@ def test_vllm_backend_selectable_and_fails_clearly_without_wheel():
     if not has_vllm:
         with pytest.raises(RuntimeError, match="vllm"):
             backend.load_model(cfg)
+
+
+def test_sglang_backend_selectable_and_fails_clearly_without_wheel():
+    """The SGLang half of the reference's comparison pair
+    (backends/sglang_backend.py) is selectable and fails with an
+    actionable error in images without an sglang wheel."""
+    import pytest
+
+    from vgate_tpu.config import load_config
+    from vgate_tpu.engine import _create_backend
+
+    backend = _create_backend("sglang")
+    assert type(backend).__name__ == "SGLangBackend"
+    cfg = load_config(
+        model={"engine_type": "sglang", "model_id": "tiny-dense"},
+        logging={"level": "WARNING"},
+    )
+    assert cfg.model.engine_type == "sglang"
+    try:
+        import sglang  # noqa: F401
+
+        has_sglang = True
+    except ImportError:
+        has_sglang = False
+    if not has_sglang:
+        with pytest.raises(RuntimeError, match="sglang"):
+            backend.load_model(cfg)

@@ -55,7 +55,7 @@ def apply_platform(tpu_cfg) -> None:
 # "vllm" is the optional comparison backend (backends/vllm_backend.py):
 # selectable everywhere, fails with a clear error unless a vllm wheel is
 # installed (the reference benchmarks vLLM/SGLang side by side)
-VALID_ENGINE_TYPES = ("dry_run", "jax_tpu", "vllm")
+VALID_ENGINE_TYPES = ("dry_run", "jax_tpu", "vllm", "sglang")
 
 
 class ServerConfig(BaseModel):

@@ -47,6 +47,11 @@ def _create_backend(engine_type: str) -> InferenceBackend:
         from vgate_tpu.backends.vllm_backend import VLLMBackend
 
         return VLLMBackend()
+    if engine_type == "sglang":
+        # the other half of the reference's comparison pair
+        from vgate_tpu.backends.sglang_backend import SGLangBackend
+
+        return SGLangBackend()
     raise ValueError(f"Unknown engine_type: {engine_type!r}")
 
 
