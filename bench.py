@@ -162,10 +162,10 @@ def main() -> None:
         max_tokens = int(os.environ.get("VGT_BENCH_MAXTOK", 128))
         slots = int(os.environ.get("VGT_BENCH_SLOTS", 128))
         kv_pages = 0  # auto-size from HBM
-        # page size trades paged-KV granularity against DMA width: a
-        # 16-token page is a 4 KB transfer per kv head — small for HBM;
-        # 32/64 halve/quarter the per-page overhead (VGT_BENCH_PAGE sweeps)
-        page_size = int(os.environ.get("VGT_BENCH_PAGE", 16))
+        # page size trades paged-KV granularity against DMA width: 32
+        # measured best on v5e (r4 sweep: 16 -> 3729, 32 -> 4038,
+        # 64 -> 3999 tok/s); VGT_BENCH_PAGE re-sweeps
+        page_size = int(os.environ.get("VGT_BENCH_PAGE", 32))
         max_model_len = int(os.environ.get("VGT_BENCH_CTX", 512))
         # long contexts prefill in chunks (serial suffix passes) instead
         # of compiling a max_model_len-wide program
