@@ -21,7 +21,7 @@ def test_defaults():
     assert cfg.batch.max_batch_size == 8
     assert cfg.batch.max_wait_time_ms == 50.0
     assert cfg.cache.enabled is True
-    assert cfg.tpu.kv_page_size == 16
+    assert cfg.tpu.kv_page_size == 32  # measured best (RESULTS_r4.md)
     assert cfg.tpu.max_batch_slots == 32
 
 
