@@ -21,19 +21,20 @@ aux() {
   sleep 20
 }
 
-# 1. north star: Qwen2.5-7B int8 on one chip (host-staged load, jnp dequant)
+# 1. component ablation (fixed harness: readback timing, no const
+#    capture) — its rows guide the rest of the round's decode work
+aux ablate benchmarks/bench_decode_ablate.py
+# 2. north star: Qwen2.5-7B int8 on one chip (host-staged load, jnp dequant)
 run 7b_int8 VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct VGT_BENCH_QUANT=int8 \
     VGT_TPU__QUANT_KERNEL=false \
     VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
-# 2. long context >= 8k with chunked prefill
+# 3. long context >= 8k with chunked prefill
 run ctx8k VGT_BENCH_CTX=8192 VGT_BENCH_PROMPT=7900 VGT_BENCH_MAXTOK=128 \
     VGT_BENCH_REQUESTS=8 VGT_BENCH_SLOTS=8 VGT_BENCH_PREFILL_BATCH=1 \
     VGT_BENCH_PAGE=32
-# 3. TTFT under Poisson arrivals: below and above the service knee
+# 4. TTFT under Poisson arrivals: below and above the service knee
 run poisson25 VGT_BENCH_RATE=25 VGT_BENCH_PAGE=32
 run poisson40 VGT_BENCH_RATE=40 VGT_BENCH_PAGE=32
-# 4. component ablation (fixed harness: readback timing, no const capture)
-aux ablate benchmarks/bench_decode_ablate.py
 # 5. shared-prefix TTFT + speculative + kernel microbench
 aux prefix benchmarks/bench_prefix.py
 aux spec benchmarks/bench_speculative.py
