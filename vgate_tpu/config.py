@@ -156,6 +156,12 @@ class TPUConfig(BaseModel):
     # independently of the attention kernels so quantized serving can
     # still ride the jnp dequant path while this is diagnosed).
     quant_kernel: bool = True
+    # >1: the decode attention kernel serves this many slots per Pallas
+    # program (grid B/N x KV instead of B x KV — at B=128, KV=2, 28
+    # layers that is 7,168 vs 896 programs per decode step).  Opt-in
+    # (default 1 = per-slot kernel) until measured on hardware; A/B via
+    # VGT_TPU__DECODE_BLOCK_SLOTS=8.
+    decode_block_slots: int = 1
     # Thread the FULL [L, ...] KV pools through the decode AND prefill
     # scans as carry (layer-indexed in-place updates + layer-indexed
     # attention reads) instead of per-layer xs/ys slices.  MEASURED ON

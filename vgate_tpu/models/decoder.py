@@ -598,16 +598,29 @@ def decode_forward(
     if use_pallas:
         # the decode kernel supports window/softcap/scale natively (and
         # skips DMA for pages below the window), so local-attention
-        # families ride it too
-        from vgate_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention_pallas,
-        )
+        # families ride it too.  decode_block_slots > 1 selects the
+        # multi-slot blocked grid (B/N x KV programs instead of B x KV).
+        if spec.decode_block_slots > 1:
+            from vgate_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_pallas_blocked,
+            )
 
-        attn_fn = functools.partial(
-            paged_decode_attention_pallas,
-            softcap=spec.attn_softcap,
-            scale=_query_scale(spec),
-        )
+            attn_fn = functools.partial(
+                paged_decode_attention_pallas_blocked,
+                softcap=spec.attn_softcap,
+                scale=_query_scale(spec),
+                block_slots=spec.decode_block_slots,
+            )
+        else:
+            from vgate_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_pallas,
+            )
+
+            attn_fn = functools.partial(
+                paged_decode_attention_pallas,
+                softcap=spec.attn_softcap,
+                scale=_query_scale(spec),
+            )
     else:
         attn_fn = functools.partial(
             paged_decode_attention,

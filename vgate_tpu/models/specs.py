@@ -60,6 +60,12 @@ class ModelSpec:
     # meshes in one process get separate compile caches instead of
     # fighting over a module global.
     quant_kernel: bool = False
+    # >1: decode attention serves this many slots per Pallas program
+    # (paged_attention.py _blocked_kernel) — cuts grid steps B/BS x and
+    # per-program overhead; opt-in via tpu.decode_block_slots until the
+    # win is measured on hardware (threaded on the spec like
+    # quant_kernel so it reaches the jitted decode as a static arg)
+    decode_block_slots: int = 1
 
     @property
     def is_moe(self) -> bool:

@@ -293,6 +293,310 @@ def paged_decode_attention_pallas(
     return out.reshape(B, H, hd)
 
 
+def _blocked_kernel(
+    # scalar prefetch
+    page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)
+    seq_lens_ref,  # [B] int32 (SMEM)
+    window_ref,  # [1] int32 (SMEM)
+    layer_ref,  # [1] int32 (SMEM); -1 => no layer dim
+    # inputs
+    q_ref,  # [1, 1, BS, G, hd] VMEM block for (bb, g)
+    k_pages_ref,  # [KV, P, ps, hd] ANY/HBM ([L, KV, ...] when has_layer)
+    v_pages_ref,
+    # output
+    out_ref,  # [1, 1, BS, G, hd]
+    # scratch
+    k_buf,  # [2, BS, CHUNK*ps, hd] VMEM
+    v_buf,
+    acc_ref,  # [BS*G, hd] f32
+    m_ref,  # [BS*G, 128] f32
+    l_ref,  # [BS*G, 128] f32
+    sems,  # DMA semaphores [2, 2, BS, CHUNK]
+    *,
+    page_size: int,
+    softcap: float,
+    scale: float,
+    block_slots: int,
+    has_layer: bool = False,
+):
+    """Multi-slot decode attention: ``block_slots`` sequences per program.
+
+    The per-(slot, kv_head) kernel above runs B*KV tiny programs per
+    layer (7,168 grid steps per decode step at B=128, KV=2, 28 layers);
+    per-program iteration overhead is a prime suspect for the measured
+    gap to the HBM roofline (RESULTS_r3.md decision tree item 4).  This
+    variant serves ``BS`` slots per program — grid B/BS x KV — with the
+    same double-buffered live-page DMA per slot and a static unroll of
+    the per-slot 2D dots (Mosaic-safe; no batched dot_general).  The
+    fori_loop runs to the block's MAX chunk count; shorter slots mask.
+    """
+    BS = block_slots
+    bb = pl.program_id(0)
+    g = pl.program_id(1)
+    window = window_ref[0]
+    chunk_tokens = CHUNK_PAGES * page_size
+    G = q_ref.shape[3]
+
+    # per-slot page counts; loop bound is the block max
+    n_pages_j = [
+        jax.lax.div(
+            seq_lens_ref[bb * BS + j] + page_size - 1, page_size
+        )
+        for j in range(BS)
+    ]
+    n_chunks = jax.lax.div(
+        n_pages_j[0] + CHUNK_PAGES - 1, CHUNK_PAGES
+    )
+    for j in range(1, BS):
+        n_chunks = jnp.maximum(
+            n_chunks,
+            jax.lax.div(n_pages_j[j] + CHUNK_PAGES - 1, CHUNK_PAGES),
+        )
+    # sliding window: chunks wholly below the BLOCK's earliest window
+    # start are skipped (per-slot masks handle the rest)
+    lo_block = jnp.where(
+        window > 0,
+        jnp.maximum(seq_lens_ref[bb * BS] - window, 0),
+        0,
+    )
+    for j in range(1, BS):
+        lo_block = jnp.minimum(
+            lo_block,
+            jnp.where(
+                window > 0,
+                jnp.maximum(seq_lens_ref[bb * BS + j] - window, 0),
+                0,
+            ),
+        )
+    lo_chunk = jax.lax.div(lo_block, chunk_tokens)
+
+    def src(ref, page_id):
+        if has_layer:
+            return ref.at[layer_ref[0], g, page_id]
+        return ref.at[g, page_id]
+
+    def start_chunk(c, slot):
+        for j in range(BS):
+            b = bb * BS + j
+            for i in range(CHUNK_PAGES):  # static unroll
+                page_pos = c * CHUNK_PAGES + i
+
+                @pl.when(page_pos < n_pages_j[j])
+                def _():
+                    page_id = page_tables_ref[b, page_pos]
+                    pltpu.make_async_copy(
+                        src(k_pages_ref, page_id),
+                        k_buf.at[
+                            slot, j, pl.ds(i * page_size, page_size), :
+                        ],
+                        sems.at[slot, 0, j, i],
+                    ).start()
+                    pltpu.make_async_copy(
+                        src(v_pages_ref, page_id),
+                        v_buf.at[
+                            slot, j, pl.ds(i * page_size, page_size), :
+                        ],
+                        sems.at[slot, 1, j, i],
+                    ).start()
+
+                @pl.when(page_pos >= n_pages_j[j])
+                def _():
+                    k_buf[
+                        slot, j, pl.ds(i * page_size, page_size), :
+                    ] = jnp.zeros(
+                        (page_size, k_buf.shape[-1]), k_buf.dtype
+                    )
+                    v_buf[
+                        slot, j, pl.ds(i * page_size, page_size), :
+                    ] = jnp.zeros(
+                        (page_size, v_buf.shape[-1]), v_buf.dtype
+                    )
+
+    def wait_chunk(c, slot):
+        for j in range(BS):
+            for i in range(CHUNK_PAGES):
+                page_pos = c * CHUNK_PAGES + i
+
+                @pl.when(page_pos < n_pages_j[j])
+                def _():
+                    pltpu.make_async_copy(
+                        src(k_pages_ref, 0),
+                        k_buf.at[
+                            slot, j, pl.ds(i * page_size, page_size), :
+                        ],
+                        sems.at[slot, 0, j, i],
+                    ).wait()
+                    pltpu.make_async_copy(
+                        src(v_pages_ref, 0),
+                        v_buf.at[
+                            slot, j, pl.ds(i * page_size, page_size), :
+                        ],
+                        sems.at[slot, 1, j, i],
+                    ).wait()
+
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    m_ref[...] = jnp.full_like(m_ref, -1e30)
+    l_ref[...] = jnp.zeros_like(l_ref)
+
+    start_chunk(lo_chunk, jax.lax.rem(lo_chunk, 2))
+
+    def body(c, _):
+        slot = jax.lax.rem(c, 2)
+        next_slot = jax.lax.rem(c + 1, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            start_chunk(c + 1, next_slot)
+
+        wait_chunk(c, slot)
+
+        k_all = jax.lax.cond(
+            slot == 0, lambda: k_buf[0], lambda: k_buf[1]
+        )  # [BS, chunk_tokens, hd]
+        v_all = jax.lax.cond(
+            slot == 0, lambda: v_buf[0], lambda: v_buf[1]
+        )
+        token_base = c * chunk_tokens
+        for j in range(BS):  # static unroll: 2D dots only
+            b = bb * BS + j
+            q = q_ref[0, 0, j].astype(jnp.float32) * scale  # [G, hd]
+            k = k_all[j].astype(jnp.float32)  # [chunk_tokens, hd]
+            v = v_all[j].astype(jnp.float32)
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G, chunk_tokens]
+            if softcap:
+                scores = jnp.tanh(scores / softcap) * softcap
+            token_pos = token_base + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1
+            )
+            sl = seq_lens_ref[b]
+            lo = jnp.where(
+                window > 0, jnp.maximum(sl - window, 0), 0
+            )
+            valid = (token_pos >= lo) & (token_pos < sl)
+            scores = jnp.where(valid, scores, -1e30)
+            r = slice(j * G, (j + 1) * G)
+            m_prev = m_ref[r, :1]
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new)
+            l_new = alpha * l_ref[r, :1] + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            acc_ref[r, :] = acc_ref[r, :] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[r, :] = jnp.broadcast_to(m_new, (G, 128))
+            l_ref[r, :] = jnp.broadcast_to(l_new, (G, 128))
+        return 0
+
+    jax.lax.fori_loop(lo_chunk, n_chunks, body, 0)
+    for j in range(BS):
+        r = slice(j * G, (j + 1) * G)
+        denom = jnp.maximum(l_ref[r, :1], 1e-30)
+        out_ref[0, 0, j] = (acc_ref[r, :] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("interpret", "softcap", "scale", "block_slots"),
+)
+def paged_decode_attention_pallas_blocked(
+    q: jnp.ndarray,  # [B, H, hd]
+    k_pages: jnp.ndarray,  # [KV, P, ps, hd] ([L, KV, ...] with `layer`)
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, pages_per_seq]
+    seq_lens: jnp.ndarray,  # [B]
+    window=None,
+    layer=None,
+    interpret: bool = False,
+    softcap: float = 0.0,
+    scale=None,
+    block_slots: int = 8,
+) -> jnp.ndarray:
+    """Multi-slot-blocked variant of ``paged_decode_attention_pallas``:
+    grid (B/block_slots, KV) instead of (B, KV).  Opt-in via
+    ``tpu.decode_block_slots`` until its win is measured on hardware
+    (the r3 lesson: no unmeasured default flips).  Falls back to the
+    per-slot kernel when ``B % block_slots != 0``."""
+    B, H, hd = q.shape
+    has_layer = layer is not None
+    KV, P, ps, _ = k_pages.shape[1:] if has_layer else k_pages.shape
+    G = H // KV
+    BS = block_slots
+    if BS <= 1 or B % BS:
+        return paged_decode_attention_pallas(
+            q, k_pages, v_pages, page_tables, seq_lens, window=window,
+            layer=layer, interpret=interpret, softcap=softcap,
+            scale=scale,
+        )
+    chunk_tokens = CHUNK_PAGES * ps
+
+    if window is None:
+        window_arr = jnp.zeros((1,), jnp.int32)
+    else:
+        window_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    layer_arr = (
+        jnp.asarray(layer, jnp.int32).reshape(1)
+        if has_layer
+        else jnp.full((1,), -1, jnp.int32)
+    )
+    kernel = functools.partial(
+        _blocked_kernel,
+        page_size=ps,
+        softcap=float(softcap),
+        scale=float(scale) if scale is not None else hd ** -0.5,
+        block_slots=BS,
+        has_layer=has_layer,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B // BS, KV),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, BS, G, hd),
+                lambda bb, g, *prefetch: (bb, g, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, BS, G, hd),
+            lambda bb, g, *prefetch: (bb, g, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, BS, chunk_tokens, hd), k_pages.dtype),
+            pltpu.VMEM((2, BS, chunk_tokens, hd), v_pages.dtype),
+            pltpu.VMEM((BS * G, hd), jnp.float32),
+            pltpu.VMEM((BS * G, 128), jnp.float32),
+            pltpu.VMEM((BS * G, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, BS, CHUNK_PAGES)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B // BS, KV, BS, G, hd), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024,
+        ),
+    )(
+        page_tables, seq_lens, window_arr, layer_arr,
+        # q [B, H, hd] = [NB*BS, KV*G, hd] -> [NB, KV, BS, G, hd]
+        jnp.swapaxes(q.reshape(B // BS, BS, KV, G, hd), 1, 2),
+        k_pages, v_pages,
+    )
+    # out [NB, KV, BS, G, hd] -> [B, H, hd]
+    return jnp.swapaxes(out, 1, 2).reshape(B, H, hd)
+
+
 def _mt_kernel(
     # scalar prefetch
     page_tables_ref,  # [B, pages_per_seq] int32 (SMEM)

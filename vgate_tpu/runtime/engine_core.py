@@ -640,6 +640,17 @@ class EngineCore:
             tpu_cfg.use_pallas
             and self.mesh.devices.flat[0].platform == "tpu"
         )
+        if (
+            self.use_pallas
+            and int(getattr(tpu_cfg, "decode_block_slots", 1)) > 1
+        ):
+            import dataclasses as _dc
+
+            # threaded on the spec (a static jit arg), like quant_kernel
+            self.spec = _dc.replace(
+                self.spec,
+                decode_block_slots=int(tpu_cfg.decode_block_slots),
+            )
         if self.config.model.quantization in ("int8", "int4"):
             import dataclasses
 
