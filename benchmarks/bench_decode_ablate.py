@@ -119,21 +119,26 @@ def main() -> None:
 
         report("rtt", timed(rtt_fn, tokens, iters_inside=1))
 
-    # --- full serving chunk (pallas / jnp x xs-ys / carry KV) -------------
-    for name, use_pallas, kv_carry in (
-        ("chunk-pallas", True, False),
-        ("chunk-pallas-carry", True, True),
-        ("chunk-jnp", False, False),
-        ("chunk-jnp-carry", False, True),
+    # --- full serving chunk (pallas / jnp x xs-ys / carry KV / blocked) ---
+    import dataclasses
+
+    spec_blocked = dataclasses.replace(spec, decode_block_slots=8)
+    for name, use_pallas, kv_carry, chunk_spec in (
+        ("chunk-pallas", True, False, spec),
+        ("chunk-pallas-blocked", True, False, spec_blocked),
+        ("chunk-pallas-carry", True, True, spec),
+        ("chunk-jnp", False, False, spec),
+        ("chunk-jnp-carry", False, True, spec),
     ):
         if only and name not in only:
             continue
         if use_pallas and platform != "tpu":
             continue
 
-        def run(k_pages, v_pages, up=use_pallas, kc=kv_carry):
+        def run(k_pages, v_pages, up=use_pallas, kc=kv_carry,
+                sp_=chunk_spec):
             return _decode_chunk(
-                params, spec, tokens, positions, k_pages, v_pages,
+                params, sp_, tokens, positions, k_pages, v_pages,
                 page_tables, active, temps, top_ps, top_ks, key, counter,
                 num_steps=STEPS, use_pallas=up, max_position=ctx - 1,
                 seeds=seeds, steps=steps0, kv_carry=kc,
@@ -307,7 +312,7 @@ def main() -> None:
     seq_lens = positions + 1
     L = spec.num_layers
 
-    for name in ("attn-pallas", "attn-jnp"):
+    for name in ("attn-pallas", "attn-pallas-blocked", "attn-jnp"):
         if only and name not in only:
             continue
         if name == "attn-pallas":
@@ -315,6 +320,18 @@ def main() -> None:
                 continue
             from vgate_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention_pallas as attn,
+            )
+        elif name == "attn-pallas-blocked":
+            if platform != "tpu":
+                continue
+            import functools as _ft
+
+            from vgate_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_pallas_blocked,
+            )
+
+            attn = _ft.partial(
+                paged_decode_attention_pallas_blocked, block_slots=8
             )
         else:
             from vgate_tpu.ops.attention import (

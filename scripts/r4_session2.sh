@@ -35,6 +35,8 @@ run ctx8k VGT_BENCH_CTX=8192 VGT_BENCH_PROMPT=7900 VGT_BENCH_MAXTOK=128 \
 # 4. TTFT under Poisson arrivals: below and above the service knee
 run poisson25 VGT_BENCH_RATE=25 VGT_BENCH_PAGE=32
 run poisson40 VGT_BENCH_RATE=40 VGT_BENCH_PAGE=32
+# 4b. multi-slot blocked decode kernel A/B at the serving shape
+run blocked8 VGT_TPU__DECODE_BLOCK_SLOTS=8 VGT_BENCH_PAGE=32
 # 5. shared-prefix TTFT + speculative + kernel microbench
 aux prefix benchmarks/bench_prefix.py
 aux spec benchmarks/bench_speculative.py
