@@ -1,0 +1,147 @@
+"""Tensor-parallel Pallas-kernel wrappers (parallel/tp_attention.py) vs
+single-device oracles, interpret-mode kernels on the virtual CPU mesh.
+
+The hazard under test: a pallas_call has no GSPMD partition rule, so
+under a tp-sharded jit it would be replicated (all-gathering the KV
+pool); the wrappers run it per shard with the head dims split.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vgate_tpu.config import load_config
+from vgate_tpu.parallel.mesh import build_mesh
+
+
+def tp_mesh(tp):
+    return build_mesh(
+        load_config(
+            tpu={"dp": 1, "ep": 1, "sp": 1, "tp": tp, "num_devices": tp}
+        ).tpu,
+        devices=jax.devices()[:tp],
+    )
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_decode_wrapper_matches_oracle(tp):
+    from vgate_tpu.ops.attention import paged_decode_attention
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas,
+    )
+    from vgate_tpu.parallel.tp_attention import (
+        tp_divisible,
+        tp_paged_decode_attention,
+    )
+
+    if jax.device_count() < tp:
+        pytest.skip("needs devices")
+    rng = np.random.default_rng(tp)
+    B, H, KV, hd, ps, pages_per_seq = 3, 8, 4, 128, 16, 4
+    P_ = 1 + B * pages_per_seq
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.normal(size=(KV, P_, ps, hd)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.normal(size=(KV, P_, ps, hd)), jnp.float32
+    )
+    pt = jnp.asarray(
+        rng.permutation(np.arange(1, P_))[: B * pages_per_seq].reshape(
+            B, pages_per_seq
+        ),
+        jnp.int32,
+    )
+    seq_lens = jnp.asarray([5, 33, 64], jnp.int32)
+    mesh = tp_mesh(tp)
+    assert tp_divisible(mesh, H, KV)
+
+    expect = paged_decode_attention(
+        q, k_pages, v_pages, pt, seq_lens
+    )
+    kernel = functools.partial(
+        paged_decode_attention_pallas, interpret=True
+    )
+    got = tp_paged_decode_attention(
+        kernel, mesh, q, k_pages, v_pages, pt, seq_lens
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_tp_decode_wrapper_window_and_layer():
+    from vgate_tpu.ops.attention import paged_decode_attention
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas,
+    )
+    from vgate_tpu.parallel.tp_attention import tp_paged_decode_attention
+
+    if jax.device_count() < 2:
+        pytest.skip("needs devices")
+    rng = np.random.default_rng(7)
+    B, H, KV, hd, ps, pages_per_seq, L = 2, 4, 2, 128, 16, 4, 3
+    P_ = 1 + B * pages_per_seq
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    kL = jnp.asarray(
+        rng.normal(size=(L, KV, P_, ps, hd)), jnp.float32
+    )
+    vL = jnp.asarray(
+        rng.normal(size=(L, KV, P_, ps, hd)), jnp.float32
+    )
+    pt = jnp.asarray(
+        rng.permutation(np.arange(1, P_))[: B * pages_per_seq].reshape(
+            B, pages_per_seq
+        ),
+        jnp.int32,
+    )
+    seq_lens = jnp.asarray([40, 61], jnp.int32)
+    w = jnp.asarray(16, jnp.int32)
+    layer = jnp.asarray(1, jnp.int32)
+    mesh = tp_mesh(2)
+
+    expect = paged_decode_attention(
+        q, kL, vL, pt, seq_lens, window=w, layer=layer, softcap=25.0
+    )
+    kernel = functools.partial(
+        paged_decode_attention_pallas, interpret=True, softcap=25.0
+    )
+    got = tp_paged_decode_attention(
+        kernel, mesh, q, kL, vL, pt, seq_lens, window=w, layer=layer
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_tp_flash_prefill_wrapper_matches_oracle():
+    from vgate_tpu.ops.attention import causal_prefill_attention
+    from vgate_tpu.ops.pallas.flash_prefill import (
+        flash_prefill_attention_pallas,
+    )
+    from vgate_tpu.parallel.tp_attention import (
+        tp_flash_prefill_attention,
+    )
+
+    if jax.device_count() < 2:
+        pytest.skip("needs devices")
+    rng = np.random.default_rng(9)
+    B, S, H, KV, hd = 2, 128, 4, 2, 128
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, KV, hd)), jnp.float32)
+    seq_lens = jnp.asarray([S, S - 37], jnp.int32)
+    mesh = tp_mesh(2)
+
+    expect = causal_prefill_attention(q, k, v, seq_lens)
+    kernel = functools.partial(
+        flash_prefill_attention_pallas, interpret=True
+    )
+    got = tp_flash_prefill_attention(kernel, mesh, q, k, v, seq_lens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )

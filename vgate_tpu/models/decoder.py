@@ -345,11 +345,39 @@ def prefill_forward(
             flash_prefill_attention_pallas,
         )
 
-        attn_fn = functools.partial(
+        kernel = functools.partial(
             flash_prefill_attention_pallas,
             softcap=spec.attn_softcap,
             scale=_query_scale(spec),
         )
+        # tp>1: run the kernel per shard (parallel/tp_attention.py) —
+        # GSPMD has no partition rule for pallas_call and would
+        # replicate the sharded q/k/v heads otherwise
+        tp_mesh = (
+            mesh
+            if mesh is not None and mesh.shape.get("tp", 1) > 1
+            else None
+        )
+        if tp_mesh is None:
+            attn_fn = kernel
+        else:
+            from vgate_tpu.parallel.tp_attention import (
+                tp_divisible,
+                tp_flash_prefill_attention,
+            )
+
+            if tp_divisible(
+                tp_mesh, spec.num_heads, spec.num_kv_heads
+            ):
+                attn_fn = functools.partial(
+                    tp_flash_prefill_attention, kernel, tp_mesh
+                )
+            else:
+                attn_fn = functools.partial(
+                    flash_prefill_attention,
+                    softcap=spec.attn_softcap,
+                    scale=_query_scale(spec),
+                )
     else:
         attn_fn = functools.partial(
             flash_prefill_attention,
@@ -595,6 +623,15 @@ def decode_forward(
             sp_layer_fn, x, (params["layers"], windows, k_pages, v_pages)
         )
         return _logits(params, spec, x), k_pages, v_pages
+    # tp>1 (no sp/pp): params and the pool's kv-head dim are GSPMD-
+    # sharded.  The jnp twin partitions automatically; a pallas_call
+    # does NOT — it must run per shard via shard_map
+    # (parallel/tp_attention.py) or GSPMD would all-gather the pool.
+    tp_mesh = (
+        mesh
+        if mesh is not None and mesh.shape.get("tp", 1) > 1
+        else None
+    )
     if use_pallas:
         # the decode kernel supports window/softcap/scale natively (and
         # skips DMA for pages below the window), so local-attention
@@ -602,25 +639,47 @@ def decode_forward(
         # multi-slot blocked grid (B/N x KV programs instead of B x KV).
         if spec.decode_block_slots > 1:
             from vgate_tpu.ops.pallas.paged_attention import (
-                paged_decode_attention_pallas_blocked,
+                paged_decode_attention_pallas_blocked as _decode_kernel,
             )
 
-            attn_fn = functools.partial(
-                paged_decode_attention_pallas_blocked,
+            kernel = functools.partial(
+                _decode_kernel,
                 softcap=spec.attn_softcap,
                 scale=_query_scale(spec),
                 block_slots=spec.decode_block_slots,
             )
         else:
             from vgate_tpu.ops.pallas.paged_attention import (
-                paged_decode_attention_pallas,
+                paged_decode_attention_pallas as _decode_kernel,
             )
 
-            attn_fn = functools.partial(
-                paged_decode_attention_pallas,
+            kernel = functools.partial(
+                _decode_kernel,
                 softcap=spec.attn_softcap,
                 scale=_query_scale(spec),
             )
+        if tp_mesh is None:
+            attn_fn = kernel
+        else:
+            from vgate_tpu.parallel.tp_attention import (
+                tp_divisible,
+                tp_paged_decode_attention,
+            )
+
+            if tp_divisible(
+                tp_mesh, spec.num_heads, spec.num_kv_heads
+            ):
+                attn_fn = functools.partial(
+                    tp_paged_decode_attention, kernel, tp_mesh
+                )
+            else:
+                # heads don't divide tp: the auto-partitioned jnp twin
+                # is strictly better than a replicated pallas_call
+                attn_fn = functools.partial(
+                    paged_decode_attention,
+                    softcap=spec.attn_softcap,
+                    scale=_query_scale(spec),
+                )
     else:
         attn_fn = functools.partial(
             paged_decode_attention,
@@ -744,8 +803,12 @@ def prefill_suffix_forward(
     # acc/m/l/scores blocks total ~15 MB — comfortable; S=2048 doubles
     # that and serializes huge per-program dots.  Cap the kernel route
     # at the default chunked-prefill width and keep the blockwise jnp
-    # path beyond (row-tiling the kernel is the future fix).
+    # path beyond (row-tiling the kernel is the future fix).  tp>1:
+    # the jnp path auto-partitions; the kernel would be GSPMD-
+    # replicated (parallel/tp_attention.py rationale), so gate it off.
     use_pallas = use_pallas and S <= 1024
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        use_pallas = False
     if use_pallas:
         from vgate_tpu.ops.pallas.paged_attention import (
             paged_multitok_attention_pallas,
@@ -839,6 +902,10 @@ def spec_verify_forward(
         if mesh is not None and mesh.shape.get("sp", 1) > 1
         else None
     )
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        # tp>1: the blockwise jnp verify path auto-partitions over the
+        # head dims; the multitok kernel would be GSPMD-replicated
+        use_pallas = False
     if sp_mesh is not None:
         # speculative verify on an sp-sharded pool: per-token scatter
         # writes + blockwise partials per shard, LSE merge over sp

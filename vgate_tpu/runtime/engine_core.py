@@ -651,6 +651,22 @@ class EngineCore:
                 self.spec,
                 decode_block_slots=int(tpu_cfg.decode_block_slots),
             )
+        # tp>1 with Pallas on: the forwards need the mesh so attention
+        # kernels run per tp shard (parallel/tp_attention.py) instead of
+        # GSPMD replicating the pallas_call's operands.  The sp/pp
+        # routing mesh (self._fwd_mesh) takes precedence when set.
+        tp_size = int(self.mesh.shape.get("tp", 1))
+        self._attn_mesh = self._fwd_mesh
+        if self._attn_mesh is None and tp_size > 1 and self.use_pallas:
+            self._attn_mesh = self.mesh
+        # suffix-prefill / spec-verify dispatch mesh: sp shard path, or
+        # the tp mesh (those forwards then gate their kernels off and
+        # ride the auto-partitioned jnp paths)
+        self._mt_mesh = (
+            self.mesh
+            if (self._sp > 1 or (tp_size > 1 and self.use_pallas))
+            else None
+        )
         if self.config.model.quantization in ("int8", "int4"):
             import dataclasses
 
@@ -1150,7 +1166,7 @@ class EngineCore:
             jnp.asarray(top_ps),
             jnp.asarray(top_ks),
             self._step_key(),
-            mesh=self._fwd_mesh,
+            mesh=self._attn_mesh,
             use_pallas=self.use_pallas,
             seeds=jnp.asarray(seeds),
             steps=jnp.asarray(steps),
@@ -1272,7 +1288,7 @@ class EngineCore:
             bias_ids=lb_ids,
             bias_vals=lb_vals,
             use_pallas=self.use_pallas,
-            mesh=self._fwd_mesh if self._sp > 1 else None,
+            mesh=self._mt_mesh,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -1340,7 +1356,7 @@ class EngineCore:
                 steps=jnp.zeros((1,), jnp.int32),
                 kv_carry=self._kv_carry,
                 use_pallas=self.use_pallas,
-                mesh=self._fwd_mesh if self._sp > 1 else None,
+                mesh=self._mt_mesh,
             )
             start += n
         # final chunk: exactly a B=1 suffix-group dispatch with
@@ -1529,11 +1545,7 @@ class EngineCore:
             max_position=self.config.model.max_model_len - 1,
             seeds=state["seeds"],
             steps=state["steps"],
-            mesh=(
-                self._fwd_mesh
-                if (self._pp > 1 or self._sp > 1)
-                else None
-            ),
+            mesh=self._attn_mesh,
             num_logprobs=num_lp,
             counts=state["counts"],
             freq_pens=state["freq_pens"],
@@ -1766,7 +1778,7 @@ class EngineCore:
                 kv_carry=self._kv_carry,
                 bias_ids=spec_lb,
                 bias_vals=spec_lb_vals,
-                mesh=self._fwd_mesh if self._sp > 1 else None,
+                mesh=self._mt_mesh,
             )
         )
         if want_pen:
