@@ -135,3 +135,45 @@ def test_dp_prefix_affinity_routing():
         assert max(hits) > 0
     finally:
         engine.stop()
+
+
+def test_dp_x_sp_replicas_shard_their_pools():
+    """dp x sp composes: each replica's submesh carries sp=2, its KV
+    pool shards over sp inside the replica, and greedy output is
+    token-identical to a plain single engine."""
+    if jax.device_count() < 4:
+        pytest.skip("needs >=4 devices")
+    prompt = [5 + (i % 13) for i in range(20)]
+
+    single = ReplicatedEngine(
+        dp_config(dp=1, sp=1, num_devices=1, prefill_buckets=[8, 32]),
+        devices=jax.devices()[:1],
+    )
+    single.start()
+    try:
+        a = single.submit_tokens(prompt, greedy(6))
+        assert a.done_event.wait(300)
+        want = list(a.generated_ids)
+    finally:
+        single.stop()
+
+    engine = ReplicatedEngine(
+        dp_config(dp=2, sp=2, num_devices=4, prefill_buckets=[8, 32]),
+        devices=jax.devices()[:4],
+    )
+    engine.start()
+    try:
+        for core in engine.replicas:
+            assert dict(core.mesh.shape).get("sp") == 2
+            assert "sp" in str(core.k_pages.sharding.spec)
+        seqs = [
+            engine.submit_tokens(prompt[:-1] + [90 + i], greedy(6))
+            for i in range(4)
+        ]
+        for s in seqs:
+            assert s.done_event.wait(300)
+        b = engine.submit_tokens(prompt, greedy(6))
+        assert b.done_event.wait(300)
+        assert list(b.generated_ids) == want
+    finally:
+        engine.stop()
