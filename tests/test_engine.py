@@ -416,6 +416,49 @@ def test_moe_engine_end_to_end_expert_parallel():
         core.stop()
 
 
+def test_moe_ep_x_sp_end_to_end():
+    """ep x sp composes: the sp shard_map covers only attention + the
+    KV write, so the MoE FFN's ep dispatch stays under jit auto
+    sharding.  Greedy output must be token-identical to the ep=1/sp=1
+    engine."""
+    if jax.device_count() < 4:
+        pytest.skip("needs >=4 devices")
+
+    def cfg(ep, sp, n_dev):
+        return load_config(
+            model={
+                "model_id": "tiny-moe",
+                "engine_type": "jax_tpu",
+                "dtype": "float32",
+                "max_model_len": 64,
+            },
+            tpu={
+                "dp": 1, "tp": 1, "ep": ep, "sp": sp,
+                "num_devices": n_dev,
+                "kv_num_pages": 64, "kv_page_size": 4,
+                "max_batch_slots": 2, "prefill_buckets": [16, 32],
+                "use_pallas": False,
+            },
+            scheduler={"max_queue_size": 8},
+            logging={"level": "WARNING"},
+        )
+
+    prompt_ids = [3 + (i % 19) for i in range(24)]
+    outs = []
+    for ep, sp, n_dev in ((1, 1, 1), (2, 2, 4)):
+        core = EngineCore(cfg(ep, sp, n_dev), devices=jax.devices()[:n_dev])
+        core.start()
+        try:
+            seq = core.submit_tokens(prompt_ids, greedy(8))
+            assert seq.done_event.wait(300)
+            outs.append(list(seq.generated_ids))
+            if sp > 1:
+                assert "sp" in str(core.k_pages.sharding.spec)
+        finally:
+            core.stop()
+    assert outs[0] == outs[1]
+
+
 def test_sp_engine_long_prefill_end_to_end():
     """Sequence-parallel serving: with sp=2 the engine's prefill runs ring
     attention over the sp axis (SURVEY.md section 5.7 long-context path) and
