@@ -210,3 +210,42 @@ def test_decode_forward_tp_mesh_selects_wrapped_kernel():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expect), rtol=2e-4, atol=2e-4
     )
+
+
+def test_tp_wrapper_with_blocked_kernel():
+    """decode_block_slots > 1 composes with tp: the blocked kernel runs
+    per shard inside the wrapper."""
+    if jax.device_count() < 2:
+        pytest.skip("needs devices")
+    from vgate_tpu.ops.attention import paged_decode_attention
+    from vgate_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas_blocked,
+    )
+    from vgate_tpu.parallel.tp_attention import tp_paged_decode_attention
+
+    rng = np.random.default_rng(21)
+    B, H, KV, hd, ps, pages_per_seq = 4, 4, 2, 128, 16, 4
+    P_ = 1 + B * pages_per_seq
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(KV, P_, ps, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(KV, P_, ps, hd)), jnp.float32)
+    pt = jnp.asarray(
+        rng.permutation(np.arange(1, P_))[: B * pages_per_seq].reshape(
+            B, pages_per_seq
+        ),
+        jnp.int32,
+    )
+    seq_lens = jnp.asarray([5, 33, 64, 17], jnp.int32)
+    mesh = tp_mesh(2)
+
+    expect = paged_decode_attention(q, k_pages, v_pages, pt, seq_lens)
+    kernel = functools.partial(
+        paged_decode_attention_pallas_blocked, interpret=True,
+        block_slots=2,
+    )
+    got = tp_paged_decode_attention(
+        kernel, mesh, q, k_pages, v_pages, pt, seq_lens
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
