@@ -177,3 +177,34 @@ def test_dp_x_sp_replicas_shard_their_pools():
         assert list(b.generated_ids) == want
     finally:
         engine.stop()
+
+
+def test_dp_routes_around_dead_replica():
+    """Engine-fatal on one replica (SURVEY 5.3 failure containment):
+    new requests ride the surviving replica; health reports degraded
+    but serving-capable; all-dead surfaces the fatal."""
+    engine = ReplicatedEngine(dp_config(dp=2), devices=jax.devices()[:2])
+    engine.start()
+    try:
+        victim = engine.replicas[0]
+        victim._fatal = RuntimeError("injected device loss")
+        for i in range(4):
+            seq = engine.submit_tokens(
+                [20 + i, 7, 9, 11, 13], greedy(3)
+            )
+            assert seq.done_event.wait(timeout=300)
+            assert seq.num_output_tokens == 3
+        assert engine.replicas[1].scheduler.total_admitted >= 4
+        health = engine.device_health()
+        assert health["alive"] and health["replicas_alive"] == 1
+
+        engine.replicas[1]._fatal = RuntimeError("second loss")
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="engine is dead"):
+            engine.submit_tokens([1, 2, 3, 4], greedy(2))
+        assert not engine.device_health()["alive"]
+    finally:
+        for core in engine.replicas:
+            core._fatal = None  # let stop() run cleanly
+        engine.stop()

@@ -95,6 +95,10 @@ class ReplicatedEngine:
     def _load(core: EngineCore) -> int:
         return len(core.scheduler.waiting) + len(core.scheduler.running)
 
+    @staticmethod
+    def _alive(core: EngineCore) -> bool:
+        return core._fatal is None
+
     def _pick_replica(
         self, prompt_ids: Optional[List[int]] = None
     ) -> EngineCore:
@@ -102,12 +106,21 @@ class ReplicatedEngine:
         on ties so idle replicas fill evenly — with **prefix affinity**:
         each replica's KV prefix cache is private, so requests sharing a
         first prompt page stick to the same replica (cache hits) unless
-        that replica is meaningfully more loaded than the best one."""
+        that replica is meaningfully more loaded than the best one.
+
+        Failure containment (SURVEY 5.3): a replica whose engine thread
+        died (engine-fatal) is routed AROUND — in-flight sequences on it
+        fail, but new requests ride the surviving replicas.  Only when
+        every replica is dead does the submit surface the fatal."""
         with self._route_lock:
             offset = next(self._rr)
             n = len(self.replicas)
             order = [self.replicas[(offset + i) % n] for i in range(n)]
-            best = min(order, key=self._load)
+            alive = [c for c in order if self._alive(c)]
+            if not alive:
+                # all dead: let EngineCore.submit_tokens raise the fatal
+                return order[0]
+            best = min(alive, key=self._load)
             page = self.config.tpu.kv_page_size
             if (
                 prompt_ids is not None
@@ -121,9 +134,10 @@ class ReplicatedEngine:
                 )
                 sticky = self.replicas[zlib.crc32(block) % n]
                 # affinity wins unless it costs real queueing headroom
-                if self._load(sticky) <= self._load(best) + max(
-                    2, self.config.tpu.max_batch_slots // 4
-                ):
+                # (or the sticky replica is dead)
+                if self._alive(sticky) and self._load(sticky) <= self._load(
+                    best
+                ) + max(2, self.config.tpu.max_batch_slots // 4):
                     return sticky
             return best
 
@@ -200,8 +214,15 @@ class ReplicatedEngine:
 
     def device_health(self) -> Dict[str, Any]:
         healths = [core.device_health() for core in self.replicas]
+        alive = [
+            h.get("alive", False) and self._alive(core)
+            for h, core in zip(healths, self.replicas)
+        ]
         return {
-            "alive": all(h.get("alive") for h in healths),
+            # serving-capable as long as ANY replica lives (the router
+            # steers around dead ones); per-replica detail alongside
+            "alive": any(alive),
+            "replicas_alive": sum(alive),
             "platform": healths[0].get("platform"),
             "device_kind": healths[0].get("device_kind"),
             "num_devices": sum(h.get("num_devices", 0) for h in healths),
