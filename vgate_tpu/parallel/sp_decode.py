@@ -33,7 +33,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from vgate_tpu.parallel.mesh import AXIS_SP
+from vgate_tpu.parallel.mesh import AXIS_SP, AXIS_TP
+
+
+def _tp_axis(mesh, H: int, KV: int):
+    """``AXIS_TP`` when this mesh also carries tp and the head counts
+    divide it — the shard bodies then run per (sp, tp) shard on local
+    heads with NO tp collectives (attention is head-parallel).  None
+    otherwise: the specs replicate over tp, which is correct but
+    all-gathers tp-sharded operands at the shard_map boundary."""
+    tp = int(mesh.shape.get(AXIS_TP, 1))
+    if tp > 1 and H % tp == 0 and KV % tp == 0:
+        return AXIS_TP
+    return None
 
 
 def reserved_page_ids(num_pages: int, sp: int) -> list:
@@ -162,12 +174,15 @@ def sp_decode_attention_and_write(
 
     from jax.experimental.shard_map import shard_map
 
-    pool = P(None, AXIS_SP, None, None)
+    tp_ax = _tp_axis(mesh, H, k_t.shape[1])
+    pool = P(tp_ax, AXIS_SP, None, None)
+    heads = P(None, tp_ax, None)  # q [B,H,hd] / k_t,v_t [B,KV,hd]
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(pool, pool, P(), P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), pool, pool),
+        in_specs=(pool, pool, heads, heads, heads, P(), P(), P(), P(),
+                  P()),
+        out_specs=(heads, pool, pool),
         check_rep=False,
     )
     return fn(
@@ -344,12 +359,15 @@ def sp_suffix_attention_and_write(
 
     from jax.experimental.shard_map import shard_map
 
-    pool = P(None, AXIS_SP, None, None)
+    tp_ax = _tp_axis(mesh, H, KV)
+    pool = P(tp_ax, AXIS_SP, None, None)
+    heads = P(None, None, tp_ax, None)  # [B,S,H|KV,hd]
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(pool, pool, P(), P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), pool, pool),
+        in_specs=(pool, pool, heads, heads, heads, P(), P(), P(), P(),
+                  P()),
+        out_specs=(heads, pool, pool),
         check_rep=False,
     )
     return fn(
@@ -424,13 +442,15 @@ def sp_multitok_attention_and_write(
 
     from jax.experimental.shard_map import shard_map
 
-    pool = P(None, AXIS_SP, None, None)
+    tp_ax = _tp_axis(mesh, H, k_t.shape[2])
+    pool = P(tp_ax, AXIS_SP, None, None)
+    heads = P(None, None, tp_ax, None)  # [B,S,H|KV,hd]
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(pool, pool, P(), P(), P(), P(), P(), P(), P(), P(),
-                  P()),
-        out_specs=(P(), pool, pool),
+        in_specs=(pool, pool, heads, heads, heads, P(), P(), P(), P(),
+                  P(), P()),
+        out_specs=(heads, pool, pool),
         check_rep=False,
     )
     return fn(
