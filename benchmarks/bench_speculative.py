@@ -13,6 +13,14 @@ next-8).  Two drafter configurations per k:
 * ``ngram`` — the real prompt-lookup drafter on a repetitive prompt
   (speculation's home turf: boilerplate/code-completion shapes).
 
+Read ``oracle@1`` acceptance as a LOWER bound: the k=0 continuation
+comes from the chunked-decode program and the verify runs a different
+compiled program, so near-tied logits can flip argmax at ulp level and
+reject a "true" draft (greedy exactness of the OUTPUT is still
+guaranteed — the engine always appends its own argmax).  The tok/s
+rows are unaffected: they measure the verify mechanism's cost at the
+achieved acceptance, which is what decides whether speculative_k pays.
+
 Prints one JSON line per row: {"k", "drafter", "toks_per_s",
 "acceptance", ...}.  Single-stream (B=1) plus a small batch row — the
 speculative tick is host-synchronous, so its win shrinks as batching
