@@ -785,12 +785,21 @@ class EngineCore:
                 if not self._tick():
                     self._wakeup.wait(timeout=0.005)
                     self._wakeup.clear()
-            except Exception as exc:  # pragma: no cover - engine fatal path
+            except Exception as exc:
                 logger.error("engine loop fatal error", exc_info=True)
                 self._fatal = exc
-                for seq in list(self.scheduler.running) + list(
+                # fail EVERY owed future: running, waiting, and anything
+                # still sitting in the submit queue (a client blocked on
+                # one of those would otherwise hang forever)
+                doomed = list(self.scheduler.running) + list(
                     self.scheduler.waiting
-                ):
+                )
+                while True:
+                    try:
+                        doomed.append(self._submit_q.get_nowait())
+                    except queue.Empty:
+                        break
+                for seq in doomed:
                     seq.fail(exc)
                 self.scheduler.waiting.clear()
                 for i in range(len(self.scheduler.slots)):
