@@ -37,6 +37,8 @@ run poisson25 VGT_BENCH_RATE=25 VGT_BENCH_PAGE=32
 run poisson40 VGT_BENCH_RATE=40 VGT_BENCH_PAGE=32
 # 4b. multi-slot blocked decode kernel A/B at the serving shape
 run blocked8 VGT_TPU__DECODE_BLOCK_SLOTS=8 VGT_BENCH_PAGE=32
+# 4c. DMA chunk width (pages per double-buffer slot; decision tree 4)
+run chunkpages16 VGT_CHUNK_PAGES=16 VGT_BENCH_PAGE=32
 # 5. shared-prefix TTFT + speculative + kernel microbench
 aux prefix benchmarks/bench_prefix.py
 aux spec benchmarks/bench_speculative.py
