@@ -1198,3 +1198,25 @@ def test_engine_fatal_fails_inflight_and_rejects_new():
     finally:
         core._fatal = None
         core.stop()
+
+
+def test_submit_fatal_toctou_drain():
+    """If the engine dies between submit_tokens' fatal check and its
+    queue put, the fatal handler's drain has already run and will never
+    see the new sequence — the post-put re-check must drain/fail it and
+    raise instead of leaving the client hung on done_event (ADVICE r4,
+    engine_core.py submit_tokens)."""
+    from vgate_tpu.runtime.sequence import SeqStatus
+
+    core = EngineCore(tiny_config(), devices=jax.devices()[:1])
+    boom = RuntimeError("died mid-submit")
+    real_put = core._submit_q.put
+
+    def racing_put(seq):
+        real_put(seq)
+        core._fatal = boom  # the loop died right as the put landed
+
+    core._submit_q.put = racing_put
+    with pytest.raises(RuntimeError, match="engine is dead"):
+        core.submit_tokens([1, 2, 3], greedy(2))
+    assert core._submit_q.empty()

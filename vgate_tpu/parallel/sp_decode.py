@@ -214,9 +214,22 @@ def _partial_suffix_attention(
     ps = k_local.shape[2]
     n_rep = H // KV
     ctx_pages = local_ct.shape[1]
+    if ctx_pages == 0:
+        return (
+            jnp.zeros((B, S, H, hd), jnp.float32),
+            jnp.full((B, S, H), -1e30, jnp.float32),
+            jnp.zeros((B, S, H), jnp.float32),
+        )
     block_pages = min(block_pages, ctx_pages)
-    while ctx_pages % block_pages:
-        block_pages -= 1
+    # Pad the page tables up to a block multiple instead of shrinking
+    # the block (a prime ctx_pages would otherwise degrade to 1-page
+    # blocks); padded entries carry owned=False so the valid mask zeroes
+    # their contribution.
+    pad = (-ctx_pages) % block_pages
+    if pad:
+        local_ct = jnp.pad(local_ct, ((0, 0), (0, pad)))
+        owned = jnp.pad(owned, ((0, 0), (0, pad)))
+        ctx_pages += pad
     n_blocks = ctx_pages // block_pages
 
     from vgate_tpu.ops.attention import repeat_kv

@@ -218,13 +218,20 @@ class ReplicatedEngine:
             h.get("alive", False) and self._alive(core)
             for h, core in zip(healths, self.replicas)
         ]
+        # Report platform/device_kind from an ALIVE replica: replica 0
+        # may be the dead one, and alive=true must describe a core that
+        # can actually serve.  Fall back to healths[0] only when none
+        # are alive.
+        rep = next(
+            (h for h, ok in zip(healths, alive) if ok), healths[0]
+        )
         return {
             # serving-capable as long as ANY replica lives (the router
             # steers around dead ones); per-replica detail alongside
             "alive": any(alive),
             "replicas_alive": sum(alive),
-            "platform": healths[0].get("platform"),
-            "device_kind": healths[0].get("device_kind"),
+            "platform": rep.get("platform"),
+            "device_kind": rep.get("device_kind"),
             "num_devices": sum(h.get("num_devices", 0) for h in healths),
             "replicas": len(self.replicas),
         }

@@ -591,7 +591,13 @@ class EngineCore:
         if sp_size > 1 and pp_size > 1:
             raise ValueError(
                 f"sp={sp_size} and pp={pp_size} cannot combine: ring-"
-                "attention prefill and the pipeline relay are exclusive"
+                "attention prefill and the pipeline relay restructure "
+                "the same forward along incompatible axes (sequence-"
+                "inside-layers vs layers-across-stages) — a permanent "
+                "design exclusion, not a missing feature; rationale and "
+                "the supported matrix: docs/composition.md. For large "
+                "meshes use sp*tp (long context) or pp*tp (deep model) "
+                "with dp over the remainder."
             )
         if pp_size > 1 and self.spec.num_layers % pp_size:
             raise ValueError(
@@ -624,8 +630,13 @@ class EngineCore:
         # scan (parallel/pipeline.py, r4 — the r3 gate is gone)
         if tpu_cfg.speculative_k > 0 and pp_size > 1:
             raise ValueError(
-                "speculative decoding is not supported with pp>1 (the "
-                "verify step has no pipeline-stage relay)"
+                "speculative decoding cannot combine with pp>1: a "
+                "verify round would relay candidates through every "
+                "stage and roll back rejected KV writes per stage, "
+                "serializing the pipeline — a permanent design "
+                "exclusion (docs/composition.md). Speculation composes "
+                "with sp, its long-context home turf; pp's throughput "
+                "workloads are served by continuous batching."
             )
         # speculative x sp composes (r4): the verify step rides
         # sp_multitok_attention_and_write on the sharded pool — the
@@ -729,6 +740,20 @@ class EngineCore:
             stream_cb=stream_cb,
         )
         self._submit_q.put(seq)
+        # Re-check after the put: if the engine died between the check
+        # above and the put, the fatal handler may already have drained
+        # the queue and will never see this seq — fail everything still
+        # queued ourselves so no client hangs on done_event.
+        if self._fatal is not None:
+            exc = self._fatal
+            while True:
+                try:
+                    orphan = self._submit_q.get_nowait()
+                except queue.Empty:
+                    break
+                orphan.fail(exc)
+            if seq.status is SeqStatus.FAILED:
+                raise RuntimeError("engine is dead") from exc
         self._wakeup.set()
         return seq
 
