@@ -1,0 +1,35 @@
+#!/bin/bash
+# Kill-free heal watcher for round 5: wait for the standing probe loop
+# (scripts/tpu_probe_loop.sh) to report a healthy grant via the status
+# file, then run the r5 measurement session ONCE and disarm.  Never
+# kills anything; if the probe loop died, relaunch it (fresh processes
+# only — a failed init poisons jax's in-process backend cache).
+#
+# Staleness guard: only a status file written AFTER this watcher armed
+# counts as a heal — a file left by an earlier healthy window must not
+# launch ~15 serialized benches against a re-wedged grant.  (If a heal
+# landed moments before arming, the relaunched probe loop re-probes and
+# rewrites the file, so a genuinely healthy grant is picked up within
+# one probe cycle.)
+cd /root/repo
+STATUS=${1:-/tmp/vgt_tpu_status.json}
+MARKER=/tmp/r5_watch_armed
+LOG=/tmp/r5_heal.log
+touch "$MARKER"
+echo "[heal] armed at $(date -u +%FT%TZ), status=$STATUS" >> "$LOG"
+for i in $(seq 1 2000); do
+  if [ "$STATUS" -nt "$MARKER" ]; then
+    echo "[heal] grant healthy at $(date -u +%FT%TZ): $(cat "$STATUS")" >> "$LOG"
+    bash scripts/r5_session.sh
+    echo "[heal] session complete at $(date -u +%FT%TZ); watcher disarmed" >> "$LOG"
+    exit 0
+  fi
+  if ! pgrep -f tpu_probe_loop.sh > /dev/null && \
+     ! pgrep -f tpu_patient_probe.py > /dev/null; then
+    echo "[heal] probe loop gone; relaunching at $(date -u +%FT%TZ)" >> "$LOG"
+    setsid nohup bash scripts/tpu_probe_loop.sh "$STATUS" \
+      >> /tmp/vgt_probe_launcher.log 2>&1 < /dev/null &
+  fi
+  sleep 30
+done
+echo "[heal] gave up after 2000 polls" >> "$LOG"

@@ -1,0 +1,95 @@
+#!/bin/bash
+# Round-5 measurement session: the staged r4 list (VERDICT r4 next-2)
+# plus the decode-roofline A/B grid (next-3) and TPU speculative rows
+# (next-6).  Serialized, kill-free (memory: tpu-grant-discipline —
+# nothing here ever kills a device process).  Quantized runs ride the
+# jnp dequant path: tpu.quant_kernel now DEFAULTS OFF (r5); the fused-
+# kernel compile probe is gated behind RUN_KERNELPROBE=1 because a
+# Mosaic hang would hold the chip with no kill-free recovery.
+cd /root/repo
+log=/tmp/r5_session.log
+raw=benchmarks/r5_raw
+mkdir -p "$raw"
+run() {
+  tag="$1"; shift
+  echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
+  env "$@" python bench.py > "$raw/$tag.jsonl" 2>/tmp/r5_${tag}.err
+  echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+  cat "$raw/$tag.jsonl" >> "$log"
+  sleep 20
+}
+aux() {
+  tag="$1"; script="$2"; shift 2
+  echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
+  env "$@" python "$script" > "$raw/$tag.jsonl" 2>/tmp/r5_${tag}.err
+  echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+  cat "$raw/$tag.jsonl" >> "$log"
+  sleep 20
+}
+
+# 1. headline confirm at r4 defaults (page 32, carry off, argmax fast
+#    path): the driver-format row the round is judged on
+run headline VGT_BENCH_PAGE=32
+# 2. decode-roofline chase (VERDICT next-3): multi-slot blocked decode
+#    kernel grid + DMA chunk width at the serving shape
+run blocked4  VGT_TPU__DECODE_BLOCK_SLOTS=4  VGT_BENCH_PAGE=32
+run blocked8  VGT_TPU__DECODE_BLOCK_SLOTS=8  VGT_BENCH_PAGE=32
+run blocked16 VGT_TPU__DECODE_BLOCK_SLOTS=16 VGT_BENCH_PAGE=32
+run chunkpages16 VGT_CHUNK_PAGES=16 VGT_BENCH_PAGE=32
+# 3. component ablation rows (readback timing) guide any follow-up
+aux ablate benchmarks/bench_decode_ablate.py
+# 4. north star: Qwen2.5-7B int8 on one chip (host-staged load, jnp
+#    dequant — VERDICT missing-2)
+run 7b_int8 VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct VGT_BENCH_QUANT=int8 \
+    VGT_TPU__QUANT_KERNEL=false \
+    VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
+# 5. long context >= 8k with chunked prefill (VERDICT missing-4)
+run ctx8k VGT_BENCH_CTX=8192 VGT_BENCH_PROMPT=7900 VGT_BENCH_MAXTOK=128 \
+    VGT_BENCH_REQUESTS=8 VGT_BENCH_SLOTS=8 VGT_BENCH_PREFILL_BATCH=1 \
+    VGT_BENCH_PAGE=32
+# 6. TTFT under Poisson arrivals, below/above the service knee
+#    (VERDICT missing-5)
+run poisson25 VGT_BENCH_RATE=25 VGT_BENCH_PAGE=32
+run poisson40 VGT_BENCH_RATE=40 VGT_BENCH_PAGE=32
+# 7. speculative decoding on device, k in {0,4,8} (VERDICT next-6)
+aux spec benchmarks/bench_speculative.py VGT_SPEC_KS=4,8
+# 8. shared-prefix TTFT + kernel microbench
+aux prefix benchmarks/bench_prefix.py
+aux kernels benchmarks/bench_kernels.py
+# 9. quant delta vs bf16: jnp dequant path AND the new W8A8/W4A8
+#    native s8xs8->s32 MXU path (r5, ops/quant.py int8_native_einsum —
+#    no Pallas involved, cannot hang)  (VERDICT next-4/5)
+run int8_jnp VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false \
+    VGT_BENCH_PAGE=32
+run int4_jnp VGT_BENCH_QUANT=int4 VGT_TPU__QUANT_KERNEL=false \
+    VGT_BENCH_PAGE=32
+run int8_native VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false \
+    VGT_TPU__INT8_NATIVE=true VGT_BENCH_PAGE=32
+run int4_native VGT_BENCH_QUANT=int4 VGT_TPU__QUANT_KERNEL=false \
+    VGT_TPU__INT8_NATIVE=true VGT_BENCH_PAGE=32
+# 9b. flagship on the native path (the likely 7B winner)
+run 7b_int8_native VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct \
+    VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false \
+    VGT_TPU__INT8_NATIVE=true \
+    VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
+# 10. OPT-IN ONLY: fused-kernel compile probe.  A Mosaic hang holds the
+#     chip and the only recovery (kill) wedges the grant for hours —
+#     run manually, early in a healthy window, never near round end.
+if [ "${RUN_KERNELPROBE:-0}" = "1" ]; then
+  echo "### kernelprobe start $(date -u +%H:%M:%S)" >> "$log"
+  python - > "$raw/kernelprobe.jsonl" 2>/tmp/r5_kernelprobe.err <<'EOF'
+import time, jax, jax.numpy as jnp, numpy as np
+from vgate_tpu.ops.pallas.quant_matmul import int8_matmul_pallas
+t0 = time.time()
+x = jnp.asarray(np.random.randn(128, 1536), jnp.bfloat16)
+wq = jnp.asarray(np.random.randint(-127, 127, (1536, 8960)), jnp.int8)
+scale = jnp.ones((1, 8960), jnp.float32)
+out = int8_matmul_pallas(x, wq, scale)
+np.asarray(out)
+print(f'{{"probe": "int8_kernel_compile", "seconds": {time.time()-t0:.1f}}}')
+EOF
+  echo "### kernelprobe rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+  cat "$raw/kernelprobe.jsonl" >> "$log"
+fi
+echo "### R5 SESSION DONE $(date -u +%H:%M:%S)" >> "$log"
+touch /tmp/r5_session_done

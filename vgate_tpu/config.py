@@ -151,11 +151,23 @@ class TPUConfig(BaseModel):
     # Use Pallas kernels where available; False falls back to jnp reference
     # implementations (needed on CPU test meshes).
     use_pallas: bool = True
-    # Fused dequant-matmul Pallas kernels for int8/int4 weights (r4: the
-    # int8 serving warmup hung >19 min in compile on v5e — gate them
-    # independently of the attention kernels so quantized serving can
-    # still ride the jnp dequant path while this is diagnosed).
-    quant_kernel: bool = True
+    # Fused dequant-matmul Pallas kernels for int8/int4 weights.
+    # Default OFF: the int8 serving warmup hung Mosaic compile >19 min
+    # on first v5e contact (r4, benchmarks/RESULTS_r4.md) and a default
+    # must never be able to hang a fresh deployment — quantized serving
+    # rides the jnp dequant path until the standalone compile probe
+    # adjudicates slow-compile vs hang (VERDICT r4 weak-3).  Opt in via
+    # VGT_TPU__QUANT_KERNEL=true once proven on your toolchain.
+    quant_kernel: bool = False
+    # W8A8/W4A8: dynamically quantize activations per-token (int8) and
+    # run projection GEMMs on the MXU's NATIVE s8 x s8 -> s32 path (2x
+    # bf16 matmul throughput on v5e) — pure jnp, no Pallas/Mosaic, and
+    # it auto-partitions under any mesh.  Changes numerics (~1% per-GEMM
+    # quantization error on top of weight quant), so opt-in until the
+    # accuracy/throughput trade is measured on hardware
+    # (VGT_TPU__INT8_NATIVE=true; applies when model.quantization is
+    # int8 or int4).
+    int8_native: bool = False
     # >1: the decode attention kernel serves this many slots per Pallas
     # program (grid B/N x KV instead of B x KV — at B=128, KV=2, 28
     # layers that is 7,168 vs 896 programs per decode step).  Opt-in

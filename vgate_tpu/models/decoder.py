@@ -99,10 +99,13 @@ def init_params(
 
 def _project_qkv(x, lp, spec: ModelSpec):
     """x: [..., D] -> q [..., H, hd], k/v [..., KV, hd]."""
-    ik = spec.quant_kernel
-    q = weighted_einsum("...d,dh->...h", x, lp["q"]["w"], quant_kernel=ik)
-    k = weighted_einsum("...d,dh->...h", x, lp["k"]["w"], quant_kernel=ik)
-    v = weighted_einsum("...d,dh->...h", x, lp["v"]["w"], quant_kernel=ik)
+    ik, i8 = spec.quant_kernel, spec.int8_native
+    q = weighted_einsum("...d,dh->...h", x, lp["q"]["w"], quant_kernel=ik,
+                        int8_native=i8)
+    k = weighted_einsum("...d,dh->...h", x, lp["k"]["w"], quant_kernel=ik,
+                        int8_native=i8)
+    v = weighted_einsum("...d,dh->...h", x, lp["v"]["w"], quant_kernel=ik,
+                        int8_native=i8)
     if spec.qkv_bias:
         q = q + lp["q"]["b"]
         k = k + lp["k"]["b"]
@@ -122,15 +125,17 @@ def _act(x32, spec: ModelSpec):
 
 
 def _dense_mlp(x, lp, spec: ModelSpec):
-    ik = spec.quant_kernel
+    ik, i8 = spec.quant_kernel, spec.int8_native
     gate = weighted_einsum("...d,df->...f", x, lp["gate"]["w"],
-                           quant_kernel=ik)
-    up = weighted_einsum("...d,df->...f", x, lp["up"]["w"], quant_kernel=ik)
+                           quant_kernel=ik, int8_native=i8)
+    up = weighted_einsum("...d,df->...f", x, lp["up"]["w"], quant_kernel=ik,
+                         int8_native=i8)
     return weighted_einsum(
         "...f,fd->...d",
         _act(gate.astype(jnp.float32), spec).astype(x.dtype) * up,
         lp["down"]["w"],
         quant_kernel=ik,
+        int8_native=i8,
     )
 
 
@@ -231,6 +236,10 @@ def _logits(params: Params, spec: ModelSpec, x: jnp.ndarray) -> jnp.ndarray:
             preferred_element_type=jnp.float32,
         )
     else:
+        # int8_native deliberately NOT applied to the lm_head: per-token
+        # activation quantization error (~1% of logit absmax) can flip
+        # the argmax between near-tied top logits under greedy decoding,
+        # so the logits GEMM keeps the dequant path (W8A8 convention).
         logits = weighted_einsum(
             "...d,dv->...v", x, params["lm_head"],
             preferred_element_type=jnp.float32,
@@ -468,7 +477,8 @@ def _finish_layer(h, attn, lp, spec: ModelSpec):
     attn = attn.reshape(*h.shape[:-1], spec.q_dim)
     uo = spec.unit_offset_norm
     attn_out = weighted_einsum(
-        "...h,hd->...d", attn, lp["o"]["w"], quant_kernel=spec.quant_kernel
+        "...h,hd->...d", attn, lp["o"]["w"], quant_kernel=spec.quant_kernel,
+        int8_native=spec.int8_native,
     )
     if spec.ffn_sandwich:
         attn_out = rms_norm(attn_out, lp["post_norm"], spec.rms_eps, uo)

@@ -60,6 +60,12 @@ class ModelSpec:
     # meshes in one process get separate compile caches instead of
     # fighting over a module global.
     quant_kernel: bool = False
+    # W8A8/W4A8 (tpu.int8_native): dynamically quantize activations
+    # per-token and run the projection GEMMs on the MXU's native
+    # s8 x s8 -> s32 path (ops/quant.py int8_native_einsum).  Pure jnp —
+    # auto-partitions under any mesh, no Pallas/Mosaic involvement.
+    # Threaded per-engine like quant_kernel.
+    int8_native: bool = False
     # >1: decode attention serves this many slots per Pallas program
     # (paged_attention.py _blocked_kernel) — cuts grid steps B/BS x and
     # per-program overhead; opt-in via tpu.decode_block_slots until the

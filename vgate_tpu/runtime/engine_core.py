@@ -695,6 +695,9 @@ class EngineCore:
                     int(self.mesh.shape.get(a, 1)) == 1
                     for a in ("tp", "pp", "sp", "ep")
                 ),
+                # W8A8/W4A8 native-int8 GEMMs: pure jnp, so no mesh or
+                # Pallas restriction (auto-partitions under jit sharding)
+                int8_native=bool(getattr(tpu_cfg, "int8_native", False)),
             )
         self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
         self._wakeup = threading.Event()
