@@ -1,0 +1,85 @@
+"""Collect benchmarks/r5_raw/*.jsonl into a RESULTS_r5.md skeleton.
+
+Each tag's JSON rows are copied verbatim (driver format, `ts`-stamped by
+bench.py since r5) under a section header, with the session log's
+start/end/rc lines for provenance.  Run after scripts/r5_session.sh
+completes; the builder then annotates the interesting rows by hand.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+RAW = os.path.join(os.path.dirname(__file__), "..", "benchmarks", "r5_raw")
+LOG = "/tmp/r5_session.log"
+OUT = os.path.join(
+    os.path.dirname(__file__), "..", "benchmarks", "RESULTS_r5.md"
+)
+
+# session order (matches scripts/r5_session.sh)
+ORDER = [
+    "headline", "blocked4", "blocked8", "blocked16", "chunkpages16",
+    "chunk128", "ablate", "7b_int8", "ctx8k", "poisson25", "poisson40",
+    "spec", "prefix", "kernels", "int8_jnp", "int4_jnp", "int8_native",
+    "int4_native", "7b_int8_native", "kernelprobe",
+]
+
+
+def main():
+    stamps = {}
+    if os.path.exists(LOG):
+        for line in open(LOG):
+            m = re.match(r"### (\S+) (start|rc=(-?\d+) end) (\S+)", line)
+            if m:
+                tag = m.group(1)
+                stamps.setdefault(tag, []).append(line.strip())
+    lines = [
+        "# Round-5 measured results (one TPU v5e chip via axon tunnel)",
+        "",
+        "Raw per-tag rows harvested from benchmarks/r5_raw/ "
+        "(scripts/harvest_r5.py); all JSON lines are verbatim bench "
+        "output.",
+        "",
+    ]
+    seen = set()
+    written = 0
+    tags = [t for t in ORDER] + sorted(
+        os.path.basename(p)[:-6]
+        for p in glob.glob(os.path.join(RAW, "*.jsonl"))
+    )
+    for tag in tags:
+        if tag in seen:
+            continue
+        seen.add(tag)
+        path = os.path.join(RAW, f"{tag}.jsonl")
+        if not os.path.exists(path):
+            continue
+        written += 1
+        body = open(path).read().strip()
+        lines.append(f"## {tag}")
+        lines.append("")
+        for s in stamps.get(tag, []):
+            lines.append(f"<!-- {s} -->")
+        if body:
+            for row in body.splitlines():
+                row = row.strip()
+                if not row:
+                    continue
+                try:
+                    json.loads(row)
+                    lines.append(row)
+                except ValueError:
+                    lines.append(f"    {row}")
+        else:
+            lines.append("(no output)")
+        lines.append("")
+    with open(OUT, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {OUT} ({written} sections)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

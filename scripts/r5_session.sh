@@ -36,6 +36,7 @@ run blocked4  VGT_TPU__DECODE_BLOCK_SLOTS=4  VGT_BENCH_PAGE=32
 run blocked8  VGT_TPU__DECODE_BLOCK_SLOTS=8  VGT_BENCH_PAGE=32
 run blocked16 VGT_TPU__DECODE_BLOCK_SLOTS=16 VGT_BENCH_PAGE=32
 run chunkpages16 VGT_CHUNK_PAGES=16 VGT_BENCH_PAGE=32
+run chunk128 VGT_BENCH_CHUNK=128 VGT_BENCH_PAGE=32
 # 3. component ablation rows (readback timing) guide any follow-up
 aux ablate benchmarks/bench_decode_ablate.py
 # 4. north star: Qwen2.5-7B int8 on one chip (host-staged load, jnp
