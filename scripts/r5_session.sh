@@ -2,10 +2,15 @@
 # Round-5 measurement session: the staged r4 list (VERDICT r4 next-2)
 # plus the decode-roofline A/B grid (next-3) and TPU speculative rows
 # (next-6).  Serialized, kill-free (memory: tpu-grant-discipline —
-# nothing here ever kills a device process).  Quantized runs ride the
-# jnp dequant path: tpu.quant_kernel now DEFAULTS OFF (r5); the fused-
-# kernel compile probe is gated behind RUN_KERNELPROBE=1 because a
-# Mosaic hang would hold the chip with no kill-free recovery.
+# nothing here ever kills a device process).
+#
+# RISK ORDERING: every config whose kernels are hardware-proven runs
+# FIRST, so the verdict-retiring rows (headline, 7B, ctx8k, Poisson)
+# are banked before anything that could hang Mosaic.  The blocked-
+# decode kernel (new Pallas variant, never hardware-compiled) runs
+# near the END behind a wall-clock-budgeted compile probe; the fused
+# quant kernel probe is opt-in only (RUN_KERNELPROBE=1).  Quantized
+# runs ride the jnp dequant or native-int8 paths (no Mosaic).
 cd /root/repo
 log=/tmp/r5_session.log
 raw=benchmarks/r5_raw
@@ -27,39 +32,36 @@ aux() {
   sleep 20
 }
 
+# ---- tier 1: hardware-proven kernels only --------------------------
 # 1. headline confirm at r4 defaults (page 32, carry off, argmax fast
 #    path): the driver-format row the round is judged on
 run headline VGT_BENCH_PAGE=32
-# 2. decode-roofline chase (VERDICT next-3): multi-slot blocked decode
-#    kernel grid + DMA chunk width at the serving shape
-run blocked4  VGT_TPU__DECODE_BLOCK_SLOTS=4  VGT_BENCH_PAGE=32
-run blocked8  VGT_TPU__DECODE_BLOCK_SLOTS=8  VGT_BENCH_PAGE=32
-run blocked16 VGT_TPU__DECODE_BLOCK_SLOTS=16 VGT_BENCH_PAGE=32
-run chunkpages16 VGT_CHUNK_PAGES=16 VGT_BENCH_PAGE=32
-run chunk128 VGT_BENCH_CHUNK=128 VGT_BENCH_PAGE=32
-# 3. component ablation rows (readback timing) guide any follow-up
-aux ablate benchmarks/bench_decode_ablate.py
-# 4. north star: Qwen2.5-7B int8 on one chip (host-staged load, jnp
-#    dequant — VERDICT missing-2)
+# 2. north star: Qwen2.5-7B int8 on one chip (jnp dequant path —
+#    VERDICT missing-2)
 run 7b_int8 VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct VGT_BENCH_QUANT=int8 \
     VGT_TPU__QUANT_KERNEL=false \
     VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
-# 5. long context >= 8k with chunked prefill (VERDICT missing-4)
+# 3. long context >= 8k with chunked prefill (VERDICT missing-4)
 run ctx8k VGT_BENCH_CTX=8192 VGT_BENCH_PROMPT=7900 VGT_BENCH_MAXTOK=128 \
     VGT_BENCH_REQUESTS=8 VGT_BENCH_SLOTS=8 VGT_BENCH_PREFILL_BATCH=1 \
     VGT_BENCH_PAGE=32
-# 6. TTFT under Poisson arrivals, below/above the service knee
+# 4. TTFT under Poisson arrivals, below/above the service knee
 #    (VERDICT missing-5)
 run poisson25 VGT_BENCH_RATE=25 VGT_BENCH_PAGE=32
 run poisson40 VGT_BENCH_RATE=40 VGT_BENCH_PAGE=32
-# 7. speculative decoding on device, k in {0,4,8} (VERDICT next-6)
-aux spec benchmarks/bench_speculative.py VGT_SPEC_KS=4,8
-# 8. shared-prefix TTFT + kernel microbench
+# 5. same-kernel parameter A/Bs (DMA chunk width, decode chunk length)
+run chunkpages16 VGT_CHUNK_PAGES=16 VGT_BENCH_PAGE=32
+run chunk128 VGT_BENCH_CHUNK=128 VGT_BENCH_PAGE=32
+# 6. component ablation rows (readback timing)
+aux ablate benchmarks/bench_decode_ablate.py
+# 7. shared-prefix TTFT + speculative (multitok verify kernel's first
+#    hardware contact is inside these; they run after the core rows)
 aux prefix benchmarks/bench_prefix.py
+aux spec benchmarks/bench_speculative.py VGT_SPEC_KS=4,8
 aux kernels benchmarks/bench_kernels.py
-# 9. quant delta vs bf16: jnp dequant path AND the new W8A8/W4A8
-#    native s8xs8->s32 MXU path (r5, ops/quant.py int8_native_einsum —
-#    no Pallas involved, cannot hang)  (VERDICT next-4/5)
+# 8. quant delta vs bf16: jnp dequant AND the W8A8/W4A8 native
+#    s8xs8->s32 MXU path (r5, ops/quant.py int8_native_einsum — pure
+#    jnp, no Mosaic)  (VERDICT next-4/5)
 run int8_jnp VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false \
     VGT_BENCH_PAGE=32
 run int4_jnp VGT_BENCH_QUANT=int4 VGT_TPU__QUANT_KERNEL=false \
@@ -68,14 +70,44 @@ run int8_native VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false \
     VGT_TPU__INT8_NATIVE=true VGT_BENCH_PAGE=32
 run int4_native VGT_BENCH_QUANT=int4 VGT_TPU__QUANT_KERNEL=false \
     VGT_TPU__INT8_NATIVE=true VGT_BENCH_PAGE=32
-# 9b. flagship on the native path (the likely 7B winner)
+# 9. flagship on the native path (the likely 7B winner)
 run 7b_int8_native VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct \
     VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false \
     VGT_TPU__INT8_NATIVE=true \
     VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
-# 10. OPT-IN ONLY: fused-kernel compile probe.  A Mosaic hang holds the
-#     chip and the only recovery (kill) wedges the grant for hours —
-#     run manually, early in a healthy window, never near round end.
+
+# ---- tier 2: new Pallas variant (Mosaic risk) ----------------------
+# 10. blocked-decode kernel compile probe, detached with a wall-clock
+#     budget: if Mosaic hangs (r4's quant-kernel failure mode), we do
+#     NOT kill it (kill = wedged grant) — we record the hang and skip
+#     the blocked grid; anything queued behind a truly hung process
+#     would stall anyway, and the core rows are already banked.
+echo "### blockedprobe start $(date -u +%H:%M:%S)" >> "$log"
+setsid nohup python benchmarks/probe_blocked_kernel.py \
+    > "$raw/blockedprobe.jsonl" 2>/tmp/r5_blockedprobe.err < /dev/null &
+probe_pid=$!
+probe_ok=0
+for i in $(seq 1 60); do   # 10-minute budget, 10 s resolution
+  if ! kill -0 "$probe_pid" 2>/dev/null; then
+    grep -q '"ok": true' "$raw/blockedprobe.jsonl" && probe_ok=1
+    break
+  fi
+  sleep 10
+done
+echo "### blockedprobe ok=$probe_ok end $(date -u +%H:%M:%S)" >> "$log"
+if [ "$probe_ok" = "1" ]; then
+  run blocked4  VGT_TPU__DECODE_BLOCK_SLOTS=4  VGT_BENCH_PAGE=32
+  run blocked8  VGT_TPU__DECODE_BLOCK_SLOTS=8  VGT_BENCH_PAGE=32
+  run blocked16 VGT_TPU__DECODE_BLOCK_SLOTS=16 VGT_BENCH_PAGE=32
+else
+  echo "### blocked grid SKIPPED (probe hung or failed; see " \
+       "/tmp/r5_blockedprobe.err — do not kill pid $probe_pid)" >> "$log"
+fi
+
+# ---- tier 3: opt-in diagnostics ------------------------------------
+# 11. fused-quant-kernel compile probe.  A Mosaic hang holds the chip
+#     and the only recovery (kill) wedges the grant for hours — run
+#     manually, early in a healthy window, never near round end.
 if [ "${RUN_KERNELPROBE:-0}" = "1" ]; then
   echo "### kernelprobe start $(date -u +%H:%M:%S)" >> "$log"
   python - > "$raw/kernelprobe.jsonl" 2>/tmp/r5_kernelprobe.err <<'EOF'
