@@ -99,6 +99,8 @@ if [ "$probe_ok" = "1" ]; then
   run blocked4  VGT_TPU__DECODE_BLOCK_SLOTS=4  VGT_BENCH_PAGE=32
   run blocked8  VGT_TPU__DECODE_BLOCK_SLOTS=8  VGT_BENCH_PAGE=32
   run blocked16 VGT_TPU__DECODE_BLOCK_SLOTS=16 VGT_BENCH_PAGE=32
+  run blocked8_cp16 VGT_TPU__DECODE_BLOCK_SLOTS=8 VGT_CHUNK_PAGES=16 \
+      VGT_BENCH_PAGE=32
 else
   echo "### blocked grid SKIPPED (probe hung or failed; see " \
        "/tmp/r5_blockedprobe.err — do not kill pid $probe_pid)" >> "$log"
