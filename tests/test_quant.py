@@ -360,3 +360,80 @@ def test_int8_native_engine_end_to_end():
         assert seq.num_output_tokens == 6
     finally:
         core.stop()
+
+
+def test_int4_native_engine_end_to_end():
+    """W4A8: an int4-quantized engine with tpu.int8_native serves tokens
+    (nibble planes contract as int8 on the native path; packed bytes in
+    HBM)."""
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.config import load_config
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    config = load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+            "quantization": "int4",
+        },
+        tpu={"dp": 1, "tp": 1, "ep": 1, "sp": 1, "int8_native": True,
+             "kv_num_pages": 64, "kv_page_size": 4, "max_batch_slots": 2,
+             "prefill_buckets": [16]},
+        logging={"level": "WARNING"},
+    )
+    core = EngineCore(config, devices=jax.devices()[:1])
+    assert core.spec.int8_native
+    core.start()
+    try:
+        [result] = core.generate(
+            ["w4a8 probe"], [SamplingParams(max_tokens=4, temperature=0.0)]
+        )
+        assert result["num_tokens"] >= 1
+        assert str(core.params["layers"]["q"]["w"].q_packed.dtype) == "uint8"
+    finally:
+        core.stop()
+
+
+def test_int8_native_sp_engine_end_to_end():
+    """int8_native under an sp=2 mesh: the native-path GEMMs are pure
+    jnp and must auto-partition through the ring-prefill / sp-decode
+    programs (the claim config.py makes for tpu.int8_native)."""
+    import jax as _jax
+
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.config import load_config
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    if _jax.device_count() < 2:
+        pytest.skip("needs >=2 devices")
+    config = load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+            "quantization": "int8",
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 2,
+            "num_devices": 2, "int8_native": True,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 2, "prefill_buckets": [16, 32],
+            "use_pallas": False,
+        },
+        logging={"level": "WARNING"},
+    )
+    core = EngineCore(config, devices=_jax.devices()[:2])
+    assert core.spec.int8_native
+    core.start()
+    try:
+        long_prompt = " ".join(["w8a8"] * 24)
+        [r] = core.generate(
+            [long_prompt], [SamplingParams(max_tokens=6, temperature=0.0)]
+        )
+        assert r["num_tokens"] >= 1
+        assert core.get_stats()["mesh"]["sp"] == 2
+    finally:
+        core.stop()
