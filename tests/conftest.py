@@ -64,6 +64,27 @@ def pytest_collection_modifyitems(config, items):
         item.add_marker(getattr(pytest.mark, tier))
 
 
+_last_module = [None]
+
+
+@pytest.fixture(autouse=True)
+def _clear_jax_caches_per_file(request):
+    """Clear jax's pjit/compile caches at test-FILE boundaries.
+
+    A single-process full-suite run accumulates ~350 tests' worth of
+    compiled executables; twice (r5) the XLA CPU compiler segfaulted in
+    backend_compile_and_load near the END of such runs (test_speculative,
+    after ~340 prior compiles) while every file passes in isolation.
+    Bounding cache growth at file granularity keeps one-invocation runs
+    viable; per-file recompiles cost little since files rarely share
+    program shapes."""
+    mod = request.module.__name__
+    if _last_module[0] not in (None, mod):
+        jax.clear_caches()
+    _last_module[0] = mod
+    yield
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run coroutine test functions on a fresh event loop."""
     fn = pyfuncitem.obj
