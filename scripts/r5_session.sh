@@ -15,8 +15,20 @@ cd /root/repo
 log=/tmp/r5_session.log
 raw=benchmarks/r5_raw
 mkdir -p "$raw"
+# HARD LAUNCH CUTOFF: after this instant no NEW bench starts — the
+# round's driver bench needs exclusive chip access at round end
+# (~04:57 UTC Aug 1), and a heal landing late (r4 healed 03:47) must
+# not leave the driver queueing behind this session.  Override with
+# R5_CUTOFF_EPOCH for a different round window.
+CUTOFF=${R5_CUTOFF_EPOCH:-$(date -u -d '2026-08-01 04:05' +%s)}
+past_cutoff() {
+  [ "$(date -u +%s)" -ge "$CUTOFF" ]
+}
 run() {
   tag="$1"; shift
+  if past_cutoff; then
+    echo "### $tag SKIPPED (past driver cutoff)" >> "$log"; return
+  fi
   echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
   env "$@" python bench.py > "$raw/$tag.jsonl" 2>/tmp/r5_${tag}.err
   echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
@@ -25,6 +37,9 @@ run() {
 }
 aux() {
   tag="$1"; script="$2"; shift 2
+  if past_cutoff; then
+    echo "### $tag SKIPPED (past driver cutoff)" >> "$log"; return
+  fi
   echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
   env "$@" python "$script" > "$raw/$tag.jsonl" 2>/tmp/r5_${tag}.err
   echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
@@ -82,6 +97,12 @@ run 7b_int8_native VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct \
 #     NOT kill it (kill = wedged grant) — we record the hang and skip
 #     the blocked grid; anything queued behind a truly hung process
 #     would stall anyway, and the core rows are already banked.
+if past_cutoff; then
+  echo "### blockedprobe + grid SKIPPED (past driver cutoff)" >> "$log"
+  echo "### R5 SESSION DONE (cutoff) $(date -u +%H:%M:%S)" >> "$log"
+  touch /tmp/r5_session_done
+  exit 0
+fi
 echo "### blockedprobe start $(date -u +%H:%M:%S)" >> "$log"
 setsid nohup python benchmarks/probe_blocked_kernel.py \
     > "$raw/blockedprobe.jsonl" 2>/tmp/r5_blockedprobe.err < /dev/null &
