@@ -35,8 +35,19 @@ def main():
             if m:
                 tag = m.group(1)
                 stamps.setdefault(tag, []).append(line.strip())
-    lines = [
+    MARKER = "<!-- harvested rows below; edits above survive re-runs -->"
+    prefix = [
         "# Round-5 measured results (one TPU v5e chip via axon tunnel)",
+        "",
+    ]
+    if os.path.exists(OUT):
+        # preserve hand-written content (grant timeline, analysis):
+        # everything above the marker survives a re-harvest
+        body = open(OUT).read()
+        prefix = body.split(MARKER)[0].rstrip("\n").splitlines()
+    lines = prefix + [
+        "",
+        MARKER,
         "",
         "Raw per-tag rows harvested from benchmarks/r5_raw/ "
         "(scripts/harvest_r5.py); all JSON lines are verbatim bench "
