@@ -463,3 +463,101 @@ def test_builtin_drafter_proposes_through_engine():
         assert core.total_spec_drafted > 0
     finally:
         core.stop()
+
+
+# ------------------------------------------------- draft-model drafting
+
+def test_draft_model_drafter_standalone():
+    """DraftModelDrafter proposes k in-vocab tokens from a windowed
+    greedy scan of its own (tiny) model."""
+    from vgate_tpu.runtime.speculative import DraftModelDrafter
+
+    d = DraftModelDrafter(
+        "tiny-dense", k_max=4, dtype=jnp.float32, window=32
+    )
+
+    class _Seq:
+        prompt_ids = [5, 9, 13]
+        output_ids = [21]
+
+    toks = d.draft_for(_Seq(), 4)
+    assert len(toks) == 4
+    assert all(0 <= t < d.spec.vocab_size for t in toks)
+    assert d.draft_for(_Seq(), 0) == []
+    # k below k_max slices the same compiled program's output
+    assert d.draft_for(_Seq(), 2) == toks[:2]
+
+
+def test_draft_model_engine_matches_plain_and_accepts():
+    """A same-architecture, same-seed drafter IS the target model: greedy
+    output must stay token-identical to the plain engine (the verify
+    invariant) and acceptance must be high (the drafter's windowed
+    forward equals the target's for sequences shorter than the window).
+    """
+    prompts = ["one two three four", "zzz"]
+    n = 12
+    plain = EngineCore(spec_config(k=0), devices=jax.devices()[:1])
+    plain.start()
+    try:
+        base = plain.generate(prompts, [greedy(n)] * 2)
+    finally:
+        plain.stop()
+
+    cfg = load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+            "draft_model_id": "tiny-dense",
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 4, "prefill_buckets": [8, 16],
+            "use_pallas": False,
+            "speculative_k": 3, "draft_window": 32,
+        },
+        scheduler={"max_queue_size": 16},
+        logging={"level": "WARNING"},
+    )
+    core = EngineCore(cfg, devices=jax.devices()[:1])
+    assert core.draft_model is not None
+    core.start()
+    try:
+        got = core.generate(prompts, [greedy(n)] * 2)
+        stats = core.get_stats()
+    finally:
+        core.stop()
+    for b, g in zip(base, got):
+        assert b["token_ids"] == g["token_ids"]
+    spec_stats = stats["speculative"]
+    assert spec_stats["drafter"] == "draft-model:tiny-dense"
+    assert spec_stats["drafted"] > 0
+    assert spec_stats["acceptance_rate"] > 0.6, spec_stats
+
+
+def test_draft_model_falls_back_to_ngram_on_mesh():
+    """Model-parallel meshes keep n-gram drafting (the drafter is a
+    single-device program); the engine must not crash, just warn."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >=2 devices")
+    cfg = load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+            "draft_model_id": "tiny-dense",
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 2, "num_devices": 2,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 4, "prefill_buckets": [8, 16],
+            "use_pallas": False, "speculative_k": 2,
+        },
+        logging={"level": "ERROR"},
+    )
+    core = EngineCore(cfg, devices=jax.devices()[:2])
+    assert core.draft_model is None
+    assert core.drafter == core._ngram_drafter

@@ -89,6 +89,13 @@ class ModelConfig(BaseModel):
     max_model_len: int = 2048
     embedding_model_id: str = "BAAI/bge-base-en-v1.5"
     embedding_checkpoint_path: Optional[str] = None
+    # Speculative decoding with a draft MODEL (tpu.speculative_k > 0):
+    # a second, smaller registered model proposes tokens each round
+    # (runtime/speculative.py DraftModelDrafter) instead of prompt
+    # lookup.  Same tokenizer family as model_id (e.g. Qwen2.5-0.5B
+    # drafting for 1.5B/7B); None keeps n-gram drafting.
+    draft_model_id: Optional[str] = None
+    draft_checkpoint_path: Optional[str] = None
 
     @field_validator("engine_type")
     @classmethod
@@ -240,6 +247,10 @@ class TPUConfig(BaseModel):
     speculative_k: int = 0
     # Match length for the prompt-lookup drafter.
     speculative_ngram: int = 2
+    # Token window the draft MODEL sees (model.draft_model_id): each
+    # draft round recomputes this suffix window, so it bounds the
+    # drafter's cost and its context.
+    draft_window: int = 128
 
 
 class BatchConfig(BaseModel):

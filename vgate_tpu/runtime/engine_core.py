@@ -572,6 +572,35 @@ class EngineCore:
         self.drafter: Callable[[Sequence, int], List[int]] = (
             self._ngram_drafter
         )
+        # model.draft_model_id upgrades drafting from prompt-lookup to a
+        # small draft MODEL (runtime/speculative.py DraftModelDrafter).
+        # Plain meshes only: the drafter is a second single-device
+        # program; model-parallel engines keep n-gram drafting.
+        self.draft_model = None
+        draft_id = self.config.model.draft_model_id
+        if self.spec_k > 0 and draft_id:
+            if all(
+                int(self.mesh.shape.get(a, 1)) == 1
+                for a in ("tp", "pp", "sp", "ep")
+            ):
+                from vgate_tpu.runtime.speculative import DraftModelDrafter
+
+                self.draft_model = DraftModelDrafter(
+                    draft_id,
+                    k_max=self.spec_k,
+                    dtype=self.dtype,
+                    window=int(tpu_cfg.draft_window),
+                    checkpoint_path=self.config.model.draft_checkpoint_path,
+                    target_vocab=self.spec.vocab_size,
+                    device=self.mesh.devices.flat[0],
+                )
+                self.drafter = self.draft_model.draft_for
+            else:
+                logger.warning(
+                    "draft_model_id ignored on a model-parallel mesh; "
+                    "using n-gram drafting",
+                    extra={"extra_data": {"draft_model_id": draft_id}},
+                )
         self.total_spec_drafted = 0
         self.total_spec_accepted = 0
         # device-resident penalty histogram for speculative mode, keyed
@@ -2103,6 +2132,19 @@ class EngineCore:
                 {
                     "speculative": {
                         "k": self.spec_k,
+                        "drafter": (
+                            f"draft-model:{self.draft_model.spec.name}"
+                            if self.draft_model is not None
+                            else f"ngram:{self.spec_ngram}"
+                        ),
+                        **(
+                            {
+                                "draft_calls":
+                                    self.draft_model.total_draft_calls
+                            }
+                            if self.draft_model is not None
+                            else {}
+                        ),
                         "drafted": self.total_spec_drafted,
                         "accepted": self.total_spec_accepted,
                         "acceptance_rate": round(

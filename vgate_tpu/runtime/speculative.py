@@ -36,8 +36,12 @@ Distribution-exact for every request:
 
 from __future__ import annotations
 
-from typing import List, Sequence
+import functools
+from typing import List, Optional, Sequence
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 
@@ -112,3 +116,143 @@ def count_accepted(
     # cumprod turns the boolean run into 1,1,...,1,0,0 — its sum is the
     # length of the accepted prefix
     return jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+
+# ---------------------------------------------------- draft-model drafting
+
+
+def _draft_scan(
+    params, window, n_valid, k_pages, v_pages, page_tables, *, spec, k_max
+):
+    """``k_max`` greedy draft steps as ONE device program.
+
+    Each step runs the drafter's full windowed prefill pass
+    (models/decoder.py prefill_forward over the [W]-token window) and
+    appends its argmax; once the window is full it shifts left.  The KV
+    pool is a scratch the pass overwrites every step — the drafter
+    manages no cache, it recomputes the (small, fixed) window.  RoPE
+    positions are window-relative, not sequence-absolute: acceptable
+    for a DRAFTER, whose only job is proposing likely continuations
+    (the target's verify pass uses true absolute positions).
+    """
+    from vgate_tpu.models.decoder import prefill_forward
+
+    W = window.shape[0]
+
+    def step(carry, _):
+        win, n, kp, vp = carry
+        logits, kp, vp = prefill_forward(
+            params, spec, win[None], n[None], kp, vp, page_tables
+        )
+        t = jnp.argmax(logits[0]).astype(jnp.int32)
+        full = n >= W
+        win = jnp.where(
+            full,
+            jnp.concatenate([win[1:], t[None]]),
+            jax.lax.dynamic_update_index_in_dim(
+                win, t, jnp.minimum(n, W - 1), 0
+            ),
+        )
+        return (win, jnp.minimum(n + 1, W), kp, vp), t
+
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (window, n_valid, k_pages, v_pages), None, length=k_max
+    )
+    return toks
+
+
+class DraftModelDrafter:
+    """Greedy draft-model drafting (the step beyond prompt-lookup).
+
+    A second, small registered model proposes up to ``k_max`` tokens per
+    round from a fixed ``window``-token suffix of the sequence.  One
+    jitted ``lax.scan`` dispatches all steps (one device round-trip per
+    draft call); the drafter holds a tiny scratch KV pool and recomputes
+    the window each step instead of managing a paged cache.
+
+    Correctness does not depend on the drafter: the engine's verify
+    round (engine_core._tick_speculative + ops/sampling.verify_and_sample)
+    accepts exactly the distribution-correct prefix of ANY proposal, so
+    a weak or mismatched drafter only lowers the acceptance rate.  The
+    cost model: a draft round re-reads the drafter's weights k_max
+    times, so the drafter should be several times smaller than the
+    target (e.g. Qwen2.5-0.5B drafting for 1.5B/7B — same tokenizer
+    family; drafted ids outside the target vocab are dropped).
+
+    Known limit: the engine's drafter seam is per-sequence, so a round
+    with B active sequences dispatches B sequential draft scans before
+    the one batched verify — draft latency scales with B.  Acceptable
+    because speculation's home turf is single-stream (B~1) latency;
+    batching the seam into one [B, W] scan is the optimization to reach
+    for if multi-stream speculative serving ever becomes a target.
+
+    Plain (single-device) meshes only — the engine falls back to n-gram
+    drafting on model-parallel meshes (engine_core.__init__).
+    """
+
+    def __init__(
+        self,
+        model_id: str,
+        k_max: int,
+        dtype=jnp.bfloat16,
+        window: int = 128,
+        checkpoint_path: Optional[str] = None,
+        target_vocab: Optional[int] = None,
+        device=None,
+    ) -> None:
+        from vgate_tpu.models.specs import spec_for_model_id
+        from vgate_tpu.runtime.weights import load_or_init_params
+        from vgate_tpu.utils.math import round_up
+
+        self.spec = spec_for_model_id(model_id)
+        self.k_max = max(1, int(k_max))
+        ps = 8  # internal scratch-pool page size
+        self.window = round_up(max(ps, int(window)), ps)
+        self.target_vocab = int(target_vocab or self.spec.vocab_size)
+        params = load_or_init_params(self.spec, checkpoint_path, dtype)
+        if device is not None:
+            params = jax.device_put(params, device)
+        self.params = params
+        n_pages = 1 + self.window // ps
+        kv_shape = (
+            self.spec.num_layers, self.spec.num_kv_heads, n_pages, ps,
+            self.spec.head_dim,
+        )
+        self._kv_dtype = dtype
+        self._k_scratch = jnp.zeros(kv_shape, dtype)
+        self._v_scratch = jnp.zeros(kv_shape, dtype)
+        self._page_tables = jnp.arange(
+            1, 1 + self.window // ps, dtype=jnp.int32
+        )[None, :]
+        self._fn = jax.jit(
+            functools.partial(
+                _draft_scan, spec=self.spec, k_max=self.k_max
+            )
+        )
+        self.total_draft_calls = 0
+
+    def draft_for(self, seq, k: int) -> List[int]:
+        """The engine drafter seam (Callable[[Sequence, int], List[int]])."""
+        k = min(int(k), self.k_max)
+        if k <= 0:
+            return []
+        ids = (seq.prompt_ids + seq.output_ids)[-self.window:]
+        win = np.zeros((self.window,), np.int32)
+        win[: len(ids)] = ids
+        toks = np.asarray(
+            self._fn(
+                self.params,
+                jnp.asarray(win),
+                jnp.asarray(len(ids), jnp.int32),
+                self._k_scratch,
+                self._v_scratch,
+                self._page_tables,
+            )
+        )
+        self.total_draft_calls += 1
+        out: List[int] = []
+        for t in toks[:k].tolist():
+            if not 0 <= int(t) < self.target_vocab:
+                break  # drafter/target vocab mismatch: stop proposing
+            out.append(int(t))
+        return out
