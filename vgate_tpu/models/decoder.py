@@ -139,12 +139,23 @@ def _dense_mlp(x, lp, spec: ModelSpec):
     )
 
 
-def _expert_einsum(subscripts, x, w):
+def _expert_einsum(subscripts, x, w, int8_native=False):
     """Per-expert einsum accepting plain or quantized expert weights
     (QTensor scale is per (expert, out-channel): [E, out] broadcasts as
-    [E, 1, out] against the [E, C, out] einsum result)."""
-    from vgate_tpu.ops.quant import PackedQTensor, QTensor, packed_einsum
+    [E, 1, out] against the [E, C, out] einsum result).  With
+    ``int8_native`` (tpu.int8_native) the expert GEMMs run the native
+    s8 x s8 -> s32 MXU path with per-(expert, token-row) activation
+    quantization (ops/quant.py int8_native_partial)."""
+    from vgate_tpu.ops.quant import (
+        PackedQTensor,
+        QTensor,
+        int8_native_partial,
+        packed_einsum,
+    )
 
+    if int8_native and isinstance(w, (QTensor, PackedQTensor)):
+        out = int8_native_partial(subscripts, x, w)
+        return (out * w.scale[:, None, :]).astype(x.dtype)
     if isinstance(w, PackedQTensor):
         out = packed_einsum(subscripts, x, w)
         return out * w.scale[:, None, :].astype(x.dtype)
@@ -204,10 +215,17 @@ def _moe_mlp(x, lp, spec: ModelSpec, capacity_factor: float = 2.0):
         xt[sorted_token]
     )
     expert_in = buf[:, :capacity]  # [E, C, D]
-    gate_h = _expert_einsum("ecd,edf->ecf", expert_in, lp["gate"]["w"])
-    up_h = _expert_einsum("ecd,edf->ecf", expert_in, lp["up"]["w"])
+    i8 = spec.int8_native
+    gate_h = _expert_einsum(
+        "ecd,edf->ecf", expert_in, lp["gate"]["w"], int8_native=i8
+    )
+    up_h = _expert_einsum(
+        "ecd,edf->ecf", expert_in, lp["up"]["w"], int8_native=i8
+    )
     act = _act(gate_h.astype(jnp.float32), spec).astype(xt.dtype) * up_h
-    expert_out = _expert_einsum("ecf,efd->ecd", act, lp["down"]["w"])
+    expert_out = _expert_einsum(
+        "ecf,efd->ecd", act, lp["down"]["w"], int8_native=i8
+    )
 
     contrib = expert_out[sorted_expert, jnp.minimum(pos, capacity - 1)]
     contrib = jnp.where(within[:, None], contrib, 0)

@@ -140,21 +140,21 @@ def _quantize_activations(x: jnp.ndarray):
     return x_q, x_scale
 
 
-def int8_native_einsum(
-    subscripts: str, x: jnp.ndarray, w: Weight, out_dtype,
+def int8_native_partial(
+    subscripts: str, x: jnp.ndarray, w: Weight
 ) -> jnp.ndarray:
-    """W8A8: dynamically quantize activations per-token and contract
-    int8 x int8 with int32 accumulation — XLA lowers this to the MXU's
-    native s8 x s8 -> s32 path on v5e-class TPUs (2x bf16 matmul
-    throughput), with no dequantized weight plane ever materializing.
-    The TPU-native answer to the fused AWQ dequant-GEMM the reference
-    gets through vLLM's CUDA kernels (vgate/config.py:46): weight HBM
-    traffic is the narrow-int bytes AND the MACs run at int8 rate.
+    """W8A8 contraction WITHOUT the weight scale: dynamically quantize
+    activations per-token and contract int8 x int8 with int32
+    accumulation — XLA lowers this to the MXU's native s8 x s8 -> s32
+    path on v5e-class TPUs (2x bf16 matmul throughput), with no
+    dequantized weight plane ever materializing.
 
     Works for QTensor (one int8 GEMM) and PackedQTensor (W4A8: the two
     sign-extended nibble planes stay int8 and each contracts the
     matching activation half — two native GEMMs, packed bytes in HBM).
-    Output: ``(x @ w) * x_scale * w.scale`` cast to ``out_dtype``.
+    Returns ``(x @ w) * x_scale`` in f32; the CALLER applies ``w.scale``
+    (its broadcast shape differs between dense [out] and expert
+    [E, out] weights — the same split as ``packed_einsum``).
     """
     x_q, x_scale = _quantize_activations(x)
     if isinstance(w, PackedQTensor):
@@ -171,7 +171,18 @@ def int8_native_einsum(
         acc = jnp.einsum(
             subscripts, x_q, w.q, preferred_element_type=jnp.int32
         )
-    out = acc.astype(jnp.float32) * x_scale * w.scale
+    return acc.astype(jnp.float32) * x_scale
+
+
+def int8_native_einsum(
+    subscripts: str, x: jnp.ndarray, w: Weight, out_dtype,
+) -> jnp.ndarray:
+    """Dense-weight W8A8/W4A8: ``int8_native_partial`` with the
+    per-output-channel scale applied — the TPU-native answer to the
+    fused AWQ dequant-GEMM the reference gets through vLLM's CUDA
+    kernels (vgate/config.py:46): weight HBM traffic is the narrow-int
+    bytes AND the MACs run at int8 rate."""
+    out = int8_native_partial(subscripts, x, w) * w.scale
     return out.astype(out_dtype)
 
 
