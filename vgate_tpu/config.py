@@ -237,13 +237,15 @@ class TPUConfig(BaseModel):
     # shared across requests; a prefix hit prefills only the suffix.
     # Disabled automatically when sp>1 or pp>1 (those reshape the prefill).
     prefix_cache: bool = True
-    # Speculative decoding (prompt-lookup / n-gram self-drafting): each
-    # decode round verifies up to `speculative_k` drafted tokens in ONE
-    # forward pass, so accepted drafts cost one model read for several
-    # tokens.  Greedy-exact: only temperature==0 sequences draft (others
-    # fall back to single-token steps inside the same program).  0 = off
-    # (the default — chunked decode wins on high-RTT device links; this
-    # mode wins single-stream latency on local hardware).
+    # Speculative decoding: each decode round verifies up to
+    # `speculative_k` drafted tokens in ONE forward pass, so accepted
+    # drafts cost one model read for several tokens.  Greedy rows
+    # verify by exact argmax match; sampled rows by rejection sampling
+    # (both distribution-exact, runtime/speculative.py).  Drafts come
+    # from prompt-lookup, or from a draft MODEL when
+    # model.draft_model_id is set.  0 = off (the default — chunked
+    # decode wins on high-RTT device links; this mode wins
+    # single-stream latency on local hardware).
     speculative_k: int = 0
     # Match length for the prompt-lookup drafter.
     speculative_ngram: int = 2
