@@ -112,12 +112,19 @@ async def test_in_batch_dedup():
     await batcher.start()
     try:
         results = await asyncio.gather(
-            *[batcher.submit("same prompt") for _ in range(3)]
+            *[
+                batcher.submit("same prompt", request_id=f"req-{i}")
+                for i in range(3)
+            ]
         )
         assert all(r["text"] == "out:same prompt" for r in results)
         assert len(backend.calls) == 1
         assert backend.calls[0] == ["same prompt"]
         assert batcher.get_metrics()["total_deduplicated"] == 2
+        # deduped followers share the computation but keep their OWN ids
+        assert sorted(r["request_id"] for r in results) == [
+            "req-0", "req-1", "req-2",
+        ]
     finally:
         await batcher.stop()
 

@@ -288,6 +288,9 @@ class RequestBatcher:
                     if not req.future.done():
                         out = dict(payload)
                         out["cached"] = False
+                        # deduped followers share the lead's computation
+                        # but must carry their OWN request id
+                        out["request_id"] = req.request_id
                         req.future.set_result(out)
 
     async def _run_batch_inference(
