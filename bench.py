@@ -108,8 +108,7 @@ def _last_recorded_tpu_result():
             body = open(path).read()
         except OSError:
             continue
-        last = None
-        raw = None
+        rows = []
         for m in re.finditer(r"^\{.*\}", body, re.M):
             try:
                 entry = json.loads(m.group(0))
@@ -119,18 +118,26 @@ def _last_recorded_tpu_result():
                 entry.get("platform") == "tpu"
                 and entry.get("metric") == "output_tokens_per_sec_per_chip"
             ):
-                last = {
-                    k: entry[k]
-                    for k in (
-                        "value", "unit", "vs_baseline", "p50_ttft_ms",
-                        "model", "device", "ts",
-                    )
-                    if k in entry
-                }
-                last["recorded_in"] = os.path.basename(path)
-                raw = m.group(0)
-        if last is None:
+                rows.append((entry, m.group(0)))
+        if not rows:
             continue
+        # most recent by the embedded ts when any row carries one
+        # (harvested files group rows by TAG, not chronology — file
+        # order is not capture order); fall back to file order only
+        # for pre-r5 rows without timestamps
+        stamped = [r for r in rows if r[0].get("ts")]
+        entry, raw = (
+            max(stamped, key=lambda r: r[0]["ts"]) if stamped else rows[-1]
+        )
+        last = {
+            k: entry[k]
+            for k in (
+                "value", "unit", "vs_baseline", "p50_ttft_ms",
+                "model", "device", "ts",
+            )
+            if k in entry
+        }
+        last["recorded_in"] = os.path.basename(path)
         if "ts" not in last:
             # the row carries no timestamp (pre-r5 rows): date it by the
             # commit that INTRODUCED the line (oldest -S hit), not the

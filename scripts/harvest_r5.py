@@ -42,9 +42,20 @@ def main():
     ]
     if os.path.exists(OUT):
         # preserve hand-written content (grant timeline, analysis):
-        # everything above the marker survives a re-harvest
+        # everything above the marker survives a re-harvest.  If the
+        # marker was edited away, drop any bare JSON rows from the
+        # preserved prose — otherwise every re-run would duplicate the
+        # previously harvested rows (and bench.py's fallback parser
+        # would scan the stale duplicates).
         body = open(OUT).read()
         prefix = body.split(MARKER)[0].rstrip("\n").splitlines()
+        if MARKER not in body:
+            def _is_row(line):
+                try:
+                    return isinstance(json.loads(line), dict)
+                except ValueError:
+                    return False
+            prefix = [ln for ln in prefix if not _is_row(ln.strip())]
     lines = prefix + [
         "",
         MARKER,

@@ -24,17 +24,6 @@ CUTOFF=${R5_CUTOFF_EPOCH:-$(date -u -d '2026-08-01 04:05' +%s)}
 past_cutoff() {
   [ "$(date -u +%s)" -ge "$CUTOFF" ]
 }
-run() {
-  tag="$1"; shift
-  if past_cutoff; then
-    echo "### $tag SKIPPED (past driver cutoff)" >> "$log"; return
-  fi
-  echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
-  env "$@" python bench.py > "$raw/$tag.jsonl" 2>/tmp/r5_${tag}.err
-  echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
-  cat "$raw/$tag.jsonl" >> "$log"
-  sleep 20
-}
 aux() {
   tag="$1"; script="$2"; shift 2
   if past_cutoff; then
@@ -45,6 +34,10 @@ aux() {
   echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
   cat "$raw/$tag.jsonl" >> "$log"
   sleep 20
+}
+run() {
+  tag="$1"; shift
+  aux "$tag" bench.py "$@"
 }
 
 # ---- tier 1: hardware-proven kernels only --------------------------

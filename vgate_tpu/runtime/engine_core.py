@@ -578,6 +578,12 @@ class EngineCore:
         # program; model-parallel engines keep n-gram drafting.
         self.draft_model = None
         draft_id = self.config.model.draft_model_id
+        if draft_id and self.spec_k <= 0:
+            logger.warning(
+                "model.draft_model_id has no effect with "
+                "tpu.speculative_k=0 — speculative decoding is off",
+                extra={"extra_data": {"draft_model_id": draft_id}},
+            )
         if self.spec_k > 0 and draft_id:
             if all(
                 int(self.mesh.shape.get(a, 1)) == 1
@@ -727,6 +733,11 @@ class EngineCore:
                 # W8A8/W4A8 native-int8 GEMMs: pure jnp, so no mesh or
                 # Pallas restriction (auto-partitions under jit sharding)
                 int8_native=bool(getattr(tpu_cfg, "int8_native", False)),
+            )
+        elif bool(getattr(tpu_cfg, "int8_native", False)):
+            logger.warning(
+                "tpu.int8_native has no effect without model.quantization "
+                "(int8 or int4) — serving stays on the plain dtype path"
             )
         self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
         self._wakeup = threading.Event()
