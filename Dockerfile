@@ -19,7 +19,7 @@ RUN pip install --no-cache-dir "jax[tpu]" \
 ENV VGT_MODEL__ENGINE_TYPE=jax_tpu
 EXPOSE 8000
 HEALTHCHECK --interval=30s --timeout=5s --start-period=300s --retries=3 \
-    CMD python -c "import urllib.request; urllib.request.urlopen('http://localhost:8000/health', timeout=4)"
+    CMD python -c "import urllib.request; urllib.request.urlopen('http://localhost:8000/health/live', timeout=4)"
 CMD ["python", "main.py"]
 
 # ---- CPU / dry-run target ----
@@ -28,5 +28,5 @@ RUN pip install --no-cache-dir jax
 ENV VGT_DRY_RUN=true
 EXPOSE 8000
 HEALTHCHECK --interval=30s --timeout=5s --start-period=30s --retries=3 \
-    CMD python -c "import urllib.request; urllib.request.urlopen('http://localhost:8000/health', timeout=4)"
+    CMD python -c "import urllib.request; urllib.request.urlopen('http://localhost:8000/health/live', timeout=4)"
 CMD ["python", "main.py"]

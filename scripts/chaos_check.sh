@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Chaos/fault-injection gate: runs the deterministic fault-injection
+# suite plus the chaos-marked randomized test, with env-armed injections
+# layered on top so the env parsing path (faults.arm_from_env) is also
+# exercised end to end.
+#
+# Usage:
+#   scripts/chaos_check.sh            # full run (deterministic + chaos)
+#   scripts/chaos_check.sh --fast     # registry/gateway tier only
+#
+# Knobs (see docs/operations.md "Fault-injection env knobs"):
+#   VGT_CHAOS=<p>     arm every point with per-probe probability p
+#   VGT_FAULTS=...    arm specific points, e.g.
+#                     "decode_step:raise:times=2,kv_alloc:delay:delay=0.01"
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+if [[ "${1:-}" == "--fast" ]]; then
+  exec python -m pytest tests/test_faults.py -q -p no:cacheprovider
+fi
+
+echo "== deterministic fault-injection suite =="
+python -m pytest tests/test_faults.py tests/test_recovery.py \
+  -q -p no:cacheprovider -m "not chaos"
+
+echo "== chaos-marked randomized suite =="
+python -m pytest tests/test_recovery.py \
+  -q -p no:cacheprovider -m chaos

@@ -47,6 +47,7 @@ SLOW_FILES = {
     "test_pipeline",
     "test_prefix_cache",
     "test_quant",
+    "test_recovery",
     "test_ring_attention",
     "test_sharding",
     "test_speculative",
@@ -101,15 +102,20 @@ def pytest_pyfunc_call(pyfuncitem):
 @pytest.fixture(autouse=True)
 def _reset_globals(monkeypatch):
     from vgate_tpu import config as config_mod
+    from vgate_tpu import faults
     from vgate_tpu import tracing as tracing_mod
 
     # isolate tests from the repo's sample ./config.yaml
     monkeypatch.setenv("VGT_CONFIG_PATH", "/nonexistent/vgt-test-config.yaml")
     config_mod.reset_config()
     tracing_mod.reset_tracing()
+    faults.reset()
     yield
     config_mod.reset_config()
     tracing_mod.reset_tracing()
+    # armed faults must never leak across tests (a leaked decode_step
+    # fault would crash every later engine test)
+    faults.reset()
 
 
 @pytest.fixture

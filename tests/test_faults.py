@@ -1,0 +1,390 @@
+"""Fault-injection registry + gateway health/recovery surface (fast tier:
+no engine compiles — registry unit tests, dry-run gateway tests, and
+batcher shutdown-drain tests)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vgate_tpu import faults
+from vgate_tpu.config import load_config
+from vgate_tpu.errors import (
+    EngineDeadError,
+    EngineRecoveringError,
+    PoisonRequestError,
+    RetryableError,
+)
+from vgate_tpu.server.app import create_app
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_arm_and_fire_consumes_charges():
+    spec = faults.arm("decode_step", mode="raise", times=2)
+    with pytest.raises(faults.InjectedFault):
+        faults.check("decode_step")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("decode_step")
+    faults.check("decode_step")  # charges exhausted: no-op
+    assert spec.fired == 2
+    assert spec.times == 0
+
+
+def test_unknown_point_and_mode_rejected():
+    with pytest.raises(ValueError):
+        faults.arm("not_a_point")
+    with pytest.raises(ValueError):
+        faults.arm("decode_step", mode="explode")
+    with pytest.raises(ValueError):
+        faults.arm("decode_step", kind="weird")
+
+
+def test_disarm_and_reset():
+    faults.arm("prefill", times=-1)
+    faults.disarm("prefill")
+    faults.check("prefill")  # disarmed: no-op
+    faults.arm("kv_alloc", times=-1)
+    faults.reset()
+    faults.check("kv_alloc")
+    assert faults.snapshot() == []
+
+
+def test_kind_and_fingerprint_carried():
+    faults.arm("prefill", kind="poison", times=1)
+    with pytest.raises(faults.InjectedFault) as exc_info:
+        faults.check("prefill", payload=(1, 2, 3))
+    assert exc_info.value.fault_kind == "poison"
+    assert exc_info.value.fingerprint == faults.fingerprint((1, 2, 3))
+
+
+def test_match_targets_one_payload():
+    faults.arm(
+        "prefill", times=-1, match=lambda ids: ids is not None and 666 in ids
+    )
+    faults.check("prefill", payload=(1, 2, 3))  # no match: passes
+    with pytest.raises(faults.InjectedFault):
+        faults.check("prefill", payload=(5, 666))
+
+
+def test_delay_mode_sleeps_not_raises():
+    import time
+
+    faults.arm("backend_generate", mode="delay", delay_s=0.05, times=1)
+    start = time.perf_counter()
+    faults.check("backend_generate")
+    assert time.perf_counter() - start >= 0.04
+
+
+def test_probability_seeded_deterministic():
+    spec = faults.arm(
+        "kv_alloc", mode="raise", times=-1, probability=0.5, seed=7
+    )
+    fired = 0
+    for _ in range(200):
+        try:
+            faults.check("kv_alloc")
+        except faults.InjectedFault:
+            fired += 1
+    assert spec.fired == fired
+    assert 60 <= fired <= 140  # ~p=0.5, seeded so stable
+
+
+def test_corrupt_array_scrambles_and_counts():
+    faults.arm("decode_step", mode="corrupt", times=1)
+    arr = np.arange(8, dtype=np.int32)
+    out = faults.corrupt_array("decode_step", arr)
+    assert (out == (arr ^ 0x55)).all()
+    # charge consumed: second call is a passthrough
+    again = faults.corrupt_array("decode_step", arr)
+    assert (again == arr).all()
+    # corrupt specs are invisible to check()
+    faults.arm("decode_step", mode="corrupt", times=1)
+    faults.check("decode_step")
+
+
+def test_arm_from_env_faults_and_chaos():
+    n = faults.arm_from_env(
+        {"VGT_FAULTS": "decode_step:raise:kind=poison:times=3,"
+                       "kv_alloc:delay:delay=0.01"}
+    )
+    assert n == 2
+    snap = {s["point"]: s for s in faults.snapshot()}
+    assert snap["decode_step"]["kind"] == "poison"
+    assert snap["decode_step"]["times"] == 3
+    assert snap["kv_alloc"]["mode"] == "delay"
+    faults.reset()
+    n = faults.arm_from_env({"VGT_CHAOS": "0.1"})
+    assert n == len(faults.FAULT_POINTS)
+    assert all(s["probability"] == 0.1 for s in faults.snapshot())
+
+
+def test_arm_from_env_bad_entries_ignored():
+    n = faults.arm_from_env(
+        {"VGT_FAULTS": "garbage,decode_step:raise:times=notanint,"
+                       "prefill:raise"}
+    )
+    assert n == 1  # only the well-formed entry armed
+    assert faults.snapshot()[0]["point"] == "prefill"
+
+
+def test_fingerprint_stable_and_distinct():
+    assert faults.fingerprint([1, 2, 3]) == faults.fingerprint((1, 2, 3))
+    assert faults.fingerprint([1, 2, 3]) != faults.fingerprint([1, 2, 4])
+
+
+def test_check_raises_injected_fault_for_scalar_payloads():
+    """kv_alloc probes with an int payload and weight_load with a path
+    string; a raise-mode fault there must still produce InjectedFault
+    (with its kind intact), never a fingerprint TypeError."""
+    faults.arm("kv_alloc", mode="raise", kind="unrecoverable", times=1)
+    with pytest.raises(faults.InjectedFault) as exc_info:
+        faults.check("kv_alloc", payload=5)
+    assert exc_info.value.fault_kind == "unrecoverable"
+    faults.arm("weight_load", mode="raise", times=1)
+    with pytest.raises(faults.InjectedFault):
+        faults.check("weight_load", payload="/models/ckpt")
+    assert faults.fingerprint(None) != faults.fingerprint(5)
+
+
+# ------------------------------------------------------------ error types
+
+
+def test_error_taxonomy():
+    assert isinstance(EngineRecoveringError("x"), RetryableError)
+    assert isinstance(EngineDeadError("x"), RetryableError)
+    assert EngineRecoveringError("x", retry_after=0.01).retry_after >= 1.0
+    assert EngineDeadError("x").retry_after == 30.0
+    assert not isinstance(PoisonRequestError("x"), RetryableError)
+
+
+# --------------------------------------------------------------- gateway
+
+
+async def _client(**overrides):
+    overrides.setdefault("model", {"engine_type": "dry_run"})
+    overrides.setdefault(
+        "batch", {"max_batch_size": 4, "max_wait_time_ms": 5.0}
+    )
+    overrides.setdefault("logging", {"level": "WARNING"})
+    config = load_config(**overrides)
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    return client
+
+
+async def test_health_always_reports_engine_state():
+    """Satellite: /health carries engine state + queue depth even for
+    backends without device_health (the dry-run backend has neither a
+    device nor a supervisor)."""
+    client = await _client()
+    try:
+        body = await (await client.get("/health")).json()
+        assert body["status"] == "ok"
+        assert body["engine"]["state"] == "serving"
+        assert body["engine"]["alive"] is True
+        assert "queue_depth" in body["engine"]
+        assert "batcher_pending" in body["engine"]
+    finally:
+        await client.close()
+
+
+async def test_liveness_readiness_split():
+    client = await _client()
+    try:
+        live = await client.get("/health/live")
+        ready = await client.get("/health/ready")
+        assert live.status == 200
+        assert ready.status == 200
+        # simulate the health state machine positions the supervisor
+        # drives on a real engine
+        backend = client.server.app["engine"].backend
+        backend.serving_state = lambda: "recovering"
+        ready = await client.get("/health/ready")
+        assert ready.status == 503
+        assert "Retry-After" in ready.headers
+        live = await client.get("/health/live")
+        assert live.status == 200  # recovering is alive
+        backend.serving_state = lambda: "dead"
+        assert (await client.get("/health/ready")).status == 503
+        assert (await client.get("/health/live")).status == 503
+        assert (await client.get("/health")).status == 503
+    finally:
+        await client.close()
+
+
+async def test_batcher_rejects_fast_while_recovering():
+    """Satellite + tentpole: while RECOVERING the batcher sheds at
+    admission with a retryable 503 + Retry-After instead of queuing into
+    a dead engine; quarantined prompts map to 400."""
+    client = await _client()
+    try:
+        backend = client.server.app["engine"].backend
+        backend.serving_state = lambda: "recovering"
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        body = await resp.json()
+        assert body["error"]["type"] == "overloaded_error"
+        backend.serving_state = lambda: "dead"
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        backend.serving_state = lambda: "serving"
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_cache_hit_serves_while_recovering():
+    """A cache-servable request needs no engine: the fail-fast gate sits
+    below the cache lookup, so hits keep serving through recovery."""
+    client = await _client()
+    try:
+        req = {
+            "messages": [{"role": "user", "content": "cache me"}],
+            "temperature": 0.5,
+        }
+        first = await client.post("/v1/chat/completions", json=req)
+        assert first.status == 200
+        backend = client.server.app["engine"].backend
+        backend.serving_state = lambda: "recovering"
+        second = await client.post("/v1/chat/completions", json=req)
+        assert second.status == 200
+        assert (await second.json())["cached"] is True
+        # a novel request is still shed
+        miss = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "novel"}]},
+        )
+        assert miss.status == 503
+    finally:
+        await client.close()
+
+
+async def test_poison_request_maps_to_400():
+    client = await _client()
+    try:
+        batcher = client.server.app["batcher"]
+
+        async def poisoned_submit(*args, **kwargs):
+            raise PoisonRequestError("request abc is quarantined")
+
+        batcher.submit = poisoned_submit
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "boom"}]},
+        )
+        assert resp.status == 400
+        body = await resp.json()
+        assert body["error"]["type"] == "invalid_request_error"
+        assert "quarantined" in body["error"]["message"]
+    finally:
+        await client.close()
+
+
+# ------------------------------------------------------- batcher shutdown
+
+
+class _DeadBackend:
+    """Backend whose engine is already dead: every generate fails."""
+
+    def create_sampling_params(self, **kwargs):
+        from vgate_tpu.backends.base import SamplingParams
+
+        return SamplingParams(**kwargs)
+
+    def generate(self, prompts, params):
+        raise RuntimeError("engine is dead")
+
+
+class _DeadEngine:
+    def __init__(self, config):
+        self.config = config
+        self.backend = _DeadBackend()
+
+
+async def test_stop_resolves_queue_drained_into_dead_engine():
+    """Satellite fix: stop() must resolve EVERY pending future even when
+    the engine is dead and the queue exceeds one batch — leftover
+    requests previously hung forever."""
+    from vgate_tpu.batcher import RequestBatcher
+
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 2, "max_wait_time_ms": 10_000.0},
+        cache={"enabled": False},
+        logging={"level": "ERROR"},
+    )
+    from vgate_tpu.batcher import BatchRequest
+
+    batcher = RequestBatcher(_DeadEngine(config), config)
+    # enqueue directly (no start(), no size trigger): only stop() can
+    # resolve these, and 5 > max_batch_size forces the drain LOOP
+    loop = asyncio.get_running_loop()
+    futs = []
+    for i in range(5):
+        fut = loop.create_future()
+        futs.append(fut)
+        batcher._queue.append(
+            BatchRequest(
+                request_id=f"r{i}",
+                prompt=f"prompt {i}",
+                params=batcher.engine.backend.create_sampling_params(),
+                cache_key=f"k{i}",
+                future=fut,
+            )
+        )
+    await batcher.stop()
+    settled = await asyncio.wait_for(
+        asyncio.gather(*futs, return_exceptions=True), timeout=5
+    )
+    assert len(settled) == 5
+    assert all(isinstance(r, RuntimeError) for r in settled)
+    assert not batcher._queue
+
+
+async def test_stop_fails_leftover_futures_explicitly():
+    """The belt-and-braces leftover sweep: a request still queued after
+    the drain + loop-cancel (e.g. a racer that slipped in between) gets
+    an explicit retryable error, never a forever-pending future."""
+    from vgate_tpu.batcher import BatchRequest, RequestBatcher
+
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 4, "max_wait_time_ms": 10_000.0},
+        logging={"level": "ERROR"},
+    )
+    batcher = RequestBatcher(_DeadEngine(config), config)
+    fut = asyncio.get_running_loop().create_future()
+    request = BatchRequest(
+        request_id="r1",
+        prompt="late",
+        params=batcher.engine.backend.create_sampling_params(),
+        cache_key="k",
+        future=fut,
+    )
+
+    # simulate the race: the drain loop sees an empty queue; the request
+    # lands while stop() awaits the cancelled batch loop, so only the
+    # leftover sweep can resolve it
+    batcher._running = True
+    batcher._loop_task = asyncio.get_running_loop().create_task(
+        asyncio.sleep(60)
+    )
+    asyncio.get_running_loop().call_soon(batcher._queue.append, request)
+    await batcher.stop()
+    with pytest.raises(EngineRecoveringError):
+        await asyncio.wait_for(fut, timeout=2)

@@ -1,0 +1,458 @@
+"""Supervised engine recovery: deterministic fault-injection through the
+real EngineCore on CPU (slow tier — engine compiles), covering the ISSUE 1
+acceptance criteria:
+
+* transient fault -> supervised restart, in-flight requests fail with the
+  retryable 503 type, subsequent requests succeed in the same process;
+* poison request -> quarantined, cannot re-crash the next incarnation;
+* restart budget exhausted / unrecoverable fault -> DEAD;
+* the gateway surfaces SERVING -> RECOVERING -> SERVING through /health
+  under concurrent load;
+* a chaos-marked randomized run stays live end-to-end.
+"""
+
+import asyncio
+import threading
+import time
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vgate_tpu import faults
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import load_config
+from vgate_tpu.errors import (
+    EngineDeadError,
+    EngineRecoveringError,
+    PoisonRequestError,
+    RetryableError,
+)
+from vgate_tpu.runtime.supervisor import EngineSupervisor, HealthState
+
+
+def rec_config(recovery=None, **tpu_overrides):
+    tpu = {
+        "dp": 1,
+        "tp": 1,
+        "ep": 1,
+        "sp": 1,
+        "kv_num_pages": 64,
+        "kv_page_size": 4,
+        "max_batch_slots": 4,
+        "prefill_buckets": [8, 16, 32],
+        "use_pallas": False,
+    }
+    tpu.update(tpu_overrides)
+    rec = {
+        "enabled": True,
+        "max_restarts": 5,
+        "restart_window_s": 120.0,
+        "backoff_base_s": 0.02,
+        "backoff_cap_s": 0.2,
+        "degraded_probation_s": 0.25,
+        "poison_threshold": 2,
+    }
+    rec.update(recovery or {})
+    return load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu=tpu,
+        scheduler={"max_queue_size": 16},
+        recovery=rec,
+        logging={"level": "ERROR"},
+    )
+
+
+def greedy(max_tokens=6):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0)
+
+
+def wait_for(pred, timeout=90.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def generate_with_retry(sup, prompt, max_tokens=4, attempts=20):
+    """Client-style retry loop against the supervisor: retryable errors
+    back off briefly; anything else propagates."""
+    for _ in range(attempts):
+        try:
+            return sup.generate([prompt], [greedy(max_tokens)])[0]
+        except RetryableError:
+            time.sleep(0.1)
+    raise AssertionError(f"request never succeeded: {prompt!r}")
+
+
+def test_transient_fault_restarts_and_serves_again():
+    """Transient decode crash: the in-flight request fails with the
+    retryable type, the supervisor restarts the core (weights kept), the
+    state machine walks SERVING -> RECOVERING -> DEGRADED -> SERVING,
+    and the next request succeeds WITHOUT a process restart."""
+    sup = EngineSupervisor(rec_config(), devices=jax.devices()[:1])
+    sup.start()
+    try:
+        assert sup.state is HealthState.SERVING
+        [ok] = sup.generate(["warmup probe"], [greedy(4)])
+        assert ok["num_tokens"] >= 1
+        params_leaf_before = jax.tree.leaves(sup.core.params)[0]
+
+        faults.arm("decode_step", mode="raise", kind="transient", times=1)
+        seq = sup.submit_tokens([5, 9, 13, 17, 21], greedy(30))
+        assert seq.done_event.wait(120)
+        assert isinstance(seq.error, EngineRecoveringError)
+        assert seq.error.retry_after >= 1.0
+
+        assert wait_for(
+            lambda: sup.state in (HealthState.DEGRADED, HealthState.SERVING)
+        )
+        assert sup.total_restarts == 1
+        assert ("serving", "recovering") in sup.transitions
+        assert ("recovering", "degraded") in sup.transitions
+        # weights were KEPT across the restart (same device buffers)
+        params_leaf_after = jax.tree.leaves(sup.core.params)[0]
+        assert params_leaf_after is params_leaf_before
+
+        result = generate_with_retry(sup, "after recovery")
+        assert result["num_tokens"] >= 1
+        # probation expires -> SERVING again
+        assert wait_for(lambda: sup.state is HealthState.SERVING, 10)
+        assert ("degraded", "serving") in sup.transitions
+        assert sup.health()["state"] == "serving"
+        assert sup.health()["restarts"] == 1
+    finally:
+        faults.reset()
+        sup.stop()
+
+
+def test_poison_request_is_quarantined():
+    """A request whose prefill keeps crashing the engine is quarantined:
+    the restarted incarnation rejects it at submission (400-type error)
+    while other requests serve normally."""
+    sup = EngineSupervisor(rec_config(), devices=jax.devices()[:1])
+    sup.start()
+    try:
+        poison_ids = [3, 1, 666, 4]
+        faults.arm(
+            "prefill",
+            mode="raise",
+            kind="poison",
+            times=-1,
+            match=lambda ids: ids is not None and 666 in ids,
+        )
+        seq = sup.submit_tokens(poison_ids, greedy(4))
+        assert seq.done_event.wait(120)
+        assert isinstance(seq.error, EngineRecoveringError)
+        assert wait_for(
+            lambda: sup.state in (HealthState.DEGRADED, HealthState.SERVING)
+        )
+        assert sup.health()["quarantined"] == 1
+        with pytest.raises(PoisonRequestError):
+            sup.submit_tokens(poison_ids, greedy(4))
+        # an innocent request is unaffected (and its prefill passes the
+        # armed matcher without firing)
+        result = generate_with_retry(sup, "innocent request")
+        assert result["num_tokens"] >= 1
+        # still only one incarnation lost
+        assert sup.total_restarts == 1
+    finally:
+        faults.reset()
+        sup.stop()
+
+
+def test_repeat_offender_heuristic_quarantines():
+    """Without an explicit poison marker, a request in flight across
+    `poison_threshold` consecutive transient crashes gets quarantined."""
+    sup = EngineSupervisor(
+        rec_config(recovery={"poison_threshold": 2, "max_restarts": 10}),
+        devices=jax.devices()[:1],
+    )
+    sup.start()
+    try:
+        bad_ids = [2, 4, 6, 8]
+        for round_no in range(2):
+            faults.arm(
+                "decode_step", mode="raise", kind="transient", times=1
+            )
+            seq = sup.submit_tokens(bad_ids, greedy(20))
+            assert seq.done_event.wait(120)
+            assert seq.status.value == "failed"
+            assert wait_for(
+                lambda: sup.state
+                in (HealthState.DEGRADED, HealthState.SERVING)
+            )
+        assert sup.health()["quarantined"] == 1
+        with pytest.raises(PoisonRequestError):
+            sup.submit_tokens(bad_ids, greedy(4))
+    finally:
+        faults.reset()
+        sup.stop()
+
+
+def test_restart_budget_exhausted_lands_dead():
+    """Crashing on every incarnation exhausts the sliding-window restart
+    budget: the state machine lands in DEAD, submissions raise the
+    dead-engine type, and /health-style introspection reports it."""
+    sup = EngineSupervisor(
+        rec_config(
+            recovery={
+                "max_restarts": 1,
+                "restart_window_s": 120.0,
+                "poison_threshold": 99,  # isolate the budget path
+            }
+        ),
+        devices=jax.devices()[:1],
+    )
+    sup.start()
+    try:
+        faults.arm("decode_step", mode="raise", kind="transient", times=-1)
+
+        def poke(i):
+            try:
+                seq = sup.submit_tokens([7, i + 1, 3], greedy(10))
+                seq.done_event.wait(60)
+            except (EngineRecoveringError, EngineDeadError):
+                pass
+
+        poke(0)  # crash 1 -> restart (budget now full)
+        assert wait_for(
+            lambda: sup.state
+            in (HealthState.DEGRADED, HealthState.SERVING, HealthState.DEAD)
+        )
+        deadline = time.monotonic() + 90
+        while (
+            sup.state is not HealthState.DEAD
+            and time.monotonic() < deadline
+        ):
+            poke(1)  # crash 2 -> budget exhausted -> DEAD
+            time.sleep(0.05)
+        assert sup.state is HealthState.DEAD
+        with pytest.raises(EngineDeadError):
+            sup.submit_tokens([9, 9, 9], greedy(2))
+        health = sup.health()
+        assert health["state"] == "dead"
+        assert health["alive"] is False
+        assert health["ready"] is False
+    finally:
+        faults.reset()
+        sup.stop()
+
+
+def test_unrecoverable_fault_goes_straight_to_dead():
+    sup = EngineSupervisor(rec_config(), devices=jax.devices()[:1])
+    sup.start()
+    try:
+        faults.arm(
+            "decode_step", mode="raise", kind="unrecoverable", times=1
+        )
+        seq = sup.submit_tokens([1, 2, 3, 4], greedy(10))
+        assert seq.done_event.wait(120)
+        assert wait_for(lambda: sup.state is HealthState.DEAD, 30)
+        assert sup.total_restarts == 0
+        assert ("recovering", "dead") in sup.transitions
+    finally:
+        faults.reset()
+        sup.stop()
+
+
+def test_weight_load_fault_fails_first_construction():
+    """weight_load faults hit initial construction (there is nothing to
+    recover *to* yet): the error propagates to the caller."""
+    faults.arm("weight_load", mode="raise", times=1)
+    with pytest.raises(faults.InjectedFault):
+        EngineSupervisor(rec_config(), devices=jax.devices()[:1])
+    faults.reset()
+
+
+# ----------------------------------------------------------------- gateway
+
+
+async def _gateway_client(**recovery):
+    config_kwargs = dict(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "num_devices": 1,
+            "kv_num_pages": 128, "kv_page_size": 4,
+            "max_batch_slots": 4, "prefill_buckets": [16, 32],
+            "use_pallas": False,
+        },
+        scheduler={"max_queue_size": 32},
+        recovery={
+            "enabled": True,
+            "max_restarts": 8,
+            "restart_window_s": 120.0,
+            "backoff_base_s": 0.02,
+            "backoff_cap_s": 0.2,
+            "degraded_probation_s": 0.2,
+            "poison_threshold": 99,
+            **recovery,
+        },
+        batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},
+        cache={"enabled": False},
+        logging={"level": "ERROR"},
+    )
+    from vgate_tpu.server.app import create_app
+
+    config = load_config(**config_kwargs)
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    return client
+
+
+async def test_gateway_recovers_under_concurrent_load():
+    """ISSUE 1 acceptance: with a transient decode fault armed, concurrent
+    load sees the engine restart; /health transits SERVING -> RECOVERING
+    -> SERVING; in-flight requests fail with a retryable 503 carrying
+    Retry-After; subsequent requests succeed without a process restart."""
+    client = await _gateway_client()
+    try:
+        body = await (await client.get("/health")).json()
+        assert body["engine"]["state"] == "serving"
+
+        faults.arm("decode_step", mode="raise", kind="transient", times=1)
+
+        async def fire(i):
+            resp = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "messages": [
+                        {"role": "user", "content": f"crash probe {i}"}
+                    ],
+                    "max_tokens": 24,
+                    "min_tokens": 24,
+                    "temperature": 0.0,
+                },
+            )
+            return resp.status, dict(resp.headers), await resp.json()
+
+        results = await asyncio.gather(*(fire(i) for i in range(6)))
+        shed = [r for r in results if r[0] == 503]
+        assert shed, "the armed fault should have failed in-flight work"
+        for status, headers, body in results:
+            assert status in (200, 503)
+            if status == 503:
+                assert int(headers["Retry-After"]) >= 1
+                assert body["error"]["type"] == "overloaded_error"
+
+        # readiness dips while recovering, then returns; the state
+        # machine's walk is recorded in /stats
+        async def ready():
+            return (await client.get("/health/ready")).status == 200
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if await ready():
+                break
+            await asyncio.sleep(0.05)
+        assert await ready()
+
+        status, headers, body = await fire(99)
+        assert status == 200
+        assert body["usage"]["completion_tokens"] == 24
+
+        stats = await (await client.get("/stats")).json()
+        sup = stats["engine"]["supervisor"]
+        assert sup["restarts"] >= 1
+        transitions = [tuple(t) for t in sup["transitions"]]
+        assert ("serving", "recovering") in transitions
+        assert ("recovering", "degraded") in transitions
+        # liveness stayed green the whole time (the pod was never
+        # recycled: recovery happened in-process)
+        assert (await client.get("/health/live")).status == 200
+    finally:
+        faults.reset()
+        await client.close()
+
+
+# ------------------------------------------------------------------- chaos
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_randomized_faults_under_concurrent_load():
+    """Chaos mode: randomized raise/delay injections at several points
+    under concurrent threaded load.  Invariants: no request hangs (every
+    submission resolves or raises), the supervisor never wedges in
+    RECOVERING, and serving works after the storm."""
+    sup = EngineSupervisor(
+        rec_config(
+            recovery={
+                "max_restarts": 50,
+                "restart_window_s": 5.0,
+                "backoff_base_s": 0.01,
+                "backoff_cap_s": 0.05,
+                "poison_threshold": 1000,  # innocents stay admitted
+            }
+        ),
+        devices=jax.devices()[:1],
+    )
+    sup.start()
+    outcomes = []
+    lock = threading.Lock()
+    try:
+        faults.arm(
+            "decode_step", mode="raise", kind="transient",
+            times=-1, probability=0.08, seed=11,
+        )
+        faults.arm(
+            "prefill", mode="raise", kind="transient",
+            times=-1, probability=0.04, seed=13,
+        )
+        faults.arm(
+            "kv_alloc", mode="delay", delay_s=0.002,
+            times=-1, probability=0.3, seed=17,
+        )
+
+        def worker(i):
+            for j in range(4):
+                try:
+                    seq = sup.submit_tokens(
+                        [i + 1, j + 1, (i * 7 + j) % 50 + 1], greedy(6)
+                    )
+                    finished = seq.done_event.wait(120)
+                except (RetryableError, PoisonRequestError) as exc:
+                    with lock:
+                        outcomes.append(("shed", type(exc).__name__))
+                    time.sleep(0.05)
+                    continue
+                with lock:
+                    outcomes.append(
+                        ("done" if finished else "hang", seq.status.value)
+                    )
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert all(not t.is_alive() for t in threads), "worker hung"
+        assert outcomes
+        assert not [o for o in outcomes if o[0] == "hang"]
+
+        faults.reset()
+        assert wait_for(
+            lambda: sup.state
+            in (HealthState.SERVING, HealthState.DEGRADED, HealthState.DEAD),
+            60,
+        )
+        if sup.state is not HealthState.DEAD:
+            result = generate_with_retry(sup, "after the storm")
+            assert result["num_tokens"] >= 1
+    finally:
+        faults.reset()
+        sup.stop()

@@ -22,8 +22,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vgate_tpu import faults
 from vgate_tpu.backends.base import GenerationResult, SamplingParams
 from vgate_tpu.config import get_config
+from vgate_tpu.errors import state_is_alive, state_is_ready
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
 from vgate_tpu.runtime.engine_core import EngineCore
@@ -123,9 +125,14 @@ class JaxTPUBackend:
         # for callers that still pass only the model section
         self._config = config if hasattr(config, "tpu") else get_config()
         if self._config.tpu.dp > 1:
+            # dp replicas have their own failover; unsupervised
             from vgate_tpu.runtime.dp_engine import ReplicatedEngine
 
             self.core = ReplicatedEngine(self._config)
+        elif self._config.recovery.enabled:
+            from vgate_tpu.runtime.supervisor import EngineSupervisor
+
+            self.core = EngineSupervisor(self._config)
         else:
             self.core = EngineCore(self._config)
         self.core.start()
@@ -151,6 +158,7 @@ class JaxTPUBackend:
         sampling_params: Sequence[SamplingParams],
     ) -> List[GenerationResult]:
         assert self.core is not None, "load_model not called"
+        faults.check("backend_generate")
         raw = self.core.generate(prompts, sampling_params)
         return [GenerationResult(**r) for r in raw]
 
@@ -171,6 +179,7 @@ class JaxTPUBackend:
         the whole batch — one deadline-shed or failed sequence must not
         discard its co-batched neighbours' completed generations."""
         assert self.core is not None
+        faults.check("backend_generate")
         loop = asyncio.get_running_loop()
         seqs = []
         for p, sp in zip(prompts, sampling_params):
@@ -373,6 +382,42 @@ class JaxTPUBackend:
         if self.core is None:
             return {"alive": False, "error": "not loaded"}
         return self.core.device_health()
+
+    def serving_state(self) -> str:
+        """Health-state-machine position ("serving" | "degraded" |
+        "recovering" | "dead"); unsupervised cores are "serving" while
+        alive and "dead" after a fatal."""
+        if self.core is None:
+            return "dead"
+        state = getattr(self.core, "state", None)
+        if state is not None:
+            return state.value
+        if getattr(self.core, "_fatal", None) is not None:
+            return "dead"
+        return "serving"
+
+    def serving_health(self) -> Dict[str, Any]:
+        """Engine liveness block for /health: always present, regardless
+        of whether the device exposes health (satellite: app.py must not
+        depend on device_health existing)."""
+        health_fn = getattr(self.core, "health", None)
+        if health_fn is not None:
+            return health_fn()
+        state = self.serving_state()
+        body: Dict[str, Any] = {
+            "state": state,
+            "alive": state_is_alive(state),
+            "ready": state_is_ready(state),
+        }
+        stats_fn = getattr(self.core, "get_stats", None)
+        if stats_fn is not None:
+            try:
+                sched = (stats_fn() or {}).get("scheduler", {})
+                body["queue_depth"] = sched.get("waiting", 0)
+                body["running"] = sched.get("running", 0)
+            except Exception:
+                pass
+        return body
 
     def get_stats(self) -> Dict[str, Any]:
         if self.core is None:

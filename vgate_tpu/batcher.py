@@ -38,6 +38,7 @@ from vgate_tpu.backends.base import GenerationResult, SamplingParams
 from vgate_tpu.cache import ResultCache
 from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.engine import VGTEngine
+from vgate_tpu.errors import EngineRecoveringError, raise_for_state
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.tracing import get_tracer
 
@@ -74,6 +75,9 @@ class RequestBatcher:
         self._queue_lock = asyncio.Lock()
         self._loop_task: Optional[asyncio.Task] = None
         self._running = False
+        # set by stop(): submissions racing shutdown must fail fast, not
+        # enqueue behind the leftover sweep and hang
+        self._stopped = False
         # Backends without generate_async share one worker hop at a time
         # (the reference's global _inference_lock, batcher.py:79).
         self._sync_lock = asyncio.Lock()
@@ -101,9 +105,17 @@ class RequestBatcher:
         )
 
     async def stop(self) -> None:
-        """Drain the queue, then cancel the loop (reference: batcher.py:103-114)."""
+        """Drain the queue, then cancel the loop (reference: batcher.py:103-114).
+
+        The drain loops until the queue is empty — one ``_process_batch``
+        only takes ``max_batch_size`` requests, and anything left behind
+        would hang its client forever.  A dead/fatal engine still
+        resolves every future: per-request failures come back through the
+        settled path, and whatever survives the drain (e.g. racing
+        submissions) is failed explicitly below."""
         self._running = False
-        if self._queue:
+        self._stopped = True
+        while self._queue:
             await self._process_batch()
         if self._loop_task is not None:
             self._loop_task.cancel()
@@ -112,6 +124,17 @@ class RequestBatcher:
             except asyncio.CancelledError:
                 pass
             self._loop_task = None
+        async with self._queue_lock:
+            leftovers = self._queue[:]
+            self._queue.clear()
+            metrics.PENDING_REQUESTS.set(0)
+        for req in leftovers:
+            if not req.future.done():
+                req.future.set_exception(
+                    EngineRecoveringError(
+                        "server shut down before the request could run"
+                    )
+                )
 
     # -- submission (reference: vgate/batcher.py:116-182) --
 
@@ -187,6 +210,23 @@ class RequestBatcher:
                 result["cached"] = True
                 return result
 
+            # Fail fast instead of queuing into a dead/recovering
+            # engine: the health state machine (runtime/supervisor.py)
+            # says a batch fired now cannot succeed, so the client gets
+            # an immediate retryable 503 + Retry-After rather than a
+            # max_wait_time_ms queue hop into a crash.  AFTER the cache
+            # lookup: a cache-servable request needs no engine.
+            state_fn = getattr(self.engine.backend, "serving_state", None)
+            if state_fn is not None:
+                raise_for_state(
+                    state_fn(),
+                    retry_after=getattr(
+                        getattr(self.engine.backend, "core", None),
+                        "retry_after_s",
+                        1.0,
+                    ),
+                )
+
             request = BatchRequest(
                 request_id=request_id or uuid.uuid4().hex[:12],
                 prompt=prompt,
@@ -195,6 +235,12 @@ class RequestBatcher:
                 future=asyncio.get_running_loop().create_future(),
             )
             async with self._queue_lock:
+                if self._stopped:
+                    # shutdown raced past the cache lookup: nothing will
+                    # ever drain the queue again
+                    raise EngineRecoveringError(
+                        "server is shutting down; retry another replica"
+                    )
                 self._queue.append(request)
                 metrics.PENDING_REQUESTS.set(len(self._queue))
                 trigger = len(self._queue) >= self.config.batch.max_batch_size

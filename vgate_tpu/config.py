@@ -281,6 +281,33 @@ class SchedulerConfig(BaseModel):
     preempt_on_oom: bool = True
 
 
+class RecoveryConfig(BaseModel):
+    """Supervised engine recovery (runtime/supervisor.py): a fatal
+    engine-loop error tears the core down and rebuilds it (weights kept,
+    KV + scheduler state fresh) instead of killing serving until a
+    process restart.  The health state machine SERVING → DEGRADED →
+    RECOVERING → DEAD is surfaced through /health and /stats."""
+
+    # dp == 1 engines only; ReplicatedEngine (tpu.dp > 1) has its own
+    # replica failover and stays unsupervised.
+    enabled: bool = True
+    # Restart budget: more than `max_restarts` restarts within
+    # `restart_window_s` lands the engine in DEAD (liveness probe then
+    # recycles the pod) instead of crash-looping forever.
+    max_restarts: int = 3
+    restart_window_s: float = 300.0
+    # Capped exponential backoff before each rebuild attempt.
+    backoff_base_s: float = 0.25
+    backoff_cap_s: float = 30.0
+    # A freshly restarted engine serves in DEGRADED for this long; one
+    # crash-free probation promotes it back to SERVING.
+    degraded_probation_s: float = 30.0
+    # A request in flight across this many consecutive crashes is
+    # quarantined as suspected poison (rejected at submission with a 400
+    # so it cannot crash the next incarnation).
+    poison_threshold: int = 2
+
+
 class InferenceConfig(BaseModel):
     """Default sampling parameters (reference: vgate/config.py:74-80)."""
 
@@ -319,7 +346,9 @@ class SecurityConfig(BaseModel):
     enabled: bool = False
     api_keys: List[str] = Field(default_factory=list)
     exempt_paths: List[str] = Field(
-        default_factory=lambda: ["/health", "/metrics"]
+        default_factory=lambda: [
+            "/health", "/health/live", "/health/ready", "/metrics",
+        ]
     )
 
 
@@ -353,6 +382,7 @@ class VGTConfig(BaseModel):
     batch: BatchConfig = Field(default_factory=BatchConfig)
     cache: CacheConfig = Field(default_factory=CacheConfig)
     scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
+    recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     inference: InferenceConfig = Field(default_factory=InferenceConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     metrics: MetricsConfig = Field(default_factory=MetricsConfig)

@@ -1,0 +1,86 @@
+"""Serving error taxonomy for the recovery path.
+
+The engine's fault handling distinguishes three client-visible outcomes:
+
+* **Retryable** — the failure is about *when* the request arrived, not
+  *what* it asked for: the engine crashed mid-flight and is restarting,
+  or has been torn down.  Clients should back off ``retry_after``
+  seconds and resend; the gateway maps these to 503 + ``Retry-After``
+  so the SDK's existing backoff honors the server's suggestion.
+* **Poison** — the request itself is the suspected crash cause and has
+  been quarantined (vgate_tpu/runtime/supervisor.py); resending it will
+  never succeed, so the gateway maps it to a 400.
+
+Kept free of imports from the runtime so every layer (scheduler,
+batcher, server, client-facing docs) can reference one taxonomy without
+cycles.
+"""
+
+from __future__ import annotations
+
+
+# The single source of truth for deriving probe answers from a health
+# state string (supervisor.health, backend.serving_health and the
+# gateway's /health handlers all consult these — they must never
+# disagree about what counts as ready).
+READY_STATES = ("serving", "degraded")
+
+
+def state_is_ready(state: str) -> bool:
+    """May this engine accept new work (readiness probe)?"""
+    return state in READY_STATES
+
+
+def state_is_alive(state: str) -> bool:
+    """Is a pod restart NOT warranted (liveness probe)?"""
+    return state != "dead"
+
+
+def raise_for_state(
+    state: str, retry_after: float = 1.0, detail: str = None
+) -> None:
+    """The one state -> admission-error mapping (supervisor gate and
+    batcher fail-fast both use it; they must never disagree).  No-op for
+    ready states."""
+    if state == "dead":
+        raise EngineDeadError(
+            "engine is dead (restart budget exhausted or unrecoverable "
+            "fault" + (f": {detail}" if detail else "") + ")"
+        )
+    if state == "recovering":
+        raise EngineRecoveringError(
+            "engine is restarting after a crash; retry shortly",
+            retry_after=retry_after,
+        )
+
+
+class RetryableError(RuntimeError):
+    """A transient serving failure the client should retry after
+    ``retry_after`` seconds (surfaced as 503 + ``Retry-After``)."""
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = max(1.0, float(retry_after))
+
+
+class EngineRecoveringError(RetryableError):
+    """The engine crashed and a supervised restart is in progress; the
+    request was failed fast (or shed at admission) instead of queuing
+    into a dead engine."""
+
+
+class EngineDeadError(RetryableError):
+    """The engine exhausted its restart budget (or hit an unrecoverable
+    fault) and will not come back in this process.  Still retryable from
+    the client's point of view — another replica behind the LB can serve
+    it while the liveness probe recycles this pod."""
+
+    def __init__(self, message: str, retry_after: float = 30.0) -> None:
+        super().__init__(message, retry_after=retry_after)
+
+
+class PoisonRequestError(ValueError):
+    """This request was in flight across enough engine crashes (or an
+    injected poison fault named it) that the supervisor quarantined it:
+    it is rejected at submission so it cannot crash the next engine
+    incarnation.  Not retryable — mapped to a 400."""

@@ -158,6 +158,45 @@ RECOMPILES = _safe_metric(
     labelnames=("kind",),
 )
 
+# --- recovery / health state machine (runtime/supervisor.py) ---
+ENGINE_RESTARTS = _safe_metric(
+    Counter, "vgt_engine_restarts", "Supervised engine restarts"
+)
+ENGINE_CRASHES = _safe_metric(
+    Counter,
+    "vgt_engine_crashes",
+    "Engine-loop fatal errors by classification",
+    labelnames=("kind",),  # transient | poison | unrecoverable
+)
+HEALTH_STATE = _safe_metric(
+    Gauge,
+    "vgt_engine_health_state",
+    "Serving health state machine (1 on the current state's label)",
+    labelnames=("state",),  # serving | degraded | recovering | dead
+)
+STATE_TRANSITIONS = _safe_metric(
+    Counter,
+    "vgt_engine_state_transitions",
+    "Health state machine transitions",
+    labelnames=("from_state", "to_state"),
+)
+QUARANTINED_REQUESTS = _safe_metric(
+    Counter,
+    "vgt_quarantined_requests",
+    "Requests quarantined as suspected engine poison",
+)
+TIME_IN_DEGRADED = _safe_metric(
+    Counter,
+    "vgt_time_in_degraded_seconds",
+    "Cumulative seconds spent in the DEGRADED health state",
+)
+FAULTS_INJECTED = _safe_metric(
+    Counter,
+    "vgt_faults_injected",
+    "Armed fault-injection probes that fired (vgate_tpu/faults.py)",
+    labelnames=("point", "mode"),
+)
+
 INFO = _safe_metric(Info, "vgt_build", "Framework build information")
 
 

@@ -39,8 +39,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from vgate_tpu import metrics
+from vgate_tpu import faults, metrics
 from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.errors import EngineRecoveringError
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.models.decoder import (
@@ -399,6 +400,7 @@ class EngineCore:
         spec: Optional[ModelSpec] = None,
         params: Optional[Any] = None,
         devices: Optional[list] = None,
+        params_ready: bool = False,
     ) -> None:
         self.config = config or get_config()
         self.spec = spec or spec_for_model_id(self.config.model.model_id)
@@ -429,7 +431,13 @@ class EngineCore:
         # keep the place-then-quantize order so the eager quantize ops
         # run SPMD and scales inherit the tp layout.
         host_stage = None
-        if quant_bits and self.mesh.devices.size == 1:
+        if params_ready:
+            # supervised restart (runtime/supervisor.py): `params` is the
+            # previous incarnation's tree, already quantized/sharded on
+            # these same devices — re-quantizing or re-sharding it would
+            # corrupt it, so place it verbatim and skip the load path
+            assert params is not None, "params_ready requires params"
+        elif quant_bits and self.mesh.devices.size == 1:
             try:
                 host_stage = jax.devices("cpu")[0]
             except RuntimeError:  # pragma: no cover - cpu backend absent
@@ -440,7 +448,9 @@ class EngineCore:
                     "bf16 tree may OOM the chip) — pin tpu.platform so "
                     "apply_platform keeps cpu registered"
                 )
-        if host_stage is not None:
+        if params_ready:
+            self.params = params
+        elif host_stage is not None:
             from vgate_tpu.ops.quant import quantize_decoder_params
 
             with jax.default_device(host_stage):
@@ -744,6 +754,14 @@ class EngineCore:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._fatal: Optional[BaseException] = None
+        # supervision hook (runtime/supervisor.py): called once from the
+        # engine thread after a fatal error is fully contained.  When set,
+        # owed futures fail with a *retryable* error (the supervisor is
+        # about to restart the core) instead of the raw fault.
+        self.on_fatal: Optional[Callable[[BaseException], None]] = None
+        # prompt fingerprints of the requests resident when the loop died
+        # — the supervisor's poison heuristic counts repeat offenders
+        self._fatal_suspects: List[str] = []
         self.total_steps = 0
         self.total_prefills = 0
         self.total_decode_tokens = 0
@@ -769,6 +787,20 @@ class EngineCore:
 
     # ------------------------------------------------------------ submission
 
+    def _fail_exception(self, exc: BaseException) -> BaseException:
+        """The exception owed futures fail with after a fatal: supervised
+        engines (on_fatal set) are about to restart, so clients get the
+        retryable 503 type with the raw fault chained; unsupervised
+        engines keep the raw fault (the dp router's containment
+        contract)."""
+        if self.on_fatal is None:
+            return exc
+        wrapped = EngineRecoveringError(
+            f"engine crashed and is restarting: {exc}"
+        )
+        wrapped.__cause__ = exc
+        return wrapped
+
     def submit_tokens(
         self,
         prompt_ids: List[int],
@@ -788,7 +820,7 @@ class EngineCore:
         # the queue and will never see this seq — fail everything still
         # queued ourselves so no client hangs on done_event.
         if self._fatal is not None:
-            exc = self._fatal
+            exc = self._fail_exception(self._fatal)
             while True:
                 try:
                     orphan = self._submit_q.get_nowait()
@@ -800,17 +832,23 @@ class EngineCore:
         self._wakeup.set()
         return seq
 
+    def encode_prompt(self, prompt: str) -> List[int]:
+        """Prompt -> submission token ids (chat-style suffix truncation).
+        Split out so the supervisor can fingerprint a prompt for the
+        poison quarantine before submission."""
+        ids = self.tokenizer.encode(prompt)
+        max_prompt = self.config.model.max_model_len - 1
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]  # keep the suffix (chat-style truncation)
+        return ids or [self.tokenizer.bos_id]
+
     def submit_prompt(
         self,
         prompt: str,
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
     ) -> Sequence:
-        ids = self.tokenizer.encode(prompt)
-        max_prompt = self.config.model.max_model_len - 1
-        if len(ids) > max_prompt:
-            ids = ids[-max_prompt:]  # keep the suffix (chat-style truncation)
-        return self.submit_tokens(ids or [self.tokenizer.bos_id], params, stream_cb)
+        return self.submit_tokens(self.encode_prompt(prompt), params, stream_cb)
 
     def generate(
         self, prompts: Seq[str], params: Seq[SamplingParams]
@@ -856,6 +894,15 @@ class EngineCore:
             except Exception as exc:
                 logger.error("engine loop fatal error", exc_info=True)
                 self._fatal = exc
+                # poison-heuristic evidence: the requests resident at the
+                # crash (keyed by their ORIGINAL prompt, which survives
+                # preemption's prompt folding)
+                self._fatal_suspects = [
+                    faults.fingerprint(
+                        s.prompt_ids[: s.orig_prompt_len]
+                    )
+                    for s in self.scheduler.running
+                ]
                 # fail EVERY owed future: running, waiting, and anything
                 # still sitting in the submit queue (a client blocked on
                 # one of those would otherwise hang forever)
@@ -867,13 +914,21 @@ class EngineCore:
                         doomed.append(self._submit_q.get_nowait())
                     except queue.Empty:
                         break
+                fail_exc = self._fail_exception(exc)
                 for seq in doomed:
-                    seq.fail(exc)
+                    seq.fail(fail_exc)
                 self.scheduler.waiting.clear()
                 for i in range(len(self.scheduler.slots)):
                     self.scheduler.slots[i] = None
                 self._pending_chunks.clear()
                 self._running = False
+                if self.on_fatal is not None:
+                    try:
+                        self.on_fatal(exc)
+                    except Exception:  # pragma: no cover - defensive
+                        logger.error(
+                            "on_fatal hook failed", exc_info=True
+                        )
         logger.info("engine thread stopped")
 
     def _tick(self) -> bool:
@@ -1036,6 +1091,18 @@ class EngineCore:
             plans.append(plan)
         if not plans:
             return False
+        if faults.is_active():
+            # fault probe (vgate_tpu/faults.py): payload is the request's
+            # ORIGINAL prompt so a poison fault can target one request.
+            # Gated so the disarmed hot path never pays the per-plan
+            # prompt copy.
+            for plan in plans:
+                faults.check(
+                    "prefill",
+                    payload=tuple(
+                        plan.seq.prompt_ids[: plan.seq.orig_prompt_len]
+                    ),
+                )
         # group same-bucket plans into batched dispatches; prefix-cache
         # hits (suffix-only prompt pass) compile a different program and
         # group separately.  Chunked plans (prompt > the bucket cap) run
@@ -1570,6 +1637,7 @@ class EngineCore:
         return 1 << (headroom.bit_length() - 1)
 
     def _dispatch_chunk(self, active: List[Sequence], chunk: int) -> None:
+        faults.check("decode_step")
         state = self._dec_state
         num_lp = (
             LOGPROBS_K
@@ -1656,6 +1724,7 @@ class EngineCore:
             # queueing when more than one chunk is in flight
             block_start = time.perf_counter()
             sampled = np.asarray(tokens_dev)  # [chunk, B]; blocks
+            sampled = faults.corrupt_array("decode_step", sampled)
             lp_np = (
                 None
                 if lp_dev is None
@@ -1810,6 +1879,7 @@ class EngineCore:
         spec_mt_ids = self._spec_mt["ids"]
         spec_lb = self._spec_mt["lb"]
         spec_lb_vals = self._spec_mt["lb_vals"]
+        faults.check("decode_step")
         start = time.perf_counter()
         num_lp = (
             LOGPROBS_K

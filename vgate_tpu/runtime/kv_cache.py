@@ -19,7 +19,7 @@ from typing import Deque, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from vgate_tpu import metrics
+from vgate_tpu import faults, metrics
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.models.specs import ModelSpec
 from vgate_tpu.utils.math import cdiv
@@ -170,6 +170,7 @@ class PageAllocator:
         """All-or-nothing allocation of n pages; None when insufficient.
         Evicts least-recently-used cached pages when the free list runs
         short."""
+        faults.check("kv_alloc", payload=n)
         if n > self.num_free:
             return None
         pages = []

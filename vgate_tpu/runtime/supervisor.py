@@ -1,0 +1,504 @@
+"""Supervised engine recovery + the serving health state machine.
+
+The engine core treats any step-loop exception as terminal: ``_fatal``
+is set, every owed future fails, and all later submissions raise until
+the process restarts.  The reference V-Gate dodged this by delegating
+crash handling to external vLLM/SGLang engines; an in-house TPU engine
+must own it (ISSUE 1).  ``EngineSupervisor`` wraps one
+:class:`~vgate_tpu.runtime.engine_core.EngineCore` and:
+
+* watches for the fatal state (the core's ``on_fatal`` hook fires from
+  the engine thread once the crash is contained);
+* classifies the error — **transient** (restart), **poison** (a specific
+  request keeps crashing the engine: quarantine it, then restart), or
+  **unrecoverable** (straight to ``DEAD``);
+* tears the core down and rebuilds it with capped exponential backoff
+  and a sliding-window restart budget.  Weights are KEPT (the previous
+  incarnation's already-quantized/sharded tree is passed back through
+  ``EngineCore(params=..., params_ready=True)`` — no reload, no
+  re-quantize); KV pages and scheduler state are rebuilt fresh;
+* fails in-flight requests with the retryable
+  :class:`~vgate_tpu.errors.EngineRecoveringError` (503 + Retry-After at
+  the gateway) and rejects new submissions fast while ``RECOVERING``;
+* quarantines suspected poison requests by prompt fingerprint so a
+  client retry cannot re-crash the next incarnation.
+
+Health state machine, surfaced through /health (readiness vs liveness
+split) and /stats::
+
+    SERVING ──crash──▶ RECOVERING ──restart ok──▶ DEGRADED ──probation──▶ SERVING
+       ▲                   │
+       └───────────────────┴──budget exhausted / unrecoverable──▶ DEAD
+
+``DEGRADED`` is post-restart probation: the engine serves, but /health
+reports the reduced confidence; one crash-free probation window promotes
+it back to ``SERVING``.  ``DEAD`` fails the liveness probe so the
+orchestrator recycles the pod.
+
+dp == 1 engines only; ``ReplicatedEngine`` (tpu.dp > 1) keeps its own
+replica failover and stays unsupervised.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+
+from vgate_tpu import faults, metrics
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.errors import (
+    EngineRecoveringError,
+    PoisonRequestError,
+    raise_for_state,
+    state_is_alive,
+    state_is_ready,
+)
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.runtime.engine_core import EngineCore
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+
+logger = get_logger(__name__)
+
+
+class HealthState(enum.Enum):
+    SERVING = "serving"
+    DEGRADED = "degraded"
+    RECOVERING = "recovering"
+    DEAD = "dead"
+
+
+def classify_fatal(exc: BaseException) -> str:
+    """transient | poison | unrecoverable.  Injected faults carry their
+    kind (faults.InjectedFault.fault_kind); real errors default to
+    transient — a restart is cheap relative to killing serving, and the
+    restart budget bounds misclassification."""
+    kind = getattr(exc, "fault_kind", None)
+    if kind in faults.FAULT_KINDS:
+        return kind
+    if isinstance(exc, MemoryError):
+        return "unrecoverable"
+    return "transient"
+
+
+class EngineSupervisor:
+    """Owns the live EngineCore and the recovery loop.  Exposes the same
+    serving surface the backend drives (submit/generate/stop/stats/...);
+    everything not intercepted here delegates to the live core."""
+
+    def __init__(
+        self,
+        config: Optional[VGTConfig] = None,
+        devices: Optional[list] = None,
+    ) -> None:
+        self.config = config or get_config()
+        self._recovery = self.config.recovery
+        self._devices = devices
+        self._lock = threading.RLock()
+        self._state = HealthState.SERVING
+        self._degraded_since: Optional[float] = None
+        self._time_in_degraded = 0.0
+        self._restart_times: List[float] = []
+        self._quarantine: set = set()
+        self._suspect_counts: Dict[str, int] = {}
+        self._crash_event = threading.Event()
+        self._stopping = False
+        self._watcher: Optional[threading.Thread] = None
+        self.total_crashes = 0
+        self.total_restarts = 0
+        self.transitions: List[tuple] = []
+        self.last_fatal: Optional[str] = None
+        # first build: construction failures (bad config, weight-load
+        # faults) propagate — there is nothing to recover *to* yet
+        self.core = EngineCore(self.config, devices=devices)
+        self._attach(self.core)
+        self._set_state_metric(self._state)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.core.start()
+        if self._watcher is None:
+            self._watcher = threading.Thread(
+                target=self._watch_loop, name="vgt-supervisor", daemon=True
+            )
+            self._watcher.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._crash_event.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=30)
+            self._watcher = None
+        self.core.stop()
+
+    # ------------------------------------------------------------ the state
+
+    @property
+    def state(self) -> HealthState:
+        """Current health state, with the lazy DEGRADED -> SERVING
+        promotion: one crash-free probation window restores full
+        confidence without a dedicated timer thread."""
+        with self._lock:
+            if (
+                self._state is HealthState.DEGRADED
+                and self._degraded_since is not None
+                and time.monotonic() - self._degraded_since
+                >= self._recovery.degraded_probation_s
+            ):
+                self._transition(HealthState.SERVING)
+            return self._state
+
+    def _transition(self, new: HealthState) -> None:
+        with self._lock:
+            old = self._state
+            if old is new:
+                return
+            now = time.monotonic()
+            if old is HealthState.DEGRADED and self._degraded_since is not None:
+                dt = now - self._degraded_since
+                self._time_in_degraded += dt
+                metrics.TIME_IN_DEGRADED.inc(dt)
+                self._degraded_since = None
+            if new is HealthState.DEGRADED:
+                self._degraded_since = now
+            self._state = new
+            self.transitions.append((old.value, new.value))
+            metrics.STATE_TRANSITIONS.labels(
+                from_state=old.value, to_state=new.value
+            ).inc()
+            self._set_state_metric(new)
+        logger.warning(
+            "engine health transition",
+            extra={"extra_data": {"from": old.value, "to": new.value}},
+        )
+
+    @staticmethod
+    def _set_state_metric(current: HealthState) -> None:
+        for s in HealthState:
+            metrics.HEALTH_STATE.labels(state=s.value).set(
+                1.0 if s is current else 0.0
+            )
+
+    @property
+    def retry_after_s(self) -> float:
+        """Suggested client backoff: the next restart attempt's backoff
+        (plus margin) while recovering, else the floor of 1s."""
+        rec = self._recovery
+        backoff = min(
+            rec.backoff_cap_s,
+            rec.backoff_base_s * (2 ** len(self._restart_times)),
+        )
+        return max(1.0, backoff)
+
+    # ----------------------------------------------------------- recovery
+
+    def _attach(self, core: EngineCore) -> None:
+        core.on_fatal = self._on_fatal
+
+    def _on_fatal(self, exc: BaseException) -> None:
+        """Runs on the dying engine thread after the crash is contained
+        (futures failed, slots cleared): flip to RECOVERING and hand off
+        to the watcher thread."""
+        with self._lock:
+            self.total_crashes += 1
+            self.last_fatal = f"{type(exc).__name__}: {exc}"
+            if self._state is not HealthState.DEAD:
+                self._transition(HealthState.RECOVERING)
+        self._crash_event.set()
+
+    def _watch_loop(self) -> None:
+        while not self._stopping:
+            fired = self._crash_event.wait(timeout=0.25)
+            if self._stopping:
+                return
+            if not fired:
+                continue
+            self._crash_event.clear()
+            if self.core._fatal is not None:
+                try:
+                    self._handle_crash()
+                except Exception:  # pragma: no cover - defensive
+                    logger.error(
+                        "supervisor crash handler failed", exc_info=True
+                    )
+                    self._transition(HealthState.DEAD)
+
+    def _sleep(self, seconds: float) -> None:
+        deadline = time.monotonic() + seconds
+        while not self._stopping and time.monotonic() < deadline:
+            time.sleep(min(0.05, deadline - time.monotonic()))
+
+    def _update_quarantine(self, exc: BaseException, kind: str) -> None:
+        suspects = list(self.core._fatal_suspects)
+        if kind == "poison":
+            # the fault names its victim; fall back to every resident
+            # request when it doesn't
+            named = getattr(exc, "fingerprint", None)
+            for fp in [named] if named else suspects:
+                if fp and fp not in self._quarantine:
+                    self._quarantine.add(fp)
+                    metrics.QUARANTINED_REQUESTS.inc()
+                    logger.error(
+                        "request quarantined as engine poison",
+                        extra={"extra_data": {"fingerprint": fp}},
+                    )
+            return
+        # transient path: count repeat offenders — a request in flight
+        # across `poison_threshold` consecutive crashes is quarantined
+        new_counts: Dict[str, int] = {}
+        for fp in suspects:
+            count = self._suspect_counts.get(fp, 0) + 1
+            if count >= self._recovery.poison_threshold:
+                if fp not in self._quarantine:
+                    self._quarantine.add(fp)
+                    metrics.QUARANTINED_REQUESTS.inc()
+                    logger.error(
+                        "repeat-offender request quarantined",
+                        extra={
+                            "extra_data": {
+                                "fingerprint": fp, "crashes": count,
+                            }
+                        },
+                    )
+            else:
+                new_counts[fp] = count
+        # requests NOT in this crash reset their streak (consecutive
+        # involvement is the poison signal, not lifetime involvement)
+        self._suspect_counts = new_counts
+
+    def _handle_crash(self) -> None:
+        exc = self.core._fatal
+        assert exc is not None
+        kind = classify_fatal(exc)
+        metrics.ENGINE_CRASHES.labels(kind=kind).inc()
+        logger.error(
+            "engine crashed; supervisor recovering",
+            extra={
+                "extra_data": {
+                    "kind": kind, "error": f"{type(exc).__name__}: {exc}",
+                }
+            },
+        )
+        self._update_quarantine(exc, kind)
+        if kind == "unrecoverable":
+            self._transition(HealthState.DEAD)
+            return
+        rec = self._recovery
+        while not self._stopping:
+            now = time.monotonic()
+            self._restart_times = [
+                t for t in self._restart_times
+                if now - t < rec.restart_window_s
+            ]
+            if len(self._restart_times) >= rec.max_restarts:
+                logger.error(
+                    "restart budget exhausted; engine is DEAD",
+                    extra={
+                        "extra_data": {
+                            "max_restarts": rec.max_restarts,
+                            "window_s": rec.restart_window_s,
+                        }
+                    },
+                )
+                self._transition(HealthState.DEAD)
+                return
+            backoff = min(
+                rec.backoff_cap_s,
+                rec.backoff_base_s * (2 ** len(self._restart_times)),
+            )
+            self._sleep(backoff)
+            if self._stopping:
+                return
+            self._restart_times.append(time.monotonic())
+            try:
+                old = self.core
+                old.stop()
+                # free the dead incarnation's device KV pool BEFORE
+                # building the new one: auto-sized pools fill most of
+                # HBM, so keeping both alive would OOM every rebuild
+                # attempt on real hardware (old stays self.core until
+                # the swap below, pinning anything still referenced)
+                old.k_pages = None
+                old.v_pages = None
+                old._dec_state = None
+                old._pending_chunks.clear()
+                old._spec_pen = None
+                # weights kept: the old core's tree is already
+                # quantized/sharded on these devices — KV pools,
+                # allocator and scheduler rebuild fresh
+                new_core = EngineCore(
+                    self.config,
+                    spec=old.spec,
+                    params=old.params,
+                    devices=self._devices,
+                    params_ready=True,
+                )
+            except Exception:
+                logger.error(
+                    "engine rebuild attempt failed", exc_info=True
+                )
+                continue  # burns budget via _restart_times; retry
+            self._attach(new_core)
+            self.core = new_core
+            if self._stopping:
+                # stop() raced the rebuild (its join timed out while we
+                # were constructing): never start an engine nothing owns
+                new_core.stop()
+                return
+            new_core.start()
+            self.total_restarts += 1
+            metrics.ENGINE_RESTARTS.inc()
+            self._transition(HealthState.DEGRADED)
+            logger.warning(
+                "engine restarted",
+                extra={
+                    "extra_data": {
+                        "restarts": self.total_restarts,
+                        "backoff_s": backoff,
+                    }
+                },
+            )
+            return
+
+    # ----------------------------------------------------------- submission
+
+    def _gate(self, prompt_ids: List[int]) -> None:
+        raise_for_state(
+            self.state.value,
+            retry_after=self.retry_after_s,
+            detail=self.last_fatal,
+        )
+        if not self._quarantine:
+            return  # steady state: skip the O(prompt) fingerprint
+        fp = faults.fingerprint(prompt_ids)
+        if fp in self._quarantine:
+            raise PoisonRequestError(
+                f"request {fp} is quarantined: it was in flight across "
+                "repeated engine crashes (or was named by a poison "
+                "fault) and will not be admitted again"
+            )
+
+    def submit_tokens(
+        self,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        stream_cb: Optional[Callable[[int], Any]] = None,
+    ) -> Sequence:
+        self._gate(list(prompt_ids))
+        try:
+            return self.core.submit_tokens(prompt_ids, params, stream_cb)
+        except EngineRecoveringError:
+            raise
+        except RuntimeError as exc:
+            if self.core._fatal is not None:
+                # crashed between the gate and the submit
+                raise EngineRecoveringError(
+                    "engine crashed during submission; retry shortly",
+                    retry_after=self.retry_after_s,
+                ) from exc
+            raise
+
+    def submit_prompt(
+        self,
+        prompt: str,
+        params: SamplingParams,
+        stream_cb: Optional[Callable[[int], Any]] = None,
+    ) -> Sequence:
+        return self.submit_tokens(
+            self.core.encode_prompt(prompt), params, stream_cb
+        )
+
+    def generate(
+        self, prompts: Seq[str], params: Seq[SamplingParams]
+    ) -> List[Dict[str, Any]]:
+        """Blocking batch API (mirrors EngineCore.generate) routed through
+        the supervisor's gate so quarantine/health checks apply."""
+        seqs = [
+            self.submit_prompt(p, sp) for p, sp in zip(prompts, params)
+        ]
+        results = []
+        for seq in seqs:
+            seq.done_event.wait()
+            if seq.status is SeqStatus.FAILED:
+                raise seq.error  # type: ignore[misc]
+            core = self.core
+            text = core.final_text(seq)
+            gen_time = (seq.finish_t or 0) - seq.arrival_t
+            result = {
+                "text": text,
+                "token_ids": list(seq.generated_ids),
+                "num_tokens": seq.num_output_tokens,
+                "prompt_tokens": seq.orig_prompt_len,
+                "finish_reason": seq.finish_reason,
+                "metrics": {
+                    "ttft": seq.ttft or 0.0,
+                    "tpot": seq.tpot or 0.0,
+                    "gen_time": gen_time,
+                },
+            }
+            if seq.params.logprobs:
+                result["logprobs"] = core.logprob_entries(seq)
+            results.append(result)
+        return results
+
+    # -------------------------------------------------------- introspection
+
+    def health(self) -> Dict[str, Any]:
+        """The health block /health and /stats surface: state machine
+        position, restart accounting, quarantine size, queue depth."""
+        state = self.state
+        try:
+            sched = self.core.scheduler.get_stats()
+            queue_depth = sched["waiting"]
+            running = sched["running"]
+        except Exception:  # mid-rebuild: scheduler may not exist yet
+            queue_depth = 0
+            running = 0
+        degraded_s = self._time_in_degraded
+        if self._degraded_since is not None:
+            degraded_s += time.monotonic() - self._degraded_since
+        return {
+            "state": state.value,
+            "alive": state_is_alive(state.value),
+            "ready": state_is_ready(state.value),
+            "crashes": self.total_crashes,
+            "restarts": self.total_restarts,
+            "quarantined": len(self._quarantine),
+            "queue_depth": queue_depth,
+            "running": running,
+            "time_in_degraded_s": round(degraded_s, 3),
+            "last_fatal": self.last_fatal,
+            "transitions": list(self.transitions[-8:]),
+        }
+
+    def device_health(self) -> Dict[str, Any]:
+        if self.state is HealthState.DEAD:
+            return {"alive": False, "state": "dead", "error": self.last_fatal}
+        out = self.core.device_health()
+        out["state"] = self.state.value
+        return out
+
+    def get_stats(self) -> Dict[str, Any]:
+        try:
+            stats = self.core.get_stats()
+        except Exception:  # mid-rebuild
+            stats = {}
+        stats["supervisor"] = self.health()
+        armed = faults.snapshot()
+        if armed:
+            stats["faults_armed"] = armed
+        return stats
+
+    def __getattr__(self, name: str) -> Any:
+        # serving surface not intercepted above (tokenizer, spec, mesh,
+        # geometry, warmup, final_text, logprob_entries, ...) delegates
+        # to the live core.  __getattr__ only fires for attributes not
+        # found on the supervisor itself; guard against recursion while
+        # __init__ is still building the first core.
+        core = self.__dict__.get("core")
+        if core is None:
+            raise AttributeError(name)
+        return getattr(core, name)

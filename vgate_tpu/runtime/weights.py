@@ -199,6 +199,9 @@ def load_or_init_params(
     seed: int = 0,
 ) -> Params:
     """Checkpoint when available, random init otherwise (zero-egress path)."""
+    from vgate_tpu import faults
+
+    faults.check("weight_load", payload=checkpoint_path)
     if checkpoint_path and os.path.isdir(checkpoint_path):
         return params_from_safetensors(spec, checkpoint_path, dtype)
     from vgate_tpu.models.decoder import init_params

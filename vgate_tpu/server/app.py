@@ -27,6 +27,12 @@ from vgate_tpu import metrics
 from vgate_tpu.batcher import RequestBatcher
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.engine import VGTEngine
+from vgate_tpu.errors import (
+    PoisonRequestError,
+    RetryableError,
+    state_is_alive,
+    state_is_ready,
+)
 from vgate_tpu.logging_config import get_logger, setup_logging
 from vgate_tpu.runtime.scheduler import EngineBusyError
 from vgate_tpu.security import build_security_middleware
@@ -51,7 +57,7 @@ from vgate_tpu.version import __version__
 logger = get_logger(__name__)
 tracer = get_tracer(__name__)
 
-_QUIET_PATHS = {"/health", "/metrics"}
+_QUIET_PATHS = {"/health", "/health/live", "/health/ready", "/metrics"}
 
 
 def _error(status: int, message: str, err_type: str) -> web.Response:
@@ -117,13 +123,53 @@ async def observability_middleware(request: web.Request, handler):
     return response
 
 
+def _retry_after(exc: BaseException, default: float = 1.0) -> str:
+    """Whole-second ``Retry-After`` header value from an error's hint."""
+    return str(max(1, int(round(getattr(exc, "retry_after", default)))))
+
+
+def _engine_health(engine: Optional[VGTEngine]) -> Dict[str, Any]:
+    """Engine liveness/state block — ALWAYS present in /health, even for
+    backends without device_health (satellite fix): state-machine
+    position (runtime/supervisor.py) and scheduler queue depth."""
+    if engine is None:
+        return {"state": "starting", "alive": False, "ready": False}
+    health_fn = getattr(engine.backend, "serving_health", None)
+    if health_fn is not None:
+        try:
+            return health_fn()
+        except Exception:
+            logger.error("serving_health failed", exc_info=True)
+            return {"state": "dead", "alive": False, "ready": False}
+    # backends without the full recovery surface (dry-run, vllm,
+    # sglang): use their state string when they expose one, else
+    # loaded == serving
+    state_fn = getattr(engine.backend, "serving_state", None)
+    state = state_fn() if state_fn is not None else "serving"
+    return {
+        "state": state,
+        "alive": state_is_alive(state),
+        "ready": state_is_ready(state),
+        "queue_depth": 0,
+    }
+
+
 async def health(request: web.Request) -> web.Response:
-    """Liveness/readiness (reference: main.py:199-204); additionally reports
-    device liveness per SURVEY.md section 5.3's gap note."""
+    """Combined health report (reference: main.py:199-204) — readiness
+    semantics: 200 only while the engine can accept work.  Split probes
+    live at /health/live and /health/ready (docs/operations.md)."""
     engine: Optional[VGTEngine] = request.app.get("engine")
+    eng = _engine_health(engine)
+    batcher: Optional[RequestBatcher] = request.app.get("batcher")
+    if batcher is not None:
+        eng["batcher_pending"] = len(batcher._queue)
     body: Dict[str, Any] = {
-        "status": "ok" if engine is not None else "starting",
+        "status": (
+            "ok" if eng.get("ready")
+            else ("starting" if engine is None else eng["state"])
+        ),
         "version": __version__,
+        "engine": eng,
     }
     if engine is not None:
         body["model"] = engine.config.model.model_id
@@ -131,8 +177,40 @@ async def health(request: web.Request) -> web.Response:
         device_health = getattr(engine.backend, "device_health", None)
         if device_health is not None:
             body["device"] = device_health()
-    status = 200 if engine is not None else 503
-    return web.json_response(body, status=status)
+    status = 200 if eng.get("ready") else 503
+    resp = web.json_response(body, status=status)
+    if status == 503:
+        resp.headers["Retry-After"] = "5"
+    return resp
+
+
+async def health_live(request: web.Request) -> web.Response:
+    """Liveness probe: 200 unless the health state machine is DEAD (the
+    orchestrator should then recycle the pod).  Startup and RECOVERING
+    are alive — killing a pod mid-recovery only loses the warm weights."""
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    eng = _engine_health(engine)
+    alive = engine is None or eng.get("alive", True)
+    return web.json_response(
+        {"status": "ok" if alive else "dead", "engine": eng},
+        status=200 if alive else 503,
+    )
+
+
+async def health_ready(request: web.Request) -> web.Response:
+    """Readiness probe: 200 only in SERVING/DEGRADED — while RECOVERING
+    or DEAD the pod must leave the load-balancer set instead of queuing
+    traffic into a dead engine."""
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    eng = _engine_health(engine)
+    ready = engine is not None and eng.get("ready", False)
+    resp = web.json_response(
+        {"status": "ok" if ready else eng["state"], "engine": eng},
+        status=200 if ready else 503,
+    )
+    if not ready:
+        resp.headers["Retry-After"] = "5"
+    return resp
 
 
 def _build_prompt(engine: VGTEngine, messages) -> str:
@@ -186,9 +264,18 @@ async def _settle_submits(engine: VGTEngine, coros):
             f"({engine.config.server.request_timeout_s:.0f}s)",
             "timeout_error",
         )
+    except PoisonRequestError as exc:
+        # quarantined: resending can never succeed, so NOT retryable
+        return None, _error(400, str(exc), "invalid_request_error")
+    except RetryableError as exc:
+        # engine crashed/restarting (or dead): retryable 503 carrying
+        # the server-suggested backoff
+        resp = _error(503, f"Engine unavailable: {exc}", "overloaded_error")
+        resp.headers["Retry-After"] = _retry_after(exc)
+        return None, resp
     except EngineBusyError as exc:
         resp = _error(503, f"Engine overloaded: {exc}", "overloaded_error")
-        resp.headers["Retry-After"] = "1"
+        resp.headers["Retry-After"] = _retry_after(exc)
         return None, resp
     except Exception as exc:
         return None, _error(500, f"Inference failed: {exc}", "server_error")
@@ -413,6 +500,22 @@ async def _stream_chat(
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
+        except (RetryableError, PoisonRequestError) as exc:
+            # engine crashed mid-stream (or the prompt is quarantined):
+            # the 200 is already on the wire, so the failure travels as
+            # an SSE error event the client can act on
+            err_type = (
+                "invalid_request_error"
+                if isinstance(exc, PoisonRequestError)
+                else "overloaded_error"
+            )
+            await resp.write(
+                f'data: {{"error": {{"message": {json.dumps(str(exc))}, '
+                f'"type": "{err_type}"}}}}\n\n'.encode()
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
     else:
         try:
             result = await batcher.submit(
@@ -432,14 +535,18 @@ async def _stream_chat(
                 presence_penalty=payload.presence_penalty or 0.0,
                 logit_bias=logit_bias,
             )
-        except (asyncio.TimeoutError, EngineBusyError) as exc:
+        except (
+            asyncio.TimeoutError, EngineBusyError, RetryableError,
+            PoisonRequestError,
+        ) as exc:
             # the 200 + role chunk are already on the wire: deliver the
             # failure as an SSE error event, not a reset connection
-            err_type = (
-                "timeout_error"
-                if isinstance(exc, asyncio.TimeoutError)
-                else "overloaded_error"
-            )
+            if isinstance(exc, asyncio.TimeoutError):
+                err_type = "timeout_error"
+            elif isinstance(exc, PoisonRequestError):
+                err_type = "invalid_request_error"
+            else:
+                err_type = "overloaded_error"
             await resp.write(
                 f'data: {{"error": {{"message": "{err_type}", '
                 f'"type": "{err_type}"}}}}\n\n'.encode()
@@ -736,16 +843,25 @@ async def run_benchmark(request: web.Request) -> web.Response:
     latencies: list[float] = []
     total_tokens = 0
     bench_start = time.perf_counter()
-    for _ in range(rounds):
-        starts = time.perf_counter()
-        results = await asyncio.gather(
-            *[
-                batcher.submit(prompt, max_tokens=max_tokens)
-                for prompt in prompts
-            ]
-        )
-        latencies.append(time.perf_counter() - starts)
-        total_tokens += sum(r.get("num_tokens", 0) for r in results)
+    try:
+        for _ in range(rounds):
+            starts = time.perf_counter()
+            results = await asyncio.gather(
+                *[
+                    batcher.submit(prompt, max_tokens=max_tokens)
+                    for prompt in prompts
+                ]
+            )
+            latencies.append(time.perf_counter() - starts)
+            total_tokens += sum(r.get("num_tokens", 0) for r in results)
+    except PoisonRequestError as exc:
+        return _error(400, str(exc), "invalid_request_error")
+    except (RetryableError, EngineBusyError) as exc:
+        # batcher.submit raises these routinely while the engine is
+        # recovering — map them like every other handler instead of a 500
+        resp = _error(503, f"Engine unavailable: {exc}", "overloaded_error")
+        resp.headers["Retry-After"] = _retry_after(exc)
+        return resp
     wall = time.perf_counter() - bench_start
     latencies_ms = sorted(l * 1000 for l in latencies)
     return web.json_response(
@@ -867,6 +983,8 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     )
     app["config"] = config
     app.router.add_get("/health", health)
+    app.router.add_get("/health/live", health_live)
+    app.router.add_get("/health/ready", health_ready)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)

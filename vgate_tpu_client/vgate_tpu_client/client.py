@@ -187,7 +187,10 @@ class VGT:
                 time.sleep(retry_after)
                 continue
             if response.status_code >= 500 and attempt < self.max_retries:
-                time.sleep(2 ** attempt)
+                # 503s from admission shed / engine recovery carry a
+                # server-suggested Retry-After; honor it like on 429
+                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
+                time.sleep(retry_after)
                 continue
             _raise_for_status(response)
             return response.json()
@@ -351,7 +354,9 @@ class AsyncVGT:
                 await asyncio.sleep(retry_after)
                 continue
             if response.status_code >= 500 and attempt < self.max_retries:
-                await asyncio.sleep(2 ** attempt)
+                # honor the server-suggested Retry-After on 5xx too
+                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
+                await asyncio.sleep(retry_after)
                 continue
             _raise_for_status(response)
             return response.json()
