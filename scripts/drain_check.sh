@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Graceful-drain gate (sibling of chaos_check.sh): start the server on
+# the dry-run backend, put slow in-flight load on it, SIGTERM it
+# mid-flight, and assert
+#   1. /health/ready flips to 503 ("draining") while /health/live stays 200,
+#   2. new admissions are rejected 503 + Retry-After,
+#   3. ZERO in-flight responses drop — every request that was accepted
+#      before SIGTERM completes with 200,
+#   4. the process exits cleanly within the drain window.
+#
+# Usage: scripts/drain_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${1:-8731}"
+export JAX_PLATFORMS=cpu
+export VGT_DRY_RUN=1
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_BATCH__MAX_WAIT_TIME_MS=100
+export VGT_BATCH__MAX_BATCH_SIZE=64
+export VGT_LIFECYCLE__DRAIN_TIMEOUT_S=20
+# deterministic in-flight window: every generate call sleeps 2s via the
+# backend_generate fault probe, so SIGTERM provably lands mid-flight and
+# the drain-state probes have a real window to observe
+export VGT_FAULTS="backend_generate:delay:delay=2:times=-1"
+
+python main.py &
+SERVER_PID=$!
+trap 'kill -9 $SERVER_PID 2>/dev/null || true' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+for _ in $(seq 1 100); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: server never became ready"; exit 1; }
+
+python - "$BASE" "$SERVER_PID" <<'EOF'
+import asyncio, json, os, signal, sys, time
+import aiohttp
+
+BASE, SERVER_PID = sys.argv[1], int(sys.argv[2])
+N = 12
+
+
+async def fire(session, i):
+    try:
+        async with session.post(
+            f"{BASE}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": f"drain probe {i}"}],
+                "max_tokens": 8,
+            },
+        ) as resp:
+            await resp.json()
+            return resp.status
+    except aiohttp.ClientError as exc:
+        return f"dropped ({exc})"
+
+
+async def main():
+    async with aiohttp.ClientSession() as session:
+        inflight = [asyncio.ensure_future(fire(session, i)) for i in range(N)]
+        # the batch fires within max_wait_time_ms=100 and then sits in
+        # the armed 2s backend delay; SIGTERM provably lands mid-flight
+        await asyncio.sleep(0.3)
+        os.kill(SERVER_PID, signal.SIGTERM)
+        await asyncio.sleep(0.2)
+
+        async with session.get(f"{BASE}/health/ready") as resp:
+            body = await resp.json()
+            assert resp.status == 503, f"ready={resp.status} during drain"
+            assert body["engine"]["state"] == "draining", body
+            assert "Retry-After" in resp.headers
+        async with session.get(f"{BASE}/health/live") as resp:
+            assert resp.status == 200, "liveness must hold during drain"
+        async with session.post(
+            f"{BASE}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "late"}]},
+        ) as resp:
+            assert resp.status == 503, (
+                f"admission during drain got {resp.status}, want 503"
+            )
+            assert "Retry-After" in resp.headers
+
+        statuses = await asyncio.gather(*inflight)
+        dropped = [s for s in statuses if s != 200]
+        assert not dropped, f"in-flight responses dropped: {dropped}"
+        print(f"PASS: {N}/{N} in-flight requests completed through the drain")
+
+
+asyncio.run(main())
+EOF
+
+# the drain must end in a clean process exit within the window
+for _ in $(seq 1 100); do
+  if ! kill -0 "$SERVER_PID" 2>/dev/null; then break; fi
+  sleep 0.3
+done
+if kill -0 "$SERVER_PID" 2>/dev/null; then
+  echo "FAIL: server still running after drain window"
+  exit 1
+fi
+wait "$SERVER_PID" 2>/dev/null || true
+trap - EXIT
+echo "PASS: drain_check complete (ready flipped, zero drops, clean exit)"

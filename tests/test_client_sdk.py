@@ -14,6 +14,7 @@ sys.path.insert(0, str(Path(__file__).parent.parent / "vgate_tpu_client"))
 from vgate_tpu_client import (  # noqa: E402
     AsyncVGT,
     AuthenticationError,
+    DeadlineExceeded,
     RateLimitError,
     ServerError,
     VGT,
@@ -249,6 +250,77 @@ def test_chat_create_sends_new_sampling_fields():
     assert seen["min_tokens"] == 4
     assert seen["stop_token_ids"] == [7, 9]
     assert result.choices[0].logprobs["content"][0]["logprob"] == -0.5
+
+
+def test_timeout_kwarg_sets_header():
+    """chat.create(timeout=...) sends X-Request-Timeout (server-side
+    deadline) plus a per-request transport timeout with margin."""
+    seen = {}
+
+    def handler(request):
+        seen["header"] = request.headers.get("X-Request-Timeout")
+        seen["timeout"] = request.extensions.get("timeout")
+        return httpx.Response(200, json=CHAT_RESPONSE)
+
+    client = make_client(handler)
+    client.chat.create([{"role": "user", "content": "hi"}], timeout=2.5)
+    assert seen["header"] == "2.5"
+    # transport timeout = deadline + margin, so the server's typed 504
+    # beats the socket timeout even when an engine tick stalls the shed
+    # (margin must exceed the server's ~30s engine-shed grace)
+    assert seen["timeout"]["read"] == pytest.approx(37.5)
+
+
+def test_embeddings_timeout_kwarg_sets_header():
+    seen = {}
+
+    def handler(request):
+        seen["header"] = request.headers.get("X-Request-Timeout")
+        return httpx.Response(
+            200,
+            json={
+                "object": "list",
+                "data": [],
+                "model": "bge",
+                "usage": {"prompt_tokens": 0, "completion_tokens": 0,
+                          "total_tokens": 0},
+            },
+        )
+
+    client = make_client(handler)
+    client.embeddings.create("hello", timeout=1.0)
+    assert seen["header"] == "1.0"
+
+
+def test_504_maps_to_deadline_exceeded_without_retry():
+    """A 504 raises the typed DeadlineExceeded carrying the server's
+    partial-generation metadata, and is NOT retried — the same request
+    would blow the same budget."""
+    calls = {"n": 0}
+
+    def handler(request):
+        calls["n"] += 1
+        return httpx.Response(
+            504,
+            json={
+                "error": {
+                    "message": "deadline passed mid-generation",
+                    "type": "timeout_error",
+                    "partial_tokens": 17,
+                    "partial_text": "the partial...",
+                }
+            },
+        )
+
+    client = make_client(handler, max_retries=2)
+    with pytest.raises(DeadlineExceeded) as err:
+        client.chat.create(
+            [{"role": "user", "content": "x"}], timeout=0.05
+        )
+    assert calls["n"] == 1  # no retry on deadline
+    assert err.value.status_code == 504
+    assert err.value.partial_tokens == 17
+    assert err.value.partial_text == "the partial..."
 
 
 def test_completions_resource_roundtrip():

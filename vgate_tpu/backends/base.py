@@ -48,6 +48,13 @@ class SamplingParams:
     # OpenAI logit_bias: {token_id: additive bias in [-100, 100]},
     # applied to the logits before sampling at every position.
     logit_bias: Optional[Dict[int, float]] = None
+    # End-to-end request deadline in seconds, measured from engine
+    # arrival.  The engine sheds the sequence between decode ticks once
+    # it passes (DeadlineExceededError with partial-tokens metadata →
+    # 504 at the gateway).  None = only server.request_timeout_s
+    # applies.  NOT part of the result-cache identity: a completed
+    # result is the same whatever budget produced it.
+    timeout_s: Optional[float] = None
 
     @property
     def has_penalties(self) -> bool:
@@ -118,6 +125,12 @@ class DryRunBackend:
         prompts: Sequence[str],
         sampling_params: Sequence[SamplingParams],
     ) -> List[GenerationResult]:
+        # same named fault point the jax backend probes: lets chaos/drain
+        # drills inject latency or failures into dry-run serving too
+        # (scripts/drain_check.sh arms backend_generate:delay)
+        from vgate_tpu import faults
+
+        faults.check("backend_generate")
         self.calls += 1
         start = time.perf_counter()
         results = []

@@ -167,26 +167,58 @@ class JaxTPUBackend:
             self.core.stop()
             self.core = None
 
+    def abort_in_flight(self, reason: str = "drain") -> None:
+        """Graceful-drain straggler sweep: ask the engine thread to
+        request-abort every resident sequence at its next tick
+        (supervised cores delegate to the live EngineCore)."""
+        if self.core is None:
+            return
+        fn = getattr(self.core, "abort_in_flight", None)
+        if fn is not None:
+            fn(reason)
+
     # -- async extensions used by the gateway --
 
     async def generate_settled_async(
         self,
         prompts: Sequence[str],
         sampling_params: Sequence[SamplingParams],
+        cancel_tokens: Optional[Sequence[Any]] = None,
     ) -> List[Any]:
         """Like ``generate_async`` but failures are returned per slot (the
         exception object in place of a GenerationResult) instead of failing
         the whole batch — one deadline-shed or failed sequence must not
-        discard its co-batched neighbours' completed generations."""
+        discard its co-batched neighbours' completed generations.
+
+        ``cancel_tokens`` (one ``lifecycle.CancelToken`` or None per
+        prompt) is the request-scoped cancellation plumbing: a token
+        cancelled while its sequence decodes aborts exactly that
+        sequence — slot and KV pages free within one engine tick — and
+        its slot settles with finish_reason "abort" while batchmates
+        keep decoding.  This closes the gap where batched gateway
+        traffic ran under the batcher's own task and a client
+        disconnect left the sequence decoding to completion."""
         assert self.core is not None
         faults.check("backend_generate")
         loop = asyncio.get_running_loop()
         seqs = []
-        for p, sp in zip(prompts, sampling_params):
+        for i, (p, sp) in enumerate(zip(prompts, sampling_params)):
             try:
-                seqs.append(self.core.submit_prompt(p, sp))
+                seq = self.core.submit_prompt(p, sp)
             except Exception as exc:  # queue full / dead engine
                 seqs.append(exc)
+                continue
+            token = cancel_tokens[i] if cancel_tokens else None
+            if token is not None:
+                # fires immediately when the client vanished between
+                # enqueue and dispatch (add_callback runs late
+                # registrants inline)
+                token.add_callback(
+                    lambda s=seq, t=token: s.request_abort(
+                        t.reason or "client_disconnect"
+                    )
+                )
+            seqs.append(seq)
 
         def wait_all():
             for seq in seqs:
@@ -197,10 +229,8 @@ class JaxTPUBackend:
             await loop.run_in_executor(None, wait_all)
         except asyncio.CancelledError:
             # the awaiting task died (client disconnect on a direct
-            # caller) — release the engine work it was waiting on.
-            # NB batched gateway traffic runs under the batcher's own
-            # task, which client disconnects do NOT cancel; per-request
-            # cancellation there would need request-scoped plumbing.
+            # caller, or the whole batch task torn down) — release the
+            # engine work it was waiting on
             for seq in seqs:
                 if not isinstance(seq, BaseException):
                     seq.request_abort()

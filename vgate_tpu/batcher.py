@@ -28,6 +28,7 @@ Deliberate departures from the reference:
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -38,12 +39,29 @@ from vgate_tpu.backends.base import GenerationResult, SamplingParams
 from vgate_tpu.cache import ResultCache
 from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.engine import VGTEngine
-from vgate_tpu.errors import EngineRecoveringError, raise_for_state
+from vgate_tpu.errors import (
+    ClientDisconnectError,
+    EngineRecoveringError,
+    ServerDrainingError,
+    raise_for_state,
+)
+from vgate_tpu.lifecycle import CancelToken, all_of
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.tracing import get_tracer
 
 logger = get_logger(__name__)
 tracer = get_tracer(__name__)
+
+# finish_reasons that mark a PARTIAL generation (cancelled or
+# deadline-shed): never stored in the ResultCache — a later identical
+# request must get the full completion, not a truncated replay
+UNCACHEABLE_FINISH = frozenset({"abort", "deadline"})
+
+# extra wait past a request's deadline when the ENGINE enforces it (a
+# typed shed with partial metadata is coming; it trails the nominal
+# deadline by up to a tick, which a first-contact compile can stretch
+# to seconds).  Pure safety net against enforcement failing outright.
+ENGINE_SHED_GRACE_S = 30.0
 
 
 @dataclass
@@ -56,6 +74,19 @@ class BatchRequest:
     cache_key: str
     future: "asyncio.Future[Dict[str, Any]]"
     enqueued_at: float = field(default_factory=time.perf_counter)
+    # client-disconnect propagation: queued → dequeue + fail fast;
+    # dispatched → the backend registered seq.request_abort on it
+    token: Optional[CancelToken] = None
+    # absolute deadline (enqueued_at + timeout_s); dedup groups pick the
+    # member with the MOST headroom as lead so a short-deadline twin
+    # can't shed a patient one's generation
+    deadline_t: Optional[float] = None
+    # set at dispatch when THIS request's params (deadline included)
+    # reached an engine that sheds past-deadline sequences itself —
+    # true for group leads on the async engine path.  Non-leads (their
+    # tighter deadline is NOT the one the engine enforces) and sync
+    # backends keep False, so their backstop fires exactly on time.
+    engine_enforced: bool = False
 
 
 class RequestBatcher:
@@ -78,6 +109,12 @@ class RequestBatcher:
         # set by stop(): submissions racing shutdown must fail fast, not
         # enqueue behind the leftover sweep and hang
         self._stopped = False
+        # set by begin_drain() (SIGTERM): new submissions are rejected
+        # with a retryable 503 while in-flight work runs to completion
+        self._draining = False
+        self._drain_retry_after = 2.0
+        # memoized: does the backend's settled path accept cancel_tokens?
+        self._settled_takes_tokens: Optional[bool] = None
         # Backends without generate_async share one worker hop at a time
         # (the reference's global _inference_lock, batcher.py:79).
         self._sync_lock = asyncio.Lock()
@@ -136,6 +173,33 @@ class RequestBatcher:
                     )
                 )
 
+    # -- graceful drain (vgate_tpu/lifecycle.py DrainController) --
+
+    def begin_drain(self, retry_after_s: float = 2.0) -> None:
+        """SIGTERM: stop admitting (new submissions raise the retryable
+        ``ServerDrainingError`` → 503 + Retry-After) while queued and
+        dispatched work keeps flowing to completion."""
+        self._draining = True
+        self._drain_retry_after = retry_after_s
+
+    def fail_pending(self, exc: Optional[BaseException] = None) -> int:
+        """Drain-timeout straggler sweep: fail every still-QUEUED future
+        (dispatched work is the engine's ``abort_in_flight``).  Sync and
+        loop-thread-only by design — it must run to completion without
+        yielding so no batch fire can interleave."""
+        exc = exc or ServerDrainingError(
+            "server shut down before the request could run",
+            retry_after=self._drain_retry_after,
+        )
+        leftovers, self._queue[:] = self._queue[:], []
+        metrics.PENDING_REQUESTS.set(0)
+        failed = 0
+        for req in leftovers:
+            if not req.future.done():
+                req.future.set_exception(exc)
+                failed += 1
+        return failed
+
     # -- submission (reference: vgate/batcher.py:116-182) --
 
     async def submit(
@@ -157,7 +221,12 @@ class RequestBatcher:
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
         logit_bias: Optional[Dict[int, float]] = None,
+        cancel_token: Optional[CancelToken] = None,
     ) -> Dict[str, Any]:
+        if self._draining:
+            raise ServerDrainingError(
+                retry_after=self._drain_retry_after
+            )
         inf = self.config.inference
         params = SamplingParams(
             max_tokens=max_tokens if max_tokens is not None else inf.max_tokens,
@@ -175,6 +244,10 @@ class RequestBatcher:
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
+            # the engine sheds past-deadline sequences between decode
+            # ticks (504 + partial-tokens metadata); excluded from the
+            # cache key below — completed results don't depend on it
+            timeout_s=timeout_s,
         )
         with tracer.start_as_current_span("batcher.submit"):
             self._total_requests += 1
@@ -233,6 +306,12 @@ class RequestBatcher:
                 params=params,
                 cache_key=cache_key,
                 future=asyncio.get_running_loop().create_future(),
+                token=cancel_token,
+                deadline_t=(
+                    time.perf_counter() + timeout_s
+                    if timeout_s is not None
+                    else None
+                ),
             )
             async with self._queue_lock:
                 if self._stopped:
@@ -244,22 +323,118 @@ class RequestBatcher:
                 self._queue.append(request)
                 metrics.PENDING_REQUESTS.set(len(self._queue))
                 trigger = len(self._queue) >= self.config.batch.max_batch_size
+            if cancel_token is not None:
+                # client disconnect: a queued request dequeues + fails
+                # fast; a dispatched one is aborted by the backend (it
+                # registered seq.request_abort on this same token)
+                cancel_token.add_callback(
+                    lambda: self._on_cancel(request)
+                )
             if trigger:
                 asyncio.ensure_future(self._process_batch())
-            if timeout_s is None:
-                return await request.future
             try:
-                return await asyncio.wait_for(request.future, timeout_s)
-            except asyncio.TimeoutError:
-                # shed the abandoned work: a still-queued request must not
-                # occupy a future batch (its client is gone — generating
-                # the completion would amplify the overload).  If already
-                # dispatched, the engine finishes it; only the wait ends.
+                if timeout_s is None:
+                    return await request.future
+                try:
+                    # shield: a wait_for timeout must not CANCEL the
+                    # future — the engine-enforced branch below keeps
+                    # awaiting it, and the engine's typed shed still
+                    # needs somewhere to land
+                    return await asyncio.wait_for(
+                        asyncio.shield(request.future), timeout_s
+                    )
+                except asyncio.TimeoutError:
+                    pass
+                if request.engine_enforced:
+                    # THIS request's deadline reached the engine (it
+                    # led its dispatch group), so a typed
+                    # DeadlineExceededError with partial metadata is
+                    # imminent — the shed can trail the nominal
+                    # deadline by a tick, and a first-contact XLA
+                    # compile can stretch one tick to seconds.  Wait it
+                    # out generously rather than race it with a
+                    # metadata-less 504; the outer timeout below is
+                    # only the safety net for enforcement failing
+                    # entirely.  Non-leads (a tighter deadline the
+                    # engine is NOT enforcing), sync backends and
+                    # still-queued requests get no grace: their wait IS
+                    # the deadline.
+                    # a grace timeout propagates as TimeoutError and
+                    # correctly skips the queue-removal below (an
+                    # engine-enforced request was already dispatched)
+                    return await asyncio.wait_for(
+                        request.future, ENGINE_SHED_GRACE_S
+                    )
+                # giving up: settle the future so later batch fan-out
+                # skips it, and shed the abandoned work — a still-queued
+                # request must not occupy a future batch (its client is
+                # gone; generating the completion would amplify the
+                # overload).  If already dispatched, the engine finishes
+                # it; only the wait ends.
+                request.future.cancel()
                 async with self._queue_lock:
                     if request in self._queue:
                         self._queue.remove(request)
                         metrics.PENDING_REQUESTS.set(len(self._queue))
+                raise asyncio.TimeoutError()
+            except asyncio.CancelledError:
+                # the AWAITING TASK died — aiohttp cancels handler tasks
+                # on client disconnect when handler_cancellation is on
+                # (the gateway's watcher covers the default-off case),
+                # or a direct caller was torn down.  Fire the token so
+                # queued work dequeues and dispatched work aborts in the
+                # engine instead of decoding for nobody.
+                if cancel_token is not None:
+                    cancel_token.cancel("client_disconnect")
+                elif request in self._queue:
+                    # sync removal, no await: a cancelled task must not
+                    # block on the queue lock (it can be re-cancelled),
+                    # and list mutation on the loop thread is atomic
+                    # with respect to every coroutine critical section
+                    self._queue.remove(request)
+                    metrics.PENDING_REQUESTS.set(len(self._queue))
+                    metrics.CANCELLED_REQUESTS.labels(
+                        reason="client_disconnect"
+                    ).inc()
                 raise
+
+    def _on_cancel(self, request: BatchRequest) -> None:
+        """CancelToken callback (runs on the canceller's thread — the
+        event loop for the gateway's disconnect watcher): dequeue a
+        still-queued request and fail its future fast.  Dispatched
+        requests are the backend's job (it registered the engine abort
+        on the same token)."""
+        if request.future.done():
+            return
+        try:
+            loop = request.future.get_loop()
+        except RuntimeError:  # pragma: no cover - future already dead
+            return
+        loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(self._drop_cancelled(request))
+        )
+
+    async def _drop_cancelled(self, request: BatchRequest) -> None:
+        async with self._queue_lock:
+            if request in self._queue:
+                self._queue.remove(request)
+                metrics.PENDING_REQUESTS.set(len(self._queue))
+                # released HERE (never dispatched): count the
+                # cancellation at this site; dispatched requests are
+                # counted by the engine's abort path instead
+                metrics.CANCELLED_REQUESTS.labels(
+                    reason="client_disconnect"
+                ).inc()
+        if not request.future.done():
+            request.future.set_exception(
+                ClientDisconnectError(
+                    "client disconnected before the request completed"
+                )
+            )
+            # the waiter may already be dead (handler task cancelled on
+            # disconnect): mark the exception retrieved so GC doesn't
+            # log "exception was never retrieved"
+            request.future.exception()
 
     # -- batch firing (reference: vgate/batcher.py:184-324) --
 
@@ -286,7 +461,20 @@ class RequestBatcher:
             groups: Dict[str, List[BatchRequest]] = {}
             for req in batch:
                 groups.setdefault(req.cache_key, []).append(req)
-            unique = [reqs[0] for reqs in groups.values()]
+            # the group lead's SamplingParams reach the engine, deadline
+            # included — so lead = the member with the MOST headroom
+            # (None = unbounded), or a 50ms-deadline twin would shed a
+            # patient client's generation with it
+            unique = [
+                max(
+                    reqs,
+                    key=lambda r: (
+                        r.deadline_t is None,
+                        r.deadline_t or 0.0,
+                    ),
+                )
+                for reqs in groups.values()
+            ]
             n_duplicates = len(batch) - len(unique)
             self._total_deduped += n_duplicates
             if n_duplicates:
@@ -300,7 +488,7 @@ class RequestBatcher:
             span.set_attribute("batch.unique", len(unique))
 
             try:
-                results = await self._run_batch_inference(unique)
+                results = await self._run_batch_inference(unique, groups)
             except Exception as exc:  # fail the whole batch (batcher.py:310-324)
                 metrics.INFERENCE_ERRORS.labels(
                     error_type=type(exc).__name__
@@ -329,7 +517,11 @@ class RequestBatcher:
                             req.future.set_exception(result)
                     continue
                 payload = self._normalize(lead, result)
-                await self.cache.put(lead.cache_key, payload)
+                if payload.get("finish_reason") not in UNCACHEABLE_FINISH:
+                    # cancelled/deadline-shed results are PARTIAL: caching
+                    # one would replay a truncated generation to every
+                    # later identical request
+                    await self.cache.put(lead.cache_key, payload)
                 for req in groups[lead.cache_key]:
                     if not req.future.done():
                         out = dict(payload)
@@ -340,20 +532,79 @@ class RequestBatcher:
                         req.future.set_result(out)
 
     async def _run_batch_inference(
-        self, unique: List[BatchRequest]
+        self,
+        unique: List[BatchRequest],
+        groups: Optional[Dict[str, List[BatchRequest]]] = None,
     ) -> List[GenerationResult]:
         """Dispatch to the backend, preferring its async path
         (reference thread hop: vgate/batcher.py:326-399)."""
         prompts = [req.prompt for req in unique]
-        params = [req.params for req in unique]
+        # re-anchor each deadline to the REMAINING budget at dispatch:
+        # the engine measures timeout_s from its own arrival, so without
+        # this, time spent queued here would silently extend the
+        # client's end-to-end deadline — and under congestion the
+        # metadata-less gateway backstop would beat the typed engine
+        # shed (partial_tokens) exactly when clients most need it
+        now = time.perf_counter()
+        params = [
+            req.params
+            if req.deadline_t is None
+            else dataclasses.replace(
+                req.params,
+                timeout_s=max(0.001, req.deadline_t - now),
+            )
+            for req in unique
+        ]
         backend = self.engine.backend
         with tracer.start_as_current_span("batcher.inference"):
             # prefer the settled path: per-request failures (deadline shed,
             # queue full) stay per-request instead of failing the batch
             gen_settled = getattr(backend, "generate_settled_async", None)
-            if gen_settled is not None:
-                return await gen_settled(prompts, params)
             gen_async = getattr(backend, "generate_async", None)
+            if gen_settled is not None or gen_async is not None:
+                # the engine will enforce each LEAD's deadline (its
+                # params carry it); a deduped non-lead with a tighter
+                # deadline stays un-enforced and its submit() backstop
+                # fires exactly on time instead of waiting out the
+                # engine-shed grace
+                for req in unique:
+                    if req.deadline_t is not None:
+                        req.engine_enforced = True
+            if gen_settled is not None:
+                if self._settled_takes_tokens is None:
+                    import inspect
+
+                    try:
+                        self._settled_takes_tokens = (
+                            "cancel_tokens"
+                            in inspect.signature(gen_settled).parameters
+                        )
+                    except (TypeError, ValueError):
+                        self._settled_takes_tokens = False
+                if self._settled_takes_tokens and any(
+                    req.token is not None for req in unique
+                ):
+                    # per dedup GROUP, not per lead: the shared
+                    # generation aborts only when EVERY member's client
+                    # cancelled — one disconnected twin must not
+                    # truncate a still-connected twin's completion
+                    tokens = [
+                        all_of(
+                            [
+                                r.token
+                                for r in (
+                                    groups[lead.cache_key]
+                                    if groups
+                                    else [lead]
+                                )
+                            ]
+                        )
+                        for lead in unique
+                    ]
+                    return await gen_settled(
+                        prompts, params, cancel_tokens=tokens
+                    )
+                return await gen_settled(prompts, params)
             if gen_async is not None:
                 return await gen_async(prompts, params)
             async with self._sync_lock:

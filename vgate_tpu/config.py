@@ -308,6 +308,28 @@ class RecoveryConfig(BaseModel):
     poison_threshold: int = 2
 
 
+class LifecycleConfig(BaseModel):
+    """Graceful shutdown/drain (server/app.py + vgate_tpu/lifecycle.py):
+    SIGTERM flips /health/ready to 503 ("draining"), admission stops
+    with Retry-After, in-flight requests run to completion up to
+    ``drain_timeout_s``, stragglers are aborted, then the process exits.
+    Wired to the k8s preStop hook + terminationGracePeriodSeconds
+    (k8s/base/deployment.yaml; docs/operations.md)."""
+
+    # Install the SIGTERM drain handler when serving (main/run_app).
+    # Off => aiohttp's default immediate-teardown SIGTERM behavior.
+    drain_enabled: bool = True
+    # In-flight requests get this long to finish after SIGTERM before
+    # being aborted.  terminationGracePeriodSeconds must exceed
+    # preStop sleep + this + a teardown margin.
+    drain_timeout_s: float = 30.0
+    # Drain-completion poll cadence.
+    drain_poll_ms: float = 50.0
+    # Retry-After suggested to clients shed during the drain (they
+    # should land on another replica once the LB converges).
+    drain_retry_after_s: float = 2.0
+
+
 class InferenceConfig(BaseModel):
     """Default sampling parameters (reference: vgate/config.py:74-80)."""
 
@@ -383,6 +405,7 @@ class VGTConfig(BaseModel):
     cache: CacheConfig = Field(default_factory=CacheConfig)
     scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
+    lifecycle: LifecycleConfig = Field(default_factory=LifecycleConfig)
     inference: InferenceConfig = Field(default_factory=InferenceConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     metrics: MetricsConfig = Field(default_factory=MetricsConfig)

@@ -10,6 +10,11 @@ The engine's fault handling distinguishes three client-visible outcomes:
 * **Poison** — the request itself is the suspected crash cause and has
   been quarantined (vgate_tpu/runtime/supervisor.py); resending it will
   never succeed, so the gateway maps it to a 400.
+* **Deadline / cancellation** — the *client's* time budget ran out
+  (``DeadlineExceededError`` → 504 with partial-tokens metadata) or the
+  client went away (``ClientDisconnectError``, nothing left to answer).
+  Both shed the sequence between decode ticks and free its KV pages
+  immediately instead of burning the batch to completion.
 
 Kept free of imports from the runtime so every layer (scheduler,
 batcher, server, client-facing docs) can reference one taxonomy without
@@ -77,6 +82,51 @@ class EngineDeadError(RetryableError):
 
     def __init__(self, message: str, retry_after: float = 30.0) -> None:
         super().__init__(message, retry_after=retry_after)
+
+
+class ServerDrainingError(RetryableError):
+    """This replica received SIGTERM and is draining in-flight work; new
+    admissions are rejected with 503 + ``Retry-After`` so the client (or
+    the LB) resends against a replica that is staying up."""
+
+    def __init__(self, message: str = None, retry_after: float = 2.0) -> None:
+        super().__init__(
+            message
+            or "server is draining for shutdown; retry another replica",
+            retry_after=retry_after,
+        )
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's end-to-end deadline (``X-Request-Timeout`` header /
+    ``timeout`` body field, capped by ``server.request_timeout_s``)
+    passed before generation finished.  The sequence was shed between
+    decode ticks — KV pages and its slot freed immediately — and the
+    gateway maps this to a **504** carrying partial-generation metadata
+    (tokens produced before the shed), so the client can distinguish
+    "slow but working" from "nothing happened".  Not retryable as-is:
+    the same request will blow the same budget; the client should raise
+    its deadline instead."""
+
+    def __init__(
+        self,
+        message: str,
+        partial_text: str = "",
+        partial_tokens: int = 0,
+        deadline_s: float = 0.0,
+    ) -> None:
+        super().__init__(message)
+        self.partial_text = partial_text
+        self.partial_tokens = partial_tokens
+        self.deadline_s = deadline_s
+
+
+class ClientDisconnectError(RuntimeError):
+    """The client went away while its request was queued or decoding;
+    the work was cancelled (dequeued, or aborted between decode ticks)
+    instead of running to completion for nobody.  Never serialized to a
+    response — there is no one left to read it — but it travels through
+    futures so bookkeeping (metrics, logs) sees a typed outcome."""
 
 
 class PoisonRequestError(ValueError):

@@ -197,6 +197,34 @@ FAULTS_INJECTED = _safe_metric(
     labelnames=("point", "mode"),
 )
 
+# --- request lifecycle: deadlines, cancellation, graceful drain ---
+CANCELLED_REQUESTS = _safe_metric(
+    Counter,
+    "vgt_cancelled_requests",
+    "Requests cancelled before completion, by reason",
+    labelnames=("reason",),  # client_disconnect | deadline | drain
+)
+DEADLINE_PARTIAL_TOKENS = _safe_metric(
+    Histogram,
+    "vgt_deadline_partial_tokens",
+    "Tokens already generated when a deadline shed the request",
+    buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+)
+DRAINING = _safe_metric(
+    Gauge, "vgt_draining", "1 while the server is draining for shutdown"
+)
+DRAINED_REQUESTS = _safe_metric(
+    Counter,
+    "vgt_drained_requests",
+    "In-flight requests that completed during a graceful drain",
+)
+DRAIN_DURATION = _safe_metric(
+    Histogram,
+    "vgt_drain_seconds",
+    "Graceful drain wall time (SIGTERM to drained/aborted)",
+    buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
+)
+
 INFO = _safe_metric(Info, "vgt_build", "Framework build information")
 
 

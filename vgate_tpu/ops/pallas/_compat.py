@@ -1,0 +1,14 @@
+"""Pallas API compatibility shims shared by the kernel modules.
+
+jax 0.5 renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+resolving it here (once) keeps the kernels — and their interpret-mode
+tests — running on either toolchain without per-file shims drifting.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)

@@ -89,6 +89,14 @@ class ReplicatedEngine:
         for core in self.replicas:
             core.stop()
 
+    def abort_in_flight(self, reason: str = "drain") -> None:
+        """Graceful-drain straggler sweep: fan the abort out to every
+        replica (without this, dp>1 pods would drop their in-flight
+        responses at drain timeout instead of settling them)."""
+        for core in self.replicas:
+            if self._alive(core):
+                core.abort_in_flight(reason)
+
     # ------------------------------------------------------------ routing
 
     @staticmethod

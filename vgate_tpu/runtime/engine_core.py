@@ -41,7 +41,7 @@ import numpy as np
 
 from vgate_tpu import faults, metrics
 from vgate_tpu.backends.base import SamplingParams
-from vgate_tpu.errors import EngineRecoveringError
+from vgate_tpu.errors import DeadlineExceededError, EngineRecoveringError
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.models.decoder import (
@@ -557,6 +557,7 @@ class EngineCore:
             ),
             prefix_cache=self.prefix_cache_enabled,
             prefill_chunk=tpu_cfg.prefill_chunk,
+            text_fn=self.final_text,
         )
 
         # host-side mirror of the device page tables, one row per slot
@@ -750,6 +751,11 @@ class EngineCore:
                 "(int8 or int4) — serving stays on the plain dtype path"
             )
         self._submit_q: "queue.Queue[Sequence]" = queue.Queue()
+        # abort commands from OTHER threads: (seq_id | None for all,
+        # reason).  Processed on the engine thread each tick — the
+        # scheduler's deques are engine-thread-owned, so cross-thread
+        # iteration (a drain sweep racing try_admit) is never safe.
+        self._abort_q: "queue.Queue[tuple]" = queue.Queue()
         self._wakeup = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -784,6 +790,26 @@ class EngineCore:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # resolve every owed future: a sequence still resident (or still
+        # in the submit queue) when the loop exits would leave its
+        # waiter blocked on done_event forever.  Runs after the join, so
+        # no engine thread races these mutations.
+        owed = list(self.scheduler.running) + list(self.scheduler.waiting)
+        while True:
+            try:
+                owed.append(self._submit_q.get_nowait())
+            except queue.Empty:
+                break
+        stop_exc: Optional[BaseException] = None
+        for seq in owed:
+            if seq.status in (SeqStatus.RUNNING, SeqStatus.WAITING):
+                if stop_exc is None:
+                    stop_exc = EngineRecoveringError(
+                        "engine stopped before the request could finish"
+                    )
+                self.scheduler._release_residency(seq)
+                seq.fail(stop_exc)
+        self.scheduler.waiting.clear()
 
     # ------------------------------------------------------------ submission
 
@@ -946,7 +972,9 @@ class EngineCore:
         Returns False when there was no work (the loop then sleeps).
         """
         self._drain_submissions()
+        self._drain_abort_requests()
         self._handle_aborts()
+        self._handle_deadlines()
         if self.spec_k > 0:
             worked = self._admit_and_prefill()
             return self._tick_speculative() or worked
@@ -1036,6 +1064,65 @@ class EngineCore:
         for seq in self._running_seqs():
             if seq.abort_requested:
                 self.scheduler.abort(seq)
+
+    def _handle_deadlines(self) -> None:
+        """Shed RUNNING sequences past their end-to-end deadline between
+        decode ticks: the client's budget is blown, so decoding on would
+        only burn batchmates' step time.  The owed future fails with a
+        DeadlineExceededError carrying the partial generation (→ 504
+        with partial-tokens metadata at the gateway); slot + KV pages
+        free this tick.  Waiting-queue deadlines are the scheduler's
+        ``_shed_expired``.  In-flight chunks holding the sequence are
+        harmless: the per-chunk status check discards their tokens."""
+        now = time.perf_counter()
+        for seq in self._running_seqs():
+            if not seq.past_deadline(now):
+                continue
+            self.scheduler.shed(
+                seq,
+                DeadlineExceededError(
+                    f"request deadline ({seq.params.timeout_s:.3f}s) "
+                    f"passed mid-generation after "
+                    f"{seq.num_generated} tokens",
+                    partial_text=self.final_text(seq),
+                    partial_tokens=seq.num_generated,
+                    deadline_s=seq.params.timeout_s or 0.0,
+                ),
+            )
+
+    def abort(self, seq_id: int, reason: str = "client_disconnect") -> None:
+        """Request-scoped cancellation by sequence id (the vLLM
+        ``abort_request`` surface): enqueues an abort command the engine
+        thread applies at its next tick (shed within one tick; slot +
+        KV pages freed).  Thread-safe by construction — the scheduler's
+        deques are only ever touched on the engine thread."""
+        self._abort_q.put((seq_id, reason))
+        self._wakeup.set()
+
+    def abort_in_flight(self, reason: str = "drain") -> None:
+        """Request-abort EVERY waiting/running sequence (the graceful
+        drain's straggler sweep once ``lifecycle.drain_timeout_s``
+        passes).  Applied on the engine thread at its next tick."""
+        self._abort_q.put((None, reason))
+        self._wakeup.set()
+
+    def _drain_abort_requests(self) -> None:
+        """Apply queued abort commands (engine thread only)."""
+        while True:
+            try:
+                seq_id, reason = self._abort_q.get_nowait()
+            except queue.Empty:
+                return
+            for seq in list(self.scheduler.running) + list(
+                self.scheduler.waiting
+            ):
+                if (
+                    (seq_id is None or seq.seq_id == seq_id)
+                    and seq.status
+                    in (SeqStatus.RUNNING, SeqStatus.WAITING)
+                    and not seq.abort_requested
+                ):
+                    seq.request_abort(reason)
 
     @staticmethod
     def _all_greedy(seqs, num_lp: int) -> bool:

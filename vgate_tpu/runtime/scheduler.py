@@ -21,6 +21,7 @@ from typing import Deque, List, Optional, Union
 import numpy as np
 
 from vgate_tpu import metrics
+from vgate_tpu.errors import DeadlineExceededError
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.kv_cache import PageAllocator
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
@@ -78,7 +79,14 @@ class Scheduler:
         admission_deadline_ms: float = 0.0,
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
+        text_fn=None,
     ) -> None:
+        # renders a sequence's partial generation for deadline-shed
+        # metadata (the engine injects tokenizer.decode-backed
+        # final_text); None keeps queued sheds text-less.  A preempted
+        # sequence shed from the WAITING queue can hold generated
+        # tokens, and its 504 must carry them like a running shed's.
+        self.text_fn = text_fn
         self.allocator = allocator
         self.page_size = page_size
         # buckets: page-aligned, capped at max_model_len, and always
@@ -106,6 +114,11 @@ class Scheduler:
         self.prefix_cache = prefix_cache
         self.total_prefix_hit_tokens = 0
         self.waiting: Deque[Sequence] = deque()
+        # sticky: set once any deadline-bearing sequence is ever queued,
+        # so deployments without client deadlines skip _shed_expired's
+        # per-tick queue scan entirely (try_admit runs in a tight loop
+        # on the engine thread)
+        self._deadline_seen = False
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.total_preemptions = 0
         self.total_admitted = 0
@@ -124,6 +137,8 @@ class Scheduler:
                 f"prompt of {seq.num_prompt_tokens} tokens exceeds "
                 f"max_model_len={self.max_model_len}"
             )
+        if seq.deadline_t is not None:
+            self._deadline_seen = True
         self.waiting.append(seq)
         metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
 
@@ -204,20 +219,49 @@ class Scheduler:
         return self.try_admit()  # everything preempted; try re-admission
 
     def _shed_expired(self) -> None:
-        """Fail queued sequences whose admission deadline has passed (their
-        completion would arrive too late to be useful).  Preempted sequences
-        are exempt: they were already admitted once and hold generated
-        tokens the client is owed."""
-        if not self.admission_deadline_ms:
+        """Fail queued sequences whose deadline has passed (their
+        completion would arrive too late to be useful).  Two deadlines
+        apply: the global admission deadline (preempted sequences are
+        exempt — they were already admitted once and hold generated
+        tokens the client is owed) and each request's own end-to-end
+        deadline (``seq.deadline_t``; applies unconditionally — the
+        client's budget is blown either way)."""
+        if not self.admission_deadline_ms and not self._deadline_seen:
             return
-        deadline_s = self.admission_deadline_ms / 1000.0
+        admission_s = self.admission_deadline_ms / 1000.0
         now = time.perf_counter()
         kept: Deque[Sequence] = deque()
         shed = 0
         for seq in self.waiting:
-            if (
-                seq.preempt_count == 0
-                and now - seq.arrival_t > deadline_s
+            if seq.past_deadline(now):
+                waited = (now - seq.arrival_t) * 1000
+                partial_text = ""
+                if seq.num_generated and self.text_fn is not None:
+                    # preempted sequences re-enter the queue carrying
+                    # generated tokens — their shed metadata must be as
+                    # complete as a running shed's
+                    try:
+                        partial_text = self.text_fn(seq)
+                    except Exception:  # pragma: no cover - defensive
+                        pass
+                seq.fail(
+                    DeadlineExceededError(
+                        f"request deadline "
+                        f"({seq.params.timeout_s:.3f}s) passed after "
+                        f"{waited:.0f}ms in queue, before generation "
+                        "could finish",
+                        partial_text=partial_text,
+                        partial_tokens=seq.num_generated,
+                        deadline_s=seq.params.timeout_s or 0.0,
+                    )
+                )
+                metrics.CANCELLED_REQUESTS.labels(reason="deadline").inc()
+                metrics.DEADLINE_PARTIAL_TOKENS.observe(seq.num_generated)
+                shed += 1
+            elif (
+                self.admission_deadline_ms
+                and seq.preempt_count == 0
+                and now - seq.arrival_t > admission_s
             ):
                 seq.fail(
                     AdmissionDeadlineExceeded(
@@ -234,7 +278,7 @@ class Scheduler:
             self.total_deadline_shed += shed
             metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
             logger.warning(
-                "shed requests past admission deadline",
+                "shed requests past deadline",
                 extra={"extra_data": {"shed": shed}},
             )
 
@@ -443,7 +487,21 @@ class Scheduler:
         abort bookkeeping for both the running and queued paths."""
         self._release_residency(seq)
         self.total_aborted += 1
+        metrics.CANCELLED_REQUESTS.labels(reason=seq.abort_reason).inc()
         seq.finish("abort")
+
+    def shed(self, seq: Sequence, exc: DeadlineExceededError) -> None:
+        """Deadline shed of a RUNNING sequence (the engine detected
+        ``past_deadline`` between decode ticks and built the exception,
+        which carries the partial text): release residency immediately —
+        slot and KV pages free this tick, not at natural completion —
+        and fail the owed future.  Counted with the queued sheds in
+        ``total_deadline_shed``."""
+        self._release_residency(seq)
+        self.total_deadline_shed += 1
+        metrics.CANCELLED_REQUESTS.labels(reason="deadline").inc()
+        metrics.DEADLINE_PARTIAL_TOKENS.observe(seq.num_generated)
+        seq.fail(exc)
 
     def get_stats(self) -> dict:
         return {

@@ -55,10 +55,25 @@ class Sequence:
     # the sequence with reason "abort" and freeing its slot/pages —
     # the capability vLLM exposes as abort_request, first-party here
     abort_requested: bool = False
+    # why the abort was requested — labels the cancellation metric
+    # (client_disconnect | drain)
+    abort_reason: str = "client_disconnect"
+    # absolute perf_counter deadline (arrival_t + params.timeout_s);
+    # the engine sheds the sequence between decode ticks once passed
+    deadline_t: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
             self.orig_prompt_len = len(self.prompt_ids)
+        if self.deadline_t is None and self.params.timeout_s is not None:
+            self.deadline_t = self.arrival_t + self.params.timeout_s
+
+    def past_deadline(self, now: Optional[float] = None) -> bool:
+        if self.deadline_t is None:
+            return False
+        return (now if now is not None else time.perf_counter()) >= (
+            self.deadline_t
+        )
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -100,10 +115,12 @@ class Sequence:
         if self.stream_cb is not None:
             self.stream_cb(token)
 
-    def request_abort(self) -> None:
+    def request_abort(self, reason: str = "client_disconnect") -> None:
         """Ask the engine to drop this sequence (thread-safe, advisory:
         tokens already in flight may still append before the engine
-        processes the abort)."""
+        processes the abort).  ``reason`` labels the cancellation
+        metric: "client_disconnect" (the default) or "drain"."""
+        self.abort_reason = reason
         self.abort_requested = True
 
     def finish(self, reason: str) -> None:

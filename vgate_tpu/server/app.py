@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import signal
 import statistics
 import tempfile
 import time
@@ -28,11 +29,14 @@ from vgate_tpu.batcher import RequestBatcher
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.engine import VGTEngine
 from vgate_tpu.errors import (
+    ClientDisconnectError,
+    DeadlineExceededError,
     PoisonRequestError,
     RetryableError,
     state_is_alive,
     state_is_ready,
 )
+from vgate_tpu.lifecycle import CancelToken, DrainController
 from vgate_tpu.logging_config import get_logger, setup_logging
 from vgate_tpu.runtime.scheduler import EngineBusyError
 from vgate_tpu.security import build_security_middleware
@@ -58,6 +62,13 @@ logger = get_logger(__name__)
 tracer = get_tracer(__name__)
 
 _QUIET_PATHS = {"/health", "/health/live", "/health/ready", "/metrics"}
+# excluded from the drain's in-flight count: probes/scrapes (and /stats
+# polls watching the drain itself) must never hold a drain open
+_UNCOUNTED_PATHS = _QUIET_PATHS | {"/stats"}
+# non-standard but conventional (nginx): the client closed the
+# connection before the response could be written — nobody reads the
+# body, but metrics/logs get a truthful status
+_STATUS_CLIENT_CLOSED = 499
 
 
 def _error(status: int, message: str, err_type: str) -> web.Response:
@@ -66,12 +77,28 @@ def _error(status: int, message: str, err_type: str) -> web.Response:
     )
 
 
+class _InflightCounter:
+    """Mutable in-place counter (aiohttp deprecates reassigning app keys
+    after startup); single-threaded on the event loop, so bare +=."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+
 @web.middleware
 async def observability_middleware(request: web.Request, handler):
-    """Request metrics + latency + X-Request-ID (reference: main.py:118-172)."""
+    """Request metrics + latency + X-Request-ID (reference: main.py:118-172).
+    Also maintains the app-level in-flight counter the graceful drain
+    waits on (probe/metrics paths excluded — a scraper must never hold
+    the drain open)."""
     request_id = request.headers.get("X-Request-ID", uuid.uuid4().hex[:16])
     start = time.perf_counter()
     metrics.REQUESTS_IN_PROGRESS.inc()
+    counted = request.path not in _UNCOUNTED_PATHS
+    if counted:
+        request.app["inflight"].value += 1
     try:
         with tracer.start_as_current_span(
             f"{request.method} {request.path}"
@@ -92,6 +119,8 @@ async def observability_middleware(request: web.Request, handler):
         return _error(500, "Internal server error", "server_error")
     finally:
         metrics.REQUESTS_IN_PROGRESS.dec()
+        if counted:
+            request.app["inflight"].value -= 1
     elapsed = time.perf_counter() - start
     metrics.inc_with_exemplar(
         metrics.REQUEST_COUNT.labels(
@@ -128,6 +157,84 @@ def _retry_after(exc: BaseException, default: float = 1.0) -> str:
     return str(max(1, int(round(getattr(exc, "retry_after", default)))))
 
 
+def _effective_timeout(request: web.Request, body_timeout) -> float:
+    """Per-request end-to-end deadline in seconds: the tightest of the
+    server cap (``server.request_timeout_s``), the ``X-Request-Timeout``
+    header and the ``timeout`` body field.  Raises ValueError (→ 422)
+    on a malformed/non-positive header."""
+    engine: VGTEngine = request.app["engine"]
+    timeout = engine.config.server.request_timeout_s
+    header = request.headers.get("X-Request-Timeout")
+    if header is not None:
+        try:
+            value = float(header)
+        except ValueError:
+            raise ValueError(
+                f"X-Request-Timeout must be seconds, got {header!r}"
+            )
+        if value <= 0:
+            raise ValueError(
+                f"X-Request-Timeout must be positive, got {value}"
+            )
+        timeout = min(timeout, value)
+    if body_timeout is not None:
+        timeout = min(timeout, body_timeout)
+    return timeout
+
+
+def _watch_disconnect(
+    request: web.Request, token: CancelToken, poll_s: float = 0.25
+) -> "asyncio.Task":
+    """Disconnect watcher for non-streaming handlers: aiohttp does not
+    cancel handler tasks when the peer goes away (default
+    handler_cancellation=False), so generation for a vanished client
+    would decode to completion.  Poll the transport; on close, fire the
+    request's CancelToken — the batcher dequeues a queued request, the
+    backend aborts a decoding one (slot + KV pages free within a tick).
+    The caller cancels the task when the request settles first.  The
+    0.25s cadence keeps per-request polling cost negligible — the shed
+    saves whole seconds of decode, so sub-second detection is plenty.
+    (Deployments running handler_cancellation=True get the same effect
+    via batcher.submit's CancelledError path, with no polling at all.)"""
+
+    async def _watch() -> None:
+        while not token.cancelled:
+            transport = request.transport
+            if transport is None or transport.is_closing():
+                token.cancel("client_disconnect")
+                return
+            await asyncio.sleep(poll_s)
+
+    return asyncio.ensure_future(_watch())
+
+
+@web.middleware
+async def drain_middleware(request: web.Request, handler):
+    """One admission gate for every work-accepting endpoint while the
+    server drains (SIGTERM received): POSTs under /v1/ shed with 503 +
+    Retry-After.  A single middleware instead of per-handler checks so
+    a newly added endpoint can never silently miss the gate; GETs
+    (health, stats, metrics, models) stay up for observers, and the
+    batcher's own ServerDrainingError covers non-HTTP callers."""
+    drain: Optional[DrainController] = request.app.get("drain")
+    if (
+        drain is not None
+        and drain.draining
+        and request.method == "POST"
+        and request.path.startswith("/v1/")
+    ):
+        resp = _error(
+            503,
+            "server is draining for shutdown; retry another replica",
+            "overloaded_error",
+        )
+        resp.headers["Retry-After"] = str(
+            max(1, int(round(drain.retry_after_s)))
+        )
+        return resp
+    return await handler(request)
+
+
 def _engine_health(engine: Optional[VGTEngine]) -> Dict[str, Any]:
     """Engine liveness/state block — ALWAYS present in /health, even for
     backends without device_health (satellite fix): state-machine
@@ -160,6 +267,12 @@ async def health(request: web.Request) -> web.Response:
     live at /health/live and /health/ready (docs/operations.md)."""
     engine: Optional[VGTEngine] = request.app.get("engine")
     eng = _engine_health(engine)
+    drain: Optional[DrainController] = request.app.get("drain")
+    if drain is not None and drain.draining:
+        # SIGTERM received: leave the LB set (ready 503) while in-flight
+        # work finishes; liveness is untouched
+        eng["state"] = "draining"
+        eng["ready"] = False
     batcher: Optional[RequestBatcher] = request.app.get("batcher")
     if batcher is not None:
         eng["batcher_pending"] = len(batcher._queue)
@@ -203,6 +316,10 @@ async def health_ready(request: web.Request) -> web.Response:
     traffic into a dead engine."""
     engine: Optional[VGTEngine] = request.app.get("engine")
     eng = _engine_health(engine)
+    drain: Optional[DrainController] = request.app.get("drain")
+    if drain is not None and drain.draining:
+        eng["state"] = "draining"
+        eng["ready"] = False
     ready = engine is not None and eng.get("ready", False)
     resp = web.json_response(
         {"status": "ok" if ready else eng["state"], "engine": eng},
@@ -257,12 +374,38 @@ async def _settle_submits(engine: VGTEngine, coros):
             if isinstance(item, BaseException):
                 raise item
         return list(settled), None
+    except DeadlineExceededError as exc:
+        # engine-shed deadline: 504 with partial-generation metadata so
+        # the client can tell "slow but generating" from "stuck"
+        resp = web.json_response(
+            {
+                "error": {
+                    "message": str(exc),
+                    "type": "timeout_error",
+                    "partial_tokens": exc.partial_tokens,
+                    "partial_text": exc.partial_text,
+                }
+            },
+            status=504,
+        )
+        return None, resp
     except asyncio.TimeoutError:
         return None, _error(
             504,
-            "Request exceeded server.request_timeout_s "
-            f"({engine.config.server.request_timeout_s:.0f}s)",
+            "Request exceeded its deadline "
+            f"(server cap {engine.config.server.request_timeout_s:.0f}s)",
             "timeout_error",
+        )
+    except ClientDisconnectError:
+        # nobody is listening; the 499 is for metrics/logs only
+        return None, web.json_response(
+            {
+                "error": {
+                    "message": "client closed the connection",
+                    "type": "client_disconnect",
+                }
+            },
+            status=_STATUS_CLIENT_CLOSED,
         )
     except PoisonRequestError as exc:
         # quarantined: resending can never succeed, so NOT retryable
@@ -299,6 +442,10 @@ async def chat_completions(request: web.Request) -> web.Response:
         )
     batcher: RequestBatcher = request.app["batcher"]
     engine: VGTEngine = request.app["engine"]
+    try:
+        timeout_s = _effective_timeout(request, payload.timeout)
+    except ValueError as exc:
+        return _error(422, str(exc), "invalid_request_error")
     prompt = _build_prompt(engine, payload.messages)
 
     if payload.stream:
@@ -307,7 +454,9 @@ async def chat_completions(request: web.Request) -> web.Response:
                 422, "n > 1 is not supported with stream=true",
                 "invalid_request_error",
             )
-        return await _stream_chat(request, payload, prompt, logit_bias)
+        return await _stream_chat(
+            request, payload, prompt, logit_bias, timeout_s
+        )
 
     # n choices run as n engine requests sampled concurrently (the
     # variant salt keeps them from deduping; prefix caching shares
@@ -315,32 +464,38 @@ async def chat_completions(request: web.Request) -> web.Response:
     n_submits, deterministic = _n_plan(
         engine, payload.temperature, payload.seed, payload.n
     )
-    settled, err = await _settle_submits(
-        engine,
-        (
-            batcher.submit(
-                prompt,
-                max_tokens=payload.effective_max_tokens(),
-                min_tokens=payload.min_tokens,
-                temperature=payload.temperature,
-                top_p=payload.top_p,
-                top_k=payload.top_k,
-                stop=payload.stop_list(),
-                stop_token_ids=payload.stop_token_ids,
-                seed=(
-                    payload.seed + i if payload.seed is not None else None
-                ),
-                timeout_s=engine.config.server.request_timeout_s,
-                logprobs=payload.logprobs or bool(payload.top_logprobs),
-                top_logprobs=payload.top_logprobs or 0,
-                variant=i,
-                frequency_penalty=payload.frequency_penalty or 0.0,
-                presence_penalty=payload.presence_penalty or 0.0,
-                logit_bias=logit_bias,
-            )
-            for i in range(n_submits)
-        ),
-    )
+    token = CancelToken()
+    watcher = _watch_disconnect(request, token)
+    try:
+        settled, err = await _settle_submits(
+            engine,
+            (
+                batcher.submit(
+                    prompt,
+                    max_tokens=payload.effective_max_tokens(),
+                    min_tokens=payload.min_tokens,
+                    temperature=payload.temperature,
+                    top_p=payload.top_p,
+                    top_k=payload.top_k,
+                    stop=payload.stop_list(),
+                    stop_token_ids=payload.stop_token_ids,
+                    seed=(
+                        payload.seed + i if payload.seed is not None else None
+                    ),
+                    timeout_s=timeout_s,
+                    logprobs=payload.logprobs or bool(payload.top_logprobs),
+                    top_logprobs=payload.top_logprobs or 0,
+                    variant=i,
+                    frequency_penalty=payload.frequency_penalty or 0.0,
+                    presence_penalty=payload.presence_penalty or 0.0,
+                    logit_bias=logit_bias,
+                    cancel_token=token,
+                )
+                for i in range(n_submits)
+            ),
+        )
+    finally:
+        watcher.cancel()
     if err is not None:
         return err
     results = (settled * (payload.n if deterministic else 1))[: payload.n]
@@ -380,12 +535,18 @@ async def chat_completions(request: web.Request) -> web.Response:
 
 async def _stream_chat(
     request: web.Request, payload: ChatCompletionRequest, prompt: str,
-    logit_bias=None,
+    logit_bias=None, timeout_s: Optional[float] = None,
 ) -> web.StreamResponse:
     """SSE streaming.  Uses the backend's token stream when it has one;
-    otherwise generates fully and replays in chunks (dry-run path)."""
+    otherwise generates fully and replays in chunks (dry-run path).
+    Client disconnect mid-stream already propagates: closing the
+    response generator aborts the engine sequence (stream_async's
+    finally clause); ``timeout_s`` is the request's effective deadline
+    (surfaced as an SSE timeout_error event — the 200 is on the wire)."""
     engine: VGTEngine = request.app["engine"]
     batcher: RequestBatcher = request.app["batcher"]
+    if timeout_s is None:
+        timeout_s = engine.config.server.request_timeout_s
     resp = web.StreamResponse(
         status=200,
         headers={
@@ -479,9 +640,7 @@ async def _stream_chat(
                 kwargs["on_usage"] = (
                     lambda u: usage_box.__setitem__("value", u)
                 )
-            async with asyncio.timeout(
-                engine.config.server.request_timeout_s
-            ):
+            async with asyncio.timeout(timeout_s):
                 async for piece in stream_fn(prompt, params, **kwargs):
                     if isinstance(piece, dict):  # logprobs-carrying delta
                         await resp.write(
@@ -528,7 +687,7 @@ async def _stream_chat(
                 stop=payload.stop_list(),
                 stop_token_ids=payload.stop_token_ids,
                 seed=payload.seed,
-                timeout_s=engine.config.server.request_timeout_s,
+                timeout_s=timeout_s,
                 logprobs=payload.logprobs or bool(payload.top_logprobs),
                 top_logprobs=payload.top_logprobs or 0,
                 frequency_penalty=payload.frequency_penalty or 0.0,
@@ -536,12 +695,14 @@ async def _stream_chat(
                 logit_bias=logit_bias,
             )
         except (
-            asyncio.TimeoutError, EngineBusyError, RetryableError,
-            PoisonRequestError,
+            asyncio.TimeoutError, DeadlineExceededError, EngineBusyError,
+            RetryableError, PoisonRequestError,
         ) as exc:
             # the 200 + role chunk are already on the wire: deliver the
             # failure as an SSE error event, not a reset connection
-            if isinstance(exc, asyncio.TimeoutError):
+            if isinstance(
+                exc, (asyncio.TimeoutError, DeadlineExceededError)
+            ):
                 err_type = "timeout_error"
             elif isinstance(exc, PoisonRequestError):
                 err_type = "invalid_request_error"
@@ -651,6 +812,10 @@ async def completions(request: web.Request) -> web.Response:
     best_of = payload.best_of or payload.n
     batcher: RequestBatcher = request.app["batcher"]
     engine: VGTEngine = request.app["engine"]
+    try:
+        timeout_s = _effective_timeout(request, payload.timeout)
+    except ValueError as exc:
+        return _error(422, str(exc), "invalid_request_error")
     n_submits, deterministic = _n_plan(
         engine, payload.temperature, payload.seed, best_of
     )
@@ -661,35 +826,41 @@ async def completions(request: web.Request) -> web.Response:
     # logprobs are requested internally even when the client didn't ask
     ranking = not deterministic and best_of > payload.n
 
-    settled, err = await _settle_submits(
-        engine,
-        (
-            batcher.submit(
-                p,
-                max_tokens=payload.max_tokens,
-                min_tokens=payload.min_tokens,
-                temperature=payload.temperature,
-                top_p=payload.top_p,
-                top_k=payload.top_k,
-                stop=payload.stop_list(),
-                stop_token_ids=payload.stop_token_ids,
-                seed=(
-                    payload.seed + i if payload.seed is not None else None
-                ),
-                timeout_s=engine.config.server.request_timeout_s,
-                logprobs=want_lp or ranking,
-                top_logprobs=payload.logprobs or 0,
-                # globally unique salt: duplicate prompts in the list must
-                # not dedup into one sample
-                variant=pi * best_of + i,
-                frequency_penalty=payload.frequency_penalty or 0.0,
-                presence_penalty=payload.presence_penalty or 0.0,
-                logit_bias=logit_bias,
-            )
-            for pi, p in enumerate(prompts)
-            for i in range(n_submits)
-        ),
-    )
+    token = CancelToken()
+    watcher = _watch_disconnect(request, token)
+    try:
+        settled, err = await _settle_submits(
+            engine,
+            (
+                batcher.submit(
+                    p,
+                    max_tokens=payload.max_tokens,
+                    min_tokens=payload.min_tokens,
+                    temperature=payload.temperature,
+                    top_p=payload.top_p,
+                    top_k=payload.top_k,
+                    stop=payload.stop_list(),
+                    stop_token_ids=payload.stop_token_ids,
+                    seed=(
+                        payload.seed + i if payload.seed is not None else None
+                    ),
+                    timeout_s=timeout_s,
+                    logprobs=want_lp or ranking,
+                    top_logprobs=payload.logprobs or 0,
+                    # globally unique salt: duplicate prompts in the list must
+                    # not dedup into one sample
+                    variant=pi * best_of + i,
+                    frequency_penalty=payload.frequency_penalty or 0.0,
+                    presence_penalty=payload.presence_penalty or 0.0,
+                    logit_bias=logit_bias,
+                    cancel_token=token,
+                )
+                for pi, p in enumerate(prompts)
+                for i in range(n_submits)
+            ),
+        )
+    finally:
+        watcher.cancel()
     if err is not None:
         return err
 
@@ -762,8 +933,29 @@ async def embeddings(request: web.Request) -> web.Response:
     if not inputs:
         return _error(422, "input must be non-empty", "invalid_request_error")
     engine: VGTEngine = request.app["engine"]
+    try:
+        timeout_s = _effective_timeout(request, None)
+    except ValueError as exc:
+        return _error(422, str(exc), "invalid_request_error")
     loop = asyncio.get_running_loop()
-    result = await loop.run_in_executor(None, lambda: engine.embeddings(inputs))
+    try:
+        # the encoder pass is a sync executor hop (can't be cancelled
+        # mid-flight), but the CLIENT's deadline is still honored with a
+        # typed 504 — otherwise the SDK's embeddings timeout kwarg would
+        # degrade to a transport timeout that gets retried as a
+        # connection error
+        result = await asyncio.wait_for(
+            loop.run_in_executor(
+                None, lambda: engine.embeddings(inputs)
+            ),
+            timeout_s,
+        )
+    except asyncio.TimeoutError:
+        return _error(
+            504,
+            f"embedding request exceeded its deadline ({timeout_s:.3f}s)",
+            "timeout_error",
+        )
     response = EmbeddingResponse(
         data=[
             EmbeddingData(index=i, embedding=vec)
@@ -941,6 +1133,48 @@ async def capture_profile(request: web.Request) -> web.Response:
     return web.json_response(result)
 
 
+def _raise_graceful_exit() -> None:
+    # GracefulExit subclasses SystemExit, so raising it inside the drain
+    # task propagates through the loop and ends web.run_app's
+    # run_forever — the normal aiohttp shutdown path (cleanup hooks run)
+    raise web.GracefulExit()
+
+
+def _build_drain_controller(
+    app: web.Application, config: VGTConfig
+) -> DrainController:
+    """Graceful drain wiring (vgate_tpu/lifecycle.py): SIGTERM →
+    ready=503 + admission stop → in-flight completes (up to
+    lifecycle.drain_timeout_s) → straggler abort → process exit."""
+    lc = config.lifecycle
+
+    def stop_admission() -> None:
+        batcher: Optional[RequestBatcher] = app.get("batcher")
+        if batcher is not None:
+            batcher.begin_drain(retry_after_s=lc.drain_retry_after_s)
+
+    def abort_stragglers() -> None:
+        batcher: Optional[RequestBatcher] = app.get("batcher")
+        if batcher is not None:
+            batcher.fail_pending()
+        engine: Optional[VGTEngine] = app.get("engine")
+        abort_fn = getattr(engine.backend, "abort_in_flight", None) if (
+            engine is not None
+        ) else None
+        if abort_fn is not None:
+            abort_fn("drain")
+
+    return DrainController(
+        drain_timeout_s=lc.drain_timeout_s,
+        poll_s=lc.drain_poll_ms / 1000.0,
+        retry_after_s=lc.drain_retry_after_s,
+        stop_admission=stop_admission,
+        inflight=lambda: app["inflight"].value,
+        abort_stragglers=abort_stragglers,
+        on_complete=_raise_graceful_exit,
+    )
+
+
 async def _on_startup(app: web.Application) -> None:
     config: VGTConfig = app["config"]
     app["profile_lock"] = asyncio.Lock()
@@ -955,6 +1189,19 @@ async def _on_startup(app: web.Application) -> None:
     app["engine"] = engine
     batcher = RequestBatcher(engine, config)
     app["batcher"] = batcher
+    drain = _build_drain_controller(app, config)
+    app["drain"] = drain
+    if config.lifecycle.drain_enabled:
+        try:
+            # replaces aiohttp's default SIGTERM → immediate GracefulExit
+            # with drain-then-exit; k8s preStop + termination grace give
+            # the drain its window (k8s/base/deployment.yaml)
+            loop.add_signal_handler(signal.SIGTERM, drain.begin)
+            app["drain_signal_installed"] = True
+        except (NotImplementedError, RuntimeError, ValueError):
+            # non-main thread / platforms without signal support: drain
+            # stays reachable programmatically (drain.begin())
+            app["drain_signal_installed"] = False
     metrics.init_app_info(
         __version__, config.model.model_id, config.model.engine_type
     )
@@ -962,6 +1209,11 @@ async def _on_startup(app: web.Application) -> None:
 
 
 async def _on_cleanup(app: web.Application) -> None:
+    if app.get("drain_signal_installed"):
+        try:
+            asyncio.get_running_loop().remove_signal_handler(signal.SIGTERM)
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
     batcher: Optional[RequestBatcher] = app.get("batcher")
     if batcher is not None:
         await batcher.stop()
@@ -978,10 +1230,13 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
         middlewares=[
             build_security_middleware(config),
             observability_middleware,
+            drain_middleware,
         ],
         client_max_size=32 * 1024 * 1024,
     )
     app["config"] = config
+    # client-facing requests in flight (the graceful drain waits on it)
+    app["inflight"] = _InflightCounter()
     app.router.add_get("/health", health)
     app.router.add_get("/health/live", health_live)
     app.router.add_get("/health/ready", health_ready)

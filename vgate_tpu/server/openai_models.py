@@ -90,6 +90,11 @@ class ChatCompletionRequest(BaseModel):
     # OpenAI logit_bias: token-id (stringified, per the OpenAI schema)
     # -> additive bias in [-100, 100]
     logit_bias: Optional[Dict[str, float]] = None
+    # end-to-end deadline in seconds (the body-field twin of the
+    # X-Request-Timeout header; the tighter of the two wins, both
+    # capped by server.request_timeout_s).  Past it the request is shed
+    # between decode ticks: 504 with partial-tokens metadata.
+    timeout: Optional[float] = Field(default=None, gt=0)
 
     def logit_bias_ints(self) -> Optional[Dict[int, float]]:
         """OpenAI sends string token-id keys; normalize + clamp."""
@@ -163,6 +168,9 @@ class CompletionRequest(BaseModel):
         default=None, ge=-2.0, le=2.0
     )
     logit_bias: Optional[Dict[str, float]] = None
+    # end-to-end deadline in seconds (same semantics as the chat
+    # endpoint's field; tightest of body/header/server cap wins)
+    timeout: Optional[float] = Field(default=None, gt=0)
 
     def logit_bias_ints(self) -> Optional[Dict[int, float]]:
         return _logit_bias_ints(self.logit_bias)

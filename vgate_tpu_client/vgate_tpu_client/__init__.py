@@ -4,6 +4,7 @@ from vgate_tpu_client.client import AsyncVGT, VGT
 from vgate_tpu_client.exceptions import (
     AuthenticationError,
     ConnectionError,
+    DeadlineExceeded,
     RateLimitError,
     ServerError,
     VGTError,
@@ -26,6 +27,7 @@ __all__ = [
     "AsyncVGT",
     "VGTError",
     "AuthenticationError",
+    "DeadlineExceeded",
     "RateLimitError",
     "ServerError",
     "ConnectionError",

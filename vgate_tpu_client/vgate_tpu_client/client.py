@@ -21,6 +21,7 @@ import httpx
 from vgate_tpu_client.exceptions import (
     AuthenticationError,
     ConnectionError,
+    DeadlineExceeded,
     RateLimitError,
     ServerError,
     VGTError,
@@ -37,6 +38,15 @@ from vgate_tpu_client.models import (
 
 DEFAULT_TIMEOUT = 120.0
 DEFAULT_MAX_RETRIES = 2
+# transport-timeout headroom over a per-request server deadline: the
+# server's 504 (with partial-tokens metadata) must beat the client-side
+# socket timeout, or the typed DeadlineExceeded is lost to a raw
+# httpx.ReadTimeout that the retry loop then re-runs.  The server may
+# answer up to its engine-shed grace (~30s, vgate_tpu/batcher.py
+# ENGINE_SHED_GRACE_S) past the nominal deadline when a first-contact
+# compile stretches an engine tick, so the margin must exceed that.
+# Costs nothing on the happy path — responses return when ready.
+DEADLINE_TRANSPORT_MARGIN = 35.0
 
 
 def _raise_for_status(response: httpx.Response) -> None:
@@ -54,9 +64,23 @@ def _raise_for_status(response: httpx.Response) -> None:
         raise RateLimitError(
             message, response.status_code, body, retry_after=info.retry_after
         )
+    if response.status_code == 504:
+        raise DeadlineExceeded(message, response.status_code, body)
     if response.status_code >= 500:
         raise ServerError(message, response.status_code, body)
     raise VGTError(message, response.status_code, body)
+
+
+def _deadline_kwargs(timeout: Optional[float]) -> Dict[str, Any]:
+    """Per-request kwargs for a client deadline: the X-Request-Timeout
+    header (server-side shed → typed 504) plus a transport timeout with
+    margin so the server's answer wins the race."""
+    if timeout is None:
+        return {}
+    return {
+        "headers": {"X-Request-Timeout": str(float(timeout))},
+        "timeout": timeout + DEADLINE_TRANSPORT_MARGIN,
+    }
 
 
 def _messages_payload(
@@ -91,6 +115,7 @@ class _ChatResource:
         min_tokens: Optional[int] = None,
         stop_token_ids: Optional[List[int]] = None,
         logit_bias: Optional[Dict[str, float]] = None,
+        timeout: Optional[float] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -112,8 +137,13 @@ class _ChatResource:
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
-            return self._client._stream("/v1/chat/completions", payload)
-        data = self._client._request("POST", "/v1/chat/completions", payload)
+            return self._client._stream(
+                "/v1/chat/completions", payload, **_deadline_kwargs(timeout)
+            )
+        data = self._client._request(
+            "POST", "/v1/chat/completions", payload,
+            **_deadline_kwargs(timeout),
+        )
         return ChatCompletion.model_validate(data)
 
 
@@ -123,21 +153,36 @@ class _CompletionsResource:
     def __init__(self, client: "VGT") -> None:
         self._client = client
 
-    def create(self, prompt, model: Optional[str] = None, **kwargs):
+    def create(
+        self,
+        prompt,
+        model: Optional[str] = None,
+        timeout: Optional[float] = None,
+        **kwargs,
+    ):
         payload = {"prompt": prompt, "model": model, **kwargs}
         payload = {k: v for k, v in payload.items() if v is not None}
-        return self._client._request("POST", "/v1/completions", payload)
+        return self._client._request(
+            "POST", "/v1/completions", payload, **_deadline_kwargs(timeout)
+        )
 
 
 class _EmbeddingsResource:
     def __init__(self, client: "VGT") -> None:
         self._client = client
 
-    def create(self, input, model: Optional[str] = None) -> EmbeddingResponse:
+    def create(
+        self,
+        input,
+        model: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> EmbeddingResponse:
         payload = EmbeddingRequest(model=model, input=input).model_dump(
             exclude_none=True
         )
-        data = self._client._request("POST", "/v1/embeddings", payload)
+        data = self._client._request(
+            "POST", "/v1/embeddings", payload, **_deadline_kwargs(timeout)
+        )
         return EmbeddingResponse.model_validate(data)
 
 
@@ -167,13 +212,23 @@ class VGT:
         return headers
 
     def _request(
-        self, method: str, path: str, payload: Optional[Dict] = None
+        self,
+        method: str,
+        path: str,
+        payload: Optional[Dict] = None,
+        headers: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
     ) -> Any:
         last_exc: Optional[Exception] = None
+        extra: Dict[str, Any] = {}
+        if timeout is not None:
+            extra["timeout"] = timeout
         for attempt in range(self.max_retries + 1):
             try:
                 response = self._http.request(
-                    method, path, json=payload, headers=self._headers()
+                    method, path, json=payload,
+                    headers={**self._headers(), **(headers or {})},
+                    **extra,
                 )
             except httpx.HTTPError as exc:
                 last_exc = ConnectionError(f"connection failed: {exc}")
@@ -186,9 +241,15 @@ class VGT:
                 retry_after = self.last_rate_limit.retry_after or 2 ** attempt
                 time.sleep(retry_after)
                 continue
-            if response.status_code >= 500 and attempt < self.max_retries:
-                # 503s from admission shed / engine recovery carry a
-                # server-suggested Retry-After; honor it like on 429
+            if (
+                response.status_code >= 500
+                and response.status_code != 504
+                and attempt < self.max_retries
+            ):
+                # 503s from admission shed / engine recovery / drain
+                # carry a server-suggested Retry-After; honor it like on
+                # 429.  504 (deadline) is NOT retried: the same request
+                # would blow the same budget.
                 retry_after = self.last_rate_limit.retry_after or 2 ** attempt
                 time.sleep(retry_after)
                 continue
@@ -196,10 +257,26 @@ class VGT:
             return response.json()
         raise last_exc or ServerError("retries exhausted")
 
-    def _stream(self, path: str, payload: Dict) -> Iterator[Dict[str, Any]]:
+    def _stream(
+        self,
+        path: str,
+        payload: Dict,
+        headers: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        extra: Dict[str, Any] = {}
+        if timeout is not None:
+            extra["timeout"] = timeout
         with self._http.stream(
-            "POST", path, json=payload, headers=self._headers()
+            "POST", path, json=payload,
+            headers={**self._headers(), **(headers or {})}, **extra,
         ) as response:
+            if response.status_code >= 400:
+                # read the body first: _raise_for_status parses it for
+                # the typed error, and an unread streamed response
+                # raises httpx.ResponseNotRead instead (routine now
+                # that stream-open can meet a draining replica's 503)
+                response.read()
             _raise_for_status(response)
             for line in response.iter_lines():
                 if not line.startswith("data: "):
@@ -254,6 +331,7 @@ class _AsyncChatResource:
         min_tokens: Optional[int] = None,
         stop_token_ids: Optional[List[int]] = None,
         logit_bias: Optional[Dict[str, float]] = None,
+        timeout: Optional[float] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -275,9 +353,12 @@ class _AsyncChatResource:
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
-            return self._client._stream("/v1/chat/completions", payload)
+            return self._client._stream(
+                "/v1/chat/completions", payload, **_deadline_kwargs(timeout)
+            )
         data = await self._client._request(
-            "POST", "/v1/chat/completions", payload
+            "POST", "/v1/chat/completions", payload,
+            **_deadline_kwargs(timeout),
         )
         return ChatCompletion.model_validate(data)
 
@@ -286,11 +367,17 @@ class _AsyncCompletionsResource:
     def __init__(self, client: "AsyncVGT") -> None:
         self._client = client
 
-    async def create(self, prompt, model: Optional[str] = None, **kwargs):
+    async def create(
+        self,
+        prompt,
+        model: Optional[str] = None,
+        timeout: Optional[float] = None,
+        **kwargs,
+    ):
         payload = {"prompt": prompt, "model": model, **kwargs}
         payload = {k: v for k, v in payload.items() if v is not None}
         return await self._client._request(
-            "POST", "/v1/completions", payload
+            "POST", "/v1/completions", payload, **_deadline_kwargs(timeout)
         )
 
 
@@ -299,12 +386,17 @@ class _AsyncEmbeddingsResource:
         self._client = client
 
     async def create(
-        self, input, model: Optional[str] = None
+        self,
+        input,
+        model: Optional[str] = None,
+        timeout: Optional[float] = None,
     ) -> EmbeddingResponse:
         payload = EmbeddingRequest(model=model, input=input).model_dump(
             exclude_none=True
         )
-        data = await self._client._request("POST", "/v1/embeddings", payload)
+        data = await self._client._request(
+            "POST", "/v1/embeddings", payload, **_deadline_kwargs(timeout)
+        )
         return EmbeddingResponse.model_validate(data)
 
 
@@ -334,13 +426,23 @@ class AsyncVGT:
         return headers
 
     async def _request(
-        self, method: str, path: str, payload: Optional[Dict] = None
+        self,
+        method: str,
+        path: str,
+        payload: Optional[Dict] = None,
+        headers: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
     ) -> Any:
         last_exc: Optional[Exception] = None
+        extra: Dict[str, Any] = {}
+        if timeout is not None:
+            extra["timeout"] = timeout
         for attempt in range(self.max_retries + 1):
             try:
                 response = await self._http.request(
-                    method, path, json=payload, headers=self._headers()
+                    method, path, json=payload,
+                    headers={**self._headers(), **(headers or {})},
+                    **extra,
                 )
             except httpx.HTTPError as exc:
                 last_exc = ConnectionError(f"connection failed: {exc}")
@@ -353,8 +455,13 @@ class AsyncVGT:
                 retry_after = self.last_rate_limit.retry_after or 2 ** attempt
                 await asyncio.sleep(retry_after)
                 continue
-            if response.status_code >= 500 and attempt < self.max_retries:
-                # honor the server-suggested Retry-After on 5xx too
+            if (
+                response.status_code >= 500
+                and response.status_code != 504
+                and attempt < self.max_retries
+            ):
+                # honor the server-suggested Retry-After on 5xx too;
+                # 504 (deadline) is terminal for this budget
                 retry_after = self.last_rate_limit.retry_after or 2 ** attempt
                 await asyncio.sleep(retry_after)
                 continue
@@ -363,11 +470,22 @@ class AsyncVGT:
         raise last_exc or ServerError("retries exhausted")
 
     async def _stream(
-        self, path: str, payload: Dict
+        self,
+        path: str,
+        payload: Dict,
+        headers: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
     ) -> AsyncIterator[Dict[str, Any]]:
+        extra: Dict[str, Any] = {}
+        if timeout is not None:
+            extra["timeout"] = timeout
         async with self._http.stream(
-            "POST", path, json=payload, headers=self._headers()
+            "POST", path, json=payload,
+            headers={**self._headers(), **(headers or {})}, **extra,
         ) as response:
+            if response.status_code >= 400:
+                # read before raising (see sync _stream)
+                await response.aread()
             _raise_for_status(response)
             async for line in response.aiter_lines():
                 if not line.startswith("data: "):

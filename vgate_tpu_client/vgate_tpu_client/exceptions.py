@@ -38,6 +38,25 @@ class RateLimitError(VGTError):
         self.retry_after = retry_after
 
 
+class DeadlineExceeded(VGTError):
+    """504 — the server shed the request at its end-to-end deadline
+    (``timeout=`` kwarg / ``X-Request-Timeout``).  ``partial_tokens`` /
+    ``partial_text`` carry whatever generation happened before the shed
+    (the server's partial-tokens metadata).  Not auto-retried: the same
+    request would blow the same budget — raise the deadline instead."""
+
+    def __init__(
+        self,
+        message: str,
+        status_code: Optional[int] = None,
+        body: Optional[Any] = None,
+    ) -> None:
+        super().__init__(message, status_code, body)
+        err = body.get("error", {}) if isinstance(body, dict) else {}
+        self.partial_tokens: int = err.get("partial_tokens", 0) or 0
+        self.partial_text: str = err.get("partial_text", "") or ""
+
+
 class ServerError(VGTError):
     """5xx — gateway or engine failure."""
 
