@@ -1,0 +1,44 @@
+"""CI guardrail (ISSUE 3 satellite): monitoring assets must only
+reference metrics vgate_tpu/metrics.py defines, and every vgt_ metric
+must carry a documentation string.  Fast tier so the tier-1 flow
+enforces it."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts"),
+)
+
+import metrics_lint  # noqa: E402
+
+
+def test_repo_monitoring_assets_pass_lint(capsys):
+    assert metrics_lint.main() == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_lint_catches_undefined_metric(tmp_path, monkeypatch, capsys):
+    bad = tmp_path / "alerts.yml"
+    bad.write_text(
+        "groups:\n  - name: g\n    rules:\n"
+        "      - alert: A\n        expr: vgt_totally_made_up_total > 0\n"
+    )
+    monkeypatch.setattr(metrics_lint, "MONITORING_FILES", (str(bad),))
+    assert metrics_lint.main() == 1
+    err = capsys.readouterr().err
+    assert "vgt_totally_made_up_total" in err
+
+
+def test_lint_understands_exposition_suffixes():
+    defined, families = metrics_lint.defined_metric_names()
+    # counter family + _total alias
+    assert "vgt_requests" in defined and "vgt_requests_total" in defined
+    # histogram expositions
+    assert "vgt_request_latency_seconds_bucket" in defined
+    assert "vgt_time_to_first_token_seconds_sum" in defined
+    # gauges stay bare
+    assert "vgt_kv_pages_in_use" in defined
+    # every vgt_ family is documented (the repo invariant)
+    assert families and all(doc.strip() for _, doc in families)

@@ -122,3 +122,49 @@ def test_tracer_is_noop_without_sdk():
 
 def test_tracing_enabled_without_sdk_degrades():
     assert init_tracing(load_config(tracing={"enabled": True})) is False
+
+
+def test_bound_request_fields_fall_back_into_log_records():
+    """Engine-thread log records carry the owning request's
+    request_id/trace_id via the thread-local binding (ISSUE 3
+    satellite) when no OTel span is active."""
+    from vgate_tpu.logging_config import bound_request
+
+    with bound_request("req-77", "aa" * 16):
+        out = json.loads(JSONFormatter().format(_record()))
+        assert out["request_id"] == "req-77"
+        assert out["trace_id"] == "aa" * 16
+        console = ConsoleFormatter().format(_record())
+        assert "req-77" in console and "aaaaaaaa" in console
+    # binding is scoped: gone after the context exits
+    out = json.loads(JSONFormatter().format(_record()))
+    assert "request_id" not in out and "trace_id" not in out
+
+
+def test_bound_request_nesting_restores_previous_binding():
+    from vgate_tpu.logging_config import bound_request
+
+    with bound_request("outer", None):
+        with bound_request("inner", None):
+            out = json.loads(JSONFormatter().format(_record()))
+            assert out["request_id"] == "inner"
+        out = json.loads(JSONFormatter().format(_record()))
+        assert out["request_id"] == "outer"
+
+
+def test_exemplar_helpers_accept_explicit_trace_id():
+    """TTFT/TPOT/step-time are observed off the request thread; the
+    helpers must take the captured trace id (ISSUE 3 satellite)."""
+    tid = "bb" * 16
+    metrics.observe_with_exemplar(metrics.TTFT, 0.01, trace_id=tid)
+    metrics.observe_with_exemplar(
+        metrics.ENGINE_STEP_TIME.labels(kind="decode"), 0.02, trace_id=tid
+    )
+    metrics.inc_with_exemplar(
+        metrics.REQUEST_COUNT.labels(
+            method="GET", endpoint="/x", status=200
+        ),
+        trace_id=tid,
+    )
+    body, _ = metrics.render_metrics("application/openmetrics-text")
+    assert f'trace_id="{tid}"'.encode() in body

@@ -13,6 +13,7 @@ engine between decode steps), ``stream_async`` (per-token SSE), ``embed``
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import functools
 import os
 import threading
@@ -184,6 +185,7 @@ class JaxTPUBackend:
         prompts: Sequence[str],
         sampling_params: Sequence[SamplingParams],
         cancel_tokens: Optional[Sequence[Any]] = None,
+        request_meta: Optional[Sequence[Any]] = None,
     ) -> List[Any]:
         """Like ``generate_async`` but failures are returned per slot (the
         exception object in place of a GenerationResult) instead of failing
@@ -197,14 +199,23 @@ class JaxTPUBackend:
         its slot settles with finish_reason "abort" while batchmates
         keep decoding.  This closes the gap where batched gateway
         traffic ran under the batcher's own task and a client
-        disconnect left the sequence decoding to completion."""
+        disconnect left the sequence decoding to completion.
+
+        ``request_meta`` (one ``observability.RequestMeta`` or None per
+        prompt) carries the gateway request id and the captured OTel
+        context: the engine parents its queue/prefill/decode phase
+        spans on it and stamps flight-recorder records with the
+        request/trace ids."""
         assert self.core is not None
         faults.check("backend_generate")
         loop = asyncio.get_running_loop()
         seqs = []
         for i, (p, sp) in enumerate(zip(prompts, sampling_params)):
             try:
-                seq = self.core.submit_prompt(p, sp)
+                seq = self.core.submit_prompt(
+                    p, sp,
+                    meta=request_meta[i] if request_meta else None,
+                )
             except Exception as exc:  # queue full / dead engine
                 seqs.append(exc)
                 continue
@@ -242,9 +253,19 @@ class JaxTPUBackend:
             elif seq.status is SeqStatus.FAILED:
                 results.append(seq.error)
             else:
+                # the final-text assembly (tokenizer decode + stop
+                # truncation) is the request's last serving phase
+                with (
+                    seq.trace.span(
+                        "detokenize", tokens=seq.num_output_tokens
+                    )
+                    if seq.trace is not None
+                    else contextlib.nullcontext()
+                ):
+                    text = self.core.final_text(seq)
                 results.append(
                     GenerationResult(
-                        text=self.core.final_text(seq),
+                        text=text,
                         token_ids=list(seq.generated_ids),
                         num_tokens=seq.num_output_tokens,
                         prompt_tokens=seq.orig_prompt_len,
@@ -287,6 +308,7 @@ class JaxTPUBackend:
         params: SamplingParams,
         on_finish: Optional[Any] = None,
         on_usage: Optional[Any] = None,
+        request_meta: Optional[Any] = None,
     ) -> AsyncIterator[str]:
         """Token-by-token text deltas for SSE streaming.  ``on_finish`` (if
         given) is called with the sequence's finish_reason after the last
@@ -309,7 +331,9 @@ class JaxTPUBackend:
             except RuntimeError:
                 pass  # loop closed: consumer disconnected, abort follows
 
-        seq = self.core.submit_prompt(prompt, params, stream_cb=on_token)
+        seq = self.core.submit_prompt(
+            prompt, params, stream_cb=on_token, meta=request_meta
+        )
 
         def on_done() -> None:
             seq.done_event.wait()

@@ -47,7 +47,11 @@ from vgate_tpu.errors import (
 )
 from vgate_tpu.lifecycle import CancelToken, all_of
 from vgate_tpu.logging_config import get_logger
-from vgate_tpu.tracing import get_tracer
+from vgate_tpu.observability.reqtrace import (
+    RequestMeta,
+    emit_gateway_phases,
+)
+from vgate_tpu.tracing import capture_context, context_trace_id, get_tracer
 
 logger = get_logger(__name__)
 tracer = get_tracer(__name__)
@@ -87,6 +91,10 @@ class BatchRequest:
     # tighter deadline is NOT the one the engine enforces) and sync
     # backends keep False, so their backstop fires exactly on time.
     engine_enforced: bool = False
+    # observability (observability/reqtrace.py): request id + the OTel
+    # context captured while the HTTP span was active, so engine phase
+    # spans parent on the request's trace across the thread boundary
+    meta: Optional[RequestMeta] = None
 
 
 class RequestBatcher:
@@ -115,6 +123,10 @@ class RequestBatcher:
         self._drain_retry_after = 2.0
         # memoized: does the backend's settled path accept cancel_tokens?
         self._settled_takes_tokens: Optional[bool] = None
+        # memoized: does it accept request_meta (the engine then emits
+        # exact phase spans; otherwise the batcher approximates them)?
+        self._settled_takes_meta: Optional[bool] = None
+        self._obs_enabled = self.config.observability.enabled
         # Backends without generate_async share one worker hop at a time
         # (the reference's global _inference_lock, batcher.py:79).
         self._sync_lock = asyncio.Lock()
@@ -249,6 +261,12 @@ class RequestBatcher:
             # cache key below — completed results don't depend on it
             timeout_s=timeout_s,
         )
+        request_id = request_id or uuid.uuid4().hex[:12]
+        # capture the request's trace context BEFORE opening the
+        # batcher.submit span, so the engine's phase spans become direct
+        # children of the HTTP request span (siblings of batcher.submit)
+        # rather than grandchildren through a span that ends early
+        trace_ctx = capture_context() if self._obs_enabled else None
         with tracer.start_as_current_span("batcher.submit"):
             self._total_requests += 1
             cache_key = ResultCache.make_key(
@@ -301,7 +319,7 @@ class RequestBatcher:
                 )
 
             request = BatchRequest(
-                request_id=request_id or uuid.uuid4().hex[:12],
+                request_id=request_id,
                 prompt=prompt,
                 params=params,
                 cache_key=cache_key,
@@ -311,6 +329,9 @@ class RequestBatcher:
                     time.perf_counter() + timeout_s
                     if timeout_s is not None
                     else None
+                ),
+                meta=RequestMeta(
+                    request_id=request_id, trace_ctx=trace_ctx
                 ),
             )
             async with self._queue_lock:
@@ -517,6 +538,18 @@ class RequestBatcher:
                             req.future.set_exception(result)
                     continue
                 payload = self._normalize(lead, result)
+                if self._obs_enabled and not self._settled_takes_meta:
+                    # black-box backend (dry-run / external adapters):
+                    # approximate the engine phase spans from reported
+                    # ttft/gen_time so the trace still attributes queue
+                    # vs prefill vs decode
+                    emit_gateway_phases(
+                        lead.meta,
+                        lead.enqueued_at,
+                        start,
+                        payload.get("metrics", {}),
+                        time.perf_counter(),
+                    )
                 if payload.get("finish_reason") not in UNCACHEABLE_FINISH:
                     # cancelled/deadline-shed results are PARTIAL: caching
                     # one would replay a truncated generation to every
@@ -575,12 +608,26 @@ class RequestBatcher:
                     import inspect
 
                     try:
-                        self._settled_takes_tokens = (
-                            "cancel_tokens"
-                            in inspect.signature(gen_settled).parameters
-                        )
+                        sig_params = inspect.signature(
+                            gen_settled
+                        ).parameters
                     except (TypeError, ValueError):
-                        self._settled_takes_tokens = False
+                        sig_params = {}
+                    self._settled_takes_tokens = (
+                        "cancel_tokens" in sig_params
+                    )
+                    self._settled_takes_meta = (
+                        "request_meta" in sig_params
+                    )
+                kwargs = {}
+                if self._settled_takes_meta and self._obs_enabled:
+                    # the engine emits exact per-phase spans and stamps
+                    # flight records with request/trace ids (dedup
+                    # followers share the lead's compute, so only the
+                    # lead's trace shows engine phases)
+                    kwargs["request_meta"] = [
+                        req.meta for req in unique
+                    ]
                 if self._settled_takes_tokens and any(
                     req.token is not None for req in unique
                 ):
@@ -588,7 +635,7 @@ class RequestBatcher:
                     # generation aborts only when EVERY member's client
                     # cancelled — one disconnected twin must not
                     # truncate a still-connected twin's completion
-                    tokens = [
+                    kwargs["cancel_tokens"] = [
                         all_of(
                             [
                                 r.token
@@ -601,10 +648,7 @@ class RequestBatcher:
                         )
                         for lead in unique
                     ]
-                    return await gen_settled(
-                        prompts, params, cancel_tokens=tokens
-                    )
-                return await gen_settled(prompts, params)
+                return await gen_settled(prompts, params, **kwargs)
             if gen_async is not None:
                 return await gen_async(prompts, params)
             async with self._sync_lock:
@@ -617,10 +661,28 @@ class RequestBatcher:
     def _normalize(req: BatchRequest, result: GenerationResult) -> Dict[str, Any]:
         out = result.to_dict()
         m = out.get("metrics", {})
+        # exemplar trace id from the request's CAPTURED context — this
+        # runs on the batch task, where the active span (if any) is the
+        # batch-scoped batcher.process_batch, whose trace id must NOT
+        # leak onto request-scoped histograms; no valid request trace
+        # means plain observations, not the fallback lookup
+        trace_id = (
+            context_trace_id(req.meta.trace_ctx) if req.meta else None
+        )
         if "ttft" in m:
-            metrics.TTFT.observe(m["ttft"])
+            if trace_id:
+                metrics.observe_with_exemplar(
+                    metrics.TTFT, m["ttft"], trace_id=trace_id
+                )
+            else:
+                metrics.TTFT.observe(m["ttft"])
         if "tpot" in m:
-            metrics.TPOT.observe(m["tpot"])
+            if trace_id:
+                metrics.observe_with_exemplar(
+                    metrics.TPOT, m["tpot"], trace_id=trace_id
+                )
+            else:
+                metrics.TPOT.observe(m["tpot"])
         if result.num_tokens:
             metrics.GENERATED_TOKENS.inc(result.num_tokens)
         if result.prompt_tokens:

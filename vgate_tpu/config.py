@@ -362,6 +362,34 @@ class TracingConfig(BaseModel):
     service_name: str = "vgate-tpu"
 
 
+class ObservabilityConfig(BaseModel):
+    """Engine flight recorder + cross-thread request tracing
+    (vgate_tpu/observability/; docs/observability.md).
+
+    Distinct from ``tracing`` (the OTel exporter wiring): this section
+    governs what the serving stack *records about itself* — the
+    per-tick flight recorder ring, the per-request phase records, and
+    whether engine-side phase spans are emitted at all."""
+
+    # Master switch: off = no flight recorder, no engine phase spans,
+    # no /debug payloads — the hot path reverts to pre-observability
+    # behavior exactly.
+    enabled: bool = True
+    # Ring sizes (entries kept; oldest evicted).  Ticks are small
+    # fixed-shape dicts, requests a bit larger.
+    flight_ticks: int = 512
+    flight_requests: int = 256
+    # Ticks included in the crash snapshot the supervisor logs and
+    # /stats surfaces under engine.last_crash.
+    crash_dump_ticks: int = 64
+    # Never store prompt text in request records; only token counts and
+    # the fingerprint.  Set false to keep a short preview for debugging
+    # (prompt_preview_chars) — leaks user content into /debug and crash
+    # logs, so off only in trusted environments.
+    redact_prompts: bool = True
+    prompt_preview_chars: int = 48
+
+
 class SecurityConfig(BaseModel):
     """API-key auth (reference: vgate/config.py:101-115)."""
 
@@ -410,6 +438,9 @@ class VGTConfig(BaseModel):
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     metrics: MetricsConfig = Field(default_factory=MetricsConfig)
     tracing: TracingConfig = Field(default_factory=TracingConfig)
+    observability: ObservabilityConfig = Field(
+        default_factory=ObservabilityConfig
+    )
     security: SecurityConfig = Field(default_factory=SecurityConfig)
     rate_limit: RateLimitConfig = Field(default_factory=RateLimitConfig)
     benchmark: BenchmarkConfig = Field(default_factory=BenchmarkConfig)

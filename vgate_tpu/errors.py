@@ -114,11 +114,17 @@ class DeadlineExceededError(RuntimeError):
         partial_text: str = "",
         partial_tokens: int = 0,
         deadline_s: float = 0.0,
+        phases: dict = None,
     ) -> None:
         super().__init__(message)
         self.partial_text = partial_text
         self.partial_tokens = partial_tokens
         self.deadline_s = deadline_s
+        # per-phase breakdown of where the budget went (queue_s /
+        # prefill_s / decode_s, from the engine flight recorder) so a
+        # 504's metadata answers "slow where?" — empty when the shed
+        # happened before any phase attribution existed
+        self.phases = dict(phases or {})
 
 
 class ClientDisconnectError(RuntimeError):

@@ -9,13 +9,61 @@ vgate/batcher.py:95-101).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import sys
+import threading
 from datetime import datetime, timezone
 from typing import Any, Dict, Optional
 
 from vgate_tpu.tracing import get_current_span_id, get_current_trace_id
+
+# Thread-local request binding: the engine thread has no active OTel
+# span (spans are emitted with explicit timestamps, never attached to
+# its context), so sequence-scoped log records would lose their
+# request/trace identity.  The engine binds the owning request around
+# per-sequence work; both formatters fall back to it when the span
+# lookup yields nothing.
+_bound = threading.local()
+
+
+def bind_request_fields(
+    request_id: Optional[str], trace_id: Optional[str]
+):
+    """Set the calling thread's bound request identity; returns the
+    previous binding (pass it back to restore).  Hot-path friendly: two
+    attribute writes, no allocation when both ids are None."""
+    prev = getattr(_bound, "fields", None)
+    if request_id is None and trace_id is None:
+        _bound.fields = None
+    else:
+        fields = {}
+        if request_id:
+            fields["request_id"] = request_id
+        if trace_id:
+            fields["trace_id"] = trace_id
+        _bound.fields = fields or None
+    return prev
+
+
+def restore_request_fields(prev) -> None:
+    _bound.fields = prev
+
+
+@contextlib.contextmanager
+def bound_request(
+    request_id: Optional[str] = None, trace_id: Optional[str] = None
+):
+    prev = bind_request_fields(request_id, trace_id)
+    try:
+        yield
+    finally:
+        restore_request_fields(prev)
+
+
+def _bound_fields() -> Optional[Dict[str, str]]:
+    return getattr(_bound, "fields", None)
 
 _ANSI = {
     "DEBUG": "\033[36m",
@@ -45,6 +93,10 @@ class JSONFormatter(logging.Formatter):
             span_id = get_current_span_id()
             if span_id:
                 payload["span_id"] = span_id
+        else:
+            bound = _bound_fields()
+            if bound:
+                payload.update(bound)
         extra = getattr(record, "extra_data", None)
         if isinstance(extra, dict):
             payload.update(extra)
@@ -66,6 +118,13 @@ class ConsoleFormatter(logging.Formatter):
         trace_id = get_current_trace_id()
         if trace_id:
             parts.append(f" [trace={trace_id[:8]}]")
+        else:
+            bound = _bound_fields()
+            if bound:
+                if "trace_id" in bound:
+                    parts.append(f" [trace={bound['trace_id'][:8]}]")
+                if "request_id" in bound:
+                    parts.append(f" [req={bound['request_id']}]")
         extra = getattr(record, "extra_data", None)
         if isinstance(extra, dict) and extra:
             parts.append(" " + json.dumps(extra, default=str))

@@ -235,25 +235,32 @@ def init_app_info(version: str, model_id: str, engine_type: str) -> None:
     )
 
 
-def _exemplar() -> Optional[Dict[str, str]]:
-    trace_id = get_current_trace_id()
+def _exemplar(trace_id: Optional[str] = None) -> Optional[Dict[str, str]]:
+    trace_id = trace_id or get_current_trace_id()
     if trace_id:
         return {"trace_id": trace_id}
     return None
 
 
-def observe_with_exemplar(histogram_child, value: float) -> None:
-    """Attach the current trace id as an exemplar when available
-    (reference exemplar wiring: main.py:142-153)."""
+def observe_with_exemplar(
+    histogram_child, value: float, trace_id: Optional[str] = None
+) -> None:
+    """Attach a trace id as an exemplar when available (reference
+    exemplar wiring: main.py:142-153).  ``trace_id`` overrides the
+    active-span lookup for observations made OFF the request's
+    thread/context — the engine thread and the batcher's batch task
+    observe TTFT/TPOT/step-time with the owning request's captured id."""
     try:
-        histogram_child.observe(value, exemplar=_exemplar())
+        histogram_child.observe(value, exemplar=_exemplar(trace_id))
     except (TypeError, ValueError):  # pragma: no cover
         histogram_child.observe(value)
 
 
-def inc_with_exemplar(counter_child, value: float = 1.0) -> None:
+def inc_with_exemplar(
+    counter_child, value: float = 1.0, trace_id: Optional[str] = None
+) -> None:
     try:
-        counter_child.inc(value, exemplar=_exemplar())
+        counter_child.inc(value, exemplar=_exemplar(trace_id))
     except (TypeError, ValueError):  # pragma: no cover
         counter_child.inc(value)
 

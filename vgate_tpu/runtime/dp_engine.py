@@ -31,6 +31,62 @@ from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 logger = get_logger(__name__)
 
 
+class _MergedFlight:
+    """Read-only view merging the replicas' flight recorders so /debug
+    works on dp>1 pods (each replica records independently; entries are
+    stamped with their replica index and merged by wall time)."""
+
+    def __init__(self, replicas: List[EngineCore]) -> None:
+        self._replicas = replicas
+
+    @property
+    def enabled(self) -> bool:
+        return any(r.flight.enabled for r in self._replicas)
+
+    def _merged(self, method: str, n: Optional[int]) -> List[Dict[str, Any]]:
+        out = []
+        for i, core in enumerate(self._replicas):
+            for entry in getattr(core.flight, method)():
+                entry = dict(entry)
+                entry["replica"] = i
+                out.append(entry)
+        out.sort(key=lambda e: e.get("t") or e.get("arrival_t") or 0.0)
+        if n is not None and n >= 0:
+            out = out[-n:]
+        return out
+
+    def ticks(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        return self._merged("ticks", n)
+
+    def requests(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        return self._merged("requests", n)
+
+    def live_requests(self) -> List[Dict[str, Any]]:
+        return self._merged("live_requests", None)
+
+    def find_request(self, ident: str) -> Optional[Dict[str, Any]]:
+        # newest attempt wins ACROSS replicas too (a retry may land on
+        # a different replica than the failed original)
+        best: Optional[Dict[str, Any]] = None
+        for i, core in enumerate(self._replicas):
+            record = core.flight.find_request(ident)
+            if record is None:
+                continue
+            record = dict(record)
+            record["replica"] = i
+            if best is None or (record.get("arrival_t") or 0.0) > (
+                best.get("arrival_t") or 0.0
+            ):
+                best = record
+        return best
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "replicas": [r.flight.get_stats() for r in self._replicas],
+        }
+
+
 class ReplicatedEngine:
     """``dp`` EngineCore replicas over disjoint submeshes + a load router."""
 
@@ -61,6 +117,8 @@ class ReplicatedEngine:
         ]
         self._rr = itertools.count()
         self._route_lock = threading.Lock()
+        # /debug surface parity with dp=1: one merged recorder view
+        self.flight = _MergedFlight(self.replicas)
         # convenience aliases: identical across replicas
         lead = self.replicas[0]
         self.spec = lead.spec
@@ -154,9 +212,10 @@ class ReplicatedEngine:
         prompt_ids: List[int],
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[Any] = None,
     ) -> Sequence:
         return self._pick_replica(list(prompt_ids)).submit_tokens(
-            prompt_ids, params, stream_cb
+            prompt_ids, params, stream_cb, meta=meta
         )
 
     def submit_prompt(
@@ -164,13 +223,14 @@ class ReplicatedEngine:
         prompt: str,
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[Any] = None,
     ) -> Sequence:
         ids = self.tokenizer.encode(prompt)
         max_prompt = self.config.model.max_model_len - 1
         if len(ids) > max_prompt:
             ids = ids[-max_prompt:]
         return self._pick_replica(ids).submit_tokens(
-            ids or [self.tokenizer.bos_id], params, stream_cb
+            ids or [self.tokenizer.bos_id], params, stream_cb, meta=meta
         )
 
     def generate(

@@ -43,7 +43,7 @@ from vgate_tpu import faults, metrics
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.errors import DeadlineExceededError, EngineRecoveringError
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
-from vgate_tpu.logging_config import get_logger
+from vgate_tpu.logging_config import bound_request, get_logger
 from vgate_tpu.models.decoder import (
     decode_forward,
     prefill_forward,
@@ -59,6 +59,8 @@ from vgate_tpu.ops.sampling import (
     suppress_stop_tokens,
     verify_and_sample,
 )
+from vgate_tpu.observability.flight import FlightRecorder
+from vgate_tpu.observability.reqtrace import RequestMeta, RequestTrace
 from vgate_tpu.parallel.mesh import build_mesh, initialize_distributed
 from vgate_tpu.parallel.sharding import kv_pspec, named, shard_params
 from vgate_tpu.runtime.kv_cache import (
@@ -544,6 +546,10 @@ class EngineCore:
                 "relay prompt pass reshapes the program incompatibly "
                 "(sp is fine: chunks ride the sp-capable suffix program)"
             )
+        # flight recorder (vgate_tpu/observability/flight.py): per-tick
+        # + per-request post-mortem rings; the supervisor snapshots it
+        # on every crash and /debug serves it live
+        self.flight = FlightRecorder(self.config.observability)
         self.scheduler = Scheduler(
             allocator=self.allocator,
             max_slots=self.max_slots,
@@ -558,6 +564,7 @@ class EngineCore:
             prefix_cache=self.prefix_cache_enabled,
             prefill_chunk=tpu_cfg.prefill_chunk,
             text_fn=self.final_text,
+            recorder=self.flight,
         )
 
         # host-side mirror of the device page tables, one row per slot
@@ -760,6 +767,9 @@ class EngineCore:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._fatal: Optional[BaseException] = None
+        # flight snapshot taken on the dying engine thread, while the
+        # crashed tick's residents are still live (supervisor reads it)
+        self._crash_snapshot: Optional[Dict[str, Any]] = None
         # supervision hook (runtime/supervisor.py): called once from the
         # engine thread after a fatal error is fully contained.  When set,
         # owed futures fail with a *retryable* error (the supervisor is
@@ -827,11 +837,26 @@ class EngineCore:
         wrapped.__cause__ = exc
         return wrapped
 
+    def _on_seq_settle(self, seq: Sequence) -> None:
+        """Single settle observer (Sequence.finish/fail): closes the
+        flight-recorder request record and the request's phase spans —
+        covers every settle path, scheduler-internal sheds included."""
+        self.flight.on_close(seq)
+        tr = seq.trace
+        if tr is not None:
+            if seq.error is None:
+                tr.end("decode", tokens=seq.num_generated)
+            # failures leave the phase span open so close() annotates
+            # it with the exception — a cleanly-ended decode span on a
+            # failed request would misread as a normal completion
+            tr.close(seq.error)
+
     def submit_tokens(
         self,
         prompt_ids: List[int],
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[RequestMeta] = None,
     ) -> Sequence:
         if self._fatal is not None:
             raise RuntimeError("engine is dead") from self._fatal
@@ -840,6 +865,14 @@ class EngineCore:
             params=params,
             stream_cb=stream_cb,
         )
+        if self.flight.enabled:
+            seq.on_settle = self._on_seq_settle
+            if meta is not None:
+                seq.request_id = meta.request_id
+                seq.trace = RequestTrace(meta)
+                # the queue phase starts NOW (caller thread); the engine
+                # thread ends it at admission
+                seq.trace.start("queue", start_pc=seq.arrival_t)
         self._submit_q.put(seq)
         # Re-check after the put: if the engine died between the check
         # above and the put, the fatal handler may already have drained
@@ -873,8 +906,11 @@ class EngineCore:
         prompt: str,
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[RequestMeta] = None,
     ) -> Sequence:
-        return self.submit_tokens(self.encode_prompt(prompt), params, stream_cb)
+        return self.submit_tokens(
+            self.encode_prompt(prompt), params, stream_cb, meta=meta
+        )
 
     def generate(
         self, prompts: Seq[str], params: Seq[SamplingParams]
@@ -919,6 +955,18 @@ class EngineCore:
                     self._wakeup.clear()
             except Exception as exc:
                 logger.error("engine loop fatal error", exc_info=True)
+                # the crash becomes the ring's final tick, so a snapshot
+                # ends with the faulting dispatch; snapshot BEFORE the
+                # containment below fails every owed future — the
+                # in-flight view must show what was resident at the
+                # moment of death, not after the sweep
+                self.flight.record_tick(
+                    "crash",
+                    error=f"{type(exc).__name__}: {exc}",
+                    batch=len(self.scheduler.running),
+                    queue_depth=len(self.scheduler.waiting),
+                )
+                self._crash_snapshot = self.flight.crash_snapshot(exc)
                 self._fatal = exc
                 # poison-heuristic evidence: the requests resident at the
                 # crash (keyed by their ORIGINAL prompt, which survives
@@ -1063,7 +1111,14 @@ class EngineCore:
         (scheduler.try_admit)."""
         for seq in self._running_seqs():
             if seq.abort_requested:
-                self.scheduler.abort(seq)
+                # bind the owning request so every log record emitted
+                # while dropping the sequence carries its identity
+                # (logging_config falls back to the thread-local when
+                # the engine thread has no active span)
+                with bound_request(
+                    seq.request_id, getattr(seq.trace, "trace_id", None)
+                ):
+                    self.scheduler.abort(seq)
 
     def _handle_deadlines(self) -> None:
         """Shed RUNNING sequences past their end-to-end deadline between
@@ -1078,17 +1133,29 @@ class EngineCore:
         for seq in self._running_seqs():
             if not seq.past_deadline(now):
                 continue
-            self.scheduler.shed(
-                seq,
-                DeadlineExceededError(
-                    f"request deadline ({seq.params.timeout_s:.3f}s) "
-                    f"passed mid-generation after "
-                    f"{seq.num_generated} tokens",
-                    partial_text=self.final_text(seq),
-                    partial_tokens=seq.num_generated,
-                    deadline_s=seq.params.timeout_s or 0.0,
-                ),
-            )
+            if seq.trace is not None:
+                seq.trace.event("deadline_shed")
+            with bound_request(
+                seq.request_id, getattr(seq.trace, "trace_id", None)
+            ):
+                self._shed_deadline(seq)
+
+    def _shed_deadline(self, seq: Sequence) -> None:
+        self.scheduler.shed(
+            seq,
+            DeadlineExceededError(
+                f"request deadline ({seq.params.timeout_s:.3f}s) "
+                f"passed mid-generation after "
+                f"{seq.num_generated} tokens",
+                partial_text=self.final_text(seq),
+                partial_tokens=seq.num_generated,
+                deadline_s=seq.params.timeout_s or 0.0,
+                # where the budget went (flight recorder): lets a 504
+                # distinguish "queued forever" from "decoded slowly"
+                # without server access
+                phases=self.flight.phases_of(seq),
+            ),
+        )
 
     def abort(self, seq_id: int, reason: str = "client_disconnect") -> None:
         """Request-scoped cancellation by sequence id (the vLLM
@@ -1178,6 +1245,28 @@ class EngineCore:
             plans.append(plan)
         if not plans:
             return False
+        if self.flight.enabled:
+            for plan in plans:
+                seq = plan.seq
+                preview = None
+                if not self.flight.redact_prompts:
+                    try:
+                        preview = self.tokenizer.decode(
+                            seq.prompt_ids[:32]
+                        )
+                    except Exception:  # pragma: no cover - defensive
+                        preview = None
+                self.flight.on_admit(
+                    seq, plan.bucket, plan.cached_len, preview=preview
+                )
+                if seq.trace is not None:
+                    seq.trace.end("queue")
+                    seq.trace.start(
+                        "prefill",
+                        bucket=plan.bucket,
+                        cached_tokens=plan.cached_len,
+                        chunked=plan.chunked,
+                    )
         if faults.is_active():
             # fault probe (vgate_tpu/faults.py): payload is the request's
             # ORIGINAL prompt so a poison fault can target one request.
@@ -1225,16 +1314,43 @@ class EngineCore:
         # an equal share to each prefill so observation count stays
         # one-per-prefill and the histogram sum stays the true wall time
         share = (time.perf_counter() - start) / len(plans)
-        for _ in plans:
-            metrics.ENGINE_STEP_TIME.labels(kind="prefill").observe(share)
+        for plan in plans:
+            metrics.observe_with_exemplar(
+                metrics.ENGINE_STEP_TIME.labels(kind="prefill"),
+                share,
+                trace_id=getattr(plan.seq.trace, "trace_id", None),
+            )
         for (group, _), (tokens, lp) in zip(dispatched, firsts):
+            self.flight.record_tick(
+                "prefill",
+                batch=len(group),
+                bucket=group[0].bucket,
+                step_s=round(share * len(group), 6),
+                kv_used=self.allocator.num_used,
+                kv_free=self.allocator.num_free,
+                queue_depth=len(self.scheduler.waiting),
+            )
             arr = np.asarray(tokens)
             for row, plan in enumerate(group):
                 token = int(arr[row])
                 self.total_prefills += 1
                 if lp is not None and plan.seq.params.logprobs:
                     self._attach_logprob(plan.seq, lp, 0, row)
+                # a RE-prefill (post-preemption) keeps the original
+                # first_token_t; its phase boundary is NOW, not the
+                # first incarnation's first token
+                fresh_first = plan.seq.first_token_t is None
                 plan.seq.append_token(token)
+                self.flight.on_first_token(plan.seq)
+                tr = plan.seq.trace
+                if tr is not None:
+                    boundary = (
+                        plan.seq.first_token_t
+                        if fresh_first
+                        else time.perf_counter()
+                    )
+                    tr.end("prefill", end_pc=boundary)
+                    tr.start("decode", start_pc=boundary)
                 self._maybe_finish(plan.seq, token)
         return True
 
@@ -1385,6 +1501,12 @@ class EngineCore:
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
+            self.flight.record_tick(
+                "recompile", program="prefill", bucket=bucket, batch=B
+            )
+            for plan in plans:
+                if plan.seq.trace is not None:
+                    plan.seq.trace.event("xla_compile", bucket=bucket)
         out, self.k_pages, self.v_pages = _prefill_step(
             self.params,
             self.spec,
@@ -1493,6 +1615,13 @@ class EngineCore:
         if key not in self._compiled_buckets:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
+            self.flight.record_tick(
+                "recompile", program="suffix_prefill", bucket=bucket,
+                batch=B,
+            )
+            for plan in plans:
+                if plan.seq.trace is not None:
+                    plan.seq.trace.event("xla_compile", bucket=bucket)
         out, self.k_pages, self.v_pages = _suffix_prefill_step(
             self.params,
             self.spec,
@@ -1747,6 +1876,13 @@ class EngineCore:
         if chunk_key not in self._compiled_chunks:
             metrics.RECOMPILES.labels(kind="decode").inc()
             self._compiled_chunks.add(chunk_key)
+            self.flight.record_tick(
+                "recompile", program="decode", chunk=chunk,
+                batch=len(active),
+            )
+            for seq in active:
+                if seq.trace is not None:
+                    seq.trace.event("xla_compile", chunk=chunk)
         start = time.perf_counter()
         (
             chunk_tokens,
@@ -1817,8 +1953,27 @@ class EngineCore:
                 if lp_dev is None
                 else tuple(np.asarray(a) for a in lp_dev)
             )
-            metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
-                time.perf_counter() - block_start
+            block_s = time.perf_counter() - block_start
+            metrics.observe_with_exemplar(
+                metrics.ENGINE_STEP_TIME.labels(kind="decode"),
+                block_s,
+                trace_id=next(
+                    (
+                        s.trace.trace_id
+                        for s, _ in seqs
+                        if s.trace is not None and s.trace.trace_id
+                    ),
+                    None,
+                ),
+            )
+            self.flight.record_tick(
+                "decode",
+                batch=len(seqs),
+                chunk=chunk,
+                step_s=round(block_s, 6),
+                kv_used=self.allocator.num_used,
+                kv_free=self.allocator.num_free,
+                queue_depth=len(self.scheduler.waiting),
             )
             for seq, epoch in seqs:
                 if (
@@ -2029,8 +2184,27 @@ class EngineCore:
                 np.transpose(np.asarray(lp_data[1]), (1, 0, 2)),
                 np.transpose(np.asarray(lp_data[2]), (1, 0, 2)),
             )
-        metrics.ENGINE_STEP_TIME.labels(kind="decode").observe(
-            time.perf_counter() - start
+        spec_s = time.perf_counter() - start
+        metrics.observe_with_exemplar(
+            metrics.ENGINE_STEP_TIME.labels(kind="decode"),
+            spec_s,
+            trace_id=next(
+                (
+                    s.trace.trace_id
+                    for s in active
+                    if s.trace is not None and s.trace.trace_id
+                ),
+                None,
+            ),
+        )
+        self.flight.record_tick(
+            "spec_verify",
+            batch=len(active),
+            chunk=S_round,
+            step_s=round(spec_s, 6),
+            kv_used=self.allocator.num_used,
+            kv_free=self.allocator.num_free,
+            queue_depth=len(self.scheduler.waiting),
         )
         for seq in active:
             if seq.status is not SeqStatus.RUNNING:
@@ -2289,6 +2463,7 @@ class EngineCore:
             "prefills": self.total_prefills,
             "decode_tokens": self.total_decode_tokens,
             "state_rebuilds": self.total_state_rebuilds,
+            "flight": self.flight.get_stats(),
             "kv_pages_total": self.allocator.num_allocatable,
             "kv_token_capacity": self.geometry.total_tokens,
             "model": self.spec.name,

@@ -80,7 +80,11 @@ class Scheduler:
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
         text_fn=None,
+        recorder=None,
     ) -> None:
+        # optional flight recorder (observability/flight.py): residency
+        # events (preempt/shed/abort) become post-mortem ring entries
+        self.recorder = recorder
         # renders a sequence's partial generation for deadline-shed
         # metadata (the engine injects tokenizer.decode-backed
         # final_text); None keeps queued sheds text-less.  A preempted
@@ -244,6 +248,18 @@ class Scheduler:
                         partial_text = self.text_fn(seq)
                     except Exception:  # pragma: no cover - defensive
                         pass
+                self._event(
+                    "shed", seq, where="queued",
+                    partial_tokens=seq.num_generated,
+                )
+                # phase attribution from the recorder when attached: a
+                # PREEMPTED sequence re-queued here spent most of its
+                # budget computing, and reporting the whole lifetime as
+                # queue_s would misattribute it
+                if self.recorder is not None:
+                    phases = self.recorder.phases_of(seq)
+                else:
+                    phases = {"queue_s": round(waited / 1000.0, 6)}
                 seq.fail(
                     DeadlineExceededError(
                         f"request deadline "
@@ -253,6 +269,7 @@ class Scheduler:
                         partial_text=partial_text,
                         partial_tokens=seq.num_generated,
                         deadline_s=seq.params.timeout_s or 0.0,
+                        phases=phases,
                     )
                 )
                 metrics.CANCELLED_REQUESTS.labels(reason="deadline").inc()
@@ -263,6 +280,7 @@ class Scheduler:
                 and seq.preempt_count == 0
                 and now - seq.arrival_t > admission_s
             ):
+                self._event("shed", seq, where="admission")
                 seq.fail(
                     AdmissionDeadlineExceeded(
                         f"request waited {(now - seq.arrival_t) * 1000:.0f}ms "
@@ -443,16 +461,35 @@ class Scheduler:
             return None
         return max(running, key=lambda s: s.seq_id)
 
+    def _event(self, kind: str, seq: Sequence, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.record_tick(
+                kind,
+                seq_id=seq.seq_id,
+                request_id=seq.request_id,
+                queue_depth=len(self.waiting),
+                **fields,
+            )
+
     def _preempt(self, seq: Sequence) -> None:
         logger.warning(
             "preempting sequence for KV pressure",
             extra={
                 "extra_data": {
                     "seq_id": seq.seq_id,
+                    "request_id": seq.request_id,
+                    "trace_id": getattr(seq.trace, "trace_id", None),
                     "resident_tokens": seq.total_len,
                 }
             },
         )
+        self._event("preempt", seq, resident_tokens=seq.total_len)
+        if self.recorder is not None:
+            # phase accounting: accrue the interrupted compute phase,
+            # re-enter queue time (re-admission resumes at on_admit)
+            self.recorder.on_preempt(seq)
+        if seq.trace is not None:
+            seq.trace.preempted()
         slot = seq.slot
         self.allocator.release(seq.pages)
         if slot is not None:
@@ -488,6 +525,7 @@ class Scheduler:
         self._release_residency(seq)
         self.total_aborted += 1
         metrics.CANCELLED_REQUESTS.labels(reason=seq.abort_reason).inc()
+        self._event("abort", seq, reason=seq.abort_reason)
         seq.finish("abort")
 
     def shed(self, seq: Sequence, exc: DeadlineExceededError) -> None:
@@ -501,6 +539,10 @@ class Scheduler:
         self.total_deadline_shed += 1
         metrics.CANCELLED_REQUESTS.labels(reason="deadline").inc()
         metrics.DEADLINE_PARTIAL_TOKENS.observe(seq.num_generated)
+        self._event(
+            "shed", seq, where="running",
+            partial_tokens=seq.num_generated,
+        )
         seq.fail(exc)
 
     def get_stats(self) -> dict:

@@ -61,6 +61,16 @@ class Sequence:
     # absolute perf_counter deadline (arrival_t + params.timeout_s);
     # the engine sheds the sequence between decode ticks once passed
     deadline_t: Optional[float] = None
+    # observability identity (observability/reqtrace.py): the gateway's
+    # request id, and the per-request phase-span emitter — both optional
+    # so direct engine callers (tests, bench drivers) pay nothing
+    request_id: Optional[str] = None
+    trace: Optional[Any] = None
+    # settle observer, invoked exactly once from finish()/fail() — the
+    # engine's flight recorder closes the request record here so every
+    # settle path (scheduler sheds included) is covered by one hook
+    on_settle: Optional[Callable[["Sequence"], Any]] = None
+    _settle_notified: bool = False
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -123,16 +133,27 @@ class Sequence:
         self.abort_reason = reason
         self.abort_requested = True
 
+    def _notify_settle(self) -> None:
+        if self._settle_notified or self.on_settle is None:
+            return
+        self._settle_notified = True
+        try:
+            self.on_settle(self)
+        except Exception:
+            pass  # observability must never break delivery
+
     def finish(self, reason: str) -> None:
         self.status = SeqStatus.FINISHED
         self.finish_reason = reason
         self.finish_t = time.perf_counter()
+        self._notify_settle()
         self.done_event.set()
 
     def fail(self, exc: BaseException) -> None:
         self.status = SeqStatus.FAILED
         self.error = exc
         self.finish_t = time.perf_counter()
+        self._notify_settle()
         self.done_event.set()
 
     def reset_for_recompute(self) -> None:

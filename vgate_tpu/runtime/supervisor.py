@@ -110,6 +110,10 @@ class EngineSupervisor:
         self.total_restarts = 0
         self.transitions: List[tuple] = []
         self.last_fatal: Optional[str] = None
+        # flight-recorder snapshot of the most recent crash (ticks +
+        # in-flight requests at the moment of death) — logged on every
+        # crash classification and surfaced via /stats engine.last_crash
+        self.last_crash: Optional[Dict[str, Any]] = None
         # first build: construction failures (bad config, weight-load
         # faults) propagate — there is nothing to recover *to* yet
         self.core = EngineCore(self.config, devices=devices)
@@ -282,6 +286,25 @@ class EngineSupervisor:
                 }
             },
         )
+        # post-mortem: dump the dead core's flight recorder (its final
+        # tick is the faulting dispatch) as one structured log record,
+        # and keep it for /stats → engine.last_crash — the rings
+        # themselves die with the core at rebuild
+        flight = getattr(self.core, "flight", None)
+        if flight is not None:
+            # prefer the snapshot the dying engine thread took before
+            # containment swept its residents; fall back to a fresh one
+            # (still carries the ticks) for cores that died another way
+            snapshot = (
+                getattr(self.core, "_crash_snapshot", None)
+                or flight.crash_snapshot(exc)
+            )
+            snapshot["classification"] = kind
+            self.last_crash = snapshot
+            logger.error(
+                "engine crash flight-recorder snapshot",
+                extra={"extra_data": {"flight": snapshot}},
+            )
         self._update_quarantine(exc, kind)
         if kind == "unrecoverable":
             self._transition(HealthState.DEAD)
@@ -386,10 +409,13 @@ class EngineSupervisor:
         prompt_ids: List[int],
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[Any] = None,
     ) -> Sequence:
         self._gate(list(prompt_ids))
         try:
-            return self.core.submit_tokens(prompt_ids, params, stream_cb)
+            return self.core.submit_tokens(
+                prompt_ids, params, stream_cb, meta=meta
+            )
         except EngineRecoveringError:
             raise
         except RuntimeError as exc:
@@ -406,9 +432,10 @@ class EngineSupervisor:
         prompt: str,
         params: SamplingParams,
         stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[Any] = None,
     ) -> Sequence:
         return self.submit_tokens(
-            self.core.encode_prompt(prompt), params, stream_cb
+            self.core.encode_prompt(prompt), params, stream_cb, meta=meta
         )
 
     def generate(
@@ -487,6 +514,9 @@ class EngineSupervisor:
         except Exception:  # mid-rebuild
             stats = {}
         stats["supervisor"] = self.health()
+        # always present (None until a crash happens) so operators can
+        # discover the field without inducing one; docs/operations.md
+        stats["last_crash"] = self.last_crash
         armed = faults.snapshot()
         if armed:
             stats["faults_armed"] = armed

@@ -38,6 +38,7 @@ from vgate_tpu.errors import (
 )
 from vgate_tpu.lifecycle import CancelToken, DrainController
 from vgate_tpu.logging_config import get_logger, setup_logging
+from vgate_tpu.observability.reqtrace import RequestMeta
 from vgate_tpu.runtime.scheduler import EngineBusyError
 from vgate_tpu.security import build_security_middleware
 from vgate_tpu.server.openai_models import (
@@ -55,7 +56,12 @@ from vgate_tpu.server.openai_models import (
     Usage,
     messages_to_prompt,
 )
-from vgate_tpu.tracing import get_tracer, init_tracing, shutdown_tracing
+from vgate_tpu.tracing import (
+    capture_context,
+    get_tracer,
+    init_tracing,
+    shutdown_tracing,
+)
 from vgate_tpu.version import __version__
 
 logger = get_logger(__name__)
@@ -65,6 +71,13 @@ _QUIET_PATHS = {"/health", "/health/live", "/health/ready", "/metrics"}
 # excluded from the drain's in-flight count: probes/scrapes (and /stats
 # polls watching the drain itself) must never hold a drain open
 _UNCOUNTED_PATHS = _QUIET_PATHS | {"/stats"}
+
+
+def _drain_counted(path: str) -> bool:
+    """Should this request hold a graceful drain open?  Probe, scrape
+    and introspection surfaces (/debug — operators use it to watch a
+    drain or diagnose the reason for one) never do."""
+    return path not in _UNCOUNTED_PATHS and not path.startswith("/debug")
 # non-standard but conventional (nginx): the client closed the
 # connection before the response could be written — nobody reads the
 # body, but metrics/logs get a truthful status
@@ -94,9 +107,12 @@ async def observability_middleware(request: web.Request, handler):
     waits on (probe/metrics paths excluded — a scraper must never hold
     the drain open)."""
     request_id = request.headers.get("X-Request-ID", uuid.uuid4().hex[:16])
+    # visible to handlers (the streaming path stamps it onto the engine
+    # sequence so /debug/requests/{X-Request-ID} finds the record)
+    request["request_id"] = request_id
     start = time.perf_counter()
     metrics.REQUESTS_IN_PROGRESS.inc()
-    counted = request.path not in _UNCOUNTED_PATHS
+    counted = _drain_counted(request.path)
     if counted:
         request.app["inflight"].value += 1
     try:
@@ -376,7 +392,9 @@ async def _settle_submits(engine: VGTEngine, coros):
         return list(settled), None
     except DeadlineExceededError as exc:
         # engine-shed deadline: 504 with partial-generation metadata so
-        # the client can tell "slow but generating" from "stuck"
+        # the client can tell "slow but generating" from "stuck", plus
+        # the flight recorder's phase breakdown (queue/prefill/decode)
+        # answering WHERE the budget went
         resp = web.json_response(
             {
                 "error": {
@@ -384,6 +402,7 @@ async def _settle_submits(engine: VGTEngine, coros):
                     "type": "timeout_error",
                     "partial_tokens": exc.partial_tokens,
                     "partial_text": exc.partial_text,
+                    "phases": exc.phases,
                 }
             },
             status=504,
@@ -639,6 +658,16 @@ async def _stream_chat(
             if want_usage and "on_usage" in stream_params:
                 kwargs["on_usage"] = (
                     lambda u: usage_box.__setitem__("value", u)
+                )
+            if (
+                "request_meta" in stream_params
+                and engine.config.observability.enabled
+            ):
+                # streaming bypasses the batcher, so the trace context
+                # and request id cross the seam here instead
+                kwargs["request_meta"] = RequestMeta(
+                    request_id=request.get("request_id"),
+                    trace_ctx=capture_context(),
                 )
             async with asyncio.timeout(timeout_s):
                 async for piece in stream_fn(prompt, params, **kwargs):
@@ -1014,8 +1043,85 @@ async def get_stats(request: web.Request) -> web.Response:
     }
     engine_stats = getattr(engine.backend, "get_stats", None)
     if engine_stats is not None:
-        stats["engine"] = engine_stats()
+        try:
+            stats["engine"] = engine_stats()
+        except Exception as exc:
+            # a mid-rebuild or dead engine must not take the whole
+            # stats surface down with a 500 — operators need /stats
+            # MOST while the engine is unhealthy
+            logger.error("engine stats failed", exc_info=True)
+            stats["engine"] = {"error": f"{type(exc).__name__}: {exc}"}
     return web.json_response(stats)
+
+
+def _flight_recorder(request: web.Request):
+    """The live engine's flight recorder, or None for backends without
+    one (dry-run, external adapters).  Supervised engines delegate
+    through EngineSupervisor.__getattr__ to the current core."""
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    core = getattr(engine.backend, "core", None) if engine else None
+    return getattr(core, "flight", None)
+
+
+def _debug_n(request: web.Request, default: int = 128) -> int:
+    try:
+        n = int(request.query.get("n", default))
+    except ValueError:
+        return default
+    return max(1, min(n, 4096))
+
+
+async def debug_flight(request: web.Request) -> web.Response:
+    """GET /debug/flight?n= — the engine flight recorder's most recent
+    ticks (dispatches, readbacks, recompiles, sheds, aborts, crashes).
+    Auth-gated like every non-exempt path; excluded from drain
+    accounting like /stats."""
+    rec = _flight_recorder(request)
+    if rec is None:
+        return web.json_response(
+            {"enabled": False, "ticks": [],
+             "reason": "engine has no flight recorder"}
+        )
+    return web.json_response(
+        {"enabled": rec.enabled, "ticks": rec.ticks(_debug_n(request))}
+    )
+
+
+async def debug_requests(request: web.Request) -> web.Response:
+    """GET /debug/requests?n= — in-flight and recently completed request
+    records with per-phase timings."""
+    rec = _flight_recorder(request)
+    if rec is None:
+        return web.json_response(
+            {"enabled": False, "live": [], "completed": [],
+             "reason": "engine has no flight recorder"}
+        )
+    return web.json_response(
+        {
+            "enabled": rec.enabled,
+            "live": rec.live_requests(),
+            "completed": rec.requests(_debug_n(request)),
+        }
+    )
+
+
+async def debug_request_detail(request: web.Request) -> web.Response:
+    """GET /debug/requests/{ident} — one request record by request id,
+    trace id, or engine seq id (newest attempt wins)."""
+    rec = _flight_recorder(request)
+    if rec is None:
+        return _error(
+            404, "engine has no flight recorder", "invalid_request_error"
+        )
+    record = rec.find_request(request.match_info["ident"])
+    if record is None:
+        return _error(
+            404,
+            f"no request record for {request.match_info['ident']!r} "
+            "(records are bounded rings; it may have aged out)",
+            "invalid_request_error",
+        )
+    return web.json_response(record)
 
 
 async def run_benchmark(request: web.Request) -> web.Response:
@@ -1082,8 +1188,10 @@ async def capture_profile(request: web.Request) -> web.Response:
     engine: Optional[VGTEngine] = request.app.get("engine")
     core = getattr(engine.backend, "core", None) if engine else None
     if core is None or not hasattr(core, "capture_profile"):
+        # a client error (this deployment can never profile), not a
+        # conflict: 409 is reserved for the concurrent-capture case
         return _error(
-            409,
+            400,
             "profiling requires the jax_tpu engine",
             "invalid_request_error",
         )
@@ -1246,6 +1354,9 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/metrics", prometheus_metrics)
     app.router.add_get("/stats", get_stats)
+    app.router.add_get("/debug/flight", debug_flight)
+    app.router.add_get("/debug/requests", debug_requests)
+    app.router.add_get("/debug/requests/{ident}", debug_request_detail)
     app.router.add_post("/v1/benchmark", run_benchmark)
     app.router.add_post("/v1/profile", capture_profile)
     app.on_startup.append(_on_startup)

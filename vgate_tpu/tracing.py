@@ -16,11 +16,20 @@ logger = logging.getLogger(__name__)
 
 _initialized = False
 _provider: Any = None
+# test/dev tracer-provider override (observability.memtrace installs an
+# in-memory recorder here): consulted by every get_tracer() call so it
+# takes effect even for tracers bound at module import time
+_override_provider: Any = None
 
 try:  # The OTel API is a light dependency; tolerate even its absence.
     from opentelemetry import trace as _otel_trace
 except ImportError:  # pragma: no cover
     _otel_trace = None
+
+try:
+    from opentelemetry import context as _otel_context
+except ImportError:  # pragma: no cover
+    _otel_context = None
 
 
 class _NoopSpan:
@@ -102,12 +111,77 @@ def init_tracing(config=None) -> bool:
     return True
 
 
+class _ProxyTracer:
+    """Late-binding tracer: resolves the live tracer at each span call so
+    a provider installed AFTER module import (init_tracing, or the
+    in-memory recorder observability.memtrace puts in
+    ``set_tracer_provider_override``) is honored by tracers that were
+    bound at import time (``tracer = get_tracer(__name__)``)."""
+
+    __slots__ = ("_name",)
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+
+    def _resolve(self):
+        if _override_provider is not None:
+            return _override_provider.get_tracer(self._name)
+        return _otel_trace.get_tracer(self._name)
+
+    def start_as_current_span(self, *a, **k):
+        return self._resolve().start_as_current_span(*a, **k)
+
+    def start_span(self, *a, **k):
+        return self._resolve().start_span(*a, **k)
+
+
 def get_tracer(name: str):
     """Tracer accessor; returns a no-op tracer when OTel is absent
     (reference: vgate/tracing.py:97-108)."""
     if _otel_trace is None:
         return _NoopTracer()
-    return _otel_trace.get_tracer(name)
+    return _ProxyTracer(name)
+
+
+def set_tracer_provider_override(provider) -> None:
+    """Install (or with None, remove) a process-local tracer provider
+    that wins over the OTel global.  Exists so tests and dev tooling can
+    record spans without the OTel SDK (observability/memtrace.py); not a
+    serving configuration surface."""
+    global _override_provider
+    _override_provider = provider
+
+
+def capture_context() -> Optional[Any]:
+    """Snapshot the current OTel context (the active span rides in it)
+    for cross-thread propagation — the batcher captures it per request
+    and the engine thread parents its phase spans on it.  None when the
+    OTel API is absent."""
+    if _otel_context is None:
+        return None
+    return _otel_context.get_current()
+
+
+def context_trace_id(ctx: Any) -> Optional[str]:
+    """Hex trace id of the span carried by a captured context (exemplar
+    attachment off the request thread), or None."""
+    if ctx is None or _otel_trace is None:
+        return None
+    span = _otel_trace.get_current_span(ctx)
+    sc = span.get_span_context()
+    if sc is None or not sc.is_valid:
+        return None
+    return format(sc.trace_id, "032x")
+
+
+def context_span_id(ctx: Any) -> Optional[str]:
+    if ctx is None or _otel_trace is None:
+        return None
+    span = _otel_trace.get_current_span(ctx)
+    sc = span.get_span_context()
+    if sc is None or not sc.is_valid:
+        return None
+    return format(sc.span_id, "016x")
 
 
 def get_current_trace_id() -> Optional[str]:
@@ -147,3 +221,4 @@ def reset_tracing() -> None:
     """Test hook mirroring the reference's autouse reset fixture
     (tests/conftest.py:242-249 in the reference)."""
     shutdown_tracing()
+    set_tracer_provider_override(None)

@@ -55,6 +55,10 @@ class DeadlineExceeded(VGTError):
         err = body.get("error", {}) if isinstance(body, dict) else {}
         self.partial_tokens: int = err.get("partial_tokens", 0) or 0
         self.partial_text: str = err.get("partial_text", "") or ""
+        # where the budget went, from the server's flight recorder:
+        # {"queue_s": ..., "prefill_s": ..., "decode_s": ...} — empty
+        # against servers that predate the field
+        self.phases: dict = err.get("phases") or {}
 
 
 class ServerError(VGTError):
