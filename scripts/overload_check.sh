@@ -1,0 +1,182 @@
+#!/usr/bin/env bash
+# Overload-protection gate (sibling of drain_check.sh / chaos_check.sh):
+# start the server on the dry-run backend with tight admission budgets
+# and a slowed backend, flood it ~10x over capacity with mixed priority
+# tiers, and assert
+#   1. the queued-token backlog never exceeds admission.max_queued_tokens,
+#   2. rejected requests get 503 + Retry-After (reason "overloaded") and
+#      the per-key cap gets 429 + Retry-After,
+#   3. ZERO 500s and zero dropped responses — every request is answered,
+#   4. strict-priority shedding: batch sheds most, interactive least,
+#      and interactive p99 latency stays under a threshold,
+#   5. the server stays SERVING/ready throughout and after the flood.
+#
+# Usage: scripts/overload_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${1:-8733}"
+export JAX_PLATFORMS=cpu
+export VGT_DRY_RUN=1
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_BATCH__MAX_WAIT_TIME_MS=10
+# 8, not smaller: the weighted dequeue reserves one slot per lower
+# non-empty tier each cycle, so tiny batches flatten the 8/4/1 weights
+# toward round-robin and interactive loses the dominance this drill
+# asserts (the rotation itself is unit-tested in test_admission.py)
+export VGT_BATCH__MAX_BATCH_SIZE=8
+# each generate call sleeps 100ms via the backend_generate fault probe:
+# ~4 req / 100ms of capacity against a 60-request instant flood
+export VGT_FAULTS="backend_generate:delay:delay=0.1:times=-1"
+# tight budgets so the flood provably sheds: ~13 est. tokens/request
+export VGT_ADMISSION__MAX_QUEUED_TOKENS=400
+export VGT_ADMISSION__MAX_QUEUED_REQUESTS=0
+export VGT_ADMISSION__PER_KEY_MAX_INFLIGHT=2
+
+python main.py &
+SERVER_PID=$!
+trap 'kill -9 $SERVER_PID 2>/dev/null || true' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+for _ in $(seq 1 100); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: server never became ready"; exit 1; }
+
+python - "$BASE" <<'EOF'
+import asyncio, sys, time
+import aiohttp
+
+BASE = sys.argv[1]
+PER_TIER = 20
+MAX_QUEUED_TOKENS = 400
+INTERACTIVE_P99_S = 3.0
+
+
+async def fire(session, tier, i, out):
+    body = {
+        "messages": [{"role": "user", "content": f"flood {tier} {i}"}],
+        "max_tokens": 8,
+        "temperature": 0.0,
+        "priority": tier,
+    }
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+            f"{BASE}/v1/chat/completions", json=body
+        ) as resp:
+            payload = await resp.json()
+            out.append((tier, resp.status, time.perf_counter() - t0,
+                        dict(resp.headers), payload))
+    except aiohttp.ClientError as exc:
+        out.append((tier, f"dropped({exc})", 0.0, {}, None))
+
+
+async def watch_backlog(session, peak, stop):
+    while not stop.is_set():
+        try:
+            async with session.get(f"{BASE}/stats") as resp:
+                stats = await resp.json()
+                peak[0] = max(peak[0], stats["admission"]["queued_tokens"])
+                peak[1] = max(peak[1],
+                              stats["admission"]["pressure"]["level"])
+        except aiohttp.ClientError:
+            pass
+        # the server must stay ready (SERVING/DEGRADED, never DEAD)
+        async with session.get(f"{BASE}/health/live") as resp:
+            assert resp.status == 200, "liveness lost mid-flood"
+        await asyncio.sleep(0.05)
+
+
+async def main():
+    async with aiohttp.ClientSession() as session:
+        out, peak, stop = [], [0, 0], asyncio.Event()
+        watcher = asyncio.ensure_future(watch_backlog(session, peak, stop))
+        await asyncio.gather(*[
+            fire(session, tier, i, out)
+            for tier in ("interactive", "standard", "batch")
+            for i in range(PER_TIER)
+        ])
+        stop.set()
+        await watcher
+
+        dropped = [r for r in out if not isinstance(r[1], int)]
+        assert not dropped, f"dropped responses: {dropped[:3]}"
+        statuses = {}
+        for tier, status, dur, headers, payload in out:
+            statuses.setdefault(tier, []).append(status)
+            assert status in (200, 503), (
+                f"unexpected status {status} ({tier}): {payload}"
+            )
+            if status == 503:
+                assert "Retry-After" in headers, "503 without Retry-After"
+                assert payload["error"]["reason"] == "overloaded", payload
+
+        shed = {t: sum(1 for s in ss if s == 503)
+                for t, ss in statuses.items()}
+        assert shed["batch"] >= shed["standard"] >= shed["interactive"], (
+            f"shed order violated: {shed}"
+        )
+        assert shed["batch"] > 0, "flood never triggered shedding"
+        assert peak[0] <= MAX_QUEUED_TOKENS, (
+            f"backlog {peak[0]} exceeded admission.max_queued_tokens"
+        )
+
+        inter = sorted(
+            dur for tier, s, dur, _, _ in out
+            if tier == "interactive" and s == 200
+        )
+        assert inter, "every interactive request was shed"
+        p99 = inter[max(0, int(len(inter) * 0.99) - 1)]
+        assert p99 < INTERACTIVE_P99_S, (
+            f"interactive p99 {p99:.2f}s over {INTERACTIVE_P99_S}s"
+        )
+
+        # per-key in-flight cap: 3 concurrent on one key, cap is 2
+        key = {"Authorization": "Bearer flood-key"}
+
+        async def keyed(i):
+            body = {
+                "messages": [{"role": "user",
+                              "content": f"keyed {i}"}],
+                "max_tokens": 8,
+            }
+            async with session.post(
+                f"{BASE}/v1/chat/completions", json=body, headers=key
+            ) as resp:
+                return resp.status, dict(resp.headers)
+
+        keyed_out = await asyncio.gather(*[keyed(i) for i in range(3)])
+        k_statuses = sorted(s for s, _ in keyed_out)
+        assert 429 in k_statuses, f"per-key cap never fired: {k_statuses}"
+        for s, headers in keyed_out:
+            if s == 429:
+                assert "Retry-After" in headers, "429 without Retry-After"
+
+        async with session.get(f"{BASE}/health/ready") as resp:
+            assert resp.status == 200, "server not ready after the flood"
+
+        ok = {t: sum(1 for s in ss if s == 200)
+              for t, ss in statuses.items()}
+        print(
+            f"PASS: completed={ok} shed={shed} "
+            f"peak_backlog={peak[0]} peak_pressure_level={peak[1]} "
+            f"interactive_p99={p99*1000:.0f}ms"
+        )
+
+
+asyncio.run(main())
+EOF
+
+kill -TERM "$SERVER_PID" 2>/dev/null || true
+for _ in $(seq 1 100); do
+  if ! kill -0 "$SERVER_PID" 2>/dev/null; then break; fi
+  sleep 0.3
+done
+kill -9 "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+trap - EXIT
+echo "PASS: overload_check complete (bounded backlog, tiered shed, zero 500s)"

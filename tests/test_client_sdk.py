@@ -350,3 +350,126 @@ def test_completions_resource_roundtrip():
         "complete this", echo=True, max_tokens=3
     )
     assert result["choices"][0]["text"].startswith("complete this")
+
+
+# --- overload protection (ISSUE 4): typed 503s, priority, jittered backoff ---
+
+
+def test_503_overloaded_is_typed_with_retry_after():
+    from vgate_tpu_client import ServerOverloadedError
+
+    def handler(request):
+        return httpx.Response(
+            503,
+            headers={"Retry-After": "7"},
+            json={
+                "error": {
+                    "message": "server overloaded (backlog_tokens)",
+                    "type": "overloaded_error",
+                    "reason": "overloaded",
+                }
+            },
+        )
+
+    client = make_client(handler, max_retries=0)
+    with pytest.raises(ServerOverloadedError) as err:
+        client.chat.create([{"role": "user", "content": "x"}])
+    assert err.value.status_code == 503
+    assert err.value.retry_after == 7.0
+
+
+def test_503_draining_stays_plain_server_error():
+    from vgate_tpu_client import ServerOverloadedError
+
+    def handler(request):
+        return httpx.Response(
+            503,
+            headers={"Retry-After": "2"},
+            json={
+                "error": {
+                    "message": "server is draining for shutdown",
+                    "type": "overloaded_error",
+                    "reason": "draining",
+                }
+            },
+        )
+
+    client = make_client(handler, max_retries=0)
+    with pytest.raises(ServerError) as err:
+        client.chat.create([{"role": "user", "content": "x"}])
+    assert not isinstance(err.value, ServerOverloadedError)
+
+
+def test_priority_kwarg_rides_the_payload():
+    seen = {}
+
+    def handler(request):
+        seen[request.url.path] = json.loads(request.content)
+        if request.url.path == "/v1/embeddings":
+            return httpx.Response(
+                200,
+                json={"object": "list", "data": [], "model": "m",
+                      "usage": {"prompt_tokens": 0,
+                                "completion_tokens": 0,
+                                "total_tokens": 0}},
+            )
+        if request.url.path == "/v1/completions":
+            return httpx.Response(
+                200, json={"choices": [], "usage": {}}
+            )
+        return httpx.Response(200, json=CHAT_RESPONSE)
+
+    client = make_client(handler)
+    client.chat.create(
+        [{"role": "user", "content": "x"}], priority="interactive"
+    )
+    client.completions.create("x", priority="batch")
+    client.embeddings.create("x", priority="standard")
+    assert seen["/v1/chat/completions"]["priority"] == "interactive"
+    assert seen["/v1/completions"]["priority"] == "batch"
+    assert seen["/v1/embeddings"]["priority"] == "standard"
+    # omitted priority never reaches the wire (exclude_none)
+    client.chat.create([{"role": "user", "content": "x"}])
+    assert "priority" not in seen["/v1/chat/completions"]
+
+
+def test_backoff_is_jittered_and_honors_retry_after():
+    from vgate_tpu_client.client import _retry_delay
+
+    # no server hint: equal jitter inside (base/2, base]
+    delays = {_retry_delay(1) for _ in range(64)}
+    assert all(1.0 <= d <= 2.0 for d in delays)
+    assert len(delays) > 1, "backoff must not be deterministic"
+    # retried clients must not synchronize into storms
+    assert len({_retry_delay(2) for _ in range(64)}) > 1
+    # Retry-After is the MINIMUM, jitter only stretches it
+    delays = [_retry_delay(0, retry_after=4.0) for _ in range(64)]
+    assert all(d >= 4.0 for d in delays)
+    assert max(delays) > 4.0
+
+
+def test_retry_sleep_uses_jitter(monkeypatch):
+    import vgate_tpu_client.client as client_mod
+
+    sleeps = []
+    monkeypatch.setattr(
+        client_mod.time, "sleep", lambda s: sleeps.append(s)
+    )
+    calls = {"n": 0}
+
+    def handler(request):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return httpx.Response(
+                503,
+                json={"error": {"message": "recovering",
+                                "type": "overloaded_error",
+                                "reason": "recovering"}},
+            )
+        return httpx.Response(200, json=CHAT_RESPONSE)
+
+    client = make_client(handler, max_retries=1)
+    result = client.chat.create([{"role": "user", "content": "x"}])
+    assert result.id == "chatcmpl-test"
+    # no Retry-After header -> equal-jitter from the attempt number
+    assert len(sleeps) == 1 and 0.5 <= sleeps[0] <= 1.0

@@ -47,6 +47,39 @@ class TestRateLimiterWindow:
         assert headers["X-RateLimit-Limit"] == "5"
         assert headers["X-RateLimit-Remaining"] == "4"
 
+    def test_stale_keys_are_swept(self):
+        """Regression: every distinct key used to leak a dict entry
+        forever — the sweep must drop keys whose whole window expired,
+        while keys with live timestamps survive."""
+        rl = RateLimiter(requests_per_minute=10, window_s=60.0)
+        for i in range(500):
+            rl.check(f"rotating-{i}", now=100.0)
+        rl.check("steady", now=100.0)
+        assert len(rl._windows) == 501
+        # a check one window later triggers the sweep; only keys with
+        # in-window activity remain
+        rl.check("steady", now=161.0)
+        assert set(rl._windows) == {"steady"}
+        assert len(rl._windows["steady"]) == 1  # old stamp evicted too
+
+    def test_windows_are_deques(self):
+        """The per-key window must not be an O(n)-pop list."""
+        from collections import deque
+
+        rl = RateLimiter(requests_per_minute=3)
+        rl.check("k", now=1.0)
+        assert isinstance(rl._windows["k"], deque)
+
+    def test_sweep_preserves_over_limit_state(self):
+        rl = RateLimiter(requests_per_minute=2, window_s=60.0)
+        rl.check("k", now=100.0)
+        rl.check("k", now=140.0)
+        # sweep fires (>= window since _last_sweep=0) but the key's
+        # recent stamps survive and still count against the limit
+        allowed, _ = rl.check("k", now=150.0)
+        assert not allowed
+        assert rl.get_stats()["k"] == 2
+
 
 def _secured_app(config):
     async def ok(request):

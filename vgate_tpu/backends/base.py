@@ -55,6 +55,11 @@ class SamplingParams:
     # applies.  NOT part of the result-cache identity: a completed
     # result is the same whatever budget produced it.
     timeout_s: Optional[float] = None
+    # Priority-tier rank (vgate_tpu/admission.py: 0 = interactive,
+    # 1 = standard, 2 = batch).  The engine scheduler admits
+    # lower-rank sequences first and preempts higher-rank ones first
+    # under KV pressure.  Like timeout_s, NOT part of the cache key.
+    priority: int = 1
 
     @property
     def has_penalties(self) -> bool:

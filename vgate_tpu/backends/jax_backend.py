@@ -178,6 +178,33 @@ class JaxTPUBackend:
         if fn is not None:
             fn(reason)
 
+    def set_spec_suspended(self, flag: bool) -> None:
+        """Brownout L3 (vgate_tpu/admission.py): suspend/resume
+        speculative decoding on the live core (supervised cores
+        delegate; dp routers fan out to every replica)."""
+        fn = getattr(self.core, "set_spec_suspended", None) if (
+            self.core is not None
+        ) else None
+        if fn is not None:
+            try:
+                fn(bool(flag))
+            except Exception:  # pragma: no cover - mid-rebuild race
+                logger.warning("set_spec_suspended failed", exc_info=True)
+
+    def pressure_signals(self) -> Dict[str, Any]:
+        """KV/queue gauges for gateway admission + brownout; empty while
+        the core is loading or mid-rebuild (the controllers then fall
+        back to gateway-side signals alone)."""
+        fn = getattr(self.core, "pressure_signals", None) if (
+            self.core is not None
+        ) else None
+        if fn is None:
+            return {}
+        try:
+            return fn() or {}
+        except Exception:  # pragma: no cover - mid-rebuild race
+            return {}
+
     # -- async extensions used by the gateway --
 
     async def generate_settled_async(

@@ -35,6 +35,13 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from vgate_tpu import metrics
+from vgate_tpu.admission import (
+    AdmissionController,
+    PressureController,
+    TierQueue,
+    estimate_prompt_tokens,
+    tier_rank,
+)
 from vgate_tpu.backends.base import GenerationResult, SamplingParams
 from vgate_tpu.cache import ResultCache
 from vgate_tpu.config import VGTConfig, get_config
@@ -95,6 +102,10 @@ class BatchRequest:
     # context captured while the HTTP span was active, so engine phase
     # spans parent on the request's trace across the thread boundary
     meta: Optional[RequestMeta] = None
+    # priority tier rank (admission.py: 0 interactive, 1 standard,
+    # 2 batch) — selects the TierQueue lane and rides params.priority
+    # into the engine scheduler
+    tier_rank: int = 1
 
 
 class RequestBatcher:
@@ -110,8 +121,23 @@ class RequestBatcher:
             max_size=self.config.cache.max_size,
             enabled=self.config.cache.enabled,
         )
-        self._queue: List[BatchRequest] = []
+        self._queue: TierQueue = TierQueue(
+            weights=self.config.admission.tier_weights
+        )
         self._queue_lock = asyncio.Lock()
+        # overload protection (vgate_tpu/admission.py): token-budget
+        # admission + the adaptive brownout controller.  The signals
+        # provider reads cheap engine-side gauges (KV free ratio,
+        # engine queue depth) through the backend when it has them.
+        self.admission = AdmissionController(
+            self.config.admission, signals=self._pressure_signals
+        )
+        self.pressure = PressureController(
+            self.config.admission,
+            self.admission,
+            signals=self._pressure_signals,
+            on_transition=self._on_pressure_transition,
+        )
         self._loop_task: Optional[asyncio.Task] = None
         self._running = False
         # set by stop(): submissions racing shutdown must fail fast, not
@@ -174,8 +200,7 @@ class RequestBatcher:
                 pass
             self._loop_task = None
         async with self._queue_lock:
-            leftovers = self._queue[:]
-            self._queue.clear()
+            leftovers = self._queue.drain()
             metrics.PENDING_REQUESTS.set(0)
         for req in leftovers:
             if not req.future.done():
@@ -184,6 +209,50 @@ class RequestBatcher:
                         "server shut down before the request could run"
                     )
                 )
+
+    # -- overload protection (vgate_tpu/admission.py) --
+
+    def _pressure_signals(self) -> Dict[str, Any]:
+        """Cheap engine-side gauges for admission + brownout: KV
+        free-page ratio and engine queue depth.  Backends without the
+        surface (dry-run, external adapters) contribute nothing — the
+        controllers then run on gateway-side signals alone."""
+        fn = getattr(self.engine.backend, "pressure_signals", None)
+        if fn is None:
+            return {}
+        try:
+            return fn() or {}
+        except Exception:  # pragma: no cover - mid-restart races
+            return {}
+
+    def _on_pressure_transition(
+        self, level: int, prev: int, score: float
+    ) -> None:
+        """Brownout level changed: apply the engine-side step
+        (speculative decoding on/off at the L3 boundary) and leave an
+        ``overload`` tick in the flight recorder so post-mortems show
+        when degradation engaged relative to the dispatch stream."""
+        set_spec = getattr(
+            self.engine.backend, "set_spec_suspended", None
+        )
+        if set_spec is not None:
+            try:
+                set_spec(level >= 3)
+            except Exception:  # pragma: no cover - mid-restart races
+                logger.error("set_spec_suspended failed", exc_info=True)
+        # resolve the recorder at call time: supervised engines swap
+        # cores (and recorders) across restarts
+        core = getattr(self.engine.backend, "core", None)
+        flight = getattr(core, "flight", None)
+        if flight is not None:
+            flight.record_tick(
+                "overload",
+                level=level,
+                prev=prev,
+                score=score,
+                steps=self.pressure.active_steps(),
+                queue_depth=len(self._queue),
+            )
 
     # -- graceful drain (vgate_tpu/lifecycle.py DrainController) --
 
@@ -203,7 +272,7 @@ class RequestBatcher:
             "server shut down before the request could run",
             retry_after=self._drain_retry_after,
         )
-        leftovers, self._queue[:] = self._queue[:], []
+        leftovers = self._queue.drain()
         metrics.PENDING_REQUESTS.set(0)
         failed = 0
         for req in leftovers:
@@ -234,14 +303,24 @@ class RequestBatcher:
         presence_penalty: float = 0.0,
         logit_bias: Optional[Dict[int, float]] = None,
         cancel_token: Optional[CancelToken] = None,
+        priority: Optional[str] = None,
+        api_key: Optional[str] = None,
     ) -> Dict[str, Any]:
         if self._draining:
             raise ServerDrainingError(
                 retry_after=self._drain_retry_after
             )
+        self.pressure.maybe_update()
+        tier = self.admission.resolve_tier(priority, api_key)
         inf = self.config.inference
+        # brownout level >= 1 clamps every request's completion budget:
+        # the clamp happens BEFORE the cache key is built, so clamped
+        # and unclamped results never collide in the cache
+        effective_max_tokens = self.pressure.clamp_max_tokens(
+            max_tokens if max_tokens is not None else inf.max_tokens
+        )
         params = SamplingParams(
-            max_tokens=max_tokens if max_tokens is not None else inf.max_tokens,
+            max_tokens=effective_max_tokens,
             min_tokens=min_tokens,
             temperature=(
                 temperature if temperature is not None else inf.temperature
@@ -260,6 +339,9 @@ class RequestBatcher:
             # ticks (504 + partial-tokens metadata); excluded from the
             # cache key below — completed results don't depend on it
             timeout_s=timeout_s,
+            # rides into the engine scheduler: admit interactive
+            # first, preempt batch first (also not cache identity)
+            priority=tier_rank(tier),
         )
         request_id = request_id or uuid.uuid4().hex[:12]
         # capture the request's trace context BEFORE opening the
@@ -318,6 +400,13 @@ class RequestBatcher:
                     ),
                 )
 
+            # admission control: refuse work the server cannot finish
+            # (503/429 + Retry-After) instead of queuing it into a
+            # deadline 504.  After the cache lookup (a cache-servable
+            # request costs nothing) and the health fail-fast (a
+            # recovering engine's 503 is the more truthful answer).
+            cost = estimate_prompt_tokens(prompt) + params.max_tokens
+            self.admission.admit(cost, tier=tier, deadline_s=timeout_s)
             request = BatchRequest(
                 request_id=request_id,
                 prompt=prompt,
@@ -333,11 +422,20 @@ class RequestBatcher:
                 meta=RequestMeta(
                     request_id=request_id, trace_ctx=trace_ctx
                 ),
+                tier_rank=tier_rank(tier),
+            )
+            # the backlog releases exactly once, whatever the outcome —
+            # done callbacks fire on set_result, set_exception AND
+            # cancel, covering every settle path below
+            request.future.add_done_callback(
+                lambda _f, c=cost: self.admission.release(c)
             )
             async with self._queue_lock:
                 if self._stopped:
                     # shutdown raced past the cache lookup: nothing will
-                    # ever drain the queue again
+                    # ever drain the queue again.  Cancel the future so
+                    # its done callback returns the admitted backlog.
+                    request.future.cancel()
                     raise EngineRecoveringError(
                         "server is shutting down; retry another replica"
                     )
@@ -460,16 +558,27 @@ class RequestBatcher:
     # -- batch firing (reference: vgate/batcher.py:184-324) --
 
     async def _batch_loop(self) -> None:
-        wait_s = self.config.batch.max_wait_time_ms / 1000.0
         while self._running:
+            # re-read per iteration: brownout level >= 2 shrinks the
+            # batch window so queued work reaches the engine sooner
+            # under pressure, and restores it on recovery
+            wait_s = (
+                self.pressure.effective_wait_ms(
+                    self.config.batch.max_wait_time_ms
+                )
+                / 1000.0
+            )
             await asyncio.sleep(wait_s)
+            self.pressure.maybe_update()
             if self._queue:
                 await self._process_batch()
 
     async def _process_batch(self) -> None:
         async with self._queue_lock:
-            batch = self._queue[: self.config.batch.max_batch_size]
-            del self._queue[: len(batch)]
+            # weighted dequeue across the priority tiers (admission.py
+            # TierQueue): interactive dominates each fill cycle, batch
+            # keeps a trickle so it cannot starve outright
+            batch = self._queue.take(self.config.batch.max_batch_size)
             metrics.PENDING_REQUESTS.set(len(self._queue))
         if not batch:
             return
@@ -538,6 +647,12 @@ class RequestBatcher:
                             req.future.set_exception(result)
                     continue
                 payload = self._normalize(lead, result)
+                # decode-throughput EWMA feed for admission's queue-wait
+                # estimate — once per unique generation (leads only, so
+                # dedup followers don't double-count shared compute)
+                self.admission.observe_completion(
+                    payload.get("num_tokens", 0)
+                )
                 if self._obs_enabled and not self._settled_takes_meta:
                     # black-box backend (dry-run / external adapters):
                     # approximate the engine phase spans from reported
@@ -550,10 +665,15 @@ class RequestBatcher:
                         payload.get("metrics", {}),
                         time.perf_counter(),
                     )
-                if payload.get("finish_reason") not in UNCACHEABLE_FINISH:
+                if (
+                    payload.get("finish_reason") not in UNCACHEABLE_FINISH
+                    and not self.pressure.cache_write_bypass
+                ):
                     # cancelled/deadline-shed results are PARTIAL: caching
                     # one would replay a truncated generation to every
-                    # later identical request
+                    # later identical request.  Brownout level >= 4 skips
+                    # the write path entirely (reads stay on — they only
+                    # help under overload).
                     await self.cache.put(lead.cache_key, payload)
                 for req in groups[lead.cache_key]:
                     if not req.future.done():
@@ -699,6 +819,7 @@ class RequestBatcher:
             "total_deduplicated": self._total_deduped,
             "total_cache_hits": self._total_cache_hits,
             "pending_requests": len(self._queue),
+            "pending_by_tier": self._queue.depths(),
             "avg_batch_size": (
                 (self._total_requests - self._total_cache_hits)
                 / self._total_batches

@@ -330,6 +330,116 @@ class LifecycleConfig(BaseModel):
     drain_retry_after_s: float = 2.0
 
 
+# the canonical tier vocabulary lives with the admission policy
+# (admission.py has no config import, so this cannot cycle)
+from vgate_tpu.admission import TIERS as VALID_TIERS  # noqa: E402
+
+
+class AdmissionConfig(BaseModel):
+    """Overload protection (vgate_tpu/admission.py): token-budget
+    admission control, priority tiers and the adaptive brownout
+    controller.  The gateway estimates each request's cost (prompt
+    tokens + max_tokens) at submit time and **refuses work it cannot
+    finish** — 503 + Retry-After when the backlog/KV limits are hit,
+    429 for the per-key in-flight cap — instead of queuing into a
+    deadline 504.  docs/operations.md has the runbook."""
+
+    enabled: bool = True
+    # Reject when the estimated token backlog (admitted but unsettled
+    # prompt+completion tokens) would exceed this.  0 = unlimited.
+    max_queued_tokens: int = 200_000
+    # Reject when this many requests are admitted but unsettled.
+    # 0 = unlimited.
+    max_queued_requests: int = 256
+    # Reject a deadline-carrying request whose predicted queue wait
+    # (backlog / decode-throughput EWMA) already exceeds its deadline —
+    # cheaper to refuse at the door than to shed mid-queue as a 504.
+    reject_would_miss_slo: bool = True
+    # KV free-page ratio floor: below it new work is rejected
+    # (tier-scaled — batch tier rejects at a higher free ratio than
+    # interactive).  0 disables the check.
+    kv_free_watermark: float = 0.05
+    # Per-API-key in-flight cap -> 429 + Retry-After.  0 = unlimited;
+    # applies only to authenticated (Bearer-keyed) requests.
+    per_key_max_inflight: int = 0
+    # api key -> tier; a mapped key's tier also CAPS the request's own
+    # `priority` field (a batch-mapped key cannot claim interactive).
+    key_tiers: Dict[str, str] = Field(default_factory=dict)
+    default_tier: str = "standard"
+    # Weighted dequeue at the gateway batcher: per batch-fill cycle,
+    # take up to this many requests from each tier, highest first.
+    tier_weights: Dict[str, int] = Field(
+        default_factory=lambda: {
+            "interactive": 8, "standard": 4, "batch": 1,
+        }
+    )
+    # Strict-priority shedding: each tier sees the backlog limits scaled
+    # by its fraction (and the KV watermark divided by it), so batch
+    # rejects first and interactive last as pressure rises.
+    tier_fractions: Dict[str, float] = Field(
+        default_factory=lambda: {
+            "interactive": 1.0, "standard": 0.85, "batch": 0.6,
+        }
+    )
+    # Decode-throughput EWMA feeding the queue-wait estimate.
+    throughput_alpha: float = 0.3
+    throughput_init_tps: float = 400.0
+
+    # -- adaptive brownout (PressureController) --
+    brownout_enabled: bool = True
+    # Predicted queue wait that counts as pressure 1.0.
+    target_wait_s: float = 5.0
+    brownout_update_interval_s: float = 0.5
+    # Hysteresis: a level releases (one step at a time) only after the
+    # score has stayed below engage*release_ratio for this long.
+    brownout_hold_s: float = 10.0
+    brownout_release_ratio: float = 0.8
+    # Pressure-score thresholds engaging levels 1..4.  The degradation
+    # steps, in engage order: clamp max_tokens -> shrink the batch
+    # window -> disable speculative decoding -> bypass result-cache
+    # writes.
+    brownout_engage: List[float] = Field(
+        default_factory=lambda: [0.5, 0.7, 0.85, 0.95]
+    )
+    # Level >= 1: clamp every request's max_tokens to this.
+    brownout_max_tokens: int = 128
+    # Level >= 2: shrink batch.max_wait_time_ms to this.
+    brownout_wait_ms: float = 10.0
+
+    @field_validator("default_tier")
+    @classmethod
+    def _check_default_tier(cls, v: str) -> str:
+        if v not in VALID_TIERS:
+            raise ValueError(
+                f"admission.default_tier must be one of {VALID_TIERS}, "
+                f"got {v!r}"
+            )
+        return v
+
+    @field_validator("key_tiers")
+    @classmethod
+    def _check_key_tiers(cls, v: Dict[str, str]) -> Dict[str, str]:
+        for key, tier in v.items():
+            if tier not in VALID_TIERS:
+                raise ValueError(
+                    f"admission.key_tiers[{key!r}] must be one of "
+                    f"{VALID_TIERS}, got {tier!r}"
+                )
+        return v
+
+    @field_validator("brownout_engage")
+    @classmethod
+    def _check_engage(cls, v: List[float]) -> List[float]:
+        if len(v) != 4 or any(
+            b <= a for a, b in zip(v, v[1:])
+        ):
+            raise ValueError(
+                "admission.brownout_engage must be 4 strictly "
+                f"ascending thresholds, got {v!r}"
+            )
+        return v
+
+
 class InferenceConfig(BaseModel):
     """Default sampling parameters (reference: vgate/config.py:74-80)."""
 
@@ -434,6 +544,7 @@ class VGTConfig(BaseModel):
     scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     lifecycle: LifecycleConfig = Field(default_factory=LifecycleConfig)
+    admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
     inference: InferenceConfig = Field(default_factory=InferenceConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)
     metrics: MetricsConfig = Field(default_factory=MetricsConfig)

@@ -61,7 +61,14 @@ def raise_for_state(
 
 class RetryableError(RuntimeError):
     """A transient serving failure the client should retry after
-    ``retry_after`` seconds (surfaced as 503 + ``Retry-After``)."""
+    ``retry_after`` seconds (surfaced as 503 + ``Retry-After``).
+
+    ``reason`` travels in the error body so clients can tell apart the
+    503 flavors (overloaded vs draining vs recovering vs dead) without
+    parsing messages — the SDK maps "overloaded" to its typed
+    ``ServerOverloadedError``."""
+
+    reason = "unavailable"
 
     def __init__(self, message: str, retry_after: float = 1.0) -> None:
         super().__init__(message)
@@ -73,12 +80,16 @@ class EngineRecoveringError(RetryableError):
     request was failed fast (or shed at admission) instead of queuing
     into a dead engine."""
 
+    reason = "recovering"
+
 
 class EngineDeadError(RetryableError):
     """The engine exhausted its restart budget (or hit an unrecoverable
     fault) and will not come back in this process.  Still retryable from
     the client's point of view — another replica behind the LB can serve
     it while the liveness probe recycles this pod."""
+
+    reason = "dead"
 
     def __init__(self, message: str, retry_after: float = 30.0) -> None:
         super().__init__(message, retry_after=retry_after)
@@ -89,12 +100,52 @@ class ServerDrainingError(RetryableError):
     admissions are rejected with 503 + ``Retry-After`` so the client (or
     the LB) resends against a replica that is staying up."""
 
+    reason = "draining"
+
     def __init__(self, message: str = None, retry_after: float = 2.0) -> None:
         super().__init__(
             message
             or "server is draining for shutdown; retry another replica",
             retry_after=retry_after,
         )
+
+
+class ServerOverloadedError(RetryableError):
+    """Admission control refused the request at the door (503 +
+    ``Retry-After``): the queued-token backlog is over budget, the
+    predicted queue wait would blow the request's own deadline, or the
+    KV pool is below its free-page watermark (vgate_tpu/admission.py).
+    Rejecting here is deliberate load shedding — the work was *never
+    accepted*, so retrying after the suggested backoff (ideally against
+    another replica) is safe and expected.  ``shed_reason`` says which
+    limit fired (backlog_tokens | backlog_requests | would_miss_slo |
+    kv_pressure); ``tier`` is the priority tier the request was judged
+    at (batch sheds first, interactive last)."""
+
+    reason = "overloaded"
+
+    def __init__(
+        self,
+        message: str,
+        retry_after: float = 1.0,
+        shed_reason: str = "backlog_tokens",
+        tier: str = "standard",
+    ) -> None:
+        super().__init__(message, retry_after=retry_after)
+        self.shed_reason = shed_reason
+        self.tier = tier
+
+
+class ClientQuotaExceededError(RuntimeError):
+    """This API key already has ``admission.per_key_max_inflight``
+    requests in flight — a per-client fairness cap, not server-wide
+    overload, so it maps to a **429** + ``Retry-After`` (the rate-limit
+    status the SDK's backoff already understands) rather than the 503
+    the admission controller uses for whole-server shedding."""
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = max(1.0, float(retry_after))
 
 
 class DeadlineExceededError(RuntimeError):

@@ -225,6 +225,53 @@ DRAIN_DURATION = _safe_metric(
     buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
 )
 
+# --- overload protection: admission control + brownout (vgate_tpu/admission.py) ---
+ADMISSION_REJECTIONS = _safe_metric(
+    Counter,
+    "vgt_admission_rejections",
+    "Requests refused at admission, by limit hit and priority tier",
+    # reason: backlog_tokens | backlog_requests | would_miss_slo |
+    #         kv_pressure | per_key_inflight
+    labelnames=("reason", "tier"),
+)
+ADMISSION_QUEUED_TOKENS = _safe_metric(
+    Gauge,
+    "vgt_admission_queued_tokens",
+    "Estimated prompt+completion tokens admitted but not yet settled",
+)
+ADMISSION_QUEUED_REQUESTS = _safe_metric(
+    Gauge,
+    "vgt_admission_queued_requests",
+    "Requests admitted but not yet settled",
+)
+ADMISSION_PREDICTED_WAIT = _safe_metric(
+    Gauge,
+    "vgt_admission_predicted_wait_seconds",
+    "Estimated queue wait for newly admitted work "
+    "(token backlog / decode-throughput EWMA)",
+)
+ADMISSION_THROUGHPUT = _safe_metric(
+    Gauge,
+    "vgt_admission_decode_throughput",
+    "Decode-throughput EWMA (tokens/s) feeding the wait estimate",
+)
+PRESSURE_LEVEL = _safe_metric(
+    Gauge,
+    "vgt_pressure_level",
+    "Adaptive brownout level (0 = normal .. 4 = maximum degradation)",
+)
+PRESSURE_SCORE = _safe_metric(
+    Gauge,
+    "vgt_pressure_score",
+    "Composite overload pressure score driving the brownout controller",
+)
+PRESSURE_TRANSITIONS = _safe_metric(
+    Counter,
+    "vgt_pressure_transitions",
+    "Brownout level transitions by direction",
+    labelnames=("direction",),  # up | down
+)
+
 INFO = _safe_metric(Info, "vgt_build", "Framework build information")
 
 

@@ -1,0 +1,68 @@
+"""JAX version-compatibility shims shared by the parallel modules
+(sibling of ops/pallas/_compat.py, which does the same for Pallas).
+
+Two API moves straddle the toolchains this repo runs on:
+
+* ``shard_map`` graduated from ``jax.experimental.shard_map`` to
+  ``jax.shard_map`` (and the experimental module is slated for
+  removal);
+* ``jax.lax.axis_size`` is the blessed way to read a mapped axis's
+  static size, but older toolchains predate it — there,
+  ``jax.core.axis_frame(name)`` returns the size directly.
+
+Resolving both here keeps ring attention / pipeline parallelism (and
+their tests) running on either toolchain without per-file shims
+drifting apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_new_shard_map = getattr(jax, "shard_map", None)
+if _new_shard_map is not None:
+    shard_map = _new_shard_map
+else:  # pre-graduation toolchains
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(
+        f,
+        *,
+        mesh,
+        in_specs,
+        out_specs,
+        axis_names: Optional[frozenset] = None,
+        check_vma: Optional[bool] = None,
+        check_rep: Optional[bool] = None,
+        **kwargs,
+    ):
+        """Adapter to the experimental signature: ``check_vma`` was
+        ``check_rep`` there, and ``axis_names`` (the MANUAL axes) was
+        expressed inversely as ``auto`` (the axes left automatic)."""
+        if check_rep is None:
+            check_rep = check_vma if check_vma is not None else True
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kwargs["auto"] = auto
+        return _old_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_rep,
+            **kwargs,
+        )
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a mapped axis, usable in Python control flow
+    (loop bounds, permutation tables) inside a shard_map body."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    # older jax returns the size itself; some versions a frame object
+    return frame if isinstance(frame, int) else frame.size

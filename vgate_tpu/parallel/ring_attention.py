@@ -70,7 +70,9 @@ def ring_attention_shard(
     masks, so local-attention layers ride the same ring — blocks wholly
     outside a query's window contribute only masked (-1e30) scores, which
     the online softmax absorbs."""
-    sp = jax.lax.axis_size(axis_name)
+    from vgate_tpu.parallel._compat import axis_size
+
+    sp = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S_local, H, hd = q.shape
     if scale is None:
@@ -142,7 +144,7 @@ def ring_prefill_attention(
         0 if window is None else window, jnp.int32
     )
 
-    from jax.experimental.shard_map import shard_map
+    from vgate_tpu.parallel._compat import shard_map
 
     seq_sharded = P(None, AXIS_SP, None, None)
     fn = shard_map(

@@ -172,7 +172,7 @@ def sp_decode_attention_and_write(
         out = acc_g / jnp.maximum(l_g, 1e-30)[..., None]
         return out.astype(q.dtype), kp, vp
 
-    from jax.experimental.shard_map import shard_map
+    from vgate_tpu.parallel._compat import shard_map
 
     tp_ax = _tp_axis(mesh, H, k_t.shape[1])
     pool = P(tp_ax, AXIS_SP, None, None)
@@ -370,7 +370,7 @@ def sp_suffix_attention_and_write(
         out = acc_g / jnp.maximum(l_g, 1e-30)[..., None]
         return out.astype(q.dtype), kp, vp
 
-    from jax.experimental.shard_map import shard_map
+    from vgate_tpu.parallel._compat import shard_map
 
     tp_ax = _tp_axis(mesh, H, KV)
     pool = P(tp_ax, AXIS_SP, None, None)
@@ -453,7 +453,7 @@ def sp_multitok_attention_and_write(
         out = acc_g / jnp.maximum(l_g, 1e-30)[..., None]
         return out.astype(q.dtype), kp, vp
 
-    from jax.experimental.shard_map import shard_map
+    from vgate_tpu.parallel._compat import shard_map
 
     tp_ax = _tp_axis(mesh, H, k_t.shape[2])
     pool = P(tp_ax, AXIS_SP, None, None)

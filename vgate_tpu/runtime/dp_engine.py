@@ -155,6 +155,35 @@ class ReplicatedEngine:
             if self._alive(core):
                 core.abort_in_flight(reason)
 
+    def set_spec_suspended(self, flag: bool) -> None:
+        """Brownout L3 fan-out: every replica suspends/resumes
+        speculative decoding together (dead replicas included — the
+        flag is a plain bool store, and a replica revived later must
+        not come back drafting under the load being shed)."""
+        for core in self.replicas:
+            core.set_spec_suspended(flag)
+
+    def pressure_signals(self) -> Dict[str, Any]:
+        """Admission/brownout gauges aggregated across replicas: the
+        WORST KV free ratio (one full replica is where new work lands
+        when routing prefers prefix affinity) and summed queue depth."""
+        ratios = []
+        depth = running = 0
+        for core in self.replicas:
+            if not self._alive(core):
+                continue
+            sig = core.pressure_signals()
+            if "kv_free_ratio" in sig:
+                ratios.append(sig["kv_free_ratio"])
+            depth += sig.get("engine_queue_depth", 0)
+            running += sig.get("running", 0)
+        out: Dict[str, Any] = {
+            "engine_queue_depth": depth, "running": running,
+        }
+        if ratios:
+            out["kv_free_ratio"] = min(ratios)
+        return out
+
     # ------------------------------------------------------------ routing
 
     @staticmethod

@@ -587,6 +587,12 @@ class EngineCore:
         # drafter is pluggable (tests inject oracles).
         self.spec_k = max(0, tpu_cfg.speculative_k)
         self.spec_ngram = max(1, tpu_cfg.speculative_ngram)
+        # brownout level >= 3 (vgate_tpu/admission.py) suspends
+        # speculative decoding at runtime: drafting burns verify-step
+        # compute that plain decode gives back under saturation.  One
+        # boolean read per tick; flipped cross-thread via
+        # set_spec_suspended (bool stores are atomic under the GIL).
+        self.spec_suspended = False
         self.drafter: Callable[[Sequence, int], List[int]] = (
             self._ngram_drafter
         )
@@ -1023,7 +1029,15 @@ class EngineCore:
         self._drain_abort_requests()
         self._handle_aborts()
         self._handle_deadlines()
-        if self.spec_k > 0:
+        if self.spec_k > 0 and not self.spec_suspended:
+            if self._pending_chunks:
+                # chunked decode ran while a brownout suspended
+                # speculation: fold the in-flight chunks into host
+                # state before a spec round reads last-token/positions,
+                # and kill the chunk path's signature cache (spec
+                # rounds advance positions behind its back)
+                self._process_chunks(drain=True)
+                self._decode_signature_cache = None
             worked = self._admit_and_prefill()
             return self._tick_speculative() or worked
         worked = self._admit_and_prefill()
@@ -2437,6 +2451,25 @@ class EngineCore:
             "trace_dir": out_dir,
             "duration_s": duration_s,
             "files": n_files,
+        }
+
+    def set_spec_suspended(self, flag: bool) -> None:
+        """Brownout hook (vgate_tpu/admission.py L3): suspend/resume
+        speculative decoding without a rebuild.  Safe from any thread —
+        the engine loop re-reads the flag every tick and folds any
+        in-flight decode chunks before the first spec round."""
+        self.spec_suspended = bool(flag)
+
+    def pressure_signals(self) -> Dict[str, Any]:
+        """Cheap cross-thread gauges for the gateway's admission and
+        brownout controllers: plain int/len reads only (atomic enough
+        under the GIL for control decisions — no locks, no device
+        touches)."""
+        total = max(1, self.allocator.num_allocatable)
+        return {
+            "kv_free_ratio": round(self.allocator.num_free / total, 4),
+            "engine_queue_depth": len(self.scheduler.waiting),
+            "running": len(self.scheduler.running),
         }
 
     def device_health(self) -> Dict[str, Any]:

@@ -30,9 +30,19 @@ from vgate_tpu.utils.math import bucket_for, cdiv, round_up
 logger = get_logger(__name__)
 
 
+def _rank(seq: "Sequence") -> int:
+    """Priority-tier rank from the request's SamplingParams
+    (vgate_tpu/admission.py: 0 interactive, 1 standard, 2 batch);
+    direct engine callers without the field schedule as standard."""
+    return getattr(seq.params, "priority", 1)
+
+
 class EngineBusyError(RuntimeError):
     """Raised at admission when the waiting queue is full (load shedding,
     SURVEY.md section 5.3: 'add deadlines/load-shedding at admission')."""
+
+    # the 503 body's machine-readable flavor (vgate_tpu/errors.py)
+    reason = "overloaded"
 
 
 class AdmissionDeadlineExceeded(EngineBusyError):
@@ -123,6 +133,9 @@ class Scheduler:
         # per-tick queue scan entirely (try_admit runs in a tight loop
         # on the engine thread)
         self._deadline_seen = False
+        # sticky twin for priority tiers: until a non-standard-priority
+        # sequence is queued, admission selection stays head-of-queue
+        self._priority_seen = False
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.total_preemptions = 0
         self.total_admitted = 0
@@ -143,6 +156,10 @@ class Scheduler:
             )
         if seq.deadline_t is not None:
             self._deadline_seen = True
+        if _rank(seq) != 1:
+            # sticky, like _deadline_seen: deployments without priority
+            # tiers keep the O(1) head-of-queue admission path
+            self._priority_seen = True
         self.waiting.append(seq)
         metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
 
@@ -170,11 +187,7 @@ class Scheduler:
         decode-chunk cap) key off this — page-exhausted queues must NOT
         shrink chunks or spin, since admission is blocked on a sequence
         finishing, not on loop latency."""
-        head = None
-        for seq in self.waiting:
-            if not seq.abort_requested:
-                head = seq
-                break
+        head = self._select_next()
         if head is None or self._free_slot() is None:
             return False
         n_pages = cdiv(max(1, head.num_prompt_tokens), self.page_size)
@@ -332,19 +345,64 @@ class Scheduler:
         seq._prefix_chain_cache = (key, chain)  # type: ignore[attr-defined]
         return chain
 
+    def _select_next(self) -> Optional[Sequence]:
+        """Admission candidate: the oldest sequence of the most
+        important waiting tier (rank, then seq_id — FIFO within a
+        tier; a preempted sequence's old seq_id keeps it ahead of
+        younger tier-mates on re-admission).  Aborted sequences are
+        skipped here and reaped by ``_reap_aborted``.  Without priority
+        tiers in play this is the head of the queue (O(1))."""
+        if not self._priority_seen:
+            for seq in self.waiting:  # head modulo an aborted prefix
+                if not seq.abort_requested:
+                    return seq
+            return None
+        best = None
+        for seq in self.waiting:
+            if seq.abort_requested:
+                continue
+            if best is None or (_rank(seq), seq.seq_id) < (
+                _rank(best), best.seq_id
+            ):
+                best = seq
+        return best
+
+    def _dequeue(self, seq: Sequence) -> None:
+        """Remove a selected sequence from the waiting queue — O(1) for
+        the head (the only case without priority tiers in play)."""
+        if self.waiting and self.waiting[0] is seq:
+            self.waiting.popleft()
+        else:
+            self.waiting.remove(seq)
+
+    def _reap_aborted(self) -> None:
+        """Settle client-cancelled waiting sequences WHEREVER they sit.
+        Head-only reaping is not enough once priority selection admits
+        around the head: an aborted sequence parked behind a bypassed
+        lower-tier head would otherwise never settle — its future (and
+        the gateway's admission backlog charge) would leak forever."""
+        if not any(s.abort_requested for s in self.waiting):
+            return
+        kept: Deque[Sequence] = deque()
+        for seq in self.waiting:
+            if seq.abort_requested:
+                self.abort(seq)
+            else:
+                kept.append(seq)
+        self.waiting = kept
+        metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+
     def try_admit(self) -> Optional[PrefillPlan]:
         self._shed_expired()
-        # client-cancelled requests drop as they reach the queue head
-        # (head-only keeps this race-free vs. concurrent add())
-        while self.waiting and self.waiting[0].abort_requested:
-            self.abort(self.waiting.popleft())
-            metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+        self._reap_aborted()
         if not self.waiting:
             return None
         slot = self._free_slot()
         if slot is None:
             return None
-        seq = self.waiting[0]
+        seq = self._select_next()
+        if seq is None:
+            return None
         n_pages = cdiv(max(1, seq.num_prompt_tokens), self.page_size)
 
         # prefix cache: match the longest chain of full prompt pages
@@ -365,7 +423,7 @@ class Scheduler:
             if self.preempt_on_oom and not self.running:
                 # nothing to preempt and still no memory: the prompt can
                 # never fit — fail it rather than deadlock
-                self.waiting.popleft()
+                self._dequeue(seq)
                 seq.fail(
                     RuntimeError(
                         "KV cache too small for prompt "
@@ -373,7 +431,7 @@ class Scheduler:
                     )
                 )
             return None
-        self.waiting.popleft()
+        self._dequeue(seq)
         metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
         seq.pages = matched + pages
         seq.slot = slot
@@ -416,7 +474,9 @@ class Scheduler:
         crossing into unowned memory; preempt the youngest sequences on
         exhaustion.  Returns True when a decode step can proceed."""
         max_pages = cdiv(self.max_model_len, self.page_size)
-        for seq in sorted(active, key=lambda s: s.seq_id):
+        # higher tiers claim pages first, so when the pool runs dry
+        # mid-loop it is the lower tiers that trigger preemption
+        for seq in sorted(active, key=lambda s: (_rank(s), s.seq_id)):
             if seq.status is not SeqStatus.RUNNING:
                 continue  # preempted by an earlier iteration
             # pages only need to cover the steps this sequence will KEEP
@@ -455,11 +515,13 @@ class Scheduler:
         return any(s is not None for s in self.slots)
 
     def _pick_victim(self) -> Optional[Sequence]:
-        """Youngest running sequence — possibly the requester itself."""
+        """Lowest-tier running sequence, youngest within the tier —
+        under KV pressure batch work yields to interactive before any
+        same-tier sequence is touched.  Possibly the requester itself."""
         running = self.running
         if not running:
             return None
-        return max(running, key=lambda s: s.seq_id)
+        return max(running, key=lambda s: (_rank(s), s.seq_id))
 
     def _event(self, kind: str, seq: Sequence, **fields) -> None:
         if self.recorder is not None:

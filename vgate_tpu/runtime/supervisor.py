@@ -359,6 +359,13 @@ class EngineSupervisor:
                     devices=self._devices,
                     params_ready=True,
                 )
+                # brownout state survives the rebuild: a crash while
+                # level >= 3 must not silently re-enable speculative
+                # decoding under the exact saturation being shed (the
+                # pressure controller only re-asserts on transitions)
+                new_core.spec_suspended = bool(
+                    getattr(old, "spec_suspended", False)
+                )
             except Exception:
                 logger.error(
                     "engine rebuild attempt failed", exc_info=True

@@ -11,7 +11,8 @@ reference is FastAPI/Starlette middleware; here it is an aiohttp
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
 
 from aiohttp import web
 
@@ -34,7 +35,13 @@ def extract_api_key(request: web.Request) -> Optional[str]:
 
 class RateLimiter:
     """Per-key sliding window over raw timestamps
-    (reference: vgate/security.py:42-113)."""
+    (reference: vgate/security.py:42-113).
+
+    Windows are ``deque``s (O(1) expiry at the old end, vs the O(n)
+    ``list.pop(0)`` this replaced), and keys whose window has fully
+    expired are swept out once per window period — the key space is
+    client-controlled (API keys / IPs), so an entry per distinct key
+    forever is an unbounded-memory hole under key-rotating traffic."""
 
     def __init__(
         self,
@@ -45,18 +52,33 @@ class RateLimiter:
         self.default_limit = requests_per_minute
         self.per_key_limits = dict(per_key_limits or {})
         self.window_s = window_s
-        self._windows: Dict[str, List[float]] = {}
+        self._windows: Dict[str, Deque[float]] = {}
+        self._last_sweep = 0.0
 
     def limit_for(self, key: str) -> int:
         return self.per_key_limits.get(key, self.default_limit)
 
+    def _sweep(self, now: float) -> None:
+        """Drop keys with no timestamp inside the window.  O(total
+        entries), amortized to once per window period."""
+        cutoff = now - self.window_s
+        for key in list(self._windows):
+            window = self._windows[key]
+            while window and window[0] <= cutoff:
+                window.popleft()
+            if not window:
+                del self._windows[key]
+        self._last_sweep = now
+
     def check(self, key: str, now: Optional[float] = None) -> Tuple[bool, Dict[str, str]]:
         """Record one request attempt.  Returns (allowed, headers)."""
         now = time.monotonic() if now is None else now
-        window = self._windows.setdefault(key, [])
+        if now - self._last_sweep >= self.window_s:
+            self._sweep(now)
+        window = self._windows.setdefault(key, deque())
         cutoff = now - self.window_s
         while window and window[0] <= cutoff:
-            window.pop(0)
+            window.popleft()
         limit = self.limit_for(key)
         headers = {
             "X-RateLimit-Limit": str(limit),
@@ -104,6 +126,9 @@ def build_security_middleware(config) -> web.middleware:
                 return _error_json(
                     401, "Invalid API key", "authentication_error"
                 )
+            # downstream consumers (admission tier mapping, per-key
+            # in-flight caps) read the authenticated key from here
+            request["api_key"] = key
             if config.rate_limit.enabled:
                 allowed, headers = rate_limiter.check(key)
                 if not allowed:

@@ -25,14 +25,17 @@ from aiohttp import web
 from pydantic import ValidationError
 
 from vgate_tpu import metrics
+from vgate_tpu.admission import estimate_prompt_tokens, tier_rank
 from vgate_tpu.batcher import RequestBatcher
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.engine import VGTEngine
 from vgate_tpu.errors import (
     ClientDisconnectError,
+    ClientQuotaExceededError,
     DeadlineExceededError,
     PoisonRequestError,
     RetryableError,
+    ServerDrainingError,
     state_is_alive,
     state_is_ready,
 )
@@ -40,7 +43,7 @@ from vgate_tpu.lifecycle import CancelToken, DrainController
 from vgate_tpu.logging_config import get_logger, setup_logging
 from vgate_tpu.observability.reqtrace import RequestMeta
 from vgate_tpu.runtime.scheduler import EngineBusyError
-from vgate_tpu.security import build_security_middleware
+from vgate_tpu.security import build_security_middleware, extract_api_key
 from vgate_tpu.server.openai_models import (
     BenchmarkRequest,
     ChatCompletion,
@@ -66,6 +69,13 @@ from vgate_tpu.version import __version__
 
 logger = get_logger(__name__)
 tracer = get_tracer(__name__)
+
+# asyncio.timeout is 3.11+; aiohttp's async_timeout dependency is the
+# same context manager for the 3.10 interpreters this serves on
+if hasattr(asyncio, "timeout"):  # pragma: no cover - py3.11+ images
+    _timeout_ctx = asyncio.timeout
+else:
+    from async_timeout import timeout as _timeout_ctx
 
 _QUIET_PATHS = {"/health", "/health/live", "/health/ready", "/metrics"}
 # excluded from the drain's in-flight count: probes/scrapes (and /stats
@@ -173,6 +183,43 @@ def _retry_after(exc: BaseException, default: float = 1.0) -> str:
     return str(max(1, int(round(getattr(exc, "retry_after", default)))))
 
 
+def _unavailable_503(exc: BaseException, message: str) -> web.Response:
+    """503 + Retry-After for every RetryableError flavor, carrying the
+    error's ``reason`` (overloaded | draining | recovering | dead |
+    unavailable) so clients — the SDK's typed ``ServerOverloadedError``
+    among them — can tell deliberate load shedding from a replica going
+    away without parsing message strings."""
+    resp = web.json_response(
+        {
+            "error": {
+                "message": message,
+                "type": "overloaded_error",
+                "reason": getattr(exc, "reason", "unavailable"),
+            }
+        },
+        status=503,
+    )
+    resp.headers["Retry-After"] = _retry_after(exc)
+    return resp
+
+
+def _quota_429(exc: ClientQuotaExceededError) -> web.Response:
+    """429 + Retry-After for the per-key in-flight cap — the rate-limit
+    status (client-scoped fairness), distinct from the 503 the
+    admission controller uses for whole-server shedding."""
+    resp = _error(429, str(exc), "rate_limit_error")
+    resp.headers["Retry-After"] = _retry_after(exc)
+    return resp
+
+
+def _request_api_key(request: web.Request) -> Optional[str]:
+    """Bearer key for tier mapping + per-key caps: the security
+    middleware stashes it when auth is on; otherwise fall back to
+    extracting it directly so admission.key_tiers works on deployments
+    without auth enabled."""
+    return request.get("api_key") or extract_api_key(request)
+
+
 def _effective_timeout(request: web.Request, body_timeout) -> float:
     """Per-request end-to-end deadline in seconds: the tightest of the
     server cap (``server.request_timeout_s``), the ``X-Request-Timeout``
@@ -239,15 +286,8 @@ async def drain_middleware(request: web.Request, handler):
         and request.method == "POST"
         and request.path.startswith("/v1/")
     ):
-        resp = _error(
-            503,
-            "server is draining for shutdown; retry another replica",
-            "overloaded_error",
-        )
-        resp.headers["Retry-After"] = str(
-            max(1, int(round(drain.retry_after_s)))
-        )
-        return resp
+        exc = ServerDrainingError(retry_after=drain.retry_after_s)
+        return _unavailable_503(exc, str(exc))
     return await handler(request)
 
 
@@ -300,6 +340,10 @@ async def health(request: web.Request) -> web.Response:
         "version": __version__,
         "engine": eng,
     }
+    if batcher is not None:
+        # overload surface: brownout level + active degradation steps
+        # (admission detail lives in /stats)
+        body["pressure"] = batcher.pressure.brief()
     if engine is not None:
         body["model"] = engine.config.model.model_id
         body["engine_type"] = type(engine.backend).__name__
@@ -429,16 +473,16 @@ async def _settle_submits(engine: VGTEngine, coros):
     except PoisonRequestError as exc:
         # quarantined: resending can never succeed, so NOT retryable
         return None, _error(400, str(exc), "invalid_request_error")
+    except ClientQuotaExceededError as exc:
+        # per-key in-flight cap (admission.per_key_max_inflight): the
+        # client-scoped 429, not the server-scoped 503
+        return None, _quota_429(exc)
     except RetryableError as exc:
-        # engine crashed/restarting (or dead): retryable 503 carrying
-        # the server-suggested backoff
-        resp = _error(503, f"Engine unavailable: {exc}", "overloaded_error")
-        resp.headers["Retry-After"] = _retry_after(exc)
-        return None, resp
+        # admission shed / engine crashed / draining / dead: retryable
+        # 503 carrying the server-suggested backoff and the reason
+        return None, _unavailable_503(exc, f"Engine unavailable: {exc}")
     except EngineBusyError as exc:
-        resp = _error(503, f"Engine overloaded: {exc}", "overloaded_error")
-        resp.headers["Retry-After"] = _retry_after(exc)
-        return None, resp
+        return None, _unavailable_503(exc, f"Engine overloaded: {exc}")
     except Exception as exc:
         return None, _error(500, f"Inference failed: {exc}", "server_error")
 
@@ -473,9 +517,53 @@ async def chat_completions(request: web.Request) -> web.Response:
                 422, "n > 1 is not supported with stream=true",
                 "invalid_request_error",
             )
-        return await _stream_chat(
-            request, payload, prompt, logit_bias, timeout_s
+        stream_key = _request_api_key(request)
+        tier = batcher.admission.resolve_tier(
+            payload.priority, stream_key
         )
+        # one per-key slot per CLIENT request (the fairness cap must
+        # never count internal fan-out, and a 429 here is a real
+        # status line, not an SSE event)
+        try:
+            release_slot = batcher.admission.acquire_inflight(
+                stream_key, tier=tier
+            )
+        except ClientQuotaExceededError as exc:
+            return _quota_429(exc)
+        if getattr(engine.backend, "stream_async", None) is None:
+            # replay path: token-budget admission happens inside
+            # batcher.submit
+            try:
+                return await _stream_chat(
+                    request, payload, prompt, logit_bias, timeout_s
+                )
+            finally:
+                release_slot()
+        # true-streaming path bypasses the batcher, so admission runs
+        # here — while the status line is still ours, a rejected stream
+        # gets a real 503 instead of an SSE error event
+        batcher.pressure.maybe_update()
+        # same brownout clamp _stream_chat applies to the params: the
+        # backlog must be charged what the engine will actually decode
+        cost = estimate_prompt_tokens(prompt) + (
+            batcher.pressure.clamp_max_tokens(
+                payload.effective_max_tokens()
+                or engine.config.inference.max_tokens
+            )
+        )
+        try:
+            batcher.admission.admit(cost, tier=tier, deadline_s=timeout_s)
+        except RetryableError as exc:
+            release_slot()
+            return _unavailable_503(exc, str(exc))
+        try:
+            return await _stream_chat(
+                request, payload, prompt, logit_bias, timeout_s,
+                tier=tier,
+            )
+        finally:
+            batcher.admission.release(cost)
+            release_slot()
 
     # n choices run as n engine requests sampled concurrently (the
     # variant salt keeps them from deduping; prefix caching shares
@@ -483,6 +571,16 @@ async def chat_completions(request: web.Request) -> web.Response:
     n_submits, deterministic = _n_plan(
         engine, payload.temperature, payload.seed, payload.n
     )
+    api_key = _request_api_key(request)
+    # the per-key fairness cap charges the CLIENT request once — its n
+    # fan-out submits below are one client action, not n
+    try:
+        release_slot = batcher.admission.acquire_inflight(
+            api_key,
+            tier=batcher.admission.resolve_tier(payload.priority, api_key),
+        )
+    except ClientQuotaExceededError as exc:
+        return _quota_429(exc)
     token = CancelToken()
     watcher = _watch_disconnect(request, token)
     try:
@@ -509,12 +607,15 @@ async def chat_completions(request: web.Request) -> web.Response:
                     presence_penalty=payload.presence_penalty or 0.0,
                     logit_bias=logit_bias,
                     cancel_token=token,
+                    priority=payload.priority,
+                    api_key=api_key,
                 )
                 for i in range(n_submits)
             ),
         )
     finally:
         watcher.cancel()
+        release_slot()
     if err is not None:
         return err
     results = (settled * (payload.n if deterministic else 1))[: payload.n]
@@ -555,6 +656,7 @@ async def chat_completions(request: web.Request) -> web.Response:
 async def _stream_chat(
     request: web.Request, payload: ChatCompletionRequest, prompt: str,
     logit_bias=None, timeout_s: Optional[float] = None,
+    tier: Optional[str] = None,
 ) -> web.StreamResponse:
     """SSE streaming.  Uses the backend's token stream when it has one;
     otherwise generates fully and replays in chunks (dry-run path).
@@ -619,8 +721,10 @@ async def _stream_chat(
     stream_fn = getattr(engine.backend, "stream_async", None)
     if stream_fn is not None:
         params = engine.backend.create_sampling_params(
-            max_tokens=payload.effective_max_tokens()
-            or engine.config.inference.max_tokens,
+            max_tokens=batcher.pressure.clamp_max_tokens(
+                payload.effective_max_tokens()
+                or engine.config.inference.max_tokens
+            ),
             min_tokens=payload.min_tokens,
             temperature=(
                 payload.temperature
@@ -645,6 +749,7 @@ async def _stream_chat(
             frequency_penalty=payload.frequency_penalty or 0.0,
             presence_penalty=payload.presence_penalty or 0.0,
             logit_bias=logit_bias,
+            priority=tier_rank(tier) if tier else 1,
         )
         try:
             import inspect
@@ -655,7 +760,11 @@ async def _stream_chat(
                 kwargs["on_finish"] = (
                     lambda r: finish_reason.__setitem__("value", r)
                 )
-            if want_usage and "on_usage" in stream_params:
+            if "on_usage" in stream_params:
+                # always captured (emission to the client stays gated
+                # on want_usage): streaming bypasses the batcher, so
+                # this is where its completions feed the admission
+                # throughput EWMA
                 kwargs["on_usage"] = (
                     lambda u: usage_box.__setitem__("value", u)
                 )
@@ -669,7 +778,7 @@ async def _stream_chat(
                     request_id=request.get("request_id"),
                     trace_ctx=capture_context(),
                 )
-            async with asyncio.timeout(timeout_s):
+            async with _timeout_ctx(timeout_s):
                 async for piece in stream_fn(prompt, params, **kwargs):
                     if isinstance(piece, dict):  # logprobs-carrying delta
                         await resp.write(
@@ -680,7 +789,14 @@ async def _stream_chat(
                         )
                     else:
                         await resp.write(_chunk({"content": piece}))
-        except TimeoutError:
+            if usage_box["value"] is not None:
+                batcher.admission.observe_completion(
+                    usage_box["value"].get("completion_tokens", 0)
+                )
+        # both spellings: on py3.10 the async_timeout shim raises
+        # asyncio.TimeoutError, which is NOT the builtin TimeoutError
+        # there (they merged in 3.11)
+        except (TimeoutError, asyncio.TimeoutError):
             await resp.write(
                 b'data: {"error": {"message": "request timed out", '
                 b'"type": "timeout_error"}}\n\n'
@@ -722,10 +838,12 @@ async def _stream_chat(
                 frequency_penalty=payload.frequency_penalty or 0.0,
                 presence_penalty=payload.presence_penalty or 0.0,
                 logit_bias=logit_bias,
+                priority=payload.priority,
+                api_key=_request_api_key(request),
             )
         except (
             asyncio.TimeoutError, DeadlineExceededError, EngineBusyError,
-            RetryableError, PoisonRequestError,
+            RetryableError, PoisonRequestError, ClientQuotaExceededError,
         ) as exc:
             # the 200 + role chunk are already on the wire: deliver the
             # failure as an SSE error event, not a reset connection
@@ -735,6 +853,8 @@ async def _stream_chat(
                 err_type = "timeout_error"
             elif isinstance(exc, PoisonRequestError):
                 err_type = "invalid_request_error"
+            elif isinstance(exc, ClientQuotaExceededError):
+                err_type = "rate_limit_error"
             else:
                 err_type = "overloaded_error"
             await resp.write(
@@ -855,6 +975,15 @@ async def completions(request: web.Request) -> web.Response:
     # logprobs are requested internally even when the client didn't ask
     ranking = not deterministic and best_of > payload.n
 
+    api_key = _request_api_key(request)
+    # per-key cap: one slot per client request, not per fan-out submit
+    try:
+        release_slot = batcher.admission.acquire_inflight(
+            api_key,
+            tier=batcher.admission.resolve_tier(payload.priority, api_key),
+        )
+    except ClientQuotaExceededError as exc:
+        return _quota_429(exc)
     token = CancelToken()
     watcher = _watch_disconnect(request, token)
     try:
@@ -883,6 +1012,8 @@ async def completions(request: web.Request) -> web.Response:
                     presence_penalty=payload.presence_penalty or 0.0,
                     logit_bias=logit_bias,
                     cancel_token=token,
+                    priority=payload.priority,
+                    api_key=api_key,
                 )
                 for pi, p in enumerate(prompts)
                 for i in range(n_submits)
@@ -890,6 +1021,7 @@ async def completions(request: web.Request) -> web.Response:
         )
     finally:
         watcher.cancel()
+        release_slot()
     if err is not None:
         return err
 
@@ -962,10 +1094,23 @@ async def embeddings(request: web.Request) -> web.Response:
     if not inputs:
         return _error(422, "input must be non-empty", "invalid_request_error")
     engine: VGTEngine = request.app["engine"]
+    batcher: RequestBatcher = request.app["batcher"]
     try:
         timeout_s = _effective_timeout(request, None)
     except ValueError as exc:
         return _error(422, str(exc), "invalid_request_error")
+    # embeddings skip the token-budget path (no decode backlog), but
+    # the per-key in-flight fairness cap still applies
+    emb_key = _request_api_key(request)
+    try:
+        release_slot = batcher.admission.acquire_inflight(
+            emb_key,
+            tier=batcher.admission.resolve_tier(
+                payload.priority, emb_key
+            ),
+        )
+    except ClientQuotaExceededError as exc:
+        return _quota_429(exc)
     loop = asyncio.get_running_loop()
     try:
         # the encoder pass is a sync executor hop (can't be cancelled
@@ -985,6 +1130,8 @@ async def embeddings(request: web.Request) -> web.Response:
             f"embedding request exceeded its deadline ({timeout_s:.3f}s)",
             "timeout_error",
         )
+    finally:
+        release_slot()
     response = EmbeddingResponse(
         data=[
             EmbeddingData(index=i, embedding=vec)
@@ -1033,6 +1180,11 @@ async def get_stats(request: web.Request) -> web.Response:
     stats = {
         "batcher": batcher.get_metrics(),
         "cache": batcher.cache.get_stats(),
+        "admission": {
+            **batcher.admission.get_stats(),
+            "pressure": batcher.pressure.get_stats(),
+            "queue_depths": batcher._queue.depths(),
+        },
         "config": {
             "max_batch_size": engine.config.batch.max_batch_size,
             "max_wait_time_ms": engine.config.batch.max_wait_time_ms,
@@ -1154,12 +1306,13 @@ async def run_benchmark(request: web.Request) -> web.Response:
             total_tokens += sum(r.get("num_tokens", 0) for r in results)
     except PoisonRequestError as exc:
         return _error(400, str(exc), "invalid_request_error")
+    except ClientQuotaExceededError as exc:
+        return _quota_429(exc)
     except (RetryableError, EngineBusyError) as exc:
         # batcher.submit raises these routinely while the engine is
-        # recovering — map them like every other handler instead of a 500
-        resp = _error(503, f"Engine unavailable: {exc}", "overloaded_error")
-        resp.headers["Retry-After"] = _retry_after(exc)
-        return resp
+        # recovering or shedding — map them like every other handler
+        # instead of a 500
+        return _unavailable_503(exc, f"Engine unavailable: {exc}")
     wall = time.perf_counter() - bench_start
     latencies_ms = sorted(l * 1000 for l in latencies)
     return web.json_response(

@@ -8,7 +8,23 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Union
 
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, Field, field_validator
+
+from vgate_tpu.admission import TIERS
+
+# priority tier for admission + scheduling: admission sheds batch
+# first and interactive last; a key's configured tier caps the field.
+# Validated against the canonical vocabulary (admission.TIERS) so a
+# new tier needs exactly one definition site.
+Priority = Optional[str]
+
+
+def _check_priority(v: Optional[str]) -> Optional[str]:
+    if v is not None and v not in TIERS:
+        raise ValueError(
+            f"priority must be one of {TIERS}, got {v!r}"
+        )
+    return v
 
 
 def _logit_bias_ints(
@@ -95,6 +111,11 @@ class ChatCompletionRequest(BaseModel):
     # capped by server.request_timeout_s).  Past it the request is shed
     # between decode ticks: 504 with partial-tokens metadata.
     timeout: Optional[float] = Field(default=None, gt=0)
+    # priority tier for admission + scheduling (None -> the key's
+    # configured tier, else admission.default_tier)
+    priority: Priority = None
+
+    _check_priority = field_validator("priority")(_check_priority)
 
     def logit_bias_ints(self) -> Optional[Dict[int, float]]:
         """OpenAI sends string token-id keys; normalize + clamp."""
@@ -171,6 +192,10 @@ class CompletionRequest(BaseModel):
     # end-to-end deadline in seconds (same semantics as the chat
     # endpoint's field; tightest of body/header/server cap wins)
     timeout: Optional[float] = Field(default=None, gt=0)
+    # priority tier for admission + scheduling
+    priority: Priority = None
+
+    _check_priority = field_validator("priority")(_check_priority)
 
     def logit_bias_ints(self) -> Optional[Dict[int, float]]:
         return _logit_bias_ints(self.logit_bias)
@@ -207,6 +232,11 @@ class EmbeddingRequest(BaseModel):
     model: Optional[str] = None
     input: Union[str, List[str]]
     user: Optional[str] = None
+    # accepted for SDK symmetry; embeddings skip the token-budget path,
+    # so only the per-key in-flight cap applies to them
+    priority: Priority = None
+
+    _check_priority = field_validator("priority")(_check_priority)
 
 
 class EmbeddingData(BaseModel):

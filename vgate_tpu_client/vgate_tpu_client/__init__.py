@@ -7,6 +7,7 @@ from vgate_tpu_client.exceptions import (
     DeadlineExceeded,
     RateLimitError,
     ServerError,
+    ServerOverloadedError,
     VGTError,
 )
 from vgate_tpu_client.models import (
@@ -30,6 +31,7 @@ __all__ = [
     "DeadlineExceeded",
     "RateLimitError",
     "ServerError",
+    "ServerOverloadedError",
     "ConnectionError",
     "ChatMessage",
     "ChatCompletionRequest",

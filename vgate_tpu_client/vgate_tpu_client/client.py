@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
 from typing import Any, AsyncIterator, Dict, Iterator, List, Optional, Union
 
@@ -24,6 +25,7 @@ from vgate_tpu_client.exceptions import (
     DeadlineExceeded,
     RateLimitError,
     ServerError,
+    ServerOverloadedError,
     VGTError,
 )
 from vgate_tpu_client.models import (
@@ -49,6 +51,19 @@ DEFAULT_MAX_RETRIES = 2
 DEADLINE_TRANSPORT_MARGIN = 35.0
 
 
+def _retry_delay(attempt: int, retry_after: Optional[float] = None) -> float:
+    """Jittered backoff.  The plain ``2 ** attempt`` this replaces
+    synchronizes every client that failed together into retry storms
+    that re-overload the server in lockstep — the opposite of load
+    shedding.  Equal jitter spreads the herd: half the base delay
+    fixed, half uniform-random.  A server-suggested ``Retry-After`` is
+    honored as the MINIMUM (never retry early) with jitter on top."""
+    if retry_after:
+        return retry_after + random.uniform(0, 0.25 * retry_after + 0.1)
+    base = min(8.0, 2.0 ** attempt)
+    return base / 2 + random.uniform(0, base / 2)
+
+
 def _raise_for_status(response: httpx.Response) -> None:
     if response.status_code < 400:
         return
@@ -66,6 +81,24 @@ def _raise_for_status(response: httpx.Response) -> None:
         )
     if response.status_code == 504:
         raise DeadlineExceeded(message, response.status_code, body)
+    if response.status_code == 503:
+        # the body's reason distinguishes deliberate admission-control
+        # shedding (typed, carries the server's backoff hint) from a
+        # replica going away (draining/recovering/dead -> ServerError)
+        reason = (
+            body.get("error", {}).get("reason")
+            if isinstance(body, dict)
+            else None
+        )
+        if reason == "overloaded":
+            raise ServerOverloadedError(
+                message,
+                response.status_code,
+                body,
+                retry_after=RateLimitInfo.from_headers(
+                    response.headers
+                ).retry_after,
+            )
     if response.status_code >= 500:
         raise ServerError(message, response.status_code, body)
     raise VGTError(message, response.status_code, body)
@@ -116,6 +149,7 @@ class _ChatResource:
         stop_token_ids: Optional[List[int]] = None,
         logit_bias: Optional[Dict[str, float]] = None,
         timeout: Optional[float] = None,
+        priority: Optional[str] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -134,6 +168,9 @@ class _ChatResource:
             min_tokens=min_tokens,
             stop_token_ids=stop_token_ids,
             logit_bias=logit_bias,
+            # interactive | standard | batch: the server sheds batch
+            # first under overload (admission control)
+            priority=priority,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -158,9 +195,13 @@ class _CompletionsResource:
         prompt,
         model: Optional[str] = None,
         timeout: Optional[float] = None,
+        priority: Optional[str] = None,
         **kwargs,
     ):
-        payload = {"prompt": prompt, "model": model, **kwargs}
+        payload = {
+            "prompt": prompt, "model": model, "priority": priority,
+            **kwargs,
+        }
         payload = {k: v for k, v in payload.items() if v is not None}
         return self._client._request(
             "POST", "/v1/completions", payload, **_deadline_kwargs(timeout)
@@ -176,10 +217,11 @@ class _EmbeddingsResource:
         input,
         model: Optional[str] = None,
         timeout: Optional[float] = None,
+        priority: Optional[str] = None,
     ) -> EmbeddingResponse:
-        payload = EmbeddingRequest(model=model, input=input).model_dump(
-            exclude_none=True
-        )
+        payload = EmbeddingRequest(
+            model=model, input=input, priority=priority
+        ).model_dump(exclude_none=True)
         data = self._client._request(
             "POST", "/v1/embeddings", payload, **_deadline_kwargs(timeout)
         )
@@ -233,13 +275,14 @@ class VGT:
             except httpx.HTTPError as exc:
                 last_exc = ConnectionError(f"connection failed: {exc}")
                 if attempt < self.max_retries:
-                    time.sleep(2 ** attempt)
+                    time.sleep(_retry_delay(attempt))
                     continue
                 raise last_exc from exc
             self.last_rate_limit = RateLimitInfo.from_headers(response.headers)
             if response.status_code == 429 and attempt < self.max_retries:
-                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
-                time.sleep(retry_after)
+                time.sleep(
+                    _retry_delay(attempt, self.last_rate_limit.retry_after)
+                )
                 continue
             if (
                 response.status_code >= 500
@@ -247,11 +290,12 @@ class VGT:
                 and attempt < self.max_retries
             ):
                 # 503s from admission shed / engine recovery / drain
-                # carry a server-suggested Retry-After; honor it like on
-                # 429.  504 (deadline) is NOT retried: the same request
-                # would blow the same budget.
-                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
-                time.sleep(retry_after)
+                # carry a server-suggested Retry-After; honor it (with
+                # jitter on top) like on 429.  504 (deadline) is NOT
+                # retried: the same request would blow the same budget.
+                time.sleep(
+                    _retry_delay(attempt, self.last_rate_limit.retry_after)
+                )
                 continue
             _raise_for_status(response)
             return response.json()
@@ -332,6 +376,7 @@ class _AsyncChatResource:
         stop_token_ids: Optional[List[int]] = None,
         logit_bias: Optional[Dict[str, float]] = None,
         timeout: Optional[float] = None,
+        priority: Optional[str] = None,
     ):
         payload = ChatCompletionRequest(
             model=model,
@@ -350,6 +395,9 @@ class _AsyncChatResource:
             min_tokens=min_tokens,
             stop_token_ids=stop_token_ids,
             logit_bias=logit_bias,
+            # interactive | standard | batch: the server sheds batch
+            # first under overload (admission control)
+            priority=priority,
             stream=stream,
         ).model_dump(exclude_none=True)
         if stream:
@@ -372,9 +420,13 @@ class _AsyncCompletionsResource:
         prompt,
         model: Optional[str] = None,
         timeout: Optional[float] = None,
+        priority: Optional[str] = None,
         **kwargs,
     ):
-        payload = {"prompt": prompt, "model": model, **kwargs}
+        payload = {
+            "prompt": prompt, "model": model, "priority": priority,
+            **kwargs,
+        }
         payload = {k: v for k, v in payload.items() if v is not None}
         return await self._client._request(
             "POST", "/v1/completions", payload, **_deadline_kwargs(timeout)
@@ -390,10 +442,11 @@ class _AsyncEmbeddingsResource:
         input,
         model: Optional[str] = None,
         timeout: Optional[float] = None,
+        priority: Optional[str] = None,
     ) -> EmbeddingResponse:
-        payload = EmbeddingRequest(model=model, input=input).model_dump(
-            exclude_none=True
-        )
+        payload = EmbeddingRequest(
+            model=model, input=input, priority=priority
+        ).model_dump(exclude_none=True)
         data = await self._client._request(
             "POST", "/v1/embeddings", payload, **_deadline_kwargs(timeout)
         )
@@ -447,23 +500,25 @@ class AsyncVGT:
             except httpx.HTTPError as exc:
                 last_exc = ConnectionError(f"connection failed: {exc}")
                 if attempt < self.max_retries:
-                    await asyncio.sleep(2 ** attempt)
+                    await asyncio.sleep(_retry_delay(attempt))
                     continue
                 raise last_exc from exc
             self.last_rate_limit = RateLimitInfo.from_headers(response.headers)
             if response.status_code == 429 and attempt < self.max_retries:
-                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
-                await asyncio.sleep(retry_after)
+                await asyncio.sleep(
+                    _retry_delay(attempt, self.last_rate_limit.retry_after)
+                )
                 continue
             if (
                 response.status_code >= 500
                 and response.status_code != 504
                 and attempt < self.max_retries
             ):
-                # honor the server-suggested Retry-After on 5xx too;
-                # 504 (deadline) is terminal for this budget
-                retry_after = self.last_rate_limit.retry_after or 2 ** attempt
-                await asyncio.sleep(retry_after)
+                # honor the server-suggested Retry-After on 5xx too
+                # (jittered); 504 (deadline) is terminal for this budget
+                await asyncio.sleep(
+                    _retry_delay(attempt, self.last_rate_limit.retry_after)
+                )
                 continue
             _raise_for_status(response)
             return response.json()

@@ -65,5 +65,25 @@ class ServerError(VGTError):
     """5xx — gateway or engine failure."""
 
 
+class ServerOverloadedError(ServerError):
+    """503 with ``reason: "overloaded"`` — admission control refused
+    the request at the door (token backlog / would-miss-SLO / KV
+    watermark).  Distinct from the other 503 flavors (draining,
+    recovering, dead — plain :class:`ServerError`): overload shedding
+    is a *deliberate, healthy* response, and the right client move is
+    to back off ``retry_after`` seconds (ideally against another
+    replica) or resend at a lower ``priority`` tier."""
+
+    def __init__(
+        self,
+        message: str,
+        status_code: Optional[int] = None,
+        body: Optional[Any] = None,
+        retry_after: Optional[float] = None,
+    ) -> None:
+        super().__init__(message, status_code, body)
+        self.retry_after = retry_after
+
+
 class ConnectionError(VGTError):
     """Transport-level failure reaching the gateway."""

@@ -32,6 +32,9 @@ class ChatCompletionRequest(BaseModel):
     stop_token_ids: Optional[List[int]] = None
     # OpenAI logit_bias: stringified token-id -> bias in [-100, 100]
     logit_bias: Optional[Dict[str, float]] = None
+    # priority tier (interactive | standard | batch): the server sheds
+    # batch first and interactive last under overload
+    priority: Optional[str] = None
 
 
 class Usage(BaseModel):
@@ -75,6 +78,7 @@ class EmbeddingResponse(BaseModel):
 class EmbeddingRequest(BaseModel):
     model: Optional[str] = None
     input: Union[str, List[str]]
+    priority: Optional[str] = None
 
 
 class HealthResponse(BaseModel):
