@@ -23,8 +23,12 @@ fi
 
 echo "== deterministic fault-injection suite =="
 python -m pytest tests/test_faults.py tests/test_recovery.py \
+  tests/test_resume.py \
   -q -p no:cacheprovider -m "not chaos"
 
 echo "== chaos-marked randomized suite =="
 python -m pytest tests/test_recovery.py \
   -q -p no:cacheprovider -m chaos
+
+echo "== in-flight survival drill =="
+bash scripts/resume_check.sh

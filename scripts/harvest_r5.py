@@ -50,12 +50,32 @@ def main():
         body = open(OUT).read()
         prefix = body.split(MARKER)[0].rstrip("\n").splitlines()
         if MARKER not in body:
-            def _is_row(line):
+            # Only drop rows that verifiably came from a previous
+            # harvest — i.e. lines that appear verbatim in the raw
+            # per-tag files.  "Parses as a JSON dict" alone is NOT
+            # evidence of harvest provenance: the builder's hand-written
+            # analysis legitimately embeds example JSON rows in prose,
+            # and a marker-less re-run used to silently delete those
+            # (ADVICE.md).
+            harvested = set()
+            for path in glob.glob(os.path.join(RAW, "*.jsonl")):
+                for raw_line in open(path):
+                    raw_line = raw_line.strip()
+                    if raw_line:
+                        harvested.add(raw_line)
+
+            def _is_harvested_row(line):
+                if line not in harvested:
+                    return False
                 try:
                     return isinstance(json.loads(line), dict)
                 except ValueError:
                     return False
-            prefix = [ln for ln in prefix if not _is_row(ln.strip())]
+
+            prefix = [
+                ln for ln in prefix
+                if not _is_harvested_row(ln.strip())
+            ]
     lines = prefix + [
         "",
         MARKER,

@@ -1,0 +1,171 @@
+#!/usr/bin/env bash
+# In-flight request survival drill (sibling of chaos_check.sh /
+# drain_check.sh): boot a CPU tiny-dense server with TWO one-shot
+# faults armed from the environment —
+#   * a `stall` delay longer than a lowered recovery.step_stall_s
+#     (simulates the wedged-engine mode: stuck decode step / Mosaic
+#     hang) so the hang watchdog must declare the fault, and
+#   * a `decode_step` transient raise (a plain engine-loop crash),
+# then fire concurrent greedy generations through both events and
+# assert:
+#   1. ZERO client-visible 5xx — every accepted request completes 200,
+#   2. resumed responses are token-identical to a clean rerun of the
+#      same prompts (result cache disabled, temperature 0),
+#   3. vgt_resumed_sequences > 0 and the supervisor saw >= 1 stall and
+#      >= 1 restart (/stats),
+#   4. /health/ready recovers to 200 after the storm.
+#
+# Usage: scripts/resume_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${1:-8734}"
+export JAX_PLATFORMS=cpu
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=64
+export VGT_TPU__DP=1
+export VGT_TPU__TP=1
+export VGT_TPU__EP=1
+export VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=1
+export VGT_TPU__KV_NUM_PAGES=128
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=8
+export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=20
+# identical reruns must recompute, not replay a cached body
+export VGT_CACHE__ENABLED=false
+export VGT_RECOVERY__BACKOFF_BASE_S=0.05
+export VGT_RECOVERY__BACKOFF_CAP_S=0.2
+export VGT_RECOVERY__MAX_RESTARTS=8
+export VGT_RECOVERY__DEGRADED_PROBATION_S=0.5
+# lowered watchdog threshold so the armed 6s stall trips it — but
+# comfortably above a real CPU decode chunk (~1s on a loaded host; a
+# tighter value false-positives honest dispatches into restarts); the
+# compile grace stays wide so first-contact compiles never trip
+export VGT_RECOVERY__STEP_STALL_S=2.5
+export VGT_RECOVERY__COMPILE_GRACE_S=600
+# the storm: wedge the first busy tick for 6s, then crash a later
+# decode dispatch (both one-shot)
+export VGT_FAULTS="stall:delay:delay=6:times=1,decode_step:raise:kind=transient:times=1"
+
+python main.py &
+SERVER_PID=$!
+trap 'kill -9 $SERVER_PID 2>/dev/null || true' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+for _ in $(seq 1 300); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: server never became ready"; exit 1; }
+
+python - "$BASE" <<'EOF'
+import asyncio, sys, time
+import aiohttp
+
+BASE = sys.argv[1]
+N = 8
+PROMPTS = [f"resume drill prompt {i}" for i in range(N)]
+
+
+async def fire(session, prompt):
+    async with session.post(
+        f"{BASE}/v1/chat/completions",
+        json={
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": 24,
+            "temperature": 0.0,
+        },
+    ) as resp:
+        return resp.status, await resp.json()
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        # the storm wave: the first busy engine tick sleeps for the
+        # armed stall delay (VGT_FAULTS stall:delay above) -> the
+        # watchdog declares a wedge once the heartbeat is
+        # STEP_STALL_S stale -> checkpoint, rebuild, replay; a later
+        # decode dispatch then raises the armed transient -> second
+        # checkpoint/replay.  Every request must still answer 200.
+        results = await asyncio.gather(
+            *(fire(session, p) for p in PROMPTS)
+        )
+        fivexx = [s for s, _ in results if s >= 500]
+        assert not fivexx, f"client-visible 5xx during resume: {results}"
+        storm_text = [
+            b["choices"][0]["message"]["content"] for _, b in results
+        ]
+        resumed_flags = [b.get("resumed", False) for _, b in results]
+        assert any(resumed_flags), (
+            "no response carried resumed:true — the storm never "
+            "touched an in-flight request"
+        )
+
+        # engine accounting: the watchdog saw the wedge, the supervisor
+        # restarted (twice: stall + crash), work was replayed not lost
+        async with session.get(f"{BASE}/stats") as resp:
+            stats = await resp.json()
+        sup = stats["engine"]["supervisor"]
+        assert sup["stalls"] >= 1, sup
+        assert sup["restarts"] >= 2, sup
+        assert sup["resumed"] >= 1, sup
+        assert sup["lost"] == 0, sup
+        assert stats["engine"]["last_resume"] is not None
+
+        async with session.get(f"{BASE}/metrics") as resp:
+            metrics_text = await resp.text()
+        for line in metrics_text.splitlines():
+            if line.startswith("vgt_resumed_sequences_total"):
+                assert float(line.split()[-1]) > 0, line
+                break
+        else:
+            raise AssertionError("vgt_resumed_sequences not exported")
+
+        # ready recovered; liveness never mattered less (in-process)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            async with session.get(f"{BASE}/health/ready") as resp:
+                if resp.status == 200:
+                    break
+            await asyncio.sleep(0.2)
+        else:
+            raise AssertionError("ready never recovered")
+
+        # token-identity: a clean rerun (faults exhausted, cache off,
+        # temperature 0) must reproduce the resumed outputs exactly
+        rerun = await asyncio.gather(
+            *(fire(session, p) for p in PROMPTS)
+        )
+        for (s, b), want, was_resumed in zip(
+            rerun, storm_text, resumed_flags
+        ):
+            assert s == 200, (s, b)
+            got = b["choices"][0]["message"]["content"]
+            assert got == want, (
+                f"resumed output diverged (resumed={was_resumed}):\n"
+                f"  storm: {want!r}\n  clean: {got!r}"
+            )
+        print(
+            f"PASS: {N}/{N} completed through stall+crash with zero "
+            f"5xx; {sum(resumed_flags)} resumed responses "
+            f"token-identical to clean rerun; stalls={sup['stalls']} "
+            f"restarts={sup['restarts']} resumed={sup['resumed']}"
+        )
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+echo "resume_check: OK"

@@ -10,7 +10,7 @@ from vgate_tpu.config import load_config
 from vgate_tpu.runtime.dp_engine import ReplicatedEngine
 
 
-def dp_config(dp=2, **tpu_overrides):
+def dp_config(dp=2, recovery=None, **tpu_overrides):
     tpu = {
         "dp": dp,
         "tp": 1,
@@ -33,6 +33,7 @@ def dp_config(dp=2, **tpu_overrides):
         },
         tpu=tpu,
         scheduler={"max_queue_size": 16},
+        recovery=recovery or {},
         logging={"level": "WARNING"},
     )
 
@@ -182,8 +183,13 @@ def test_dp_x_sp_replicas_shard_their_pools():
 def test_dp_routes_around_dead_replica():
     """Engine-fatal on one replica (SURVEY 5.3 failure containment):
     new requests ride the surviving replica; health reports degraded
-    but serving-capable; all-dead surfaces the fatal."""
-    engine = ReplicatedEngine(dp_config(dp=2), devices=jax.devices()[:2])
+    but serving-capable; all-dead surfaces the fatal.  Repair is OFF
+    here (recovery.enabled False) — this pins the pure routing
+    contract; failover + rebuild live in tests/test_resume.py."""
+    engine = ReplicatedEngine(
+        dp_config(dp=2, recovery={"enabled": False}),
+        devices=jax.devices()[:2],
+    )
     engine.start()
     try:
         victim = engine.replicas[0]

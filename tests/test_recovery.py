@@ -52,6 +52,10 @@ def rec_config(recovery=None, **tpu_overrides):
         "backoff_cap_s": 0.2,
         "degraded_probation_s": 0.25,
         "poison_threshold": 2,
+        # this file pins the PR-1 FAIL-FAST contract (in-flight work
+        # fails with the retryable 503 across a restart); the
+        # checkpoint-&-replay default lives in tests/test_resume.py
+        "resume_in_flight": False,
     }
     rec.update(recovery or {})
     return load_config(
@@ -298,6 +302,9 @@ async def _gateway_client(**recovery):
             "backoff_cap_s": 0.2,
             "degraded_probation_s": 0.2,
             "poison_threshold": 99,
+            # fail-fast contract (see rec_config above); the resume
+            # path's gateway behavior is scripts/resume_check.sh
+            "resume_in_flight": False,
             **recovery,
         },
         batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},

@@ -303,6 +303,7 @@ class JaxTPUBackend:
                             "gen_time": (
                                 (seq.finish_t or 0.0) - seq.arrival_t
                             ),
+                            **seq.resume_metrics(),
                         },
                         logprobs=(
                             self.core.logprob_entries(seq)

@@ -673,8 +673,17 @@ class RequestBatcher:
                     # one would replay a truncated generation to every
                     # later identical request.  Brownout level >= 4 skips
                     # the write path entirely (reads stay on — they only
-                    # help under overload).
-                    await self.cache.put(lead.cache_key, payload)
+                    # help under overload).  `resumed` is per-delivery
+                    # provenance (THIS response rode a restart), never
+                    # cache content.
+                    await self.cache.put(
+                        lead.cache_key,
+                        {
+                            k: v
+                            for k, v in payload.items()
+                            if k != "resumed"
+                        },
+                    )
                 for req in groups[lead.cache_key]:
                     if not req.future.done():
                         out = dict(payload)
@@ -807,6 +816,13 @@ class RequestBatcher:
             metrics.GENERATED_TOKENS.inc(result.num_tokens)
         if result.prompt_tokens:
             metrics.PROMPT_TOKENS.inc(result.prompt_tokens)
+        if m.pop("resumed", 0):
+            # the engine checkpointed & replayed this generation across
+            # a restart/failover: lift the marker to a typed response
+            # flag (like `cached`) — and strip it from the metrics dict
+            # so a later ResultCache hit of this payload doesn't claim
+            # a restart that never touched the cached reader
+            out["resumed"] = True
         out["request_id"] = req.request_id
         return out
 

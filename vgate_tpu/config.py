@@ -306,6 +306,25 @@ class RecoveryConfig(BaseModel):
     # quarantined as suspected poison (rejected at submission with a 400
     # so it cannot crash the next incarnation).
     poison_threshold: int = 2
+    # In-flight request survival: on any supervised restart (fatal,
+    # poison sweep, or watchdog trip) checkpoint every live sequence's
+    # resumable state and replay it into the rebuilt engine as a
+    # prefill-continue (prompt + partial generation), so clients see a
+    # latency blip instead of a 503.  Deadlines stay anchored to the
+    # original budget; quarantined fingerprints are excluded.
+    resume_in_flight: bool = True
+    # A sequence checkpointed across more than this many restarts is
+    # given up on (typed retryable 503) instead of replaying forever.
+    max_resume_attempts: int = 3
+    # Hang watchdog: the engine loop heartbeats around every dispatch/
+    # readback; a beat older than step_stall_s is classified as an
+    # EngineStalledError and fed through the supervisor path (stall →
+    # checkpoint → rebuild → replay).  0 disables the watchdog.
+    step_stall_s: float = 120.0
+    # First-compile of a program variant can legitimately pause the
+    # loop for minutes (XLA/Mosaic); beats carrying compiling=True get
+    # this grace instead of step_stall_s.
+    compile_grace_s: float = 900.0
 
 
 class LifecycleConfig(BaseModel):

@@ -83,6 +83,40 @@ class EngineRecoveringError(RetryableError):
     reason = "recovering"
 
 
+class EngineStalledError(RuntimeError):
+    """The engine loop stopped heartbeating: a decode/prefill dispatch
+    (or its readback) has been stuck past ``recovery.step_stall_s`` —
+    the wedged-engine failure mode (Mosaic hang, stuck TPU grant) that
+    a crash-only supervisor never sees, because nothing ever *raises*.
+    Declared by the watchdog (supervisor / dp repair thread) OFF the
+    engine thread; ``fault_kind`` classifies it transient so the
+    existing supervised path applies: stall → checkpoint → rebuild →
+    replay."""
+
+    fault_kind = "transient"
+
+    def __init__(
+        self,
+        message: str,
+        stalled_s: float = 0.0,
+        phase: str = "unknown",
+    ) -> None:
+        super().__init__(message)
+        self.stalled_s = stalled_s
+        self.phase = phase
+
+
+class ResumeExhaustedError(RetryableError):
+    """This request's in-flight generation was checkpointed across
+    ``recovery.max_resume_attempts`` engine restarts and still never
+    finished — replaying it again is more likely to be the *cause* of
+    the crashes than their victim, so the supervisor gives up on it
+    with a retryable 503 (the client may resend; the poison quarantine
+    catches true repeat offenders by fingerprint)."""
+
+    reason = "recovering"
+
+
 class EngineDeadError(RetryableError):
     """The engine exhausted its restart budget (or hit an unrecoverable
     fault) and will not come back in this process.  Still retryable from

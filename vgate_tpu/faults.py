@@ -17,6 +17,11 @@ Probed sites (each calls :func:`check` with the point name):
 ``weight_load``     runtime.weights.load_or_init_params
 ``kv_alloc``        runtime.kv_cache.PageAllocator.allocate
 ``backend_generate``  backends.jax_backend generate entry points
+``stall``           engine_core tick, probed only while work is resident
+                    — arm with ``mode="delay"`` and a ``delay_s`` past
+                    ``recovery.step_stall_s`` to simulate a wedged loop
+                    (stuck decode step / Mosaic hang) for the hang
+                    watchdog; ``raise`` mode is a plain tick crash
 ==================  ====================================================
 
 Arming — programmatic (tests)::
@@ -61,6 +66,7 @@ FAULT_POINTS = (
     "weight_load",
     "kv_alloc",
     "backend_generate",
+    "stall",
 )
 
 FAULT_KINDS = ("transient", "poison", "unrecoverable")

@@ -197,6 +197,38 @@ FAULTS_INJECTED = _safe_metric(
     labelnames=("point", "mode"),
 )
 
+# --- in-flight request survival: checkpoint/replay, stall watchdog, dp failover ---
+RESUMED_SEQUENCES = _safe_metric(
+    Counter,
+    "vgt_resumed_sequences",
+    "In-flight sequences checkpointed across an engine restart/failover "
+    "and replayed to completion instead of failing with a 503",
+)
+LOST_SEQUENCES = _safe_metric(
+    Counter,
+    "vgt_lost_sequences",
+    "Checkpointed in-flight sequences that could NOT be replayed, "
+    "by reason",
+    # quarantined | max_attempts | resubmit_failed | no_replica | shutdown
+    labelnames=("reason",),
+)
+ENGINE_STALLS = _safe_metric(
+    Counter,
+    "vgt_engine_stalls",
+    "Wedged-engine detections by the hang watchdog (heartbeat stale "
+    "past recovery.step_stall_s; compile-aware)",
+)
+DP_REPLICAS_ALIVE = _safe_metric(
+    Gauge,
+    "vgt_dp_replicas_alive",
+    "Data-parallel replica engines currently able to serve",
+)
+DP_REPLICAS_TOTAL = _safe_metric(
+    Gauge,
+    "vgt_dp_replicas_total",
+    "Configured data-parallel replica engines (tpu.dp)",
+)
+
 # --- request lifecycle: deadlines, cancellation, graceful drain ---
 CANCELLED_REQUESTS = _safe_metric(
     Counter,

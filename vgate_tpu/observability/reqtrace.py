@@ -140,6 +140,23 @@ class RequestTrace:
         self.end("prefill", preempted=True)
         self.start("queue", preempted=True)
 
+    def resumed(self) -> None:
+        """Engine crash/stall checkpoint: the sequence survives into a
+        rebuilt (or surviving dp) engine — close the active compute
+        phase and re-enter queue, like preemption, so the span tree
+        shows the restart gap truthfully instead of one decode span
+        silently spanning two engine incarnations."""
+        if not self._emit:
+            return
+        self.event("engine_restart")
+        if "queue" in self._open:
+            # checkpointed while still WAITING: the queue span simply
+            # keeps running across the restart
+            return
+        self.end("decode", resumed=True)
+        self.end("prefill", resumed=True)
+        self.start("queue", resumed=True)
+
     def close(self, error: Optional[BaseException] = None) -> None:
         """Settle: end every open phase span.  Idempotent; later
         detokenize spans may still be emitted."""

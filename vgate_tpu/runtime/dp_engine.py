@@ -12,21 +12,48 @@ at all — vLLM hides replica management behind external orchestration).
 ``ReplicatedEngine`` exposes the same surface the backend drives on
 ``EngineCore`` (submit/generate/warmup/stats/health), so ``dp=1`` and
 ``dp>1`` are interchangeable behind ``JaxTPUBackend``.
-"""
+
+**Replica failover** (recovery.enabled): a replica whose engine died —
+fatal crash OR a watchdog-declared stall (the repair thread classifies
+each replica's heartbeat like the dp=1 supervisor does) — has its
+checkpointed in-flight sequences redistributed to surviving replicas
+(recovery.resume_in_flight), so clients see a latency blip instead of
+losing every resident request with the replica.  The repair thread then
+rebuilds the dead replica in place (weights kept, capped backoff, the
+recovery.* restart budget shared across replicas) and ``/health``
+reports per-replica state: DEGRADED while n_alive < dp, SERVING once
+recovery restores the full complement, DEAD only when no replica can
+serve."""
 
 from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
 
 import jax
 
+from vgate_tpu import faults, metrics
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.errors import (
+    EngineRecoveringError,
+    EngineStalledError,
+    PoisonRequestError,
+)
 from vgate_tpu.logging_config import get_logger
-from vgate_tpu.runtime.engine_core import EngineCore
+from vgate_tpu.runtime.engine_core import (
+    EngineCore,
+    rebuild_core,
+    replay_into,
+)
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+from vgate_tpu.runtime.supervisor import (
+    HealthState,
+    classify_fatal,
+    classify_heartbeat,
+)
 
 logger = get_logger(__name__)
 
@@ -111,12 +138,52 @@ class ReplicatedEngine:
         replica_cfg = self.config.model_copy(deep=True)
         replica_cfg.tpu.dp = 1
         replica_cfg.tpu.num_devices = per
+        self._replica_cfg = replica_cfg
+        self._device_slices = [
+            devices[i * per : (i + 1) * per] for i in range(dp)
+        ]
         self.replicas: List[EngineCore] = [
-            EngineCore(replica_cfg, devices=devices[i * per : (i + 1) * per])
+            EngineCore(replica_cfg, devices=self._device_slices[i])
             for i in range(dp)
         ]
         self._rr = itertools.count()
         self._route_lock = threading.Lock()
+        # ---- replica failover / repair (recovery.enabled) ----
+        self._recovery = self.config.recovery
+        self._failover_enabled = bool(self._recovery.enabled)
+        self._stopping = False
+        self._repair_event = threading.Event()
+        self._repair_thread: Optional[threading.Thread] = None
+        # rebuild backoff: replica idx -> next attempt monotonic time;
+        # the restart budget window is SHARED across replicas (a pod
+        # crash-looping any subset of its replicas is one sick pod)
+        self._next_attempt: Dict[int, float] = {}
+        self._restart_times: List[float] = []
+        # replicas with a rebuild thread in flight: EngineCore
+        # construction takes tens of seconds on real hardware, and
+        # running it inline in _sweep would block stall detection and
+        # failover for every OTHER replica that long.  stop() joins
+        # these before stopping replicas, or a rebuild finishing after
+        # shutdown would start() an engine nothing owns.
+        self._rebuilding: set = set()
+        self._rebuild_threads: Dict[int, threading.Thread] = {}
+        # poison quarantine, pod-wide (the dp=1 supervisor's, minus the
+        # repeat-offender streak — max_resume_attempts bounds replays
+        # here): a fingerprint a poison-classified replica fatal names
+        # (or its residents, when unnamed) is excluded from failover
+        # redistribution AND rejected at submission, so one
+        # crash-inducing request cannot serially kill healthy replicas
+        self._quarantine: set = set()
+        self.total_failovers = 0
+        self.total_restarts = 0
+        self.total_stalls = 0
+        self.total_resumed = 0
+        self.total_lost = 0
+        if self._failover_enabled:
+            for i, core in enumerate(self.replicas):
+                self._attach(i, core)
+        metrics.DP_REPLICAS_TOTAL.set(dp)
+        metrics.DP_REPLICAS_ALIVE.set(dp)
         # /debug surface parity with dp=1: one merged recorder view
         self.flight = _MergedFlight(self.replicas)
         # convenience aliases: identical across replicas
@@ -142,10 +209,297 @@ class ReplicatedEngine:
     def start(self) -> None:
         for core in self.replicas:
             core.start()
+        if self._failover_enabled and self._repair_thread is None:
+            self._repair_thread = threading.Thread(
+                target=self._repair_loop,
+                name="vgt-dp-repair",
+                daemon=True,
+            )
+            self._repair_thread.start()
 
     def stop(self) -> None:
+        self._stopping = True
+        self._repair_event.set()
+        if self._repair_thread is not None:
+            self._repair_thread.join(timeout=30)
+            self._repair_thread = None
+        # settle in-flight rebuilds BEFORE stopping replicas: a rebuild
+        # finishing after the sweep below would start() a fresh engine
+        # (and its HBM KV pool) that nothing ever stops
+        for thread in list(self._rebuild_threads.values()):
+            thread.join(timeout=30)
         for core in self.replicas:
             core.stop()
+
+    # --------------------------------------------------- failover / repair
+
+    def _attach(self, idx: int, core: EngineCore) -> None:
+        # on_fatal makes the core CHECKPOINT its residents at a fatal
+        # (resume_in_flight) instead of failing them raw — the repair
+        # thread redistributes them to surviving replicas.  The hook
+        # runs on the dying replica's engine thread (or the repair
+        # thread itself for watchdog stalls), so it only signals.
+        core.on_fatal = lambda exc, i=idx: self._on_replica_fatal(i, exc)
+
+    def _on_replica_fatal(self, idx: int, exc: BaseException) -> None:
+        logger.error(
+            "dp replica engine fatal",
+            extra={
+                "extra_data": {
+                    "replica": idx,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            },
+        )
+        self._repair_event.set()
+
+    def _repair_loop(self) -> None:
+        while not self._stopping:
+            self._repair_event.wait(timeout=0.25)
+            self._repair_event.clear()
+            if self._stopping:
+                return
+            try:
+                self._sweep()
+            except Exception:  # pragma: no cover - defensive
+                logger.error("dp repair sweep failed", exc_info=True)
+
+    def _sweep(self) -> None:
+        """One repair pass: declare stalled replicas (hang watchdog,
+        same heartbeat classification as the dp=1 supervisor),
+        redistribute dead replicas' checkpointed residents to
+        survivors, and rebuild dead replicas once their backoff is
+        due."""
+        rec = self._recovery
+        for i in range(len(self.replicas)):
+            # fresh clock per replica: heartbeat verdicts and backoff
+            # stamps must not age by however long earlier replicas'
+            # handling took
+            now = time.monotonic()
+            core = self.replicas[i]
+            if i in self._rebuilding:
+                continue  # a rebuild thread owns this slot
+            if core._fatal is None:
+                if core._running and rec.step_stall_s > 0:
+                    verdict = classify_heartbeat(
+                        getattr(core, "_heartbeat", None),
+                        now,
+                        rec.step_stall_s,
+                        rec.compile_grace_s,
+                    )
+                    if verdict is not None:
+                        exc = EngineStalledError(
+                            f"dp replica {i} heartbeat stale for "
+                            f"{verdict['stalled_s']:.1f}s (limit "
+                            f"{verdict['limit_s']:.1f}s) at phase "
+                            f"{verdict['phase']!r}",
+                            stalled_s=verdict["stalled_s"],
+                            phase=verdict["phase"],
+                        )
+                        logger.error(
+                            "dp replica stall detected",
+                            extra={
+                                "extra_data": {
+                                    "replica": i, **verdict,
+                                }
+                            },
+                        )
+                        if core.declare_stalled(exc):
+                            self.total_stalls += 1
+                            metrics.ENGINE_STALLS.inc()
+                continue
+            if not core._containment_done:
+                # _fatal publishes before the checkpoint sweep
+                # finishes: acting now would take an empty checkpoint
+                # and the rebuild's old.stop() would then claim the
+                # late-published sequences as shutdown-lost.  Skip this
+                # pass; containment's final act is on_fatal, which
+                # re-fires the repair event (no spin).
+                continue
+            # dead replica: classify for the poison quarantine, move
+            # its checkpointed residents (they complete on survivors
+            # while the rebuild happens), then rebuild when the
+            # backoff comes due
+            self._update_quarantine(core)
+            pending = core.take_checkpointed()
+            self.total_lost += core.take_resume_losses()
+            if pending:
+                self._redistribute(i, pending)
+            self._maybe_rebuild(i, now)
+        metrics.DP_REPLICAS_ALIVE.set(
+            sum(1 for c in self.replicas if self._alive(c))
+        )
+
+    def _update_quarantine(self, core: EngineCore) -> None:
+        """Quarantine what a poison-classified replica fatal implicates
+        (idempotent per fatal — the fingerprint set dedupes): the named
+        victim when the fault carries one, every resident otherwise —
+        the dp=1 supervisor's poison path, minus the repeat-offender
+        streak (max_resume_attempts bounds automatic replays here)."""
+        exc = core._fatal
+        if exc is None or classify_fatal(exc) != "poison":
+            return
+        named = getattr(exc, "fingerprint", None)
+        suspects = (
+            [named] if named else [fp for fp, _ in core._fatal_suspects]
+        )
+        for fp in suspects:
+            if fp and fp not in self._quarantine:
+                self._quarantine.add(fp)
+                metrics.QUARANTINED_REQUESTS.inc()
+                logger.error(
+                    "request quarantined as dp replica poison",
+                    extra={"extra_data": {"fingerprint": fp}},
+                )
+
+    def _redistribute(
+        self, dead_idx: int, pending: List[Sequence]
+    ) -> None:
+        """Failover: hand a dead replica's checkpointed sequences to the
+        least-loaded SURVIVING replicas (prepare_resume already folded
+        each partial generation, so they re-admit as prefill-continues
+        with their original deadlines).  Quarantined fingerprints are
+        excluded (replay_into) — replaying the request that killed this
+        replica would serially kill the survivors.  With no survivor
+        the client gets the retryable 503 — the rebuild path cannot be
+        waited on without holding futures hostage to a possibly-
+        exhausted budget."""
+        moved = 0
+        # submissions land in the target's _submit_q, which _load
+        # cannot see until its engine thread drains it — account for
+        # them here or every sequence would pile onto the same
+        # "least-loaded" survivor
+        extra: Dict[int, int] = {}
+        for seq in pending:
+            alive = [
+                c for c in self.replicas
+                if self._alive(c) and c is not self.replicas[dead_idx]
+            ]
+            if not alive:
+                self.total_lost += 1
+                metrics.LOST_SEQUENCES.labels(reason="no_replica").inc()
+                seq.fail(
+                    EngineRecoveringError(
+                        "every dp replica is down; retry shortly",
+                        retry_after=self.retry_after_s,
+                    )
+                )
+                continue
+            target = min(
+                alive,
+                key=lambda c: self._load(c) + extra.get(id(c), 0),
+            )
+            outcome = replay_into(
+                target, seq, self._quarantine,
+                retry_after=self.retry_after_s,
+                from_replica=dead_idx,
+            )
+            if outcome != "replayed":
+                self.total_lost += 1
+                continue
+            extra[id(target)] = extra.get(id(target), 0) + 1
+            moved += 1
+            self.total_resumed += 1
+        if moved:
+            self.total_failovers += 1
+            logger.warning(
+                "dp failover: redistributed dead replica's residents",
+                extra={
+                    "extra_data": {
+                        "replica": dead_idx,
+                        "checkpointed": len(pending),
+                        "moved": moved,
+                    }
+                },
+            )
+
+    def _backoff(self) -> float:
+        """Capped exponential backoff from the shared restart history —
+        the one formula behind rebuild scheduling AND the Retry-After
+        hint (retry_after_s), so they cannot diverge."""
+        rec = self._recovery
+        return min(
+            rec.backoff_cap_s,
+            rec.backoff_base_s * (2 ** len(self._restart_times)),
+        )
+
+    def _maybe_rebuild(self, idx: int, now: float) -> None:
+        rec = self._recovery
+        self._restart_times = [
+            t for t in self._restart_times
+            if now - t < rec.restart_window_s
+        ]
+        if len(self._restart_times) >= rec.max_restarts:
+            return  # budget exhausted; retried once the window slides
+        due = self._next_attempt.get(idx)
+        if due is None:
+            # first detection: schedule the rebuild after backoff
+            self._next_attempt[idx] = now + self._backoff()
+            self._repair_event.set()  # re-sweep promptly
+            return
+        if now < due:
+            return
+        self._restart_times.append(now)
+        # rebuild OFF the sweep thread: construction blocks for tens of
+        # seconds on real hardware (KV-pool sizing, mesh setup —
+        # potentially minutes when the device itself is sick), and the
+        # single repair thread must keep watching the OTHER replicas'
+        # heartbeats and failovers meanwhile.  _rebuilding guards the
+        # slot; the checkpoint was already redistributed above.
+        self._rebuilding.add(idx)
+        thread = threading.Thread(
+            target=self._do_rebuild,
+            args=(idx,),
+            name=f"vgt-dp-rebuild-{idx}",
+            daemon=True,
+        )
+        self._rebuild_threads[idx] = thread
+        thread.start()
+
+    def _do_rebuild(self, idx: int) -> None:
+        try:
+            try:
+                # shared teardown/rebuild sequence (engine_core.
+                # rebuild_core): stop, free the dead incarnation's
+                # device KV pool before the new one sizes, weights
+                # kept, brownout spec-suspension carried over
+                new_core = rebuild_core(
+                    self.replicas[idx],
+                    self._replica_cfg,
+                    self._device_slices[idx],
+                )
+            except Exception:
+                logger.error(
+                    "dp replica rebuild attempt failed",
+                    extra={"extra_data": {"replica": idx}},
+                    exc_info=True,
+                )
+                self._next_attempt[idx] = (
+                    time.monotonic() + self._backoff()
+                )
+                return
+            self._attach(idx, new_core)
+            self.replicas[idx] = new_core
+            self._next_attempt.pop(idx, None)
+            if self._stopping:
+                new_core.stop()
+                return
+            new_core.start()
+            if self._stopping:
+                # stop() raced the start (its join timed out): never
+                # leave an engine running that shutdown already swept
+                new_core.stop()
+                return
+            self.total_restarts += 1
+            metrics.ENGINE_RESTARTS.inc()
+            logger.warning(
+                "dp replica rebuilt",
+                extra={"extra_data": {"replica": idx}},
+            )
+        finally:
+            self._rebuilding.discard(idx)
+            self._rebuild_threads.pop(idx, None)
+            self._repair_event.set()  # re-sweep with the fresh state
 
     def abort_in_flight(self, reason: str = "drain") -> None:
         """Graceful-drain straggler sweep: fan the abort out to every
@@ -183,6 +537,85 @@ class ReplicatedEngine:
         if ratios:
             out["kv_free_ratio"] = min(ratios)
         return out
+
+    # ----------------------------------------------------------- health
+
+    @property
+    def state(self) -> HealthState:
+        """Pod-level health: SERVING with the full replica complement,
+        DEGRADED while any replica is down (survivors still serve —
+        readiness stays green), DEAD only when no replica can accept
+        work (liveness then recycles the pod)."""
+        alive = sum(1 for c in self.replicas if self._alive(c))
+        if alive == 0:
+            return HealthState.DEAD
+        if alive < len(self.replicas):
+            return HealthState.DEGRADED
+        return HealthState.SERVING
+
+    def _replica_state(self, idx: int, now: float) -> str:
+        core = self.replicas[idx]
+        if self._alive(core):
+            return "serving"
+        if not self._failover_enabled:
+            return "dead"
+        window = [
+            t for t in self._restart_times
+            if now - t < self._recovery.restart_window_s
+        ]
+        if len(window) >= self._recovery.max_restarts:
+            return "dead"  # budget exhausted until the window slides
+        return "recovering"
+
+    def health(self) -> Dict[str, Any]:
+        """The /health engine block for dp>1 pods: pod state machine
+        position plus per-replica detail (state, last fatal, queue
+        depth) so operators see WHICH replica is out, not just that
+        one is."""
+        from vgate_tpu.errors import state_is_alive, state_is_ready
+
+        now = time.monotonic()
+        state = self.state
+        replicas = []
+        for i, core in enumerate(self.replicas):
+            entry: Dict[str, Any] = {
+                "replica": i,
+                "state": self._replica_state(i, now),
+            }
+            fatal = core._fatal
+            if fatal is not None:
+                entry["last_fatal"] = (
+                    f"{type(fatal).__name__}: {fatal}"
+                )
+            try:
+                sched = core.scheduler.get_stats()
+                entry["queue_depth"] = sched["waiting"]
+                entry["running"] = sched["running"]
+            except Exception:  # pragma: no cover - mid-rebuild
+                pass
+            replicas.append(entry)
+        alive = sum(1 for r in replicas if r["state"] == "serving")
+        metrics.DP_REPLICAS_ALIVE.set(alive)
+        return {
+            "state": state.value,
+            "alive": state_is_alive(state.value),
+            "ready": state_is_ready(state.value),
+            "dp": len(self.replicas),
+            "replicas_alive": alive,
+            "replicas": replicas,
+            "failovers": self.total_failovers,
+            "restarts": self.total_restarts,
+            "stalls": self.total_stalls,
+            "resumed": self.total_resumed,
+            "lost": self.total_lost,
+            "quarantined": len(self._quarantine),
+        }
+
+    @property
+    def retry_after_s(self) -> float:
+        """Client backoff suggestion while degraded (the batcher reads
+        this off the backend core for its 503s, like the supervisor's)."""
+        return max(1.0, self._backoff())
 
     # ------------------------------------------------------------ routing
 
@@ -236,6 +669,20 @@ class ReplicatedEngine:
                     return sticky
             return best
 
+    def _gate(self, prompt_ids: List[int]) -> None:
+        """Reject quarantined prompts at the door (the supervisor's
+        gate, pod-wide): a request a poison-classified replica fatal
+        implicated must not be given a fresh replica to kill.  Steady
+        state (empty quarantine) skips the O(prompt) fingerprint."""
+        if not self._quarantine:
+            return
+        fp = faults.fingerprint(prompt_ids)
+        if fp in self._quarantine:
+            raise PoisonRequestError(
+                f"request {fp} is quarantined: a poison fault on a dp "
+                "replica named it and it will not be admitted again"
+            )
+
     def submit_tokens(
         self,
         prompt_ids: List[int],
@@ -243,7 +690,9 @@ class ReplicatedEngine:
         stream_cb: Optional[Callable[[int], Any]] = None,
         meta: Optional[Any] = None,
     ) -> Sequence:
-        return self._pick_replica(list(prompt_ids)).submit_tokens(
+        ids = list(prompt_ids)
+        self._gate(ids)
+        return self._pick_replica(ids).submit_tokens(
             prompt_ids, params, stream_cb, meta=meta
         )
 
@@ -258,8 +707,10 @@ class ReplicatedEngine:
         max_prompt = self.config.model.max_model_len - 1
         if len(ids) > max_prompt:
             ids = ids[-max_prompt:]
+        ids = ids or [self.tokenizer.bos_id]
+        self._gate(ids)
         return self._pick_replica(ids).submit_tokens(
-            ids or [self.tokenizer.bos_id], params, stream_cb, meta=meta
+            ids, params, stream_cb, meta=meta
         )
 
     def generate(
@@ -287,6 +738,7 @@ class ReplicatedEngine:
                         "ttft": seq.ttft or 0.0,
                         "tpot": seq.tpot or 0.0,
                         "gen_time": gen_time,
+                        **seq.resume_metrics(),
                     },
                 }
             )
@@ -366,6 +818,17 @@ class ReplicatedEngine:
                 }
         agg["model"] = self.spec.name
         agg["dp"] = len(self.replicas)
+        # failover accounting mirrors the dp=1 supervisor block's shape
+        agg["failover"] = {
+            "failovers": self.total_failovers,
+            "restarts": self.total_restarts,
+            "stalls": self.total_stalls,
+            "resumed": self.total_resumed,
+            "lost": self.total_lost,
+            "replicas_alive": sum(
+                1 for c in self.replicas if self._alive(c)
+            ),
+        }
         agg["mesh"] = dict(per_replica[0]["mesh"], dp=len(self.replicas))
         agg["load_time_s"] = round(self.load_time_s, 2)
         agg["replicas"] = per_replica

@@ -41,7 +41,12 @@ import numpy as np
 
 from vgate_tpu import faults, metrics
 from vgate_tpu.backends.base import SamplingParams
-from vgate_tpu.errors import DeadlineExceededError, EngineRecoveringError
+from vgate_tpu.errors import (
+    DeadlineExceededError,
+    EngineRecoveringError,
+    PoisonRequestError,
+    ResumeExhaustedError,
+)
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.logging_config import bound_request, get_logger
 from vgate_tpu.models.decoder import (
@@ -393,6 +398,93 @@ def _spec_verify_step(
     return model_toks, accepted, lp_data, counts, k_pages, v_pages
 
 
+def rebuild_core(
+    old: "EngineCore",
+    config: VGTConfig,
+    devices: Optional[list],
+) -> "EngineCore":
+    """Tear a dead core down and construct its successor — the ONE
+    rebuild sequence both the dp=1 supervisor and the dp repair thread
+    use (a per-core device buffer freed in one copy but not the other
+    would keep the dead incarnation's pool alive and OOM every rebuild
+    on real hardware).  Stops the old core, releases its device KV pool
+    and decode state BEFORE the new pool is sized (auto-sized pools
+    fill most of HBM; the old core stays referenced by its owner until
+    the swap, pinning anything still shared), rebuilds with weights
+    KEPT (the old tree is already quantized/sharded on these devices),
+    and carries the brownout spec-suspension flag so a crash at level
+    >= 3 cannot silently re-enable speculative decoding.  The caller
+    swaps it in, re-attaches on_fatal, and start()s it."""
+    old.stop()
+    old.k_pages = None
+    old.v_pages = None
+    old._dec_state = None
+    old._pending_chunks.clear()
+    old._spec_pen = None
+    new_core = EngineCore(
+        config,
+        spec=old.spec,
+        params=old.params,
+        devices=devices,
+        params_ready=True,
+    )
+    new_core.spec_suspended = bool(
+        getattr(old, "spec_suspended", False)
+    )
+    return new_core
+
+
+def replay_into(
+    core: "EngineCore",
+    seq: Sequence,
+    quarantine: set,
+    retry_after: float = 1.0,
+    **tick_fields: Any,
+) -> str:
+    """Replay ONE checkpointed sequence into ``core`` — the shared
+    per-sequence pipeline behind the supervisor's restart replay and
+    the dp router's failover redistribution (one definition so lost/
+    resumed accounting can never drift between dp=1 and dp>1):
+    quarantined fingerprints fail with the 400 poison error, a refused
+    resubmission fails with the retryable 503, success records the
+    `resume` flight tick and bumps vgt_resumed_sequences.  Returns
+    "replayed" | "quarantined" | "failed"; callers fold the outcome
+    into their own counters."""
+    fp = faults.fingerprint(seq.prompt_ids[: seq.orig_prompt_len])
+    if fp in quarantine:
+        metrics.LOST_SEQUENCES.labels(reason="quarantined").inc()
+        seq.fail(
+            PoisonRequestError(
+                f"request {fp} was quarantined while its generation "
+                "was checkpointed and will not be replayed"
+            )
+        )
+        return "quarantined"
+    try:
+        core.submit_existing(seq)
+    except Exception:
+        logger.error("resume resubmission failed", exc_info=True)
+        metrics.LOST_SEQUENCES.labels(reason="resubmit_failed").inc()
+        seq.fail(
+            EngineRecoveringError(
+                "engine restarted but the checkpointed request could "
+                "not be replayed; retry shortly",
+                retry_after=retry_after,
+            )
+        )
+        return "failed"
+    metrics.RESUMED_SEQUENCES.inc()
+    core.flight.record_tick(
+        "resume",
+        seq_id=seq.seq_id,
+        request_id=seq.request_id,
+        tokens=seq.num_generated,
+        attempt=seq.resume_count,
+        **tick_fields,
+    )
+    return "replayed"
+
+
 class EngineCore:
     """Owns params, KV pages, the mesh and the engine thread."""
 
@@ -623,6 +715,12 @@ class EngineCore:
                     checkpoint_path=self.config.model.draft_checkpoint_path,
                     target_vocab=self.spec.vocab_size,
                     device=self.mesh.devices.flat[0],
+                    # ADVICE r5: a randomly-initialized drafter next to
+                    # a real target checkpoint is a pure slowdown —
+                    # DraftModelDrafter warns loudly on the combination
+                    target_has_checkpoint=bool(
+                        self.config.model.checkpoint_path
+                    ),
                 )
                 self.drafter = self.draft_model.draft_for
             else:
@@ -773,6 +871,56 @@ class EngineCore:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._fatal: Optional[BaseException] = None
+        # hang-watchdog heartbeat: the loop stamps a fresh dict around
+        # every dispatch/readback (whole-dict store — atomic under the
+        # GIL, so the watchdog thread reads a consistent beat without a
+        # lock).  `compiling` beats get recovery.compile_grace_s instead
+        # of step_stall_s before the watchdog declares a stall.
+        self._heartbeat: Dict[str, Any] = {
+            "t": time.monotonic(), "kind": "init", "compiling": True,
+        }
+        # set by declare_stalled(): the engine thread is presumed stuck
+        # in a device call — stop() then joins briefly instead of 30s
+        self._stalled = False
+        # containment entry gate: the watchdog thread and the engine
+        # thread can both reach _contain_fatal (a woken stalled thread
+        # typically raises against the swept state) — only the first
+        # entry may run, or the second would overwrite _checkpointed
+        # and silently drop the in-flight sequences awaiting replay
+        self._contain_lock = threading.Lock()
+        # readback/containment mutual exclusion: every token-append
+        # readback loop holds this, and so does containment's
+        # checkpoint sweep — the status/epoch guards alone are
+        # check-then-append, and a woken stalled thread interleaving
+        # appends with prepare_resume's prompt fold would corrupt the
+        # generation (a token streamed to the client but excluded from
+        # the folded prompt gets regenerated by the replay).
+        # Uncontended in steady state: one acquire per readback.
+        self._readback_lock = threading.Lock()
+        # published at the END of containment (before on_fatal): the dp
+        # repair thread polls _fatal, which is set FIRST — acting on a
+        # mid-containment core would take an empty checkpoint and then
+        # stop() the old core, turning the late-published checkpoint
+        # into shutdown-lost sequences
+        self._containment_done = False
+        # in-flight sequences checkpointed by fatal containment for the
+        # supervisor / dp router to replay (resume_in_flight); consumed
+        # via take_checkpointed()
+        self._checkpointed: List[Sequence] = []
+        # sequences containment gave up on (max_resume_attempts); the
+        # replayer folds this into its lost accounting via
+        # take_resume_losses()
+        self._resume_losses = 0
+        self._resume_enabled = bool(
+            self.config.recovery.resume_in_flight
+        )
+        self._max_resume_attempts = max(
+            0, int(self.config.recovery.max_resume_attempts)
+        )
+        # first-dispatch tracking for spec-verify program variants (the
+        # prefill/decode ladders have their own sets): heartbeat
+        # compile-grace only — spec rounds recompile on width changes
+        self._compiled_spec: set = set()
         # flight snapshot taken on the dying engine thread, while the
         # crashed tick's residents are still live (supervisor reads it)
         self._crash_snapshot: Optional[Dict[str, Any]] = None
@@ -781,9 +929,13 @@ class EngineCore:
         # owed futures fail with a *retryable* error (the supervisor is
         # about to restart the core) instead of the raw fault.
         self.on_fatal: Optional[Callable[[BaseException], None]] = None
-        # prompt fingerprints of the requests resident when the loop died
-        # — the supervisor's poison heuristic counts repeat offenders
-        self._fatal_suspects: List[str] = []
+        # (fingerprint, resume_count) of the requests resident when the
+        # loop died — the supervisor's poison heuristic counts repeat
+        # offenders, but only FRESH submissions (resume_count == 0)
+        # increment a streak: with resume_in_flight, innocent bystanders
+        # ride consecutive crashes by design, and counting replays would
+        # quarantine all traffic after any two rapid crashes
+        self._fatal_suspects: List[tuple] = []
         self.total_steps = 0
         self.total_prefills = 0
         self.total_decode_tokens = 0
@@ -804,13 +956,26 @@ class EngineCore:
         self._running = False
         self._wakeup.set()
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            # a watchdog-declared stall means the thread is presumed
+            # stuck inside a device call: don't hold the rebuild
+            # hostage for 30s waiting on it (it is a daemon; the epoch
+            # checks discard anything it does if it ever wakes)
+            self._thread.join(timeout=1 if self._stalled else 30)
             self._thread = None
         # resolve every owed future: a sequence still resident (or still
         # in the submit queue) when the loop exits would leave its
         # waiter blocked on done_event forever.  Runs after the join, so
-        # no engine thread races these mutations.
-        owed = list(self.scheduler.running) + list(self.scheduler.waiting)
+        # no engine thread races these mutations.  Checkpointed
+        # sequences nobody claimed (supervisor stopped before replay)
+        # are owed too.
+        checkpointed = self.take_checkpointed()
+        for _ in checkpointed:
+            metrics.LOST_SEQUENCES.labels(reason="shutdown").inc()
+        owed = (
+            list(self.scheduler.running)
+            + list(self.scheduler.waiting)
+            + checkpointed
+        )
         while True:
             try:
                 owed.append(self._submit_q.get_nowait())
@@ -883,7 +1048,16 @@ class EngineCore:
         # Re-check after the put: if the engine died between the check
         # above and the put, the fatal handler may already have drained
         # the queue and will never see this seq — fail everything still
-        # queued ourselves so no client hangs on done_event.
+        # queued ourselves so no client hangs on done_event.  NOTE:
+        # several submitter threads can race this drain (and the fatal
+        # handler's own sweep) over the same queue; get_nowait hands
+        # each orphan to exactly one drainer, but the SAME sequence can
+        # still see fail() twice when a submitter drains a sibling the
+        # handler also holds in `doomed` — correctness relies on
+        # Sequence.fail() being idempotent-safe (done_event.set and the
+        # _settle_notified guard make the second call a no-op for the
+        # waiter and the observer; status/error overwrite with an
+        # equivalent terminal value).
         if self._fatal is not None:
             exc = self._fail_exception(self._fatal)
             while True:
@@ -943,6 +1117,7 @@ class EngineCore:
                     "ttft": seq.ttft or 0.0,
                     "tpot": seq.tpot or 0.0,
                     "gen_time": gen_time,
+                    **seq.resume_metrics(),
                 },
             }
             if seq.params.logprobs:
@@ -956,60 +1131,261 @@ class EngineCore:
         logger.info("engine thread started")
         while self._running:
             try:
+                self._beat("tick")
                 if not self._tick():
                     self._wakeup.wait(timeout=0.005)
                     self._wakeup.clear()
             except Exception as exc:
                 logger.error("engine loop fatal error", exc_info=True)
-                # the crash becomes the ring's final tick, so a snapshot
-                # ends with the faulting dispatch; snapshot BEFORE the
-                # containment below fails every owed future — the
-                # in-flight view must show what was resident at the
-                # moment of death, not after the sweep
-                self.flight.record_tick(
-                    "crash",
-                    error=f"{type(exc).__name__}: {exc}",
-                    batch=len(self.scheduler.running),
-                    queue_depth=len(self.scheduler.waiting),
-                )
-                self._crash_snapshot = self.flight.crash_snapshot(exc)
-                self._fatal = exc
-                # poison-heuristic evidence: the requests resident at the
-                # crash (keyed by their ORIGINAL prompt, which survives
-                # preemption's prompt folding)
-                self._fatal_suspects = [
-                    faults.fingerprint(
-                        s.prompt_ids[: s.orig_prompt_len]
-                    )
-                    for s in self.scheduler.running
-                ]
-                # fail EVERY owed future: running, waiting, and anything
-                # still sitting in the submit queue (a client blocked on
-                # one of those would otherwise hang forever)
-                doomed = list(self.scheduler.running) + list(
-                    self.scheduler.waiting
-                )
-                while True:
-                    try:
-                        doomed.append(self._submit_q.get_nowait())
-                    except queue.Empty:
-                        break
-                fail_exc = self._fail_exception(exc)
-                for seq in doomed:
-                    seq.fail(fail_exc)
-                self.scheduler.waiting.clear()
-                for i in range(len(self.scheduler.slots)):
-                    self.scheduler.slots[i] = None
-                self._pending_chunks.clear()
-                self._running = False
-                if self.on_fatal is not None:
-                    try:
-                        self.on_fatal(exc)
-                    except Exception:  # pragma: no cover - defensive
-                        logger.error(
-                            "on_fatal hook failed", exc_info=True
-                        )
+                self._contain_fatal(exc)
         logger.info("engine thread stopped")
+
+    def _beat(self, kind: str, compiling: bool = False, **fields) -> None:
+        """Stamp the watchdog heartbeat (whole-dict store — atomic under
+        the GIL).  Call immediately BEFORE any potentially-blocking
+        device dispatch/readback so a wedge there is exactly what ages
+        the beat; ``compiling`` widens the stall threshold to
+        recovery.compile_grace_s for first-compile pauses."""
+        self._heartbeat = {
+            "t": time.monotonic(),
+            "kind": kind,
+            "compiling": bool(compiling),
+            **fields,
+        }
+
+    def _contain_fatal(self, exc: BaseException) -> bool:
+        """Fatal containment, shared by the engine thread's crash
+        handler and the watchdog's :meth:`declare_stalled`: record the
+        crash tick + flight snapshot, collect poison suspects, then
+        either CHECKPOINT resumable in-flight sequences for the
+        supervisor / dp router to replay (resume_in_flight under
+        supervision) or fail every owed future (the unsupervised
+        containment contract).
+
+        The crash becomes the ring's final tick, so a snapshot ends
+        with the faulting dispatch; the snapshot runs BEFORE the sweep
+        below — the in-flight view must show what was resident at the
+        moment of death, not after.
+
+        First entry only (returns False otherwise): after a watchdog
+        declare_stalled, the stuck engine thread usually wakes into the
+        already-swept state, raises, and lands here AGAIN via the loop's
+        except handler — re-running the sweep would overwrite
+        _checkpointed (dropping the sequences awaiting replay) and fire
+        a duplicate on_fatal."""
+        with self._contain_lock:
+            if self._fatal is not None:
+                logger.warning(
+                    "fatal containment skipped: engine already "
+                    "contained",
+                    extra={
+                        "extra_data": {
+                            "error": f"{type(exc).__name__}: {exc}",
+                            "first": (
+                                f"{type(self._fatal).__name__}: "
+                                f"{self._fatal}"
+                            ),
+                        }
+                    },
+                )
+                return False
+            self._fatal = exc
+        try:
+            self._contain_body(exc)
+        except Exception:  # pragma: no cover - defensive
+            # containment itself failing must NOT strand the system:
+            # _fatal is already set, so if _containment_done never
+            # published, the supervisor would stay SERVING with hung
+            # clients and the dp sweep would skip this replica forever.
+            # Swallow (we are already dying of `exc`), log loudly, and
+            # fall through so the flag + on_fatal always run.
+            logger.error(
+                "fatal containment raised; proceeding to publication "
+                "with a possibly partial sweep",
+                exc_info=True,
+            )
+            self._running = False
+        # published before on_fatal: when the dp repair thread (or the
+        # supervisor) wakes on the hook, the checkpoint is complete
+        self._containment_done = True
+        if self.on_fatal is not None:
+            try:
+                self.on_fatal(exc)
+            except Exception:  # pragma: no cover - defensive
+                logger.error("on_fatal hook failed", exc_info=True)
+        return True
+
+    def _contain_body(self, exc: BaseException) -> None:
+        """The containment work itself (snapshot, suspects, sweep) —
+        split from :meth:`_contain_fatal` so the caller can guarantee
+        `_containment_done` + `on_fatal` publication even if any of
+        this raises."""
+        self.flight.record_tick(
+            "crash",
+            error=f"{type(exc).__name__}: {exc}",
+            batch=len(self.scheduler.running),
+            queue_depth=len(self.scheduler.waiting),
+        )
+        self._crash_snapshot = self.flight.crash_snapshot(exc)
+        # poison-heuristic evidence: the requests resident at the
+        # crash (keyed by their ORIGINAL prompt, which survives
+        # preemption's and resume's prompt folding), with the resume
+        # attempt count so the supervisor can tell client persistence
+        # (fresh submissions) from the engine's own replays
+        self._fatal_suspects = [
+            (
+                faults.fingerprint(s.prompt_ids[: s.orig_prompt_len]),
+                s.resume_count,
+            )
+            for s in self.scheduler.running
+        ]
+        # sweep EVERY owed future: running, waiting, and anything still
+        # sitting in the submit queue (a client blocked on one of those
+        # would otherwise hang forever).  Under supervision with
+        # resume_in_flight, resumable sequences are checkpointed as
+        # prefill-continues instead of failed — the supervisor replays
+        # them into the rebuilt core and clients see a latency blip,
+        # not a 503.
+        checkpointing = (
+            self._resume_enabled and self.on_fatal is not None
+        )
+        fail_exc: Optional[BaseException] = None
+        kept: List[Sequence] = []
+        # the sweep excludes token-append readbacks (see
+        # _readback_lock): a woken stalled thread must observe either
+        # pre-fold state (its epoch check passes, containment waits) or
+        # fully-folded state (epoch bumped, it skips) — never a fold in
+        # progress.  BOUNDED acquire, fail-open: the append sections
+        # run stream_cb/settle callbacks, and a wedge *there* is
+        # precisely a stall — blocking the watchdog thread on it
+        # forever would wedge the monitor itself (no rebuild, no
+        # further stall detection).  Proceeding without the lock risks
+        # only the narrow interleaving the lock exists for; a wedged
+        # monitor loses everything.
+        locked = self._readback_lock.acquire(timeout=5.0)
+        if not locked:
+            logger.error(
+                "containment proceeding WITHOUT the readback lock "
+                "(append section appears wedged — likely a stuck "
+                "stream callback); sequences mid-append may replay "
+                "with a duplicated token"
+            )
+        try:
+            doomed = list(self.scheduler.running) + list(
+                self.scheduler.waiting
+            )
+            while True:
+                try:
+                    doomed.append(self._submit_q.get_nowait())
+                except queue.Empty:
+                    break
+            for seq in doomed:
+                if checkpointing and not seq.abort_requested:
+                    if seq.resume_count >= self._max_resume_attempts:
+                        # replaying a request that has now ridden
+                        # through max_resume_attempts restarts is more
+                        # likely the crashes' cause than their victim:
+                        # typed 503
+                        metrics.LOST_SEQUENCES.labels(
+                            reason="max_attempts"
+                        ).inc()
+                        self._resume_losses += 1
+                        seq.fail(
+                            ResumeExhaustedError(
+                                "request was in flight across "
+                                f"{seq.resume_count} engine restarts "
+                                "and was given up on; retry shortly"
+                            )
+                        )
+                        continue
+                    if seq.trace is not None:
+                        seq.trace.resumed()
+                    seq.prepare_resume()
+                    kept.append(seq)
+                    continue
+                if fail_exc is None:
+                    fail_exc = self._fail_exception(exc)
+                seq.fail(fail_exc)
+            self._checkpointed = kept
+            self.scheduler.waiting.clear()
+            for i in range(len(self.scheduler.slots)):
+                self.scheduler.slots[i] = None
+            self._pending_chunks.clear()
+            self._running = False
+        finally:
+            if locked:
+                self._readback_lock.release()
+
+    def declare_stalled(self, exc: BaseException) -> bool:
+        """Watchdog containment, called OFF the engine thread when the
+        heartbeat went stale: the loop is presumed stuck inside a
+        device call (Mosaic hang, stuck TPU grant, wedged transfer) —
+        nothing will ever *raise*, so the monitor declares the fault.
+        Stops the loop flag first (the stuck thread exits if it ever
+        wakes), then runs the same containment as an on-thread crash.
+        The small window where a merely-slow thread wakes mid-sweep is
+        covered by the preempt-epoch checks on every readback path:
+        checkpointed sequences bumped their epoch, so late tokens are
+        discarded.  Returns False when the engine already died (or
+        stopped) another way."""
+        if self._fatal is not None or not self._running:
+            return False
+        self._stalled = True
+        self._running = False
+        self._wakeup.set()
+        hb = self._heartbeat
+        self.flight.record_tick(
+            "stall",
+            phase=hb.get("kind"),
+            stalled_s=round(time.monotonic() - hb.get("t", 0.0), 3),
+            compiling=hb.get("compiling", False),
+            batch=len(self.scheduler.running),
+            queue_depth=len(self.scheduler.waiting),
+        )
+        # False when an on-thread crash won the containment race — the
+        # caller must not count a stall the engine didn't die of
+        return self._contain_fatal(exc)
+
+    def take_checkpointed(self) -> List[Sequence]:
+        """Hand the fatal-containment checkpoint to its replayer
+        (supervisor restart / dp failover); idempotent-empty after."""
+        out, self._checkpointed = self._checkpointed, []
+        return out
+
+    def take_resume_losses(self) -> int:
+        """Sequences containment gave up on (already failed typed);
+        the replayer folds the count into its lost total.  Zeroing like
+        take_checkpointed so repeated sweeps never double-count."""
+        n, self._resume_losses = self._resume_losses, 0
+        return n
+
+    def submit_existing(self, seq: Sequence) -> None:
+        """Re-admit a checkpointed sequence from another engine
+        incarnation (supervisor replay) or a dead dp replica
+        (failover).  The SAME Sequence object rides in — done_event
+        waiter, stream_cb, cancel-token abort hooks and the absolute
+        deadline all stay valid — re-wired to this core's settle
+        observer, and prefilled-continue on admission (prepare_resume
+        already folded the partial generation into the prompt)."""
+        if self._fatal is not None:
+            raise RuntimeError("engine is dead") from self._fatal
+        seq.on_settle = (
+            self._on_seq_settle if self.flight.enabled else None
+        )
+        self._submit_q.put(seq)
+        # same post-put re-check as submit_tokens: a crash between the
+        # gate and the put may have swept the queue already
+        if self._fatal is not None:
+            exc = self._fail_exception(self._fatal)
+            while True:
+                try:
+                    orphan = self._submit_q.get_nowait()
+                except queue.Empty:
+                    break
+                orphan.fail(exc)
+            if seq.status is SeqStatus.FAILED:
+                raise RuntimeError("engine is dead") from exc
+        self._wakeup.set()
 
     def _tick(self) -> bool:
         """One iteration of the engine loop.
@@ -1026,6 +1402,18 @@ class EngineCore:
         Returns False when there was no work (the loop then sleeps).
         """
         self._drain_submissions()
+        # stall fault probe (vgate_tpu/faults.py): a `delay` armed here
+        # past recovery.step_stall_s simulates a wedged loop for the
+        # hang watchdog.  Only probed while work is resident, so chaos
+        # arming cannot stall an idle engine into a pointless restart.
+        if faults.is_active() and self.scheduler.has_work():
+            faults.check("stall")
+            if not self._running:
+                # the watchdog declared this core stalled while the
+                # armed delay slept: containment already swept the
+                # residents — touching scheduler state now would race
+                # the replay on the rebuilt core
+                return False
         self._drain_abort_requests()
         self._handle_aborts()
         self._handle_deadlines()
@@ -1259,6 +1647,15 @@ class EngineCore:
             plans.append(plan)
         if not plans:
             return False
+        # stale-wake epochs: if a watchdog-declared stall checkpoints
+        # (preempt_count bump) and replays these sequences while this
+        # thread is stuck in the device_get below, the replay may
+        # already be RUNNING again on the NEW core when we wake — a
+        # status check alone would pass, so readback also compares the
+        # epoch captured here (mirrors the chunked-decode path)
+        plan_epochs = {
+            id(plan): plan.seq.preempt_count for plan in plans
+        }
         if self.flight.enabled:
             for plan in plans:
                 seq = plan.seq
@@ -1323,6 +1720,7 @@ class EngineCore:
         for plan in plans:
             for page, h in plan.register_hashes or ():
                 self.allocator.register(page, h)
+        self._beat("prefill_readback", batch=len(plans))
         firsts = jax.device_get([h for _, h in dispatched])  # [(tok, lp)]
         # batched admission costs one combined dispatch+readback; attribute
         # an equal share to each prefill so observation count stays
@@ -1345,27 +1743,44 @@ class EngineCore:
                 queue_depth=len(self.scheduler.waiting),
             )
             arr = np.asarray(tokens)
-            for row, plan in enumerate(group):
-                token = int(arr[row])
-                self.total_prefills += 1
-                if lp is not None and plan.seq.params.logprobs:
-                    self._attach_logprob(plan.seq, lp, 0, row)
-                # a RE-prefill (post-preemption) keeps the original
-                # first_token_t; its phase boundary is NOW, not the
-                # first incarnation's first token
-                fresh_first = plan.seq.first_token_t is None
-                plan.seq.append_token(token)
-                self.flight.on_first_token(plan.seq)
-                tr = plan.seq.trace
-                if tr is not None:
-                    boundary = (
-                        plan.seq.first_token_t
-                        if fresh_first
-                        else time.perf_counter()
-                    )
-                    tr.end("prefill", end_pc=boundary)
-                    tr.start("decode", start_pc=boundary)
-                self._maybe_finish(plan.seq, token)
+            # append under the readback lock (device waits all happened
+            # above): the stale-wake guard is check-then-append, and a
+            # watchdog containment folding these sequences mid-loop
+            # would otherwise interleave with the appends
+            with self._readback_lock:
+                for row, plan in enumerate(group):
+                    # stale-wake guard: a watchdog-declared stall may
+                    # have checkpointed this sequence while the
+                    # readback above was stuck — appending its token
+                    # now would corrupt the replay (which may already
+                    # be RUNNING on the rebuilt core, hence the epoch
+                    # check, not just status)
+                    if (
+                        plan.seq.status is not SeqStatus.RUNNING
+                        or plan.seq.preempt_count
+                        != plan_epochs[id(plan)]
+                    ):
+                        continue
+                    token = int(arr[row])
+                    self.total_prefills += 1
+                    if lp is not None and plan.seq.params.logprobs:
+                        self._attach_logprob(plan.seq, lp, 0, row)
+                    # a RE-prefill (post-preemption) keeps the original
+                    # first_token_t; its phase boundary is NOW, not the
+                    # first incarnation's first token
+                    fresh_first = plan.seq.first_token_t is None
+                    plan.seq.append_token(token)
+                    self.flight.on_first_token(plan.seq)
+                    tr = plan.seq.trace
+                    if tr is not None:
+                        boundary = (
+                            plan.seq.first_token_t
+                            if fresh_first
+                            else time.perf_counter()
+                        )
+                        tr.end("prefill", end_pc=boundary)
+                        tr.start("decode", start_pc=boundary)
+                    self._maybe_finish(plan.seq, token)
         return True
 
     def _penalty_arrays(self, B: int, rows):
@@ -1512,7 +1927,8 @@ class EngineCore:
             None if mt is None else mt_ids.shape[1], num_lp,
             None if lb_ids is None else lb_ids.shape[1],
         )
-        if key not in self._compiled_buckets:
+        fresh = key not in self._compiled_buckets
+        if fresh:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
             self.flight.record_tick(
@@ -1521,6 +1937,7 @@ class EngineCore:
             for plan in plans:
                 if plan.seq.trace is not None:
                     plan.seq.trace.event("xla_compile", bucket=bucket)
+        self._beat("prefill", compiling=fresh, bucket=bucket, batch=B)
         out, self.k_pages, self.v_pages = _prefill_step(
             self.params,
             self.spec,
@@ -1626,7 +2043,8 @@ class EngineCore:
             None if mt is None else mt_ids.shape[1], num_lp,
             None if lb_ids is None else lb_ids.shape[1],
         )
-        if key not in self._compiled_buckets:
+        fresh = key not in self._compiled_buckets
+        if fresh:
             metrics.RECOMPILES.labels(kind="prefill").inc()
             self._compiled_buckets.add(key)
             self.flight.record_tick(
@@ -1636,6 +2054,7 @@ class EngineCore:
             for plan in plans:
                 if plan.seq.trace is not None:
                     plan.seq.trace.event("xla_compile", bucket=bucket)
+        self._beat("prefill", compiling=fresh, bucket=bucket, batch=B)
         out, self.k_pages, self.v_pages = _suffix_prefill_step(
             self.params,
             self.spec,
@@ -1709,9 +2128,13 @@ class EngineCore:
             key = self._suffix_key(
                 chunk, 1, ctx_pages, False, None, 0, None
             )
-            if key not in self._compiled_buckets:
+            fresh = key not in self._compiled_buckets
+            if fresh:
                 metrics.RECOMPILES.labels(kind="prefill").inc()
                 self._compiled_buckets.add(key)
+            self._beat(
+                "prefill_chunk", compiling=fresh, bucket=chunk, batch=1
+            )
             _out, self.k_pages, self.v_pages = _suffix_prefill_step(
                 self.params,
                 self.spec,
@@ -1887,7 +2310,8 @@ class EngineCore:
             if state["bias_ids"] is None
             else state["bias_ids"].shape[1],
         )
-        if chunk_key not in self._compiled_chunks:
+        fresh = chunk_key not in self._compiled_chunks
+        if fresh:
             metrics.RECOMPILES.labels(kind="decode").inc()
             self._compiled_chunks.add(chunk_key)
             self.flight.record_tick(
@@ -1897,6 +2321,9 @@ class EngineCore:
             for seq in active:
                 if seq.trace is not None:
                     seq.trace.event("xla_compile", chunk=chunk)
+        self._beat(
+            "decode", compiling=fresh, chunk=chunk, batch=len(active)
+        )
         start = time.perf_counter()
         (
             chunk_tokens,
@@ -1959,6 +2386,7 @@ class EngineCore:
             # observe only the host-blocking readback time (kind="decode"):
             # dispatch-to-now would double-count deliberate pipeline
             # queueing when more than one chunk is in flight
+            self._beat("decode_readback", chunk=chunk, batch=len(seqs))
             block_start = time.perf_counter()
             sampled = np.asarray(tokens_dev)  # [chunk, B]; blocks
             sampled = faults.corrupt_array("decode_step", sampled)
@@ -1989,22 +2417,27 @@ class EngineCore:
                 kv_free=self.allocator.num_free,
                 queue_depth=len(self.scheduler.waiting),
             )
-            for seq, epoch in seqs:
-                if (
-                    seq.status is not SeqStatus.RUNNING
-                    or seq.preempt_count != epoch
-                ):
-                    continue  # stopped or preempted since dispatch
-                slot = seq.slot
-                for k in range(chunk):
-                    token = int(sampled[k, slot])
-                    if lp_np is not None and seq.params.logprobs:
-                        self._attach_logprob(seq, lp_np, k, slot)
-                    seq.append_token(token)
-                    self.total_decode_tokens += 1
-                    self._maybe_finish(seq, token)
-                    if seq.status is not SeqStatus.RUNNING:
-                        break
+            # append under the readback lock (the blocking np.asarray
+            # is above): see _admit_and_prefill — the epoch guard is
+            # check-then-append, and containment's fold must not
+            # interleave with it
+            with self._readback_lock:
+                for seq, epoch in seqs:
+                    if (
+                        seq.status is not SeqStatus.RUNNING
+                        or seq.preempt_count != epoch
+                    ):
+                        continue  # stopped or preempted since dispatch
+                    slot = seq.slot
+                    for k in range(chunk):
+                        token = int(sampled[k, slot])
+                        if lp_np is not None and seq.params.logprobs:
+                            self._attach_logprob(seq, lp_np, k, slot)
+                        seq.append_token(token)
+                        self.total_decode_tokens += 1
+                        self._maybe_finish(seq, token)
+                        if seq.status is not SeqStatus.RUNNING:
+                            break
             self.total_steps += chunk
             if not drain:
                 break
@@ -2044,6 +2477,16 @@ class EngineCore:
             return True
         B = self.max_slots
         max_len = self.config.model.max_model_len
+        if (
+            self.draft_model is not None
+            and self.draft_model.total_draft_calls == 0
+        ):
+            # the drafter's lazily-jitted scan compiles on its FIRST
+            # call (inside the array-build loop below) — beat with the
+            # compile grace or the watchdog would judge a multi-minute
+            # Mosaic draft compile against step_stall_s and restart-loop
+            # a healthy engine through the same compile until DEAD
+            self._beat("draft", compiling=True)
         tokens = np.zeros((B, S), np.int32)
         positions0 = np.zeros((B,), np.int32)
         input_lens = np.ones((B,), np.int32)
@@ -2136,6 +2579,10 @@ class EngineCore:
         spec_lb = self._spec_mt["lb"]
         spec_lb_vals = self._spec_mt["lb_vals"]
         faults.check("decode_step")
+        # stale-wake epochs for the readback loop below (the verify
+        # call + np.asarray block this thread; a stall declared there
+        # may checkpoint + replay these sequences)
+        spec_epochs = {s.seq_id: s.preempt_count for s in active}
         start = time.perf_counter()
         num_lp = (
             LOGPROBS_K
@@ -2143,6 +2590,14 @@ class EngineCore:
             else 0
         )
         all_greedy = self._all_greedy(active, num_lp)
+        spec_key = (S_round, width, num_lp, all_greedy, want_pen)
+        self._beat(
+            "spec_verify",
+            compiling=spec_key not in self._compiled_spec,
+            chunk=S_round,
+            batch=len(active),
+        )
+        self._compiled_spec.add(spec_key)
         (
             model_toks, accepted, lp_data, counts_out,
             self.k_pages, self.v_pages,
@@ -2220,23 +2675,34 @@ class EngineCore:
             kv_free=self.allocator.num_free,
             queue_depth=len(self.scheduler.waiting),
         )
-        for seq in active:
-            if seq.status is not SeqStatus.RUNNING:
-                continue
-            slot = seq.slot
-            self.total_spec_drafted += int(input_lens[slot]) - 1
-            self.total_spec_accepted += int(acc_np[slot])
-            # model_toks[:, j] for j < accepted IS draft j+1; position
-            # `accepted` holds the bonus token — one loop covers both
-            for j in range(int(acc_np[slot]) + 1):
-                token = int(toks_np[slot, j])
-                if lp_np is not None and seq.params.logprobs:
-                    self._attach_logprob(seq, lp_np, j, slot)
-                seq.append_token(token)
-                self.total_decode_tokens += 1
-                self._maybe_finish(seq, token)
-                if seq.status is not SeqStatus.RUNNING:
-                    break
+        # append under the readback lock (device waits all happened
+        # above): see _admit_and_prefill for the interleaving hazard
+        with self._readback_lock:
+            for seq in active:
+                # stale-wake guard (see _admit_and_prefill): status AND
+                # the epoch captured at dispatch — a watchdog stall
+                # during the blocking readback above may have
+                # checkpointed + replayed this sequence already
+                if (
+                    seq.status is not SeqStatus.RUNNING
+                    or seq.preempt_count != spec_epochs[seq.seq_id]
+                ):
+                    continue
+                slot = seq.slot
+                self.total_spec_drafted += int(input_lens[slot]) - 1
+                self.total_spec_accepted += int(acc_np[slot])
+                # model_toks[:, j] for j < accepted IS draft j+1;
+                # position `accepted` holds the bonus token — one loop
+                # covers both
+                for j in range(int(acc_np[slot]) + 1):
+                    token = int(toks_np[slot, j])
+                    if lp_np is not None and seq.params.logprobs:
+                        self._attach_logprob(seq, lp_np, j, slot)
+                    seq.append_token(token)
+                    self.total_decode_tokens += 1
+                    self._maybe_finish(seq, token)
+                    if seq.status is not SeqStatus.RUNNING:
+                        break
         self.total_steps += 1
         return True
 

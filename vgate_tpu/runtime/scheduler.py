@@ -145,7 +145,17 @@ class Scheduler:
     # -- admission --
 
     def add(self, seq: Sequence) -> None:
-        if len(self.waiting) >= self.max_queue_size:
+        if (
+            len(self.waiting) >= self.max_queue_size
+            and seq.resume_count == 0
+        ):
+            # replayed sequences (resume_count > 0: checkpointed across
+            # an engine restart / dp failover) bypass the queue-full
+            # gate — they were ALREADY admitted once and their clients
+            # are still owed an answer; shedding them here would turn a
+            # survivable restart into a 503 exactly when the rebuilt
+            # queue is busiest.  Bounded: at most slots+queue sequences
+            # existed pre-crash, so the overshoot is one queue's worth.
             raise EngineBusyError(
                 f"engine queue full ({self.max_queue_size} waiting)"
             )

@@ -71,6 +71,11 @@ class Sequence:
     # settle path (scheduler sheds included) is covered by one hook
     on_settle: Optional[Callable[["Sequence"], Any]] = None
     _settle_notified: bool = False
+    # engine restarts this sequence was checkpointed across and replayed
+    # into the rebuilt core (crash, poison sweep, or watchdog stall).
+    # recovery.max_resume_attempts caps it; >0 marks the final result
+    # `resumed` so clients can see the latency blip's cause.
+    resume_count: int = 0
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -165,3 +170,131 @@ class Sequence:
         self.slot = None
         self.status = SeqStatus.WAITING
         self.preempt_count += 1
+
+    def checkpoint_summary(self) -> dict:
+        """The loggable fields of :meth:`checkpoint` WITHOUT
+        materializing the token-list copies — containment-path
+        introspection (supervisor last_resume) runs exactly when the
+        process may be dying of memory pressure, and only ever reads
+        counts.  Must mirror SequenceCheckpoint.as_dict (pinned by
+        tests/test_resume.py)."""
+        return {
+            "seq_id": self.seq_id,
+            "request_id": self.request_id,
+            "trace_id": getattr(self.trace, "trace_id", None),
+            "prompt_tokens": self.orig_prompt_len,
+            "generated_tokens": len(self.generated_ids),
+            "resume_count": self.resume_count,
+            "deadline_t": self.deadline_t,
+        }
+
+    def resume_metrics(self) -> dict:
+        """The `resumed` entry for a result's metrics dict (empty when
+        the generation never rode a restart) — one definition for every
+        result-assembly site (engine, supervisor, dp router, backend);
+        the batcher lifts it to the response's `resumed` flag."""
+        if not self.resume_count:
+            return {}
+        return {"resumed": float(self.resume_count)}
+
+    def checkpoint(self) -> "SequenceCheckpoint":
+        """Snapshot this sequence's resumable state (engine crash/stall
+        containment).  Pure data — safe to log, introspect via /stats,
+        or rebuild a sequence from (:meth:`Sequence.from_checkpoint`)."""
+        return SequenceCheckpoint(
+            prompt_ids=list(self.prompt_ids[: self.orig_prompt_len]),
+            generated_ids=list(self.generated_ids),
+            params=self.params,
+            seq_id=self.seq_id,
+            arrival_t=self.arrival_t,
+            deadline_t=self.deadline_t,
+            first_token_t=self.first_token_t,
+            preempt_count=self.preempt_count,
+            resume_count=self.resume_count,
+            request_id=self.request_id,
+            trace_id=getattr(self.trace, "trace_id", None),
+        )
+
+    @classmethod
+    def from_checkpoint(cls, cp: "SequenceCheckpoint") -> "Sequence":
+        """Rebuild a WAITING prefill-continue sequence from a checkpoint:
+        the partial generation folds into the prompt (exactly like
+        preemption's recompute), so after re-prefill decode resumes at
+        the next position.  Delivery plumbing (done_event, stream_cb,
+        on_settle) is fresh — the live replay path mutates the original
+        object via :meth:`prepare_resume` instead, so the client keeps
+        its future; this constructor serves tests and any out-of-process
+        resume."""
+        seq = cls(
+            prompt_ids=list(cp.prompt_ids) + list(cp.generated_ids),
+            params=cp.params,
+            seq_id=cp.seq_id,
+            generated_ids=list(cp.generated_ids),
+            arrival_t=cp.arrival_t,
+            first_token_t=cp.first_token_t,
+            orig_prompt_len=len(cp.prompt_ids),
+            preempt_count=cp.preempt_count,
+            resume_count=cp.resume_count + 1,
+            request_id=cp.request_id,
+        )
+        # absolute deadline survives verbatim: the replay runs on the
+        # request's ORIGINAL budget, not a fresh one
+        seq.deadline_t = cp.deadline_t
+        return seq
+
+    def prepare_resume(self) -> None:
+        """Engine crash/stall checkpoint, live-object form: fold the
+        generation into the prompt (prefill-continue) and return to
+        WAITING so the supervisor / dp router can replay this very
+        object into a rebuilt or surviving engine — every external
+        reference (done_event waiter, stream_cb, cancel-token abort
+        hooks, deadline) stays valid.  The preempt_count bump doubles
+        as the staleness epoch: a stalled engine thread that wakes
+        late discards its readbacks against this sequence."""
+        if self.status is SeqStatus.RUNNING or self.output_ids:
+            self.reset_for_recompute()
+        else:
+            # never admitted (or already folded by preemption): nothing
+            # resident to fold — just make the queue state explicit
+            self.pages = []
+            self.slot = None
+            self.status = SeqStatus.WAITING
+        self.resume_count += 1
+
+
+@dataclass
+class SequenceCheckpoint:
+    """One in-flight sequence's resumable state, snapshotted by fatal
+    containment (crash, poison sweep, watchdog stall) before the engine
+    is torn down.  RNG continuation is implicit: sampling derives from
+    ``(seed, step=num_generated)`` for seeded requests and the engine
+    base key is config-derived, so a restored greedy or seeded sequence
+    continues the identical token stream; unseeded temperature>0
+    requests resume distribution-correct (not token-identical), exactly
+    like a KV-pressure preemption."""
+
+    prompt_ids: List[int]  # the ORIGINAL prompt (pre-fold)
+    generated_ids: List[int]  # everything generated so far
+    params: SamplingParams
+    seq_id: int
+    arrival_t: float
+    deadline_t: Optional[float]  # absolute: the original budget
+    first_token_t: Optional[float]
+    preempt_count: int
+    resume_count: int
+    request_id: Optional[str]
+    trace_id: Optional[str]
+
+    def as_dict(self) -> dict:
+        """Loggable summary (token *counts*, never token content — the
+        prompt may be sensitive; observability.redact_prompts applies
+        to previews elsewhere)."""
+        return {
+            "seq_id": self.seq_id,
+            "request_id": self.request_id,
+            "trace_id": self.trace_id,
+            "prompt_tokens": len(self.prompt_ids),
+            "generated_tokens": len(self.generated_ids),
+            "resume_count": self.resume_count,
+            "deadline_t": self.deadline_t,
+        }

@@ -199,10 +199,33 @@ class DraftModelDrafter:
         checkpoint_path: Optional[str] = None,
         target_vocab: Optional[int] = None,
         device=None,
+        target_has_checkpoint: bool = False,
     ) -> None:
+        from vgate_tpu.logging_config import get_logger
         from vgate_tpu.models.specs import spec_for_model_id
         from vgate_tpu.runtime.weights import load_or_init_params
         from vgate_tpu.utils.math import round_up
+
+        if checkpoint_path is None and target_has_checkpoint:
+            # ADVICE.md round-5 finding: model.draft_model_id with
+            # draft_checkpoint_path unset next to a REAL target
+            # checkpoint means the drafter runs on random init — its
+            # proposals are noise, acceptance lands near 0%, and every
+            # verify round is pure overhead over plain decode.  Loud by
+            # design: this config is always a mistake in serving (only
+            # synthetic benchmarks exercise random/random pairs, and
+            # there the target is random too, so this never fires).
+            get_logger(__name__).warning(
+                "draft model %r has NO checkpoint "
+                "(model.draft_checkpoint_path is unset) while the "
+                "target model loads real weights: the randomly "
+                "initialized drafter will be rejected at ~every "
+                "position (~0%% acceptance) and speculative decoding "
+                "becomes a pure slowdown — set "
+                "model.draft_checkpoint_path or clear "
+                "model.draft_model_id",
+                model_id,
+            )
 
         self.spec = spec_for_model_id(model_id)
         self.k_max = max(1, int(k_max))

@@ -50,14 +50,20 @@ from vgate_tpu import faults, metrics
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.errors import (
+    EngineDeadError,
     EngineRecoveringError,
+    EngineStalledError,
     PoisonRequestError,
     raise_for_state,
     state_is_alive,
     state_is_ready,
 )
 from vgate_tpu.logging_config import get_logger
-from vgate_tpu.runtime.engine_core import EngineCore
+from vgate_tpu.runtime.engine_core import (
+    EngineCore,
+    rebuild_core,
+    replay_into,
+)
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 
 logger = get_logger(__name__)
@@ -68,6 +74,38 @@ class HealthState(enum.Enum):
     DEGRADED = "degraded"
     RECOVERING = "recovering"
     DEAD = "dead"
+
+
+def classify_heartbeat(
+    heartbeat: Optional[Dict[str, Any]],
+    now: float,
+    step_stall_s: float,
+    compile_grace_s: float,
+) -> Optional[Dict[str, Any]]:
+    """Hang-watchdog verdict for one engine heartbeat: ``None`` while
+    healthy, else ``{"stalled_s", "limit_s", "phase", "compiling"}``.
+
+    Compile-aware: a beat stamped ``compiling=True`` (first dispatch of
+    a program variant — XLA/Mosaic can legitimately pause the loop for
+    minutes) is judged against ``compile_grace_s`` instead of
+    ``step_stall_s``.  Pure function of (beat, now) so tests drive it
+    with fake clocks; ``step_stall_s <= 0`` disables the watchdog."""
+    if step_stall_s <= 0 or not heartbeat:
+        return None
+    limit = (
+        compile_grace_s
+        if heartbeat.get("compiling")
+        else step_stall_s
+    )
+    stalled_s = now - heartbeat.get("t", now)
+    if stalled_s <= limit:
+        return None
+    return {
+        "stalled_s": round(stalled_s, 3),
+        "limit_s": limit,
+        "phase": heartbeat.get("kind", "unknown"),
+        "compiling": bool(heartbeat.get("compiling")),
+    }
 
 
 def classify_fatal(exc: BaseException) -> str:
@@ -108,6 +146,20 @@ class EngineSupervisor:
         self._watcher: Optional[threading.Thread] = None
         self.total_crashes = 0
         self.total_restarts = 0
+        self.total_stalls = 0
+        # in-flight survival accounting (recovery.resume_in_flight):
+        # sequences checkpointed at a crash/stall and replayed into the
+        # rebuilt core vs given up on (quarantined / max attempts /
+        # resubmit failure)
+        self.total_resumed = 0
+        self.total_lost = 0
+        # checkpointed sequences awaiting the rebuilt core; failed with
+        # a terminal error if the engine lands DEAD or stop() wins
+        self._pending_resume: List[Sequence] = []
+        # introspection record of the most recent checkpoint/replay
+        # (/stats → engine.supervisor.last_resume): counts + per-seq
+        # checkpoint summaries, never token content
+        self.last_resume: Optional[Dict[str, Any]] = None
         self.transitions: List[tuple] = []
         self.last_fatal: Optional[str] = None
         # flight-recorder snapshot of the most recent crash (ticks +
@@ -136,6 +188,15 @@ class EngineSupervisor:
         if self._watcher is not None:
             self._watcher.join(timeout=30)
             self._watcher = None
+        # checkpointed work that never reached a rebuilt core is still
+        # owed an answer (core.stop() covers its own _checkpointed)
+        self._fail_pending_resume(
+            EngineRecoveringError(
+                "engine stopped before the checkpointed request could "
+                "be replayed"
+            ),
+            reason="shutdown",
+        )
         self.core.stop()
 
     # ------------------------------------------------------------ the state
@@ -219,6 +280,11 @@ class EngineSupervisor:
             if self._stopping:
                 return
             if not fired:
+                # idle poll doubles as the hang watchdog: a wedged
+                # engine (stuck decode step / Mosaic hang) never raises,
+                # so nothing would ever set the crash event — the
+                # monitor must declare the fault itself
+                self._check_stall()
                 continue
             self._crash_event.clear()
             if self.core._fatal is not None:
@@ -228,7 +294,60 @@ class EngineSupervisor:
                     logger.error(
                         "supervisor crash handler failed", exc_info=True
                     )
+                    self._fail_pending_resume(
+                        EngineDeadError(
+                            "supervisor crash handler failed; "
+                            "in-flight work cannot be replayed"
+                        ),
+                        reason="resubmit_failed",
+                    )
                     self._transition(HealthState.DEAD)
+
+    def _check_stall(self) -> None:
+        """Classify the live core's heartbeat; a stale beat becomes an
+        EngineStalledError declared through the core's containment, so
+        the existing crash path applies: stall → checkpoint → rebuild →
+        replay."""
+        rec = self._recovery
+        core = self.core
+        if (
+            rec.step_stall_s <= 0
+            or core._fatal is not None
+            or not core._running
+        ):
+            return
+        verdict = classify_heartbeat(
+            getattr(core, "_heartbeat", None),
+            time.monotonic(),
+            rec.step_stall_s,
+            rec.compile_grace_s,
+        )
+        if verdict is None:
+            return
+        exc = EngineStalledError(
+            "engine heartbeat stale for "
+            f"{verdict['stalled_s']:.1f}s (limit "
+            f"{verdict['limit_s']:.1f}s) at phase "
+            f"{verdict['phase']!r}; declaring the engine wedged",
+            stalled_s=verdict["stalled_s"],
+            phase=verdict["phase"],
+        )
+        logger.error(
+            "engine stall detected by watchdog",
+            extra={"extra_data": verdict},
+        )
+        if core.declare_stalled(exc):
+            self.total_stalls += 1
+            metrics.ENGINE_STALLS.inc()
+
+    def _fail_pending_resume(
+        self, exc: BaseException, reason: str
+    ) -> None:
+        pending, self._pending_resume = self._pending_resume, []
+        for seq in pending:
+            self.total_lost += 1
+            metrics.LOST_SEQUENCES.labels(reason=reason).inc()
+            seq.fail(exc)
 
     def _sleep(self, seconds: float) -> None:
         deadline = time.monotonic() + seconds
@@ -236,12 +355,13 @@ class EngineSupervisor:
             time.sleep(min(0.05, deadline - time.monotonic()))
 
     def _update_quarantine(self, exc: BaseException, kind: str) -> None:
+        # (fingerprint, resume_count) pairs of the residents at death
         suspects = list(self.core._fatal_suspects)
         if kind == "poison":
             # the fault names its victim; fall back to every resident
             # request when it doesn't
             named = getattr(exc, "fingerprint", None)
-            for fp in [named] if named else suspects:
+            for fp in [named] if named else [s[0] for s in suspects]:
                 if fp and fp not in self._quarantine:
                     self._quarantine.add(fp)
                     metrics.QUARANTINED_REQUESTS.inc()
@@ -250,11 +370,23 @@ class EngineSupervisor:
                         extra={"extra_data": {"fingerprint": fp}},
                     )
             return
-        # transient path: count repeat offenders — a request in flight
-        # across `poison_threshold` consecutive crashes is quarantined
+        # transient path: count repeat offenders — a request FRESHLY
+        # SUBMITTED into `poison_threshold` consecutive crashes is
+        # quarantined.  Only fresh submissions (resume_count == 0)
+        # increment the streak: the signal is CLIENT persistence (keep
+        # resubmitting the prompt that kills the engine), and with
+        # resume_in_flight the engine's own replays put every innocent
+        # bystander in flight across consecutive crashes by design —
+        # counting those would quarantine all traffic after any two
+        # rapid crashes.  A replayed sequence still KEEPS its streak
+        # (presence in this crash, no reset); the engine's
+        # max_resume_attempts bounds its replays, and the client's
+        # retry after that typed 503 is exactly the fresh submission
+        # that advances the streak.
         new_counts: Dict[str, int] = {}
-        for fp in suspects:
-            count = self._suspect_counts.get(fp, 0) + 1
+        for fp, resume_count in suspects:
+            prior = self._suspect_counts.get(fp, 0)
+            count = prior + (1 if resume_count == 0 else 0)
             if count >= self._recovery.poison_threshold:
                 if fp not in self._quarantine:
                     self._quarantine.add(fp)
@@ -267,7 +399,7 @@ class EngineSupervisor:
                             }
                         },
                     )
-            else:
+            elif count > 0:
                 new_counts[fp] = count
         # requests NOT in this crash reset their streak (consecutive
         # involvement is the poison signal, not lifetime involvement)
@@ -305,8 +437,32 @@ class EngineSupervisor:
                 "engine crash flight-recorder snapshot",
                 extra={"extra_data": {"flight": snapshot}},
             )
+        # claim the checkpointed in-flight sequences BEFORE the rebuild
+        # loop (the old core's stop() would otherwise fail them) and
+        # record the snapshot for /stats — counts and token counts only
+        self._pending_resume.extend(self.core.take_checkpointed())
+        # containment may have given up on sequences itself
+        # (max_resume_attempts): fold those into the lost total
+        self.total_lost += self.core.take_resume_losses()
+        if self._pending_resume:
+            self.last_resume = {
+                "time": time.time(),
+                "cause": f"{type(exc).__name__}: {exc}",
+                "checkpointed": len(self._pending_resume),
+                "sequences": [
+                    s.checkpoint_summary()
+                    for s in self._pending_resume
+                ],
+            }
         self._update_quarantine(exc, kind)
         if kind == "unrecoverable":
+            self._fail_pending_resume(
+                EngineDeadError(
+                    "engine hit an unrecoverable fault; checkpointed "
+                    "in-flight work cannot be replayed"
+                ),
+                reason="resubmit_failed",
+            )
             self._transition(HealthState.DEAD)
             return
         rec = self._recovery
@@ -326,6 +482,13 @@ class EngineSupervisor:
                         }
                     },
                 )
+                self._fail_pending_resume(
+                    EngineDeadError(
+                        "engine restart budget exhausted; checkpointed "
+                        "in-flight work cannot be replayed"
+                    ),
+                    reason="resubmit_failed",
+                )
                 self._transition(HealthState.DEAD)
                 return
             backoff = min(
@@ -337,34 +500,12 @@ class EngineSupervisor:
                 return
             self._restart_times.append(time.monotonic())
             try:
-                old = self.core
-                old.stop()
-                # free the dead incarnation's device KV pool BEFORE
-                # building the new one: auto-sized pools fill most of
-                # HBM, so keeping both alive would OOM every rebuild
-                # attempt on real hardware (old stays self.core until
-                # the swap below, pinning anything still referenced)
-                old.k_pages = None
-                old.v_pages = None
-                old._dec_state = None
-                old._pending_chunks.clear()
-                old._spec_pen = None
-                # weights kept: the old core's tree is already
-                # quantized/sharded on these devices — KV pools,
-                # allocator and scheduler rebuild fresh
-                new_core = EngineCore(
-                    self.config,
-                    spec=old.spec,
-                    params=old.params,
-                    devices=self._devices,
-                    params_ready=True,
-                )
-                # brownout state survives the rebuild: a crash while
-                # level >= 3 must not silently re-enable speculative
-                # decoding under the exact saturation being shed (the
-                # pressure controller only re-asserts on transitions)
-                new_core.spec_suspended = bool(
-                    getattr(old, "spec_suspended", False)
+                # shared teardown/rebuild sequence (engine_core.
+                # rebuild_core): stop, free the dead incarnation's
+                # device KV pool before the new one sizes, weights
+                # kept, brownout spec-suspension carried over
+                new_core = rebuild_core(
+                    self.core, self.config, self._devices
                 )
             except Exception:
                 logger.error(
@@ -376,8 +517,13 @@ class EngineSupervisor:
             if self._stopping:
                 # stop() raced the rebuild (its join timed out while we
                 # were constructing): never start an engine nothing owns
+                # (stop() fails the pending-resume sequences)
                 new_core.stop()
                 return
+            # replay checkpointed in-flight work into the rebuilt core
+            # BEFORE it starts: the first tick then admits the replays
+            # ahead of (racing) fresh client traffic
+            self._replay(new_core)
             new_core.start()
             self.total_restarts += 1
             metrics.ENGINE_RESTARTS.inc()
@@ -392,6 +538,42 @@ class EngineSupervisor:
                 },
             )
             return
+
+    def _replay(self, core: Any) -> None:
+        """Re-submit the checkpointed in-flight sequences into a rebuilt
+        core as prefill-continues (prepare_resume already folded each
+        partial generation into its prompt).  Quarantined fingerprints
+        are excluded — a poison request must not ride the replay path
+        back into the engine it keeps crashing; deadlines stay anchored
+        (absolute deadline_t survives the checkpoint), so a blown
+        budget sheds with the normal 504 + partials on the new core.
+        ``core`` only needs submit_existing + flight, so tests drive
+        this with fakes."""
+        pending, self._pending_resume = self._pending_resume, []
+        replayed = 0
+        for seq in pending:
+            outcome = replay_into(
+                core, seq, self._quarantine,
+                retry_after=self.retry_after_s,
+            )
+            if outcome == "replayed":
+                replayed += 1
+                self.total_resumed += 1
+            else:
+                self.total_lost += 1
+        if self.last_resume is not None:
+            self.last_resume["replayed"] = replayed
+        if pending:
+            logger.warning(
+                "replayed checkpointed in-flight work into rebuilt "
+                "engine",
+                extra={
+                    "extra_data": {
+                        "checkpointed": len(pending),
+                        "replayed": replayed,
+                    }
+                },
+            )
 
     # ----------------------------------------------------------- submission
 
@@ -471,6 +653,7 @@ class EngineSupervisor:
                     "ttft": seq.ttft or 0.0,
                     "tpot": seq.tpot or 0.0,
                     "gen_time": gen_time,
+                    **seq.resume_metrics(),
                 },
             }
             if seq.params.logprobs:
@@ -500,6 +683,9 @@ class EngineSupervisor:
             "ready": state_is_ready(state.value),
             "crashes": self.total_crashes,
             "restarts": self.total_restarts,
+            "stalls": self.total_stalls,
+            "resumed": self.total_resumed,
+            "lost": self.total_lost,
             "quarantined": len(self._quarantine),
             "queue_depth": queue_depth,
             "running": running,
@@ -522,8 +708,9 @@ class EngineSupervisor:
             stats = {}
         stats["supervisor"] = self.health()
         # always present (None until a crash happens) so operators can
-        # discover the field without inducing one; docs/operations.md
+        # discover the fields without inducing one; docs/operations.md
         stats["last_crash"] = self.last_crash
+        stats["last_resume"] = self.last_resume
         armed = faults.snapshot()
         if armed:
             stats["faults_armed"] = armed

@@ -648,6 +648,7 @@ async def chat_completions(request: web.Request) -> web.Response:
             + completion_tokens,
         ),
         cached=result.get("cached", False),
+        resumed=result.get("resumed", False),
         metrics=result.get("metrics", {}),
     )
     return web.json_response(completion.model_dump())

@@ -156,6 +156,10 @@ class ChatCompletion(BaseModel):
     choices: List[Choice] = Field(default_factory=list)
     usage: Usage = Field(default_factory=Usage)
     cached: bool = False
+    # generation survived an engine restart/failover via in-flight
+    # checkpoint & replay (docs/operations.md); like `cached`, a vgt
+    # extension to the OpenAI shape
+    resumed: bool = False
     metrics: Dict[str, float] = Field(default_factory=dict)
 
 

@@ -59,6 +59,9 @@ class ChatCompletion(BaseModel):
     choices: List[Choice] = Field(default_factory=list)
     usage: Usage = Field(default_factory=Usage)
     cached: bool = False
+    # vgt extension: the generation was checkpointed across an engine
+    # restart/failover and replayed (explains a one-off latency blip)
+    resumed: bool = False
     metrics: Dict[str, float] = Field(default_factory=dict)
 
 
