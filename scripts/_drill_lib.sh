@@ -1,0 +1,77 @@
+# Shared helpers for the check-script drills (drain_check.sh,
+# overload_check.sh, resume_check.sh, prefix_check.sh).  Source from a
+# script that has already cd'd to the repo root:
+#
+#   source scripts/_drill_lib.sh
+#   ensure_port_free "$PORT"
+#   python main.py & SERVER_PID=$!
+#   record_drill_pid "$PORT" "$SERVER_PID"
+#
+# Fixes the drill-port foot-gun (CHANGES.md PR 4 note): a stray server
+# left behind by a crashed/killed prior session holds ports 8731-8734
+# and makes the next drill hang on "server never became ready" or —
+# worse — assert against the WRONG server.  ensure_port_free kills a
+# stale drill server by pidfile when it provably started one of these
+# drills, and otherwise fails fast with a clear message instead of
+# letting the drill misattribute failures.
+
+_drill_pidfile() {
+  echo "/tmp/vgt_drill_port_$1.pid"
+}
+
+_port_is_free() {
+  python - "$1" <<'PY'
+import socket, sys
+s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+try:
+    s.bind(("127.0.0.1", int(sys.argv[1])))
+except OSError:
+    sys.exit(1)
+finally:
+    s.close()
+PY
+}
+
+ensure_port_free() {
+  local port="$1"
+  local pidfile
+  pidfile="$(_drill_pidfile "$port")"
+  if _port_is_free "$port"; then
+    return 0
+  fi
+  if [[ -f "$pidfile" ]]; then
+    local stale_pid
+    stale_pid="$(cat "$pidfile" 2>/dev/null || true)"
+    if [[ -n "$stale_pid" ]] && kill -0 "$stale_pid" 2>/dev/null; then
+      echo "drill: port $port held by a stale drill server" \
+           "(pid $stale_pid from $pidfile) — killing it" >&2
+      kill -9 "$stale_pid" 2>/dev/null || true
+      local _i
+      for _i in $(seq 1 25); do
+        if _port_is_free "$port"; then
+          rm -f "$pidfile"
+          return 0
+        fi
+        sleep 0.2
+      done
+    fi
+  fi
+  echo "FAIL: port $port is already in use and is not a known drill" \
+       "server (no live pidfile at $pidfile)." >&2
+  echo "      A stray server from a previous session is likely holding" \
+       "it — find it with: lsof -iTCP:$port -sTCP:LISTEN (or" \
+       "fuser $port/tcp) and kill it, or rerun with a different port:" \
+       "$0 <port>." >&2
+  exit 1
+}
+
+record_drill_pid() {
+  # record_drill_pid PORT PID — lets the NEXT session's ensure_port_free
+  # kill this server if we die before our trap runs
+  echo "$2" > "$(_drill_pidfile "$1")"
+}
+
+clear_drill_pid() {
+  rm -f "$(_drill_pidfile "$1")"
+}

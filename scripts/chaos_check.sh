@@ -32,3 +32,6 @@ python -m pytest tests/test_recovery.py \
 
 echo "== in-flight survival drill =="
 bash scripts/resume_check.sh
+
+echo "== cross-request KV reuse drill =="
+bash scripts/prefix_check.sh

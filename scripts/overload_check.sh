@@ -16,6 +16,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 PORT="${1:-8733}"
+source scripts/_drill_lib.sh
+ensure_port_free "$PORT"
 export JAX_PLATFORMS=cpu
 export VGT_DRY_RUN=1
 export VGT_SERVER__PORT="$PORT"
@@ -36,7 +38,8 @@ export VGT_ADMISSION__PER_KEY_MAX_INFLIGHT=2
 
 python main.py &
 SERVER_PID=$!
-trap 'kill -9 $SERVER_PID 2>/dev/null || true' EXIT
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; clear_drill_pid "$PORT"' EXIT
 
 BASE="http://127.0.0.1:$PORT"
 for _ in $(seq 1 100); do

@@ -1,0 +1,294 @@
+#!/usr/bin/env bash
+# Cross-request KV reuse gate (sibling of resume_check.sh /
+# overload_check.sh): boot a CPU tiny-dense server with the radix
+# prefix cache on, a squeezed KV pool and an armed `kv_alloc` delay
+# (the allocation path stays under pressure while eviction runs), then
+# replay a multi-turn chat trace — N users sharing one system prompt,
+# M turns each, every turn re-sending the grown transcript — and
+# assert:
+#   1. ZERO 5xx across the whole trace (eviction under pressure never
+#      becomes a client-visible failure),
+#   2. hit-token ratio: the radix tree serves well over half of all
+#      prompt tokens from shared KV (/stats prefix_cache.hit_tokens vs
+#      vgt_prompt_tokens),
+#   3. TTFT of warm turns << cold: replaying a user's final transcript
+#      (tree-resident) is far faster than an equal-length never-seen
+#      transcript,
+#   4. eviction ran (the pool really was squeezed) and COW copies
+#      fired (turn boundaries land mid-page at page_size 4),
+#   5. token identity: a second server with the cache OFF (same
+#      deterministic random-init weights) reproduces the exact same
+#      completions for the same prompts.
+#
+# Usage: scripts/prefix_check.sh [port] [port_off]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${1:-8732}"
+PORT_OFF="${2:-$((PORT + 40))}"
+source scripts/_drill_lib.sh
+ensure_port_free "$PORT"
+ensure_port_free "$PORT_OFF"
+export JAX_PLATFORMS=cpu
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=512
+export VGT_TPU__DP=1
+export VGT_TPU__TP=1
+export VGT_TPU__EP=1
+export VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=1
+# squeezed pool: the live trace (~6 users x ~110-page transcripts)
+# just fits, but the cold-replay phase pushes past capacity -> the
+# LRU/pressure eviction path must run while requests keep succeeding
+export VGT_TPU__KV_NUM_PAGES=900
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=4
+export VGT_TPU__PREFILL_BUCKETS='[16,32,64,128]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_TPU__PREFIX_CACHE='{"enabled": true, "cow_min_tokens": 2, "evict_watermark": 0.1}'
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=10
+# identical replays must hit the KV tree, not the result cache
+export VGT_CACHE__ENABLED=false
+# the armed pressure squeeze: every page allocation pays a small delay
+# while the drill asserts zero 5xx through live eviction
+export VGT_FAULTS="kv_alloc:delay:delay=0.002:times=-1"
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill -9 $SERVER_PID ${SERVER_OFF_PID:-} 2>/dev/null || true; clear_drill_pid "$PORT"; clear_drill_pid "$PORT_OFF"' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+for _ in $(seq 1 300); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: server never became ready"; exit 1; }
+
+TRACE_JSON="$(mktemp /tmp/vgt_prefix_trace.XXXXXX.json)"
+
+python - "$BASE" "$TRACE_JSON" <<'EOF'
+import asyncio, json, statistics, sys, time
+import aiohttp
+
+BASE, TRACE_JSON = sys.argv[1], sys.argv[2]
+N_USERS = 6
+TURNS = 3
+# tiny-dense uses the byte tokenizer: ~1 token per CHARACTER, so all
+# sizes here are in chars.  The shared preamble is ~200 tokens — long
+# enough that a cold prefill runs several chunked passes while a warm
+# turn prefills only its new tail; finals stay under max_model_len 512.
+SYSTEM = (
+    "system directive alpha beta gamma delta epsilon zeta eta theta "
+    "iota kappa lam mu nu xi omicron pi rho sigma tau upsilon phi chi "
+    "psi omega one two three four five six seven eight nine ten "
+    "eleven twelve thirteen fourteen fifteen sixteen."
+)
+QUESTIONS = [
+    "summarize topic %d for user %d in a few words now",
+    "and the follow up issue %d for user %d from before",
+    "finally close out thread %d for user %d with a status",
+]
+
+
+async def complete(session, prompt):
+    t0 = time.perf_counter()
+    async with session.post(
+        f"{BASE}/v1/completions",
+        json={
+            "prompt": prompt,
+            "max_tokens": 6,
+            "temperature": 0.0,
+        },
+    ) as resp:
+        body = await resp.json()
+        return resp.status, body, time.perf_counter() - t0
+
+
+async def ttft_totals(session):
+    """(sum_s, count) of the engine's TTFT histogram — per-phase deltas
+    give mean engine TTFT free of gateway batch-window noise."""
+    async with session.get(f"{BASE}/metrics") as resp:
+        text = await resp.text()
+    s = c = 0.0
+    for line in text.splitlines():
+        if line.startswith("vgt_time_to_first_token_seconds_sum"):
+            s = float(line.split()[-1])
+        elif line.startswith("vgt_time_to_first_token_seconds_count"):
+            c = float(line.split()[-1])
+    return s, c
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=300)
+    statuses = []
+    finals = {}
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        # multi-turn trace: each user's transcript grows turn over turn,
+        # re-sending everything the previous turns said (the agent-loop
+        # / chat shape the radix tree exists for)
+        for user in range(N_USERS):
+            transcript = SYSTEM
+            for t in range(TURNS):
+                transcript += " " + (QUESTIONS[t] % (t, user))
+                status, body, _ = await complete(session, transcript)
+                statuses.append(status)
+                if status == 200:
+                    transcript += body["choices"][0]["text"]
+            finals[user] = transcript
+        fivexx = [s for s in statuses if s >= 500]
+        assert not fivexx, f"5xx during the trace: {statuses}"
+
+        # compile warmup: on the tiny CPU model XLA compile time (not
+        # prefill compute) dominates first contact with a program
+        # variant — run one full unmeasured replay round (covering the
+        # aligned AND the COW/unaligned small-suffix variants each
+        # user's transcript length selects) plus one cold-shaped probe,
+        # so the timed phases below compare compute, not compiles
+        for user in range(N_USERS):
+            await complete(session, finals[user])
+        await complete(
+            session,
+            finals[0].replace("system directive", "warmup preamble"),
+        )
+        # warm: replay each user's final transcript (tree-resident)
+        s0, c0 = await ttft_totals(session)
+        warm = []
+        for user in range(N_USERS):
+            status, _, dt = await complete(session, finals[user])
+            assert status == 200, status
+            warm.append(dt)
+        s1, c1 = await ttft_totals(session)
+        # cold: never-seen transcripts of the same shape/length (the
+        # shared preamble is rewritten, so nothing matches the tree)
+        cold = []
+        for user in range(N_USERS):
+            fresh = finals[user].replace(
+                "system directive alpha", f"fresh preamble {user} alpha"
+            )
+            status, _, dt = await complete(session, fresh)
+            assert status == 200, status
+            cold.append(dt)
+        s2, c2 = await ttft_totals(session)
+        assert c1 > c0 and c2 > c1, "TTFT histogram never moved"
+        warm_m = (s1 - s0) / (c1 - c0)  # mean engine TTFT, warm phase
+        cold_m = (s2 - s1) / (c2 - c1)  # mean engine TTFT, cold phase
+        warm_wall = statistics.median(warm)
+        cold_wall = statistics.median(cold)
+
+        async with session.get(f"{BASE}/stats") as resp:
+            stats = await resp.json()
+        pc = stats["engine"]["scheduler"]["prefix_cache"]
+        async with session.get(f"{BASE}/metrics") as resp:
+            metrics_text = await resp.text()
+        prompt_tokens = 0.0
+        for line in metrics_text.splitlines():
+            if line.startswith("vgt_prompt_tokens_total"):
+                prompt_tokens = float(line.split()[-1])
+        hit_ratio = pc["hit_tokens"] / max(1.0, prompt_tokens)
+
+        print(
+            f"hit_tokens={pc['hit_tokens']} prompt_tokens={prompt_tokens:.0f} "
+            f"ratio={hit_ratio:.2f} evictions={pc['evictions']} "
+            f"cow={pc['cow_copies']} warm_ttft={warm_m*1000:.1f}ms "
+            f"cold_ttft={cold_m*1000:.1f}ms (wall "
+            f"{warm_wall*1000:.0f}/{cold_wall*1000:.0f}ms)"
+        )
+        # the trace itself runs ~0.75; the deliberate cold/warmup
+        # phases dilute the overall counter — 0.5 still requires the
+        # tree to serve the multi-turn shape
+        assert hit_ratio >= 0.5, (
+            f"hit-token ratio {hit_ratio:.2f} below threshold 0.5"
+        )
+        assert pc["evictions"] > 0, (
+            "the pool was never squeezed into evicting — drill proves "
+            "nothing about eviction under pressure"
+        )
+        assert pc["cow_copies"] > 0, "COW never fired on divergent turns"
+        assert warm_wall < cold_wall * 0.6, (
+            f"warm turns not clearly faster: warm={warm_wall:.3f}s "
+            f"cold={cold_wall:.3f}s (engine ttft "
+            f"{warm_m*1000:.1f}/{cold_m*1000:.1f}ms)"
+        )
+
+        # save prompts + completions for the cache-off identity replay
+        replay = {}
+        for user in range(N_USERS):
+            status, body, _ = await complete(session, finals[user])
+            assert status == 200
+            replay[finals[user]] = body["choices"][0]["text"]
+        with open(TRACE_JSON, "w") as fh:
+            json.dump(replay, fh)
+    print(
+        f"PASS phase 1: {N_USERS * TURNS} turns, zero 5xx, "
+        f"hit ratio {hit_ratio:.2f}, warm {warm_m*1000:.0f}ms vs "
+        f"cold {cold_m*1000:.0f}ms"
+    )
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+clear_drill_pid "$PORT"
+
+# phase 2: cache OFF, same deterministic weights (seeded random init) —
+# greedy completions must be byte-identical to the cache-on server's
+export VGT_TPU__PREFIX_CACHE=false
+export VGT_FAULTS=""
+export VGT_SERVER__PORT="$PORT_OFF"
+python main.py &
+SERVER_OFF_PID=$!
+record_drill_pid "$PORT_OFF" "$SERVER_OFF_PID"
+
+BASE_OFF="http://127.0.0.1:$PORT_OFF"
+for _ in $(seq 1 300); do
+  if curl -fsS "$BASE_OFF/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE_OFF/health/ready" >/dev/null || {
+  echo "FAIL: cache-off server never became ready"; exit 1; }
+
+python - "$BASE_OFF" "$TRACE_JSON" <<'EOF'
+import asyncio, json, sys
+import aiohttp
+
+BASE, TRACE_JSON = sys.argv[1], sys.argv[2]
+with open(TRACE_JSON) as fh:
+    replay = json.load(fh)
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        for prompt, want in replay.items():
+            async with session.post(
+                f"{BASE}/v1/completions",
+                json={"prompt": prompt, "max_tokens": 6,
+                      "temperature": 0.0},
+            ) as resp:
+                assert resp.status == 200, resp.status
+                body = await resp.json()
+            got = body["choices"][0]["text"]
+            assert got == want, (
+                "cache-on output diverged from cache-off:\n"
+                f"  on:  {want!r}\n  off: {got!r}"
+            )
+    print(f"PASS phase 2: {len(replay)} prompts token-identical with "
+          "the prefix cache off")
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_OFF_PID" 2>/dev/null || true
+wait "$SERVER_OFF_PID" 2>/dev/null || true
+rm -f "$TRACE_JSON"
+echo "prefix_check: OK"

@@ -20,6 +20,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 PORT="${1:-8734}"
+source scripts/_drill_lib.sh
+ensure_port_free "$PORT"
 export JAX_PLATFORMS=cpu
 export VGT_SERVER__PORT="$PORT"
 export VGT_LOGGING__LEVEL=WARNING
@@ -57,7 +59,8 @@ export VGT_FAULTS="stall:delay:delay=6:times=1,decode_step:raise:kind=transient:
 
 python main.py &
 SERVER_PID=$!
-trap 'kill -9 $SERVER_PID 2>/dev/null || true' EXIT
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; clear_drill_pid "$PORT"' EXIT
 
 BASE="http://127.0.0.1:$PORT"
 for _ in $(seq 1 300); do

@@ -313,3 +313,95 @@ def test_prefix_cache_survives_engine_preemption_pressure():
         cached_stats["scheduler"]["preemptions"] > 0
         or cached_stats["scheduler"]["prefix_cache"]["evictions"] > 0
     )
+
+
+# ------------------------------------------------- radix: COW + multi-turn
+
+
+def radix_config(prefix_cache=True, **pc_overrides):
+    pc = {"enabled": prefix_cache, "cow_min_tokens": 2, **pc_overrides}
+    return load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 96,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "num_devices": 1,
+            "kv_num_pages": 96, "kv_page_size": PS,
+            "max_batch_slots": 4, "prefill_buckets": [8, 16, 32],
+            "use_pallas": False, "prefix_cache": pc,
+        },
+        scheduler={"max_queue_size": 16},
+        logging={"level": "ERROR"},
+    )
+
+
+@pytest.fixture(scope="module")
+def radix_engines():
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    cached = EngineCore(radix_config(True), devices=jax.devices()[:1])
+    plain = EngineCore(radix_config(False), devices=jax.devices()[:1])
+    cached.start()
+    plain.start()
+    yield cached, plain
+    cached.stop()
+    plain.stop()
+
+
+def test_engine_cow_partial_page_identity(radix_engines):
+    """A prompt diverging INSIDE a shared page takes the copy-on-write
+    path (device page copy + unaligned suffix prefill) and must still
+    produce exactly the cold-path greedy output."""
+    cached, plain = radix_engines
+    base = [7, 3, 9, 4, 11, 6, 2, 13, 5, 8, 12, 10, 14, 9]
+    ids_a = base
+    ids_b = base[:10] + [21, 22, 23, 24]  # 2 full pages + 2 in-page
+    sa = cached.submit_tokens(list(ids_a), greedy())
+    assert sa.done_event.wait(timeout=300)
+    cow0 = cached.radix_cache.total_cow_copies
+    sb = cached.submit_tokens(list(ids_b), greedy())
+    assert sb.done_event.wait(timeout=300)
+    assert cached.radix_cache.total_cow_copies > cow0, "COW never fired"
+    pa = plain.submit_tokens(list(ids_a), greedy())
+    pb = plain.submit_tokens(list(ids_b), greedy())
+    assert pa.done_event.wait(timeout=300)
+    assert pb.done_event.wait(timeout=300)
+    assert list(sa.generated_ids) == list(pa.generated_ids)
+    assert list(sb.generated_ids) == list(pb.generated_ids)
+
+
+def test_engine_multi_turn_generated_reuse(radix_engines):
+    """Turn N+1 re-sends turn N's prompt AND answer: the radix tree
+    indexes generated pages at finish, so the next turn's hit covers
+    (nearly) the whole previous transcript — the flat chain could only
+    ever match the previous PROMPT pages."""
+    cached, plain = radix_engines
+    t1 = [31, 32, 33, 34, 35, 36, 37, 38, 39, 40, 41]
+    s1 = cached.submit_tokens(list(t1), greedy())
+    assert s1.done_event.wait(timeout=300)
+    answer = list(s1.generated_ids)
+    # next turn: transcript (minus the final token, whose KV was never
+    # written) + new user text
+    t2 = t1 + answer + [51, 52, 53, 54, 55]
+    hit0 = cached.scheduler.total_prefix_hit_tokens
+    s2 = cached.submit_tokens(list(t2), greedy())
+    assert s2.done_event.wait(timeout=300)
+    hit = cached.scheduler.total_prefix_hit_tokens - hit0
+    # the hit must reach INTO the generated region: more than the
+    # prompt-only pages the flat chain would serve
+    flat_max = (len(t1) // PS) * PS
+    assert hit > flat_max, (hit, flat_max)
+    p2 = plain.submit_tokens(list(t2), greedy())
+    assert p2.done_event.wait(timeout=300)
+    assert list(s2.generated_ids) == list(p2.generated_ids)
+
+
+def test_engine_radix_stats_surface(radix_engines):
+    cached, _ = radix_engines
+    stats = cached.get_stats()["scheduler"]["prefix_cache"]
+    assert stats["mode"] == "radix"
+    assert stats["inserted_pages"] > 0
+    assert "evictions_pressure" in stats and "cow_copies" in stats

@@ -1,0 +1,383 @@
+"""Radix-tree prefix cache (vgate_tpu/runtime/radix_cache.py): unit
+coverage for match/split/COW/insert/evict, plus the seeded randomized
+invariant test the subsystem is gated on — interleaved
+admit/commit/finish/release/evict/trim sequences must never free a page
+that is still referenced, never index a physical page twice, and keep
+the allocator's page accounting exact (truly-free + used + cached ==
+allocatable) at every step.  Pure host-side, fast tier."""
+
+import random
+
+import pytest
+
+from vgate_tpu.runtime.kv_cache import PageAllocator
+from vgate_tpu.runtime.radix_cache import RadixCache
+
+PS = 4
+
+
+def make(num_pages=64, **kw):
+    alloc = PageAllocator(num_pages)
+    kw.setdefault("cow_min_tokens", 2)
+    rx = RadixCache(alloc, PS, **kw)
+    alloc.set_reclaimer(rx)
+    return alloc, rx
+
+
+# ------------------------------------------------------------------ unit
+
+
+def test_match_walks_longest_prefix_and_locks():
+    alloc, rx = make()
+    toks = list(range(100, 116))  # 4 full pages
+    pages = alloc.allocate(4)
+    node = rx.insert(toks, pages)
+    assert node is not None and rx.total_inserted_pages == 4
+    alloc.release(pages)  # finish-time style: seq refs drop right away
+    # full-prefix request (plus a tail so limit allows all 4 pages)
+    m = rx.match(toks + [1, 2])
+    assert m is not None and m.pages == pages
+    assert m.node is not None and m.node.lock_ref >= 1
+    # locked nodes are not reclaimable
+    assert rx.evictable_pages() == 0
+    alloc.release(m.pages)
+    rx.unlock(m)
+    assert rx.evictable_pages() == 4
+
+
+def test_match_caps_below_full_prompt():
+    """A prompt EQUAL to an indexed stream must keep >= 1 token for the
+    suffix prefill to sample from."""
+    alloc, rx = make()
+    toks = list(range(50, 58))  # 2 pages
+    pages = alloc.allocate(2)
+    rx.insert(toks, pages)
+    m = rx.match(list(toks))  # limit = 7 -> only page 0 matchable
+    assert m is not None and len(m.pages) == 1
+    alloc.release(m.pages)
+    rx.unlock(m)
+
+
+def test_split_at_partial_match_point():
+    alloc, rx = make()
+    toks = list(range(1, 17))  # one node, 4 pages
+    pages = alloc.allocate(4)
+    rx.insert(toks, pages)
+    nodes_before = rx.total_nodes
+    # diverge at page 2 -> the 4-page run must split into 2 + 2
+    probe = toks[:8] + [91, 92, 93, 94, 95]
+    m = rx.match(probe)
+    assert m is not None and m.pages == pages[:2]
+    assert rx.total_nodes == nodes_before + 1
+    # the indexed content is unchanged: a full-stream request still
+    # matches across the split boundary
+    alloc.release(m.pages)
+    rx.unlock(m)
+    m2 = rx.match(toks + [7])
+    assert m2 is not None and m2.pages == pages
+    alloc.release(m2.pages)
+    rx.unlock(m2)
+
+
+def test_cow_tail_on_mid_page_divergence():
+    alloc, rx = make()
+    toks = list(range(1, 17))
+    pages = alloc.allocate(4)
+    rx.insert(toks, pages)
+    # shares 2 pages + 2 tokens of page 2
+    m = rx.match(toks[:10] + [88] * 6)
+    assert m is not None and len(m.pages) == 2
+    assert m.cow_tokens == 2 and m.cow_src == pages[2]
+    # the COW source node stays locked until the copy is dispatched
+    assert m.cow_node is not None and m.cow_node.lock_ref >= 1
+    rx.release_cow(m)
+    assert m.cow_node is None
+    alloc.release(m.pages)
+    rx.unlock(m)
+
+
+def test_cow_respects_min_tokens():
+    alloc, rx = make(cow_min_tokens=3)
+    toks = list(range(1, 17))
+    pages = alloc.allocate(4)
+    rx.insert(toks, pages)
+    m = rx.match(toks[:10] + [88] * 6)  # only 2 shared in-page tokens
+    assert m is not None and m.cow_tokens == 0 and m.cow_src is None
+    alloc.release(m.pages)
+    rx.unlock(m)
+
+
+def test_insert_dedups_existing_prefix():
+    alloc, rx = make()
+    toks = list(range(1, 13))
+    a = alloc.allocate(3)
+    assert rx.insert(toks, a) is not None
+    assert rx.total_inserted_pages == 3
+    # a same-wave duplicate's private pages are NOT adopted
+    b = alloc.allocate(3)
+    assert rx.insert(toks, b) is None
+    assert rx.total_inserted_pages == 3
+    assert set(rx.pages_in_tree()) == set(a)
+    # extending the stream adopts only the new tail
+    c = alloc.allocate(2)
+    assert rx.insert(toks + [77, 78, 79, 80], a + c[:1]) is not None
+    assert rx.total_inserted_pages == 4
+    alloc.release(b)
+    alloc.release(c)
+    alloc.release(a)
+
+
+def test_eviction_lru_leaves_first_and_cascades():
+    alloc, rx = make(num_pages=32)
+    streams = []
+    for s in range(3):
+        toks = [s * 100 + i for i in range(8)]
+        pages = alloc.allocate(2)
+        rx.insert(toks, pages)
+        streams.append((toks, pages))
+        alloc.release(pages)
+    # touch stream 0 so it is most-recently-used
+    m = rx.match(streams[0][0] + [1])
+    alloc.release(m.pages)
+    rx.unlock(m)
+    freed = rx.evict(2)
+    assert freed == 2
+    # the oldest untouched stream went first; stream 0 survives
+    m0 = rx.match(streams[0][0] + [1])
+    assert m0 is not None
+    alloc.release(m0.pages)
+    rx.unlock(m0)
+
+
+def test_insert_suspended_serves_hits_only():
+    alloc, rx = make()
+    toks = list(range(1, 13))
+    a = alloc.allocate(3)
+    rx.insert(toks, a)
+    rx.insert_suspended = True
+    b = alloc.allocate(3)
+    assert rx.insert([9] * 12, b) is None  # no new content indexed
+    m = rx.match(toks + [5])  # hits still served
+    assert m is not None and m.pages
+    alloc.release(m.pages)
+    rx.unlock(m)
+    alloc.release(a)
+    alloc.release(b)
+
+
+def test_trim_to_watermark_counts_pressure():
+    alloc, rx = make(num_pages=16)
+    toks = list(range(1, 41))
+    pages = alloc.allocate(10)
+    rx.insert(toks, pages)
+    alloc.release(pages)
+    hold = alloc.allocate(4)  # truly free now 1
+    assert alloc.num_truly_free == 1
+    rx.trim_to_watermark(6)
+    assert alloc.num_truly_free >= 6
+    assert rx.total_evictions["pressure"] >= 5
+    alloc.release(hold)
+
+
+def test_probe_counts_evictable_without_mutating():
+    alloc, rx = make()
+    toks = list(range(1, 17))
+    pages = alloc.allocate(4)
+    rx.insert(toks, pages)
+    alloc.release(pages)
+    full, evictable = rx.probe(toks + [1])
+    assert (full, evictable) == (4, 4)
+    m = rx.match(toks + [1])
+    full2, evictable2 = rx.probe(toks + [1])
+    assert (full2, evictable2) == (4, 0)  # locked now
+    alloc.release(m.pages)
+    rx.unlock(m)
+
+
+def test_commit_pin_keeps_running_pages_unreclaimable():
+    """A RUNNING sequence's prompt pages adopted at commit time must
+    not count as reclaimable until the sequence releases — otherwise
+    num_free overstates what allocate() can obtain and eviction strips
+    tree references without freeing anything."""
+    alloc, rx = make(num_pages=16)
+    toks = list(range(1, 17))
+    pages = alloc.allocate(4)  # the sequence's own refs
+    node = rx.insert(toks, pages)
+    assert node is not None
+    rx.lock_node(node)  # scheduler.commit_prefill
+    assert rx.evictable_pages() == 0
+    assert alloc.num_free == alloc.num_truly_free
+    # eviction pressure mid-flight cannot touch the pinned subtree
+    assert rx.evict(4) == 0
+    assert set(rx.pages_in_tree()) == set(pages)
+    # release path (scheduler._radix_unlock + page release)
+    rx.unlock_node(node)
+    alloc.release(pages)
+    assert rx.evictable_pages() == 4
+    # now the tree holds the last reference and num_free is honest
+    got = alloc.allocate(alloc.num_free)
+    assert got is not None
+    alloc.release(got)
+
+
+# ------------------------------------------- randomized invariant drill
+
+
+def _check_invariants(alloc, rx, live):
+    free_set = set(alloc._free)
+    ref_set = set(alloc._refs)
+    allocatable = set(range(alloc.num_pages)) - alloc.reserved
+    # a free page is never referenced; together they cover the pool
+    assert not (free_set & ref_set), free_set & ref_set
+    assert free_set | ref_set == allocatable
+    assert all(r > 0 for r in alloc._refs.values())
+    # no physical page indexed twice (pages_in_tree asserts internally)
+    tree_pages = rx.pages_in_tree()
+    assert set(tree_pages) <= ref_set
+    # exact refcount accounting: holders = owning sequences + the tree
+    holders = {}
+    for seq in live:
+        for p in seq["pages"]:
+            holders[p] = holders.get(p, 0) + 1
+    for p in tree_pages:
+        holders[p] = holders.get(p, 0) + 1
+    assert holders == dict(alloc._refs), (holders, dict(alloc._refs))
+    # the page accounting identity the stats surface reports
+    assert (
+        alloc.num_truly_free + alloc.num_used + alloc.num_cached
+        == alloc.num_allocatable
+    )
+    # evictable pages really are the lock-free subtrees
+    assert alloc.num_cached == rx.evictable_pages()
+    # lock accounting is EXACT: every node's lock_ref equals the live
+    # handles — match paths AND commit-time insert pins — whose deepest
+    # node sits in its subtree (splits must not orphan shares — the
+    # chain-walk regression)
+    expected = {}
+
+    def count_chain(node):
+        while node is not None and node is not rx.root:
+            expected[id(node)] = expected.get(id(node), 0) + 1
+            node = node.parent
+
+    for seq in live:
+        m = seq["match"]
+        if m is not None and m.node is not None:
+            count_chain(m.node)
+        if seq.get("insert_node") is not None:
+            count_chain(seq["insert_node"])
+    dfs_evictable = 0
+    stack = [rx.root]
+    while stack:
+        node = stack.pop()
+        for child in node.children.values():
+            assert child.lock_ref == expected.get(id(child), 0), (
+                child.pages, child.lock_ref, expected.get(id(child), 0)
+            )
+            if child.lock_ref == 0:
+                dfs_evictable += len(child.pages)
+                # num_free honesty: a lock-free node's pages have the
+                # tree as their LAST holder, so evicting them genuinely
+                # frees memory (the commit-time-pin regression: an
+                # unpinned insert of a RUNNING sequence's pages counted
+                # seq-referenced pages as reclaimable)
+                for p in child.pages:
+                    assert alloc.refcount(p) == 1, (p, child.pages)
+            stack.append(child)
+    # the incrementally-maintained count never drifts from the truth
+    assert rx.evictable_pages() == dfs_evictable
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_interleaving_invariants(seed):
+    rng = random.Random(seed)
+    alloc, rx = make(num_pages=48)
+    bases = [
+        [rng.randrange(3, 99) for _ in range(rng.randrange(8, 40))]
+        for _ in range(6)
+    ]
+    live = []  # {"tokens", "pages", "match"}
+
+    def admit():
+        base = rng.choice(bases)
+        keep = rng.randrange(0, len(base) + 1)
+        tokens = base[:keep] + [
+            rng.randrange(3, 99)
+            for _ in range(rng.randrange(2, 20))
+        ]
+        m = rx.match(tokens)
+        matched = m.pages if m is not None else []
+        need = -(-len(tokens) // PS) - len(matched)
+        own = alloc.allocate(need)
+        if own is None:  # rollback, exactly like the scheduler
+            alloc.release(list(matched))
+            if m is not None:
+                rx.unlock(m)
+            return
+        live.append(
+            {
+                "tokens": tokens,
+                "pages": list(matched) + own,
+                "match": m,
+                "insert_node": None,
+            }
+        )
+        # commit (post-dispatch): insert the full prompt pages; a node
+        # adopting this RUNNING sequence's pages is pinned until finish
+        # (scheduler.commit_prefill -> _radix_unlock)
+        if m is not None:
+            rx.release_cow(m)
+        n_full = len(tokens) // PS
+        if n_full:
+            node = rx.insert(
+                tokens[: n_full * PS], live[-1]["pages"][:n_full]
+            )
+            if node is not None:
+                rx.lock_node(node)
+                live[-1]["insert_node"] = node
+
+    def finish():
+        if not live:
+            return
+        seq = live.pop(rng.randrange(len(live)))
+        if rng.random() < 0.5:
+            # decode growth + finish-time insert of generated content
+            gen = [rng.randrange(3, 99) for _ in range(rng.randrange(1, 9))]
+            total = len(seq["tokens"]) + len(gen)
+            extra = -(-total // PS) - len(seq["pages"])
+            if extra > 0:
+                got = alloc.allocate(extra)
+                if got is None:
+                    got = []
+                seq["pages"] += got
+            n_full = (total - 1) // PS
+            n_full = min(n_full, len(seq["pages"]))
+            if n_full > 0:
+                rx.insert(
+                    (seq["tokens"] + gen)[: n_full * PS],
+                    seq["pages"][:n_full],
+                )
+        if seq["match"] is not None:
+            rx.unlock(seq["match"])
+        if seq["insert_node"] is not None:
+            rx.unlock_node(seq["insert_node"])
+        alloc.release(seq["pages"])
+
+    def evict():
+        rx.evict(rng.randrange(1, 6))
+
+    def trim():
+        rx.trim_to_watermark(rng.randrange(1, 10))
+
+    ops = [admit, admit, finish, evict, trim]
+    for _ in range(400):
+        rng.choice(ops)()
+        _check_invariants(alloc, rx, live)
+    while live:
+        finish()
+        _check_invariants(alloc, rx, live)
+    # drain: everything left is reclaimable; the pool returns whole
+    got = alloc.allocate(alloc.num_free)
+    assert got is not None
+    alloc.release(got)
+    assert alloc.num_truly_free == alloc.num_allocatable

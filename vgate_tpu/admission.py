@@ -69,6 +69,61 @@ def estimate_prompt_tokens(prompt: str) -> int:
     return max(1, len(prompt) // 4)
 
 
+class PrefixHintIndex:
+    """Gateway-side predictor of prefix-cache hits, for cache-aware
+    admission: a 90%-cached request must not be shed as if it were cold.
+
+    The engine's radix tree is token-indexed and lives on the engine
+    thread; admission runs on the event loop and must not tokenize.  So
+    the gateway keeps its own coarse, text-level mirror: a rolling
+    chain hash over fixed-size character blocks of every prompt it has
+    SUBMITTED (once a prompt reaches the engine, its prefix will be in
+    the tree within one prefill).  A new prompt's predicted cached
+    tokens = matched chain blocks * BLOCK_CHARS / 4 (the same
+    chars-per-token rule as the cost estimate itself).  Mispredictions
+    only skew the admission *estimate* — the backlog limits are set in
+    hundreds of thousands of tokens and self-correct as requests
+    settle.  Bounded LRU; event-loop-only (no locking — callers are
+    ``AdmissionController.estimate_cost`` / ``note_submitted`` on the
+    loop thread)."""
+
+    BLOCK_CHARS = 256
+
+    def __init__(self, max_blocks: int = 65536) -> None:
+        from collections import OrderedDict
+
+        self._seen: "OrderedDict[int, None]" = OrderedDict()
+        self.max_blocks = max_blocks
+
+    def _chain(self, prompt: str):
+        h = 0
+        for start in range(
+            0, len(prompt) - self.BLOCK_CHARS + 1, self.BLOCK_CHARS
+        ):
+            # builtin hash chaining: collisions only skew an estimate,
+            # never correctness (the engine matches real tokens)
+            h = hash((h, prompt[start : start + self.BLOCK_CHARS]))
+            yield h
+
+    def observe(self, prompt: str) -> None:
+        for key in self._chain(prompt):
+            if key in self._seen:
+                self._seen.move_to_end(key)
+            else:
+                self._seen[key] = None
+        while len(self._seen) > self.max_blocks:
+            self._seen.popitem(last=False)
+
+    def estimate_cached_chars(self, prompt: str) -> int:
+        matched = 0
+        for key in self._chain(prompt):
+            if key not in self._seen:
+                break
+            self._seen.move_to_end(key)
+            matched += self.BLOCK_CHARS
+        return matched
+
+
 class AdmissionController:
     """Token-budget admission control with strict-priority shedding.
 
@@ -105,6 +160,16 @@ class AdmissionController:
         # per-event shed-rate EWMA (0 = all admitted, 1 = all rejected);
         # one of the three pressure-score inputs
         self._reject_ewma = 0.0
+        # cache-aware admission (admission.prefix_discount > 0): the
+        # text-level hint index predicting each prompt's prefix-cache
+        # hit, so warm requests are charged their *suffix* cost.  The
+        # gateway only enables it when the engine's prefix cache is on.
+        self.hints: Optional[PrefixHintIndex] = (
+            PrefixHintIndex()
+            if float(getattr(cfg, "prefix_discount", 0.0)) > 0
+            else None
+        )
+        self.total_discounted_tokens = 0
         self.total_admitted = 0
         self.total_rejected: Dict[str, int] = {
             r: 0 for r in self.REJECT_REASONS
@@ -132,6 +197,35 @@ class AdmissionController:
         return max(
             0.05, float(self.cfg.tier_fractions.get(tier, 1.0))
         )
+
+    # -- cache-aware cost estimation --
+
+    def estimate_cost(
+        self, prompt: str, max_tokens: int, prefix_cached: bool = True
+    ) -> int:
+        """Estimated tokens this request will actually COST the engine:
+        prompt estimate minus the predicted prefix-cache hit (capped at
+        ``admission.prefix_discount`` of the prompt part — decode cost
+        is never discounted), plus ``max_tokens``.  ``prefix_cached``
+        false (engine cache off) skips the discount.  Callers must pass
+        the SAME value to admit() and release() — compute once."""
+        est = estimate_prompt_tokens(prompt)
+        if self.hints is not None and prefix_cached:
+            cached = self.hints.estimate_cached_chars(prompt) // 4
+            discount = min(
+                cached, int(est * float(self.cfg.prefix_discount))
+            )
+            if discount > 0:
+                self.total_discounted_tokens += discount
+                est -= discount
+        return est + max_tokens
+
+    def note_submitted(self, prompt: str) -> None:
+        """Record a successfully admitted+enqueued prompt in the hint
+        index: its prefix will be resident after one prefill, so later
+        prompts sharing it predict as (partially) cached."""
+        if self.hints is not None:
+            self.hints.observe(prompt)
 
     # -- the admission decision --
 
@@ -348,6 +442,7 @@ class AdmissionController:
                 "inflight_keys": len(self._inflight_by_key),
                 "admitted": self.total_admitted,
                 "rejected": dict(self.total_rejected),
+                "prefix_discounted_tokens": self.total_discounted_tokens,
             }
 
 

@@ -191,6 +191,21 @@ class JaxTPUBackend:
             except Exception:  # pragma: no cover - mid-rebuild race
                 logger.warning("set_spec_suspended failed", exc_info=True)
 
+    def set_prefix_insert_suspended(self, flag: bool) -> None:
+        """Brownout L4 (vgate_tpu/admission.py "bypass cache writes"):
+        stop prefix-tree inserts, keep serving hits (supervised cores
+        delegate; dp routers fan out to every replica)."""
+        fn = getattr(
+            self.core, "set_prefix_insert_suspended", None
+        ) if self.core is not None else None
+        if fn is not None:
+            try:
+                fn(bool(flag))
+            except Exception:  # pragma: no cover - mid-rebuild race
+                logger.warning(
+                    "set_prefix_insert_suspended failed", exc_info=True
+                )
+
     def pressure_signals(self) -> Dict[str, Any]:
         """KV/queue gauges for gateway admission + brownout; empty while
         the core is loading or mid-rebuild (the controllers then fall

@@ -39,7 +39,6 @@ from vgate_tpu.admission import (
     AdmissionController,
     PressureController,
     TierQueue,
-    estimate_prompt_tokens,
     tier_rank,
 )
 from vgate_tpu.backends.base import GenerationResult, SamplingParams
@@ -132,6 +131,20 @@ class RequestBatcher:
         self.admission = AdmissionController(
             self.config.admission, signals=self._pressure_signals
         )
+        # cache-aware admission discounts only make sense when the
+        # engine actually shares prefixes: mirror the engine's own gate
+        # (engine_core disables the prefix cache under pp > 1 — the
+        # suffix-prefill program only exists on the pp == 1 layout), or
+        # pp deployments would discount hits that can never occur
+        self._prefix_cache_on = bool(
+            self.config.tpu.prefix_cache.enabled
+            and int(self.config.tpu.pp) == 1
+        )
+        # brownout L4 mirror (set by _on_pressure_transition): while
+        # the engine's tree inserts are suspended, submitted prompts do
+        # NOT become cache-resident, so the hint index must stop
+        # learning them (note_prompt_submitted)
+        self._prefix_insert_suspended = False
         self.pressure = PressureController(
             self.config.admission,
             self.admission,
@@ -240,6 +253,22 @@ class RequestBatcher:
                 set_spec(level >= 3)
             except Exception:  # pragma: no cover - mid-restart races
                 logger.error("set_spec_suspended failed", exc_info=True)
+        # level 4's "bypass cache writes" covers the KV prefix tree too:
+        # stop inserting, keep serving hits (runtime/radix_cache.py).
+        # The gateway's hint index follows the same policy — granting
+        # the admission discount for prefixes that will never become
+        # resident would admit MORE work exactly as pressure rises
+        self._prefix_insert_suspended = level >= 4
+        set_insert = getattr(
+            self.engine.backend, "set_prefix_insert_suspended", None
+        )
+        if set_insert is not None:
+            try:
+                set_insert(level >= 4)
+            except Exception:  # pragma: no cover - mid-restart races
+                logger.error(
+                    "set_prefix_insert_suspended failed", exc_info=True
+                )
         # resolve the recorder at call time: supervised engines swap
         # cores (and recorders) across restarts
         core = getattr(self.engine.backend, "core", None)
@@ -253,6 +282,16 @@ class RequestBatcher:
                 steps=self.pressure.active_steps(),
                 queue_depth=len(self._queue),
             )
+
+    def note_prompt_submitted(self, prompt: str) -> None:
+        """Teach the admission hint index that this prompt reached the
+        engine — its prefix will be tree-resident after one prefill, so
+        later prompts sharing it admit at their suffix cost.  Gated off
+        while brownout L4 has the engine's tree inserts suspended: the
+        prefix will NOT become resident then, and learning it would
+        grant discounts for hits that cannot materialize."""
+        if self._prefix_cache_on and not self._prefix_insert_suspended:
+            self.admission.note_submitted(prompt)
 
     # -- graceful drain (vgate_tpu/lifecycle.py DrainController) --
 
@@ -405,7 +444,15 @@ class RequestBatcher:
             # deadline 504.  After the cache lookup (a cache-servable
             # request costs nothing) and the health fail-fast (a
             # recovering engine's 503 is the more truthful answer).
-            cost = estimate_prompt_tokens(prompt) + params.max_tokens
+            # cache-aware cost: the estimated prompt cost is discounted
+            # by the predicted prefix-cache hit (admission.PrefixHintIndex)
+            # so a mostly-cached multi-turn request is charged its
+            # suffix, not re-charged its whole transcript every turn
+            cost = self.admission.estimate_cost(
+                prompt,
+                params.max_tokens,
+                prefix_cached=self._prefix_cache_on,
+            )
             self.admission.admit(cost, tier=tier, deadline_s=timeout_s)
             request = BatchRequest(
                 request_id=request_id,
@@ -442,6 +489,7 @@ class RequestBatcher:
                 self._queue.append(request)
                 metrics.PENDING_REQUESTS.set(len(self._queue))
                 trigger = len(self._queue) >= self.config.batch.max_batch_size
+            self.note_prompt_submitted(prompt)
             if cancel_token is not None:
                 # client disconnect: a queued request dequeues + fails
                 # fast; a dispatched one is aborted by the backend (it

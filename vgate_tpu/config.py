@@ -114,6 +114,55 @@ class ModelConfig(BaseModel):
         return v
 
 
+class PrefixCacheConfig(BaseModel):
+    """Cross-request KV prefix sharing (runtime/radix_cache.py;
+    docs/operations.md "Cross-request KV reuse").  Accepts a bare bool
+    for backward compatibility (``tpu.prefix_cache: true`` enables with
+    defaults)."""
+
+    enabled: bool = True
+    # Page-granular radix tree with refcounted sharing, generated-token
+    # reuse and COW partial pages; false falls back to the flat
+    # whole-page hash chain (the pre-radix index, kept for comparison).
+    radix: bool = True
+    # Minimum full pages a match must share to be taken at all — tiny
+    # shares cost tree locks and dispatch complexity for little reuse.
+    min_share_pages: int = 1
+    # Copy-on-write partial-page sharing: device-copy the shared head of
+    # a diverging page so prefill starts mid-page.  Requires sp == 1
+    # (the copy program indexes the unsharded pool).
+    cow: bool = True
+    # Shared tokens inside the diverging page below this are recomputed
+    # instead of copied (a device copy has dispatch overhead).
+    cow_min_tokens: int = 8
+    # Index a finished sequence's generated tokens too (multi-turn chat:
+    # turn N+1 re-sends turn N's answer inside its prompt).
+    insert_generated: bool = True
+    # Scheduler prefers admitting waiting work that shares resident tree
+    # nodes (bounded FIFO bypass), keeping hot prefixes co-batched.
+    cache_aware_sched: bool = True
+    # Proactive eviction: keep at least this fraction of the pool truly
+    # free by trimming cold cache (reason="pressure") from the engine
+    # tick — ahead of admission's kv_free_watermark shedding.
+    evict_watermark: float = 0.08
+
+    @field_validator("min_share_pages")
+    @classmethod
+    def _check_min_share(cls, v: int) -> int:
+        if v < 1:
+            raise ValueError("prefix_cache.min_share_pages must be >= 1")
+        return v
+
+    @field_validator("evict_watermark")
+    @classmethod
+    def _check_watermark(cls, v: float) -> float:
+        if not 0.0 <= v < 1.0:
+            raise ValueError(
+                "prefix_cache.evict_watermark must be in [0, 1)"
+            )
+        return v
+
+
 class TPUConfig(BaseModel):
     """Device mesh + engine shape settings (TPU-only addition, SURVEY.md 5.6).
 
@@ -233,10 +282,25 @@ class TPUConfig(BaseModel):
     # (the top bucket covers max_model_len, the r2 behavior).  Requires
     # sp == 1 and pp == 1 (those reshape the prompt pass).
     prefill_chunk: int = 0
-    # Automatic prefix caching: full prompt pages are content-hashed and
-    # shared across requests; a prefix hit prefills only the suffix.
-    # Disabled automatically when sp>1 or pp>1 (those reshape the prefill).
-    prefix_cache: bool = True
+    # Cross-request KV prefix sharing (runtime/radix_cache.py): prompt
+    # (and, with the radix tree, generated) pages are content-indexed
+    # and shared across requests; a prefix hit prefills only the
+    # suffix.  A bare bool is accepted (`prefix_cache: false`) and
+    # coerced to {enabled: false}.  Disabled automatically when pp>1
+    # (the relay prompt pass reshapes incompatibly).
+    prefix_cache: PrefixCacheConfig = Field(
+        default_factory=PrefixCacheConfig
+    )
+
+    @field_validator("prefix_cache", mode="before")
+    @classmethod
+    def _coerce_prefix_cache(cls, v):
+        # the knob shipped as a bool through r5; a bare bool (config
+        # files, env VGT_TPU__PREFIX_CACHE=false, test kwargs) keeps
+        # working as the master switch
+        if isinstance(v, bool):
+            return {"enabled": v}
+        return v
     # Speculative decoding: each decode round verifies up to
     # `speculative_k` drafted tokens in ONE forward pass, so accepted
     # drafts cost one model read for several tokens.  Greedy rows
@@ -403,6 +467,12 @@ class AdmissionConfig(BaseModel):
     # Decode-throughput EWMA feeding the queue-wait estimate.
     throughput_alpha: float = 0.3
     throughput_init_tps: float = 400.0
+    # Cache-aware admission (vgate_tpu/admission.py PrefixHintIndex):
+    # discount a request's estimated prompt cost by its predicted
+    # prefix-cache hit, capped at this fraction of the prompt estimate
+    # — a 90%-cached request must not be shed as if it were cold.
+    # 0 disables; only meaningful with tpu.prefix_cache enabled.
+    prefix_discount: float = 0.9
 
     # -- adaptive brownout (PressureController) --
     brownout_enabled: bool = True
@@ -444,6 +514,15 @@ class AdmissionConfig(BaseModel):
                     f"admission.key_tiers[{key!r}] must be one of "
                     f"{VALID_TIERS}, got {tier!r}"
                 )
+        return v
+
+    @field_validator("prefix_discount")
+    @classmethod
+    def _check_prefix_discount(cls, v: float) -> float:
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(
+                "admission.prefix_discount must be in [0, 1]"
+            )
         return v
 
     @field_validator("brownout_engage")

@@ -304,6 +304,39 @@ PRESSURE_TRANSITIONS = _safe_metric(
     labelnames=("direction",),  # up | down
 )
 
+# --- cross-request KV prefix cache (runtime/radix_cache.py + kv_cache.py) ---
+PREFIX_HIT_TOKENS = _safe_metric(
+    Counter,
+    "vgt_prefix_hit_tokens",
+    "Prompt tokens served from shared KV pages instead of prefilled "
+    "(prefix-cache hits, radix or flat-chain)",
+)
+PREFIX_HIT_PAGES = _safe_metric(
+    Counter,
+    "vgt_prefix_hit_pages",
+    "Whole KV pages shared at admission via the prefix cache",
+)
+PREFIX_CACHED_PAGES = _safe_metric(
+    Gauge,
+    "vgt_prefix_cached_pages",
+    "KV pages holding reusable cached prefix content not referenced by "
+    "any running sequence (reclaimable under pressure)",
+)
+PREFIX_EVICTIONS = _safe_metric(
+    Counter,
+    "vgt_prefix_evictions",
+    "Cached prefix pages evicted, by reason (lru = reclaimed on "
+    "allocation demand, pressure = proactive trim below "
+    "tpu.prefix_cache.evict_watermark)",
+    labelnames=("reason",),  # lru | pressure
+)
+PREFIX_COW_COPIES = _safe_metric(
+    Counter,
+    "vgt_prefix_cow_copies",
+    "Copy-on-write page copies: a request diverged inside a shared KV "
+    "page and the shared head was device-copied into a fresh page",
+)
+
 INFO = _safe_metric(Info, "vgt_build", "Framework build information")
 
 

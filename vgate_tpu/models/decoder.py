@@ -440,7 +440,7 @@ def prefill_forward(
 
 def _prefill_qkv_write(
     h, lp, spec: ModelSpec, positions, page_tables, k_pages_l, v_pages_l,
-    layer=None,
+    layer=None, offsets=None,
 ):
     """Shared prompt-pass front half: norm + qkv projection + rope at the
     given (possibly offset) positions, then write this layer's KV into its
@@ -448,7 +448,16 @@ def _prefill_qkv_write(
     [KV, P, ps, hd]: the fresh KV transposes to [KV, B, n_pages, ps, hd]
     so each head's pages land contiguously.  With ``layer`` (a traced
     scalar) the pools carry a leading [L] dim and the write is a
-    layer-indexed in-place update — the carry-threaded prompt pass."""
+    layer-indexed in-place update — the carry-threaded prompt pass.
+
+    ``offsets`` ([B] int32) switches to the UNALIGNED write used by
+    copy-on-write prefix sharing (runtime/radix_cache.py): row ``b``'s
+    first token lands at slot ``offsets[b]`` of its first page (the COW
+    page, whose head holds the copied shared KV and must not be
+    clobbered), so writes become a per-token (page, slot) scatter
+    instead of whole-page sets.  ``page_tables`` must then carry one
+    extra page column (``S // ps + 1``): an offset start can spill the
+    suffix into one more page."""
     B, S = h.shape[:2]
     ps = k_pages_l.shape[-2]
     n_pages = S // ps
@@ -458,6 +467,27 @@ def _prefill_qkv_write(
     q, k, v = _project_qkv(normed, lp, spec)
     q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
     k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
+    if offsets is not None:
+        idx = offsets[:, None] + jnp.arange(S)[None, :]  # [B, S] in-suffix
+        slot = idx % ps
+        pages_bs = jnp.take_along_axis(page_tables, idx // ps, axis=1)
+        k_t = k.reshape(B, S, spec.num_kv_heads, spec.head_dim)
+        v_t = v.reshape(B, S, spec.num_kv_heads, spec.head_dim)
+        if layer is None:
+            # advanced indices (dims 1, 2) are adjacent: update shape
+            # [KV, B, S, hd]
+            k_pages_l = k_pages_l.at[:, pages_bs, slot].set(
+                jnp.transpose(k_t, (2, 0, 1, 3))
+            )
+            v_pages_l = v_pages_l.at[:, pages_bs, slot].set(
+                jnp.transpose(v_t, (2, 0, 1, 3))
+            )
+        else:
+            # scalar layer + slice + advanced: broadcast (B, S) dims
+            # move to the FRONT — update shape [B, S, KV, hd]
+            k_pages_l = k_pages_l.at[layer, :, pages_bs, slot].set(k_t)
+            v_pages_l = v_pages_l.at[layer, :, pages_bs, slot].set(v_t)
+        return q, k, v, k_pages_l, v_pages_l
     pt = page_tables[:, :n_pages]
     if layer is None:
         k_resh = jnp.transpose(
@@ -763,27 +793,37 @@ def prefill_suffix_forward(
     suffix_lens: jnp.ndarray,  # [B] real suffix tokens (<= S)
     k_pages: jnp.ndarray,  # [L, KV, P, ps, hd]
     v_pages: jnp.ndarray,
-    suffix_page_tables: jnp.ndarray,  # [B, S // ps] pages the suffix fills
+    suffix_page_tables: jnp.ndarray,  # [B, S // ps (+1 if unaligned)]
     ctx_page_tables: jnp.ndarray,  # [B, ctx_pages] window covering prefix+suffix
     kv_carry: bool = False,  # thread FULL KV buffers as scan carry
     use_pallas: bool = False,  # multitok kernel for the context attention
     mesh=None,  # sp>1 routes write+attention through the sp shard path
+    unaligned: bool = False,  # COW prefix sharing: prefix_lens % ps != 0
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prompt pass for only the uncached suffix of a prefix-cache hit.
 
     The first ``prefix_lens`` tokens' KV is already resident in shared
     pages (runtime/kv_cache.py prefix caching) — this writes just the
-    suffix KV into its own pages (the suffix starts page-aligned, so it
-    packs pages from offset 0 exactly like a fresh prefill) and attends
-    suffix-queries vs the paged context window (ops/attention.py
+    suffix KV into its own pages (page-aligned suffixes pack pages from
+    offset 0 exactly like a fresh prefill) and attends suffix-queries
+    vs the paged context window (ops/attention.py
     paged_suffix_attention, blockwise).  The saved work is the whole
     prefix prompt pass: O(prefix) projections + O(S * prefix) attention
-    FLOPs never run.  Returns (last-token logits [B, V], k_pages,
-    v_pages).
+    FLOPs never run.
+
+    ``unaligned`` is the copy-on-write variant (runtime/radix_cache.py):
+    ``prefix_lens`` may fall mid-page, the first suffix token writes at
+    slot ``prefix_lens % ps`` of the COW page (whose head holds the
+    device-copied shared KV), and ``suffix_page_tables`` carries one
+    extra page column.  The attention masks are positional already, so
+    only the KV write changes (scatter instead of whole-page sets);
+    sp > 1 never takes this variant (the engine gates COW off there).
+    Returns (last-token logits [B, V], k_pages, v_pages).
     """
     B, S = tokens.shape
     positions = prefix_lens[:, None] + jnp.arange(S)[None, :]  # absolute
     total_lens = prefix_lens + suffix_lens
+    offsets = (prefix_lens % k_pages.shape[-2]) if unaligned else None
     x = _embed(params, spec, tokens)  # [B, S, D]
 
     sp_mesh = (
@@ -834,7 +874,7 @@ def prefill_suffix_forward(
     # path beyond (row-tiling the kernel is the future fix).  tp>1:
     # the jnp path auto-partitions; the kernel would be GSPMD-
     # replicated (parallel/tp_attention.py rationale), so gate it off.
-    use_pallas = use_pallas and S <= 1024
+    use_pallas = use_pallas and S <= 1024 and not unaligned
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         use_pallas = False
     if use_pallas:
@@ -849,7 +889,7 @@ def prefill_suffix_forward(
     def body(h, lp, win, kp, vp, layer):
         q, _k, _v, kp, vp = _prefill_qkv_write(
             h, lp, spec, positions, suffix_page_tables, kp, vp,
-            layer=layer,
+            layer=layer, offsets=offsets,
         )
         window = win if spec.sliding_window > 0 else None
         if use_pallas:

@@ -517,6 +517,13 @@ class ReplicatedEngine:
         for core in self.replicas:
             core.set_spec_suspended(flag)
 
+    def set_prefix_insert_suspended(self, flag: bool) -> None:
+        """Brownout L4 fan-out: every replica stops/resumes prefix-tree
+        inserts together (dead replicas included, same rationale as the
+        spec-suspension fan-out)."""
+        for core in self.replicas:
+            core.set_prefix_insert_suspended(flag)
+
     def pressure_signals(self) -> Dict[str, Any]:
         """Admission/brownout gauges aggregated across replicas: the
         WORST KV free ratio (one full replica is where new work lands

@@ -74,6 +74,7 @@ from vgate_tpu.runtime.kv_cache import (
     auto_num_pages,
     make_kv_buffers,
 )
+from vgate_tpu.runtime.radix_cache import RadixCache
 from vgate_tpu.runtime.scheduler import PrefillPlan, Scheduler
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.runtime.tokenizer import get_tokenizer
@@ -138,7 +139,7 @@ def _prefill_step(
 @functools.partial(
     jax.jit,
     static_argnames=("spec", "num_logprobs", "kv_carry", "use_pallas",
-                     "mesh"),
+                     "mesh", "unaligned"),
     donate_argnames=("k_pages", "v_pages"),
 )
 def _suffix_prefill_step(
@@ -148,13 +149,16 @@ def _suffix_prefill_step(
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, kv_carry: bool = False,
     bias_ids=None, bias_vals=None, use_pallas: bool = False, mesh=None,
+    unaligned: bool = False,
 ):
     """Prompt pass for the uncached suffix of a prefix-cache hit, with
-    fused first-token sampling (models/decoder.py prefill_suffix_forward)."""
+    fused first-token sampling (models/decoder.py prefill_suffix_forward).
+    ``unaligned`` is the copy-on-write variant: prefix_lens may fall
+    mid-page and the KV write becomes a per-token scatter."""
     logits, k_pages, v_pages = prefill_suffix_forward(
         params, spec, tokens, prefix_lens, suffix_lens, k_pages, v_pages,
         suffix_page_tables, ctx_page_tables, kv_carry=kv_carry,
-        use_pallas=use_pallas, mesh=mesh,
+        use_pallas=use_pallas, mesh=mesh, unaligned=unaligned,
     )
     if counts is not None:
         logits = apply_penalties(logits, counts, freq_pens, pres_pens)
@@ -172,6 +176,24 @@ def _suffix_prefill_step(
         logits, temps, top_ps, top_ks, key, seeds=seeds, steps=steps
     )
     return (next_tokens, None), k_pages, v_pages
+
+
+@functools.partial(jax.jit, donate_argnames=("k_pages", "v_pages"))
+def _cow_copy_pages(k_pages, v_pages, src, dst, upto):
+    """Copy-on-write page copy (runtime/radix_cache.py): duplicate the
+    first ``upto`` token slots of page ``src`` into page ``dst`` across
+    every layer and head, so a sequence diverging mid-page gets the
+    shared head's KV without recomputing it.  Scalars are traced — one
+    compile serves every (src, dst, upto) combination."""
+    ps = k_pages.shape[-2]
+    keep = (jnp.arange(ps) < upto)[:, None]  # [ps, 1] broadcasts over hd
+    k_pages = k_pages.at[:, :, dst].set(
+        jnp.where(keep, k_pages[:, :, src], k_pages[:, :, dst])
+    )
+    v_pages = v_pages.at[:, :, dst].set(
+        jnp.where(keep, v_pages[:, :, src], v_pages[:, :, dst])
+    )
+    return k_pages, v_pages
 
 
 def _decode_step(
@@ -431,6 +453,12 @@ def rebuild_core(
     new_core.spec_suspended = bool(
         getattr(old, "spec_suspended", False)
     )
+    # same carry for brownout L4: a crash while cache writes were
+    # bypassed must not silently resume prefix-tree inserts (the method
+    # also propagates the flag onto the fresh core's radix cache)
+    new_core.set_prefix_insert_suspended(
+        getattr(old, "prefix_insert_suspended", False)
+    )
     return new_core
 
 
@@ -629,9 +657,28 @@ class EngineCore:
         # still reshapes the prompt pass incompatibly
         mesh_sp = int(self.mesh.shape.get("sp", 1))
         mesh_pp = int(self.mesh.shape.get("pp", 1))
-        self.prefix_cache_enabled = bool(
-            tpu_cfg.prefix_cache and mesh_pp == 1
-        )
+        pc = tpu_cfg.prefix_cache
+        self.prefix_cache_enabled = bool(pc.enabled and mesh_pp == 1)
+        # radix-tree prefix index (runtime/radix_cache.py): page-granular
+        # cross-request sharing with COW partial pages and
+        # pressure-integrated eviction; the tree registers itself as the
+        # allocator's reclaimer so cached pages stay allocatable.  COW
+        # needs the unsharded pool (the copy program indexes pages
+        # globally), so it gates off under sp > 1 while full-page radix
+        # sharing stays on.
+        self.radix_cache = None
+        if self.prefix_cache_enabled and pc.radix:
+            self.radix_cache = RadixCache(
+                self.allocator,
+                tpu_cfg.kv_page_size,
+                min_share_pages=pc.min_share_pages,
+                cow=bool(pc.cow and mesh_sp == 1),
+                cow_min_tokens=pc.cow_min_tokens,
+            )
+            self.allocator.set_reclaimer(self.radix_cache)
+        # brownout L4 upstream state, carried across supervisor rebuilds
+        # exactly like spec_suspended
+        self.prefix_insert_suspended = False
         if tpu_cfg.prefill_chunk > 0 and mesh_pp > 1:
             raise ValueError(
                 "prefill_chunk (chunked prefill) requires pp == 1 — the "
@@ -657,6 +704,10 @@ class EngineCore:
             prefill_chunk=tpu_cfg.prefill_chunk,
             text_fn=self.final_text,
             recorder=self.flight,
+            radix=self.radix_cache,
+            cache_aware_sched=pc.cache_aware_sched,
+            insert_generated=pc.insert_generated,
+            evict_watermark=pc.evict_watermark,
         )
 
         # host-side mirror of the device page tables, one row per slot
@@ -1417,6 +1468,11 @@ class EngineCore:
         self._drain_abort_requests()
         self._handle_aborts()
         self._handle_deadlines()
+        # proactive prefix-cache trim (two int compares when healthy):
+        # keep truly-free pages above the evict watermark so allocation
+        # bursts never pay the eviction walk synchronously and
+        # admission's kv_pressure shedding only ever sees a drained cache
+        self.scheduler.maybe_trim()
         if self.spec_k > 0 and not self.spec_suspended:
             if self._pending_chunks:
                 # chunked decode ran while a brownout suspended
@@ -1692,8 +1748,10 @@ class EngineCore:
                 )
         # group same-bucket plans into batched dispatches; prefix-cache
         # hits (suffix-only prompt pass) compile a different program and
-        # group separately.  Chunked plans (prompt > the bucket cap) run
-        # serial suffix passes and never batch with others.
+        # group separately, as do COW hits (unaligned start: the write
+        # is a scatter and the suffix table carries an extra column).
+        # Chunked plans (prompt > the bucket cap) run serial suffix
+        # passes and never batch with others.
         by_bucket: Dict[tuple, List[PrefillPlan]] = {}
         dispatched = []  # (group plans, [B] device tokens)
         for plan in plans:
@@ -1702,24 +1760,35 @@ class EngineCore:
                     ([plan], self._dispatch_chunked_prefill(plan))
                 )
                 continue
-            key = (plan.bucket, plan.cached_len > 0)
+            key = (
+                plan.bucket,
+                plan.cached_len > 0,
+                plan.cached_len % self.geometry.page_size != 0,
+            )
             by_bucket.setdefault(key, []).append(plan)
         batch_max = max(1, self.config.tpu.prefill_batch_max)
-        for (bucket, cached), group in sorted(by_bucket.items()):
+        for (bucket, cached, unaligned), group in sorted(by_bucket.items()):
             for i in range(0, len(group), batch_max):
                 chunk = group[i : i + batch_max]
-                fn = (
-                    self._dispatch_suffix_group
-                    if cached
-                    else self._dispatch_prefill_group
-                )
-                dispatched.append((chunk, fn(chunk, bucket)))
+                if cached:
+                    handle = self._dispatch_suffix_group(
+                        chunk, bucket, unaligned=unaligned
+                    )
+                else:
+                    handle = self._dispatch_prefill_group(chunk, bucket)
+                dispatched.append((chunk, handle))
         # index the freshly-filled prompt pages only now, with every
         # writer program enqueued: a reader admitted in a LATER tick is
-        # guaranteed to dispatch after the writer (device program order)
+        # guaranteed to dispatch after the writer (device program order).
+        # A sequence a watchdog containment checkpointed mid-dispatch
+        # (its pages are already released) must not be indexed — the
+        # epoch guard mirrors the readback one below.
         for plan in plans:
-            for page, h in plan.register_hashes or ():
-                self.allocator.register(page, h)
+            stale = (
+                plan.seq.status is not SeqStatus.RUNNING
+                or plan.seq.preempt_count != plan_epochs[id(plan)]
+            )
+            self.scheduler.commit_prefill(plan, stale=stale)
         self._beat("prefill_readback", batch=len(plans))
         firsts = jax.device_get([h for _, h in dispatched])  # [(tok, lp)]
         # batched admission costs one combined dispatch+readback; attribute
@@ -1968,25 +2037,47 @@ class EngineCore:
 
     @staticmethod
     def _suffix_key(
-        bucket, B, ctx_pages, has_pen, mt_width, num_lp, lb_width
+        bucket, B, ctx_pages, has_pen, mt_width, num_lp, lb_width,
+        unaligned=False,
     ):
         """Compile-variant key for one _suffix_prefill_step shape — the
         single definition both the batched suffix-group dispatch and
         the chunked-prefill loop count RECOMPILES against."""
         return (
             "suffix", bucket, B, ctx_pages, has_pen, mt_width, num_lp,
-            lb_width,
+            lb_width, unaligned,
         )
 
-    def _dispatch_suffix_group(self, plans: List[PrefillPlan], bucket: int):
+    def _dispatch_suffix_group(
+        self, plans: List[PrefillPlan], bucket: int, unaligned: bool = False
+    ):
         """Launch ONE suffix-prefill program for up to prefill_batch_max
         prefix-cache hits whose suffix lengths share a bucket.  The cached
         prefix pages are read-only shared KV; only the suffix pages are
-        written.  Returns the (async) [B] first-token device array."""
+        written.  ``unaligned`` is the COW group: each plan's page copy
+        is dispatched first (device program order guarantees the copy
+        reads the source before any later program could reuse it), the
+        suffix then starts mid-page and the suffix table carries one
+        extra column.  Returns the (async) [B] first-token device array."""
         n = len(plans)
         B = 1 << (n - 1).bit_length()
         ps = self.geometry.page_size
-        n_suffix_pages = bucket // ps
+        n_suffix_pages = bucket // ps + (1 if unaligned else 0)
+        # copy-on-write: duplicate the shared head of each diverging
+        # page into the sequence's own first page BEFORE the suffix
+        # program that writes the rest of that page
+        for plan in plans:
+            if plan.cow is not None:
+                src, dst, upto = plan.cow
+                self.k_pages, self.v_pages = _cow_copy_pages(
+                    self.k_pages, self.v_pages,
+                    jnp.asarray(src, jnp.int32),
+                    jnp.asarray(dst, jnp.int32),
+                    jnp.asarray(upto, jnp.int32),
+                )
+                if self.radix_cache is not None:
+                    self.radix_cache.total_cow_copies += 1
+                metrics.PREFIX_COW_COPIES.inc()
         # context window bucketed to a power of two of pages: bounds both
         # the KV gather and the compile-variant count
         max_ctx_pages = max(
@@ -2042,6 +2133,7 @@ class EngineCore:
             bucket, B, ctx_pages, pen_counts is not None,
             None if mt is None else mt_ids.shape[1], num_lp,
             None if lb_ids is None else lb_ids.shape[1],
+            unaligned=unaligned,
         )
         fresh = key not in self._compiled_buckets
         if fresh:
@@ -2080,8 +2172,11 @@ class EngineCore:
             kv_carry=self._kv_carry,
             bias_ids=lb_ids,
             bias_vals=lb_vals,
-            use_pallas=self.use_pallas,
+            # the multitok kernel's DMA ranges assume page-aligned
+            # starts; COW groups take the blockwise jnp path
+            use_pallas=self.use_pallas and not unaligned,
             mesh=self._mt_mesh,
+            unaligned=unaligned,
         )
         return out  # (first tokens [B], logprob triple or None)
 
@@ -2926,14 +3021,34 @@ class EngineCore:
         in-flight decode chunks before the first spec round."""
         self.spec_suspended = bool(flag)
 
+    def set_prefix_insert_suspended(self, flag: bool) -> None:
+        """Brownout hook (vgate_tpu/admission.py L4 "bypass cache
+        writes"): stop inserting into the prefix tree, keep serving
+        hits — under saturation new cache content mostly evicts warmer
+        content, while existing hits still save prefill compute.  Safe
+        from any thread (bool stores are atomic under the GIL); carried
+        across supervisor rebuilds like spec_suspended."""
+        self.prefix_insert_suspended = bool(flag)
+        if self.radix_cache is not None:
+            self.radix_cache.insert_suspended = bool(flag)
+
     def pressure_signals(self) -> Dict[str, Any]:
         """Cheap cross-thread gauges for the gateway's admission and
         brownout controllers: plain int/len reads only (atomic enough
         under the GIL for control decisions — no locks, no device
-        touches)."""
+        touches).  ``kv_free_ratio`` counts reclaimable cached pages as
+        free (a warm prefix cache must not shed admissions);
+        ``kv_truly_free_ratio`` excludes them — the gap between the two
+        is the reclaimable cache."""
         total = max(1, self.allocator.num_allocatable)
         return {
             "kv_free_ratio": round(self.allocator.num_free / total, 4),
+            "kv_truly_free_ratio": round(
+                self.allocator.num_truly_free / total, 4
+            ),
+            "prefix_cached_ratio": round(
+                self.allocator.num_cached / total, 4
+            ),
             "engine_queue_depth": len(self.scheduler.waiting),
             "running": len(self.scheduler.running),
         }

@@ -142,11 +142,22 @@ class PageAllocator:
         self._page_hash: Dict[int, int] = {}
         # refcount-0 pages with live cached content, in LRU order
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        # radix-tree prefix cache (runtime/radix_cache.py): holds its own
+        # references on cached pages and reclaims them on demand when
+        # the free list runs short — the tree-mode replacement for the
+        # flat _evictable LRU above
+        self._reclaimer = None
         self.prefix_hits = 0
         self.prefix_evictions = 0
         self._allocatable = num_pages - len(self.reserved)
         metrics.KV_PAGES_TOTAL.set(self._allocatable)
         metrics.KV_PAGES_IN_USE.set(0)
+
+    def set_reclaimer(self, reclaimer) -> None:
+        """Attach a cache that can free refcounted pages on demand
+        (``evictable_pages() -> int`` and ``reclaim(n) -> int freed``).
+        Reclaimable pages count as obtainable in ``num_free``."""
+        self._reclaimer = reclaimer
 
     @property
     def num_allocatable(self) -> int:
@@ -155,8 +166,16 @@ class PageAllocator:
 
     @property
     def num_free(self) -> int:
-        """Pages obtainable by allocate(): truly free + evictable cached."""
-        return len(self._free) + len(self._evictable)
+        """Pages obtainable by allocate(): truly free + evictable cached
+        (flat LRU or reclaimable radix-tree pages)."""
+        return len(self._free) + self.num_cached
+
+    @property
+    def num_truly_free(self) -> int:
+        """Pages obtainable without evicting cache — the proactive-trim
+        watermark (radix_cache.trim_to_watermark) keys off this so
+        eviction cost is paid ahead of the allocation hot path."""
+        return len(self._free)
 
     @property
     def num_used(self) -> int:
@@ -164,6 +183,8 @@ class PageAllocator:
 
     @property
     def num_cached(self) -> int:
+        if self._reclaimer is not None:
+            return self._reclaimer.evictable_pages()
         return len(self._evictable)
 
     def allocate(self, n: int) -> Optional[List[int]]:
@@ -173,18 +194,42 @@ class PageAllocator:
         faults.check("kv_alloc", payload=n)
         if n > self.num_free:
             return None
+        if self._reclaimer is not None:
+            short = n - len(self._free)
+            if short > 0 and self._reclaimer.reclaim(short) < short:
+                # a reclaimable page was still referenced (lock races
+                # are excluded by design; defensive all-or-nothing)
+                return None  # pragma: no cover - lock invariant holds
         pages = []
         for _ in range(n):
             if self._free:
                 page = self._free.popleft()
-            else:  # evict the LRU cached page
+            else:  # evict the LRU cached page (flat-chain mode)
                 page, _ = self._evictable.popitem(last=False)
                 self._drop_hash(page)
                 self.prefix_evictions += 1
+                metrics.PREFIX_EVICTIONS.labels(reason="lru").inc()
             self._refs[page] = 1
             pages.append(page)
         metrics.KV_PAGES_IN_USE.set(self.num_used)
         return pages
+
+    def refcount(self, page: int) -> int:
+        """Live reference count of a page (0 = free/parked)."""
+        return self._refs.get(page, 0)
+
+    def retain(self, pages: List[int]) -> None:
+        """Take an extra reference on already-allocated pages (prefix
+        sharing: the radix tree and each matching sequence hold their
+        own reference; release() drops them symmetrically)."""
+        for page in pages:
+            refs = self._refs.get(page, 0)
+            if refs <= 0:
+                # a retained page must already be live — retaining a
+                # free page would let allocate() hand it out again
+                raise ValueError(f"retain of unreferenced page {page}")
+            self._refs[page] = refs + 1
+        metrics.KV_PAGES_IN_USE.set(self.num_used)
 
     def release(self, pages: List[int]) -> None:
         for page in pages:
@@ -202,6 +247,8 @@ class PageAllocator:
             else:
                 self._free.append(page)
         metrics.KV_PAGES_IN_USE.set(self.num_used)
+        if self._reclaimer is None and self._page_hash:
+            metrics.PREFIX_CACHED_PAGES.set(len(self._evictable))
 
     # ----------------------------------------------------- prefix caching
 
@@ -234,6 +281,7 @@ class PageAllocator:
         if page in self._evictable:  # revive a parked page
             del self._evictable[page]
             self._refs[page] = 1
+            metrics.PREFIX_CACHED_PAGES.set(len(self._evictable))
         else:
             self._refs[page] = self._refs.get(page, 0) + 1
         metrics.KV_PAGES_IN_USE.set(self.num_used)

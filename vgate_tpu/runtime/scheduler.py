@@ -24,6 +24,7 @@ from vgate_tpu import metrics
 from vgate_tpu.errors import DeadlineExceededError
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.kv_cache import PageAllocator
+from vgate_tpu.runtime.radix_cache import RadixCache, RadixMatch
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.utils.math import bucket_for, cdiv, round_up
 
@@ -60,12 +61,26 @@ class PrefillPlan:
     # resident in shared pages; only the suffix needs the prompt pass.
     # `bucket` then buckets the SUFFIX length, and register_hashes lists
     # (page, chain_hash) pairs to index once this prefill is dispatched.
+    # With the radix tree, cached_len may be UNALIGNED (full shared pages
+    # plus a copy-on-write partial page) and register_hashes stays None —
+    # radix_insert/cow carry the tree bookkeeping instead.
     cached_len: int = 0
     register_hashes: list = None  # type: ignore[assignment]
     # chunked prefill: the (suffix) prompt exceeds the bucket cap and
     # runs as SERIAL suffix passes of `bucket` tokens each
     # (engine_core._dispatch_chunked_prefill)
     chunked: bool = False
+    # copy-on-write partial page: (src_page, dst_page, shared_tokens) —
+    # the engine device-copies the first shared_tokens of src into dst
+    # (the sequence's own page) BEFORE dispatching the suffix prefill,
+    # then prefill starts mid-page at cached_len
+    cow: tuple = None  # type: ignore[assignment]
+    # radix commit data snapshotted at admission: (tokens, pages) of the
+    # full prompt pages this prefill makes indexable, plus the match
+    # handle whose COW lock commit_prefill releases.  Snapshotted so a
+    # containment fold between dispatch and commit cannot skew it.
+    radix_insert: tuple = None  # type: ignore[assignment]
+    radix_match: RadixMatch = None  # type: ignore[assignment]
 
 
 @dataclass
@@ -91,6 +106,10 @@ class Scheduler:
         prefill_chunk: int = 0,
         text_fn=None,
         recorder=None,
+        radix: Optional[RadixCache] = None,
+        cache_aware_sched: bool = True,
+        insert_generated: bool = True,
+        evict_watermark: float = 0.0,
     ) -> None:
         # optional flight recorder (observability/flight.py): residency
         # events (preempt/shed/abort) become post-mortem ring entries
@@ -126,6 +145,20 @@ class Scheduler:
         self.admission_deadline_ms = admission_deadline_ms
         self.total_deadline_shed = 0
         self.prefix_cache = prefix_cache
+        # radix-tree prefix index (runtime/radix_cache.py): replaces the
+        # flat hash chain when provided; None keeps the r2-era flat
+        # whole-page chain (still constructible for comparison)
+        self.radix = radix if prefix_cache else None
+        self.cache_aware_sched = bool(cache_aware_sched)
+        self.insert_generated = bool(insert_generated)
+        # proactive trim target in PAGES (0 disables): the engine tick
+        # calls maybe_trim() so eviction walks run off the allocation
+        # hot path, before admission's kv_pressure watermark engages
+        self._trim_target = 0
+        if self.radix is not None and evict_watermark > 0:
+            self._trim_target = int(
+                evict_watermark * allocator.num_allocatable
+            )
         self.total_prefix_hit_tokens = 0
         self.waiting: Deque[Sequence] = deque()
         # sticky: set once any deadline-bearing sequence is ever queued,
@@ -201,6 +234,17 @@ class Scheduler:
         if head is None or self._free_slot() is None:
             return False
         n_pages = cdiv(max(1, head.num_prompt_tokens), self.page_size)
+        if self.radix is not None:
+            # mirror try_admit's radix accounting: matched pages are
+            # shared, not allocated, but matched pages of UNLOCKED
+            # nodes currently count toward num_free and a real match
+            # would revive them out of that pool — subtract those or
+            # this predicate would say "admissible" where allocate()
+            # then fails (busy-spin + needless decode-chunk shrink)
+            full, evictable = self._radix_probe(head)
+            return (
+                self.allocator.num_free - evictable >= n_pages - full
+            )
         if self.prefix_cache:
             # mirror try_admit's accounting: resident prefix pages are
             # shared, not allocated (peek — no refcount mutation).  A
@@ -355,26 +399,98 @@ class Scheduler:
         seq._prefix_chain_cache = (key, chain)  # type: ignore[attr-defined]
         return chain
 
-    def _select_next(self) -> Optional[Sequence]:
+    def _radix_probe(self, seq: Sequence) -> tuple:
+        """(matched full pages, matched-but-reclaimable pages) for a
+        waiting sequence, memoized per (prompt epoch, tree clock) —
+        cache-aware selection probes several candidates per admission
+        and must not re-walk an unchanged tree."""
+        key = (
+            len(seq.prompt_ids), seq.preempt_count, self.radix._clock
+        )
+        cached = getattr(seq, "_radix_probe_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        result = self.radix.probe(seq.prompt_ids)
+        if result[0] < self.radix.min_share_pages:
+            # match() refuses sub-threshold shares, so crediting them
+            # here would claim admissibility where try_admit must then
+            # allocate every page (busy-spin when it can't), and would
+            # prefer a "warm" candidate that actually admits cold
+            result = (0, 0)
+        seq._radix_probe_cache = (key, result)  # type: ignore[attr-defined]
+        return result
+
+    # bounded FIFO bypass for cache-aware selection: a cold head is
+    # passed over at most this many times before it is admitted
+    # regardless, so warm traffic cannot starve it
+    CACHE_AWARE_MAX_BYPASS = 4
+    # candidates probed per admission (first K of the best tier, queue
+    # order) — bounds the per-tick probe cost under deep queues
+    CACHE_AWARE_LOOKAHEAD = 8
+
+    def _select_next(self, count_bypass: bool = False) -> Optional[Sequence]:
         """Admission candidate: the oldest sequence of the most
         important waiting tier (rank, then seq_id — FIFO within a
         tier; a preempted sequence's old seq_id keeps it ahead of
         younger tier-mates on re-admission).  Aborted sequences are
         skipped here and reaped by ``_reap_aborted``.  Without priority
-        tiers in play this is the head of the queue (O(1))."""
+        tiers in play this is the head of the queue (O(1)).
+
+        With the radix tree and ``cache_aware_sched``, same-tier
+        candidates that share MORE resident tree pages are preferred
+        (bounded lookahead, bounded bypass): admitting warm work while
+        its prefix is locked-resident keeps hot prefixes co-batched and
+        un-evictable, and costs the cold head at most
+        ``CACHE_AWARE_MAX_BYPASS`` admissions of delay.
+        ``count_bypass`` is set only by ``try_admit`` — probe callers
+        (``has_admissible_waiting``) must not age the head."""
         if not self._priority_seen:
+            best = None
             for seq in self.waiting:  # head modulo an aborted prefix
                 if not seq.abort_requested:
-                    return seq
-            return None
-        best = None
+                    best = seq
+                    break
+        else:
+            best = None
+            for seq in self.waiting:
+                if seq.abort_requested:
+                    continue
+                if best is None or (_rank(seq), seq.seq_id) < (
+                    _rank(best), best.seq_id
+                ):
+                    best = seq
+        if (
+            best is None
+            or self.radix is None
+            or not self.cache_aware_sched
+        ):
+            return best
+        if (
+            getattr(best, "_cache_bypassed", 0)
+            >= self.CACHE_AWARE_MAX_BYPASS
+        ):
+            return best
+        best_rank = _rank(best)
+        best_pages = self._radix_probe(best)[0]
+        warm, warm_pages = best, best_pages
+        seen = 0
         for seq in self.waiting:
-            if seq.abort_requested:
+            if seq.abort_requested or _rank(seq) != best_rank:
                 continue
-            if best is None or (_rank(seq), seq.seq_id) < (
-                _rank(best), best.seq_id
+            seen += 1
+            if seen > self.CACHE_AWARE_LOOKAHEAD:
+                break
+            pages = self._radix_probe(seq)[0]
+            if pages > warm_pages or (
+                pages == warm_pages and seq.seq_id < warm.seq_id
             ):
-                best = seq
+                warm, warm_pages = seq, pages
+        if warm is not best and warm_pages > best_pages:
+            if count_bypass:
+                best._cache_bypassed = (  # type: ignore[attr-defined]
+                    getattr(best, "_cache_bypassed", 0) + 1
+                )
+            return warm
         return best
 
     def _dequeue(self, seq: Sequence) -> None:
@@ -410,16 +526,25 @@ class Scheduler:
         slot = self._free_slot()
         if slot is None:
             return None
-        seq = self._select_next()
+        seq = self._select_next(count_bypass=True)
         if seq is None:
             return None
         n_pages = cdiv(max(1, seq.num_prompt_tokens), self.page_size)
 
-        # prefix cache: match the longest chain of full prompt pages
-        # already resident; only the remainder allocates + prefills
+        # prefix cache: match the longest shared prefix already resident;
+        # only the remainder allocates + prefills.  Radix mode walks the
+        # tree (full pages + optional COW partial page); flat mode
+        # matches the whole-page hash chain.
         matched: List[int] = []
         chain: List[bytes] = []
-        if self.prefix_cache:
+        radix_match: Optional[RadixMatch] = None
+        cow_tokens = 0
+        if self.radix is not None:
+            radix_match = self.radix.match(seq.prompt_ids)
+            if radix_match is not None:
+                matched = radix_match.pages
+                cow_tokens = radix_match.cow_tokens
+        elif self.prefix_cache:
             chain = self._prefix_chain(seq)
             for h in chain:
                 page = self.allocator.lookup(h)
@@ -427,9 +552,25 @@ class Scheduler:
                     break
                 matched.append(page)
 
+        if cow_tokens and (
+            seq.num_prompt_tokens
+            - len(matched) * self.page_size
+            - cow_tokens
+            > self.prefill_buckets[-1]
+        ):
+            # the suffix exceeds the bucket cap, so this prefill runs
+            # CHUNKED — serial page-aligned passes that cannot start
+            # mid-page.  Drop the COW tail and recompute those tokens
+            # with the first chunk instead.
+            self.radix.release_cow(radix_match)
+            radix_match.cow_tokens = 0
+            cow_tokens = 0
+
         pages = self.allocator.allocate(n_pages - len(matched))
         if pages is None:
             self.allocator.release(matched)
+            if radix_match is not None:
+                self.radix.unlock(radix_match)
             if self.preempt_on_oom and not self.running:
                 # nothing to preempt and still no memory: the prompt can
                 # never fit — fail it rather than deadlock
@@ -449,16 +590,41 @@ class Scheduler:
         self.slots[slot] = seq
         self.total_admitted += 1
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
-        cached_len = len(matched) * self.page_size
+        cached_len = len(matched) * self.page_size + cow_tokens
         self.total_prefix_hit_tokens += cached_len
         # hits count only on successful admission (a failed allocate above
         # rolls the references back and must not inflate the stat)
         self.allocator.prefix_hits += len(matched)
-        # pages this prefill will fill (full prompt pages beyond the
-        # matched prefix), for the ENGINE to index AFTER it dispatched the
-        # program — registering here would let a same-tick reader's
-        # program be grouped ahead of this writer's and gather unwritten
-        # pages (same-wave identical prompts are the batcher dedup's job)
+        if cached_len:
+            metrics.PREFIX_HIT_TOKENS.inc(cached_len)
+            metrics.PREFIX_HIT_PAGES.inc(len(matched))
+        cow = None
+        if cow_tokens:
+            # dst = the sequence's first OWN page: the engine copies the
+            # shared head of the diverging source page into it, then the
+            # suffix prefill starts mid-page at cached_len
+            cow = (radix_match.cow_src, pages[0], cow_tokens)
+        if radix_match is not None:
+            # the sequence's release path must drop the tree path locks
+            seq._radix_match = radix_match  # type: ignore[attr-defined]
+        radix_insert = None
+        if self.radix is not None:
+            # snapshot what this prefill makes indexable (all full
+            # prompt pages): commit_prefill inserts it after dispatch.
+            # Snapshotted NOW so a watchdog containment folding the
+            # sequence mid-dispatch cannot skew the commit data.
+            n_full = seq.num_prompt_tokens // self.page_size
+            if n_full > len(matched):
+                radix_insert = (
+                    list(seq.prompt_ids[: n_full * self.page_size]),
+                    list(seq.pages[:n_full]),
+                )
+        # flat mode: pages this prefill will fill (full prompt pages
+        # beyond the matched prefix), for the ENGINE to index AFTER it
+        # dispatched the program — registering here would let a
+        # same-tick reader's program be grouped ahead of this writer's
+        # and gather unwritten pages (same-wave identical prompts are
+        # the batcher dedup's job)
         register_hashes = [
             (seq.pages[i], chain[i]) for i in range(len(matched), len(chain))
         ]
@@ -469,12 +635,58 @@ class Scheduler:
             return PrefillPlan(
                 seq=seq, slot=slot, bucket=top, cached_len=cached_len,
                 register_hashes=register_hashes, chunked=True,
+                cow=cow, radix_insert=radix_insert,
+                radix_match=radix_match,
             )
         bucket = bucket_for(suffix_len, self.prefill_buckets)
         return PrefillPlan(
             seq=seq, slot=slot, bucket=bucket, cached_len=cached_len,
             register_hashes=register_hashes,
+            cow=cow, radix_insert=radix_insert, radix_match=radix_match,
         )
+
+    def commit_prefill(self, plan: PrefillPlan, stale: bool = False) -> None:
+        """Index the pages a dispatched prefill has made reusable —
+        called by the engine AFTER every writer program of the admission
+        wave is enqueued, so a reader admitted in a later tick provably
+        dispatches after the writer.  Flat mode registers the chain
+        hashes; radix mode inserts the admission-time snapshot and
+        releases the COW source lock.  ``stale`` (the sequence was
+        checkpointed by a watchdog containment mid-dispatch) skips the
+        insert — its snapshot pages were already released — but still
+        drops the COW lock."""
+        if self.radix is not None:
+            if plan.radix_match is not None:
+                self.radix.release_cow(plan.radix_match)
+            if plan.radix_insert is not None and not stale:
+                tokens, pages = plan.radix_insert
+                node = self.radix.insert(tokens, pages)
+                if node is not None:
+                    # the adopted pages are still referenced by the
+                    # RUNNING sequence: pin the path until its release
+                    # (_radix_unlock), or eviction would count/strip
+                    # seq-referenced pages as reclaimable
+                    self.radix.lock_node(node)
+                    plan.seq._radix_insert_node = (  # type: ignore[attr-defined]
+                        node
+                    )
+            return
+        if stale:
+            return
+        for page, h in plan.register_hashes or ():
+            self.allocator.register(page, h)
+
+    def maybe_trim(self) -> None:
+        """Proactive cache trim (engine tick): keep the truly-free list
+        above the evict watermark by evicting cold tree pages, so
+        allocation bursts never pay the eviction walk synchronously and
+        admission's kv_pressure shedding only engages when the pool is
+        genuinely exhausted."""
+        if (
+            self._trim_target
+            and self.allocator.num_truly_free < self._trim_target
+        ):
+            self.radix.trim_to_watermark(self._trim_target)
 
     def prepare_decode(
         self, active: List[Sequence], horizon: int = 1
@@ -563,6 +775,7 @@ class Scheduler:
         if seq.trace is not None:
             seq.trace.preempted()
         slot = seq.slot
+        self._radix_unlock(seq)
         self.allocator.release(seq.pages)
         if slot is not None:
             self.slots[slot] = None
@@ -575,7 +788,46 @@ class Scheduler:
 
     # -- completion --
 
+    def _radix_unlock(self, seq: Sequence) -> None:
+        """Drop the sequence's tree path locks (idempotent; its page
+        references are released with the rest of ``seq.pages``) — both
+        the match-time path lock and the commit-time pin on the node
+        holding its own adopted prompt pages."""
+        if self.radix is None:
+            return
+        match = getattr(seq, "_radix_match", None)
+        if match is not None:
+            self.radix.unlock(match)
+            seq._radix_match = None  # type: ignore[attr-defined]
+        node = getattr(seq, "_radix_insert_node", None)
+        if node is not None:
+            self.radix.unlock_node(node)
+            seq._radix_insert_node = None  # type: ignore[attr-defined]
+
+    def _radix_insert_final(self, seq: Sequence) -> None:
+        """Index a finishing sequence's GENERATED tokens too: turn N+1
+        of a chat re-sends turn N's answer inside its prompt, so the
+        transcript's full pages are exactly what the next request
+        matches.  Valid KV covers positions ``0 .. total_len - 2`` (the
+        final sampled token was never fed back, so its KV was never
+        written) — only full pages at or below that bound insert."""
+        if (
+            self.radix is None
+            or not self.insert_generated
+            or seq.status is not SeqStatus.RUNNING
+            or not seq.pages
+        ):
+            return
+        n_full = (seq.total_len - 1) // self.page_size
+        if n_full <= 0:
+            return
+        stream = seq.prompt_ids + seq.output_ids
+        self.radix.insert(
+            stream[: n_full * self.page_size], seq.pages[:n_full]
+        )
+
     def _release_residency(self, seq: Sequence) -> None:
+        self._radix_unlock(seq)
         if seq.pages:
             self.allocator.release(seq.pages)
             seq.pages = []
@@ -585,7 +837,11 @@ class Scheduler:
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
 
     def remove(self, seq: Sequence) -> None:
-        """Release residency after finish/failure."""
+        """Release residency after finish/failure.  A sequence finishing
+        cleanly (the engine calls remove just before ``seq.finish``, so
+        its status is still RUNNING — failures arrive already FAILED)
+        donates its transcript's full pages to the radix tree first."""
+        self._radix_insert_final(seq)
         self._release_residency(seq)
         self.total_finished += 1
 
@@ -631,9 +887,30 @@ class Scheduler:
             "aborted": self.total_aborted,
             "prefix_cache": {
                 "enabled": self.prefix_cache,
+                "mode": "radix" if self.radix is not None else "flat",
                 "hit_tokens": self.total_prefix_hit_tokens,
                 "hit_pages": self.allocator.prefix_hits,
                 "cached_pages": self.allocator.num_cached,
-                "evictions": self.allocator.prefix_evictions,
+                "evictions": (
+                    sum(self.radix.total_evictions.values())
+                    if self.radix is not None
+                    else self.allocator.prefix_evictions
+                ),
+                **(
+                    {
+                        "nodes": self.radix.total_nodes,
+                        "inserted_pages": self.radix.total_inserted_pages,
+                        "evictions_lru": self.radix.total_evictions.get(
+                            "lru", 0
+                        ),
+                        "evictions_pressure": (
+                            self.radix.total_evictions.get("pressure", 0)
+                        ),
+                        "cow_copies": self.radix.total_cow_copies,
+                        "insert_suspended": self.radix.insert_suspended,
+                    }
+                    if self.radix is not None
+                    else {}
+                ),
             },
         }

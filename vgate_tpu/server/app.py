@@ -25,7 +25,7 @@ from aiohttp import web
 from pydantic import ValidationError
 
 from vgate_tpu import metrics
-from vgate_tpu.admission import estimate_prompt_tokens, tier_rank
+from vgate_tpu.admission import tier_rank
 from vgate_tpu.batcher import RequestBatcher
 from vgate_tpu.config import VGTConfig, apply_platform, get_config
 from vgate_tpu.engine import VGTEngine
@@ -545,17 +545,22 @@ async def chat_completions(request: web.Request) -> web.Response:
         batcher.pressure.maybe_update()
         # same brownout clamp _stream_chat applies to the params: the
         # backlog must be charged what the engine will actually decode
-        cost = estimate_prompt_tokens(prompt) + (
+        # — discounted by the predicted prefix-cache hit, like the
+        # batcher path (admission.PrefixHintIndex)
+        cost = batcher.admission.estimate_cost(
+            prompt,
             batcher.pressure.clamp_max_tokens(
                 payload.effective_max_tokens()
                 or engine.config.inference.max_tokens
-            )
+            ),
+            prefix_cached=batcher._prefix_cache_on,
         )
         try:
             batcher.admission.admit(cost, tier=tier, deadline_s=timeout_s)
         except RetryableError as exc:
             release_slot()
             return _unavailable_503(exc, str(exc))
+        batcher.note_prompt_submitted(prompt)
         try:
             return await _stream_chat(
                 request, payload, prompt, logit_bias, timeout_s,
