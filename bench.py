@@ -156,6 +156,186 @@ def _last_recorded_tpu_result():
     return None
 
 
+def _run_kv_quant_scenario(
+    config, on_accelerator, n_requests, prompt_len, max_tokens, buckets
+) -> None:
+    """bf16-vs-int8 KV A/B on one process (arms run serially; each
+    core's pool frees before the next auto-sizes).  The oracle arm is
+    the plain pool at the model compute dtype ("auto": bf16 on
+    hardware, f32 on the CPU smoke fallback)."""
+    import gc
+
+    import jax
+
+    from vgate_tpu import metrics as vgt_metrics
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    rng_tokens = [
+        [3 + (i * 37 + j * 11) % 200 for j in range(prompt_len)]
+        for i in range(n_requests)
+    ]
+    n_quality = min(8, n_requests)
+    # min_tokens pins every quality stream to the full horizon: an
+    # early greedy EOS (likely on the random-init CPU smoke model)
+    # would shrink the compared window to a few tokens and report a
+    # vacuous identity horizon
+    # the acceptance bar is a >= 64-step identity horizon, so the
+    # quality probe never runs shorter than that even when the
+    # throughput arms use a smaller max_tokens
+    quality_tokens = max(64, max_tokens)
+    quality_params = SamplingParams(
+        max_tokens=quality_tokens, min_tokens=quality_tokens,
+        temperature=0.0, logprobs=True, top_logprobs=1,
+    )
+    # quality prompts clip to the largest warmup bucket and must leave
+    # the full horizon of decode room (the CPU smoke's max_model_len
+    # would otherwise clamp the streams to ~1 token — a vacuous probe)
+    quality_clip = max(buckets) - 1
+    config.model.max_model_len = max(
+        config.model.max_model_len, max(buckets) + quality_tokens
+    )
+    # quality probe text: deterministic natural prompts (synthetic
+    # digit streams produce near-tied logits whose argmax flips on any
+    # numeric noise, which would measure tie-breaking, not KV quality)
+    topics = [
+        "systolic arrays", "high bandwidth memory",
+        "sequence parallelism", "paged attention",
+        "speculative decoding", "continuous batching",
+        "prefix caching", "tensor parallelism",
+    ]
+    quality_prompts = [
+        f"Explain {topics[i % len(topics)]} to a systems "
+        f"engineer in part {i} of the series, covering the "
+        "performance trade-offs in detail"
+        for i in range(n_quality)
+    ]
+    arms = {}
+    for arm in ("oracle", "int8"):
+        config.kv_cache.dtype = "auto" if arm == "oracle" else "int8"
+        core = EngineCore(config, devices=jax.devices()[:1])
+        core.start()
+        try:
+            core.warmup(buckets=buckets)
+            params = SamplingParams(max_tokens=max_tokens, temperature=0.0)
+            start = time.perf_counter()
+            seqs = [core.submit_tokens(ids, params) for ids in rng_tokens]
+            for seq in seqs:
+                # a hung or failed arm must abort the A/B, not skew
+                # toks_ratio — that number adjudicates the default flip
+                if not seq.done_event.wait(timeout=1800):
+                    raise TimeoutError(
+                        f"kv_quant {arm} arm: request never finished"
+                    )
+                if seq.error is not None:
+                    raise seq.error
+            wall = time.perf_counter() - start
+            total_out = sum(s.num_output_tokens for s in seqs)
+            # quality probe: greedy + logprobs, prompts tokenized and
+            # clipped so the full horizon fits both the bucket ladder
+            # and max_model_len on every platform
+            q_seqs = [
+                core.submit_tokens(
+                    core.tokenizer.encode(text)[:quality_clip]
+                    or [core.tokenizer.bos_id],
+                    quality_params,
+                )
+                for text in quality_prompts
+            ]
+            for seq in q_seqs:
+                seq.done_event.wait(timeout=1800)
+                if seq.error is not None:
+                    raise seq.error
+            arms[arm] = {
+                "kv_dtype": core.geometry.kv_dtype,
+                "toks_per_s": total_out / wall if wall > 0 else 0.0,
+                "kv_pages_total": core.allocator.num_allocatable,
+                "kv_token_capacity": core.geometry.total_tokens,
+                "kv_page_bytes": core.geometry.page_bytes,
+                "quality": [
+                    {
+                        "token_ids": list(seq.generated_ids),
+                        "logprobs": [
+                            e["logprob"]
+                            for e in core.logprob_entries(seq)
+                        ],
+                    }
+                    for seq in q_seqs
+                ],
+            }
+        finally:
+            core.stop()
+            del core
+            gc.collect()
+        row = {
+            "scenario": "kv_quant",
+            "arm": arm,
+            **{
+                k: (round(v, 2) if isinstance(v, float) else v)
+                for k, v in arms[arm].items()
+                if k != "quality"
+            },
+            "requests": n_requests,
+            "platform": jax.devices()[0].platform,
+            "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        }
+        print(json.dumps(row), flush=True)
+
+    # comparison: identity horizon = first greedy divergence (min over
+    # prompts); drift = max |chosen-logprob delta| over identical
+    # prefixes — the numbers the default flip is adjudicated on
+    max_drift = 0.0
+    diverged_tokens = 0
+    diverged_at = []  # first-divergence steps of prompts that diverged
+    compared = 0  # longest fully-compared identical stream
+    for qa, qb in zip(arms["oracle"]["quality"], arms["int8"]["quality"]):
+        ids_a, ids_b = qa["token_ids"], qb["token_ids"]
+        n = next(
+            (i for i, (a, b) in enumerate(zip(ids_a, ids_b)) if a != b),
+            min(len(ids_a), len(ids_b)),
+        )
+        d = max(len(ids_a), len(ids_b)) - n
+        diverged_tokens += d
+        if d:
+            diverged_at.append(n)
+        else:
+            compared = max(compared, n)
+        for la, lb in zip(qa["logprobs"][:n], qb["logprobs"][:n]):
+            max_drift = max(max_drift, abs(la - lb))
+    # horizon semantics: earliest observed divergence, or — when every
+    # stream stayed identical — the longest stream fully verified (a
+    # lower bound, not a divergence)
+    horizon = min(diverged_at) if diverged_at else compared
+    if diverged_tokens:
+        vgt_metrics.KV_QUANT_DRIFT_TOKENS.inc(diverged_tokens)
+    oracle, int8 = arms["oracle"], arms["int8"]
+    print(json.dumps({
+        "scenario": "kv_quant",
+        "metric": "kv_quant_ab",
+        "model": config.model.model_id,
+        "capacity_ratio": round(
+            int8["kv_token_capacity"]
+            / max(1, oracle["kv_token_capacity"]), 3
+        ),
+        "toks_ratio": round(
+            int8["toks_per_s"] / max(1e-9, oracle["toks_per_s"]), 3
+        ),
+        "greedy_identity_horizon": horizon,
+        "all_identical": diverged_tokens == 0,
+        "quality_max_tokens": quality_tokens,
+        "max_logprob_drift": round(max_drift, 4),
+        "diverged_tokens": diverged_tokens,
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "note": (
+            "config.yaml kv_cache.dtype flips to int8 only if "
+            "toks_ratio >= 1.0 at equal batch AND the capacity win "
+            "holds AND drift/horizon are acceptable on hardware"
+        ),
+    }), flush=True)
+
+
 def main() -> None:
     from vgate_tpu.config import apply_platform, load_config
 
@@ -268,6 +448,18 @@ def main() -> None:
         scheduler={"max_queue_size": 4096},
         logging={"level": "ERROR"},
     )
+
+    if os.environ.get("VGT_BENCH_SCENARIO") == "kv_quant":
+        # int8-KV A/B (ISSUE 7 satellite): same model/config, bf16 vs
+        # int8 pages — tok/s, resident capacity, and the quality deltas
+        # (greedy token-identity horizon + max logprob drift vs the
+        # full-precision oracle) the config.yaml default flip is
+        # adjudicated on.  Emits one JSON line per arm + a comparison
+        # line; staged in scripts/r6_session.sh for the next TPU grant.
+        return _run_kv_quant_scenario(
+            config, on_accelerator, n_requests, prompt_len, max_tokens,
+            buckets,
+        )
 
     core = EngineCore(config, devices=jax.devices()[:1])
     core.start()

@@ -17,7 +17,17 @@ Components:
   attn-jnp       28x jnp twin per iteration
 
 Prints one JSON line per component: {"component", "ms_per_step", ...}.
-Results land in benchmarks/RESULTS_r3.md.
+Timed decode rows also carry the roofline columns (benchmarks/_roofline.py):
+``kv_bytes_per_token`` (the resident-KV read cost this row's KV config
+implies), ``achieved_hbm_gbps`` over the step's modeled traffic
+(weights + live-context KV reads) and ``pct_of_hbm_roofline`` against
+the device's HBM peak — so KV-quant and future roofline PRs carry a
+roofline number automatically instead of a bare tok/s.
+
+``VGT_ABLATE_KV=int8`` runs the KV-heavy rows (chunk/fwd/attn) on an
+int8 QuantPages pool (kv_cache.dtype: int8 — ops/kv_quant.py): halved
+KV read bytes per step is the capacity/roofline lever this ablation is
+meant to price on hardware.  Results land in benchmarks/RESULTS_r3.md.
 """
 
 from __future__ import annotations
@@ -72,6 +82,13 @@ def main() -> None:
     from vgate_tpu.ops.sampling import sample_tokens
     from vgate_tpu.runtime.engine_core import _decode_chunk
 
+    from benchmarks._roofline import (
+        decode_step_bytes,
+        kv_bytes_per_token,
+        roofline_row,
+    )
+    from vgate_tpu.ops.kv_quant import SCALE_BYTES, QuantPages
+
     model_id = os.environ.get("VGT_BENCH_MODEL", "Qwen/Qwen2.5-1.5B-Instruct")
     only = set(sys.argv[1:])  # optional component filter
     spec = spec_for_model_id(model_id)
@@ -82,15 +99,44 @@ def main() -> None:
     pages_per_seq = ctx // ps
     P = B * pages_per_seq + 1
     STEPS = 32
+    # KV storage format for the KV-heavy rows: bf16 (default) or int8
+    # (kv_cache.dtype: int8 — halved KV read bytes, the roofline lever)
+    kv_mode = os.environ.get("VGT_ABLATE_KV", "bf16")
+    kv_quant = kv_mode == "int8"
+    kv_tok_bytes = kv_bytes_per_token(
+        spec.num_layers, spec.num_kv_heads, spec.head_dim,
+        dtype_bytes=1 if kv_quant else jnp.dtype(dtype).itemsize,
+        scale_bytes=SCALE_BYTES if kv_quant else 0,
+    )
 
     platform = jax.devices()[0].platform
-    base = {"model": spec.name, "B": B, "ctx": ctx, "platform": platform}
+    device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    base = {
+        "model": spec.name, "B": B, "ctx": ctx, "platform": platform,
+        "kv_dtype": "int8" if kv_quant else "bf16",
+        "kv_bytes_per_token": kv_tok_bytes,
+    }
     print(json.dumps({**base, "event": "start"}), flush=True)
 
     params = init_params(spec, jax.random.PRNGKey(0), dtype)
+    weight_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
+    )
+    # live context the decode rows actually read per slot (positions
+    # start at ctx/2 and advance STEPS; midpoint of the sweep)
+    ctx_live = ctx // 2 + STEPS // 2
     kv_shape = (spec.num_layers, spec.num_kv_heads, P, ps, spec.head_dim)
-    k_pages = jnp.zeros(kv_shape, dtype)
-    v_pages = jnp.zeros(kv_shape, dtype)
+
+    def fresh_kv():
+        if kv_quant:
+            return QuantPages(
+                jnp.zeros(kv_shape, jnp.int8),
+                jnp.ones(kv_shape[:-1], jnp.bfloat16),
+            )
+        return jnp.zeros(kv_shape, dtype)
+
+    k_pages = fresh_kv()
+    v_pages = fresh_kv()
     page_tables = jnp.asarray(
         (np.arange(B * pages_per_seq, dtype=np.int32) % (P - 1) + 1)
         .reshape(B, pages_per_seq)
@@ -106,9 +152,24 @@ def main() -> None:
     key = jax.random.PRNGKey(0)
     counter = jnp.asarray(0, jnp.uint32)
 
+    def step_bytes_for(component):
+        """Modeled HBM traffic per step by component family: decode
+        rows stream the weights once + read every slot's live KV
+        window; attention-only rows read just the KV (their L layer
+        calls compose to the same all-layer total).  Host-RTT and
+        sampler rows have no meaningful HBM story — no columns."""
+        if component.startswith(("chunk-", "fwd-")):
+            return decode_step_bytes(weight_bytes, B, ctx_live, kv_tok_bytes)
+        if component.startswith("attn-"):
+            return B * ctx_live * kv_tok_bytes
+        return None
+
     def report(component, ms):
-        print(json.dumps({**base, "component": component,
-                          "ms_per_step": round(ms, 3)}), flush=True)
+        row = {**base, "component": component, "ms_per_step": round(ms, 3)}
+        sb = step_bytes_for(component)
+        if sb:
+            row.update(roofline_row(ms, sb, device_kind))
+        print(json.dumps(row), flush=True)
 
     # bare dispatch + host-readback round-trip (NOT divided by STEPS):
     # subtract this from `* 32` totals when comparing absolute floors
@@ -145,13 +206,13 @@ def main() -> None:
             )[0]
 
         # donation consumes the caches: rebuild fresh copies per rep
-        kp = jnp.zeros(kv_shape, dtype)
-        vp = jnp.zeros(kv_shape, dtype)
+        kp = fresh_kv()
+        vp = fresh_kv()
         _sync(run(kp, vp))  # compile + warm
         best = float("inf")
         for _ in range(3):
-            kp = jnp.zeros(kv_shape, dtype)
-            vp = jnp.zeros(kv_shape, dtype)
+            kp = fresh_kv()
+            vp = fresh_kv()
             jax.block_until_ready((kp, vp))
             t0 = time.perf_counter()
             _sync(run(kp, vp))
@@ -187,13 +248,13 @@ def main() -> None:
             )
             return ys
 
-        kp = jnp.zeros(kv_shape, dtype)
-        vp = jnp.zeros(kv_shape, dtype)
+        kp = fresh_kv()
+        vp = fresh_kv()
         _sync(fwd_loop(params, kp, vp, use_pallas))
         best = float("inf")
         for _ in range(3):
-            kp = jnp.zeros(kv_shape, dtype)
-            vp = jnp.zeros(kv_shape, dtype)
+            kp = fresh_kv()
+            vp = fresh_kv()
             jax.block_until_ready((kp, vp))
             t0 = time.perf_counter()
             _sync(fwd_loop(params, kp, vp, use_pallas))
@@ -231,13 +292,13 @@ def main() -> None:
                 )
                 return ys
 
-            kp = jnp.zeros(kv_shape, dtype)
-            vp = jnp.zeros(kv_shape, dtype)
+            kp = fresh_kv()
+            vp = fresh_kv()
             _sync(prefill_loop(params, kp, vp, kc))
             best = float("inf")
             for _ in range(3):
-                kp = jnp.zeros(kv_shape, dtype)
-                vp = jnp.zeros(kv_shape, dtype)
+                kp = fresh_kv()
+                vp = fresh_kv()
                 jax.block_until_ready((kp, vp))
                 t0 = time.perf_counter()
                 _sync(prefill_loop(params, kp, vp, kc))
@@ -296,19 +357,25 @@ def main() -> None:
         report("lmhead", timed(lmhead_loop, params, x, iters_inside=STEPS))
 
     # --- attention only (28 layer calls per iteration) --------------------
+    from vgate_tpu.ops.kv_quant import quantize
+
     q = jax.random.normal(
         jax.random.PRNGKey(3), (B, spec.num_heads, spec.head_dim), dtype
     )
-    kp1 = jax.random.normal(
-        jax.random.PRNGKey(4),
-        (spec.num_kv_heads, P, ps, spec.head_dim), dtype,
-    ) * 0.1
+
+    def attn_pool(seed):
+        vals = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (spec.num_kv_heads, P, ps, spec.head_dim), dtype,
+        ) * 0.1
+        if kv_quant:
+            return QuantPages(*quantize(vals))
+        return vals
+
+    kp1 = attn_pool(4)
     # independent V buffer: aliasing K/V would let XLA CSE the twin's two
     # page gathers and halve its apparent memory traffic
-    vp1 = jax.random.normal(
-        jax.random.PRNGKey(5),
-        (spec.num_kv_heads, P, ps, spec.head_dim), dtype,
-    ) * 0.1
+    vp1 = attn_pool(5)
     seq_lens = positions + 1
     L = spec.num_layers
 

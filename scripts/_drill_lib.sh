@@ -66,6 +66,40 @@ ensure_port_free() {
   exit 1
 }
 
+snapshot_kv_config() {
+  # snapshot_kv_config BASE_URL [TAG] — one attributable JSON line per
+  # drill artifact: which KV storage config (kv_cache.dtype) the
+  # server under test was actually serving, plus the capacity it
+  # yields.  A drill log that says "pass" means nothing for the int8
+  # A/B unless the artifact names its KV config.
+  local base="$1" tag="${2:-drill}" body
+  # stats land in argv, NOT stdin: `curl | python - <<heredoc` would
+  # hand the heredoc to python as the *program* and the piped body
+  # would never be read (every snapshot said "stats unavailable")
+  body="$(curl -fsS "$base/stats" 2>/dev/null || true)"
+  python - "$tag" "$body" <<'PY' || true
+import json, sys
+try:
+    stats = json.loads(sys.argv[2])
+except ValueError:
+    print(json.dumps({"snapshot": sys.argv[1], "kv_dtype": None,
+                      "error": "stats unavailable"}), flush=True)
+    sys.exit(0)
+eng = stats.get("engine") or {}
+cfg = stats.get("config") or {}
+print(json.dumps({
+    "snapshot": sys.argv[1],
+    # resolved dtype from the live engine when it has one; otherwise
+    # the configured kv_cache.dtype (dry-run backends have no pools)
+    "kv_dtype": eng.get("kv_dtype") or cfg.get("kv_dtype"),
+    "kv_pages_total": eng.get("kv_pages_total"),
+    "kv_token_capacity": eng.get("kv_token_capacity"),
+    "kv_page_bytes": eng.get("kv_page_bytes"),
+    "model": eng.get("model"),
+}), flush=True)
+PY
+}
+
 record_drill_pid() {
   # record_drill_pid PORT PID — lets the NEXT session's ensure_port_free
   # kill this server if we die before our trap runs

@@ -39,6 +39,7 @@ for _ in $(seq 1 100); do
 done
 curl -fsS "$BASE/health/ready" >/dev/null || {
   echo "FAIL: server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" drain_check
 
 python - "$BASE" "$SERVER_PID" <<'EOF'
 import asyncio, json, os, signal, sys, time

@@ -48,6 +48,7 @@ for _ in $(seq 1 100); do
 done
 curl -fsS "$BASE/health/ready" >/dev/null || {
   echo "FAIL: server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" overload_check
 
 python - "$BASE" <<'EOF'
 import asyncio, sys, time

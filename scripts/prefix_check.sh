@@ -70,6 +70,7 @@ for _ in $(seq 1 300); do
 done
 curl -fsS "$BASE/health/ready" >/dev/null || {
   echo "FAIL: server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" prefix_check
 
 TRACE_JSON="$(mktemp /tmp/vgt_prefix_trace.XXXXXX.json)"
 
@@ -255,6 +256,7 @@ for _ in $(seq 1 300); do
 done
 curl -fsS "$BASE_OFF/health/ready" >/dev/null || {
   echo "FAIL: cache-off server never became ready"; exit 1; }
+snapshot_kv_config "$BASE_OFF" prefix_check_off
 
 python - "$BASE_OFF" "$TRACE_JSON" <<'EOF'
 import asyncio, json, sys

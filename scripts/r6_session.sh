@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-6 measurement session: the still-unbanked r5 list (the official
+# bench has said platform:"cpu" five rounds running — r5_session.sh's
+# verdict-retiring rows run FIRST, unchanged) plus the new int8-KV
+# rows this round adjudicates:
+#
+#   * kv_quant A/B (bench.py VGT_BENCH_SCENARIO=kv_quant) at 1.5B and
+#     7B — tok/s at equal batch, resident capacity (auto-sized pages:
+#     int8 should report ~1.97x the bf16 page count), and the quality
+#     deltas (greedy token-identity horizon + max logprob drift vs the
+#     bf16 oracle).  config.yaml kv_cache.dtype flips to int8 only if
+#     tok/s holds AND drift/horizon are acceptable at BOTH sizes
+#     (docs/operations.md "KV-cache capacity planning").
+#   * decode ablation bf16-vs-int8 KV (VGT_ABLATE_KV) — the same rows
+#     now carry kv_bytes_per_token / achieved_hbm_gbps /
+#     pct_of_hbm_roofline, so the KV-read halving prices itself
+#     against the repo's own roofline (ROADMAP "13.2% -> >=40%").
+#
+# Same discipline as r5: serialized, kill-free (memory:
+# tpu-grant-discipline — nothing here ever kills a device process);
+# hardware-proven kernels first, the int8-KV Pallas dequant variants
+# (first hardware contact) behind the banked rows.
+cd /root/repo
+log=/tmp/r6_session.log
+raw=benchmarks/r6_raw
+mkdir -p "$raw"
+
+# ---- tier 1: the unbanked r5 list (cutoffs disabled: this session is
+# armed fresh against the NEXT grant window; set R5_CUTOFF_EPOCH /
+# R5_HEAVY_CUTOFF_EPOCH for a bounded window) ---------------------------
+R5_CUTOFF_EPOCH=${R6_CUTOFF_EPOCH:-$(( $(date -u +%s) + 86400 ))} \
+R5_HEAVY_CUTOFF_EPOCH=${R6_HEAVY_CUTOFF_EPOCH:-$(( $(date -u +%s) + 86400 ))} \
+  bash scripts/r5_session.sh
+echo "### r5 list complete $(date -u +%H:%M:%S)" >> "$log"
+
+aux() {
+  tag="$1"; script="$2"; shift 2
+  echo "### $tag start $(date -u +%H:%M:%S)" >> "$log"
+  env "$@" python "$script" > "$raw/$tag.jsonl" 2>/tmp/r6_${tag}.err
+  echo "### $tag rc=$? end $(date -u +%H:%M:%S)" >> "$log"
+  cat "$raw/$tag.jsonl" >> "$log"
+  sleep 20
+}
+
+# ---- tier 2: int8-KV rows -------------------------------------------
+# 1. kv_quant A/B, 1.5B (auto-sized pages: kv_num_pages stays 0 via
+#    the scenario's own cores; jnp dequant twin on the CPU-proven
+#    read path, Pallas dequant compiles fresh — run AFTER the banked
+#    rows for exactly that reason)
+aux kvquant_1p5b bench.py VGT_BENCH_SCENARIO=kv_quant VGT_BENCH_PAGE=32
+# 2. kv_quant A/B, 7B (the capacity win matters most where pages are
+#    biggest; long host-staged load — the heavy row of this tier)
+aux kvquant_7b bench.py VGT_BENCH_SCENARIO=kv_quant \
+    VGT_BENCH_MODEL=Qwen/Qwen2.5-7B-Instruct \
+    VGT_BENCH_SLOTS=64 VGT_BENCH_PREFILL_BATCH=16 VGT_BENCH_PAGE=32
+# 3. ablation rows with int8 KV: per-row roofline columns price the
+#    halved KV read bytes against the bf16 ablate banked in tier 1
+aux ablate_kv_int8 benchmarks/bench_decode_ablate.py VGT_ABLATE_KV=int8
+# 4. int8 KV x int8 weights: the combined-quantization serving config
+#    (weights stream once, KV reads dominate at depth — the two
+#    halvings compose; this is the candidate production default)
+aux kvquant_1p5b_w8 bench.py VGT_BENCH_SCENARIO=kv_quant \
+    VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false VGT_BENCH_PAGE=32
+
+echo "### R6 SESSION DONE $(date -u +%H:%M:%S)" >> "$log"
+touch /tmp/r6_session_done

@@ -69,6 +69,7 @@ for _ in $(seq 1 300); do
 done
 curl -fsS "$BASE/health/ready" >/dev/null || {
   echo "FAIL: server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" resume_check
 
 python - "$BASE" <<'EOF'
 import asyncio, sys, time

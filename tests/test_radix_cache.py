@@ -381,3 +381,75 @@ def test_randomized_interleaving_invariants(seed):
     assert got is not None
     alloc.release(got)
     assert alloc.num_truly_free == alloc.num_allocatable
+
+
+# -------------------------------------------- int8 KV x radix sharing
+
+
+def test_int8_cow_and_shared_page_scale_consistency():
+    """Radix sharing over an int8 pool (kv_cache.dtype: int8): the
+    per-slot quantization scales live in page-indexed pools, so they
+    travel with shared pages for free and the COW copy duplicates them
+    with the data.  Engine-level contract: (a) a mid-page divergence
+    fires COW and the diverged request is greedy-identical to a cold
+    int8 engine; (b) re-running the ORIGINAL prompt after the
+    divergence still matches its first output exactly — the shared
+    page's data+scales were not perturbed by the COW'd sibling."""
+    import jax
+
+    from vgate_tpu.backends.base import SamplingParams
+    from vgate_tpu.config import load_config
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    def cfg(prefix_cache):
+        return load_config(
+            model={
+                "model_id": "tiny-dense", "engine_type": "jax_tpu",
+                "dtype": "float32", "max_model_len": 96,
+            },
+            kv_cache={"dtype": "int8"},
+            tpu={
+                "dp": 1, "tp": 1, "ep": 1, "sp": 1, "num_devices": 1,
+                "kv_num_pages": 96, "kv_page_size": PS,
+                "max_batch_slots": 4, "prefill_buckets": [8, 16, 32],
+                "use_pallas": False,
+                "prefix_cache": {
+                    "enabled": prefix_cache, "cow_min_tokens": 2,
+                },
+            },
+            scheduler={"max_queue_size": 16},
+            logging={"level": "ERROR"},
+        )
+
+    greedy = SamplingParams(max_tokens=8, temperature=0.0)
+    base = [7, 3, 9, 4, 11, 6, 2, 13, 5, 8, 12, 10, 14, 9]
+    ids_a = base
+    ids_b = base[:10] + [21, 22, 23, 24]  # 2 full pages + 2 in-page
+
+    cached = EngineCore(cfg(True), devices=jax.devices()[:1])
+    plain = EngineCore(cfg(False), devices=jax.devices()[:1])
+    cached.start()
+    plain.start()
+    try:
+        assert cached.geometry.kv_dtype == "int8"
+        sa = cached.submit_tokens(list(ids_a), greedy)
+        assert sa.done_event.wait(timeout=300)
+        cow0 = cached.radix_cache.total_cow_copies
+        sb = cached.submit_tokens(list(ids_b), greedy)
+        assert sb.done_event.wait(timeout=300)
+        assert cached.radix_cache.total_cow_copies > cow0, "COW never fired"
+        # (b) shared page unperturbed: the original prompt replays to
+        # its own first output through the shared (scaled) pages
+        sa2 = cached.submit_tokens(list(ids_a), greedy)
+        assert sa2.done_event.wait(timeout=300)
+        assert list(sa2.generated_ids) == list(sa.generated_ids)
+        # (a) cold-path identity for both shapes
+        pa = plain.submit_tokens(list(ids_a), greedy)
+        pb = plain.submit_tokens(list(ids_b), greedy)
+        assert pa.done_event.wait(timeout=300)
+        assert pb.done_event.wait(timeout=300)
+        assert list(sa.generated_ids) == list(pa.generated_ids)
+        assert list(sb.generated_ids) == list(pb.generated_ids)
+    finally:
+        cached.stop()
+        plain.stop()

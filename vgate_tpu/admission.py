@@ -235,13 +235,28 @@ class AdmissionController:
             tput = self._tput
         return backlog / max(1.0, tput)
 
-    def _kv_free_ratio(self) -> Optional[float]:
+    def _kv_signals(self) -> Dict[str, Any]:
         try:
-            sig = self._signals() or {}
+            return self._signals() or {}
         except Exception:  # pragma: no cover - defensive
-            return None
-        ratio = sig.get("kv_free_ratio")
-        return float(ratio) if ratio is not None else None
+            return {}
+
+    def _token_limit(self, sig: Dict[str, Any]) -> int:
+        """Effective backlog token limit: the static config value, or —
+        with ``admission.auto_token_budget`` — scaled to the engine's
+        resident KV token capacity, so a kv_cache.dtype flip that
+        doubles resident tokens (int8, ops/kv_quant.py) raises the
+        admission budget with it."""
+        limit = int(self.cfg.max_queued_tokens)
+        if limit <= 0:
+            # 0 = unlimited (config.yaml); scaling can only RAISE a
+            # finite limit, never conjure one out of the sentinel
+            return 0
+        auto = float(getattr(self.cfg, "auto_token_budget", 0.0))
+        capacity = sig.get("kv_token_capacity")
+        if auto > 0 and capacity:
+            limit = max(limit, int(auto * float(capacity)))
+        return limit
 
     def admit(
         self,
@@ -263,12 +278,20 @@ class AdmissionController:
                 self._register(cost)
             return
         frac = self._fraction(tier)
-        # the KV read crosses into engine state; do it outside the lock
+        # the KV/capacity reads cross into engine state; do them
+        # outside the lock
+        need_sig = (
+            self.cfg.kv_free_watermark > 0
+            or float(getattr(self.cfg, "auto_token_budget", 0.0)) > 0
+        )
+        sig = self._kv_signals() if need_sig else {}
         kv_free = (
-            self._kv_free_ratio()
+            float(sig["kv_free_ratio"])
             if self.cfg.kv_free_watermark > 0
+            and sig.get("kv_free_ratio") is not None
             else None
         )
+        token_limit = self._token_limit(sig)
         with self._lock:
             reason: Optional[str] = None
             if self.cfg.max_queued_requests > 0 and (
@@ -276,9 +299,8 @@ class AdmissionController:
                 >= max(1, int(self.cfg.max_queued_requests * frac))
             ):
                 reason = "backlog_requests"
-            elif self.cfg.max_queued_tokens > 0 and (
-                self._queued_tokens + cost
-                > int(self.cfg.max_queued_tokens * frac)
+            elif token_limit > 0 and (
+                self._queued_tokens + cost > int(token_limit * frac)
             ):
                 reason = "backlog_tokens"
             elif kv_free is not None and (
@@ -428,12 +450,25 @@ class AdmissionController:
     # -- introspection --
 
     def get_stats(self) -> Dict[str, Any]:
+        # KV capacity attribution (outside the lock: crosses into
+        # engine state): the token limit actually in force plus the
+        # kv dtype/capacity it derives from, so an operator reading
+        # /stats sees WHY the budget is what it is
+        sig = self._kv_signals()
+        token_limit = self._token_limit(sig)
+        kv_block = {
+            k: sig[k]
+            for k in ("kv_dtype", "kv_token_capacity")
+            if k in sig
+        }
         with self._lock:
             return {
                 "enabled": bool(self.cfg.enabled),
                 "queued_tokens": self._queued_tokens,
                 "queued_requests": self._queued_requests,
                 "max_queued_tokens": self.cfg.max_queued_tokens,
+                "effective_max_queued_tokens": token_limit,
+                **kv_block,
                 "max_queued_requests": self.cfg.max_queued_requests,
                 "predicted_wait_s": round(
                     self._queued_tokens / max(1.0, self._tput), 3

@@ -114,6 +114,45 @@ class ModelConfig(BaseModel):
         return v
 
 
+class KVCacheConfig(BaseModel):
+    """Paged KV cache storage format (runtime/kv_cache.py pools;
+    ops/kv_quant.py).  Geometry (page size, pool sizing) stays under
+    ``tpu.*`` — this section governs only what the pages HOLD.
+
+    ``dtype``:
+
+    * ``auto`` (default) — pages store the model compute dtype
+      (bf16 in serving configs, f32 on CPU test meshes).
+    * ``bf16`` — force bf16 pages regardless of compute dtype.
+    * ``int8`` — quantize-on-write int8 KV: pages store int8 K/V plus
+      one bf16 scale per (page, head, token slot); dequantization
+      happens in the attention read (inside the Pallas page-DMA
+      kernels and their jnp twins), so HBM only ever moves int8.  The
+      same HBM budget then holds ~2x the bf16 page count (1.94x at
+      head_dim 64, 1.97x at 128) — the capacity half of the decode
+      roofline lever (ROADMAP "Attack the decode roofline").
+      Requires a plain mesh (tp/pp/sp/ep == 1; dp composes — each
+      replica owns its pool).  Quality: per-token-per-head symmetric
+      scales bound the per-element error at ~0.4% of the row absmax;
+      the kv_quant bench A/B (bench.py) measures the end-to-end
+      logprob drift and greedy token-identity horizon vs the bf16
+      oracle.  bf16 stays the default until the hardware A/B
+      adjudicates the flip (docs/operations.md capacity planning).
+    """
+
+    dtype: str = "auto"
+
+    @field_validator("dtype")
+    @classmethod
+    def _check_dtype(cls, v: str) -> str:
+        allowed = ("auto", "bf16", "int8")
+        if v not in allowed:
+            raise ValueError(
+                f"kv_cache.dtype must be one of {allowed}, got {v!r}"
+            )
+        return v
+
+
 class PrefixCacheConfig(BaseModel):
     """Cross-request KV prefix sharing (runtime/radix_cache.py;
     docs/operations.md "Cross-request KV reuse").  Accepts a bare bool
@@ -431,6 +470,13 @@ class AdmissionConfig(BaseModel):
     # Reject when the estimated token backlog (admitted but unsettled
     # prompt+completion tokens) would exceed this.  0 = unlimited.
     max_queued_tokens: int = 200_000
+    # Capacity-scaled token budget: when > 0, the effective backlog
+    # limit is max(max_queued_tokens, this x the engine's resident KV
+    # token capacity) — flipping kv_cache.dtype to int8 (~2x resident
+    # tokens for the same HBM) then raises the admission budget with
+    # it instead of leaving a hand-tuned number sized for bf16.
+    # 0 keeps the static limit only.
+    auto_token_budget: float = 0.0
     # Reject when this many requests are admitted but unsettled.
     # 0 = unlimited.
     max_queued_requests: int = 256
@@ -637,6 +683,7 @@ class VGTConfig(BaseModel):
     server: ServerConfig = Field(default_factory=ServerConfig)
     model: ModelConfig = Field(default_factory=ModelConfig)
     tpu: TPUConfig = Field(default_factory=TPUConfig)
+    kv_cache: KVCacheConfig = Field(default_factory=KVCacheConfig)
     batch: BatchConfig = Field(default_factory=BatchConfig)
     cache: CacheConfig = Field(default_factory=CacheConfig)
     scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)

@@ -142,6 +142,27 @@ KV_PAGES_IN_USE = _safe_metric(
 KV_PAGES_TOTAL = _safe_metric(
     Gauge, "vgt_kv_pages_total", "Total KV-cache pages"
 )
+KV_DTYPE = _safe_metric(
+    Gauge,
+    "vgt_kv_dtype",
+    "Configured KV-cache storage dtype (1 on the active dtype's label; "
+    "kv_cache.dtype — int8 halves page bytes and ~doubles resident "
+    "capacity, ops/kv_quant.py)",
+    labelnames=("dtype",),  # bf16 | f32 | f16 | int8
+)
+KV_QUANTIZED_PAGES = _safe_metric(
+    Gauge,
+    "vgt_kv_quantized_pages",
+    "KV pages currently holding int8-quantized content (equals pages "
+    "in use under kv_cache.dtype=int8, 0 otherwise)",
+)
+KV_QUANT_DRIFT_TOKENS = _safe_metric(
+    Counter,
+    "vgt_kv_quant_drift_tokens",
+    "Greedy tokens that diverged from the full-precision KV oracle in "
+    "the kv_quant A/B (bench.py VGT_BENCH_SCENARIO=kv_quant; counts "
+    "tokens past the first divergence across compared streams)",
+)
 ACTIVE_SEQUENCES = _safe_metric(
     Gauge, "vgt_active_sequences", "Sequences resident in decode slots"
 )

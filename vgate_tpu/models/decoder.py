@@ -34,6 +34,7 @@ from vgate_tpu.ops.attention import (
     paged_decode_attention,
     paged_suffix_attention,
 )
+from vgate_tpu.ops.kv_quant import kv_write
 from vgate_tpu.ops.norms import rms_norm
 from vgate_tpu.ops.quant import weighted_einsum
 from vgate_tpu.ops.rope import apply_rope
@@ -475,18 +476,26 @@ def _prefill_qkv_write(
         v_t = v.reshape(B, S, spec.num_kv_heads, spec.head_dim)
         if layer is None:
             # advanced indices (dims 1, 2) are adjacent: update shape
-            # [KV, B, S, hd]
-            k_pages_l = k_pages_l.at[:, pages_bs, slot].set(
-                jnp.transpose(k_t, (2, 0, 1, 3))
+            # [KV, B, S, hd].  kv_write = .at[idx].set for plain pools,
+            # quantize-on-write for int8 pools (ops/kv_quant.py) —
+            # identical index on the scale pool minus the trailing hd.
+            k_pages_l = kv_write(
+                k_pages_l, (slice(None), pages_bs, slot),
+                jnp.transpose(k_t, (2, 0, 1, 3)),
             )
-            v_pages_l = v_pages_l.at[:, pages_bs, slot].set(
-                jnp.transpose(v_t, (2, 0, 1, 3))
+            v_pages_l = kv_write(
+                v_pages_l, (slice(None), pages_bs, slot),
+                jnp.transpose(v_t, (2, 0, 1, 3)),
             )
         else:
             # scalar layer + slice + advanced: broadcast (B, S) dims
             # move to the FRONT — update shape [B, S, KV, hd]
-            k_pages_l = k_pages_l.at[layer, :, pages_bs, slot].set(k_t)
-            v_pages_l = v_pages_l.at[layer, :, pages_bs, slot].set(v_t)
+            k_pages_l = kv_write(
+                k_pages_l, (layer, slice(None), pages_bs, slot), k_t
+            )
+            v_pages_l = kv_write(
+                v_pages_l, (layer, slice(None), pages_bs, slot), v_t
+            )
         return q, k, v, k_pages_l, v_pages_l
     pt = page_tables[:, :n_pages]
     if layer is None:
@@ -498,8 +507,8 @@ def _prefill_qkv_write(
             v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
             (3, 0, 1, 2, 4),
         )
-        k_pages_l = k_pages_l.at[:, pt].set(k_resh)
-        v_pages_l = v_pages_l.at[:, pt].set(v_resh)
+        k_pages_l = kv_write(k_pages_l, (slice(None), pt), k_resh)
+        v_pages_l = kv_write(v_pages_l, (slice(None), pt), v_resh)
     else:
         # mixed scalar/slice/array indexing moves the broadcast (B,
         # n_pages) dims to the FRONT: update shape [B, n_pages, KV, ps, hd]
@@ -511,8 +520,12 @@ def _prefill_qkv_write(
             v.reshape(B, n_pages, ps, spec.num_kv_heads, spec.head_dim),
             (0, 1, 3, 2, 4),
         )
-        k_pages_l = k_pages_l.at[layer, :, pt].set(k_resh)
-        v_pages_l = v_pages_l.at[layer, :, pt].set(v_resh)
+        k_pages_l = kv_write(
+            k_pages_l, (layer, slice(None), pt), k_resh
+        )
+        v_pages_l = kv_write(
+            v_pages_l, (layer, slice(None), pt), v_resh
+        )
     return q, k, v, k_pages_l, v_pages_l
 
 
@@ -601,11 +614,13 @@ def decode_layer(
             softcap=spec.attn_softcap, scale=_query_scale(spec),
         )
         return _finish_layer(h, attn, lp, spec), k_pages_l, v_pages_l
-    k_pages_l = k_pages_l.at[:, page_ids, page_off].set(
-        jnp.transpose(k, (1, 0, 2))
+    k_pages_l = kv_write(
+        k_pages_l, (slice(None), page_ids, page_off),
+        jnp.transpose(k, (1, 0, 2)),
     )
-    v_pages_l = v_pages_l.at[:, page_ids, page_off].set(
-        jnp.transpose(v, (1, 0, 2))
+    v_pages_l = kv_write(
+        v_pages_l, (slice(None), page_ids, page_off),
+        jnp.transpose(v, (1, 0, 2)),
     )
     if window is None:
         attn = attn_fn(q, k_pages_l, v_pages_l, page_tables, seq_lens)
@@ -761,18 +776,20 @@ def decode_forward(
     def body(h, lp, win, kp, vp, layer):
         q, k, v = _decode_qkv(h, lp, spec, positions)
         if layer is None:
-            kp = kp.at[:, page_ids, page_off].set(
-                jnp.transpose(k, (1, 0, 2))
+            kp = kv_write(
+                kp, (slice(None), page_ids, page_off),
+                jnp.transpose(k, (1, 0, 2)),
             )
-            vp = vp.at[:, page_ids, page_off].set(
-                jnp.transpose(v, (1, 0, 2))
+            vp = kv_write(
+                vp, (slice(None), page_ids, page_off),
+                jnp.transpose(v, (1, 0, 2)),
             )
         else:
             # mixed scalar/slice/array indexing: the broadcast (batch)
             # dim moves to the FRONT, so the update shape is [B, KV, hd]
             # — k/v as projected, no transpose
-            kp = kp.at[layer, :, page_ids, page_off].set(k)
-            vp = vp.at[layer, :, page_ids, page_off].set(v)
+            kp = kv_write(kp, (layer, slice(None), page_ids, page_off), k)
+            vp = kv_write(vp, (layer, slice(None), page_ids, page_off), v)
         attn = attn_fn(
             q, kp, vp, page_tables, seq_lens, layer=layer,
             window=win if spec.sliding_window > 0 else None,
@@ -1022,17 +1039,19 @@ def spec_verify_forward(
         q = apply_rope(q, positions, spec.rope_theta, spec.rope_scaling)
         k = apply_rope(k, positions, spec.rope_theta, spec.rope_scaling)
         if layer is None:
-            kp = kp.at[:, page_ids, page_off].set(
-                jnp.transpose(k, (2, 0, 1, 3))
+            kp = kv_write(
+                kp, (slice(None), page_ids, page_off),
+                jnp.transpose(k, (2, 0, 1, 3)),
             )
-            vp = vp.at[:, page_ids, page_off].set(
-                jnp.transpose(v, (2, 0, 1, 3))
+            vp = kv_write(
+                vp, (slice(None), page_ids, page_off),
+                jnp.transpose(v, (2, 0, 1, 3)),
             )
         else:
             # mixed scalar/slice/array indexing: broadcast (B, S) dims
             # move to the front — update shape [B, S, KV, hd], k/v as-is
-            kp = kp.at[layer, :, page_ids, page_off].set(k)
-            vp = vp.at[layer, :, page_ids, page_off].set(v)
+            kp = kv_write(kp, (layer, slice(None), page_ids, page_off), k)
+            vp = kv_write(vp, (layer, slice(None), page_ids, page_off), v)
         window = win if spec.sliding_window > 0 else None
         if use_pallas:
             attn = paged_multitok_attention_pallas(

@@ -15,6 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from vgate_tpu.ops.kv_quant import gather_pages
+
 
 def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """Broadcast KV heads across query-head groups (GQA). x: [..., KV, hd]."""
@@ -180,18 +182,11 @@ def paged_decode_attention(
     n_rep = H // KV
     ctx_max = page_tables.shape[1] * page_size
 
-    if layer is not None:
-        # one gather composing (layer, head, page) — reads only the live
-        # pages of layer `layer`, never a full [KV, P, ps, hd] slice
-        L = k_pages.shape[0]
-        head_idx = (layer * KV + jnp.arange(KV))[:, None, None]  # [KV,1,1]
-        k_flat = k_pages.reshape(L * KV, *k_pages.shape[2:])
-        v_flat = v_pages.reshape(L * KV, *v_pages.shape[2:])
-        k_sel = k_flat[head_idx, page_tables[None]]  # [KV, B, pages, ps, hd]
-        v_sel = v_flat[head_idx, page_tables[None]]
-    else:
-        k_sel = k_pages[:, page_tables]
-        v_sel = v_pages[:, page_tables]
+    # gather_pages (ops/kv_quant.py) composes the (layer, head, page)
+    # gather so only live pages are read, and DEQUANTIZES int8 pools to
+    # f32 on the way (the same f32 the Pallas kernel folds scales in)
+    k_sel = gather_pages(k_pages, page_tables, layer=layer)
+    v_sel = gather_pages(v_pages, page_tables, layer=layer)
 
     # [KV, B, pages_per_seq, page_size, hd] -> [B, ctx, KV, hd]
     k = jnp.moveaxis(k_sel.reshape(KV, B, ctx_max, hd), 0, 2)
@@ -252,16 +247,9 @@ def paged_suffix_attention(
     page_size = k_pages.shape[-2]
     ctx = page_tables.shape[1] * page_size
 
-    if layer is not None:
-        L = k_pages.shape[0]
-        head_idx = (layer * KV + jnp.arange(KV))[:, None, None]
-        k_flat = k_pages.reshape(L * KV, *k_pages.shape[2:])
-        v_flat = v_pages.reshape(L * KV, *v_pages.shape[2:])
-        k_sel = k_flat[head_idx, page_tables[None]]
-        v_sel = v_flat[head_idx, page_tables[None]]
-    else:
-        k_sel = k_pages[:, page_tables]
-        v_sel = v_pages[:, page_tables]
+    # dequantizing live-page gather, exactly like paged_decode_attention
+    k_sel = gather_pages(k_pages, page_tables, layer=layer)
+    v_sel = gather_pages(v_pages, page_tables, layer=layer)
     k = jnp.moveaxis(k_sel.reshape(KV, B, ctx, hd), 0, 2)
     v = jnp.moveaxis(v_sel.reshape(KV, B, ctx, hd), 0, 2)
     # key blocks must divide the window; fall back to page-sized blocks

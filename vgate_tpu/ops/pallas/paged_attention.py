@@ -45,6 +45,7 @@ if CHUNK_PAGES <= 0:
 def _chunk_dma(
     page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
     b, g, n_pages, page_size, layer=None,
+    k_scale_ref=None, v_scale_ref=None, sk_buf=None, sv_buf=None,
 ):
     """Shared double-buffered page-DMA machinery for the paged kernels.
 
@@ -58,7 +59,16 @@ def _chunk_dma(
     layer dim ``[L, KV, P, ps, hd]`` and the DMA indexes it — the
     carry-threaded decode path (models/decoder.py) passes the FULL
     stacked buffer instead of a per-layer slice, so no 2x67MB slice
-    materialization per layer feeds the kernel."""
+    materialization per layer feeds the kernel.
+
+    int8 KV (``k_scale_ref`` et al. given — ops/kv_quant.py): each
+    page's per-(head, slot) bf16 scale row ``[ps]`` rides its own tiny
+    DMA into ``sk_buf``/``sv_buf`` ``[2, 1, chunk_tokens]`` alongside
+    the int8 page tile; the scale sems live at indices 2/3 (the sem
+    array widens to ``[2, 4, CHUNK]``).  Dead-page scale slots zero-fill
+    like the data tiles — stale-VMEM NaN times an exactly-0 softmax
+    weight would still poison the accumulator."""
+    quant = k_scale_ref is not None
 
     def src(ref, page_id):
         if layer is None:
@@ -82,6 +92,21 @@ def _chunk_dma(
                     v_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 1, j],
                 ).start()
+                if quant:
+                    pltpu.make_async_copy(
+                        src(k_scale_ref, page_id),
+                        sk_buf.at[
+                            slot, 0, pl.ds(j * page_size, page_size)
+                        ],
+                        sems.at[slot, 2, j],
+                    ).start()
+                    pltpu.make_async_copy(
+                        src(v_scale_ref, page_id),
+                        sv_buf.at[
+                            slot, 0, pl.ds(j * page_size, page_size)
+                        ],
+                        sems.at[slot, 3, j],
+                    ).start()
 
             @pl.when(page_pos >= n_pages)
             def _():
@@ -91,6 +116,13 @@ def _chunk_dma(
                 v_buf[slot, pl.ds(j * page_size, page_size), :] = jnp.zeros(
                     (page_size, v_buf.shape[-1]), v_buf.dtype
                 )
+                if quant:
+                    sk_buf[
+                        slot, 0, pl.ds(j * page_size, page_size)
+                    ] = jnp.zeros((page_size,), sk_buf.dtype)
+                    sv_buf[
+                        slot, 0, pl.ds(j * page_size, page_size)
+                    ] = jnp.zeros((page_size,), sv_buf.dtype)
 
     def wait_chunk(c, slot):
         for j in range(CHUNK_PAGES):
@@ -108,8 +140,31 @@ def _chunk_dma(
                     v_buf.at[slot, pl.ds(j * page_size, page_size), :],
                     sems.at[slot, 1, j],
                 ).wait()
+                if quant:
+                    pltpu.make_async_copy(
+                        src(k_scale_ref, 0),
+                        sk_buf.at[
+                            slot, 0, pl.ds(j * page_size, page_size)
+                        ],
+                        sems.at[slot, 2, j],
+                    ).wait()
+                    pltpu.make_async_copy(
+                        src(v_scale_ref, 0),
+                        sv_buf.at[
+                            slot, 0, pl.ds(j * page_size, page_size)
+                        ],
+                        sems.at[slot, 3, j],
+                    ).wait()
 
     return start_chunk, wait_chunk
+
+
+def _scale_row(buf, slot):
+    """The active double-buffer's scale row as f32 ``[1, chunk_tokens]``
+    (broadcasts over the score rows)."""
+    return jax.lax.cond(
+        slot == 0, lambda: buf[0], lambda: buf[1]
+    ).astype(jnp.float32)
 
 
 def _kernel(
@@ -118,26 +173,34 @@ def _kernel(
     seq_lens_ref,  # [B] int32 (SMEM)
     window_ref,  # [1] int32 (SMEM); >0 => attend only to the last `window`
     layer_ref,  # [1] int32 (SMEM); pool layer index (-1 => no layer dim)
-    # inputs
-    q_ref,  # [1, 1, G, hd] VMEM block for (b, g)
-    k_pages_ref,  # [KV, P, ps, hd] in ANY/HBM (head-major: one page of one
-    v_pages_ref,  # [KV, P, ps, hd]  head is a contiguous (ps, hd) DMA tile)
-    #                or [L, KV, P, ps, hd] when has_layer (carry decode)
-    # output
-    out_ref,  # [1, 1, G, hd]
-    # scratch
-    k_buf,  # [2, CHUNK*ps, hd] VMEM
-    v_buf,  # [2, CHUNK*ps, hd]
-    acc_ref,  # [G, hd] f32
-    m_ref,  # [G, 128] f32 running max (col-broadcast)
-    l_ref,  # [G, 128] f32 running denom
-    sems,  # DMA semaphores [2, 2, CHUNK]
-    *,
+    # inputs: q_ref [1, 1, G, hd] VMEM block for (b, g); k/v_pages_ref
+    # [KV, P, ps, hd] in ANY/HBM (head-major: one page of one head is a
+    # contiguous (ps, hd) DMA tile), or [L, KV, P, ps, hd] when
+    # has_layer (carry decode).  `quant` (int8 KV) adds k/v_scale_ref
+    # [KV, P, ps] bf16 pools after them.
+    # outputs: out_ref [1, 1, G, hd]
+    # scratch: k_buf/v_buf [2, CHUNK*ps, hd] VMEM (+ sk/sv_buf
+    # [2, 1, CHUNK*ps] when quant), acc [G, hd] f32, m/l [G, 128] f32
+    # running max/denom (col-broadcast), DMA sems [2, 2 or 4, CHUNK]
+    *refs,
     page_size: int,
     softcap: float,
     scale: float,
     has_layer: bool = False,
+    quant: bool = False,
 ):
+    if quant:
+        (
+            q_ref, k_pages_ref, v_pages_ref, k_scale_ref, v_scale_ref,
+            out_ref, k_buf, v_buf, sk_buf, sv_buf, acc_ref, m_ref, l_ref,
+            sems,
+        ) = refs
+    else:
+        (
+            q_ref, k_pages_ref, v_pages_ref,
+            out_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems,
+        ) = refs
+        k_scale_ref = v_scale_ref = sk_buf = sv_buf = None
     b = pl.program_id(0)
     g = pl.program_id(1)
     seq_len = seq_lens_ref[b]
@@ -157,6 +220,8 @@ def _kernel(
         page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
         b, g, n_pages, page_size,
         layer=layer_ref[0] if has_layer else None,
+        k_scale_ref=k_scale_ref, v_scale_ref=v_scale_ref,
+        sk_buf=sk_buf, sv_buf=sv_buf,
     )
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
@@ -188,6 +253,13 @@ def _kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [G, chunk_tokens]
+        if quant:
+            # linearity-exact in-VMEM dequant (ops/kv_quant.py): the
+            # per-token scale is constant over hd, so q . (k_q * s) ==
+            # (q . k_q) * s — fold it into the score row instead of
+            # materializing a dequantized K tile.  Applied BEFORE
+            # softcap/masking: those act on real scores.
+            scores = scores * _scale_row(sk_buf, slot)
         if softcap:
             scores = jnp.tanh(scores / softcap) * softcap
         token_pos = c * chunk_tokens + jax.lax.broadcasted_iota(
@@ -202,6 +274,12 @@ def _kernel(
         alpha = jnp.exp(m_prev - m_new)  # [G, 1]
         p = jnp.exp(scores - m_new)  # [G, chunk_tokens]
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        if quant:
+            # V-side twin: sum_t p_t * (v_q_t * s_t) == sum_t
+            # (p_t * s_t) . v_q_t — weight the softmax row, dot int8 V.
+            # The denominator l uses the UNWEIGHTED p (it normalizes
+            # probabilities, not values).
+            p = p * _scale_row(sv_buf, slot)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -230,9 +308,18 @@ def paged_decode_attention_pallas(
     softcap: float = 0.0,
     scale=None,  # static query scale; default hd**-0.5
 ) -> jnp.ndarray:
+    from vgate_tpu.ops.kv_quant import is_quantized
+
     B, H, hd = q.shape
     has_layer = layer is not None
-    KV, P, ps, _ = k_pages.shape[1:] if has_layer else k_pages.shape
+    quant = is_quantized(k_pages)
+    k_data, k_scale = (
+        (k_pages.data, k_pages.scale) if quant else (k_pages, None)
+    )
+    v_data, v_scale = (
+        (v_pages.data, v_pages.scale) if quant else (v_pages, None)
+    )
+    KV, P, ps, _ = k_data.shape[1:] if has_layer else k_data.shape
     G = H // KV
     chunk_tokens = CHUNK_PAGES * ps
 
@@ -251,11 +338,30 @@ def paged_decode_attention_pallas(
         softcap=float(softcap),
         scale=float(scale) if scale is not None else hd ** -0.5,
         has_layer=has_layer,
+        quant=quant,
     )
     # q is laid out [B, KV, G, hd] so each program's block covers the FULL
     # trailing (G, hd) dims — Mosaic requires trailing block dims either
     # tile-aligned (8, 128) or equal to the array dims, and G (q heads per
     # kv group, e.g. 6 or 7) is rarely tile-aligned.
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    scratch = [
+        pltpu.VMEM((2, chunk_tokens, hd), k_data.dtype),
+        pltpu.VMEM((2, chunk_tokens, hd), v_data.dtype),
+    ]
+    if quant:
+        # per-token bf16 scale rows ride their own chunk buffers; the
+        # extra sem pair (indices 2/3) covers their DMAs
+        scratch += [
+            pltpu.VMEM((2, 1, chunk_tokens), k_scale.dtype),
+            pltpu.VMEM((2, 1, chunk_tokens), v_scale.dtype),
+        ]
+    scratch += [
+        pltpu.VMEM((G, hd), jnp.float32),
+        pltpu.VMEM((G, 128), jnp.float32),
+        pltpu.VMEM((G, 128), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 4 if quant else 2, CHUNK_PAGES)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B, KV),
@@ -264,22 +370,19 @@ def paged_decode_attention_pallas(
                 (1, 1, G, hd), lambda b, g, *prefetch: (b, g, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+            any_spec,
+            any_spec,
+        ]
+        + ([any_spec, any_spec] if quant else []),
         out_specs=pl.BlockSpec(
             (1, 1, G, hd), lambda b, g, *prefetch: (b, g, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, chunk_tokens, hd), k_pages.dtype),
-            pltpu.VMEM((2, chunk_tokens, hd), v_pages.dtype),
-            pltpu.VMEM((G, hd), jnp.float32),
-            pltpu.VMEM((G, 128), jnp.float32),
-            pltpu.VMEM((G, 128), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, 2, CHUNK_PAGES)),
-        ],
+        scratch_shapes=scratch,
     )
+    inputs = [q.reshape(B, KV, G, hd), k_data, v_data]
+    if quant:
+        inputs += [k_scale, v_scale]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -288,10 +391,7 @@ def paged_decode_attention_pallas(
         compiler_params=_CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
-    )(
-        page_tables, seq_lens, window_arr, layer_arr,
-        q.reshape(B, KV, G, hd), k_pages, v_pages,
-    )
+    )(page_tables, seq_lens, window_arr, layer_arr, *inputs)
     return out.reshape(B, H, hd)
 
 
@@ -524,18 +624,23 @@ def paged_decode_attention_pallas_blocked(
     grid (B/block_slots, KV) instead of (B, KV).  Opt-in via
     ``tpu.decode_block_slots`` until its win is measured on hardware
     (the r3 lesson: no unmeasured default flips).  Falls back to the
-    per-slot kernel when ``B % block_slots != 0``."""
+    per-slot kernel when ``B % block_slots != 0`` — and for int8 KV
+    pools: the blocked grid is itself unmeasured, so it doesn't carry
+    the scale-DMA plumbing yet (the per-slot kernel dequantizes
+    in-VMEM; revisit if the hardware A/B picks the blocked grid)."""
+    from vgate_tpu.ops.kv_quant import is_quantized
+
     B, H, hd = q.shape
     has_layer = layer is not None
-    KV, P, ps, _ = k_pages.shape[1:] if has_layer else k_pages.shape
-    G = H // KV
     BS = block_slots
-    if BS <= 1 or B % BS:
+    if BS <= 1 or B % BS or is_quantized(k_pages):
         return paged_decode_attention_pallas(
             q, k_pages, v_pages, page_tables, seq_lens, window=window,
             layer=layer, interpret=interpret, softcap=softcap,
             scale=scale,
         )
+    KV, P, ps, _ = k_pages.shape[1:] if has_layer else k_pages.shape
+    G = H // KV
     chunk_tokens = CHUNK_PAGES * ps
 
     if window is None:
@@ -606,24 +711,19 @@ def _mt_kernel(
     input_lens_ref,  # [B] int32 — real query rows this slot (<= S)
     window_ref,  # [1] int32; >0 => attend only to the last `window`
     layer_ref,  # [1] int32; pool layer index (-1 => no layer dim)
-    # inputs
-    q_ref,  # [1, 1, S, G, hd] VMEM block for (b, g)
-    k_pages_ref,  # [KV, P, ps, hd] ANY/HBM ([L, KV, ...] when has_layer)
-    v_pages_ref,
-    # output
-    out_ref,  # [1, 1, S, G, hd]
-    # scratch
-    k_buf,  # [2, CHUNK*ps, hd]
-    v_buf,
-    acc_ref,  # [S*G, hd] f32
-    m_ref,  # [S*G, 128] f32
-    l_ref,  # [S*G, 128] f32
-    sems,
-    *,
+    # inputs: q_ref [1, 1, S, G, hd] VMEM block for (b, g); k/v_pages_ref
+    # [KV, P, ps, hd] ANY/HBM ([L, KV, ...] when has_layer); `quant`
+    # adds k/v_scale_ref [KV, P, ps] bf16 after them (int8 KV).
+    # outputs: out_ref [1, 1, S, G, hd]
+    # scratch: k_buf/v_buf [2, CHUNK*ps, hd] (+ sk/sv_buf
+    # [2, 1, CHUNK*ps] when quant), acc [S*G, hd] f32, m/l [S*G, 128]
+    # f32, DMA sems
+    *refs,
     page_size: int,
     softcap: float,
     scale: float,
     has_layer: bool = False,
+    quant: bool = False,
 ):
     """Multi-token decode attention: S candidate tokens per slot attend
     the slot's paged context in one program (the speculative-decoding
@@ -631,6 +731,18 @@ def _mt_kernel(
     DMA as the single-token kernel — query row s sees keys up to
     ``positions0 + s`` (causal within the candidates) intersected with
     the sliding window when one applies."""
+    if quant:
+        (
+            q_ref, k_pages_ref, v_pages_ref, k_scale_ref, v_scale_ref,
+            out_ref, k_buf, v_buf, sk_buf, sv_buf, acc_ref, m_ref, l_ref,
+            sems,
+        ) = refs
+    else:
+        (
+            q_ref, k_pages_ref, v_pages_ref,
+            out_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems,
+        ) = refs
+        k_scale_ref = v_scale_ref = sk_buf = sv_buf = None
     b = pl.program_id(0)
     g = pl.program_id(1)
     pos0 = positions0_ref[b]
@@ -649,6 +761,8 @@ def _mt_kernel(
         page_tables_ref, k_pages_ref, v_pages_ref, k_buf, v_buf, sems,
         b, g, n_pages, page_size,
         layer=layer_ref[0] if has_layer else None,
+        k_scale_ref=k_scale_ref, v_scale_ref=v_scale_ref,
+        sk_buf=sk_buf, sv_buf=sv_buf,
     )
 
     S, G, hd = q_ref.shape[-3], q_ref.shape[-2], q_ref.shape[-1]
@@ -685,6 +799,10 @@ def _mt_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [S*G, chunk_tokens]
+        if quant:
+            # fold the per-token K scale into the score row (exact:
+            # the scale is constant over hd) — see _kernel
+            scores = scores * _scale_row(sk_buf, slot)
         if softcap:
             scores = jnp.tanh(scores / softcap) * softcap
         token_pos = c * chunk_tokens + jax.lax.broadcasted_iota(
@@ -705,6 +823,10 @@ def _mt_kernel(
         # the accumulator with exp(-1e30 - (-1e30)) = 1 weights
         p = jnp.where(valid, p, 0.0)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        if quant:
+            # weight the softmax row by the per-token V scale; l stays
+            # unweighted (it normalizes probabilities, not values)
+            p = p * _scale_row(sv_buf, slot)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -742,9 +864,18 @@ def paged_multitok_attention_pallas(
     return unspecified values (their garbage queries attend the real
     context) — callers must mask by ``input_lens``, as the engine and
     the tests do."""
+    from vgate_tpu.ops.kv_quant import is_quantized
+
     B, S, H, hd = q.shape
     has_layer = layer is not None
-    KV, P, ps, _ = k_pages.shape[1:] if has_layer else k_pages.shape
+    quant = is_quantized(k_pages)
+    k_data, k_scale = (
+        (k_pages.data, k_pages.scale) if quant else (k_pages, None)
+    )
+    v_data, v_scale = (
+        (v_pages.data, v_pages.scale) if quant else (v_pages, None)
+    )
+    KV, P, ps, _ = k_data.shape[1:] if has_layer else k_data.shape
     G = H // KV
     chunk_tokens = CHUNK_PAGES * ps
 
@@ -763,7 +894,24 @@ def paged_multitok_attention_pallas(
         softcap=float(softcap),
         scale=float(scale) if scale is not None else hd ** -0.5,
         has_layer=has_layer,
+        quant=quant,
     )
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    scratch = [
+        pltpu.VMEM((2, chunk_tokens, hd), k_data.dtype),
+        pltpu.VMEM((2, chunk_tokens, hd), v_data.dtype),
+    ]
+    if quant:
+        scratch += [
+            pltpu.VMEM((2, 1, chunk_tokens), k_scale.dtype),
+            pltpu.VMEM((2, 1, chunk_tokens), v_scale.dtype),
+        ]
+    scratch += [
+        pltpu.VMEM((S * G, hd), jnp.float32),
+        pltpu.VMEM((S * G, 128), jnp.float32),
+        pltpu.VMEM((S * G, 128), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 4 if quant else 2, CHUNK_PAGES)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(B, KV),
@@ -773,28 +921,25 @@ def paged_multitok_attention_pallas(
                 lambda b, g, *pf: (b, g, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+            any_spec,
+            any_spec,
+        ]
+        + ([any_spec, any_spec] if quant else []),
         out_specs=pl.BlockSpec(
             (1, 1, S, G, hd),
             lambda b, g, *pf: (b, g, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, chunk_tokens, hd), k_pages.dtype),
-            pltpu.VMEM((2, chunk_tokens, hd), v_pages.dtype),
-            pltpu.VMEM((S * G, hd), jnp.float32),
-            pltpu.VMEM((S * G, 128), jnp.float32),
-            pltpu.VMEM((S * G, 128), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, 2, CHUNK_PAGES)),
-        ],
+        scratch_shapes=scratch,
     )
     # [B, S, H, hd] -> [B, KV, S, G, hd]: KV-major so one program's block
     # covers its group's rows contiguously
     qt = jnp.transpose(
         q.reshape(B, S, KV, G, hd), (0, 2, 1, 3, 4)
     )
+    inputs = [qt, k_data, v_data]
+    if quant:
+        inputs += [k_scale, v_scale]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -805,6 +950,6 @@ def paged_multitok_attention_pallas(
         ),
     )(
         page_tables, positions0, input_lens, window_arr, layer_arr,
-        qt, k_pages, v_pages,
+        *inputs,
     )
     return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, S, H, hd)

@@ -66,6 +66,11 @@ from vgate_tpu.ops.sampling import (
 )
 from vgate_tpu.observability.flight import FlightRecorder
 from vgate_tpu.observability.reqtrace import RequestMeta, RequestTrace
+from vgate_tpu.ops.kv_quant import (
+    SCALE_BYTES,
+    copy_page_prefix,
+    dtype_short_name,
+)
 from vgate_tpu.parallel.mesh import build_mesh, initialize_distributed
 from vgate_tpu.parallel.sharding import kv_pspec, named, shard_params
 from vgate_tpu.runtime.kv_cache import (
@@ -184,16 +189,16 @@ def _cow_copy_pages(k_pages, v_pages, src, dst, upto):
     first ``upto`` token slots of page ``src`` into page ``dst`` across
     every layer and head, so a sequence diverging mid-page gets the
     shared head's KV without recomputing it.  Scalars are traced — one
-    compile serves every (src, dst, upto) combination."""
+    compile serves every (src, dst, upto) combination.  int8 pools copy
+    the per-slot SCALES with the data (ops/kv_quant.copy_page_prefix):
+    a COW'd head dequantizes bit-identically to the page it came from,
+    so shared and diverged readers never disagree."""
     ps = k_pages.shape[-2]
-    keep = (jnp.arange(ps) < upto)[:, None]  # [ps, 1] broadcasts over hd
-    k_pages = k_pages.at[:, :, dst].set(
-        jnp.where(keep, k_pages[:, :, src], k_pages[:, :, dst])
+    keep = jnp.arange(ps) < upto  # [ps]
+    return (
+        copy_page_prefix(k_pages, src, dst, keep),
+        copy_page_prefix(v_pages, src, dst, keep),
     )
-    v_pages = v_pages.at[:, :, dst].set(
-        jnp.where(keep, v_pages[:, :, src], v_pages[:, :, dst])
-    )
-    return k_pages, v_pages
 
 
 def _decode_step(
@@ -606,6 +611,41 @@ class EngineCore:
         params_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
         )
+        # KV storage format (kv_cache.dtype — ops/kv_quant.py): int8
+        # halves page data bytes (plus a bf16 scale per page/head/slot),
+        # so the same HBM budget below yields ~2x the bf16 page count —
+        # resident-batch capacity is what governs tail latency under
+        # load (PAPERS.md vLLM/TGI study), and decode HBM traffic per
+        # token halves with it.  Quantization happens at every KV write
+        # site (models/decoder.py kv_write) and dequantization inside
+        # the attention reads (Pallas VMEM loop / jnp gather twins).
+        kv_mode = self.config.kv_cache.dtype
+        self._kv_quant = kv_mode == "int8"
+        if self._kv_quant:
+            model_axes = {
+                a: int(self.mesh.shape.get(a, 1))
+                for a in ("tp", "pp", "sp", "ep")
+            }
+            bad = {a: n for a, n in model_axes.items() if n > 1}
+            if bad:
+                raise ValueError(
+                    f"kv_cache.dtype=int8 requires a plain mesh, got "
+                    f"{bad}: the quantized pool is a (data, scale) pair "
+                    "the sp/pp relays and tp shard_map kernels do not "
+                    "thread — dp composes (each replica owns its pool)"
+                )
+            kv_pool_dtype = jnp.int8
+        elif kv_mode == "bf16":
+            kv_pool_dtype = jnp.bfloat16
+        else:  # auto: pages store the model compute dtype
+            kv_pool_dtype = self.dtype
+        kv_dtype_name = (
+            "int8" if self._kv_quant else dtype_short_name(kv_pool_dtype)
+        )
+        kv_dtype_bytes = 1 if self._kv_quant else jnp.dtype(
+            kv_pool_dtype
+        ).itemsize
+        kv_scale_bytes = SCALE_BYTES if self._kv_quant else 0
         # more pages than every slot's full context can never be used, and
         # bounding the pool keeps the page-scatter/gather programs small
         pages_per_seq = cdiv(
@@ -623,8 +663,9 @@ class EngineCore:
                 tpu_cfg.hbm_utilization,
                 device=self.mesh.devices.flat[0],
                 params_bytes=params_bytes,
-                dtype_bytes=jnp.dtype(self.dtype).itemsize,
+                dtype_bytes=kv_dtype_bytes,
                 hbm_bytes=tpu_cfg.hbm_bytes,
+                scale_bytes=kv_scale_bytes,
             ),
         )
         if sp_shards > 1:
@@ -639,16 +680,23 @@ class EngineCore:
             kv_heads=self.spec.num_kv_heads,
             head_dim=self.spec.head_dim,
             max_model_len=self.config.model.max_model_len,
-            dtype_bytes=jnp.dtype(self.dtype).itemsize,
+            dtype_bytes=kv_dtype_bytes,
             num_reserved=sp_shards,
+            scale_bytes=kv_scale_bytes,
+            kv_dtype=kv_dtype_name,
         )
         kv_sharding = named(
             self.mesh, kv_pspec(self.spec, self.mesh, num_pages)
         )
         self.k_pages, self.v_pages = make_kv_buffers(
-            self.geometry, self.dtype, kv_sharding
+            self.geometry, kv_pool_dtype, kv_sharding
         )
         self.allocator = PageAllocator(num_pages, num_shards=sp_shards)
+        self.allocator.quantized = self._kv_quant
+        for name in ("bf16", "f32", "f16", "int8"):
+            metrics.KV_DTYPE.labels(dtype=name).set(
+                1 if name == kv_dtype_name else 0
+            )
         self.max_slots = tpu_cfg.max_batch_slots
         # prefix caching rides the suffix prefill program, which runs on
         # plain meshes AND sp-sharded pools (parallel/sp_decode.py
@@ -1351,6 +1399,16 @@ class EngineCore:
                         continue
                     if seq.trace is not None:
                         seq.trace.resumed()
+                    # stamp the pool format the checkpoint's sampling
+                    # history was produced under: submit_existing on the
+                    # replay target refuses a mismatch (a replica fleet
+                    # mid-rollout can mix kv dtypes; replaying into a
+                    # different format would silently change numerics
+                    # mid-generation).  getattr: bare-core test fakes
+                    # run containment without ever building a pool.
+                    geo = getattr(self, "geometry", None)
+                    if geo is not None:
+                        seq.kv_dtype = geo.kv_dtype
                     seq.prepare_resume()
                     kept.append(seq)
                     continue
@@ -1420,6 +1478,20 @@ class EngineCore:
         already folded the partial generation into the prompt)."""
         if self._fatal is not None:
             raise RuntimeError("engine is dead") from self._fatal
+        if (
+            seq.kv_dtype is not None
+            and seq.kv_dtype != self.geometry.kv_dtype
+        ):
+            # fail cleanly instead of replaying garbage: the generated
+            # prefix being folded into the prompt was sampled against a
+            # different KV storage format — continuing it here would
+            # splice two numerically different streams.  replay_into
+            # turns this into the typed retryable 503.
+            raise ValueError(
+                f"checkpoint was taken under kv dtype "
+                f"{seq.kv_dtype!r} but this core serves "
+                f"{self.geometry.kv_dtype!r}; refusing the replay"
+            )
         seq.on_settle = (
             self._on_seq_settle if self.flight.enabled else None
         )
@@ -3049,6 +3121,11 @@ class EngineCore:
             "prefix_cached_ratio": round(
                 self.allocator.num_cached / total, 4
             ),
+            # capacity identity for admission (auto_token_budget scales
+            # the token backlog limit with it) and attribution: int8 KV
+            # roughly doubles both vs bf16 at the same HBM budget
+            "kv_token_capacity": self.geometry.total_tokens,
+            "kv_dtype": self.geometry.kv_dtype,
             "engine_queue_depth": len(self.scheduler.waiting),
             "running": len(self.scheduler.running),
         }
@@ -3080,6 +3157,10 @@ class EngineCore:
             "flight": self.flight.get_stats(),
             "kv_pages_total": self.allocator.num_allocatable,
             "kv_token_capacity": self.geometry.total_tokens,
+            # KV storage attribution: drills and bench artifacts read
+            # these so every recorded number names its KV config
+            "kv_dtype": self.geometry.kv_dtype,
+            "kv_page_bytes": self.geometry.page_bytes,
             "model": self.spec.name,
             "mesh": {
                 axis: int(size) for axis, size in self.mesh.shape.items()

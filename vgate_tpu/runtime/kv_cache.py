@@ -29,12 +29,17 @@ logger = get_logger(__name__)
 
 def _page_bytes(
     num_layers: int, page_size: int, kv_heads: int, head_dim: int,
-    dtype_bytes: int,
+    dtype_bytes: int, scale_bytes: int = 0,
 ) -> int:
     """Bytes one page occupies across all layers, K and V together — the
     single source of truth for page sizing (used by both KVGeometry and
-    auto_num_pages)."""
-    return 2 * num_layers * page_size * kv_heads * head_dim * dtype_bytes
+    auto_num_pages).  ``scale_bytes`` is the per-token-per-head
+    quantization-scale overhead (0 for plain bf16/f32 pools; int8 KV
+    stores one bf16 scale per (page, head, slot) — ops/kv_quant.py)."""
+    return (
+        2 * num_layers * page_size * kv_heads
+        * (head_dim * dtype_bytes + scale_bytes)
+    )
 
 
 @dataclass(frozen=True)
@@ -49,6 +54,11 @@ class KVGeometry:
     # reserved trash pages: 1 normally, sp under sequence-parallel decode
     # (one local trash per pool shard, parallel/sp_decode.py)
     num_reserved: int = 1
+    # per-token-per-head scale bytes: 0 for plain pools, 2 (bf16) for
+    # int8 KV (kv_cache.dtype: int8 — ops/kv_quant.py)
+    scale_bytes: int = 0
+    # reporting name for /stats, drills and bench artifacts
+    kv_dtype: str = "bf16"
 
     @property
     def pages_per_seq(self) -> int:
@@ -58,7 +68,7 @@ class KVGeometry:
     def page_bytes(self) -> int:
         return _page_bytes(
             self.num_layers, self.page_size, self.kv_heads, self.head_dim,
-            self.dtype_bytes,
+            self.dtype_bytes, self.scale_bytes,
         )
 
     @property
@@ -80,6 +90,7 @@ def auto_num_pages(
     hard_cap: int = 65536,
     dtype_bytes: int = 2,
     hbm_bytes: int = 0,
+    scale_bytes: int = 0,
 ) -> int:
     """Size the page pool from free device HBM after weights are resident
     (the serving analogue of vLLM's gpu_memory_utilization knob,
@@ -90,13 +101,16 @@ def auto_num_pages(
     ``tpu.hbm_bytes``; default 16 GiB/chip, the v5e part) minus the actual
     parameter bytes, and on CPU test platforms we return ``fallback``.
     ``dtype_bytes`` is the KV cache element width (fp32 KV needs twice the
-    page budget of bf16).
+    page budget of bf16); ``scale_bytes`` the per-token-per-head
+    quantization-scale overhead (int8 KV: dtype_bytes=1, scale_bytes=2 —
+    the same budget then yields ~2x the bf16 page count, the capacity
+    half of the roofline lever).
     """
     device = device or jax.devices()[0]
     stats = getattr(device, "memory_stats", lambda: None)()
     page_bytes = _page_bytes(
         spec.num_layers, page_size, spec.num_kv_heads, spec.head_dim,
-        dtype_bytes,
+        dtype_bytes, scale_bytes,
     )
     if stats and "bytes_limit" in stats:
         limit = stats["bytes_limit"] * hbm_utilization
@@ -149,9 +163,17 @@ class PageAllocator:
         self._reclaimer = None
         self.prefix_hits = 0
         self.prefix_evictions = 0
+        # set by the engine when the pool stores int8 KV (kv_cache.dtype:
+        # int8): every in-use page then holds quantized content, and the
+        # vgt_kv_quantized_pages gauge tracks it alongside KV_PAGES_IN_USE
+        self.quantized = False
         self._allocatable = num_pages - len(self.reserved)
         metrics.KV_PAGES_TOTAL.set(self._allocatable)
-        metrics.KV_PAGES_IN_USE.set(0)
+        self._set_in_use(0)
+
+    def _set_in_use(self, used: int) -> None:
+        metrics.KV_PAGES_IN_USE.set(used)
+        metrics.KV_QUANTIZED_PAGES.set(used if self.quantized else 0)
 
     def set_reclaimer(self, reclaimer) -> None:
         """Attach a cache that can free refcounted pages on demand
@@ -211,7 +233,7 @@ class PageAllocator:
                 metrics.PREFIX_EVICTIONS.labels(reason="lru").inc()
             self._refs[page] = 1
             pages.append(page)
-        metrics.KV_PAGES_IN_USE.set(self.num_used)
+        self._set_in_use(self.num_used)
         return pages
 
     def refcount(self, page: int) -> int:
@@ -229,7 +251,7 @@ class PageAllocator:
                 # free page would let allocate() hand it out again
                 raise ValueError(f"retain of unreferenced page {page}")
             self._refs[page] = refs + 1
-        metrics.KV_PAGES_IN_USE.set(self.num_used)
+        self._set_in_use(self.num_used)
 
     def release(self, pages: List[int]) -> None:
         for page in pages:
@@ -246,7 +268,7 @@ class PageAllocator:
                 self._evictable.move_to_end(page)
             else:
                 self._free.append(page)
-        metrics.KV_PAGES_IN_USE.set(self.num_used)
+        self._set_in_use(self.num_used)
         if self._reclaimer is None and self._page_hash:
             metrics.PREFIX_CACHED_PAGES.set(len(self._evictable))
 
@@ -284,7 +306,7 @@ class PageAllocator:
             metrics.PREFIX_CACHED_PAGES.set(len(self._evictable))
         else:
             self._refs[page] = self._refs.get(page, 0) + 1
-        metrics.KV_PAGES_IN_USE.set(self.num_used)
+        self._set_in_use(self.num_used)
         return page
 
     def _drop_hash(self, page: int) -> None:
@@ -294,7 +316,18 @@ class PageAllocator:
 
 
 def make_kv_buffers(geometry: KVGeometry, dtype=jnp.bfloat16, sharding=None):
-    """Allocate the K/V page pools (zeros) directly on device."""
+    """Allocate the K/V page pools (zeros) directly on device.
+
+    With ``geometry.kv_dtype == "int8"`` each pool is a
+    :class:`~vgate_tpu.ops.kv_quant.QuantPages` pair — int8 data plus
+    the per-(page, head, slot) bf16 scale pool (initialized to 1, the
+    scale :func:`~vgate_tpu.ops.kv_quant.quantize` assigns all-zero
+    rows, so the zeroed pool dequantizes to exactly 0).  int8 KV
+    requires a plain mesh (the engine enforces it), so ``sharding``
+    is effectively single-device/replicated there.
+    """
+    from vgate_tpu.ops.kv_quant import SCALE_DTYPE, QuantPages
+
     shape = (
         geometry.num_layers,
         geometry.kv_heads,
@@ -302,19 +335,45 @@ def make_kv_buffers(geometry: KVGeometry, dtype=jnp.bfloat16, sharding=None):
         geometry.page_size,
         geometry.head_dim,
     )
-    if sharding is not None:
-        k = jax.device_put(jnp.zeros(shape, dtype), sharding)
-        v = jax.device_put(jnp.zeros(shape, dtype), sharding)
+
+    def _place(arr, shard):
+        return arr if shard is None else jax.device_put(arr, shard)
+
+    if geometry.kv_dtype == "int8":
+        scale_sharding = None
+        if sharding is not None and hasattr(sharding, "spec"):
+            # the scale pool drops the trailing head_dim: same spec
+            # minus its last axis (all-None on the plain meshes int8
+            # is restricted to, but keep the shapes honest)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            scale_sharding = NamedSharding(
+                sharding.mesh, PartitionSpec(*tuple(sharding.spec)[:-1])
+            )
+
+        def pool():
+            return QuantPages(
+                data=_place(jnp.zeros(shape, jnp.int8), sharding),
+                scale=_place(
+                    jnp.ones(shape[:-1], SCALE_DTYPE), scale_sharding
+                ),
+            )
+
+        k, v = pool(), pool()
     else:
-        k = jnp.zeros(shape, dtype)
-        v = jnp.zeros(shape, dtype)
+        k = _place(jnp.zeros(shape, dtype), sharding)
+        v = _place(jnp.zeros(shape, dtype), sharding)
+    pool_bytes = 2 * sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(k)
+    )
     logger.info(
         "kv cache allocated",
         extra={
             "extra_data": {
                 "pages": geometry.num_pages,
                 "tokens_capacity": geometry.total_tokens,
-                "mb": round(2 * k.size * k.dtype.itemsize / 1e6),
+                "kv_dtype": geometry.kv_dtype,
+                "mb": round(pool_bytes / 1e6),
             }
         },
     )

@@ -76,6 +76,13 @@ class Sequence:
     # recovery.max_resume_attempts caps it; >0 marks the final result
     # `resumed` so clients can see the latency blip's cause.
     resume_count: int = 0
+    # KV storage format the generated prefix was sampled under, stamped
+    # by fatal containment when the sequence is checkpointed (engine
+    # geometry.kv_dtype — "bf16"/"f32"/"int8").  submit_existing on the
+    # replay target refuses a mismatch: continuing an int8-sampled
+    # prefix against a bf16 pool (or vice versa) would splice two
+    # numerically different streams mid-generation.
+    kv_dtype: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -186,6 +193,7 @@ class Sequence:
             "generated_tokens": len(self.generated_ids),
             "resume_count": self.resume_count,
             "deadline_t": self.deadline_t,
+            "kv_dtype": self.kv_dtype,
         }
 
     def resume_metrics(self) -> dict:
@@ -213,6 +221,7 @@ class Sequence:
             resume_count=self.resume_count,
             request_id=self.request_id,
             trace_id=getattr(self.trace, "trace_id", None),
+            kv_dtype=self.kv_dtype,
         )
 
     @classmethod
@@ -236,6 +245,7 @@ class Sequence:
             preempt_count=cp.preempt_count,
             resume_count=cp.resume_count + 1,
             request_id=cp.request_id,
+            kv_dtype=cp.kv_dtype,
         )
         # absolute deadline survives verbatim: the replay runs on the
         # request's ORIGINAL budget, not a fresh one
@@ -284,6 +294,10 @@ class SequenceCheckpoint:
     resume_count: int
     request_id: Optional[str]
     trace_id: Optional[str]
+    # KV storage format the generation ran under (engine
+    # geometry.kv_dtype); a replay target with a different format must
+    # refuse the checkpoint instead of splicing numerics
+    kv_dtype: Optional[str] = None
 
     def as_dict(self) -> dict:
         """Loggable summary (token *counts*, never token content — the
@@ -297,4 +311,5 @@ class SequenceCheckpoint:
             "generated_tokens": len(self.generated_ids),
             "resume_count": self.resume_count,
             "deadline_t": self.deadline_t,
+            "kv_dtype": self.kv_dtype,
         }

@@ -1197,6 +1197,10 @@ async def get_stats(request: web.Request) -> web.Response:
             "cache_enabled": engine.config.cache.enabled,
             "engine_type": engine.config.model.engine_type,
             "model": engine.config.model.model_id,
+            # configured KV storage format — the engine section carries
+            # the *resolved* dtype, but backends without get_stats
+            # (dry-run drills) still need the config attributed
+            "kv_dtype": engine.config.kv_cache.dtype,
         },
     }
     engine_stats = getattr(engine.backend, "get_stats", None)
