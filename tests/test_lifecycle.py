@@ -340,7 +340,16 @@ async def test_drain_completes_inflight_dry_run():
             )
             for i in range(4)
         ]
-        await asyncio.sleep(0.1)  # batch dispatched, sleeping in delay
+        # wait until all 4 are actually in flight (a fixed sleep races
+        # the event loop under full-suite load: drain would flip
+        # readiness before the POSTs reach the handler and shed them)
+        deadline = time.monotonic() + 5.0
+        while (
+            app["drain"].inflight() < 4
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.02)
+        assert app["drain"].inflight() == 4
         app["drain"].begin()
         resp = await client.get("/health/ready")
         assert resp.status == 503

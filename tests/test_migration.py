@@ -1,0 +1,612 @@
+"""Planned live migration — unit tier (no engine builds).
+
+Covers the pure pieces of ISSUE 8: the Sequence fold/epoch semantics of
+``prepare_migrate``, the hysteresis/rate-limit rebalancing policy on a
+fake clock, the kv-dtype placement gate (both directions), the shared
+``replay_into`` pipeline's migrate flavor, the scheduler's
+evacuate/bypass behavior, and the dp=1 supervisor's deliberate refusal.
+Engine-level drain/rebalance acceptance lives in tests/test_dp_engine.py
+(slow tier) and scripts/migrate_check.sh.
+"""
+
+import queue
+import threading
+import time
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+from vgate_tpu import metrics
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import load_config
+from vgate_tpu.errors import MigrationRefusedError, PoisonRequestError
+from vgate_tpu.runtime.dp_engine import (
+    RebalancePolicy,
+    ReplicatedEngine,
+    _structural,
+)
+from vgate_tpu.runtime.engine_core import EngineCore, replay_into
+from vgate_tpu.runtime.kv_cache import PageAllocator
+from vgate_tpu.runtime.scheduler import Scheduler
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+from vgate_tpu.runtime.supervisor import EngineSupervisor
+
+
+def greedy(max_tokens=8, **kw):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0, **kw)
+
+
+# --------------------------------------------------- sequence semantics
+
+
+def test_prepare_migrate_folds_without_spending_resume_budget():
+    seq = Sequence(prompt_ids=[4, 5], params=greedy(8))
+    seq.status = SeqStatus.RUNNING
+    seq.slot = 1
+    seq.pages = [3, 9]
+    seq.append_token(11)
+    old_epoch = seq.preempt_count
+    seq.prepare_migrate()
+    # same fold/epoch contract as prepare_resume ...
+    assert seq.status is SeqStatus.WAITING
+    assert seq.prompt_ids == [4, 5, 11] and seq.output_ids == []
+    assert seq.pages == [] and seq.slot is None
+    assert seq.preempt_count == old_epoch + 1
+    assert not seq.done_event.is_set()
+    # ... but the crash-resume budget is untouched: a rolling deploy
+    # must never eat into the restarts a request may later survive
+    assert seq.migrate_count == 1
+    assert seq.resume_count == 0
+
+
+def test_resume_metrics_carries_both_flavors():
+    seq = Sequence(prompt_ids=[1], params=greedy())
+    assert seq.resume_metrics() == {}
+    seq.migrate_count = 2
+    assert seq.resume_metrics() == {"migrated": 2.0}
+    seq.resume_count = 1
+    assert seq.resume_metrics() == {"resumed": 1.0, "migrated": 2.0}
+
+
+def test_checkpoint_round_trip_preserves_migrate_count():
+    seq = Sequence(prompt_ids=[1, 2, 3], params=greedy(16))
+    seq.append_token(7)
+    seq.migrate_count = 1
+    cp = seq.checkpoint()
+    assert cp.migrate_count == 1
+    restored = Sequence.from_checkpoint(cp)
+    assert restored.migrate_count == 1
+    # the loggable summary stays in lockstep with the pure-data form
+    assert seq.checkpoint_summary() == cp.as_dict()
+
+
+# ----------------------------------------------------- scheduler pieces
+
+
+def _scheduler(max_queue=2, num_pages=16):
+    return Scheduler(
+        allocator=PageAllocator(num_pages),
+        max_slots=2,
+        page_size=4,
+        prefill_buckets=[8, 16],
+        max_model_len=32,
+        max_queue_size=max_queue,
+    )
+
+
+def test_scheduler_add_migrated_bypasses_queue_full():
+    sched = _scheduler(max_queue=1)
+    sched.add(Sequence(prompt_ids=[1, 2], params=greedy()))
+    fresh = Sequence(prompt_ids=[3, 4], params=greedy())
+    with pytest.raises(Exception):
+        sched.add(fresh)
+    moved = Sequence(prompt_ids=[5, 6], params=greedy())
+    moved.migrate_count = 1
+    sched.add(moved)  # already admitted once on the source replica
+    assert moved in sched.waiting
+
+
+def test_scheduler_evacuate_releases_without_settling():
+    sched = _scheduler()
+    sched.add(Sequence(prompt_ids=[1, 2, 3], params=greedy()))
+    plan = sched.try_admit()
+    seq = plan.seq
+    assert seq.status is SeqStatus.RUNNING and seq.pages
+    free_before = sched.allocator.num_free
+    sched.evacuate(seq)
+    # residency freed this tick; the future is still open (nothing
+    # settled — the sequence finishes wherever it is replayed)
+    assert sched.slots[plan.slot] is None
+    assert sched.allocator.num_free > free_before
+    assert not seq.done_event.is_set()
+    assert sched.total_finished == 0 and sched.total_aborted == 0
+    # waiting-queue evacuation just dequeues
+    queued = Sequence(prompt_ids=[4, 5], params=greedy())
+    sched.add(queued)
+    sched.evacuate(queued)
+    assert queued not in sched.waiting
+    assert not queued.done_event.is_set()
+
+
+# ------------------------------------------------- replay_into flavors
+
+
+class _FakeReplayCore:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.submitted = []
+        self.ticks = []
+        self.flight = SimpleNamespace(
+            record_tick=lambda kind, **f: self.ticks.append((kind, f))
+        )
+
+    def submit_existing(self, seq):
+        if self.fail:
+            raise RuntimeError("refused")
+        self.submitted.append(seq)
+
+
+def _metric_value(counter):
+    return counter._value.get()  # prometheus_client internal, test-only
+
+
+def test_replay_into_migrate_kind_records_migrate_not_resume():
+    core = _FakeReplayCore()
+    seq = Sequence(prompt_ids=[1, 2], params=greedy())
+    seq.prepare_migrate()
+    before = _metric_value(metrics.RESUMED_SEQUENCES)
+    outcome = replay_into(
+        core, seq, set(), kind="migrate", reason="drain"
+    )
+    assert outcome == "replayed"
+    assert core.submitted == [seq]
+    assert core.ticks and core.ticks[0][0] == "migrate"
+    assert core.ticks[0][1]["reason"] == "drain"
+    assert core.ticks[0][1]["attempt"] == 1  # migrate_count, not resume
+    # vgt_resumed_sequences is the CRASH counter; migrations have their
+    # own vgt_migrations{reason} owned by the dp caller
+    assert _metric_value(metrics.RESUMED_SEQUENCES) == before
+
+
+def test_replay_into_default_kind_still_counts_resume():
+    core = _FakeReplayCore()
+    seq = Sequence(prompt_ids=[1, 2], params=greedy())
+    seq.prepare_resume()
+    before = _metric_value(metrics.RESUMED_SEQUENCES)
+    assert replay_into(core, seq, set()) == "replayed"
+    assert core.ticks[0][0] == "resume"
+    assert _metric_value(metrics.RESUMED_SEQUENCES) == before + 1
+
+
+def test_replay_into_quarantine_applies_to_migration_too():
+    core = _FakeReplayCore()
+    seq = Sequence(prompt_ids=[1, 2], params=greedy())
+    seq.prepare_migrate()
+    from vgate_tpu import faults
+
+    fp = faults.fingerprint([1, 2])
+    outcome = replay_into(core, seq, {fp}, kind="migrate")
+    assert outcome == "quarantined"
+    assert isinstance(seq.error, PoisonRequestError)
+
+
+# ------------------------------------------- kv-dtype placement gate
+
+
+def _bare_dp():
+    return ReplicatedEngine.__new__(ReplicatedEngine)
+
+
+def _fake_core(kv_dtype, fatal=None):
+    return SimpleNamespace(
+        _fatal=fatal,
+        geometry=SimpleNamespace(kv_dtype=kv_dtype),
+    )
+
+
+def test_placement_refuses_int8_source_into_bf16_fleet():
+    dp = _bare_dp()
+    src = _fake_core("int8")
+    with pytest.raises(MigrationRefusedError) as exc:
+        dp._check_placement(src, [_fake_core("bf16")])
+    assert "kv-dtype mismatch" in str(exc.value)
+
+
+def test_placement_refuses_bf16_source_into_int8_fleet():
+    dp = _bare_dp()
+    src = _fake_core("bf16")
+    with pytest.raises(MigrationRefusedError):
+        dp._check_placement(src, [_fake_core("int8")])
+
+
+def test_placement_filters_to_matching_dtype_targets():
+    dp = _bare_dp()
+    src = _fake_core("int8")
+    match, mismatch = _fake_core("int8"), _fake_core("bf16")
+    assert dp._check_placement(src, [mismatch, match]) == [match]
+
+
+def test_placement_refuses_with_no_live_target():
+    dp = _bare_dp()
+    with pytest.raises(MigrationRefusedError) as exc:
+        dp._check_placement(_fake_core("bf16"), [])
+    assert "no eligible target" in str(exc.value)
+    with pytest.raises(MigrationRefusedError):
+        dp._check_placement(
+            _fake_core("bf16"),
+            [_fake_core("bf16", fatal=RuntimeError("dead"))],
+        )
+
+
+# ------------------------------------------------- rebalancing policy
+
+
+def _policy(clock, **overrides):
+    cfg = load_config(migration=overrides).migration
+    return RebalancePolicy(cfg, clock=lambda: clock[0])
+
+
+HOT = {"kv_free_ratio": 0.05, "engine_queue_depth": 0}
+IDLE = {"kv_free_ratio": 0.9, "engine_queue_depth": 0}
+WARM = {"kv_free_ratio": 0.4, "engine_queue_depth": 1}
+
+
+def test_rebalance_policy_hysteresis_on_fake_clock():
+    clock = [0.0]
+    p = _policy(clock, rebalance_hold_s=10.0, rebalance_cooldown_s=30.0)
+    sig = {0: HOT, 1: IDLE}
+    assert p.observe(sig) is None  # first hot tick: hold starts
+    clock[0] = 9.9
+    assert p.observe(sig) is None  # not ripe
+    clock[0] = 10.1
+    assert p.observe(sig) == (0, 1)  # sustained pressure -> move
+    clock[0] = 15.0
+    assert p.observe(sig) is None  # rate limit: cooldown
+    clock[0] = 41.0
+    assert p.observe(sig) == (0, 1)  # cooldown slid
+
+
+def test_rebalance_policy_never_flaps():
+    clock = [0.0]
+    p = _policy(clock, rebalance_hold_s=10.0, rebalance_cooldown_s=30.0)
+    # pressure that flaps on/off faster than the hold can never ripen
+    for t in range(0, 100, 5):
+        clock[0] = float(t)
+        sig = {0: HOT if (t // 5) % 2 == 0 else IDLE, 1: IDLE}
+        assert p.observe(sig) is None
+
+
+def test_rebalance_policy_requires_an_idle_target():
+    clock = [0.0]
+    p = _policy(clock, rebalance_hold_s=0.0)
+    # both replicas busy: moving work just moves the pressure around
+    assert p.observe({0: HOT, 1: WARM}) is None
+    clock[0] = 1.0
+    assert p.observe({0: HOT, 1: IDLE}) == (0, 1)
+
+
+def test_rebalance_policy_queue_depth_counts_as_hot():
+    clock = [0.0]
+    p = _policy(clock, rebalance_hold_s=0.0, hot_queue_depth=4)
+    deep = {"kv_free_ratio": 0.8, "engine_queue_depth": 5}
+    clock[0] = 1.0
+    assert p.observe({0: deep, 1: IDLE}) == (0, 1)
+
+
+def test_rebalance_policy_drops_state_for_absent_replicas():
+    clock = [0.0]
+    p = _policy(clock, rebalance_hold_s=10.0)
+    p.observe({0: HOT, 1: IDLE})
+    # replica 0 stops reporting (drained/removed) past ripeness ...
+    clock[0] = 20.0
+    p.observe({1: IDLE})
+    # ... and must NOT fire the moment it reappears: the hold restarts
+    clock[0] = 21.0
+    assert p.observe({0: HOT, 1: IDLE}) is None
+
+
+# ------------------------------------------------- dp=1 refusal
+
+
+def test_supervisor_refuses_evacuation():
+    sup = EngineSupervisor.__new__(EngineSupervisor)
+    with pytest.raises(MigrationRefusedError) as exc:
+        sup.evacuate()
+    assert "dp=1" in str(exc.value)
+
+
+# --------------------------------------- evacuation command plumbing
+
+
+def test_fail_pending_evacuations_unblocks_waiters():
+    """A caller blocked in evacuate() while the engine dies must get a
+    prompt typed error, not a full timeout."""
+    core = EngineCore.__new__(EngineCore)
+    core._fatal = None
+    core._evac_q = queue.Queue()
+    core._wakeup = threading.Event()
+    results = {}
+
+    def call():
+        try:
+            core.evacuate(None, timeout=10.0)
+        except RuntimeError as exc:
+            results["error"] = exc
+
+    t = threading.Thread(target=call)
+    t.start()
+    deadline = time.monotonic() + 5
+    while core._evac_q.empty() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    core._fail_pending_evacuations(RuntimeError("boom"))
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert "unavailable for evacuation" in str(results["error"])
+
+
+# ------------------------------------------------ merged flight writer
+
+
+def test_merged_flight_records_pod_tick_once():
+    """The batcher's overload hook writes through backend.core.flight;
+    on a dp pod that is the merged view, which must accept the tick and
+    land it on exactly one live recorder (the drill surfaced this as an
+    AttributeError + a dropped tick on every brownout transition)."""
+    from vgate_tpu.observability.flight import FlightRecorder
+    from vgate_tpu.runtime.dp_engine import _MergedFlight
+
+    replicas = [
+        SimpleNamespace(flight=FlightRecorder()) for _ in range(2)
+    ]
+    merged = _MergedFlight(replicas)
+    merged.record_tick("overload", level=3, prev=0)
+    ticks = merged.ticks()
+    assert [t["kind"] for t in ticks] == ["overload"]
+    assert ticks[0]["level"] == 3
+
+
+def test_evacuation_timeout_is_not_treated_as_replica_death():
+    """MigrationError subclasses RuntimeError; _evacuate_all must let
+    the timeout propagate instead of swallowing it into the dead-
+    replica claim path — remove_replica would otherwise proceed to
+    stop() a replica still full of live sequences."""
+    from vgate_tpu.errors import MigrationError
+
+    dp = _bare_dp()
+    dp._mig = load_config().migration
+
+    class _TimingOutCore:
+        _fatal = None
+
+        def evacuate(self, seq_ids, reason, timeout):
+            raise MigrationError("evacuation did not complete")
+
+    dp._alive_override = None
+    with pytest.raises(MigrationError):
+        dp._evacuate_all(_TimingOutCore(), "drain")
+
+
+def test_cancelled_evacuation_is_never_executed():
+    """A timed-out caller cancels its _EvacRequest; the engine thread
+    must skip it entirely — executing it later would strand the
+    evacuated sequences with no waiter to place them."""
+    core = EngineCore.__new__(EngineCore)
+    core._fatal = None
+    core._evac_q = queue.Queue()
+    core._wakeup = threading.Event()
+    with pytest.raises(Exception) as exc:
+        core.evacuate(None, timeout=0.05)
+    assert "did not complete" in str(exc.value)
+    # the stale request is still queued but marked cancelled: the
+    # engine-thread pass must drop it without calling _evacuate_now
+    # (which would explode on this bare core if reached)
+    assert core._evac_q.qsize() == 1
+    core._process_evacuations()
+    assert core._evac_q.qsize() == 0
+
+
+def test_rebalance_failed_move_releases_cooldown():
+    """A decision whose execution moved nothing must not burn the full
+    rebalance cooldown — the pressured replica stays eligible."""
+    clock = [0.0]
+    pol = _policy(clock, rebalance_hold_s=10, rebalance_cooldown_s=300)
+    for _ in range(3):
+        clock[0] += 6
+        decision = pol.observe({0: HOT, 1: IDLE})
+    assert decision == (0, 1)
+    # executor found no victims: without the release, the next ripe
+    # tick would be suppressed for rebalance_cooldown_s
+    pol.note_move_failed()
+    clock[0] += 6
+    assert pol.observe({0: HOT, 1: IDLE}) == (0, 1)
+
+
+def test_claim_dead_places_as_resume_not_migrate():
+    """Sequences a planned drain claims from a CRASHED replica were
+    folded by prepare_resume — they must replay as resumes (resumed
+    counter, resume tick) so provenance flags and metrics agree."""
+    dp = _bare_dp()
+    dp.total_resumed = 0
+    dp.total_migrated = 0
+    dp.total_lost = 0
+    dp._quarantine = set()
+    dp._recovery = SimpleNamespace(backoff_base_s=0.05, backoff_cap_s=0.2)
+    dp._restart_times = []
+    target = _FakeReplayCore()
+    target._fatal = None
+    target.geometry = SimpleNamespace(kv_dtype=None)
+    target.scheduler = SimpleNamespace(waiting=[], running=[])
+    seq = Sequence(prompt_ids=[1, 2], params=greedy())
+    seq.prepare_resume()
+    before = dp.total_migrated
+    moved, lost, _ = dp._place([seq], [target], "drain", 0, kind="resume")
+    assert (moved, lost) == (1, 0)
+    assert dp.total_resumed == 1
+    assert dp.total_migrated == before
+    assert target.ticks[0][0] == "resume"
+
+
+def test_rebalance_folds_victims_back_when_cold_dies():
+    """The rebalance target dying between decision and placement must
+    not 503 healthy requests — they fold back into the hot replica."""
+    dp = _bare_dp()
+    dp._mig = load_config().migration
+    dp.total_lost = 0
+    dp._policy = RebalancePolicy(dp._mig)
+    seq = Sequence(prompt_ids=[1, 2], params=greedy())
+    seq.status = SeqStatus.RUNNING
+    for t in range(dp._mig.min_generated_tokens):
+        seq.append_token(t)
+    hot = _FakeReplayCore()
+    hot._fatal = None
+    hot.geometry = SimpleNamespace(kv_dtype=None)
+    hot.scheduler = SimpleNamespace(running=[seq], waiting=[])
+    hot.evacuate = lambda ids, reason, timeout: [seq]
+    cold = SimpleNamespace(
+        _fatal=RuntimeError("died"),
+        geometry=SimpleNamespace(kv_dtype=None),
+    )
+    assert dp._rebalance(hot, cold, 0) is None
+    assert hot.submitted == [seq]          # back where it was running
+    assert dp.total_lost == 0
+    assert not seq.done_event.is_set()     # client still streaming
+    assert dp._policy._last_move_t is None  # cooldown released
+
+
+# ------------------------------------- structural-op concurrency fixes
+
+
+def test_alive_requires_running_loop():
+    """A cleanly-stopped core (remove_replica teardown) has _fatal None
+    but no engine loop: migrating into it would strand the sequence in
+    a queue nothing drains while metrics count a successful move."""
+    assert ReplicatedEngine._alive(
+        SimpleNamespace(_fatal=None, _running=True)
+    )
+    assert not ReplicatedEngine._alive(
+        SimpleNamespace(_fatal=None, _running=False)
+    )
+    assert not ReplicatedEngine._alive(
+        SimpleNamespace(_fatal=RuntimeError("x"), _running=True)
+    )
+
+
+def test_structural_ops_hold_the_lock_for_their_full_duration():
+    """Drain/undrain/add/remove must fully serialize — the last-replica
+    guard and index-keyed draining marks are only sound when no other
+    structural op interleaves with the long evacuation phase (which
+    releases _topology_lock on purpose)."""
+    for name in (
+        "drain_replica", "undrain_replica", "add_replica",
+        "remove_replica",
+    ):
+        assert hasattr(getattr(ReplicatedEngine, name), "__wrapped__")
+    dp = _bare_dp()
+    dp._structural_lock = threading.RLock()
+    order = []
+
+    @_structural
+    def slow(self):
+        order.append("slow-in")
+        time.sleep(0.2)
+        order.append("slow-out")
+
+    @_structural
+    def fast(self):
+        order.append("fast-in")
+        order.append("fast-out")
+
+    t = threading.Thread(target=slow, args=(dp,))
+    t.start()
+    deadline = time.monotonic() + 2
+    while "slow-in" not in order and time.monotonic() < deadline:
+        time.sleep(0.005)
+    fast(dp)
+    t.join()
+    assert order == ["slow-in", "slow-out", "fast-in", "fast-out"]
+
+
+def test_health_gauge_counts_alive_draining_replica():
+    """vgt_dp_replicas_alive has ONE definition (liveness, not rotation
+    membership): a planned drain must not sawtooth the gauge between
+    /health scrapes and repair-sweep ticks or fire VgtDpReplicaDown."""
+    dp = _bare_dp()
+    dp._topology_lock = threading.RLock()
+    dp.replicas = [
+        SimpleNamespace(_fatal=None, _running=True) for _ in range(2)
+    ]
+    dp._draining = {0}
+    dp._failover_enabled = True
+    dp._restart_times = []
+    dp._quarantine = set()
+    dp.total_failovers = dp.total_restarts = dp.total_stalls = 0
+    dp.total_resumed = dp.total_migrated = dp.total_lost = 0
+    h = dp.health()
+    assert h["replicas_alive"] == 2        # drained-but-alive counts
+    assert h["replicas"][0]["state"] == "draining"
+    assert h["state"] == "degraded"        # the drain shows here...
+    assert metrics.DP_REPLICAS_ALIVE._value.get() == 2  # ...not here
+
+
+def test_place_folds_back_into_alive_source_when_targets_die():
+    """A drain whose targets all die mid-op must fold residents back
+    into the still-alive source (requeued), not 503 them as lost."""
+    dp = _bare_dp()
+    dp.total_lost = 0
+    dp.total_migrated = 0
+    dp._quarantine = set()
+    dp._recovery = SimpleNamespace(backoff_base_s=0.05, backoff_cap_s=0.2)
+    dp._restart_times = []
+    source = _FakeReplayCore()
+    source._fatal = None
+    source._running = True
+    source.geometry = SimpleNamespace(kv_dtype=None)
+    dead_target = SimpleNamespace(
+        _fatal=RuntimeError("died mid-drain"),
+        _running=True,
+        geometry=SimpleNamespace(kv_dtype=None),
+    )
+    seq = Sequence(prompt_ids=[1, 2], params=greedy())
+    seq.prepare_migrate()
+    moved, lost, requeued = dp._place(
+        [seq], [dead_target], "drain", 0, fallback=source
+    )
+    assert (moved, lost, requeued) == (0, 0, 1)
+    assert source.submitted == [seq]       # back on the source
+    assert dp.total_lost == 0
+    assert not seq.done_event.is_set()     # client still streaming
+
+
+def test_dead_source_gate_falls_back_when_listed_targets_are_dead():
+    """drain/remove of a DEAD replica must reach _fallback_targets when
+    every non-draining sibling is ALSO dead — not only when the target
+    list is empty — so an alive draining survivor still takes the
+    claimed checkpoint (matching _redistribute)."""
+    dp = _bare_dp()
+    dp._topology_lock = threading.RLock()
+    dead_src = SimpleNamespace(_fatal=RuntimeError("src"), _running=True)
+    dead_sib = SimpleNamespace(_fatal=RuntimeError("sib"), _running=True)
+    survivor = SimpleNamespace(_fatal=None, _running=True)
+    dp.replicas = [dead_src, dead_sib, survivor]
+    dp._draining = {2}
+    calls = {}
+
+    def fake_fallback(idx, core):
+        calls["fallback"] = idx
+        return [survivor]
+
+    def fake_evacuate_all(core, reason):
+        return [], "resume"
+
+    def fake_place(seqs, targets, reason, idx, kind, fallback=None):
+        calls["targets"] = targets
+        return 0, 0, 0
+
+    dp._fallback_targets = fake_fallback
+    dp._evacuate_all = fake_evacuate_all
+    dp._place = fake_place
+    dp._drain_and_place(0, "drain")
+    assert calls["fallback"] == 0
+    assert calls["targets"] == [survivor]
+    dp._draining.discard(0)

@@ -171,6 +171,7 @@ def _bare_core(resume=True, max_attempts=3, supervised=True):
         running=[], waiting=deque(), slots=[None] * 4
     )
     core._submit_q = queue.Queue()
+    core._evac_q = queue.Queue()
     core._pending_chunks = []
     core._checkpointed = []
     core._resume_losses = 0
@@ -381,6 +382,8 @@ def test_dp_redistribute_excludes_quarantined():
     survivor._fatal = None
     dead = SimpleNamespace(_fatal=RuntimeError("dead"))
     eng.replicas = [dead, survivor]
+    eng._topology_lock = threading.RLock()
+    eng._draining = set()
     eng._recovery = SimpleNamespace(
         backoff_base_s=0.05, backoff_cap_s=0.2
     )

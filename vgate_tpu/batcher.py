@@ -721,15 +721,15 @@ class RequestBatcher:
                     # one would replay a truncated generation to every
                     # later identical request.  Brownout level >= 4 skips
                     # the write path entirely (reads stay on — they only
-                    # help under overload).  `resumed` is per-delivery
-                    # provenance (THIS response rode a restart), never
-                    # cache content.
+                    # help under overload).  `resumed`/`migrated` are
+                    # per-delivery provenance (THIS response rode a
+                    # restart / a live migration), never cache content.
                     await self.cache.put(
                         lead.cache_key,
                         {
                             k: v
                             for k, v in payload.items()
-                            if k != "resumed"
+                            if k not in ("resumed", "migrated")
                         },
                     )
                 for req in groups[lead.cache_key]:
@@ -871,6 +871,11 @@ class RequestBatcher:
             # so a later ResultCache hit of this payload doesn't claim
             # a restart that never touched the cached reader
             out["resumed"] = True
+        if m.pop("migrated", 0):
+            # same contract for PLANNED movement (replica drain /
+            # rebalance / scale-down): per-delivery provenance, never
+            # cache content
+            out["migrated"] = True
         out["request_id"] = req.request_id
         return out
 

@@ -430,6 +430,54 @@ class RecoveryConfig(BaseModel):
     compile_grace_s: float = 900.0
 
 
+class MigrationConfig(BaseModel):
+    """Planned live request migration (runtime/dp_engine.py +
+    /admin/replicas): generalizes the crash-time checkpoint/replay into
+    an operational primitive — drain a replica for a rolling deploy
+    with zero 5xx, rebalance long decodes off a pressured replica, and
+    grow/shrink the dp degree without a process restart.  Requires
+    tpu.dp > 1 (a dp=1 deployment has no in-process migration target;
+    use the SIGTERM graceful drain instead)."""
+
+    # Master switch for the admin drain/undrain/scale surface and the
+    # VGT_DRAIN_REPLICA signal path.
+    enabled: bool = True
+    # How long an evacuation may wait for the source engine loop to
+    # checkpoint the selected sequences (the loop may legitimately be
+    # inside a long device dispatch; a wedged loop is the watchdog's
+    # job, not this timeout's).
+    evacuate_timeout_s: float = 30.0
+    # --- hot-replica rebalancing policy thread (vgt-dp-balance) ---
+    # Moves the longest-running decodes off a pressure-browned replica
+    # while a sibling sits idle.  Conservative by construction:
+    # hysteresis (sustained pressure for rebalance_hold_s), rate
+    # limiting (one move batch per rebalance_cooldown_s), and bounded
+    # batch size, so it can never thrash sequences back and forth.
+    rebalance_enabled: bool = True
+    rebalance_interval_s: float = 2.0
+    # A replica is "hot" while its kv_free_ratio is at/below this OR
+    # its engine queue depth is at/above hot_queue_depth — the same
+    # pressure_signals() the admission brownout keys off.
+    hot_kv_free_ratio: float = 0.15
+    hot_queue_depth: int = 8
+    # A target replica is "idle" only with at least this free-KV ratio
+    # and an empty engine queue — rebalancing onto a busy sibling just
+    # moves the pressure around.
+    idle_kv_free_ratio: float = 0.5
+    # Hysteresis: the replica must be CONTINUOUSLY hot this long before
+    # the first move (a single tick of pressure is admission's job).
+    rebalance_hold_s: float = 10.0
+    # Rate limit: at most one move batch per cooldown window.
+    rebalance_cooldown_s: float = 30.0
+    # Sequences moved per batch (longest-running decodes first — they
+    # free the most KV per move).
+    max_moves_per_cycle: int = 2
+    # Never move a decode younger than this many generated tokens: the
+    # replay re-prefills the whole context, so very young sequences
+    # cost more to move than to finish.
+    min_generated_tokens: int = 8
+
+
 class LifecycleConfig(BaseModel):
     """Graceful shutdown/drain (server/app.py + vgate_tpu/lifecycle.py):
     SIGTERM flips /health/ready to 503 ("draining"), admission stops
@@ -689,6 +737,7 @@ class VGTConfig(BaseModel):
     scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     lifecycle: LifecycleConfig = Field(default_factory=LifecycleConfig)
+    migration: MigrationConfig = Field(default_factory=MigrationConfig)
     admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
     inference: InferenceConfig = Field(default_factory=InferenceConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)

@@ -170,6 +170,25 @@ class ServerOverloadedError(RetryableError):
         self.tier = tier
 
 
+class MigrationError(RuntimeError):
+    """A planned sequence movement (replica drain, hot-replica
+    rebalance, dp scale-down) could not complete — the operational
+    error family behind the /admin/replicas surface.  Operator-facing:
+    never sent to generation clients (their sequences either stayed put
+    or already failed typed)."""
+
+
+class MigrationRefusedError(MigrationError):
+    """The migration was refused at PLACEMENT time, before any sequence
+    was evacuated: no eligible target replica exists (all dead /
+    draining / the last one), the target fleet serves a different
+    ``kv_cache.dtype`` than the source (continuing a generation against
+    a different KV storage format would splice two numerically
+    different streams mid-stream), or the deployment has no migration
+    target at all (dp == 1).  Maps to a 409 on the admin surface —
+    nothing moved, nothing was lost."""
+
+
 class ClientQuotaExceededError(RuntimeError):
     """This API key already has ``admission.per_key_max_inflight``
     requests in flight — a per-client fairness cap, not server-wide

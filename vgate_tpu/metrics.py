@@ -250,6 +250,28 @@ DP_REPLICAS_TOTAL = _safe_metric(
     "Configured data-parallel replica engines (tpu.dp)",
 )
 
+# --- planned live migration: replica drain, rebalance, elastic dp ---
+MIGRATIONS = _safe_metric(
+    Counter,
+    "vgt_migrations",
+    "In-flight sequences moved between dp replicas by PLANNED "
+    "migration (checkpoint + replay without a crash), by reason",
+    labelnames=("reason",),  # drain | rebalance | scale_down
+)
+MIGRATION_SECONDS = _safe_metric(
+    Histogram,
+    "vgt_migration_seconds",
+    "Wall time of one planned migration operation (evacuate the "
+    "source + replay every moved sequence onto its target)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30),
+)
+REPLICAS_DRAINING = _safe_metric(
+    Gauge,
+    "vgt_replicas_draining",
+    "dp replicas currently marked draining (no new placements; "
+    "residents migrated to survivors)",
+)
+
 # --- request lifecycle: deadlines, cancellation, graceful drain ---
 CANCELLED_REQUESTS = _safe_metric(
     Counter,

@@ -157,6 +157,23 @@ class RequestTrace:
         self.end("prefill", resumed=True)
         self.start("queue", resumed=True)
 
+    def migrated(self) -> None:
+        """Planned live migration (replica drain / rebalance /
+        scale-down): the sequence moves to another replica — span
+        continuation mirrors :meth:`resumed`, with a ``migrated``
+        event instead of ``engine_restart`` so a trace reads as an
+        operational move, not a crash."""
+        if not self._emit:
+            return
+        self.event("migrated")
+        if "queue" in self._open:
+            # evacuated while still WAITING: the queue span simply
+            # keeps running across the move
+            return
+        self.end("decode", migrated=True)
+        self.end("prefill", migrated=True)
+        self.start("queue", migrated=True)
+
     def close(self, error: Optional[BaseException] = None) -> None:
         """Settle: end every open phase span.  Idempotent; later
         detokenize spans may still be emitted."""

@@ -27,6 +27,7 @@ serve."""
 
 from __future__ import annotations
 
+import functools
 import itertools
 import threading
 import time
@@ -40,6 +41,8 @@ from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.errors import (
     EngineRecoveringError,
     EngineStalledError,
+    MigrationError,
+    MigrationRefusedError,
     PoisonRequestError,
 )
 from vgate_tpu.logging_config import get_logger
@@ -59,9 +62,11 @@ logger = get_logger(__name__)
 
 
 class _MergedFlight:
-    """Read-only view merging the replicas' flight recorders so /debug
-    works on dp>1 pods (each replica records independently; entries are
-    stamped with their replica index and merged by wall time)."""
+    """View merging the replicas' flight recorders so /debug works on
+    dp>1 pods (each replica records independently; entries are stamped
+    with their replica index and merged by wall time).  Pod-level
+    writers (the batcher's overload tick) land on one live recorder so
+    the merged timeline carries them exactly once."""
 
     def __init__(self, replicas: List[EngineCore]) -> None:
         self._replicas = replicas
@@ -69,6 +74,12 @@ class _MergedFlight:
     @property
     def enabled(self) -> bool:
         return any(r.flight.enabled for r in self._replicas)
+
+    def record_tick(self, kind: str, **fields: Any) -> None:
+        for core in self._replicas:
+            if core.flight.enabled:
+                core.flight.record_tick(kind, **fields)
+                return
 
     def _merged(self, method: str, n: Optional[int]) -> List[Dict[str, Any]]:
         out = []
@@ -114,6 +125,105 @@ class _MergedFlight:
         }
 
 
+class RebalancePolicy:
+    """Hysteresis-gated, rate-limited rebalancing decisions (pure
+    policy, injectable clock — fake-clock unit-testable without an
+    engine).  A replica is **hot** while its ``kv_free_ratio`` /
+    ``engine_queue_depth`` pressure signals cross the migration.*
+    watermarks; a move is decided only when a replica has been
+    CONTINUOUSLY hot for ``rebalance_hold_s`` (one pressured tick is
+    admission's job, not migration's), an **idle** sibling exists to
+    receive the work, and the last move is at least
+    ``rebalance_cooldown_s`` old — so the policy can never thrash a
+    sequence back and forth between two busy replicas."""
+
+    def __init__(self, cfg: Any, clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.clock = clock
+        # replica idx -> monotonic time it first turned hot; cleared on
+        # ANY cool observation (hysteresis: sustained pressure only)
+        self._hot_since: Dict[int, float] = {}
+        self._last_move_t: Optional[float] = None
+
+    def reset(self) -> None:
+        """Topology changed (add/remove/undrain): stale per-index
+        hysteresis state must not carry over to a renumbered fleet."""
+        self._hot_since.clear()
+
+    def observe(
+        self, signals: Dict[int, Dict[str, Any]]
+    ) -> Optional[tuple]:
+        """One policy tick over {replica_idx: pressure_signals()}.
+        Returns ``(hot_idx, cold_idx)`` when a move is due, else None.
+        Mutates hysteresis/rate-limit state — call once per interval."""
+        now = self.clock()
+        cfg = self.cfg
+        hot: list = []
+        cold: list = []
+        for idx, sig in signals.items():
+            free = sig.get("kv_free_ratio", 1.0)
+            depth = sig.get("engine_queue_depth", 0)
+            if (
+                free <= cfg.hot_kv_free_ratio
+                or depth >= cfg.hot_queue_depth
+            ):
+                self._hot_since.setdefault(idx, now)
+                hot.append((free, idx))
+            else:
+                self._hot_since.pop(idx, None)
+                if free >= cfg.idle_kv_free_ratio and depth == 0:
+                    cold.append((free, idx))
+        # drop hysteresis state for replicas no longer reporting
+        # (dead/draining/removed) so they cannot ripen while absent
+        for idx in list(self._hot_since):
+            if idx not in signals:
+                self._hot_since.pop(idx)
+        if not hot or not cold:
+            return None
+        if (
+            self._last_move_t is not None
+            and now - self._last_move_t < cfg.rebalance_cooldown_s
+        ):
+            return None
+        ripe = [
+            (free, idx)
+            for free, idx in hot
+            if now - self._hot_since[idx] >= cfg.rebalance_hold_s
+        ]
+        if not ripe:
+            return None
+        hot_idx = min(ripe)[1]  # hottest: lowest free ratio
+        cold_idx = max(cold)[1]  # coldest: highest free ratio
+        self._last_move_t = now
+        return hot_idx, cold_idx
+
+    def note_move_failed(self) -> None:
+        """The executor moved NOTHING for the decision just issued (no
+        eligible victims, kv-dtype mismatch, evacuation failure):
+        release the rate-limit stamp so the still-pressured replica is
+        re-eligible next tick instead of silently burning a full
+        cooldown.  Thrash-safe — nothing moved, so there is nothing to
+        ping-pong; retries are bounded by the policy tick interval."""
+        self._last_move_t = None
+
+
+def _structural(fn):
+    """Serialize a whole structural op (drain/undrain/add/remove) on
+    ``self._structural_lock``.  These ops release ``_topology_lock``
+    for the long evacuation/build phase (seconds to minutes on real
+    hardware), but decisions keyed on replica indices or the fleet
+    size taken BEFORE that phase are reused after it — two concurrent
+    removes on dp=2 would otherwise both pass the last-replica guard,
+    and a drain's draining-mark could land on a renumbered index.
+    Short readers (router, sweep, health, rebalance snapshot) stay on
+    ``_topology_lock`` and are never blocked by this."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._structural_lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class ReplicatedEngine:
     """``dp`` EngineCore replicas over disjoint submeshes + a load router."""
 
@@ -154,19 +264,43 @@ class ReplicatedEngine:
         self._stopping = False
         self._repair_event = threading.Event()
         self._repair_thread: Optional[threading.Thread] = None
-        # rebuild backoff: replica idx -> next attempt monotonic time;
-        # the restart budget window is SHARED across replicas (a pod
-        # crash-looping any subset of its replicas is one sick pod)
+        # rebuild backoff: dead core identity -> next attempt monotonic
+        # time (identity, not index — elastic dp can renumber replicas
+        # while a rebuild is pending); the restart budget window is
+        # SHARED across replicas (a pod crash-looping any subset of its
+        # replicas is one sick pod)
         self._next_attempt: Dict[int, float] = {}
         self._restart_times: List[float] = []
-        # replicas with a rebuild thread in flight: EngineCore
-        # construction takes tens of seconds on real hardware, and
-        # running it inline in _sweep would block stall detection and
-        # failover for every OTHER replica that long.  stop() joins
-        # these before stopping replicas, or a rebuild finishing after
-        # shutdown would start() an engine nothing owns.
+        # dead-core identities with a rebuild thread in flight:
+        # EngineCore construction takes tens of seconds on real
+        # hardware, and running it inline in _sweep would block stall
+        # detection and failover for every OTHER replica that long.
+        # stop() joins these before stopping replicas, or a rebuild
+        # finishing after shutdown would start() an engine nothing
+        # owns.
         self._rebuilding: set = set()
         self._rebuild_threads: Dict[int, threading.Thread] = {}
+        # ---- planned live migration (migration.*) ----
+        self._mig = self.config.migration
+        # replica indices marked draining: no NEW placements (router
+        # skips them); residents were live-migrated to survivors.
+        # DEGRADED-with-detail health until undrained or removed.
+        self._draining: set = set()
+        # structural changes (replicas list, device slices, draining
+        # marks) and the repair sweep serialize on this — index-keyed
+        # state must never shift under an iterating thread
+        self._topology_lock = threading.RLock()
+        # whole-op serialization for drain/undrain/add/remove (see
+        # _structural): held across the evacuation phase that
+        # _topology_lock deliberately releases
+        self._structural_lock = threading.RLock()
+        # device slices banked by remove_replica for add_replica to
+        # reuse: elastic dp within the boot-time device partition
+        self._free_slices: List[list] = []
+        self._policy = RebalancePolicy(self._mig)
+        self._balance_event = threading.Event()
+        self._balance_thread: Optional[threading.Thread] = None
+        self.total_migrated = 0
         # poison quarantine, pod-wide (the dp=1 supervisor's, minus the
         # repeat-offender streak — max_resume_attempts bounds replays
         # here): a fingerprint a poison-classified replica fatal names
@@ -216,10 +350,25 @@ class ReplicatedEngine:
                 daemon=True,
             )
             self._repair_thread.start()
+        if (
+            self._mig.enabled
+            and self._mig.rebalance_enabled
+            and self._balance_thread is None
+        ):
+            self._balance_thread = threading.Thread(
+                target=self._balance_loop,
+                name="vgt-dp-balance",
+                daemon=True,
+            )
+            self._balance_thread.start()
 
     def stop(self) -> None:
         self._stopping = True
         self._repair_event.set()
+        self._balance_event.set()
+        if self._balance_thread is not None:
+            self._balance_thread.join(timeout=30)
+            self._balance_thread = None
         if self._repair_thread is not None:
             self._repair_thread.join(timeout=30)
             self._repair_thread = None
@@ -269,15 +418,20 @@ class ReplicatedEngine:
         same heartbeat classification as the dp=1 supervisor),
         redistribute dead replicas' checkpointed residents to
         survivors, and rebuild dead replicas once their backoff is
-        due."""
+        due.  Holds the topology lock: elastic dp (add/remove_replica)
+        must never renumber the fleet under this iteration."""
         rec = self._recovery
+        with self._topology_lock:
+            self._sweep_locked(rec)
+
+    def _sweep_locked(self, rec) -> None:
         for i in range(len(self.replicas)):
             # fresh clock per replica: heartbeat verdicts and backoff
             # stamps must not age by however long earlier replicas'
             # handling took
             now = time.monotonic()
             core = self.replicas[i]
-            if i in self._rebuilding:
+            if id(core) in self._rebuilding:
                 continue  # a rebuild thread owns this slot
             if core._fatal is None:
                 if core._running and rec.step_stall_s > 0:
@@ -325,7 +479,11 @@ class ReplicatedEngine:
             self.total_lost += core.take_resume_losses()
             if pending:
                 self._redistribute(i, pending)
-            self._maybe_rebuild(i, now)
+            if i not in self._draining:
+                # a draining replica is deliberately leaving (rolling
+                # deploy / scale-down): auto-rebuilding it would fight
+                # the operator — undrain re-arms repair
+                self._maybe_rebuild(i, core, now)
         metrics.DP_REPLICAS_ALIVE.set(
             sum(1 for c in self.replicas if self._alive(c))
         )
@@ -370,11 +528,34 @@ class ReplicatedEngine:
         # them here or every sequence would pile onto the same
         # "least-loaded" survivor
         extra: Dict[int, int] = {}
+        with self._topology_lock:
+            dead_core = self.replicas[dead_idx]
+        warned_draining = False
         for seq in pending:
-            alive = [
-                c for c in self.replicas
-                if self._alive(c) and c is not self.replicas[dead_idx]
-            ]
+            with self._topology_lock:
+                eligible = [
+                    (j, c) for j, c in enumerate(self.replicas)
+                    if self._alive(c) and c is not dead_core
+                ]
+                draining = set(self._draining)
+            # the no-new-placements drain invariant first; but when
+            # every survivor is draining, completing the request on
+            # one beats failing it — remove_replica re-evacuates, so
+            # nothing is lost even if that replica is later torn down
+            alive = [c for j, c in eligible if j not in draining]
+            if not alive and eligible:
+                alive = [c for _, c in eligible]
+                if not warned_draining:
+                    warned_draining = True
+                    logger.warning(
+                        "failover placing onto DRAINING replicas: "
+                        "no non-draining survivor exists; re-issue "
+                        "the drain once the fleet recovers",
+                        extra={"extra_data": {
+                            "dead_replica": dead_idx,
+                            "draining": sorted(draining),
+                        }},
+                    )
             if not alive:
                 self.total_lost += 1
                 metrics.LOST_SEQUENCES.labels(reason="no_replica").inc()
@@ -423,7 +604,9 @@ class ReplicatedEngine:
             rec.backoff_base_s * (2 ** len(self._restart_times)),
         )
 
-    def _maybe_rebuild(self, idx: int, now: float) -> None:
+    def _maybe_rebuild(
+        self, idx: int, core: EngineCore, now: float
+    ) -> None:
         rec = self._recovery
         self._restart_times = [
             t for t in self._restart_times
@@ -431,10 +614,10 @@ class ReplicatedEngine:
         ]
         if len(self._restart_times) >= rec.max_restarts:
             return  # budget exhausted; retried once the window slides
-        due = self._next_attempt.get(idx)
+        due = self._next_attempt.get(id(core))
         if due is None:
             # first detection: schedule the rebuild after backoff
-            self._next_attempt[idx] = now + self._backoff()
+            self._next_attempt[id(core)] = now + self._backoff()
             self._repair_event.set()  # re-sweep promptly
             return
         if now < due:
@@ -445,18 +628,24 @@ class ReplicatedEngine:
         # potentially minutes when the device itself is sick), and the
         # single repair thread must keep watching the OTHER replicas'
         # heartbeats and failovers meanwhile.  _rebuilding guards the
-        # slot; the checkpoint was already redistributed above.
-        self._rebuilding.add(idx)
+        # dead core (by identity — elastic dp can renumber the fleet
+        # while this runs); the checkpoint was already redistributed
+        # above.  The device slice is captured NOW, under the topology
+        # lock, for the same reason.
+        self._rebuilding.add(id(core))
+        devices = self._device_slices[idx]
         thread = threading.Thread(
             target=self._do_rebuild,
-            args=(idx,),
+            args=(idx, core, devices),
             name=f"vgt-dp-rebuild-{idx}",
             daemon=True,
         )
-        self._rebuild_threads[idx] = thread
+        self._rebuild_threads[id(core)] = thread
         thread.start()
 
-    def _do_rebuild(self, idx: int) -> None:
+    def _do_rebuild(
+        self, idx: int, old: EngineCore, devices: list
+    ) -> None:
         try:
             try:
                 # shared teardown/rebuild sequence (engine_core.
@@ -464,9 +653,7 @@ class ReplicatedEngine:
                 # device KV pool before the new one sizes, weights
                 # kept, brownout spec-suspension carried over
                 new_core = rebuild_core(
-                    self.replicas[idx],
-                    self._replica_cfg,
-                    self._device_slices[idx],
+                    old, self._replica_cfg, devices
                 )
             except Exception:
                 logger.error(
@@ -474,14 +661,23 @@ class ReplicatedEngine:
                     extra={"extra_data": {"replica": idx}},
                     exc_info=True,
                 )
-                self._next_attempt[idx] = (
+                self._next_attempt[id(old)] = (
                     time.monotonic() + self._backoff()
                 )
                 return
-            self._attach(idx, new_core)
-            self.replicas[idx] = new_core
-            self._next_attempt.pop(idx, None)
-            if self._stopping:
+            self._next_attempt.pop(id(old), None)
+            # swap by IDENTITY, under the topology lock: the fleet may
+            # have been renumbered (remove_replica) while this built —
+            # a stale index would overwrite the wrong slot
+            with self._topology_lock:
+                try:
+                    slot = self.replicas.index(old)
+                except ValueError:
+                    slot = -1  # replica was removed mid-rebuild
+                if slot >= 0:
+                    self._attach(slot, new_core)
+                    self.replicas[slot] = new_core
+            if slot < 0 or self._stopping:
                 new_core.stop()
                 return
             new_core.start()
@@ -494,12 +690,561 @@ class ReplicatedEngine:
             metrics.ENGINE_RESTARTS.inc()
             logger.warning(
                 "dp replica rebuilt",
-                extra={"extra_data": {"replica": idx}},
+                extra={"extra_data": {"replica": slot}},
             )
         finally:
-            self._rebuilding.discard(idx)
-            self._rebuild_threads.pop(idx, None)
+            self._rebuilding.discard(id(old))
+            self._rebuild_threads.pop(id(old), None)
             self._repair_event.set()  # re-sweep with the fresh state
+
+    # ------------------------------------ planned migration / elastic dp
+
+    def _require_migration(self) -> None:
+        if not self._mig.enabled:
+            raise MigrationRefusedError(
+                "live migration is disabled (migration.enabled=false)"
+            )
+
+    @staticmethod
+    def _kv_dtype_of(core: Any) -> Optional[str]:
+        geo = getattr(core, "geometry", None)
+        return getattr(geo, "kv_dtype", None)
+
+    def _check_placement(
+        self, src_core: Any, targets: List[Any]
+    ) -> List[Any]:
+        """Placement-time migration gate, applied BEFORE any sequence
+        is evacuated: raises the typed MigrationRefusedError when no
+        live target can accept the source's checkpoints — either none
+        exists, or every candidate serves a different kv_cache.dtype
+        than the one the source's generations were sampled under
+        (submit_existing would refuse each replay with a 503; refusing
+        the whole operation up front moves nothing and loses nothing).
+        Returns the eligible targets."""
+        alive = [c for c in targets if self._alive(c)]
+        if not alive:
+            raise MigrationRefusedError(
+                "no eligible target replica: every other replica is "
+                "dead or draining"
+            )
+        src = self._kv_dtype_of(src_core)
+        ok = [
+            c for c in alive
+            if src is None
+            or self._kv_dtype_of(c) is None
+            or self._kv_dtype_of(c) == src
+        ]
+        if not ok:
+            have = sorted(
+                {str(self._kv_dtype_of(c)) for c in alive}
+            )
+            raise MigrationRefusedError(
+                f"kv-dtype mismatch: the source replica serves "
+                f"kv_cache.dtype={src!r} but every live target serves "
+                f"{have}; a generation sampled against one KV storage "
+                "format cannot continue against another — refusing at "
+                "placement time"
+            )
+        return ok
+
+    def _place(
+        self,
+        seqs: List[Sequence],
+        targets: List[Any],
+        reason: str,
+        from_replica: int,
+        kind: str = "migrate",
+        fallback: Optional[EngineCore] = None,
+    ) -> tuple:
+        """Replay evacuated sequences onto the least-loaded eligible
+        targets (the PR-5 redistribution accounting: in-loop `extra`
+        counts submissions _load cannot see yet, so a batch never piles
+        onto one survivor).  Per-sequence kv-dtype eligibility is
+        re-checked here as the backstop — _check_placement gated the
+        operation, but a mixed fleet could lose its last compatible
+        target mid-flight.  ``kind`` carries provenance: sequences a
+        planned operation claimed from a CRASHED replica were folded by
+        prepare_resume, so they replay as resumes (resumed:true,
+        vgt_resumed_sequences) — stamping them "migrate" would make
+        metrics, flight ticks and response flags disagree.  ``fallback``
+        is the alive SOURCE when it stays in the fleet (drain,
+        rebalance): a sequence whose every target died between the gate
+        and this placement folds back where it was running fine instead
+        of 503ing — a planned operation must not turn healthy requests
+        into errors.  Returns (moved, lost, requeued)."""
+        moved = lost = requeued = 0
+        extra: Dict[int, int] = {}
+        for seq in seqs:
+            eligible = [
+                c for c in targets
+                if self._alive(c)
+                and (
+                    seq.kv_dtype is None
+                    or self._kv_dtype_of(c) is None
+                    or self._kv_dtype_of(c) == seq.kv_dtype
+                )
+            ]
+            if not eligible and fallback is not None and self._alive(
+                fallback
+            ):
+                try:
+                    fallback.submit_existing(seq)
+                    requeued += 1
+                    continue
+                except (RuntimeError, ValueError):
+                    pass  # the source went down too: fall through
+            if not eligible:
+                lost += 1
+                self.total_lost += 1
+                metrics.LOST_SEQUENCES.labels(reason="no_replica").inc()
+                seq.fail(
+                    EngineRecoveringError(
+                        "no eligible replica for the migrated request; "
+                        "retry shortly",
+                        retry_after=self.retry_after_s,
+                    )
+                )
+                continue
+            target = min(
+                eligible,
+                key=lambda c: self._load(c) + extra.get(id(c), 0),
+            )
+            outcome = replay_into(
+                target, seq, self._quarantine,
+                retry_after=self.retry_after_s,
+                kind=kind,
+                reason=reason,
+                from_replica=from_replica,
+            )
+            if outcome != "replayed":
+                lost += 1
+                self.total_lost += 1
+                continue
+            extra[id(target)] = extra.get(id(target), 0) + 1
+            moved += 1
+            if kind == "resume":
+                self.total_resumed += 1
+            else:
+                self.total_migrated += 1
+                metrics.MIGRATIONS.labels(reason=reason).inc()
+        return moved, lost, requeued
+
+    def _claim_dead(self, core: EngineCore) -> List[Sequence]:
+        """A replica died while (or just before) a planned migration:
+        wait briefly for containment to publish its checkpoint, then
+        claim it — the crash checkpoint carries the same fold/epoch
+        guarantees as an evacuation, so the placement path is shared."""
+        deadline = time.monotonic() + 5.0
+        while (
+            not core._containment_done
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        self.total_lost += core.take_resume_losses()
+        return core.take_checkpointed()
+
+    def _evacuate_all(
+        self, core: EngineCore, reason: str
+    ) -> tuple:
+        """Returns ``(sequences, kind)`` — kind is "migrate" for a live
+        planned evacuation (prepare_migrate folded them) and "resume"
+        when the residents had to be claimed from a crash checkpoint
+        (prepare_resume folded them); _place forwards it so provenance
+        flags/metrics/ticks stay truthful."""
+        if not self._alive(core):
+            return self._claim_dead(core), "resume"
+        try:
+            return core.evacuate(
+                None, reason=reason,
+                timeout=self._mig.evacuate_timeout_s,
+            ), "migrate"
+        except MigrationError:
+            # TIMEOUT on a live engine is not death: the sequences
+            # stayed put (or the core folds an abandoned evacuation
+            # back into its own scheduler).  Propagate so the caller
+            # aborts the operation — remove_replica must NOT proceed
+            # to stop() a replica still full of live work.  The
+            # replica stays marked draining; the operator retries.
+            raise
+        except RuntimeError:
+            # died mid-evacuation: the containment checkpoint owns the
+            # residents now — claim and place them the same way
+            return self._claim_dead(core), "resume"
+
+    def _fallback_targets(self, idx: int, core: EngineCore) -> List[Any]:
+        """A DEAD replica's checkpoints must go somewhere: when every
+        non-draining survivor is gone, placing onto alive DRAINING
+        survivors (same call _redistribute makes in this situation)
+        beats failing the requests — remove_replica re-evacuates, so
+        nothing is lost even if that survivor is later torn down.  A
+        LIVE source never takes this path: _check_placement refuses
+        typed before anything moves."""
+        with self._topology_lock:
+            fallback = [
+                c for j, c in enumerate(self.replicas)
+                if j != idx and self._alive(c)
+            ]
+        if fallback:
+            logger.warning(
+                "placing dead replica residents onto DRAINING "
+                "survivors: no non-draining target exists; re-issue "
+                "the drain once the fleet recovers",
+                extra={"extra_data": {"replica": idx}},
+            )
+        return fallback
+
+    @_structural
+    def drain_replica(
+        self, idx: int, reason: str = "drain"
+    ) -> Dict[str, Any]:
+        """Mark replica ``idx`` draining (no new placements), then
+        live-migrate its residents to the least-loaded eligible
+        survivors.  The replica keeps serving anything that raced the
+        mark and reports DEGRADED-with-detail health until undrained or
+        removed — a rolling deploy drains, replaces the process behind
+        the replica, then undrains.  Raises ValueError for an unknown
+        index, MigrationRefusedError when no survivor can take the
+        work (nothing moves in that case), and MigrationError when the
+        evacuation times out — the replica then STAYS marked draining
+        with its residents still serving on it; retry the drain."""
+        self._require_migration()
+        core, targets, already, moved, lost, requeued = (
+            self._drain_and_place(idx, reason)
+        )
+        logger.warning(
+            "dp replica draining",
+            extra={
+                "extra_data": {
+                    "replica": idx, "reason": reason,
+                    "migrated": moved, "lost": lost,
+                    "requeued": requeued,
+                    "already_draining": already,
+                }
+            },
+        )
+        return {
+            "replica": idx,
+            "draining": True,
+            "migrated": moved,
+            "lost": lost,
+            "requeued": requeued,
+            "already_draining": already,
+        }
+
+    def _drain_and_place(
+        self, idx: int, reason: str, removing: bool = False
+    ) -> tuple:
+        """The shared gate → mark → evacuate → place sequence behind
+        drain_replica and remove_replica (ONE copy, so placement fixes
+        land once).  Returns (core, targets, already, moved, lost,
+        requeued).  Raises before anything moves: ValueError for a bad
+        index, MigrationRefusedError from the placement gate — plus
+        the remove-specific last-replica/mid-rebuild guards when
+        ``removing``."""
+        with self._topology_lock:
+            if not 0 <= idx < len(self.replicas):
+                raise ValueError(
+                    f"no replica {idx} (dp={len(self.replicas)})"
+                )
+            if removing:
+                if len(self.replicas) <= 1:
+                    raise MigrationRefusedError(
+                        "cannot remove the last replica; stop the "
+                        "server instead"
+                    )
+                if id(self.replicas[idx]) in self._rebuilding:
+                    raise MigrationRefusedError(
+                        "replica is mid-rebuild; retry once it settles"
+                    )
+            already = idx in self._draining
+            core = self.replicas[idx]
+            targets = [
+                c for j, c in enumerate(self.replicas)
+                if j != idx and j not in self._draining
+            ]
+        if self._alive(core):
+            # typed placement gate BEFORE the mark: a refused op
+            # leaves the fleet exactly as it was
+            targets = self._check_placement(core, targets)
+        elif not any(self._alive(c) for c in targets):
+            # a dead source's checkpoint must not be lost just because
+            # every NON-DRAINING sibling is also dead — alive draining
+            # survivors can still serve it (same call _redistribute
+            # makes; zero-loss beats drain purity)
+            targets = self._fallback_targets(idx, core)
+        with self._topology_lock:
+            self._draining.add(idx)
+            metrics.REPLICAS_DRAINING.set(len(self._draining))
+        t0 = time.monotonic()
+        seqs, kind = self._evacuate_all(core, reason)
+        # a drained source STAYS in the fleet: residents whose target
+        # died mid-op fold back into it rather than 503.  A removed
+        # source is leaving — no fold-back (stop() fails stragglers
+        # typed).
+        moved, lost, requeued = self._place(
+            seqs, targets, reason, idx, kind=kind,
+            fallback=None if removing else core,
+        )
+        if seqs:
+            metrics.MIGRATION_SECONDS.observe(time.monotonic() - t0)
+        return core, targets, already, moved, lost, requeued
+
+    @_structural
+    def undrain_replica(self, idx: int) -> Dict[str, Any]:
+        """Return a drained replica to the placement rotation (the
+        rolling deploy's rejoin step) and re-arm its auto-repair."""
+        self._require_migration()
+        with self._topology_lock:
+            if not 0 <= idx < len(self.replicas):
+                raise ValueError(
+                    f"no replica {idx} (dp={len(self.replicas)})"
+                )
+            was = idx in self._draining
+            self._draining.discard(idx)
+            metrics.REPLICAS_DRAINING.set(len(self._draining))
+        self._policy.reset()
+        self._repair_event.set()  # a dead drained replica rebuilds now
+        logger.warning(
+            "dp replica undrained",
+            extra={"extra_data": {"replica": idx, "was_draining": was}},
+        )
+        return {"replica": idx, "draining": False, "was_draining": was}
+
+    @_structural
+    def add_replica(self) -> Dict[str, Any]:
+        """Grow the dp degree at runtime by building a fresh replica on
+        a banked device slice (remove_replica returns its slice here).
+        Growing beyond the boot-time device partition still needs a
+        restart with a larger tpu.num_devices — slices are reused, not
+        invented."""
+        self._require_migration()
+        with self._topology_lock:
+            if not self._free_slices:
+                raise MigrationRefusedError(
+                    "no free device slice to build a replica on "
+                    "(remove_replica banks its slice for reuse; "
+                    "growing past the boot-time partition requires a "
+                    "restart)"
+                )
+            devices = self._free_slices.pop()
+        try:
+            # construction OUTSIDE the lock: it blocks for seconds to
+            # minutes on real hardware and the sweep/router must run
+            core = EngineCore(self._replica_cfg, devices=devices)
+        except Exception:
+            with self._topology_lock:
+                self._free_slices.append(devices)
+            raise
+        with self._topology_lock:
+            idx = len(self.replicas)
+            self.replicas.append(core)
+            self._device_slices.append(devices)
+            if self._failover_enabled:
+                self._attach(idx, core)
+            metrics.DP_REPLICAS_TOTAL.set(len(self.replicas))
+        core.start()
+        self._policy.reset()
+        logger.warning(
+            "dp replica added",
+            extra={"extra_data": {"replica": idx, "dp": idx + 1}},
+        )
+        return {"replica": idx, "dp": len(self.replicas)}
+
+    @_structural
+    def remove_replica(self, idx: int) -> Dict[str, Any]:
+        """Shrink the dp degree at runtime: drain + live-migrate the
+        replica's residents, tear the engine down, and bank its device
+        slice for a later add_replica.  The last replica is never
+        removable (that is process shutdown's job)."""
+        self._require_migration()
+        core, targets, _already, moved, lost, _req = (
+            self._drain_and_place(idx, "scale_down", removing=True)
+        )
+        # final sweep right before teardown: a concurrent drain whose
+        # target list was snapshotted before this replica was marked
+        # draining (or failover's draining fallback) may have placed
+        # work onto it AFTER the evacuation above — stop() would fail
+        # those as shutdown losses.  Anything that still lands in the
+        # (now tiny) window gets the retryable 503 from stop().
+        if self._alive(core):
+            seqs2, kind2 = self._evacuate_all(core, "scale_down")
+            if seqs2:
+                m2, l2, _ = self._place(
+                    seqs2, targets, "scale_down", idx, kind=kind2
+                )
+                moved += m2
+                lost += l2
+        core.stop()
+        with self._topology_lock:
+            # the slot cannot have shifted: structural ops hold
+            # _structural_lock for their full duration and the sweep
+            # skips draining replicas' rebuilds
+            slot = self.replicas.index(core)
+            self.replicas.pop(slot)
+            self._free_slices.append(self._device_slices.pop(slot))
+            self._draining.discard(slot)
+            # renumber the index-keyed draining marks above the gap
+            self._draining = {
+                i - 1 if i > slot else i for i in self._draining
+            }
+            self._next_attempt.pop(id(core), None)
+            if self._failover_enabled:
+                for j, c in enumerate(self.replicas):
+                    self._attach(j, c)
+            metrics.DP_REPLICAS_TOTAL.set(len(self.replicas))
+            metrics.REPLICAS_DRAINING.set(len(self._draining))
+            dp_now = len(self.replicas)
+        self._policy.reset()
+        logger.warning(
+            "dp replica removed",
+            extra={
+                "extra_data": {
+                    "replica": idx, "dp": dp_now,
+                    "migrated": moved, "lost": lost,
+                }
+            },
+        )
+        return {
+            "replica": idx, "dp": dp_now,
+            "migrated": moved, "lost": lost,
+        }
+
+    # --------------------------------------- hot-replica rebalancing
+
+    def _balance_loop(self) -> None:
+        while not self._stopping:
+            self._balance_event.wait(
+                timeout=max(0.1, self._mig.rebalance_interval_s)
+            )
+            self._balance_event.clear()
+            if self._stopping:
+                return
+            try:
+                self.maybe_rebalance()
+            except Exception:  # pragma: no cover - defensive
+                logger.error("dp rebalance pass failed", exc_info=True)
+
+    def maybe_rebalance(self) -> Optional[Dict[str, Any]]:
+        """One rebalance policy tick: feed live pressure signals to the
+        hysteresis policy and execute its decision (move the
+        longest-running decodes off the hot replica onto the idle one).
+        Returns the move summary, or None when the policy holds."""
+        if not self._mig.enabled or not self._mig.rebalance_enabled:
+            return None
+        with self._topology_lock:
+            reps = list(self.replicas)
+            draining = set(self._draining)
+        if len(reps) < 2:
+            return None
+        signals: Dict[int, Dict[str, Any]] = {}
+        for i, core in enumerate(reps):
+            if not self._alive(core) or i in draining:
+                continue
+            try:
+                signals[i] = core.pressure_signals()
+            except Exception:  # pragma: no cover - mid-rebuild
+                continue
+        decision = self._policy.observe(signals)
+        if decision is None:
+            return None
+        hot_idx, cold_idx = decision
+        return self._rebalance(reps[hot_idx], reps[cold_idx], hot_idx)
+
+    def _rebalance(
+        self, hot: EngineCore, cold: EngineCore, hot_idx: int
+    ) -> Optional[Dict[str, Any]]:
+        mig = self._mig
+        if self._kv_dtype_of(hot) != self._kv_dtype_of(cold):
+            self._policy.note_move_failed()
+            return None  # mixed-dtype fleet: nothing to move safely
+        victims = [
+            s for s in hot.scheduler.running
+            if s.status is SeqStatus.RUNNING
+            and not s.abort_requested
+            and s.num_generated >= mig.min_generated_tokens
+        ]
+        if not victims:
+            self._policy.note_move_failed()
+            logger.info(
+                "rebalance decided but no eligible victim (all "
+                "residents below migration.min_generated_tokens)",
+                extra={"extra_data": {"replica": hot_idx}},
+            )
+            return None
+        # longest-running decodes first: they free the most KV per
+        # move and have the longest remaining co-tenancy with the
+        # pressured pool
+        victims.sort(key=lambda s: s.num_generated, reverse=True)
+        victims = victims[: max(1, mig.max_moves_per_cycle)]
+        t0 = time.monotonic()
+        try:
+            seqs = hot.evacuate(
+                [s.seq_id for s in victims],
+                reason="rebalance",
+                timeout=mig.evacuate_timeout_s,
+            )
+        except Exception as exc:
+            self._policy.note_move_failed()
+            logger.warning(
+                "rebalance evacuation failed; replica left as-is",
+                extra={"extra_data": {
+                    "replica": hot_idx,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }},
+            )
+            return None
+        if not seqs:
+            self._policy.note_move_failed()
+            return None
+        if not self._alive(cold):
+            # the target died between the policy decision and
+            # placement: fold the victims straight back into the hot
+            # replica they were running fine on — an optional
+            # optimization must not turn healthy requests into 503s
+            requeued = 0
+            for seq in seqs:
+                try:
+                    hot.submit_existing(seq)
+                    requeued += 1
+                except (RuntimeError, ValueError):
+                    self.total_lost += 1
+                    metrics.LOST_SEQUENCES.labels(
+                        reason="no_replica"
+                    ).inc()
+                    seq.fail(EngineRecoveringError(
+                        "rebalance target died and the source could "
+                        "not take the request back; retry shortly",
+                        retry_after=self.retry_after_s,
+                    ))
+            self._policy.note_move_failed()
+            logger.warning(
+                "rebalance target died before placement; victims "
+                "folded back into the source replica",
+                extra={"extra_data": {
+                    "from": hot_idx, "requeued": requeued,
+                    "lost": len(seqs) - requeued,
+                }},
+            )
+            return None
+        moved, lost, requeued = self._place(
+            seqs, [cold], "rebalance", hot_idx, fallback=hot
+        )
+        if moved == 0:
+            self._policy.note_move_failed()
+        metrics.MIGRATION_SECONDS.observe(time.monotonic() - t0)
+        logger.warning(
+            "dp rebalance moved long decodes off a pressured replica",
+            extra={
+                "extra_data": {
+                    "from": hot_idx, "moved": moved, "lost": lost,
+                    "requeued": requeued,
+                }
+            },
+        )
+        return {
+            "from": hot_idx, "moved": moved, "lost": lost,
+            "requeued": requeued,
+        }
 
     def abort_in_flight(self, reason: str = "drain") -> None:
         """Graceful-drain straggler sweep: fan the abort out to every
@@ -530,9 +1275,17 @@ class ReplicatedEngine:
         when routing prefers prefix affinity) and summed queue depth."""
         ratios = []
         depth = running = 0
-        for core in self.replicas:
+        with self._topology_lock:
+            cores = [
+                c for i, c in enumerate(self.replicas)
+                if i not in self._draining
+            ]
+        for core in cores:
             if not self._alive(core):
                 continue
+            # draining replicas excluded above: their (possibly full)
+            # pools take no new placements, so counting them would
+            # brown out admission against capacity that isn't offered
             sig = core.pressure_signals()
             if "kv_free_ratio" in sig:
                 ratios.append(sig["kv_free_ratio"])
@@ -550,18 +1303,26 @@ class ReplicatedEngine:
     @property
     def state(self) -> HealthState:
         """Pod-level health: SERVING with the full replica complement,
-        DEGRADED while any replica is down (survivors still serve —
-        readiness stays green), DEAD only when no replica can accept
+        DEGRADED while any replica is down OR draining (survivors still
+        serve — readiness stays green; the detail block names which
+        replica is out and why), DEAD only when no replica can accept
         work (liveness then recycles the pod)."""
         alive = sum(1 for c in self.replicas if self._alive(c))
         if alive == 0:
             return HealthState.DEAD
-        if alive < len(self.replicas):
+        if alive < len(self.replicas) or self._draining:
             return HealthState.DEGRADED
         return HealthState.SERVING
 
-    def _replica_state(self, idx: int, now: float) -> str:
-        core = self.replicas[idx]
+    def _replica_state(
+        self, idx: int, core: EngineCore, draining: set, now: float
+    ) -> str:
+        # core + draining come from the caller's under-lock snapshot:
+        # a concurrent remove_replica must not shift indices under us
+        if idx in draining:
+            # deliberately out of rotation (alive or not): auto-repair
+            # is suspended until undrain, so "draining" is the truth
+            return "draining"
         if self._alive(core):
             return "serving"
         if not self._failover_enabled:
@@ -583,11 +1344,14 @@ class ReplicatedEngine:
 
         now = time.monotonic()
         state = self.state
+        with self._topology_lock:
+            reps = list(self.replicas)
+            draining = set(self._draining)
         replicas = []
-        for i, core in enumerate(self.replicas):
+        for i, core in enumerate(reps):
             entry: Dict[str, Any] = {
                 "replica": i,
-                "state": self._replica_state(i, now),
+                "state": self._replica_state(i, core, draining, now),
             }
             fatal = core._fatal
             if fatal is not None:
@@ -601,19 +1365,27 @@ class ReplicatedEngine:
             except Exception:  # pragma: no cover - mid-rebuild
                 pass
             replicas.append(entry)
-        alive = sum(1 for r in replicas if r["state"] == "serving")
+        # ONE definition for the gauge (the repair sweep writes it
+        # too): liveness, not rotation membership.  An alive draining
+        # replica still counts — a planned drain must not sawtooth
+        # vgt_dp_replicas_alive between /health scrapes and sweep
+        # ticks or fire VgtDpReplicaDown for a deliberate operation.
+        alive = sum(1 for c in reps if self._alive(c))
         metrics.DP_REPLICAS_ALIVE.set(alive)
         return {
             "state": state.value,
             "alive": state_is_alive(state.value),
             "ready": state_is_ready(state.value),
-            "dp": len(self.replicas),
+            "dp": len(reps),
             "replicas_alive": alive,
+            "replicas_draining": len(draining),
+            "draining": sorted(draining),
             "replicas": replicas,
             "failovers": self.total_failovers,
             "restarts": self.total_restarts,
             "stalls": self.total_stalls,
             "resumed": self.total_resumed,
+            "migrated": self.total_migrated,
             "lost": self.total_lost,
             "quarantined": len(self._quarantine),
         }
@@ -632,7 +1404,11 @@ class ReplicatedEngine:
 
     @staticmethod
     def _alive(core: EngineCore) -> bool:
-        return core._fatal is None
+        # a cleanly-STOPPED core (remove_replica teardown) has
+        # _fatal None but no loop: submit_existing into it would
+        # enqueue into a queue nothing drains — the client's future
+        # then hangs forever while metrics count a successful move
+        return core._fatal is None and getattr(core, "_running", True)
 
     def _pick_replica(
         self, prompt_ids: Optional[List[int]] = None
@@ -645,34 +1421,50 @@ class ReplicatedEngine:
 
         Failure containment (SURVEY 5.3): a replica whose engine thread
         died (engine-fatal) is routed AROUND — in-flight sequences on it
-        fail, but new requests ride the surviving replicas.  Only when
-        every replica is dead does the submit surface the fatal."""
+        fail, but new requests ride the surviving replicas.  A replica
+        marked DRAINING (rolling deploy / scale-down) is routed around
+        the same way: it finishes what it has, takes nothing new.  Only
+        when every replica is dead does the submit surface the fatal."""
         with self._route_lock:
+            with self._topology_lock:
+                reps = list(self.replicas)
+                draining = set(self._draining)
             offset = next(self._rr)
-            n = len(self.replicas)
-            order = [self.replicas[(offset + i) % n] for i in range(n)]
-            alive = [c for c in order if self._alive(c)]
+            n = len(reps)
+            order = [(offset + i) % n for i in range(n)]
+            alive = [
+                reps[i] for i in order
+                if self._alive(reps[i]) and i not in draining
+            ]
             if not alive:
-                # all dead: let EngineCore.submit_tokens raise the fatal
-                return order[0]
+                # no placeable replica: fall back to any live one (a
+                # fully-draining fleet still serves rather than 500s),
+                # else let EngineCore.submit_tokens raise the fatal
+                live = [reps[i] for i in order if self._alive(reps[i])]
+                return live[0] if live else reps[order[0]]
             best = min(alive, key=self._load)
             page = self.config.tpu.kv_page_size
             if (
                 prompt_ids is not None
                 and len(prompt_ids) >= page
-                and self.replicas[0].prefix_cache_enabled
+                and reps[0].prefix_cache_enabled
             ):
                 import zlib
 
                 block = bytes(
                     b for t in prompt_ids[:page] for b in t.to_bytes(4, "little")
                 )
-                sticky = self.replicas[zlib.crc32(block) % n]
+                sticky_idx = zlib.crc32(block) % n
+                sticky = reps[sticky_idx]
                 # affinity wins unless it costs real queueing headroom
-                # (or the sticky replica is dead)
-                if self._alive(sticky) and self._load(sticky) <= self._load(
-                    best
-                ) + max(2, self.config.tpu.max_batch_slots // 4):
+                # (or the sticky replica is dead/draining)
+                if (
+                    self._alive(sticky)
+                    and sticky_idx not in draining
+                    and self._load(sticky)
+                    <= self._load(best)
+                    + max(2, self.config.tpu.max_batch_slots // 4)
+                ):
                     return sticky
             return best
 
@@ -793,7 +1585,7 @@ class ReplicatedEngine:
         }
 
     def get_stats(self) -> Dict[str, Any]:
-        per_replica = [core.get_stats() for core in self.replicas]
+        per_replica = [core.get_stats() for core in list(self.replicas)]
         agg = {
             key: sum(s[key] for s in per_replica)
             for key in (
@@ -835,6 +1627,11 @@ class ReplicatedEngine:
             "replicas_alive": sum(
                 1 for c in self.replicas if self._alive(c)
             ),
+        }
+        agg["migration"] = {
+            "migrated": self.total_migrated,
+            "draining": sorted(self._draining),
+            "free_slices": len(self._free_slices),
         }
         agg["mesh"] = dict(per_replica[0]["mesh"], dp=len(self.replicas))
         agg["load_time_s"] = round(self.load_time_s, 2)

@@ -44,6 +44,7 @@ from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.errors import (
     DeadlineExceededError,
     EngineRecoveringError,
+    MigrationError,
     PoisonRequestError,
     ResumeExhaustedError,
 )
@@ -472,17 +473,21 @@ def replay_into(
     seq: Sequence,
     quarantine: set,
     retry_after: float = 1.0,
+    kind: str = "resume",
     **tick_fields: Any,
 ) -> str:
     """Replay ONE checkpointed sequence into ``core`` — the shared
-    per-sequence pipeline behind the supervisor's restart replay and
-    the dp router's failover redistribution (one definition so lost/
-    resumed accounting can never drift between dp=1 and dp>1):
-    quarantined fingerprints fail with the 400 poison error, a refused
-    resubmission fails with the retryable 503, success records the
-    `resume` flight tick and bumps vgt_resumed_sequences.  Returns
-    "replayed" | "quarantined" | "failed"; callers fold the outcome
-    into their own counters."""
+    per-sequence pipeline behind the supervisor's restart replay, the
+    dp router's failover redistribution AND planned live migration
+    (one definition so lost/resumed accounting can never drift between
+    dp=1 and dp>1): quarantined fingerprints fail with the 400 poison
+    error, a refused resubmission fails with the retryable 503,
+    success records the ``kind`` flight tick ("resume" for crash
+    replay, "migrate" for planned movement) and bumps
+    vgt_resumed_sequences (resume only — migrations have their own
+    vgt_migrations counter, labeled by reason, owned by the caller).
+    Returns "replayed" | "quarantined" | "failed"; callers fold the
+    outcome into their own counters."""
     fp = faults.fingerprint(seq.prompt_ids[: seq.orig_prompt_len])
     if fp in quarantine:
         metrics.LOST_SEQUENCES.labels(reason="quarantined").inc()
@@ -506,16 +511,45 @@ def replay_into(
             )
         )
         return "failed"
-    metrics.RESUMED_SEQUENCES.inc()
+    if kind == "resume":
+        metrics.RESUMED_SEQUENCES.inc()
     core.flight.record_tick(
-        "resume",
+        kind,
         seq_id=seq.seq_id,
         request_id=seq.request_id,
         tokens=seq.num_generated,
-        attempt=seq.resume_count,
+        attempt=seq.resume_count if kind == "resume" else seq.migrate_count,
         **tick_fields,
     )
     return "replayed"
+
+
+class _EvacRequest:
+    """One planned-evacuation command in flight between a caller thread
+    (dp drain/rebalance coordinator, admin surface) and the engine
+    thread: the engine fills ``result`` (the checkpointed live
+    sequences) or ``error`` and sets ``event``.  ``lock`` arbitrates
+    the timeout race — a caller that gives up sets ``cancelled`` under
+    it, and the engine checks it both before starting and before
+    publishing, so a stale command can never strand ownerless
+    sequences: not-yet-started work is skipped, just-finished work is
+    folded straight back into the source scheduler."""
+
+    __slots__ = (
+        "seq_ids", "reason", "event", "result", "error",
+        "lock", "cancelled",
+    )
+
+    def __init__(
+        self, seq_ids: Optional[List[int]], reason: str
+    ) -> None:
+        self.seq_ids = seq_ids
+        self.reason = reason
+        self.event = threading.Event()
+        self.result: Optional[List[Sequence]] = None
+        self.error: Optional[BaseException] = None
+        self.lock = threading.Lock()
+        self.cancelled = False
 
 
 class EngineCore:
@@ -966,6 +1000,11 @@ class EngineCore:
         # scheduler's deques are engine-thread-owned, so cross-thread
         # iteration (a drain sweep racing try_admit) is never safe.
         self._abort_q: "queue.Queue[tuple]" = queue.Queue()
+        # planned-evacuation commands (live migration): same
+        # cross-thread discipline as aborts — the caller blocks on the
+        # request's event while the engine thread checkpoints the
+        # selected sequences between ticks.  See evacuate().
+        self._evac_q: "queue.Queue[_EvacRequest]" = queue.Queue()
         self._wakeup = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -1067,6 +1106,9 @@ class EngineCore:
         # no engine thread races these mutations.  Checkpointed
         # sequences nobody claimed (supervisor stopped before replay)
         # are owed too.
+        self._fail_pending_evacuations(
+            RuntimeError("engine stopped")
+        )
         checkpointed = self.take_checkpointed()
         for _ in checkpointed:
             metrics.LOST_SEQUENCES.labels(reason="shutdown").inc()
@@ -1307,6 +1349,9 @@ class EngineCore:
         # published before on_fatal: when the dp repair thread (or the
         # supervisor) wakes on the hook, the checkpoint is complete
         self._containment_done = True
+        # unblock any evacuate() caller: the containment checkpoint now
+        # owns the residents (the dp sweep will redistribute them)
+        self._fail_pending_evacuations(exc)
         if self.on_fatal is not None:
             try:
                 self.on_fatal(exc)
@@ -1510,6 +1555,189 @@ class EngineCore:
                 raise RuntimeError("engine is dead") from exc
         self._wakeup.set()
 
+    # --------------------------------------------- planned evacuation
+
+    def evacuate(
+        self,
+        seq_ids: Optional[List[int]] = None,
+        reason: str = "drain",
+        timeout: float = 30.0,
+    ) -> List[Sequence]:
+        """Checkpoint selected RUNNING/WAITING sequences WITHOUT a
+        fatal — the planned-movement twin of ``_contain_fatal``'s
+        checkpoint path (replica drain, hot-replica rebalance, dp
+        scale-down).  The core stays alive and keeps serving its other
+        residents; the selected sequences' slots + KV pages free this
+        tick, nothing settles, and the LIVE Sequence objects come back
+        folded as prefill-continues (``prepare_migrate``: the PR-5
+        staleness epoch bumped so in-flight chunk readbacks discard,
+        the kv-dtype stamp set so a mismatched replay target refuses
+        cleanly).  ``seq.checkpoint()`` yields the pure-data
+        ``SequenceCheckpoint`` form of each.
+
+        Thread-safe: enqueues a command the engine thread applies
+        between ticks (the scheduler's deques are engine-thread-owned)
+        and blocks up to ``timeout`` — generous by default because the
+        loop may legitimately be inside a long device dispatch.
+        ``seq_ids=None`` selects everything resident or queued.
+        Raises RuntimeError when the engine is (or dies while)
+        evacuating — the caller's failover machinery then owns the
+        residents — and MigrationError on timeout."""
+        if self._fatal is not None:
+            raise RuntimeError("engine is dead") from self._fatal
+        req = _EvacRequest(
+            list(seq_ids) if seq_ids is not None else None, reason
+        )
+        self._evac_q.put(req)
+        self._wakeup.set()
+        if not req.event.wait(timeout=timeout):
+            with req.lock:
+                published = (
+                    req.result is not None or req.error is not None
+                )
+                if not published:
+                    req.cancelled = True
+            if not published:
+                if self._fatal is not None:
+                    raise RuntimeError(
+                        "engine died while evacuating"
+                    ) from self._fatal
+                raise MigrationError(
+                    f"evacuation did not complete within "
+                    f"{timeout:.1f}s (engine loop busy or wedged); "
+                    "sequences stayed put"
+                )
+            # publication raced the timeout: the evacuation completed
+            # and we own the result after all — fall through
+        if req.error is not None:
+            raise req.error
+        return req.result or []
+
+    def _fail_pending_evacuations(self, exc: BaseException) -> None:
+        """Unblock evacuate() callers when the loop can no longer serve
+        them (stop/fatal); their sequences are untouched — containment
+        or shutdown accounting owns the residents from here."""
+        while True:
+            try:
+                req = self._evac_q.get_nowait()
+            except queue.Empty:
+                return
+            with req.lock:
+                if not req.cancelled:
+                    req.error = RuntimeError(
+                        f"engine unavailable for evacuation: {exc}"
+                    )
+            req.event.set()
+
+    def _process_evacuations(self) -> None:
+        """Apply queued evacuation commands (engine thread only)."""
+        while True:
+            try:
+                req = self._evac_q.get_nowait()
+            except queue.Empty:
+                return
+            with req.lock:
+                if req.cancelled:
+                    continue  # caller timed out; sequences stayed put
+            result: Optional[List[Sequence]] = None
+            error: Optional[BaseException] = None
+            try:
+                result = self._evacuate_now(req.seq_ids, req.reason)
+            except Exception as exc:  # pragma: no cover - defensive
+                logger.error("evacuation failed", exc_info=True)
+                error = exc
+            with req.lock:
+                if req.cancelled:
+                    # the caller gave up MID-evacuation: nobody will
+                    # place these sequences — fold them straight back
+                    # into this core so their clients keep streaming
+                    # here, exactly as if nothing had moved
+                    if result:
+                        for seq in result:
+                            try:
+                                self.submit_existing(seq)
+                            except RuntimeError:
+                                # went fatal mid-undo: settle typed
+                                # rather than strand the sequence
+                                # outside every scheduler
+                                seq.fail(self._fail_exception(
+                                    self._fatal
+                                    or RuntimeError("engine stopped")
+                                ))
+                        logger.warning(
+                            "evacuation abandoned by timed-out "
+                            "caller; re-admitted locally",
+                            extra={"extra_data": {
+                                "count": len(result),
+                                "reason": req.reason,
+                            }},
+                        )
+                else:
+                    req.result = result
+                    req.error = error
+            req.event.set()
+
+    def _evacuate_now(
+        self, seq_ids: Optional[List[int]], reason: str
+    ) -> List[Sequence]:
+        targets = None if seq_ids is None else set(seq_ids)
+        candidates = list(self.scheduler.running) + list(
+            self.scheduler.waiting
+        )
+        if not any(
+            targets is None or s.seq_id in targets for s in candidates
+        ):
+            return []
+        # fold in-flight decode chunks into host state FIRST: tokens
+        # already sampled on device would otherwise be discarded by the
+        # epoch guard and regenerated on the target (correct for greedy/
+        # seeded, but wasted compute — and a distribution re-draw for
+        # unseeded sampling, exactly like preemption)
+        if self._pending_chunks:
+            self._process_chunks(drain=True)
+            self._decode_signature_cache = None
+            candidates = list(self.scheduler.running) + list(
+                self.scheduler.waiting
+            )
+        out: List[Sequence] = []
+        for seq in candidates:
+            if targets is not None and seq.seq_id not in targets:
+                continue
+            if seq.status not in (SeqStatus.RUNNING, SeqStatus.WAITING):
+                continue  # settled while the chunks drained
+            if seq.abort_requested:
+                continue  # about to settle as abort; nothing to move
+            # stamp the KV storage format the generated prefix was
+            # sampled under — submit_existing on the target refuses a
+            # mismatch (same guard as crash checkpoints)
+            geo = getattr(self, "geometry", None)
+            if geo is not None:
+                seq.kv_dtype = geo.kv_dtype
+            if seq.trace is not None:
+                seq.trace.migrated()
+            self.scheduler.evacuate(seq)
+            seq.prepare_migrate()
+            self.flight.record_tick(
+                "migrate",
+                seq_id=seq.seq_id,
+                request_id=seq.request_id,
+                tokens=seq.num_generated,
+                reason=reason,
+            )
+            out.append(seq)
+        if out:
+            # membership changed: any device decode state is stale
+            self._decode_signature_cache = None
+            logger.info(
+                "evacuated sequences for planned migration",
+                extra={
+                    "extra_data": {
+                        "count": len(out), "reason": reason,
+                    }
+                },
+            )
+        return out
+
     def _tick(self) -> bool:
         """One iteration of the engine loop.
 
@@ -1525,6 +1753,10 @@ class EngineCore:
         Returns False when there was no work (the loop then sleeps).
         """
         self._drain_submissions()
+        # planned evacuations before anything dispatches: a drain/
+        # rebalance coordinator is blocked on this, and the selected
+        # sequences must not burn another decode chunk here first
+        self._process_evacuations()
         # stall fault probe (vgate_tpu/faults.py): a `delay` armed here
         # past recovery.step_stall_s simulates a wedged loop for the
         # hang watchdog.  Only probed while work is resident, so chaos

@@ -181,14 +181,17 @@ class Scheduler:
         if (
             len(self.waiting) >= self.max_queue_size
             and seq.resume_count == 0
+            and seq.migrate_count == 0
         ):
             # replayed sequences (resume_count > 0: checkpointed across
-            # an engine restart / dp failover) bypass the queue-full
+            # an engine restart / dp failover; migrate_count > 0:
+            # planned drain/rebalance movement) bypass the queue-full
             # gate — they were ALREADY admitted once and their clients
             # are still owed an answer; shedding them here would turn a
-            # survivable restart into a 503 exactly when the rebuilt
-            # queue is busiest.  Bounded: at most slots+queue sequences
-            # existed pre-crash, so the overshoot is one queue's worth.
+            # survivable restart (or a routine rolling deploy) into a
+            # 503 exactly when the surviving queue is busiest.  Bounded:
+            # at most slots+queue sequences existed on the source, so
+            # the overshoot is one queue's worth.
             raise EngineBusyError(
                 f"engine queue full ({self.max_queue_size} waiting)"
             )
@@ -844,6 +847,23 @@ class Scheduler:
         self._radix_insert_final(seq)
         self._release_residency(seq)
         self.total_finished += 1
+
+    def evacuate(self, seq: Sequence) -> None:
+        """Planned migration (engine thread only): release this
+        sequence's residency or queue position WITHOUT settling it —
+        unlike :meth:`abort`/:meth:`shed`, the future stays open; the
+        caller folds the sequence (``Sequence.prepare_migrate``) and
+        replays it into another replica.  Accounted as neither finished
+        nor aborted: the sequence's terminal outcome happens wherever
+        it lands."""
+        if seq.status is SeqStatus.RUNNING:
+            self._release_residency(seq)
+        else:
+            try:
+                self.waiting.remove(seq)
+            except ValueError:
+                pass  # already dequeued (racing admission this tick)
+            metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
 
     def abort(self, seq: Sequence) -> None:
         """Client cancellation: release any residency, account it as

@@ -76,6 +76,13 @@ class Sequence:
     # recovery.max_resume_attempts caps it; >0 marks the final result
     # `resumed` so clients can see the latency blip's cause.
     resume_count: int = 0
+    # PLANNED movements (replica drain, hot-replica rebalance, dp
+    # scale-down) this sequence rode — the operational twin of
+    # resume_count, counted separately because a migration is not a
+    # failure: it never spends the crash-resume budget
+    # (recovery.max_resume_attempts) and surfaces as `migrated`, not
+    # `resumed`, on the final result.
+    migrate_count: int = 0
     # KV storage format the generated prefix was sampled under, stamped
     # by fatal containment when the sequence is checkpointed (engine
     # geometry.kv_dtype — "bf16"/"f32"/"int8").  submit_existing on the
@@ -192,18 +199,23 @@ class Sequence:
             "prompt_tokens": self.orig_prompt_len,
             "generated_tokens": len(self.generated_ids),
             "resume_count": self.resume_count,
+            "migrate_count": self.migrate_count,
             "deadline_t": self.deadline_t,
             "kv_dtype": self.kv_dtype,
         }
 
     def resume_metrics(self) -> dict:
-        """The `resumed` entry for a result's metrics dict (empty when
-        the generation never rode a restart) — one definition for every
-        result-assembly site (engine, supervisor, dp router, backend);
-        the batcher lifts it to the response's `resumed` flag."""
-        if not self.resume_count:
-            return {}
-        return {"resumed": float(self.resume_count)}
+        """The `resumed`/`migrated` entries for a result's metrics dict
+        (empty when the generation rode neither a restart nor a planned
+        migration) — one definition for every result-assembly site
+        (engine, supervisor, dp router, backend); the batcher lifts
+        them to the response's `resumed`/`migrated` flags."""
+        out: dict = {}
+        if self.resume_count:
+            out["resumed"] = float(self.resume_count)
+        if self.migrate_count:
+            out["migrated"] = float(self.migrate_count)
+        return out
 
     def checkpoint(self) -> "SequenceCheckpoint":
         """Snapshot this sequence's resumable state (engine crash/stall
@@ -219,6 +231,7 @@ class Sequence:
             first_token_t=self.first_token_t,
             preempt_count=self.preempt_count,
             resume_count=self.resume_count,
+            migrate_count=self.migrate_count,
             request_id=self.request_id,
             trace_id=getattr(self.trace, "trace_id", None),
             kv_dtype=self.kv_dtype,
@@ -244,6 +257,7 @@ class Sequence:
             orig_prompt_len=len(cp.prompt_ids),
             preempt_count=cp.preempt_count,
             resume_count=cp.resume_count + 1,
+            migrate_count=cp.migrate_count,
             request_id=cp.request_id,
             kv_dtype=cp.kv_dtype,
         )
@@ -252,15 +266,16 @@ class Sequence:
         seq.deadline_t = cp.deadline_t
         return seq
 
-    def prepare_resume(self) -> None:
-        """Engine crash/stall checkpoint, live-object form: fold the
-        generation into the prompt (prefill-continue) and return to
-        WAITING so the supervisor / dp router can replay this very
-        object into a rebuilt or surviving engine — every external
-        reference (done_event waiter, stream_cb, cancel-token abort
-        hooks, deadline) stays valid.  The preempt_count bump doubles
-        as the staleness epoch: a stalled engine thread that wakes
-        late discards its readbacks against this sequence."""
+    def _fold_for_replay(self) -> None:
+        """Shared checkpoint fold behind :meth:`prepare_resume` (crash/
+        stall containment) and :meth:`prepare_migrate` (planned
+        movement): fold the generation into the prompt
+        (prefill-continue) and return to WAITING so the replayer can
+        re-submit this very object — every external reference
+        (done_event waiter, stream_cb, cancel-token abort hooks,
+        deadline) stays valid.  The preempt_count bump doubles as the
+        staleness epoch: an engine thread with this sequence still in
+        flight discards its late readbacks against it."""
         if self.status is SeqStatus.RUNNING or self.output_ids:
             self.reset_for_recompute()
         else:
@@ -269,7 +284,22 @@ class Sequence:
             self.pages = []
             self.slot = None
             self.status = SeqStatus.WAITING
+
+    def prepare_resume(self) -> None:
+        """Engine crash/stall checkpoint, live-object form (see
+        :meth:`_fold_for_replay`); counts against
+        recovery.max_resume_attempts and marks the result `resumed`."""
+        self._fold_for_replay()
         self.resume_count += 1
+
+    def prepare_migrate(self) -> None:
+        """PLANNED checkpoint (replica drain / rebalance / scale-down),
+        live-object form (see :meth:`_fold_for_replay`).  Deliberately
+        does NOT touch resume_count: a migration is an operational
+        choice, not a crash, so it must never spend the request's
+        crash-resume budget — the result is marked `migrated` instead."""
+        self._fold_for_replay()
+        self.migrate_count += 1
 
 
 @dataclass
@@ -298,6 +328,8 @@ class SequenceCheckpoint:
     # geometry.kv_dtype); a replay target with a different format must
     # refuse the checkpoint instead of splicing numerics
     kv_dtype: Optional[str] = None
+    # planned movements ridden so far (drain/rebalance/scale-down)
+    migrate_count: int = 0
 
     def as_dict(self) -> dict:
         """Loggable summary (token *counts*, never token content — the
@@ -310,6 +342,7 @@ class SequenceCheckpoint:
             "prompt_tokens": len(self.prompt_ids),
             "generated_tokens": len(self.generated_ids),
             "resume_count": self.resume_count,
+            "migrate_count": self.migrate_count,
             "deadline_t": self.deadline_t,
             "kv_dtype": self.kv_dtype,
         }

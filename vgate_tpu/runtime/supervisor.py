@@ -53,6 +53,7 @@ from vgate_tpu.errors import (
     EngineDeadError,
     EngineRecoveringError,
     EngineStalledError,
+    MigrationRefusedError,
     PoisonRequestError,
     raise_for_state,
     state_is_alive,
@@ -592,6 +593,20 @@ class EngineSupervisor:
                 "repeated engine crashes (or was named by a poison "
                 "fault) and will not be admitted again"
             )
+
+    def evacuate(self, *args: Any, **kwargs: Any) -> None:
+        """Refused, deliberately: a supervised dp=1 deployment has no
+        in-process replica to replay the checkpoints into, and
+        __getattr__ would otherwise delegate straight to
+        EngineCore.evacuate — stranding live sequences (futures open,
+        nothing replaying them) the moment an admin surface or script
+        called it.  Use the SIGTERM graceful drain for single-replica
+        rollouts; live migration needs tpu.dp > 1."""
+        raise MigrationRefusedError(
+            "dp=1 deployment has no migration target; use the SIGTERM "
+            "graceful drain for rollouts (live migration requires "
+            "tpu.dp > 1)"
+        )
 
     def submit_tokens(
         self,

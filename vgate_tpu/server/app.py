@@ -33,6 +33,8 @@ from vgate_tpu.errors import (
     ClientDisconnectError,
     ClientQuotaExceededError,
     DeadlineExceededError,
+    MigrationError,
+    MigrationRefusedError,
     PoisonRequestError,
     RetryableError,
     ServerDrainingError,
@@ -86,8 +88,13 @@ _UNCOUNTED_PATHS = _QUIET_PATHS | {"/stats"}
 def _drain_counted(path: str) -> bool:
     """Should this request hold a graceful drain open?  Probe, scrape
     and introspection surfaces (/debug — operators use it to watch a
-    drain or diagnose the reason for one) never do."""
-    return path not in _UNCOUNTED_PATHS and not path.startswith("/debug")
+    drain or diagnose the reason for one) never do, and neither do the
+    /admin replica operations operators drive DURING a rollout."""
+    return (
+        path not in _UNCOUNTED_PATHS
+        and not path.startswith("/debug")
+        and not path.startswith("/admin")
+    )
 # non-standard but conventional (nginx): the client closed the
 # connection before the response could be written — nobody reads the
 # body, but metrics/logs get a truthful status
@@ -654,6 +661,7 @@ async def chat_completions(request: web.Request) -> web.Response:
         ),
         cached=result.get("cached", False),
         resumed=result.get("resumed", False),
+        migrated=result.get("migrated", False),
         metrics=result.get("metrics", {}),
     )
     return web.json_response(completion.model_dump())
@@ -1286,6 +1294,130 @@ async def debug_request_detail(request: web.Request) -> web.Response:
     return web.json_response(record)
 
 
+def _replica_manager_of(app: web.Application):
+    """The live dp ReplicatedEngine behind the /admin/replicas surface
+    and the SIGUSR1 drain path, or None — dp=1 deployments (EngineCore
+    / EngineSupervisor) have no in-process migration target, and
+    external backends have no replicas at all."""
+    engine: Optional[VGTEngine] = app.get("engine")
+    core = getattr(engine.backend, "core", None) if engine else None
+    if core is not None and hasattr(core, "drain_replica"):
+        return core
+    return None
+
+
+def _replica_manager(request: web.Request):
+    return _replica_manager_of(request.app)
+
+
+def _migration_enabled(request: web.Request) -> bool:
+    config: VGTConfig = request.app["config"]
+    return bool(config.migration.enabled)
+
+
+def _replica_idx(request: web.Request) -> int:
+    try:
+        return int(request.match_info["idx"])
+    except (KeyError, ValueError):
+        raise web.HTTPNotFound(
+            text=json.dumps(
+                {"error": {"message": "replica index must be an integer",
+                           "type": "invalid_request_error"}}
+            ),
+            content_type="application/json",
+        )
+
+
+async def _run_replica_op(
+    request: web.Request, fn, idx_op: bool = True
+) -> web.Response:
+    """Run one blocking replica operation (drain/undrain/add/remove) in
+    the executor — migrations block on the source engine thread for up
+    to migration.evacuate_timeout_s — and map the typed errors:
+    ValueError → 404 (no such replica; only for ``idx_op`` ops, whose
+    sole ValueError is the index validation — add_replica's build
+    errors are real failures, 500), MigrationRefusedError → 409
+    (nothing moved; the body says why)."""
+    if not _migration_enabled(request):
+        return _error(
+            409,
+            "live migration is disabled (migration.enabled=false)",
+            "invalid_request_error",
+        )
+    core = _replica_manager(request)
+    if core is None:
+        return _error(
+            409,
+            "replica operations require the jax_tpu engine with "
+            "tpu.dp > 1 (a dp=1 deployment drains via SIGTERM)",
+            "invalid_request_error",
+        )
+    loop = asyncio.get_running_loop()
+    try:
+        result = await loop.run_in_executor(None, lambda: fn(core))
+    except ValueError as exc:
+        if idx_op:
+            return _error(404, str(exc), "invalid_request_error")
+        return _error(500, str(exc), "migration_error")
+    except MigrationRefusedError as exc:
+        return _error(409, str(exc), "migration_refused")
+    except MigrationError as exc:
+        return _error(500, str(exc), "migration_error")
+    return web.json_response(result)
+
+
+async def admin_replicas(request: web.Request) -> web.Response:
+    """GET /admin/replicas — the dp fleet's per-replica health detail
+    (state, drain marks, migration counters); 200 with a dp=1 note for
+    single-replica deployments so dashboards can probe unconditionally."""
+    core = _replica_manager(request)
+    if core is None:
+        return web.json_response(
+            {"dp": 1, "replicas": [],
+             "note": "no replica manager (dp=1 or external backend)"}
+        )
+    return web.json_response(core.health())
+
+
+async def admin_drain_replica(request: web.Request) -> web.Response:
+    """POST /admin/replicas/{idx}/drain — stop new placements on the
+    replica and live-migrate its residents to the least-loaded
+    survivors (zero 5xx for the moved requests; they complete
+    elsewhere, marked `migrated: true`).  Health reports DEGRADED with
+    per-replica detail until undrain or removal.  Auth-gated like every
+    non-exempt path."""
+    idx = _replica_idx(request)
+    return await _run_replica_op(
+        request, lambda core: core.drain_replica(idx)
+    )
+
+
+async def admin_undrain_replica(request: web.Request) -> web.Response:
+    """POST /admin/replicas/{idx}/undrain — return a drained replica to
+    the placement rotation (the rolling deploy's rejoin step)."""
+    idx = _replica_idx(request)
+    return await _run_replica_op(
+        request, lambda core: core.undrain_replica(idx)
+    )
+
+
+async def admin_add_replica(request: web.Request) -> web.Response:
+    """POST /admin/replicas — grow the dp degree on a banked device
+    slice (elastic dp; see ReplicatedEngine.add_replica)."""
+    return await _run_replica_op(
+        request, lambda core: core.add_replica(), idx_op=False
+    )
+
+
+async def admin_remove_replica(request: web.Request) -> web.Response:
+    """DELETE /admin/replicas/{idx} — drain, migrate, tear down, and
+    bank the device slice (elastic dp scale-down)."""
+    idx = _replica_idx(request)
+    return await _run_replica_op(
+        request, lambda core: core.remove_replica(idx)
+    )
+
+
 async def run_benchmark(request: web.Request) -> web.Response:
     """POST /v1/benchmark through the full pipeline incl. batching + cache
     (reference: main.py:343-386)."""
@@ -1473,6 +1605,50 @@ async def _on_startup(app: web.Application) -> None:
             # non-main thread / platforms without signal support: drain
             # stays reachable programmatically (drain.begin())
             app["drain_signal_installed"] = False
+    if config.migration.enabled:
+        # k8s-friendly replica drain without an HTTP round-trip: a
+        # preStop hook (or an operator) sends SIGUSR1 and the replica
+        # named by $VGT_DRAIN_REPLICA (an index, default 0) drains —
+        # the live-migration twin of the SIGTERM whole-process drain.
+        def _signal_drain_replica() -> None:
+            raw = os.environ.get("VGT_DRAIN_REPLICA", "0")
+            try:
+                idx = int(raw)
+            except ValueError:
+                logger.error(
+                    "VGT_DRAIN_REPLICA=%r is not a replica index", raw
+                )
+                return
+            core = _replica_manager_of(app)
+            if core is None:
+                logger.error(
+                    "SIGUSR1 replica drain ignored: no replica "
+                    "manager (dp=1 or external backend)"
+                )
+                return
+            logger.warning(
+                "SIGUSR1: draining replica via VGT_DRAIN_REPLICA",
+                extra={"extra_data": {"replica": idx}},
+            )
+
+            def _do() -> None:
+                try:
+                    core.drain_replica(idx)
+                except Exception:
+                    logger.error(
+                        "signal-initiated replica drain failed",
+                        exc_info=True,
+                    )
+
+            loop.run_in_executor(None, _do)
+
+        try:
+            loop.add_signal_handler(
+                signal.SIGUSR1, _signal_drain_replica
+            )
+            app["replica_drain_signal_installed"] = True
+        except (NotImplementedError, RuntimeError, ValueError):
+            app["replica_drain_signal_installed"] = False
     metrics.init_app_info(
         __version__, config.model.model_id, config.model.engine_type
     )
@@ -1483,6 +1659,11 @@ async def _on_cleanup(app: web.Application) -> None:
     if app.get("drain_signal_installed"):
         try:
             asyncio.get_running_loop().remove_signal_handler(signal.SIGTERM)
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
+    if app.get("replica_drain_signal_installed"):
+        try:
+            asyncio.get_running_loop().remove_signal_handler(signal.SIGUSR1)
         except (NotImplementedError, RuntimeError, ValueError):
             pass
     batcher: Optional[RequestBatcher] = app.get("batcher")
@@ -1520,6 +1701,19 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     app.router.add_get("/debug/flight", debug_flight)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{ident}", debug_request_detail)
+    # replica operations (live migration / elastic dp) — auth-gated
+    # like every non-exempt path, excluded from drain accounting
+    app.router.add_get("/admin/replicas", admin_replicas)
+    app.router.add_post("/admin/replicas", admin_add_replica)
+    app.router.add_post(
+        "/admin/replicas/{idx}/drain", admin_drain_replica
+    )
+    app.router.add_post(
+        "/admin/replicas/{idx}/undrain", admin_undrain_replica
+    )
+    app.router.add_delete(
+        "/admin/replicas/{idx}", admin_remove_replica
+    )
     app.router.add_post("/v1/benchmark", run_benchmark)
     app.router.add_post("/v1/profile", capture_profile)
     app.on_startup.append(_on_startup)

@@ -160,6 +160,10 @@ class ChatCompletion(BaseModel):
     # checkpoint & replay (docs/operations.md); like `cached`, a vgt
     # extension to the OpenAI shape
     resumed: bool = False
+    # generation was LIVE-MIGRATED between dp replicas by a planned
+    # operation (replica drain / rebalance / scale-down) — explains a
+    # one-off latency blip during a rolling deploy
+    migrated: bool = False
     metrics: Dict[str, float] = Field(default_factory=dict)
 
 

@@ -62,6 +62,9 @@ class ChatCompletion(BaseModel):
     # vgt extension: the generation was checkpointed across an engine
     # restart/failover and replayed (explains a one-off latency blip)
     resumed: bool = False
+    # vgt extension: the generation was live-migrated between dp
+    # replicas by a planned drain/rebalance/scale-down
+    migrated: bool = False
     metrics: Dict[str, float] = Field(default_factory=dict)
 
 
