@@ -23,7 +23,7 @@ fi
 
 echo "== deterministic fault-injection suite =="
 python -m pytest tests/test_faults.py tests/test_recovery.py \
-  tests/test_resume.py \
+  tests/test_resume.py tests/test_integrity.py \
   -q -p no:cacheprovider -m "not chaos"
 
 echo "== chaos-marked randomized suite =="
@@ -38,3 +38,6 @@ bash scripts/migrate_check.sh
 
 echo "== cross-request KV reuse drill =="
 bash scripts/prefix_check.sh
+
+echo "== silent-corruption defense drill =="
+bash scripts/integrity_check.sh

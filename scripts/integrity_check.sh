@@ -1,0 +1,275 @@
+#!/usr/bin/env bash
+# Silent-corruption drill (sibling of resume_check.sh / migrate_check.sh):
+# boot a dp=2 CPU tiny-dense server with BOTH corruption faults armed —
+#   weight_corrupt:corrupt:times=1   bit-flips one weight shard on
+#                                    device; the idle checksum sweep
+#                                    must detect it
+#   logit_corrupt:corrupt:times=1    scrambles the logit-guard flags
+#                                    mid-decode; the output sentinels
+#                                    must trip and DISCARD the chunk
+# — and assert the full defense loop:
+#   1. ZERO client-visible 5xx: residents of a corrupt replica migrate
+#      to the healthy sibling (checkpoint/replay), fresh traffic routes
+#      around the quarantine,
+#   2. ZERO corrupted completions delivered: every drill response is
+#      token-identical to an undisturbed clean rerun (greedy, cache off),
+#   3. both detections fire (vgt_integrity_events: a logit_* sentinel
+#      kind AND checksum_mismatch), the replica RELOADS weights
+#      (vgt_corrupt_reloads >= 1) and rejoins only after its canary
+#      passes (quarantine gauge back to 0, /health serving),
+#   4. restarts_remaining is surfaced in /health (satellite fix),
+#   5. with integrity.enabled=false the same armed faults are inert:
+#      no integrity events, no reloads — byte-identical pre-integrity
+#      behavior.
+#
+# Usage: scripts/integrity_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${1:-8736}"
+source scripts/_drill_lib.sh
+ensure_port_free "$PORT"
+export JAX_PLATFORMS=cpu
+# two virtual CPU devices so dp=2 gets disjoint submeshes
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2"
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=64
+export VGT_TPU__DP=2
+export VGT_TPU__TP=1
+export VGT_TPU__EP=1
+export VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=2
+export VGT_TPU__KV_NUM_PAGES=128
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=8
+export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=20
+# identical reruns must recompute, not replay a cached body
+export VGT_CACHE__ENABLED=false
+# keep the drill deterministic: no surprise rebalance moves
+export VGT_MIGRATION__REBALANCE_ENABLED=false
+# fast reload loop + an eager sweep so detection lands in seconds
+export VGT_RECOVERY__BACKOFF_BASE_S=0.05
+export VGT_RECOVERY__BACKOFF_CAP_S=0.5
+export VGT_INTEGRITY__SWEEP_INTERVAL_S=1
+export VGT_INTEGRITY__SWEEP_LEAVES_PER_TICK=64
+# the corruption faults (vgate_tpu/faults.py; consumed process-wide,
+# once each, by whichever replica probes first)
+export VGT_FAULTS="weight_corrupt:corrupt:times=1,logit_corrupt:corrupt:times=1"
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; clear_drill_pid "$PORT"' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+for _ in $(seq 1 300); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" integrity_check
+
+python - "$BASE" <<'EOF'
+import asyncio, sys, time
+import aiohttp
+
+BASE = sys.argv[1]
+N = 8
+PROMPTS = [f"integrity drill prompt {i}" for i in range(N)]
+# min_tokens pins a long decode so the logit_corrupt sentinel provably
+# trips MID-decode with residents on the corrupt replica
+GEN = {"max_tokens": 24, "min_tokens": 24, "temperature": 0.0}
+
+
+async def fire(session, prompt):
+    async with session.post(
+        f"{BASE}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": prompt}], **GEN},
+    ) as resp:
+        return resp.status, await resp.json()
+
+
+async def get_json(session, path):
+    async with session.get(f"{BASE}{path}") as resp:
+        return resp.status, await resp.json()
+
+
+def metric_value(text, prefix):
+    total = 0.0
+    seen = False
+    for line in text.splitlines():
+        if line.startswith(prefix):
+            total += float(line.split()[-1])
+            seen = True
+    return total if seen else None
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        # the drill wave: concurrent long greedy decodes.  logit_corrupt
+        # trips on the first guarded readback (mid-wave); weight_corrupt
+        # lands at the next idle tick and the sweep catches it within
+        # sweep_interval_s.  Both classify corrupt -> quarantine ->
+        # weight reload -> canary -> rejoin.
+        results = await asyncio.gather(*(fire(session, p) for p in PROMPTS))
+        fivexx = [s for s, _ in results if s >= 500]
+        assert not fivexx, f"client-visible 5xx during corruption: {results}"
+        assert all(s == 200 for s, _ in results), results
+        drill_text = [
+            b["choices"][0]["message"]["content"] for _, b in results
+        ]
+
+        # wait out the full loop: both detections fired, the replica
+        # reloaded, its canary passed, the fleet is whole again
+        deadline = time.monotonic() + 120
+        health = stats = None
+        while time.monotonic() < deadline:
+            _, health = await get_json(session, "/health")
+            _, stats = await get_json(session, "/stats")
+            eng = health["engine"]
+            integ = stats["engine"].get("integrity", {})
+            if (
+                eng["state"] == "serving"
+                and not integ.get("quarantined_corrupt")
+                and integ.get("corrupt_reloads", 0) >= 1
+            ):
+                break
+            await asyncio.sleep(0.3)
+        else:
+            raise AssertionError(
+                "defense loop never completed: "
+                f"health={health and health['engine']}, "
+                f"integrity={stats and stats['engine'].get('integrity')}"
+            )
+        integ = stats["engine"]["integrity"]
+        print(f"integrity after recovery: {integ}")
+        assert integ["corrupt_reloads"] >= 1, integ
+        assert integ["canary"]["expected"], "canary never fingerprinted"
+
+        # satellite: restart-budget headroom is operator-visible
+        eng = health["engine"]
+        assert "restarts_remaining" in eng, eng
+        assert eng["restarts_remaining"] >= 0, eng
+
+        # metrics: both detector families fired, reloads counted,
+        # quarantine released
+        async with session.get(f"{BASE}/metrics") as resp:
+            mtext = await resp.text()
+        sentinel = sum(
+            metric_value(mtext, f'vgt_integrity_events_total{{kind="{k}"}}')
+            or 0.0
+            for k in ("logit_nonfinite", "logit_zero", "logit_saturated")
+        )
+        checksum = metric_value(
+            mtext, 'vgt_integrity_events_total{kind="checksum_mismatch"}'
+        ) or 0.0
+        assert sentinel >= 1, "logit sentinel never tripped"
+        assert checksum >= 1, "checksum sweep never detected the flip"
+        reloads = metric_value(mtext, "vgt_corrupt_reloads_total") or 0.0
+        assert reloads >= 1, "no corrupt reload counted"
+        quarantined = metric_value(
+            mtext, "vgt_replicas_quarantined_corrupt"
+        )
+        assert quarantined == 0, f"quarantine not released: {quarantined}"
+
+        # ZERO corrupted completions: the drill responses must be
+        # token-identical to an undisturbed rerun on the healed fleet
+        # (greedy, cache off) — any token sampled from corrupt logits
+        # would diverge here
+        rerun = await asyncio.gather(*(fire(session, p) for p in PROMPTS))
+        for (s, b), want in zip(rerun, drill_text):
+            assert s == 200, (s, b)
+            got = b["choices"][0]["message"]["content"]
+            assert got == want, (
+                "corrupted completion escaped to a client:\n"
+                f"  drill: {want!r}\n  clean: {got!r}"
+            )
+        lost = stats["engine"]["failover"]["lost"]
+        assert lost == 0, f"sequences lost during the drill: {lost}"
+        print(
+            f"PASS: {N}/{N} completed through live corruption with zero "
+            f"5xx and zero corrupted tokens; sentinel trips={sentinel:.0f} "
+            f"checksum detections={checksum:.0f} reloads={reloads:.0f}; "
+            "replica canary-gated back to SERVING"
+        )
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+clear_drill_pid "$PORT"
+
+echo "== integrity disabled: armed corruption faults must be inert =="
+ensure_port_free "$PORT"
+export VGT_INTEGRITY__ENABLED=false
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+
+for _ in $(seq 1 300); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: disabled-path server never became ready"; exit 1; }
+
+python - "$BASE" <<'EOF'
+import asyncio, sys
+import aiohttp
+
+BASE = sys.argv[1]
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        waves = await asyncio.gather(*(
+            session.post(
+                f"{BASE}/v1/chat/completions",
+                json={
+                    "messages": [{"role": "user", "content": f"off {i}"}],
+                    "max_tokens": 8, "temperature": 0.0,
+                },
+            )
+            for i in range(4)
+        ))
+        assert all(r.status == 200 for r in waves), [r.status for r in waves]
+        async with session.get(f"{BASE}/metrics") as resp:
+            mtext = await resp.text()
+        bad = [
+            line for line in mtext.splitlines()
+            if (
+                line.startswith("vgt_integrity_events_total{")
+                or line.startswith("vgt_corrupt_reloads_total ")
+            )
+            and float(line.split()[-1]) > 0
+        ]
+        assert not bad, (
+            f"integrity.enabled=false but integrity activity recorded: {bad}"
+        )
+        async with session.get(f"{BASE}/stats") as resp:
+            stats = await resp.json()
+        assert "integrity" not in stats["engine"], (
+            "disabled integrity must not surface a stats block"
+        )
+        print(
+            "PASS: integrity disabled — armed corruption faults inert, "
+            "no events, no reloads, serving normally (pre-integrity "
+            "behavior)"
+        )
+
+
+asyncio.run(main())
+EOF

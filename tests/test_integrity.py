@@ -1,0 +1,697 @@
+"""Silent-corruption defense (vgate_tpu/integrity.py): sentinels,
+weight checksums, canary keeper, corrupt classification, and the
+supervisor's reload-on-corrupt rebuild mode (fake cores — fast tier;
+the end-to-end drill lives in scripts/integrity_check.sh and the
+slow-marked test at the bottom)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vgate_tpu import faults, integrity
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import load_config
+from vgate_tpu.errors import IntegrityError, RetryableError
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+from vgate_tpu.runtime.supervisor import (
+    EngineSupervisor,
+    HealthState,
+    classify_fatal,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _icfg(**over):
+    cfg = load_config(integrity=over) if over else load_config()
+    return cfg.integrity
+
+
+def greedy(max_tokens=8, temperature=0.0):
+    return SamplingParams(max_tokens=max_tokens, temperature=temperature)
+
+
+# ------------------------------------------------------- classification
+
+
+def test_integrity_error_is_corrupt_and_retryable():
+    exc = IntegrityError("bad bits", kind="checksum_mismatch")
+    assert classify_fatal(exc) == "corrupt"
+    assert isinstance(exc, RetryableError)
+    assert exc.reason == "corrupt"
+
+
+def test_injected_corrupt_kind_classifies_corrupt():
+    faults.arm("decode_step", mode="raise", kind="corrupt", times=1)
+    with pytest.raises(faults.InjectedFault) as exc_info:
+        faults.check("decode_step")
+    assert classify_fatal(exc_info.value) == "corrupt"
+
+
+def test_new_fault_points_registered():
+    for point in ("weight_corrupt", "logit_corrupt"):
+        assert point in faults.FAULT_POINTS
+        spec = faults.arm(point, mode="corrupt", times=1)
+        assert spec.point == point
+    faults.reset()
+
+
+def test_take_corrupt_consumes_charge():
+    faults.arm("weight_corrupt", mode="corrupt", times=1)
+    assert faults.take_corrupt("weight_corrupt") is True
+    assert faults.take_corrupt("weight_corrupt") is False  # exhausted
+    assert faults.take_corrupt("logit_corrupt") is False  # never armed
+
+
+# ------------------------------------------------------------- digests
+
+
+def _tiny_tree():
+    key = jax.random.PRNGKey(0)
+    return {
+        "embed": jax.random.normal(key, (16, 8), jnp.float32),
+        "layers": {
+            "q": {"w": jax.random.normal(key, (2, 8, 8), jnp.bfloat16)},
+            "norm": jnp.ones((2, 8), jnp.float32),
+        },
+    }
+
+
+def test_tree_digests_stable_and_bitflip_sensitive():
+    tree = _tiny_tree()
+    d1 = integrity.tree_digests(tree)
+    d2 = integrity.tree_digests(jax.tree.map(lambda x: x + 0, tree))
+    assert d1 == d2 and len(d1) == 3
+    # flip ONE element's low bit: exactly that leaf's digest changes
+    flipped = dict(tree)
+    w = tree["layers"]["q"]["w"]
+    bits = jax.lax.bitcast_convert_type(w, jnp.uint16)
+    bits = bits.at[0, 0, 0].set(bits[0, 0, 0] ^ 1)
+    flipped["layers"] = {
+        "q": {"w": jax.lax.bitcast_convert_type(bits, jnp.bfloat16)},
+        "norm": tree["layers"]["norm"],
+    }
+    d3 = integrity.tree_digests(flipped)
+    changed = [k for k in d1 if d1[k] != d3[k]]
+    assert len(changed) == 1 and "q" in changed[0]
+
+
+def test_host_and_device_digests_agree():
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    assert integrity.host_leaf_digest(arr) == integrity.leaf_digest(
+        jnp.asarray(arr)
+    )
+
+
+def test_digest_positional_sensitivity():
+    a = np.asarray([1.0, 2.0], np.float32)
+    b = np.asarray([2.0, 1.0], np.float32)
+    assert integrity.host_leaf_digest(a) != integrity.host_leaf_digest(b)
+
+
+def test_checksum_roundtrip_sharded_quantized_int8():
+    """The serving-shaped round trip: a real (tiny) decoder tree,
+    int8-quantized and device-placed — baseline digests verify clean,
+    and a bit flipped in the QUANTIZED data leaf is caught."""
+    from vgate_tpu.models.decoder import init_params
+    from vgate_tpu.models.specs import spec_for_model_id
+    from vgate_tpu.ops.quant import quantize_decoder_params
+
+    spec = spec_for_model_id("tiny-dense")
+    params = init_params(spec, jax.random.PRNGKey(1), jnp.float32)
+    qparams = quantize_decoder_params(params, spec, bits=8)
+    qparams = jax.device_put(qparams, jax.devices()[0])
+
+    verifier = integrity.WeightVerifier(_icfg(sweep_leaves_per_tick=4))
+    verifier.record(qparams)
+    assert verifier.verify_all(qparams) is None
+    # drive chunked sweeps through one full clean pass
+    verifier._next_pass_t = 0.0
+    for _ in range(64):
+        assert verifier.verify_chunk(qparams) is None
+        if verifier.sweeps_completed:
+            break
+    assert verifier.sweeps_completed == 1
+    assert verifier.mismatches == 0
+    # corrupt one quantized projection leaf on device
+    corrupted = jax.tree_util.tree_map(lambda x: x, qparams)
+    corrupted["layers"]["q"]["w"] = jax.tree_util.tree_map(
+        integrity._bitflip_leaf, corrupted["layers"]["q"]["w"]
+    )
+    mismatch = verifier.verify_all(corrupted)
+    assert mismatch is not None and "q" in mismatch["leaf"]
+    # the budgeted sweep finds it too
+    verifier._cursor = 0
+    verifier._next_pass_t = 0.0
+    found = None
+    for _ in range(64):
+        found = verifier.verify_chunk(corrupted)
+        if found:
+            break
+    assert found is not None
+
+
+# ----------------------------------------------------------- sentinels
+
+
+def test_logit_guard_flag_bits():
+    rows = jnp.asarray(
+        [
+            [1.0, -2.0, 3.0],  # clean
+            [jnp.nan, 0.0, 1.0],  # nonfinite
+            [0.0, 0.0, 0.0],  # all-zero
+            [1.0e6, 0.0, -1.0],  # saturated
+        ],
+        jnp.float32,
+    )
+    flags = np.asarray(integrity.logit_guard(rows, 1.0e4))
+    assert flags[0] == 0
+    assert flags[1] & integrity.FLAG_NONFINITE
+    assert flags[2] & integrity.FLAG_ZERO
+    assert flags[3] & integrity.FLAG_SATURATED
+
+
+def _row_seq(slot, temperature=0.0, output_ids=()):
+    seq = Sequence(
+        prompt_ids=[1, 2, 3],
+        params=SamplingParams(max_tokens=64, temperature=temperature),
+    )
+    seq.status = SeqStatus.RUNNING
+    seq.slot = slot
+    seq.output_ids = list(output_ids)
+    return seq
+
+
+def test_sentinel_token_range_trips():
+    scanner = integrity.SentinelScanner(_icfg(), vocab_size=100)
+    seq = _row_seq(0)
+    sampled = np.asarray([[5], [999]], np.int32).T  # [chunk=2, B=1]? shape
+    sampled = np.asarray([[5, 0], [999, 0]], np.int32)  # [chunk=2, B=2]
+    trips = scanner.scan_decode(sampled, None, [(seq, 0)], chunk=2)
+    assert [k for k, _ in trips] == ["token_range"]
+    assert scanner.trips["token_range"] == 1
+
+
+def test_sentinel_flags_attribute_per_sequence():
+    scanner = integrity.SentinelScanner(_icfg(), vocab_size=100)
+    clean, poisoned = _row_seq(0), _row_seq(1)
+    sampled = np.zeros((1, 2), np.int32)
+    flags = np.asarray([0, integrity.FLAG_NONFINITE], np.uint8)
+    trips = scanner.scan_decode(
+        sampled, flags, [(clean, 0), (poisoned, 1)], chunk=1
+    )
+    assert len(trips) == 1
+    assert trips[0][0] == "logit_nonfinite"
+    assert trips[0][1] is poisoned
+
+
+def test_entropy_collapse_only_for_sampled_generations():
+    cfg = _icfg(entropy_window=8)
+    scanner = integrity.SentinelScanner(cfg, vocab_size=100)
+    history = [7] * 8
+    sampled = np.full((2, 1), 7, np.int32)
+    greedy_seq = _row_seq(0, temperature=0.0, output_ids=history)
+    assert (
+        scanner.scan_decode(sampled, None, [(greedy_seq, 0)], 2) == []
+    )
+    hot_seq = _row_seq(0, temperature=1.0, output_ids=history)
+    trips = scanner.scan_decode(sampled, None, [(hot_seq, 0)], 2)
+    assert [k for k, _ in trips] == ["entropy_collapse"]
+
+
+def test_engine_integrity_scan_raises_with_attribution():
+    eng = integrity.EngineIntegrity(_icfg(), vocab_size=100)
+    seq = _row_seq(3)
+    seq.request_id = "req-77"
+    flags = np.zeros(8, np.uint8)
+    flags[3] = integrity.FLAG_ZERO
+    with pytest.raises(IntegrityError) as exc_info:
+        eng.scan_decode(np.zeros((1, 8), np.int32), flags, [(seq, 3)], 1)
+    err = exc_info.value
+    assert err.integrity_kind == "logit_zero"
+    assert err.sequences[0]["request_id"] == "req-77"
+    assert classify_fatal(err) == "corrupt"
+
+
+def test_scan_clean_chunk_is_silent():
+    eng = integrity.EngineIntegrity(_icfg(), vocab_size=100)
+    seq = _row_seq(0)
+    assert eng.scan_decode(
+        np.ones((2, 4), np.int32), np.zeros(4, np.uint8), [(seq, 0)], 2
+    ) == []
+
+
+def test_entropy_collapse_is_soft_per_sequence():
+    """Entropy collapse is model-degeneration-shaped evidence: the
+    engine must fail ONLY the attributed sequence (soft trip), never
+    classify the replica corrupt and reload weights."""
+    eng = integrity.EngineIntegrity(
+        _icfg(entropy_window=8), vocab_size=100
+    )
+    hot = _row_seq(0, temperature=1.0, output_ids=[7] * 8)
+    soft = eng.scan_decode(
+        np.full((2, 1), 7, np.int32), None, [(hot, 0)], 2
+    )  # must NOT raise
+    assert len(soft) == 1
+    kind, seq, exc = soft[0]
+    assert kind == "entropy_collapse" and seq is hot
+    assert isinstance(exc, IntegrityError)
+
+
+def test_hard_trip_attribution_carries_fingerprint():
+    eng = integrity.EngineIntegrity(_icfg(), vocab_size=100)
+    seq = _row_seq(2)
+    flags = np.zeros(4, np.uint8)
+    flags[2] = integrity.FLAG_NONFINITE
+    with pytest.raises(IntegrityError) as exc_info:
+        eng.scan_decode(np.zeros((1, 4), np.int32), flags, [(seq, 2)], 1)
+    fp = exc_info.value.sequences[0]["fingerprint"]
+    assert fp == faults.fingerprint(seq.prompt_ids)
+
+
+# -------------------------------------------------------------- canary
+
+
+class _FakeCanaryCore:
+    """submit_existing + deterministic 'generation' for CanaryKeeper."""
+
+    def __init__(self, reply):
+        self.reply = list(reply)
+        self.spec = SimpleNamespace(vocab_size=100)
+        self.submitted = []
+
+    def submit_existing(self, seq):
+        assert seq.canary, "canary probes must be marked canary"
+        self.submitted.append(seq)
+        for t in self.reply:
+            seq.append_token(t)
+        seq.finish("stop")
+
+
+def test_canary_records_then_verifies_then_catches_mismatch():
+    keeper = integrity.CanaryKeeper(_icfg())
+    good = _FakeCanaryCore([4, 5, 6])
+    first = keeper.check(good)
+    assert first["ok"] and first["recorded"]
+    second = keeper.check(_FakeCanaryCore([4, 5, 6]))
+    assert second["ok"] and not second["recorded"]
+    assert keeper.passes == 1
+    bad = keeper.check(_FakeCanaryCore([4, 5, 0]))
+    assert bad["ok"] is False
+    assert keeper.failures == 1
+    assert keeper.expected == integrity.canary_fingerprint([4, 5, 6])
+
+
+def test_canary_probe_error_counts_as_failure():
+    keeper = integrity.CanaryKeeper(_icfg())
+
+    class _Dead:
+        spec = SimpleNamespace(vocab_size=100)
+
+        def submit_existing(self, seq):
+            raise RuntimeError("engine is dead")
+
+    result = keeper.check(_Dead())
+    assert result["ok"] is False and "error" in result
+    assert keeper.failures == 1
+
+
+def test_canary_prompt_ids_deterministic_and_in_vocab():
+    ids = integrity.canary_prompt_ids(100, 8)
+    assert ids == integrity.canary_prompt_ids(100, 8)
+    assert all(0 <= t < 100 for t in ids)
+
+
+# ----------------------- supervisor rebuild-mode selection (fake core)
+
+
+class _FakeFatalCore:
+    def __init__(self, exc):
+        self._fatal = exc
+        self._fatal_suspects = []
+        self.flight = None
+        self.scheduler = SimpleNamespace(waiting=[], running=[])
+
+    def take_checkpointed(self):
+        return []
+
+    def take_resume_losses(self):
+        return 0
+
+
+class _FakeNewCore:
+    def __init__(self):
+        self.started = False
+        self.stopped = False
+        self.on_fatal = None
+        self._fatal = None
+
+    def start(self):
+        self.started = True
+
+    def stop(self):
+        self.stopped = True
+
+
+def _bare_supervisor(integrity_enabled=True, canary=None):
+    sup = EngineSupervisor.__new__(EngineSupervisor)
+    cfg = load_config()
+    sup.config = cfg
+    sup._recovery = cfg.recovery.model_copy(
+        update={"backoff_base_s": 0.0, "backoff_cap_s": 0.0}
+    )
+    sup._integrity_cfg = cfg.integrity.model_copy(
+        update={"enabled": integrity_enabled}
+    )
+    sup._devices = None
+    sup._lock = threading.RLock()
+    sup._state = HealthState.SERVING
+    sup._degraded_since = None
+    sup._time_in_degraded = 0.0
+    sup._restart_times = []
+    sup._quarantine = set()
+    sup._suspect_counts = {}
+    sup._stopping = False
+    sup._pending_resume = []
+    sup._canary = canary
+    sup.quarantined_corrupt = False
+    sup.total_corrupt_reloads = 0
+    sup.total_canary_failures = 0
+    sup.last_integrity = None
+    sup.last_resume = None
+    sup.last_crash = None
+    sup.last_fatal = None
+    sup.transitions = []
+    sup.total_crashes = 0
+    sup.total_restarts = 0
+    sup.total_stalls = 0
+    sup.total_resumed = 0
+    sup.total_lost = 0
+    return sup
+
+
+def _run_crash(sup, exc, monkeypatch, new_cores=None):
+    """Drive _handle_crash with rebuild_core captured; returns the
+    recorded (reload_weights, new_core) per rebuild attempt."""
+    import vgate_tpu.runtime.supervisor as sup_mod
+
+    calls = []
+    cores = list(new_cores or [])
+
+    def fake_rebuild(old, config, devices, reload_weights=False):
+        core = cores.pop(0) if cores else _FakeNewCore()
+        calls.append((reload_weights, core))
+        return core
+
+    monkeypatch.setattr(sup_mod, "rebuild_core", fake_rebuild)
+    sup.core = _FakeFatalCore(exc)
+    sup._handle_crash()
+    return calls
+
+
+def test_supervisor_transient_keeps_weights(monkeypatch):
+    sup = _bare_supervisor()
+    calls = _run_crash(sup, RuntimeError("boom"), monkeypatch)
+    assert len(calls) == 1
+    reload_weights, core = calls[0]
+    assert reload_weights is False
+    assert core.started and not core.stopped
+    assert sup.quarantined_corrupt is False
+    assert sup.total_corrupt_reloads == 0
+    assert sup.state in (HealthState.DEGRADED, HealthState.SERVING)
+
+
+def test_supervisor_corrupt_reloads_weights(monkeypatch):
+    sup = _bare_supervisor()
+    exc = IntegrityError("flipped bits", kind="checksum_mismatch")
+    calls = _run_crash(sup, exc, monkeypatch)
+    assert len(calls) == 1
+    reload_weights, core = calls[0]
+    assert reload_weights is True
+    assert core.started
+    assert sup.total_corrupt_reloads == 1
+    assert sup.quarantined_corrupt is False  # cleared: no canary gate
+    assert sup.last_integrity["kind"] == "checksum_mismatch"
+
+
+def test_supervisor_corrupt_inert_when_integrity_disabled(monkeypatch):
+    sup = _bare_supervisor(integrity_enabled=False)
+    exc = IntegrityError("flipped bits", kind="checksum_mismatch")
+    calls = _run_crash(sup, exc, monkeypatch)
+    assert [r for r, _ in calls] == [False]  # weights kept, PR-8 behavior
+
+
+def test_supervisor_kept_verify_failure_escalates_to_reload(monkeypatch):
+    """A transient crash whose kept tree fails rebuild-time checksum
+    verification must escalate THAT recovery to a reload."""
+    import vgate_tpu.runtime.supervisor as sup_mod
+
+    sup = _bare_supervisor()
+    calls = []
+
+    def fake_rebuild(old, config, devices, reload_weights=False):
+        calls.append(reload_weights)
+        if not reload_weights:
+            raise IntegrityError("verify failed", kind="checksum_mismatch")
+        return _FakeNewCore()
+
+    monkeypatch.setattr(sup_mod, "rebuild_core", fake_rebuild)
+    sup.core = _FakeFatalCore(RuntimeError("boom"))
+    sup._handle_crash()
+    assert calls == [False, True]
+    assert sup.quarantined_corrupt is False
+    assert sup.total_corrupt_reloads == 1
+
+
+class _ScriptedKeeper:
+    def __init__(self, verdicts):
+        self.verdicts = list(verdicts)
+        self.checked = []
+
+    def check(self, core, context="probe"):
+        ok = self.verdicts.pop(0)
+        self.checked.append((core, context))
+        return {"ok": ok, "recorded": False, "context": context}
+
+    def stats(self):
+        return {}
+
+
+def test_supervisor_corrupt_replica_rejoins_only_after_canary(monkeypatch):
+    """First post-reload canary fails -> that incarnation is torn down
+    and the reload retries; the second passes -> quarantine lifts."""
+    keeper = _ScriptedKeeper([False, True])
+    sup = _bare_supervisor(canary=keeper)
+    exc = IntegrityError("sentinel", kind="logit_nonfinite")
+    calls = _run_crash(sup, exc, monkeypatch)
+    assert [r for r, _ in calls] == [True, True]
+    first, second = calls[0][1], calls[1][1]
+    assert first.started and first.stopped  # failed canary: torn down
+    assert second.started and not second.stopped
+    assert sup.quarantined_corrupt is False
+    assert sup.total_canary_failures == 1
+    # counted per reload REBUILD (tracks vgt_corrupt_reloads): both
+    # attempts reloaded weights
+    assert sup.total_corrupt_reloads == 2
+    # both probes ran against the post-reload incarnations
+    assert [c for c, _ in keeper.checked] == [first, second]
+
+
+def test_supervisor_corrupt_never_counts_poison_streaks(monkeypatch):
+    """Checksum/canary corruption is the hardware's fault: innocent
+    residents must never accumulate poison streaks from it."""
+    sup = _bare_supervisor()
+    sup.core = _FakeFatalCore(None)
+    sup.core._fatal_suspects = [("fp-innocent", 0)]
+    exc = IntegrityError("flipped bits", kind="checksum_mismatch")
+    sup._update_quarantine(exc, "corrupt")
+    assert sup._suspect_counts == {}
+    assert sup._quarantine == set()
+
+
+def test_supervisor_sentinel_attribution_feeds_poison_streak():
+    """A request that deterministically trips the logit sentinel must
+    be containable: its ATTRIBUTED fingerprint runs the repeat-offender
+    streak (threshold crashes -> quarantined), while co-resident
+    innocents accrue nothing."""
+    sup = _bare_supervisor()
+    sup._recovery = sup._recovery.model_copy(
+        update={"poison_threshold": 2}
+    )
+    bad_fp, innocent_fp = "fp-naan", "fp-innocent"
+    exc = IntegrityError(
+        "sentinel", kind="logit_nonfinite",
+        sequences=[{"fingerprint": bad_fp, "seq_id": 1}],
+    )
+    for _ in range(2):
+        sup.core = _FakeFatalCore(None)
+        sup.core._fatal_suspects = [(bad_fp, 0), (innocent_fp, 0)]
+        sup._update_quarantine(exc, "corrupt")
+    assert bad_fp in sup._quarantine
+    assert innocent_fp not in sup._quarantine
+
+
+def test_dp_sentinel_attribution_feeds_corrupt_streak():
+    """The dp twin of the supervisor streak: attributed fingerprints
+    accumulate across corrupt sentinel fatals and quarantine at
+    poison_threshold; unattributed residents accrue nothing."""
+    from vgate_tpu.runtime.dp_engine import ReplicatedEngine
+
+    dp = ReplicatedEngine.__new__(ReplicatedEngine)
+    dp._quarantine = set()
+    dp._corrupt_streaks = {}
+    dp._recovery = SimpleNamespace(poison_threshold=2)
+    bad_fp, innocent_fp = "fp-naan", "fp-innocent"
+    exc = IntegrityError(
+        "sentinel", kind="logit_nonfinite",
+        sequences=[{"fingerprint": bad_fp, "seq_id": 1}],
+    )
+    core = SimpleNamespace(
+        _fatal=exc,
+        _fatal_suspects=[(bad_fp, 0), (innocent_fp, 0)],
+    )
+    dp._update_quarantine(core)
+    assert bad_fp not in dp._quarantine  # one trip: streak only
+    dp._update_quarantine(core)
+    assert bad_fp in dp._quarantine
+    assert innocent_fp not in dp._quarantine
+
+
+def test_restart_budget_remaining_helper():
+    from vgate_tpu.runtime.supervisor import restart_budget_remaining
+
+    rec = SimpleNamespace(max_restarts=3, restart_window_s=300.0)
+    now = 1000.0
+    assert restart_budget_remaining([], rec, now) == 3
+    assert restart_budget_remaining([999.0, 998.0], rec, now) == 1
+    assert restart_budget_remaining([999.0] * 9, rec, now) == 0
+    assert restart_budget_remaining([600.0], rec, now) == 3  # aged out
+
+
+# ------------------------------------------------- health surfacing
+
+
+def test_health_reports_restarts_remaining_and_integrity():
+    sup = _bare_supervisor()
+    sup.core = _FakeFatalCore(None)
+    now = time.monotonic()
+    sup._restart_times = [now, now]  # 2 of max 3 burned
+    health = sup.health()
+    assert health["restarts_remaining"] == 1
+    assert health["integrity"]["quarantined_corrupt"] is False
+    # outside the window the budget replenishes
+    sup._restart_times = [now - 10_000]
+    assert sup.health()["restarts_remaining"] == 3
+
+
+def test_health_restarts_remaining_floor_zero():
+    sup = _bare_supervisor()
+    sup.core = _FakeFatalCore(None)
+    now = time.monotonic()
+    sup._restart_times = [now] * 10
+    assert sup.health()["restarts_remaining"] == 0
+
+
+# ------------------------------------- end-to-end drill (slow tier)
+
+
+def _engine_config(**integrity_over):
+    return load_config(
+        model={
+            "model_id": "tiny-dense",
+            "engine_type": "jax_tpu",
+            "dtype": "float32",
+            "max_model_len": 64,
+        },
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1,
+            "kv_num_pages": 64, "kv_page_size": 4,
+            "max_batch_slots": 2, "prefill_buckets": [16],
+        },
+        recovery={"backoff_base_s": 0.01, "backoff_cap_s": 0.05},
+        integrity={
+            "sweep_interval_s": 0.01,
+            "sweep_leaves_per_tick": 64,
+            **integrity_over,
+        },
+        logging={"level": "WARNING"},
+    )
+
+
+@pytest.mark.slow
+def test_weight_corrupt_detect_reload_canary_end_to_end():
+    """The tentpole loop on a real (tiny) engine: arm weight_corrupt →
+    the idle sweep bit-flips and then detects the shard → the
+    supervisor reloads weights (not weights-kept) → the post-reload
+    canary passes → serving resumes with output identical to
+    pre-corruption."""
+    sup = EngineSupervisor(_engine_config(), devices=jax.devices()[:1])
+    sup.start()
+    try:
+        params = [SamplingParams(max_tokens=8, temperature=0.0)]
+        [before] = sup.generate(["integrity drill"], list(params))
+        baseline_digests = dict(
+            sup.core.integrity.verifier.baseline
+        )
+        faults.arm("weight_corrupt", mode="corrupt", times=1)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sup.total_corrupt_reloads >= 1 and sup.state in (
+                HealthState.DEGRADED, HealthState.SERVING
+            ):
+                break
+            time.sleep(0.05)
+        assert sup.total_corrupt_reloads >= 1, (
+            f"corrupt reload never happened: state={sup.state}, "
+            f"last_fatal={sup.last_fatal}"
+        )
+        assert sup.quarantined_corrupt is False
+        assert sup.last_integrity["kind"] == "checksum_mismatch"
+        # the reloaded tree matches the original (same seed/checkpoint)
+        assert dict(sup.core.integrity.verifier.baseline) == (
+            baseline_digests
+        )
+        [after] = sup.generate(["integrity drill"], list(params))
+        assert after["token_ids"] == before["token_ids"]
+        stats = sup.get_stats()
+        assert stats["supervisor"]["integrity"]["corrupt_reloads"] >= 1
+    finally:
+        sup.stop()
+        faults.reset()
+
+
+@pytest.mark.slow
+def test_logit_corrupt_sentinel_discards_chunk_end_to_end():
+    """Sentinel path: scramble the logit-guard flags mid-decode — the
+    poisoned chunk is discarded (no garbage delivered), the engine
+    fatals corrupt, the supervisor reloads, and the in-flight request
+    completes token-identical via checkpoint/replay."""
+    sup = EngineSupervisor(_engine_config(), devices=jax.devices()[:1])
+    sup.start()
+    try:
+        params = SamplingParams(
+            max_tokens=24, min_tokens=24, temperature=0.0
+        )
+        [before] = sup.generate(["sentinel drill"], [params])
+        faults.arm("logit_corrupt", mode="corrupt", times=1)
+        [res] = sup.generate(["sentinel drill"], [params])
+        # the replayed result must be token-identical: every delivered
+        # token predates the discarded chunk or came from the reloaded
+        # core — never from corrupt logits
+        assert res["token_ids"] == before["token_ids"]
+        assert res["metrics"].get("resumed", 0) >= 1
+        assert sup.total_corrupt_reloads >= 1
+        assert sup.last_integrity["kind"].startswith("logit_")
+    finally:
+        sup.stop()
+        faults.reset()

@@ -537,9 +537,14 @@ def test_health_gauge_counts_alive_draining_replica():
         SimpleNamespace(_fatal=None, _running=True) for _ in range(2)
     ]
     dp._draining = {0}
+    dp._corrupt = set()
     dp._failover_enabled = True
     dp._restart_times = []
     dp._quarantine = set()
+    dp._recovery = SimpleNamespace(
+        restart_window_s=300.0, max_restarts=3
+    )
+    dp._integrity_cfg = SimpleNamespace(enabled=False)
     dp.total_failovers = dp.total_restarts = dp.total_stalls = 0
     dp.total_resumed = dp.total_migrated = dp.total_lost = 0
     h = dp.health()

@@ -384,6 +384,7 @@ def test_dp_redistribute_excludes_quarantined():
     eng.replicas = [dead, survivor]
     eng._topology_lock = threading.RLock()
     eng._draining = set()
+    eng._corrupt = set()
     eng._recovery = SimpleNamespace(
         backoff_base_s=0.05, backoff_cap_s=0.2
     )

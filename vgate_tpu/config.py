@@ -430,6 +430,67 @@ class RecoveryConfig(BaseModel):
     compile_grace_s: float = 900.0
 
 
+class IntegrityConfig(BaseModel):
+    """Silent-corruption defense (vgate_tpu/integrity.py): output
+    sentinels folded into the engine tick, budgeted weight-checksum
+    sweeps on idle ticks, canary self-probes, and the reload-on-corrupt
+    rebuild mode in the supervisor / dp repair loop.  With
+    ``enabled=false`` the engine byte-for-byte matches the
+    pre-integrity behavior (no guard in the decode program, no sweep,
+    no canary, corrupt classification falls back to transient)."""
+
+    enabled: bool = True
+    # --- output sentinels (per decode-chunk readback) ---
+    sentinels_enabled: bool = True
+    # Fold a per-slot guard word (NaN/Inf, all-zero row, saturated row)
+    # into the jitted decode chunk; [B] uint8 rides back with the
+    # sampled tokens.  Off = host-side token checks only.
+    logit_guard: bool = True
+    # |logit| at/above this trips the saturated-row sentinel.
+    saturate_threshold: float = 1.0e4
+    # Entropy collapse: a generation SAMPLING at temperature >=
+    # entropy_min_temp that emits fewer than entropy_min_distinct
+    # distinct tokens over a full entropy_window is a collapsed
+    # distribution.  0 disables the window check (greedy runs are
+    # never checked — repetition is legitimate there).
+    entropy_window: int = 64
+    entropy_min_distinct: int = 2
+    entropy_min_temp: float = 0.5
+    # --- weight checksum sweeps ---
+    sweep_enabled: bool = True
+    # Seconds between FULL sweep passes (the budget below spreads one
+    # pass over many idle ticks; a pass only begins this long after
+    # the previous one finished).
+    sweep_interval_s: float = 30.0
+    # Leaves verified per idle tick — the budget that keeps the sweep
+    # from ever stealing a decode tick (each leaf is one small
+    # on-device reduction + scalar readback).
+    sweep_leaves_per_tick: int = 2
+    # --- canary self-probes ---
+    canary_enabled: bool = True
+    # Slow-timer probe period per replica (0 = only on rebuild /
+    # undrain / add_replica).  The first probe against a presumed-good
+    # core RECORDS the fingerprint; later probes verify it.
+    canary_interval_s: float = 0.0
+    canary_prompt_len: int = 8
+    canary_max_tokens: int = 8
+    # Record the canary fingerprint at engine START (known-good boot,
+    # fresh from the checkpoint) instead of lazily at the first gate.
+    # STRONGLY recommended in production: without a boot baseline, the
+    # first-ever probe — possibly the post-reload gate after a
+    # corruption — records instead of verifies, and a corrupt on-disk
+    # checkpoint would be baselined as truth.  Default off only because
+    # it costs one probe (plus its compiles) per process start.
+    canary_record_on_start: bool = False
+    canary_timeout_s: float = 60.0
+    # Extra probe headroom when the target core has executed ZERO steps
+    # (post-reload / fresh add_replica): the probe's prefill/decode
+    # programs compile inside it — the recovery.compile_grace_s lesson
+    # applied to canaries, so a first-compile pause cannot quarantine a
+    # healthy replica.
+    canary_compile_grace_s: float = 900.0
+
+
 class MigrationConfig(BaseModel):
     """Planned live request migration (runtime/dp_engine.py +
     /admin/replicas): generalizes the crash-time checkpoint/replay into
@@ -738,6 +799,7 @@ class VGTConfig(BaseModel):
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     lifecycle: LifecycleConfig = Field(default_factory=LifecycleConfig)
     migration: MigrationConfig = Field(default_factory=MigrationConfig)
+    integrity: IntegrityConfig = Field(default_factory=IntegrityConfig)
     admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
     inference: InferenceConfig = Field(default_factory=InferenceConfig)
     logging: LoggingConfig = Field(default_factory=LoggingConfig)

@@ -170,6 +170,43 @@ class ServerOverloadedError(RetryableError):
         self.tier = tier
 
 
+class IntegrityError(RetryableError):
+    """Silent data corruption detected (vgate_tpu/integrity.py): an
+    output sentinel tripped on a decode readback (NaN/Inf, all-zero or
+    saturated logit rows, token ids outside the vocabulary, entropy
+    collapse), a weight checksum sweep found a shard whose bits no
+    longer match the load-time baseline, or a canary self-probe's
+    pinned greedy output stopped matching its recorded fingerprint.
+
+    ``fault_kind = "corrupt"`` routes the supervisor / dp repair loop
+    to the **reload** rebuild path: weights-kept restarts would carry
+    the corruption into every new incarnation.  Retryable from the
+    client's view (503 + Retry-After — a healthy replica or the
+    reloaded engine serves the retry); the poisoned chunk was discarded
+    before any of its tokens reached a client.
+
+    ``integrity_kind`` names the detector (logit_nonfinite |
+    logit_zero | logit_saturated | token_range | entropy_collapse |
+    checksum_mismatch | canary); ``sequences`` carries per-sequence
+    attribution (seq_id/request_id dicts) for observability."""
+
+    reason = "corrupt"
+    fault_kind = "corrupt"
+
+    def __init__(
+        self,
+        message: str,
+        kind: str = "unknown",
+        sequences: list = None,
+        detail: dict = None,
+        retry_after: float = 1.0,
+    ) -> None:
+        super().__init__(message, retry_after=retry_after)
+        self.integrity_kind = kind
+        self.sequences = list(sequences or [])
+        self.detail = dict(detail or {})
+
+
 class MigrationError(RuntimeError):
     """A planned sequence movement (replica drain, hot-replica
     rebalance, dp scale-down) could not complete — the operational

@@ -22,6 +22,18 @@ Probed sites (each calls :func:`check` with the point name):
                     ``recovery.step_stall_s`` to simulate a wedged loop
                     (stuck decode step / Mosaic hang) for the hang
                     watchdog; ``raise`` mode is a plain tick crash
+``weight_corrupt``  integrity idle sweep (vgate_tpu/integrity.py) —
+                    ``corrupt`` mode XOR-flips the bits of one
+                    device-resident weight shard (a TRUE silent
+                    corruption: the checksum sweep detects it, the
+                    canary genuinely fails, and the supervisor/dp
+                    repair reloads weights); ``raise`` mode with
+                    ``kind=corrupt`` drills the classification path
+                    without touching weights
+``logit_corrupt``   decode-chunk readback — ``corrupt`` mode scrambles
+                    the on-device logit-guard flag word so the output
+                    sentinels trip exactly as they would on NaN logits
+                    (requires ``integrity.logit_guard``)
 ==================  ====================================================
 
 Arming — programmatic (tests)::
@@ -67,9 +79,14 @@ FAULT_POINTS = (
     "kv_alloc",
     "backend_generate",
     "stall",
+    "weight_corrupt",
+    "logit_corrupt",
 )
 
-FAULT_KINDS = ("transient", "poison", "unrecoverable")
+# `corrupt` routes the supervisor/dp repair to the RELOAD rebuild path
+# (weights-kept restarts would preserve the corruption) — see
+# vgate_tpu/integrity.py and runtime/supervisor.py classify_fatal
+FAULT_KINDS = ("transient", "poison", "unrecoverable", "corrupt")
 
 FAULTS_ENV = "VGT_FAULTS"
 CHAOS_ENV = "VGT_CHAOS"
@@ -290,6 +307,24 @@ def corrupt_array(point: str, array):
 
     metrics.FAULTS_INJECTED.labels(point=point, mode="corrupt").inc()
     return array ^ 0x55
+
+
+def take_corrupt(point: str) -> bool:
+    """Consume one armed ``corrupt``-mode charge at ``point`` WITHOUT
+    transforming an array — for sites whose corruption payload is not a
+    simple int-XOR (the integrity sweep bit-flips a float weight shard
+    via bitcast; vgate_tpu/integrity.py).  Returns True when a spec
+    fired; the caller performs the corruption itself."""
+    if not _active:
+        return False
+    with _lock:
+        spec = _take(point, None, want_corrupt=True)
+    if spec is None:
+        return False
+    from vgate_tpu import metrics
+
+    metrics.FAULTS_INJECTED.labels(point=point, mode="corrupt").inc()
+    return True
 
 
 def arm_from_env(environ: Optional[Dict[str, str]] = None) -> int:

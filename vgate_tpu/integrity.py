@@ -1,0 +1,663 @@
+"""Silent-corruption defense: sentinels, weight checksums, canaries.
+
+Every recovery path built so far rebuilds the engine core **with
+weights kept** — exactly wrong when the fatal was caused by silent data
+corruption: a bitflip in an HBM-resident shard survives every restart
+and turns the supervisor into a corruption-preservation machine.  TPU
+fleets at scale suffer silent data corruption in accelerators, and the
+health state machine only ever sees crashes and hangs — never wrong
+answers.  This module gives the serving stack three independent ways to
+*notice* corruption and one typed way to react:
+
+* **Output sentinels** — cheap guards folded into the engine tick:
+  an on-device per-slot flag word computed from the decode logits
+  (NaN/Inf, all-zero rows, saturated rows) that rides back with the
+  sampled tokens, plus host-side checks over the readback itself
+  (token ids outside the vocabulary, token-entropy collapse over a
+  sliding window of a *sampled* generation).  A trip discards the
+  poisoned chunk BEFORE any token is appended/streamed — garbage never
+  reaches a client — and raises :class:`~vgate_tpu.errors.IntegrityError`
+  with per-sequence attribution.
+* **Weight checksum sweeps** — a per-leaf digest baseline recorded when
+  the (quantized, sharded) tree is placed, re-verified a few leaves at
+  a time by an idle-tick background sweep (budgeted so it never steals
+  a decode tick) and in FULL whenever a supervised rebuild wants to
+  keep the old tree (:func:`verify thereof in engine_core.rebuild_core`).
+* **Canary self-probes** — a pinned greedy prompt with a recorded
+  output fingerprint, run per replica on rebuild/undrain/add_replica
+  and on a slow timer, so a corrupt replica is caught before real
+  traffic reaches it.
+
+The supervisor / dp repair loop classify ``IntegrityError`` fatals as
+``corrupt`` and rebuild with a full weight **reload** (not
+weights-kept), quarantining the replica (``quarantined_corrupt`` in
+health detail, excluded from routing/placement) until its post-reload
+canary passes.
+
+Digests are wraparound uint32 sums over the leaf's *bit pattern* with a
+positional weight — one small on-device reduction per leaf, scalar
+readback, no full-tree transfer.  Not cryptographic; the adversary is a
+flipped bit, not an attacker.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vgate_tpu import faults, metrics
+from vgate_tpu.errors import IntegrityError
+from vgate_tpu.logging_config import get_logger
+
+logger = get_logger(__name__)
+
+# logit-guard flag bits ([B] uint8 computed inside the decode chunk)
+FLAG_NONFINITE = 1  # NaN/Inf anywhere in the row
+FLAG_ZERO = 2  # every logit exactly 0.0 (dead matmul / zeroed shard)
+FLAG_SATURATED = 4  # |logit| at/above the saturation threshold
+
+_FLAG_KINDS = (
+    (FLAG_NONFINITE, "logit_nonfinite"),
+    (FLAG_ZERO, "logit_zero"),
+    (FLAG_SATURATED, "logit_saturated"),
+)
+
+
+def logit_guard(logits, saturate_threshold: float):
+    """Per-row guard flags from a ``[B, V]`` logits array — called
+    INSIDE the jitted decode chunk (guard=True), so it must stay pure
+    jnp.  Returns ``[B] uint8`` (bits above).  ``jnp.max`` would
+    propagate NaN into a False comparison, but the nonfinite bit
+    already owns that row."""
+    finite = jnp.all(jnp.isfinite(logits), axis=-1)
+    allzero = jnp.all(logits == 0.0, axis=-1)
+    saturated = jnp.max(jnp.abs(logits), axis=-1) >= saturate_threshold
+    flags = (
+        jnp.where(finite, 0, FLAG_NONFINITE)
+        | jnp.where(allzero, FLAG_ZERO, 0)
+        | jnp.where(saturated, FLAG_SATURATED, 0)
+    )
+    return flags.astype(jnp.uint8)
+
+
+# --------------------------------------------------------------- digests
+
+
+def _uint_for_width(itemsize: int):
+    return {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint32}[
+        itemsize
+    ]
+
+
+# positional-weight modulus (largest prime < 2^16): makes the digest
+# sensitive to element *position*, not just the multiset of bit patterns
+_WEIGHT_MOD = 65521
+
+
+@jax.jit
+def _digest_device(x):
+    # everything inside the jit so XLA fuses the bitcast + iota +
+    # multiply INTO the reduction: verifying a multi-GB leaf must not
+    # materialize full-size uint32 temporaries next to a KV pool that
+    # already owns the rest of HBM
+    flat = jnp.ravel(x)
+    bits = jax.lax.bitcast_convert_type(
+        flat, _uint_for_width(flat.dtype.itemsize)
+    ).astype(jnp.uint32)
+    weights = (
+        jax.lax.iota(jnp.uint32, flat.shape[0]) % _WEIGHT_MOD
+    ) + 1
+    return jnp.sum(bits * weights, dtype=jnp.uint32)
+
+
+def leaf_digest(x) -> int:
+    """Wraparound-uint32 positional digest of one array's bit pattern.
+    Works for float (bf16/f16/f32), int8 quantized data and scale
+    leaves alike; device arrays reduce on device (scalar readback),
+    numpy leaves reduce on host via :func:`host_leaf_digest`."""
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return host_leaf_digest(np.asarray(x))
+    if jnp.dtype(x.dtype).itemsize == 8:  # pragma: no cover - no 64-bit leaves
+        x = x.astype(jnp.float32)
+    return int(_digest_device(x))
+
+
+def host_leaf_digest(arr: np.ndarray) -> int:
+    """Numpy twin of :func:`leaf_digest` — same formula, so a host-side
+    load digest and a device-side verify of the identical bit pattern
+    agree (used by runtime/weights.py load-time provenance logging)."""
+    arr = np.asarray(arr)
+    if arr.dtype.itemsize == 8:  # pragma: no cover - as above
+        arr = arr.astype(np.float32)
+    flat = np.ravel(arr)
+    width = {1: np.uint8, 2: np.uint16, 4: np.uint32}[arr.dtype.itemsize]
+    bits = flat.view(width).astype(np.uint32)
+    weights = (
+        np.arange(flat.shape[0], dtype=np.uint32) % _WEIGHT_MOD
+    ) + 1
+    return int(
+        np.sum(bits * weights, dtype=np.uint32)
+    )
+
+
+def _path_str(path) -> str:
+    return jax.tree_util.keystr(path)
+
+
+def tree_digests(params: Any) -> Dict[str, int]:
+    """Per-leaf digest map for a param pytree (quantized trees
+    included — their data/scale leaves digest independently, so a flip
+    in either is caught)."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {_path_str(p): leaf_digest(x) for p, x in leaves}
+
+
+def digest_summary(digests: Dict[str, int]) -> Dict[str, Any]:
+    """Loggable one-liner: leaf count + a combined tree digest."""
+    combined = 0
+    for path in sorted(digests):
+        combined = (combined * 1000003 + digests[path]) & 0xFFFFFFFF
+    return {"leaves": len(digests), "tree_digest": f"{combined:08x}"}
+
+
+def _bitflip_leaf(x, mask: int = 0x55):
+    """XOR every element's bit pattern with ``mask`` — the fault-
+    injection payload behind the ``weight_corrupt`` probe point (a real
+    on-device corruption: checksums mismatch, outputs go genuinely
+    wrong, the canary genuinely fails)."""
+    itemsize = jnp.dtype(x.dtype).itemsize
+    uint = _uint_for_width(itemsize)
+    bits = jax.lax.bitcast_convert_type(x, uint)
+    return jax.lax.bitcast_convert_type(
+        bits ^ uint(mask), x.dtype
+    )
+
+
+# ------------------------------------------------------------ sentinels
+
+
+class SentinelScanner:
+    """Host-side output sentinels over one decode-chunk readback.
+    Stateless between calls except trip counters; the entropy window is
+    derived from each sequence's own ``output_ids`` so it survives
+    preemption/replay without private bookkeeping."""
+
+    def __init__(self, cfg, vocab_size: int) -> None:
+        self.cfg = cfg
+        self.vocab_size = vocab_size
+        self.trips: Dict[str, int] = {}
+
+    def _trip(
+        self, kind: str, seq, trips: List[Tuple[str, Any]]
+    ) -> None:
+        self.trips[kind] = self.trips.get(kind, 0) + 1
+        metrics.INTEGRITY_EVENTS.labels(kind=kind).inc()
+        trips.append((kind, seq))
+
+    def scan_decode(
+        self,
+        sampled: np.ndarray,  # [chunk, B] host tokens
+        flags: Optional[np.ndarray],  # [B] uint8 guard word or None
+        seq_rows: List[Tuple[Any, int]],  # (live seq, slot) pairs
+        chunk: int,
+    ) -> List[Tuple[str, Any]]:
+        """Scan one chunk readback BEFORE any token is appended.
+        Returns ``[(kind, seq), ...]`` trips (empty when clean); the
+        caller discards the chunk and raises IntegrityError on any."""
+        cfg = self.cfg
+        trips: List[Tuple[str, Any]] = []
+        for seq, slot in seq_rows:
+            if flags is not None and flags[slot]:
+                word = int(flags[slot])
+                for bit, kind in _FLAG_KINDS:
+                    if word & bit:
+                        self._trip(kind, seq, trips)
+                continue  # one attribution per row is enough
+            col = sampled[:chunk, slot]
+            if np.any(col < 0) or np.any(col >= self.vocab_size):
+                self._trip("token_range", seq, trips)
+                continue
+            # entropy collapse: a *sampled* generation emitting fewer
+            # than entropy_min_distinct distinct tokens over a full
+            # window is a collapsed distribution (greedy loops are
+            # legitimate, so temperature gates the check)
+            window = cfg.entropy_window
+            if (
+                window > 0
+                and seq.params.temperature >= cfg.entropy_min_temp
+                and len(seq.output_ids) + chunk >= window
+            ):
+                tail = seq.output_ids[-(window - chunk):] if (
+                    window > chunk
+                ) else []
+                recent = list(tail) + [int(t) for t in col]
+                if len(set(recent[-window:])) < cfg.entropy_min_distinct:
+                    self._trip("entropy_collapse", seq, trips)
+        return trips
+
+
+# --------------------------------------------------------- weight sweeps
+
+
+class WeightVerifier:
+    """Baseline digests + the budgeted re-verification cursor.  One
+    instance per EngineCore; ``verify_chunk`` is called from idle ticks
+    only (never steals a decode tick) and walks ``leaves_per_tick``
+    leaves per call, pacing full passes ``interval_s`` apart."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self.baseline: Dict[str, int] = {}
+        self._order: List[str] = []
+        self._cursor = 0
+        self._next_pass_t = 0.0
+        # path->leaf map cached per tree identity: rebuilding it costs
+        # an O(leaves) flatten + keystr pass, which a 2-leaves-per-tick
+        # budget must not pay on every idle tick
+        self._leaf_cache: Optional[Dict[str, Any]] = None
+        self._leaf_cache_src: Optional[int] = None
+        self.sweeps_completed = 0
+        self.leaves_verified = 0
+        self.mismatches = 0
+
+    def record(self, params: Any) -> Dict[str, Any]:
+        start = time.perf_counter()
+        self.baseline = tree_digests(params)
+        self._order = sorted(self.baseline)
+        self._cursor = 0
+        self._leaf_cache = None
+        self._leaf_cache_src = None
+        self._next_pass_t = time.monotonic() + self.cfg.sweep_interval_s
+        elapsed = time.perf_counter() - start
+        metrics.WEIGHT_VERIFY_SECONDS.observe(elapsed)
+        summary = digest_summary(self.baseline)
+        summary["record_s"] = round(elapsed, 4)
+        return summary
+
+    def _leaf_map(self, params: Any) -> Dict[str, Any]:
+        # keyed on tree identity: reloads and the weight_corrupt
+        # injection always REPLACE the tree object (jax leaves are
+        # immutable; corruption rebuilds via tree_unflatten), so a
+        # stale id cannot alias a mutated tree
+        if (
+            self._leaf_cache is None
+            or self._leaf_cache_src != id(params)
+        ):
+            self._leaf_cache = {
+                _path_str(p): x
+                for p, x in jax.tree_util.tree_flatten_with_path(
+                    params
+                )[0]
+            }
+            self._leaf_cache_src = id(params)
+        return self._leaf_cache
+
+    def _check(
+        self, paths: List[str], leaf_map: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        for path in paths:
+            leaf = leaf_map.get(path)
+            digest = None if leaf is None else leaf_digest(leaf)
+            self.leaves_verified += 1
+            metrics.WEIGHT_LEAVES_VERIFIED.inc()
+            if digest != self.baseline[path]:
+                self.mismatches += 1
+                metrics.INTEGRITY_EVENTS.labels(
+                    kind="checksum_mismatch"
+                ).inc()
+                return {
+                    "leaf": path,
+                    "expected": self.baseline[path],
+                    "got": digest,
+                }
+        return None
+
+    def verify_chunk(
+        self, params: Any, now: Optional[float] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Verify the next budgeted slice of leaves; returns the first
+        mismatch found (None while clean or between passes)."""
+        if not self.baseline:
+            return None
+        now = time.monotonic() if now is None else now
+        if self._cursor == 0 and now < self._next_pass_t:
+            return None  # between passes
+        start = time.perf_counter()
+        n = max(1, self.cfg.sweep_leaves_per_tick)
+        paths = self._order[self._cursor : self._cursor + n]
+        mismatch = self._check(paths, self._leaf_map(params))
+        metrics.WEIGHT_VERIFY_SECONDS.observe(
+            time.perf_counter() - start
+        )
+        if mismatch is not None:
+            return mismatch
+        self._cursor += len(paths)
+        if self._cursor >= len(self._order):
+            self._cursor = 0
+            self.sweeps_completed += 1
+            self._next_pass_t = now + self.cfg.sweep_interval_s
+        return None
+
+    def verify_all(self, params: Any) -> Optional[Dict[str, Any]]:
+        """Full-tree verification (supervised rebuilds ALWAYS run this
+        before keeping the old incarnation's weights)."""
+        if not self.baseline:
+            return None
+        start = time.perf_counter()
+        mismatch = self._check(self._order, self._leaf_map(params))
+        metrics.WEIGHT_VERIFY_SECONDS.observe(
+            time.perf_counter() - start
+        )
+        return mismatch
+
+    def next_path(self) -> Optional[str]:
+        if not self._order:
+            return None
+        return self._order[self._cursor % len(self._order)]
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "leaves": len(self._order),
+            "sweeps_completed": self.sweeps_completed,
+            "leaves_verified": self.leaves_verified,
+            "mismatches": self.mismatches,
+        }
+
+
+# --------------------------------------------------------- per-core glue
+
+# sentinel kinds that are SOFT evidence: a model-behavior artifact
+# (degenerate repetition, bias-constrained sampling) is far more likely
+# than hardware corruption, so the engine fails only the attributed
+# sequence instead of reloading weights for the whole replica
+SOFT_SENTINELS = frozenset({"entropy_collapse"})
+
+
+def _attribution(trips) -> List[Dict[str, Any]]:
+    return [
+        {
+            "kind": kind,
+            "seq_id": seq.seq_id,
+            "request_id": seq.request_id,
+            # the poison-streak quarantine keys on this: a request that
+            # deterministically trips sentinels (NaN-overflowing prompt)
+            # must be containable, or it drives a reload loop
+            "fingerprint": faults.fingerprint(
+                seq.prompt_ids[: seq.orig_prompt_len]
+            ),
+        }
+        for kind, seq in trips
+    ]
+
+
+class EngineIntegrity:
+    """One EngineCore's integrity state: sentinel scanner + weight
+    verifier + the weight_corrupt fault hook.  Constructed only when
+    ``integrity.enabled`` — a None attribute keeps the disabled path
+    byte-identical to the pre-integrity engine."""
+
+    def __init__(self, cfg, vocab_size: int) -> None:
+        self.cfg = cfg
+        self.sentinels = (
+            SentinelScanner(cfg, vocab_size)
+            if cfg.sentinels_enabled
+            else None
+        )
+        self.verifier = WeightVerifier(cfg) if cfg.sweep_enabled else None
+
+    @property
+    def guard_enabled(self) -> bool:
+        """Fold the on-device logit guard into the decode chunk?"""
+        return bool(
+            self.sentinels is not None and self.cfg.logit_guard
+        )
+
+    def record_baseline(self, params: Any) -> None:
+        if self.verifier is None:
+            return
+        summary = self.verifier.record(params)
+        logger.info(
+            "weight checksum baseline recorded",
+            extra={"extra_data": summary},
+        )
+
+    def scan_decode(
+        self, sampled, flags, seq_rows, chunk
+    ) -> List[tuple]:
+        """Sentinel scan over one chunk readback.  HARD trips (logit
+        flags, out-of-vocab tokens — strong corruption evidence) raise
+        IntegrityError so the whole chunk is discarded and the engine
+        fatals corrupt; SOFT trips (entropy collapse — far more likely
+        a model-behavior artifact than hardware) are returned as
+        ``[(kind, seq, exc)]`` for the engine to fail per-sequence
+        without touching the replica.  Empty list when clean or
+        disabled."""
+        if self.sentinels is None:
+            return []
+        trips = self.sentinels.scan_decode(sampled, flags, seq_rows, chunk)
+        if not trips:
+            return []
+        hard = [t for t in trips if t[0] not in SOFT_SENTINELS]
+        soft = [t for t in trips if t[0] in SOFT_SENTINELS]
+        if hard:
+            kinds = sorted({kind for kind, _ in hard})
+            raise IntegrityError(
+                "output sentinel tripped "
+                f"({', '.join(kinds)}) on {len(hard)} sequence(s); "
+                "discarding the poisoned chunk and reloading weights",
+                kind=kinds[0],
+                sequences=_attribution(hard),
+            )
+        return [
+            (
+                kind,
+                seq,
+                IntegrityError(
+                    f"output sentinel tripped ({kind}) on this "
+                    "sequence; its generation was stopped (the engine "
+                    "and its weights are not suspected)",
+                    kind=kind,
+                    sequences=_attribution([(kind, seq)]),
+                ),
+            )
+            for kind, seq in soft
+        ]
+
+    def maybe_inject_weight_fault(self, core: Any) -> None:
+        """``weight_corrupt`` probe point (corrupt mode): when armed and
+        it fires, XOR-corrupt the sweep's next-to-verify leaf ON DEVICE
+        — a true silent corruption the checksum sweep then detects.
+        Raise-mode specs at the same point fire through faults.check
+        (classified by their armed kind, e.g. kind=corrupt drills the
+        classification path without touching weights)."""
+        if not faults.is_active():
+            return
+        faults.check("weight_corrupt")
+        if self.verifier is None or not faults.take_corrupt(
+            "weight_corrupt"
+        ):
+            return
+        target = self.verifier.next_path()
+        if target is None:
+            return
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(
+            core.params
+        )
+        rebuilt = [
+            _bitflip_leaf(x) if _path_str(p) == target else x
+            for p, x in leaves
+        ]
+        core.params = jax.tree_util.tree_unflatten(
+            treedef, rebuilt
+        )
+        logger.error(
+            "weight_corrupt fault injected: flipped bits in one "
+            "weight shard on device",
+            extra={"extra_data": {"leaf": target}},
+        )
+
+    def idle_tick(self, core: Any) -> None:
+        """Budgeted idle-tick sweep step.  Raises IntegrityError on a
+        checksum mismatch; the engine loop's containment then routes it
+        to the supervisor/dp repair as a ``corrupt`` fatal."""
+        self.maybe_inject_weight_fault(core)
+        if self.verifier is None:
+            return
+        mismatch = self.verifier.verify_chunk(core.params)
+        if mismatch is None:
+            return
+        raise IntegrityError(
+            "weight checksum sweep detected silent corruption in "
+            f"shard {mismatch['leaf']!r} (expected "
+            f"{mismatch['expected']:#010x}, got "
+            + (
+                f"{mismatch['got']:#010x}"
+                if mismatch["got"] is not None
+                else "a missing leaf"
+            )
+            + "); weights must be reloaded",
+            kind="checksum_mismatch",
+            detail=mismatch,
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"enabled": True}
+        if self.sentinels is not None:
+            out["sentinel_trips"] = dict(self.sentinels.trips)
+        if self.verifier is not None:
+            out["sweep"] = self.verifier.stats()
+        return out
+
+
+# --------------------------------------------------------------- canary
+
+
+def canary_prompt_ids(vocab_size: int, length: int) -> List[int]:
+    """The pinned canary prompt: deterministic, model-agnostic token
+    ids spread across the vocabulary (never depends on a tokenizer
+    being present)."""
+    v = max(2, int(vocab_size))
+    return [(i * 17 + 11) % v for i in range(max(1, length))]
+
+
+def canary_fingerprint(token_ids: List[int]) -> str:
+    import hashlib
+
+    data = ",".join(str(int(t)) for t in token_ids).encode()
+    return hashlib.sha1(data).hexdigest()[:16]
+
+
+class CanaryKeeper:
+    """Pinned greedy self-probe with a recorded output fingerprint.
+    The FIRST probe against a presumed-good core records; every later
+    probe verifies.  Shared across dp replicas (identical weights +
+    greedy decode ⇒ identical fingerprint), owned by the supervisor for
+    dp=1."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self.expected: Optional[str] = None
+        self.passes = 0
+        self.failures = 0
+        self.last: Optional[Dict[str, Any]] = None
+
+    def _run(self, core: Any) -> List[int]:
+        # imported here: integrity must stay importable without the
+        # runtime package (errors.py-style layering for tests)
+        from vgate_tpu.backends.base import SamplingParams
+        from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+
+        cfg = self.cfg
+        ids = canary_prompt_ids(
+            core.spec.vocab_size, cfg.canary_prompt_len
+        )
+        params = SamplingParams(
+            temperature=0.0, max_tokens=cfg.canary_max_tokens
+        )
+        seq = Sequence(prompt_ids=ids, params=params, canary=True)
+        # compile-aware deadline (the stall watchdog's compile_grace_s
+        # lesson): the canary is often the FIRST work on a fresh core
+        # (post-reload, add_replica), so its prefill/decode programs
+        # compile inside the probe — minutes on real Mosaic.  Judging
+        # that against the steady-state timeout would quarantine a
+        # healthy replica and burn the restart budget on reload loops.
+        timeout = cfg.canary_timeout_s
+        if getattr(core, "total_steps", 1) == 0:
+            timeout += cfg.canary_compile_grace_s
+        core.submit_existing(seq)
+        if not seq.done_event.wait(timeout=timeout):
+            seq.request_abort(reason="drain")
+            raise TimeoutError(
+                f"canary self-probe timed out after {timeout}s"
+            )
+        if seq.status is SeqStatus.FAILED:
+            raise RuntimeError(
+                f"canary self-probe failed: {seq.error}"
+            ) from seq.error
+        return list(seq.generated_ids)
+
+    def check(self, core: Any, context: str = "probe") -> Dict[str, Any]:
+        """Run the probe; returns ``{"ok": bool, "recorded": bool,
+        ...}``.  ``ok`` is False only on a *fingerprint mismatch or
+        probe error* — the recording run reports ok=True/recorded=True.
+        Never raises; errors count as failures (a core that cannot
+        answer its canary is not servable)."""
+        start = time.perf_counter()
+        result: Dict[str, Any] = {
+            "context": context,
+            "time": time.time(),
+        }
+        try:
+            out = self._run(core)
+        except Exception as exc:
+            self.failures += 1
+            metrics.CANARY_FAILURES.inc()
+            metrics.INTEGRITY_EVENTS.labels(kind="canary_fail").inc()
+            result.update(
+                ok=False, recorded=False,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            self.last = result
+            return result
+        fp = canary_fingerprint(out)
+        result["fingerprint"] = fp
+        result["tokens"] = len(out)
+        result["latency_s"] = round(time.perf_counter() - start, 4)
+        if self.expected is None:
+            self.expected = fp
+            result.update(ok=True, recorded=True)
+            metrics.INTEGRITY_EVENTS.labels(kind="canary_pass").inc()
+            logger.info(
+                "canary fingerprint recorded",
+                extra={"extra_data": result},
+            )
+        elif fp == self.expected:
+            self.passes += 1
+            result.update(ok=True, recorded=False)
+            metrics.INTEGRITY_EVENTS.labels(kind="canary_pass").inc()
+        else:
+            self.failures += 1
+            metrics.CANARY_FAILURES.inc()
+            metrics.INTEGRITY_EVENTS.labels(kind="canary_fail").inc()
+            result.update(
+                ok=False, recorded=False, expected=self.expected
+            )
+            logger.error(
+                "canary self-probe FINGERPRINT MISMATCH — replica "
+                "output is corrupt",
+                extra={"extra_data": result},
+            )
+        self.last = result
+        return result
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "expected": self.expected,
+            "passes": self.passes,
+            "failures": self.failures,
+            "last": self.last,
+        }

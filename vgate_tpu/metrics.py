@@ -256,7 +256,7 @@ MIGRATIONS = _safe_metric(
     "vgt_migrations",
     "In-flight sequences moved between dp replicas by PLANNED "
     "migration (checkpoint + replay without a crash), by reason",
-    labelnames=("reason",),  # drain | rebalance | scale_down
+    labelnames=("reason",),  # drain | rebalance | scale_down | corrupt
 )
 MIGRATION_SECONDS = _safe_metric(
     Histogram,
@@ -345,6 +345,52 @@ PRESSURE_TRANSITIONS = _safe_metric(
     "vgt_pressure_transitions",
     "Brownout level transitions by direction",
     labelnames=("direction",),  # up | down
+)
+
+# --- silent-corruption defense (vgate_tpu/integrity.py) ---
+INTEGRITY_EVENTS = _safe_metric(
+    Counter,
+    "vgt_integrity_events",
+    "Silent-corruption defense events by kind: output-sentinel trips "
+    "(logit_nonfinite | logit_zero | logit_saturated | token_range | "
+    "entropy_collapse), weight checksum_mismatch, canary_pass / "
+    "canary_fail self-probes, and corrupt_reload / "
+    "rebuild_verify_failed recovery actions",
+    labelnames=("kind",),
+)
+WEIGHT_VERIFY_SECONDS = _safe_metric(
+    Histogram,
+    "vgt_weight_verify_seconds",
+    "Wall time of one weight-checksum operation (baseline record, "
+    "budgeted idle-sweep slice, or full rebuild-time verification)",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+)
+WEIGHT_LEAVES_VERIFIED = _safe_metric(
+    Counter,
+    "vgt_weight_leaves_verified",
+    "Weight-tree leaves whose checksum was re-verified against the "
+    "load-time baseline (idle sweep + rebuild verification)",
+)
+CANARY_FAILURES = _safe_metric(
+    Counter,
+    "vgt_canary_failures",
+    "Canary self-probes that failed (fingerprint mismatch, probe "
+    "error, or timeout) — a failing canary quarantines the replica "
+    "and triggers a weight reload",
+)
+CORRUPT_QUARANTINED = _safe_metric(
+    Gauge,
+    "vgt_replicas_quarantined_corrupt",
+    "Replicas currently quarantined for suspected silent corruption "
+    "(excluded from routing/placement until their post-reload canary "
+    "passes)",
+)
+CORRUPT_RELOADS = _safe_metric(
+    Counter,
+    "vgt_corrupt_reloads",
+    "Engine rebuilds that RELOADED weights from the checkpoint "
+    "because the fatal was classified corrupt (vs the weights-kept "
+    "restart path)",
 )
 
 # --- cross-request KV prefix cache (runtime/radix_cache.py + kv_cache.py) ---

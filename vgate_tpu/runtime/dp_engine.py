@@ -41,10 +41,12 @@ from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.errors import (
     EngineRecoveringError,
     EngineStalledError,
+    IntegrityError,
     MigrationError,
     MigrationRefusedError,
     PoisonRequestError,
 )
+from vgate_tpu.integrity import CanaryKeeper
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.engine_core import (
     EngineCore,
@@ -56,6 +58,7 @@ from vgate_tpu.runtime.supervisor import (
     HealthState,
     classify_fatal,
     classify_heartbeat,
+    restart_budget_remaining,
 )
 
 logger = get_logger(__name__)
@@ -308,6 +311,34 @@ class ReplicatedEngine:
         # redistribution AND rejected at submission, so one
         # crash-inducing request cannot serially kill healthy replicas
         self._quarantine: set = set()
+        # repeat-offender streaks for sentinel-ATTRIBUTED corrupt
+        # fatals (fingerprint -> consecutive trips); see
+        # _update_quarantine — the dp twin of the supervisor's
+        # transient streak, scoped to attributed sequences only
+        self._corrupt_streaks: Dict[str, int] = {}
+        # ---- silent-corruption defense (vgate_tpu/integrity.py) ----
+        # replica indices quarantined for suspected corruption: routed
+        # around (like draining), excluded as failover/migration
+        # targets, and unquarantined only by a post-reload canary pass.
+        # Renumbered with the fleet (remove_replica), like _draining.
+        self._integrity_cfg = self.config.integrity
+        self._corrupt: set = set()
+        # one pod-wide canary keeper: replicas share weights, so a
+        # greedy pinned probe has ONE correct fingerprint — recorded on
+        # the first probe, verified everywhere after
+        self._canary: Optional[CanaryKeeper] = (
+            CanaryKeeper(self._integrity_cfg)
+            if self._integrity_cfg.enabled
+            and self._integrity_cfg.canary_enabled
+            else None
+        )
+        # slow-timer probe schedule, replica-index keyed; probes run
+        # off the repair thread, at most one in flight fleet-wide
+        self._next_canary: Dict[int, float] = {}
+        self._canary_probe: Optional[threading.Thread] = None
+        self.total_corrupt_reloads = 0
+        self.total_canary_failures = 0
+        self.last_integrity: Optional[Dict[str, Any]] = None
         self.total_failovers = 0
         self.total_restarts = 0
         self.total_stalls = 0
@@ -343,6 +374,16 @@ class ReplicatedEngine:
     def start(self) -> None:
         for core in self.replicas:
             core.start()
+        if (
+            self._canary is not None
+            and self._integrity_cfg.canary_record_on_start
+            and self._canary.expected is None
+        ):
+            # one boot-time baseline for the fleet (replicas share
+            # weights, greedy ⇒ one correct fingerprint): every later
+            # gate VERIFIES instead of re-recording — see the dp=1
+            # supervisor's twin for why
+            self._canary.check(self.replicas[0], context="boot")
         if self._failover_enabled and self._repair_thread is None:
             self._repair_thread = threading.Thread(
                 target=self._repair_loop,
@@ -412,6 +453,13 @@ class ReplicatedEngine:
                 self._sweep()
             except Exception:  # pragma: no cover - defensive
                 logger.error("dp repair sweep failed", exc_info=True)
+            try:
+                # slow-timer canary probes run OUTSIDE the sweep's
+                # topology lock: a greedy probe takes real decode time
+                # and must not block structural ops or stall detection
+                self._maybe_canaries()
+            except Exception:  # pragma: no cover - defensive
+                logger.error("dp canary pass failed", exc_info=True)
 
     def _sweep(self) -> None:
         """One repair pass: declare stalled replicas (hang watchdog,
@@ -475,6 +523,16 @@ class ReplicatedEngine:
             # while the rebuild happens), then rebuild when the
             # backoff comes due
             self._update_quarantine(core)
+            if (
+                self._integrity_cfg.enabled
+                and i not in self._corrupt
+                and classify_fatal(core._fatal) == "corrupt"
+            ):
+                # corrupt-classified fatal (sentinel trip / checksum
+                # mismatch / canary failure): quarantine the index —
+                # routed around, never a failover/migration target —
+                # until the post-reload canary passes in _do_rebuild
+                self._mark_corrupt(i, core._fatal)
             pending = core.take_checkpointed()
             self.total_lost += core.take_resume_losses()
             if pending:
@@ -492,10 +550,51 @@ class ReplicatedEngine:
         """Quarantine what a poison-classified replica fatal implicates
         (idempotent per fatal — the fingerprint set dedupes): the named
         victim when the fault carries one, every resident otherwise —
-        the dp=1 supervisor's poison path, minus the repeat-offender
-        streak (max_resume_attempts bounds automatic replays here)."""
+        the dp=1 supervisor's poison path, minus the general transient
+        repeat-offender streak (max_resume_attempts bounds automatic
+        replays here).  Sentinel-ATTRIBUTED corrupt fatals do run a
+        streak (the supervisor's twin): a prompt that deterministically
+        NaN-overflows would otherwise corrupt-reload its way through
+        every replica — sentinel trip → reload → failover replay /
+        client retry → trip again — burning the shared restart budget
+        with no containment."""
         exc = core._fatal
-        if exc is None or classify_fatal(exc) != "poison":
+        if exc is None:
+            return
+        kind = classify_fatal(exc)
+        if kind == "corrupt":
+            attributed = {
+                s.get("fingerprint")
+                for s in getattr(exc, "sequences", ())
+                if s.get("fingerprint")
+            }
+            threshold = self._recovery.poison_threshold
+            new_streaks: Dict[str, int] = {}
+            for fp, resume_count in core._fatal_suspects:
+                if fp not in attributed:
+                    continue
+                # replays keep their streak; only fresh submissions
+                # (resume_count == 0: the client re-sending the prompt)
+                # advance it — the supervisor's transient-streak rule
+                count = self._corrupt_streaks.get(fp, 0) + (
+                    1 if resume_count == 0 else 0
+                )
+                if count >= threshold:
+                    if fp not in self._quarantine:
+                        self._quarantine.add(fp)
+                        metrics.QUARANTINED_REQUESTS.inc()
+                        logger.error(
+                            "request quarantined: repeatedly attributed "
+                            "by corrupt-sentinel trips",
+                            extra={"extra_data": {
+                                "fingerprint": fp, "trips": count,
+                            }},
+                        )
+                elif count > 0:
+                    new_streaks[fp] = count
+            self._corrupt_streaks = new_streaks
+            return
+        if kind != "poison":
             return
         named = getattr(exc, "fingerprint", None)
         suspects = (
@@ -535,7 +634,12 @@ class ReplicatedEngine:
             with self._topology_lock:
                 eligible = [
                     (j, c) for j, c in enumerate(self.replicas)
-                    if self._alive(c) and c is not dead_core
+                    if self._alive(c)
+                    and c is not dead_core
+                    # a corrupt-quarantined replica must never receive
+                    # failover work — its outputs are suspect until the
+                    # post-reload canary passes
+                    and j not in self._corrupt
                 ]
                 draining = set(self._draining)
             # the no-new-placements drain invariant first; but when
@@ -646,15 +750,56 @@ class ReplicatedEngine:
     def _do_rebuild(
         self, idx: int, old: EngineCore, devices: list
     ) -> None:
+        # reload-on-corrupt: a corrupt-classified fatal must not keep
+        # the old tree (the corruption would survive the rebuild); a
+        # kept tree is checksum-verified inside rebuild_core and a
+        # mismatch escalates this rebuild to a reload too
+        reload_weights = (
+            self._integrity_cfg.enabled
+            and old._fatal is not None
+            and classify_fatal(old._fatal) == "corrupt"
+        )
         try:
             try:
                 # shared teardown/rebuild sequence (engine_core.
                 # rebuild_core): stop, free the dead incarnation's
                 # device KV pool before the new one sizes, weights
-                # kept, brownout spec-suspension carried over
+                # kept (verified) or reloaded, brownout
+                # spec-suspension carried over
                 new_core = rebuild_core(
-                    old, self._replica_cfg, devices
+                    old, self._replica_cfg, devices,
+                    reload_weights=reload_weights,
                 )
+            except IntegrityError:
+                logger.error(
+                    "dp replica kept-weights rebuild failed checksum "
+                    "verification; escalating to weight reload",
+                    extra={"extra_data": {"replica": idx}},
+                    exc_info=True,
+                )
+                with self._topology_lock:
+                    try:
+                        slot = self.replicas.index(old)
+                    except ValueError:
+                        slot = -1
+                    if slot >= 0 and slot not in self._corrupt:
+                        self._mark_corrupt(slot, old._fatal)
+                try:
+                    new_core = rebuild_core(
+                        old, self._replica_cfg, devices,
+                        reload_weights=True,
+                    )
+                except Exception:
+                    logger.error(
+                        "dp replica reload rebuild failed",
+                        extra={"extra_data": {"replica": idx}},
+                        exc_info=True,
+                    )
+                    self._next_attempt[id(old)] = (
+                        time.monotonic() + self._backoff()
+                    )
+                    return
+                reload_weights = True
             except Exception:
                 logger.error(
                     "dp replica rebuild attempt failed",
@@ -688,14 +833,220 @@ class ReplicatedEngine:
                 return
             self.total_restarts += 1
             metrics.ENGINE_RESTARTS.inc()
+            if reload_weights:
+                # counted per reload REBUILD (not per canary verdict)
+                # so /stats integrity.corrupt_reloads tracks the
+                # vgt_corrupt_reloads Prometheus counter exactly
+                self.total_corrupt_reloads += 1
+            if slot in self._corrupt:
+                # quarantined rebuild: the replica rejoins the
+                # placement rotation ONLY after its canary matches the
+                # recorded fingerprint.  A failing canary declares a
+                # fresh corrupt fatal on the new incarnation — the
+                # sweep then schedules another reload under the shared
+                # restart budget, and the quarantine holds meanwhile.
+                if self._canary is None:
+                    self._clear_corrupt(slot, reason="no_canary")
+                else:
+                    result = self._canary.check(
+                        new_core, context=f"reload:replica{slot}"
+                    )
+                    self.last_integrity = dict(
+                        self.last_integrity or {}, canary=result
+                    )
+                    if result["ok"]:
+                        self._clear_corrupt(slot, reason="canary_pass")
+                    else:
+                        self.total_canary_failures += 1
+                        logger.error(
+                            "dp replica post-reload canary FAILED; "
+                            "replica stays quarantined and reloads "
+                            "again",
+                            extra={"extra_data": {
+                                "replica": slot, **result,
+                            }},
+                        )
+                        new_core.declare_stalled(
+                            IntegrityError(
+                                "post-reload canary failed: "
+                                + str(
+                                    result.get("error")
+                                    or "fingerprint mismatch"
+                                ),
+                                kind="canary",
+                            )
+                        )
             logger.warning(
                 "dp replica rebuilt",
-                extra={"extra_data": {"replica": slot}},
+                extra={"extra_data": {
+                    "replica": slot,
+                    **(
+                        {"weights_reloaded": True}
+                        if reload_weights
+                        else {}
+                    ),
+                }},
             )
         finally:
             self._rebuilding.discard(id(old))
             self._rebuild_threads.pop(id(old), None)
             self._repair_event.set()  # re-sweep with the fresh state
+
+    # ------------------------------- silent-corruption defense helpers
+
+    def _mark_corrupt(self, idx: int, exc: Optional[BaseException]) -> None:
+        """Quarantine replica ``idx`` as suspected-corrupt: no routing,
+        no failover/migration placements, auto-repair reloads weights.
+        Callers hold (or are inside) the topology lock OR pass an index
+        they just resolved under it."""
+        self._corrupt.add(idx)
+        metrics.CORRUPT_QUARANTINED.set(len(self._corrupt))
+        self.last_integrity = {
+            "replica": idx,
+            "cause": (
+                f"{type(exc).__name__}: {exc}" if exc is not None else None
+            ),
+            "kind": getattr(exc, "integrity_kind", "unknown"),
+            "time": time.time(),
+        }
+        logger.error(
+            "dp replica quarantined for suspected silent corruption",
+            extra={"extra_data": self.last_integrity},
+        )
+
+    def _clear_corrupt(self, idx: int, reason: str) -> None:
+        self._corrupt.discard(idx)
+        metrics.CORRUPT_QUARANTINED.set(len(self._corrupt))
+        logger.warning(
+            "dp replica corruption quarantine lifted",
+            extra={"extra_data": {"replica": idx, "reason": reason}},
+        )
+
+    def _maybe_canaries(self) -> None:
+        """Slow-timer canary pass (integrity.canary_interval_s > 0):
+        probe each healthy in-rotation replica on its own schedule.  A
+        failing probe quarantines the replica, live-migrates its
+        residents OFF (the planned-evacuation path — suspect cores
+        never get replays), and declares the corrupt fatal so the
+        repair loop reloads its weights."""
+        interval = self._integrity_cfg.canary_interval_s
+        if self._canary is None or interval <= 0 or self._stopping:
+            return
+        if self._canary_probe is not None and self._canary_probe.is_alive():
+            return  # at most one probe in flight fleet-wide
+        now = time.monotonic()
+        with self._topology_lock:
+            candidates = [
+                (i, c) for i, c in enumerate(self.replicas)
+                if self._alive(c)
+                and i not in self._draining
+                and i not in self._corrupt
+                and id(c) not in self._rebuilding
+            ]
+        for i, core in candidates:
+            due = self._next_canary.get(i)
+            if due is None:
+                # stagger first probes one interval out (boot is
+                # already covered by the record-on-first-probe rule)
+                self._next_canary[i] = now + interval
+                continue
+            if now < due:
+                continue
+            try:
+                if core.scheduler.has_work():
+                    # busy replica: the sentinels already watch its
+                    # every readback, and a probe queued behind live
+                    # load would time out and read as corruption —
+                    # re-probe at the next interval
+                    self._next_canary[i] = now + interval
+                    continue
+            except Exception:  # pragma: no cover - mid-rebuild
+                continue
+            self._next_canary[i] = now + interval
+            # OFF the repair thread: a probe blocked on a wedged core
+            # must not suspend fleet-wide stall detection / rebuild
+            # scheduling (the watchdog's job is noticing that wedge)
+            self._canary_probe = threading.Thread(
+                target=self._run_timer_canary,
+                args=(i, core),
+                name=f"vgt-dp-canary-{i}",
+                daemon=True,
+            )
+            self._canary_probe.start()
+            break
+
+    def _run_timer_canary(self, idx: int, core: EngineCore) -> None:
+        result = self._canary.check(core, context=f"timer:replica{idx}")
+        if result["ok"]:
+            return
+        self.total_canary_failures += 1
+        self._quarantine_corrupt_live(core, result)
+
+    def _quarantine_corrupt_live(
+        self, core: EngineCore, result: Dict[str, Any]
+    ) -> None:
+        """A LIVE replica failed its canary: quarantine by identity
+        (indices may have shifted since the caller snapshotted),
+        evacuate its residents via the planned-migration path onto
+        healthy siblings, then declare the corrupt fatal for a
+        reload rebuild."""
+        exc = IntegrityError(
+            "canary self-probe failed on a live dp replica: "
+            + str(result.get("error") or "fingerprint mismatch"),
+            kind="canary",
+            detail={k: v for k, v in result.items() if k != "ok"},
+        )
+        with self._topology_lock:
+            try:
+                slot = self.replicas.index(core)
+            except ValueError:
+                return  # removed while we probed
+            self._mark_corrupt(slot, exc)
+        # PR-8 evacuation path: residents migrate OFF the suspect core
+        # as planned movements — never replayed back onto it
+        try:
+            seqs, kind = self._evacuate_all(core, "corrupt")
+        except MigrationError:
+            seqs, kind = [], "migrate"  # stuck evacuation: containment
+            # will checkpoint the residents when the fatal lands below
+        if seqs:
+            with self._topology_lock:
+                targets = [
+                    c for j, c in enumerate(self.replicas)
+                    if c is not core
+                    and self._alive(c)
+                    and j not in self._draining
+                    and j not in self._corrupt
+                ]
+                if not targets:
+                    # every clean survivor is draining: placing onto an
+                    # alive DRAINING sibling beats 503ing the work (the
+                    # file-wide zero-loss-beats-drain-purity rule —
+                    # _redistribute and _fallback_targets do the same).
+                    # Still NEVER the corrupt source or siblings.
+                    targets = [
+                        c for j, c in enumerate(self.replicas)
+                        if c is not core
+                        and self._alive(c)
+                        and j not in self._corrupt
+                    ]
+                    if targets:
+                        logger.warning(
+                            "corrupt-replica evacuation placing onto "
+                            "DRAINING replicas: no clean in-rotation "
+                            "survivor exists",
+                            extra={"extra_data": {"replica": slot}},
+                        )
+            moved, lost, _ = self._place(
+                seqs, targets, "corrupt", slot, kind=kind
+            )
+            logger.warning(
+                "evacuated residents off corrupt-quarantined replica",
+                extra={"extra_data": {
+                    "replica": slot, "moved": moved, "lost": lost,
+                }},
+            )
+        core.declare_stalled(exc)
 
     # ------------------------------------ planned migration / elastic dp
 
@@ -1000,6 +1351,22 @@ class ReplicatedEngine:
                     f"no replica {idx} (dp={len(self.replicas)})"
                 )
             was = idx in self._draining
+            core = self.replicas[idx]
+        canary = None
+        if self._canary is not None and was and self._alive(core):
+            # an undrained replica sat out of rotation (rolling deploy:
+            # possibly a whole new binary/weights under it) — prove it
+            # BEFORE it becomes routable: the probe runs while the
+            # draining mark still excludes the replica from placement,
+            # so corrupt output can never race real traffic.  A failure
+            # quarantines it (also rotation-excluding) and triggers the
+            # reload path; the undrain below then merely hands it from
+            # one exclusion to the other.
+            canary = self._canary.check(core, context=f"undrain:{idx}")
+            if not canary["ok"]:
+                self.total_canary_failures += 1
+                self._quarantine_corrupt_live(core, canary)
+        with self._topology_lock:
             self._draining.discard(idx)
             metrics.REPLICAS_DRAINING.set(len(self._draining))
         self._policy.reset()
@@ -1008,7 +1375,12 @@ class ReplicatedEngine:
             "dp replica undrained",
             extra={"extra_data": {"replica": idx, "was_draining": was}},
         )
-        return {"replica": idx, "draining": False, "was_draining": was}
+        out = {"replica": idx, "draining": False, "was_draining": was}
+        if canary is not None:
+            out["canary"] = {
+                k: canary[k] for k in ("ok", "recorded") if k in canary
+            }
+        return out
 
     @_structural
     def add_replica(self) -> Dict[str, Any]:
@@ -1035,6 +1407,14 @@ class ReplicatedEngine:
             with self._topology_lock:
                 self._free_slices.append(devices)
             raise
+        core.start()
+        canary = None
+        if self._canary is not None:
+            # a fresh replica (new load on a banked slice) must match
+            # the fleet's recorded fingerprint BEFORE it joins the
+            # fleet: the probe runs while the core is still unattached
+            # (unroutable), so an unproven replica never sees traffic
+            canary = self._canary.check(core, context="add")
         with self._topology_lock:
             idx = len(self.replicas)
             self.replicas.append(core)
@@ -1042,13 +1422,30 @@ class ReplicatedEngine:
             if self._failover_enabled:
                 self._attach(idx, core)
             metrics.DP_REPLICAS_TOTAL.set(len(self.replicas))
-        core.start()
+            if canary is not None and not canary["ok"]:
+                # attach quarantined: visible to the operator, excluded
+                # from routing, and the repair loop reloads it
+                self._mark_corrupt(idx, None)
+        if canary is not None and not canary["ok"]:
+            self.total_canary_failures += 1
+            core.declare_stalled(
+                IntegrityError(
+                    "add_replica canary failed: "
+                    + str(canary.get("error") or "fingerprint mismatch"),
+                    kind="canary",
+                )
+            )
         self._policy.reset()
         logger.warning(
             "dp replica added",
             extra={"extra_data": {"replica": idx, "dp": idx + 1}},
         )
-        return {"replica": idx, "dp": len(self.replicas)}
+        out = {"replica": idx, "dp": len(self.replicas)}
+        if canary is not None:
+            out["canary"] = {
+                k: canary[k] for k in ("ok", "recorded") if k in canary
+            }
+        return out
 
     @_structural
     def remove_replica(self, idx: int) -> Dict[str, Any]:
@@ -1086,6 +1483,19 @@ class ReplicatedEngine:
             # renumber the index-keyed draining marks above the gap
             self._draining = {
                 i - 1 if i > slot else i for i in self._draining
+            }
+            # corrupt quarantine and canary schedule are index-keyed
+            # too: renumber the same way (the removed replica's marks
+            # simply disappear with it)
+            self._corrupt.discard(slot)
+            self._corrupt = {
+                i - 1 if i > slot else i for i in self._corrupt
+            }
+            metrics.CORRUPT_QUARANTINED.set(len(self._corrupt))
+            self._next_canary = {
+                (i - 1 if i > slot else i): t
+                for i, t in self._next_canary.items()
+                if i != slot
             }
             self._next_attempt.pop(id(core), None)
             if self._failover_enabled:
@@ -1133,7 +1543,9 @@ class ReplicatedEngine:
             return None
         with self._topology_lock:
             reps = list(self.replicas)
-            draining = set(self._draining)
+            # corrupt-quarantined replicas neither shed nor receive
+            # rebalance moves
+            draining = set(self._draining) | set(self._corrupt)
         if len(reps) < 2:
             return None
         signals: Dict[int, Dict[str, Any]] = {}
@@ -1310,15 +1722,29 @@ class ReplicatedEngine:
         alive = sum(1 for c in self.replicas if self._alive(c))
         if alive == 0:
             return HealthState.DEAD
-        if alive < len(self.replicas) or self._draining:
+        if (
+            alive < len(self.replicas)
+            or self._draining
+            or self._corrupt
+        ):
             return HealthState.DEGRADED
         return HealthState.SERVING
 
     def _replica_state(
-        self, idx: int, core: EngineCore, draining: set, now: float
+        self,
+        idx: int,
+        core: EngineCore,
+        draining: set,
+        now: float,
+        corrupt: set = frozenset(),
     ) -> str:
-        # core + draining come from the caller's under-lock snapshot:
-        # a concurrent remove_replica must not shift indices under us
+        # core + draining + corrupt come from the caller's under-lock
+        # snapshot: a concurrent remove_replica renumber must not shift
+        # the index-keyed marks under this iteration
+        if idx in corrupt:
+            # suspected silent corruption: out of rotation (alive or
+            # mid-reload) until the post-reload canary passes
+            return "quarantined_corrupt"
         if idx in draining:
             # deliberately out of rotation (alive or not): auto-repair
             # is suspended until undrain, so "draining" is the truth
@@ -1347,11 +1773,14 @@ class ReplicatedEngine:
         with self._topology_lock:
             reps = list(self.replicas)
             draining = set(self._draining)
+            corrupt = set(self._corrupt)
         replicas = []
         for i, core in enumerate(reps):
             entry: Dict[str, Any] = {
                 "replica": i,
-                "state": self._replica_state(i, core, draining, now),
+                "state": self._replica_state(
+                    i, core, draining, now, corrupt
+                ),
             }
             fatal = core._fatal
             if fatal is not None:
@@ -1372,7 +1801,7 @@ class ReplicatedEngine:
         # ticks or fire VgtDpReplicaDown for a deliberate operation.
         alive = sum(1 for c in reps if self._alive(c))
         metrics.DP_REPLICAS_ALIVE.set(alive)
-        return {
+        out = {
             "state": state.value,
             "alive": state_is_alive(state.value),
             "ready": state_is_ready(state.value),
@@ -1383,12 +1812,30 @@ class ReplicatedEngine:
             "replicas": replicas,
             "failovers": self.total_failovers,
             "restarts": self.total_restarts,
+            # restart budget headroom (satellite fix; shared across
+            # the fleet — one sick pod, one budget)
+            "restarts_remaining": restart_budget_remaining(
+                self._restart_times, self._recovery, now
+            ),
             "stalls": self.total_stalls,
             "resumed": self.total_resumed,
             "migrated": self.total_migrated,
             "lost": self.total_lost,
             "quarantined": len(self._quarantine),
         }
+        if self._integrity_cfg.enabled:
+            out["integrity"] = {
+                "quarantined_corrupt": sorted(corrupt),
+                "corrupt_reloads": self.total_corrupt_reloads,
+                "canary_failures": self.total_canary_failures,
+                **(
+                    {"canary": self._canary.stats()}
+                    if self._canary is not None
+                    else {}
+                ),
+                "last": self.last_integrity,
+            }
+        return out
 
     @property
     def retry_after_s(self) -> float:
@@ -1428,7 +1875,10 @@ class ReplicatedEngine:
         with self._route_lock:
             with self._topology_lock:
                 reps = list(self.replicas)
-                draining = set(self._draining)
+                # corrupt-quarantined replicas route exactly like
+                # draining ones: alive, but taking nothing new until
+                # the post-reload canary clears them
+                draining = set(self._draining) | set(self._corrupt)
             offset = next(self._rr)
             n = len(reps)
             order = [(offset + i) % n for i in range(n)]
@@ -1633,6 +2083,17 @@ class ReplicatedEngine:
             "draining": sorted(self._draining),
             "free_slices": len(self._free_slices),
         }
+        if self._integrity_cfg.enabled:
+            agg["integrity"] = {
+                "quarantined_corrupt": sorted(self._corrupt),
+                "corrupt_reloads": self.total_corrupt_reloads,
+                "canary_failures": self.total_canary_failures,
+                **(
+                    {"canary": self._canary.stats()}
+                    if self._canary is not None
+                    else {}
+                ),
+            }
         agg["mesh"] = dict(per_replica[0]["mesh"], dp=len(self.replicas))
         agg["load_time_s"] = round(self.load_time_s, 2)
         agg["replicas"] = per_replica

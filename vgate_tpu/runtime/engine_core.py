@@ -39,11 +39,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from vgate_tpu import faults, metrics
+from vgate_tpu import faults, integrity, metrics
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.errors import (
     DeadlineExceededError,
     EngineRecoveringError,
+    IntegrityError,
     MigrationError,
     PoisonRequestError,
     ResumeExhaustedError,
@@ -211,7 +212,7 @@ def _decode_step(
     kept for single-step callers (e.g. __graft_entry__.dryrun_multichip)."""
     (
         chunk_tokens, _lp, _tokens, positions, counter, _steps, _counts,
-        k_pages, v_pages,
+        k_pages, v_pages, _flags,
     ) = _decode_chunk(
         params, spec, tokens, positions, k_pages, v_pages, page_tables,
         active, temps, top_ps, top_ks, base_key, counter,
@@ -223,7 +224,8 @@ def _decode_step(
 @functools.partial(
     jax.jit,
     static_argnames=("spec", "num_steps", "use_pallas", "max_position",
-                     "mesh", "num_logprobs", "all_greedy", "kv_carry"),
+                     "mesh", "num_logprobs", "all_greedy", "kv_carry",
+                     "guard", "guard_threshold"),
     donate_argnames=("k_pages", "v_pages", "counts"),
 )
 def _decode_chunk(
@@ -234,6 +236,7 @@ def _decode_chunk(
     counts=None, freq_pens=None, pres_pens=None,
     min_toks=None, stop_id_mat=None, all_greedy: bool = False,
     kv_carry: bool = False, bias_ids=None, bias_vals=None,
+    guard: bool = False, guard_threshold: float = 1.0e4,
 ):
     """``num_steps`` decode steps fused into one device program.
 
@@ -245,6 +248,13 @@ def _decode_chunk(
     pages the scheduler reserved for the horizon (harmless: the sequence is
     removed and its pages freed).  Returns ``chunk_tokens`` of shape
     ``[num_steps, B]`` plus the threaded device state.
+
+    ``guard`` (integrity.logit_guard) additionally computes a per-step
+    per-slot sentinel flag word over the RAW model logits — before
+    penalties/bias/min-token suppression, whose deliberate -inf writes
+    must not trip the NaN/Inf check — returned as ``[num_steps, B]``
+    uint8 (integrity.logit_guard flag bits).  Static, so the guard-off
+    program is byte-identical to the pre-integrity one.
     """
 
     if steps is None:
@@ -258,6 +268,8 @@ def _decode_chunk(
             active=active, use_pallas=use_pallas, mesh=mesh,
             kv_carry=kv_carry,
         )
+        if guard:
+            step_flags = integrity.logit_guard(logits, guard_threshold)
         if counts is not None:
             # frequency/presence penalties over the generated-token
             # histogram (ops/sampling.py apply_penalties)
@@ -280,6 +292,8 @@ def _decode_chunk(
                 steps=steps, all_greedy=all_greedy,
             )
             ys = (next_tokens,)
+        if guard:
+            ys = ys + (step_flags,)
         positions = positions + active.astype(positions.dtype)
         steps = steps + active.astype(steps.dtype)
         if counts is not None:
@@ -304,12 +318,17 @@ def _decode_chunk(
         length=num_steps,
     )
     tokens, positions, counter, steps, counts, k_pages, v_pages = carry
+    # [num_steps, B] uint8 sentinel words when guarded (host ORs the
+    # step axis at readback), None otherwise
+    chunk_flags = ys[-1] if guard else None
+    if guard:
+        ys = ys[:-1]
     chunk_tokens = ys[0]
     # ([steps, B], [steps, B, K], [steps, B, K]) when logprobs, else None
     chunk_lp = ys[1:] if num_logprobs > 0 else None
     return (
         chunk_tokens, chunk_lp, tokens, positions, counter, steps, counts,
-        k_pages, v_pages,
+        k_pages, v_pages, chunk_flags,
     )
 
 
@@ -430,6 +449,7 @@ def rebuild_core(
     old: "EngineCore",
     config: VGTConfig,
     devices: Optional[list],
+    reload_weights: bool = False,
 ) -> "EngineCore":
     """Tear a dead core down and construct its successor — the ONE
     rebuild sequence both the dp=1 supervisor and the dp repair thread
@@ -442,20 +462,60 @@ def rebuild_core(
     KEPT (the old tree is already quantized/sharded on these devices),
     and carries the brownout spec-suspension flag so a crash at level
     >= 3 cannot silently re-enable speculative decoding.  The caller
-    swaps it in, re-attaches on_fatal, and start()s it."""
+    swaps it in, re-attaches on_fatal, and start()s it.
+
+    Silent-corruption defense (vgate_tpu/integrity.py): a kept tree is
+    ALWAYS re-verified against its checksum baseline first — restarting
+    on a bit-flipped tree would preserve the corruption through every
+    incarnation — and a mismatch raises :class:`IntegrityError` so the
+    caller escalates to ``reload_weights=True``, which drops the old
+    tree and reloads from the checkpoint (the ``corrupt``-classified
+    fatal path)."""
     old.stop()
     old.k_pages = None
     old.v_pages = None
     old._dec_state = None
     old._pending_chunks.clear()
     old._spec_pen = None
-    new_core = EngineCore(
-        config,
-        spec=old.spec,
-        params=old.params,
-        devices=devices,
-        params_ready=True,
-    )
+    old_integrity = getattr(old, "integrity", None)
+    if (
+        not reload_weights
+        and old_integrity is not None
+        and old_integrity.verifier is not None
+        and old.params is not None
+    ):
+        mismatch = old_integrity.verifier.verify_all(old.params)
+        if mismatch is not None:
+            metrics.INTEGRITY_EVENTS.labels(
+                kind="rebuild_verify_failed"
+            ).inc()
+            raise IntegrityError(
+                "kept-weights rebuild verification failed: shard "
+                f"{mismatch['leaf']!r} no longer matches its load-time "
+                "checksum; escalate to a weight reload",
+                kind="checksum_mismatch",
+                detail=mismatch,
+            )
+    if reload_weights:
+        # free the suspect tree BEFORE the reload materializes a fresh
+        # one — two full trees would OOM the chip
+        old.params = None
+        metrics.CORRUPT_RELOADS.inc()
+        metrics.INTEGRITY_EVENTS.labels(kind="corrupt_reload").inc()
+        logger.warning(
+            "rebuilding engine with a FULL WEIGHT RELOAD "
+            "(corrupt-classified fatal; weights-kept would preserve "
+            "the corruption)"
+        )
+        new_core = EngineCore(config, spec=old.spec, devices=devices)
+    else:
+        new_core = EngineCore(
+            config,
+            spec=old.spec,
+            params=old.params,
+            devices=devices,
+            params_ready=True,
+        )
     new_core.spec_suspended = bool(
         getattr(old, "spec_suspended", False)
     )
@@ -620,6 +680,7 @@ class EngineCore:
                         self.spec,
                         self.config.model.checkpoint_path,
                         self.dtype,
+                        log_digests=self.config.integrity.enabled,
                     )
                 params = quantize_decoder_params(
                     params, self.spec, bits=quant_bits
@@ -630,7 +691,8 @@ class EngineCore:
         else:
             if params is None:
                 params = load_or_init_params(
-                    self.spec, self.config.model.checkpoint_path, self.dtype
+                    self.spec, self.config.model.checkpoint_path, self.dtype,
+                    log_digests=self.config.integrity.enabled,
                 )
             self.params = shard_params(params, self.spec, self.mesh)
             if quant_bits:
@@ -641,6 +703,18 @@ class EngineCore:
                 )
         jax.block_until_ready(jax.tree.leaves(self.params)[0])
         self.load_time_s = time.perf_counter() - load_start
+        # silent-corruption defense (vgate_tpu/integrity.py): sentinel
+        # scanner + weight-checksum baseline over the FINAL serving tree
+        # (post-quantize/shard — the tree supervised rebuilds keep).
+        # None when disabled, keeping every probe site a single
+        # attribute check and the decode program byte-identical.
+        icfg = self.config.integrity
+        self.integrity: Optional[integrity.EngineIntegrity] = None
+        if icfg.enabled:
+            self.integrity = integrity.EngineIntegrity(
+                icfg, self.spec.vocab_size
+            )
+            self.integrity.record_baseline(self.params)
 
         params_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree.leaves(self.params)
@@ -1382,6 +1456,10 @@ class EngineCore:
                 s.resume_count,
             )
             for s in self.scheduler.running
+            # integrity canaries are the ENGINE's own probes: never
+            # poison suspects (quarantining the canary prompt would
+            # blind every future self-probe)
+            if not s.canary
         ]
         # sweep EVERY owed future: running, waiting, and anything still
         # sitting in the submit queue (a client blocked on one of those
@@ -1424,7 +1502,11 @@ class EngineCore:
                 except queue.Empty:
                     break
             for seq in doomed:
-                if checkpointing and not seq.abort_requested:
+                if (
+                    checkpointing
+                    and not seq.abort_requested
+                    and not seq.canary
+                ):
                     if seq.resume_count >= self._max_resume_attempts:
                         # replaying a request that has now ridden
                         # through max_resume_attempts restarts is more
@@ -1787,7 +1869,16 @@ class EngineCore:
                 self._process_chunks(drain=True)
                 self._decode_signature_cache = None
             worked = self._admit_and_prefill()
-            return self._tick_speculative() or worked
+            worked = self._tick_speculative() or worked
+            if (
+                self.integrity is not None
+                and not worked
+                and not self.scheduler.has_work()
+            ):
+                # idle-tick checksum sweep, speculative path (the
+                # non-spec twin below)
+                self.integrity.idle_tick(self)
+            return worked
         worked = self._admit_and_prefill()
 
         active = self._running_seqs()
@@ -1850,6 +1941,20 @@ class EngineCore:
         ):
             self._process_chunks(drain=not active)
             worked = True
+        if (
+            self.integrity is not None
+            and not worked
+            and not self._pending_chunks
+            and not self.scheduler.has_work()
+        ):
+            # idle tick: advance the budgeted weight-checksum sweep
+            # (integrity.sweep_leaves_per_tick small on-device
+            # reductions) — never on a tick that did decode/prefill
+            # work, so the sweep cannot steal serving latency.  A
+            # mismatch raises IntegrityError: containment routes it to
+            # the supervisor / dp repair as a `corrupt` fatal and the
+            # rebuild reloads weights instead of keeping them.
+            self.integrity.idle_tick(self)
         # re-tick immediately when processing just opened a slot for a
         # waiting prompt (otherwise the loop would nap 5ms before admitting)
         return (
@@ -2723,6 +2828,9 @@ class EngineCore:
         self._beat(
             "decode", compiling=fresh, chunk=chunk, batch=len(active)
         )
+        guard = (
+            self.integrity is not None and self.integrity.guard_enabled
+        )
         start = time.perf_counter()
         (
             chunk_tokens,
@@ -2734,6 +2842,7 @@ class EngineCore:
             state["counts"],
             self.k_pages,
             self.v_pages,
+            chunk_flags,
         ) = _decode_chunk(
             self.params,
             self.spec,
@@ -2764,6 +2873,10 @@ class EngineCore:
             kv_carry=self._kv_carry,
             bias_ids=state["bias_ids"],
             bias_vals=state["bias_vals"],
+            guard=guard,
+            guard_threshold=(
+                self.config.integrity.saturate_threshold if guard else 1.0e4
+            ),
         )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
@@ -2771,7 +2884,7 @@ class EngineCore:
         # readback is processed) must NOT receive the stale tokens
         self._pending_chunks.append(
             ([(s, s.preempt_count) for s in active], chunk, chunk_tokens,
-             start, chunk_lp)
+             start, chunk_lp, chunk_flags)
         )
 
     def _process_chunks(self, drain: bool = False) -> None:
@@ -2779,7 +2892,7 @@ class EngineCore:
         host state: append tokens in order, detect EOS/length stops, discard
         steps past a stop."""
         while self._pending_chunks:
-            seqs, chunk, tokens_dev, _start, lp_dev = (
+            seqs, chunk, tokens_dev, _start, lp_dev, flags_dev = (
                 self._pending_chunks.pop(0)
             )
             # observe only the host-blocking readback time (kind="decode"):
@@ -2795,6 +2908,44 @@ class EngineCore:
                 else tuple(np.asarray(a) for a in lp_dev)
             )
             block_s = time.perf_counter() - block_start
+            if self.integrity is not None and flags_dev is not None:
+                # the flags readback + fault hooks stay OUTSIDE the
+                # lock (np.asarray blocks on the device)
+                flags_np = np.bitwise_or.reduce(
+                    np.asarray(flags_dev), axis=0
+                )
+                faults.check("logit_corrupt")
+                flags_np = faults.corrupt_array(
+                    "logit_corrupt", flags_np
+                )
+            else:
+                flags_np = None
+            if self.integrity is not None:
+                # sentinel scan BEFORE any append/stream — a HARD trip
+                # discards this whole chunk (the entry is already
+                # popped; containment clears the rest) so no token
+                # sampled from corrupt logits ever reaches a client;
+                # SOFT trips (entropy collapse) fail only the
+                # attributed sequence, whose FAILED status then skips
+                # it in the append loop below.  Under _readback_lock
+                # like the append loop: the status/epoch snapshot and
+                # the fail/residency-release must not interleave with a
+                # cross-thread containment fold (watchdog, dp canary)
+                # or a sequence could be checkpointed for replay AND
+                # settled failed at once.
+                with self._readback_lock:
+                    live_rows = [
+                        (s, s.slot)
+                        for s, epoch in seqs
+                        if s.status is SeqStatus.RUNNING
+                        and s.preempt_count == epoch
+                    ]
+                    for _kind, seq, soft_exc in (
+                        self.integrity.scan_decode(
+                            sampled, flags_np, live_rows, chunk
+                        )
+                    ):
+                        self.scheduler.fail_sequence(seq, soft_exc)
             metrics.observe_with_exemplar(
                 metrics.ENGINE_STEP_TIME.labels(kind="decode"),
                 block_s,
@@ -3398,6 +3549,11 @@ class EngineCore:
                 axis: int(size) for axis, size in self.mesh.shape.items()
             },
             "load_time_s": round(self.load_time_s, 2),
+            **(
+                {"integrity": self.integrity.stats()}
+                if self.integrity is not None
+                else {}
+            ),
             **(
                 {
                     "speculative": {

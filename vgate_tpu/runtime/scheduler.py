@@ -34,7 +34,12 @@ logger = get_logger(__name__)
 def _rank(seq: "Sequence") -> int:
     """Priority-tier rank from the request's SamplingParams
     (vgate_tpu/admission.py: 0 interactive, 1 standard, 2 batch);
-    direct engine callers without the field schedule as standard."""
+    direct engine callers without the field schedule as standard.
+    Integrity canary self-probes rank ahead of every tier: a replica's
+    fitness check must not queue behind the very traffic it gates
+    (vgate_tpu/integrity.py CanaryKeeper)."""
+    if seq.canary:
+        return -1
     return getattr(seq.params, "priority", 1)
 
 
@@ -182,6 +187,11 @@ class Scheduler:
             len(self.waiting) >= self.max_queue_size
             and seq.resume_count == 0
             and seq.migrate_count == 0
+            # integrity canaries bypass too: a self-probe rejected by an
+            # overload gate would read as a corruption verdict and tear
+            # down a merely-busy replica (one tiny greedy probe cannot
+            # meaningfully deepen a 512-entry queue)
+            and not seq.canary
         ):
             # replayed sequences (resume_count > 0: checkpointed across
             # an engine restart / dp failover; migrate_count > 0:
@@ -202,9 +212,13 @@ class Scheduler:
             )
         if seq.deadline_t is not None:
             self._deadline_seen = True
-        if _rank(seq) != 1:
+        if _rank(seq) != 1 and not seq.canary:
             # sticky, like _deadline_seen: deployments without priority
-            # tiers keep the O(1) head-of-queue admission path
+            # tiers keep the O(1) head-of-queue admission path.  The
+            # engine's own canary probes (rank -1) don't flip it — one
+            # boot probe must not tax every client admission for the
+            # process lifetime; canaries only run on idle engines, so
+            # queue position is moot for them.
             self._priority_seen = True
         self.waiting.append(seq)
         metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
@@ -875,6 +889,18 @@ class Scheduler:
         metrics.CANCELLED_REQUESTS.labels(reason=seq.abort_reason).inc()
         self._event("abort", seq, reason=seq.abort_reason)
         seq.finish("abort")
+
+    def fail_sequence(self, seq: Sequence, exc: BaseException) -> None:
+        """Fail ONE sequence with a typed error, freeing its residency
+        this tick (slot + KV pages) — the integrity soft-sentinel path:
+        the sequence's own output is suspect (entropy collapse) but the
+        engine and its weights are not, so the replica keeps serving
+        everyone else."""
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        self._release_residency(seq)
+        self._event("integrity_fail", seq, error=type(exc).__name__)
+        seq.fail(exc)
 
     def shed(self, seq: Sequence, exc: DeadlineExceededError) -> None:
         """Deadline shed of a RUNNING sequence (the engine detected

@@ -90,6 +90,12 @@ class Sequence:
     # prefix against a bf16 pool (or vice versa) would splice two
     # numerically different streams mid-generation.
     kv_dtype: Optional[str] = None
+    # integrity canary self-probe (vgate_tpu/integrity.py): ranks ahead
+    # of client traffic at admission (a probe stuck behind a deep queue
+    # can't verify anything in time) and is NEVER checkpointed/replayed
+    # or counted as a poison suspect — a canary in flight across a
+    # crash is simply failed; its keeper re-probes the rebuilt core.
+    canary: bool = False
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:

@@ -53,12 +53,14 @@ from vgate_tpu.errors import (
     EngineDeadError,
     EngineRecoveringError,
     EngineStalledError,
+    IntegrityError,
     MigrationRefusedError,
     PoisonRequestError,
     raise_for_state,
     state_is_alive,
     state_is_ready,
 )
+from vgate_tpu.integrity import CanaryKeeper
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.engine_core import (
     EngineCore,
@@ -109,11 +111,28 @@ def classify_heartbeat(
     }
 
 
+def restart_budget_remaining(
+    restart_times: Seq[float], recovery: Any, now: Optional[float] = None
+) -> int:
+    """Restarts still available inside the sliding window — the ONE
+    formula behind the `restarts_remaining` field in the supervisor's
+    and the dp router's /health blocks (they must never diverge from
+    the budget the repair loops actually enforce)."""
+    now = time.monotonic() if now is None else now
+    in_window = sum(
+        1 for t in restart_times if now - t < recovery.restart_window_s
+    )
+    return max(0, recovery.max_restarts - in_window)
+
+
 def classify_fatal(exc: BaseException) -> str:
-    """transient | poison | unrecoverable.  Injected faults carry their
-    kind (faults.InjectedFault.fault_kind); real errors default to
-    transient — a restart is cheap relative to killing serving, and the
-    restart budget bounds misclassification."""
+    """transient | poison | unrecoverable | corrupt.  Injected faults
+    carry their kind (faults.InjectedFault.fault_kind), and
+    IntegrityError (sentinel trip / checksum mismatch / canary failure;
+    fault_kind = "corrupt") routes to the reload-on-corrupt rebuild —
+    a weights-kept restart would preserve the corruption.  Real errors
+    default to transient — a restart is cheap relative to killing
+    serving, and the restart budget bounds misclassification."""
     kind = getattr(exc, "fault_kind", None)
     if kind in faults.FAULT_KINDS:
         return kind
@@ -163,6 +182,34 @@ class EngineSupervisor:
         self.last_resume: Optional[Dict[str, Any]] = None
         self.transitions: List[tuple] = []
         self.last_fatal: Optional[str] = None
+        # silent-corruption defense (vgate_tpu/integrity.py): canary
+        # keeper (pinned greedy probe; first run records, later runs
+        # verify), reload accounting, and the quarantined_corrupt mark
+        # — True from a corrupt-classified fatal until the post-reload
+        # canary passes (readiness stays red the whole time: the state
+        # machine holds RECOVERING, so no traffic reaches the suspect
+        # core).
+        self._integrity_cfg = self.config.integrity
+        self._canary: Optional[CanaryKeeper] = (
+            CanaryKeeper(self._integrity_cfg)
+            if self._integrity_cfg.enabled
+            and self._integrity_cfg.canary_enabled
+            else None
+        )
+        self.quarantined_corrupt = False
+        self.total_corrupt_reloads = 0
+        self.total_canary_failures = 0
+        self.last_integrity: Optional[Dict[str, Any]] = None
+        self._next_canary_t = (
+            time.monotonic() + self._integrity_cfg.canary_interval_s
+            if self._canary is not None
+            and self._integrity_cfg.canary_interval_s > 0
+            else None
+        )
+        # timer probes run OFF the watcher thread (one at a time): a
+        # probe blocking on a wedged core must not suspend the stall
+        # watchdog, whose whole job is noticing that wedge
+        self._canary_probe: Optional[threading.Thread] = None
         # flight-recorder snapshot of the most recent crash (ticks +
         # in-flight requests at the moment of death) — logged on every
         # crash classification and surfaced via /stats engine.last_crash
@@ -177,6 +224,16 @@ class EngineSupervisor:
 
     def start(self) -> None:
         self.core.start()
+        if (
+            self._canary is not None
+            and self._integrity_cfg.canary_record_on_start
+            and self._canary.expected is None
+        ):
+            # baseline the fingerprint against the KNOWN-GOOD boot
+            # core (fresh from the checkpoint): every later gate then
+            # VERIFIES rather than re-records — without this a reload
+            # from a corrupt on-disk checkpoint would baseline garbage
+            self._canary.check(self.core, context="boot")
         if self._watcher is None:
             self._watcher = threading.Thread(
                 target=self._watch_loop, name="vgt-supervisor", daemon=True
@@ -286,6 +343,9 @@ class EngineSupervisor:
                 # so nothing would ever set the crash event — the
                 # monitor must declare the fault itself
                 self._check_stall()
+                # ... and as the slow-timer canary (integrity.
+                # canary_interval_s): wrong answers never raise either
+                self._maybe_canary()
                 continue
             self._crash_event.clear()
             if self.core._fatal is not None:
@@ -341,6 +401,59 @@ class EngineSupervisor:
             self.total_stalls += 1
             metrics.ENGINE_STALLS.inc()
 
+    def _maybe_canary(self) -> None:
+        """Slow-timer canary self-probe (integrity.canary_interval_s >
+        0): a pinned greedy prompt whose output fingerprint must match
+        the recorded one.  A mismatch is a silent-corruption fatal —
+        declared through the core's containment (like the stall
+        watchdog) so the standard path applies: checkpoint → reload →
+        canary → replay.  The probe itself runs on its own thread (a
+        probe blocked on a wedged core must not suspend the stall
+        watchdog) and only on an IDLE engine: under live traffic the
+        sentinels already watch every readback, and a probe queued
+        behind a loaded engine would time out and read as corruption."""
+        if self._next_canary_t is None or self._canary is None:
+            return
+        now = time.monotonic()
+        if now < self._next_canary_t:
+            return
+        if self._canary_probe is not None and self._canary_probe.is_alive():
+            return  # previous probe still in flight
+        self._next_canary_t = now + self._integrity_cfg.canary_interval_s
+        if self.state not in (HealthState.SERVING, HealthState.DEGRADED):
+            return
+        core = self.core
+        if core._fatal is not None or not core._running:
+            return
+        try:
+            if core.scheduler.has_work():
+                return  # busy: re-probe at the next interval
+        except Exception:  # pragma: no cover - mid-rebuild
+            return
+        self._canary_probe = threading.Thread(
+            target=self._run_timer_canary,
+            args=(core,),
+            name="vgt-canary",
+            daemon=True,
+        )
+        self._canary_probe.start()
+
+    def _run_timer_canary(self, core: EngineCore) -> None:
+        result = self._canary.check(core, context="timer")
+        self.last_integrity = {"canary": result}
+        if result["ok"]:
+            return
+        self.total_canary_failures += 1
+        exc = IntegrityError(
+            "slow-timer canary self-probe failed: "
+            + str(result.get("error") or "fingerprint mismatch"),
+            kind="canary",
+            detail={
+                k: v for k, v in result.items() if k != "ok"
+            },
+        )
+        core.declare_stalled(exc)
+
     def _fail_pending_resume(
         self, exc: BaseException, reason: str
     ) -> None:
@@ -370,6 +483,28 @@ class EngineSupervisor:
                         "request quarantined as engine poison",
                         extra={"extra_data": {"fingerprint": fp}},
                     )
+            return
+        if kind == "corrupt":
+            # checksum/canary corruption is the HARDWARE's fault, never
+            # the residents': counting those toward a poison streak
+            # would quarantine innocent traffic for a flipped bit.  But
+            # a SENTINEL trip names the sequences whose logit rows went
+            # bad — a prompt that deterministically overflows into NaN
+            # logits would otherwise drive an unbounded reload loop
+            # (sentinel → reload → client retries → sentinel ...), so
+            # the ATTRIBUTED fingerprints run the same repeat-offender
+            # streak as transient crashes below.
+            attributed = {
+                s.get("fingerprint")
+                for s in getattr(exc, "sequences", ())
+                if s.get("fingerprint")
+            }
+            if not attributed:
+                return
+            suspects = [
+                (fp, rc) for fp, rc in suspects if fp in attributed
+            ]
+        elif kind != "transient":
             return
         # transient path: count repeat offenders — a request FRESHLY
         # SUBMITTED into `poison_threshold` consecutive crashes is
@@ -466,6 +601,28 @@ class EngineSupervisor:
             )
             self._transition(HealthState.DEAD)
             return
+        # reload-on-corrupt: a corrupt-classified fatal (sentinel trip,
+        # checksum mismatch, canary failure) must NOT keep the old tree
+        # — the corruption would ride the weights-kept path into every
+        # incarnation.  The replica is marked quarantined_corrupt until
+        # its post-reload canary passes; the state machine already
+        # holds RECOVERING (readiness red), so no traffic can land on
+        # the suspect core meanwhile.
+        # (integrity disabled ⇒ corrupt classification is inert and the
+        # weights-kept path applies, preserving pre-integrity behavior)
+        reload_weights = (
+            kind == "corrupt" and self._integrity_cfg.enabled
+        )
+        if reload_weights:
+            self.quarantined_corrupt = True
+            metrics.CORRUPT_QUARANTINED.set(1)
+            self.last_integrity = {
+                "cause": f"{type(exc).__name__}: {exc}",
+                "kind": getattr(exc, "integrity_kind", "unknown"),
+                "sequences": list(getattr(exc, "sequences", ())),
+                "detail": dict(getattr(exc, "detail", {})),
+                "time": time.time(),
+            }
         rec = self._recovery
         while not self._stopping:
             now = time.monotonic()
@@ -504,10 +661,25 @@ class EngineSupervisor:
                 # shared teardown/rebuild sequence (engine_core.
                 # rebuild_core): stop, free the dead incarnation's
                 # device KV pool before the new one sizes, weights
-                # kept, brownout spec-suspension carried over
+                # kept (checksum-verified first) or RELOADED for
+                # corrupt fatals, brownout spec-suspension carried over
                 new_core = rebuild_core(
-                    self.core, self.config, self._devices
+                    self.core, self.config, self._devices,
+                    reload_weights=reload_weights,
                 )
+            except IntegrityError:
+                # the kept tree failed its rebuild-time checksum
+                # verification: the crash itself was a symptom of the
+                # corruption — escalate this recovery to a full reload
+                logger.error(
+                    "kept-weights rebuild failed checksum "
+                    "verification; escalating to weight reload",
+                    exc_info=True,
+                )
+                self.quarantined_corrupt = True
+                metrics.CORRUPT_QUARANTINED.set(1)
+                reload_weights = True
+                continue  # burns budget via _restart_times; retry
             except Exception:
                 logger.error(
                     "engine rebuild attempt failed", exc_info=True
@@ -521,11 +693,45 @@ class EngineSupervisor:
                 # (stop() fails the pending-resume sequences)
                 new_core.stop()
                 return
-            # replay checkpointed in-flight work into the rebuilt core
-            # BEFORE it starts: the first tick then admits the replays
-            # ahead of (racing) fresh client traffic
-            self._replay(new_core)
-            new_core.start()
+            if reload_weights:
+                # counted per reload REBUILD (not per canary verdict)
+                # so health integrity.corrupt_reloads tracks the
+                # vgt_corrupt_reloads Prometheus counter exactly
+                self.total_corrupt_reloads += 1
+            if reload_weights and self._canary is not None:
+                # the reloaded core must prove itself BEFORE any work
+                # (replays included) lands on it: start, probe, and
+                # only a matching canary fingerprint lifts the
+                # quarantine.  A failing canary tears this incarnation
+                # down and retries the reload — bounded by the same
+                # restart budget as any other rebuild.
+                new_core.start()
+                result = self._canary.check(new_core, context="reload")
+                self.last_integrity = dict(
+                    self.last_integrity or {}, canary=result
+                )
+                if not result["ok"]:
+                    self.total_canary_failures += 1
+                    logger.error(
+                        "post-reload canary FAILED; tearing the "
+                        "incarnation down and retrying the reload",
+                        extra={"extra_data": result},
+                    )
+                    new_core.stop()
+                    continue
+                self.quarantined_corrupt = False
+                metrics.CORRUPT_QUARANTINED.set(0)
+                self._replay(new_core)
+            else:
+                if reload_weights:
+                    # canary disabled: trust the fresh load
+                    self.quarantined_corrupt = False
+                    metrics.CORRUPT_QUARANTINED.set(0)
+                # replay checkpointed in-flight work into the rebuilt
+                # core BEFORE it starts: the first tick then admits the
+                # replays ahead of (racing) fresh client traffic
+                self._replay(new_core)
+                new_core.start()
             self.total_restarts += 1
             metrics.ENGINE_RESTARTS.inc()
             self._transition(HealthState.DEGRADED)
@@ -535,6 +741,11 @@ class EngineSupervisor:
                     "extra_data": {
                         "restarts": self.total_restarts,
                         "backoff_s": backoff,
+                        **(
+                            {"weights_reloaded": True}
+                            if reload_weights
+                            else {}
+                        ),
                     }
                 },
             )
@@ -692,12 +903,17 @@ class EngineSupervisor:
         degraded_s = self._time_in_degraded
         if self._degraded_since is not None:
             degraded_s += time.monotonic() - self._degraded_since
-        return {
+        out = {
             "state": state.value,
             "alive": state_is_alive(state.value),
             "ready": state_is_ready(state.value),
             "crashes": self.total_crashes,
             "restarts": self.total_restarts,
+            # satellite fix: operators could not see how close a
+            # replica was to DEAD
+            "restarts_remaining": restart_budget_remaining(
+                self._restart_times, self._recovery
+            ),
             "stalls": self.total_stalls,
             "resumed": self.total_resumed,
             "lost": self.total_lost,
@@ -708,6 +924,19 @@ class EngineSupervisor:
             "last_fatal": self.last_fatal,
             "transitions": list(self.transitions[-8:]),
         }
+        if self._integrity_cfg.enabled:
+            out["integrity"] = {
+                "quarantined_corrupt": self.quarantined_corrupt,
+                "corrupt_reloads": self.total_corrupt_reloads,
+                "canary_failures": self.total_canary_failures,
+                **(
+                    {"canary": self._canary.stats()}
+                    if self._canary is not None
+                    else {}
+                ),
+                "last": self.last_integrity,
+            }
+        return out
 
     def device_health(self) -> Dict[str, Any]:
         if self.state is HealthState.DEAD:

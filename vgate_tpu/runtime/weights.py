@@ -192,22 +192,57 @@ def params_from_safetensors(
     return params
 
 
+def load_digests(params: Params) -> dict:
+    """Per-shard load-time digests (host numpy, same positional-sum
+    formula as the device-side integrity sweep — integrity.py
+    host_leaf_digest) logged as load provenance: when a later checksum
+    sweep flags a shard, the load-time digest answers "was it already
+    wrong on disk, or did HBM flip it?".  The AUTHORITATIVE serving
+    baseline is recorded post-placement (post-quantize/shard) by
+    EngineIntegrity; these digests describe the host tree as loaded."""
+    from vgate_tpu.integrity import digest_summary, host_leaf_digest
+
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    digests = {
+        jax.tree_util.keystr(p): host_leaf_digest(np.asarray(x))
+        for p, x in leaves
+    }
+    return digest_summary(digests)
+
+
 def load_or_init_params(
     spec: ModelSpec,
     checkpoint_path: Optional[str],
     dtype=jnp.bfloat16,
     seed: int = 0,
+    log_digests: bool = False,
 ) -> Params:
-    """Checkpoint when available, random init otherwise (zero-egress path)."""
+    """Checkpoint when available, random init otherwise (zero-egress path).
+
+    ``log_digests`` (integrity.enabled callers) logs the per-shard
+    load-time digest summary — one full host pass over the tree, paid
+    once at load."""
     from vgate_tpu import faults
 
     faults.check("weight_load", payload=checkpoint_path)
     if checkpoint_path and os.path.isdir(checkpoint_path):
-        return params_from_safetensors(spec, checkpoint_path, dtype)
-    from vgate_tpu.models.decoder import init_params
+        params = params_from_safetensors(spec, checkpoint_path, dtype)
+    else:
+        from vgate_tpu.models.decoder import init_params
 
-    logger.warning(
-        "no checkpoint found; using random-init weights",
-        extra={"extra_data": {"model": spec.name, "path": checkpoint_path}},
-    )
-    return init_params(spec, jax.random.PRNGKey(seed), dtype)
+        logger.warning(
+            "no checkpoint found; using random-init weights",
+            extra={
+                "extra_data": {"model": spec.name, "path": checkpoint_path}
+            },
+        )
+        params = init_params(spec, jax.random.PRNGKey(seed), dtype)
+    if log_digests:
+        try:
+            logger.info(
+                "load-time weight digests",
+                extra={"extra_data": load_digests(params)},
+            )
+        except Exception:  # digest provenance must never block a load
+            logger.warning("load-time digest pass failed", exc_info=True)
+    return params
