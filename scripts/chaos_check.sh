@@ -41,3 +41,6 @@ bash scripts/prefix_check.sh
 
 echo "== silent-corruption defense drill =="
 bash scripts/integrity_check.sh
+
+echo "== SLO-graded workload-lab drill =="
+bash scripts/slo_check.sh

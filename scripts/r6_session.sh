@@ -62,5 +62,32 @@ aux ablate_kv_int8 benchmarks/bench_decode_ablate.py VGT_ABLATE_KV=int8
 aux kvquant_1p5b_w8 bench.py VGT_BENCH_SCENARIO=kv_quant \
     VGT_BENCH_QUANT=int8 VGT_TPU__QUANT_KERNEL=false VGT_BENCH_PAGE=32
 
+# ---- tier 3: SLO-graded loadlab sweeps (ISSUE 11) --------------------
+# The latency-under-load curves the ROADMAP evidence item asks for:
+# open-loop Poisson multi-QPS against the REAL HTTP server, per-tier
+# goodput + knee per cell, stamped artifacts under benchmarks/r6_raw/.
+# bench.py delegates to vgate_tpu/loadlab and boots the server itself
+# (scenario server_env); artifacts double as the perf-PR compare
+# baselines (python -m vgate_tpu.loadlab.compare).
+# 5. mixed-tier Poisson sweep, 1.5B bf16 — the headline goodput curve
+aux loadlab_mixed_1p5b bench.py VGT_BENCH_SCENARIO=tpu_mixed_sweep \
+    VGT_BENCH_OUT=benchmarks/r6_raw/loadlab_mixed_1p5b.jsonl
+# 6. same traffic with int8 KV pages: does the PR-7 capacity win buy
+#    goodput at the knee, or just resident sequences?
+aux loadlab_mixed_1p5b_kvq bench.py VGT_BENCH_SCENARIO=tpu_mixed_sweep \
+    VGT_KV_CACHE__DTYPE=int8 \
+    VGT_BENCH_OUT=benchmarks/r6_raw/loadlab_mixed_1p5b_kvq.jsonl
+# 7. prefix-reuse arm: multi-turn chat with shared system prompts —
+#    the PR-6 radix cache priced under open-loop load (pair against a
+#    radix=off rerun when the budget allows)
+aux loadlab_chat_prefix bench.py VGT_BENCH_SCENARIO=chat_prefix \
+    VGT_BENCH_OUT=benchmarks/r6_raw/loadlab_chat_prefix.jsonl
+# 8. 7B: the same mixed sweep at the heavier serving point (staged
+#    LAST: longest load + largest memory footprint)
+aux loadlab_mixed_7b bench.py VGT_BENCH_SCENARIO=tpu_mixed_sweep \
+    VGT_MODEL__MODEL_ID=Qwen/Qwen2.5-7B-Instruct \
+    VGT_TPU__MAX_BATCH_SLOTS=64 \
+    VGT_BENCH_OUT=benchmarks/r6_raw/loadlab_mixed_7b.jsonl
+
 echo "### R6 SESSION DONE $(date -u +%H:%M:%S)" >> "$log"
 touch /tmp/r6_session_done

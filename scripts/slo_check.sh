@@ -1,0 +1,193 @@
+#!/usr/bin/env bash
+# SLO-graded workload-lab gate (sibling of overload_check.sh /
+# prefix_check.sh): boot a CPU tiny-dense server configured by the
+# bundled `smoke_mixed` scenario's server_env (one definition site),
+# run the open-loop 2-cell Poisson sweep against it, and assert
+#   1. a graded JSONL artifact lands: schema-valid, platform-stamped,
+#      per-tier goodput for every QPS cell,
+#   2. ZERO unhandled client errors across the sweep — every failure is
+#      a typed kind (503 reason / 429 / timeout), including through the
+#      chaos-armed mid-cell engine crash (decode_step raise -> PR-5
+#      supervisor restart + replay),
+#   3. tier-ordered goodput under the overload cell: interactive >=
+#      batch, and batch really shed (the cell really overloaded),
+#   4. the server's own vgt_* TTFT histogram agrees with the
+#      client-observed TTFT view on the unloaded cell (catches
+#      server-side metric skew silently drifting from client truth),
+#   5. python -m vgate_tpu.loadlab.compare: identical artifacts pass,
+#      an intentionally doctored goodput regression exits nonzero.
+#
+# Usage: scripts/slo_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# 8737: 8736 already belongs to integrity_check.sh (one port per drill
+# so ensure_port_free's stale-server kill never crosses drills)
+PORT="${1:-8737}"
+source scripts/_drill_lib.sh
+ensure_port_free "$PORT"
+
+# export the scenario's server_env verbatim (the YAML is the single
+# definition site for the experiment's server configuration)
+eval "$(python - <<'PY'
+import shlex
+from vgate_tpu.loadlab import load_scenario
+for k, v in load_scenario("smoke_mixed").server_env.items():
+    print(f"export {k}={shlex.quote(str(v))}")
+PY
+)"
+export VGT_SERVER__PORT="$PORT"
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; clear_drill_pid "$PORT"' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+for _ in $(seq 1 300); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" slo_check
+
+ART=/tmp/vgt_slo_check.jsonl
+DOCTORED=/tmp/vgt_slo_check_doctored.jsonl
+rm -f "$ART" "$DOCTORED"
+
+echo "== open-loop sweep (smoke_mixed: 2 Poisson cells, chaos on cell 1) =="
+python -m vgate_tpu.loadlab run \
+  --scenario smoke_mixed --base-url "$BASE" \
+  --out "$ART" --platform cpu --device cpu-smoke
+
+echo "== artifact assertions =="
+python - "$ART" <<'PY'
+import json, sys
+from vgate_tpu.loadlab import slo
+
+art = slo.load_artifact(sys.argv[1])
+meta, cells, summary = art["meta"], art["cells"], art["summary"]
+
+# 1. stamped, schema-valid, per-tier goodput per cell
+lines = [meta] + cells + [summary]
+problems = slo.validate_lines(lines)
+assert not problems, f"schema violations: {problems}"
+assert meta["platform"] == "cpu" and meta["git_sha"], meta
+assert len(cells) == 2, f"expected 2 cells, got {len(cells)}"
+for c in cells:
+    for tier in ("interactive", "standard", "batch"):
+        assert tier in c["tiers"], f"missing tier {tier} in cell {c['qps']}"
+        assert c["tiers"][tier]["goodput"] is not None
+
+# 2. zero unhandled client errors, chaos cell included
+assert summary["unhandled_errors"] == 0, (
+    f"unhandled errors: {[c['tiers'] for c in cells]}"
+)
+chaos_cell = cells[1]
+assert chaos_cell.get("chaos", {}).get("armed"), (
+    f"chaos arm never fired: {chaos_cell.get('chaos')}"
+)
+
+# 3. tier-ordered goodput under overload; batch really shed
+inter = chaos_cell["tiers"]["interactive"]
+batch = chaos_cell["tiers"]["batch"]
+assert inter["goodput"] >= batch["goodput"], (
+    f"tier order violated: interactive {inter['goodput']} < "
+    f"batch {batch['goodput']}"
+)
+sheds = sum(
+    n for t in chaos_cell["tiers"].values()
+    for k, n in t["errors"].items() if k.startswith("http_503")
+)
+assert sheds > 0, "overload cell never shed — the squeeze is broken"
+
+# 4. the two TTFT views agree on the UNLOADED cell (queueing in the
+# overload cell legitimately separates client truth from engine-side
+# first-token time; skew hunting belongs on the quiet cell)
+quiet = cells[0]
+server = quiet.get("server") or {}
+ttft = server.get("ttft") or {}
+inter0 = quiet["tiers"]["interactive"]
+assert ttft.get("count", 0) >= inter0["ok"], (
+    f"server TTFT histogram missed streamed requests: "
+    f"count={ttft.get('count')} < interactive ok={inter0['ok']} "
+    "(did the streaming observe path regress?)"
+)
+client_mean = (inter0["ttft_ms"] or {}).get("mean")
+server_mean = ttft.get("mean_ms")
+assert client_mean is not None and server_mean is not None, (quiet,)
+tol = max(750.0, server_mean)
+assert abs(client_mean - server_mean) <= tol, (
+    f"TTFT views diverge: client {client_mean}ms vs "
+    f"server {server_mean}ms (tol {tol}ms)"
+)
+print(
+    "artifact OK: "
+    f"cell0 goodput={quiet['overall']['goodput']} "
+    f"cell1 tiers int={inter['goodput']} batch={batch['goodput']} "
+    f"sheds={sheds} ttft client/server="
+    f"{client_mean:.0f}/{server_mean:.0f}ms"
+)
+PY
+
+echo "== chaos really fired + server recovered =="
+python - "$BASE" <<'PY'
+import re, sys, urllib.request
+
+base = sys.argv[1]
+# a fired one-shot is PRUNED from the /debug/faults registry snapshot,
+# so the injected-faults counter is the witness that the chaos crash
+# actually happened under load (vs armed-but-idle)
+with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+    text = r.read().decode()
+m = re.search(
+    r'vgt_faults_injected_total\{[^}]*mode="raise"[^}]*'
+    r'point="prefill"[^}]*\}\s+([0-9.]+)', text
+) or re.search(
+    r'vgt_faults_injected_total\{[^}]*point="prefill"[^}]*'
+    r'mode="raise"[^}]*\}\s+([0-9.]+)', text
+)
+assert m and float(m.group(1)) >= 1, (
+    "chaos fault armed but vgt_faults_injected{prefill,raise} "
+    "never incremented"
+)
+req = urllib.request.Request(f"{base}/debug/faults", method="DELETE")
+urllib.request.urlopen(req, timeout=10)
+with urllib.request.urlopen(f"{base}/health/ready", timeout=10) as r:
+    assert r.status == 200, "server not ready after chaos recovery"
+print(f"chaos OK: prefill raise fired {m.group(1)}x under load, "
+      "server recovered to ready")
+PY
+
+echo "== compare gate: identical passes, doctored regression fails =="
+python -m vgate_tpu.loadlab.compare "$ART" "$ART"
+python - "$ART" "$DOCTORED" <<'PY'
+import json, sys
+from vgate_tpu.loadlab import slo
+
+art = slo.load_artifact(sys.argv[1])
+cells = art["cells"]
+# doctor the overload cell: interactive goodput collapses by 0.4
+t = cells[1]["tiers"]["interactive"]
+t["goodput"] = max(0.0, round(t["goodput"] - 0.4, 4))
+lines = [art["meta"]] + cells + [slo.summarize(cells)]
+slo.write_artifact(sys.argv[2], lines)
+PY
+if python -m vgate_tpu.loadlab.compare "$ART" "$DOCTORED"; then
+  echo "FAIL: compare tool passed a doctored goodput regression"
+  exit 1
+fi
+echo "compare gate OK (doctored regression exits nonzero)"
+
+kill -TERM "$SERVER_PID" 2>/dev/null || true
+for _ in $(seq 1 100); do
+  if ! kill -0 "$SERVER_PID" 2>/dev/null; then break; fi
+  sleep 0.3
+done
+kill -9 "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+trap - EXIT
+clear_drill_pid "$PORT"
+echo "PASS: slo_check complete (graded artifact, zero unhandled errors," \
+     "tier-ordered overload goodput, TTFT views agree, compare gate armed)"

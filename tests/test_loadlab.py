@@ -1,0 +1,540 @@
+"""Workload-lab (vgate_tpu/loadlab) fast tier: arrival-process
+statistics + the open-loop property, SLO grader math, scenario YAML
+round-trips, artifact schema stability, compare-tool regression
+detection, and a seconds-scale dry-run smoke of the full sweep loop."""
+
+import asyncio
+import json
+import os
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from vgate_tpu.loadlab import arrivals, compare, slo, workload
+from vgate_tpu.loadlab.driver import Sample, classify_http_error, drive_cell
+from vgate_tpu.loadlab.runner import (
+    hist_delta,
+    parse_histograms,
+    run_scenario_async,
+)
+from vgate_tpu.loadlab.scenario import (
+    ArrivalSpec,
+    ChaosSpec,
+    Scenario,
+    SLOSpec,
+    TrafficMix,
+    bundled_scenarios,
+    load_scenario,
+)
+
+# ---------------------------------------------------------------- arrivals
+
+
+def test_poisson_mean_rate_and_determinism():
+    rate, dur = 40.0, 25.0
+    a = arrivals.poisson(rate, dur, seed=7)
+    # n ~ Poisson(1000): +-12% is ~4 sigma — deterministic given the seed
+    assert 0.88 * rate * dur < len(a) < 1.12 * rate * dur
+    assert a == sorted(a) and a[0] >= 0 and a[-1] < dur
+    assert a == arrivals.poisson(rate, dur, seed=7)
+    assert a != arrivals.poisson(rate, dur, seed=8)
+
+
+def test_constant_arrivals_evenly_spaced():
+    a = arrivals.constant(10.0, 2.0)
+    assert len(a) == 20
+    gaps = {round(b - x, 9) for x, b in zip(a, a[1:])}
+    assert gaps == {0.1}
+
+
+def test_bursty_preserves_mean_rate_and_modulates():
+    rate, dur = 20.0, 60.0
+    a = arrivals.bursty(rate, dur, seed=3, on_s=2.0, off_s=4.0,
+                        burst_mult=3.0)
+    assert 0.85 * rate * dur < len(a) < 1.15 * rate * dur
+    # density inside on-windows must exceed off-windows
+    on = sum(1 for t in a if (t % 6.0) < 2.0)
+    off = len(a) - on
+    assert on / 2.0 > 1.5 * (off / 4.0)
+
+
+def test_bursty_clamps_burst_mult():
+    # burst_mult > cycle/on would need a negative off rate; the clamp
+    # keeps the process well-defined (everything lands in on-windows)
+    a = arrivals.bursty(10.0, 30.0, seed=1, on_s=2.0, off_s=4.0,
+                        burst_mult=100.0)
+    assert all((t % 6.0) < 2.0 for t in a)
+
+
+def test_unknown_process_raises():
+    with pytest.raises(ValueError):
+        arrivals.generate("uniform", 1.0, 1.0, 0)
+
+
+async def test_open_loop_sends_independent_of_slow_responder():
+    """THE property: a server answering in 400ms must not delay sends
+    planned 20ms apart — arrival timestamps are precomputed and every
+    fire task sleeps to its own absolute due time."""
+
+    async def slow_chat(request):
+        await asyncio.sleep(0.4)
+        return web.json_response({
+            "object": "chat.completion",
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": "x"},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                      "total_tokens": 2},
+        })
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", slow_chat)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        base = str(server.make_url("")).rstrip("/")
+        n = 15
+        plan = [
+            workload.PlannedRequest(
+                offset_s=0.02 * i,
+                endpoint="/v1/chat/completions",
+                body={"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 4},
+                tier="standard", shape="chat", stream=False, index=i,
+            )
+            for i in range(n)
+        ]
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        samples = await drive_cell(base, plan, timeout_s=10.0)
+        wall = loop.time() - t0
+    finally:
+        await server.close()
+    assert len(samples) == n
+    assert all(s.ok for s in samples), [s.kind for s in samples]
+    # closed-loop (await each 400ms response before the next send)
+    # would need n * 0.4 = 6s; open-loop needs ~(0.28s spread + 0.4s)
+    assert wall < 2.5, f"driver serialized sends: wall={wall:.2f}s"
+    # every send left on time even though every response was in flight
+    assert max(s.send_lag_s for s in samples) < 0.2
+
+
+# ------------------------------------------------------------------ grader
+
+
+def _sample(tier="interactive", ok=True, ttft=0.1, tpot=0.01, e2e=0.5,
+            kind=None, lag=0.0):
+    return Sample(
+        tier=tier, shape="chat", offset_s=0.0,
+        kind=kind or ("ok" if ok else "http_503_overloaded"),
+        ok=ok, status=200 if ok else 503,
+        ttft_s=ttft if ok else None, tpot_s=tpot if ok else None,
+        e2e_s=e2e, tokens=8 if ok else 0, send_lag_s=lag,
+    )
+
+
+def test_goodput_boundaries():
+    spec = SLOSpec(ttft_ms=100.0)
+    at = _sample(ttft=0.100)        # exactly at the bound: good
+    over = _sample(ttft=0.1001)     # over: not good
+    shed = _sample(ok=False)        # typed error: never good
+    assert slo.meets_slo(at, spec)
+    assert not slo.meets_slo(over, spec)
+    assert not slo.meets_slo(shed, spec)
+    # no spec for the tier -> availability goodput (ok == good)
+    assert slo.meets_slo(over, None)
+    cell = slo.grade_cell(
+        [at, over, shed], {"interactive": spec}, qps=3.0, duration_s=1.0
+    )
+    t = cell["tiers"]["interactive"]
+    assert t["n"] == 3 and t["ok"] == 2 and t["slo_met"] == 1
+    assert t["goodput"] == pytest.approx(1 / 3, abs=1e-4)
+    assert t["errors"] == {"http_503_overloaded": 1}
+    assert cell["overall"]["goodput"] == pytest.approx(1 / 3, abs=1e-4)
+    assert cell["unhandled_errors"] == 0 and cell["valid"]
+
+
+def test_missing_ttft_fails_a_ttft_slo():
+    # an "ok" sample that somehow produced no first token cannot meet a
+    # TTFT bound; a sample with no tpot (single-token) passes tpot
+    spec = SLOSpec(ttft_ms=100.0, tpot_ms=10.0)
+    no_ttft = _sample(ttft=None)
+    single_tok = _sample(tpot=None)
+    assert not slo.meets_slo(no_ttft, spec)
+    assert slo.meets_slo(single_tok, spec)
+
+
+def test_send_lag_invalidates_cell():
+    bad = [_sample(lag=0.5) for _ in range(10)]
+    cell = slo.grade_cell(bad, {}, qps=1.0, duration_s=1.0)
+    assert not cell["valid"]
+
+
+def test_knee_detection():
+    cells = [(1.0, 1.0), (2.0, 1.0), (4.0, 0.9), (8.0, 0.4)]
+    assert slo.max_goodput_qps(cells) == 4.0
+    # delivered good qps: 1, 2, 3.6, 3.2 -> knee at 4
+    assert slo.knee_qps(cells) == 4.0
+    assert slo.max_goodput_qps([(1.0, 0.5)]) is None
+    assert slo.knee_qps([]) is None
+
+
+def test_percentiles_nearest_rank():
+    vals = [float(i) for i in range(1, 101)]
+    assert slo.percentile(vals, 0.50) == 50.0
+    assert slo.percentile(vals, 0.99) == 99.0
+    assert slo.percentile([], 0.5) is None
+
+
+# ---------------------------------------------------------------- scenario
+
+
+def test_scenario_yaml_roundtrip(tmp_path):
+    s = Scenario(
+        name="rt",
+        seed=5,
+        duration_s=3.0,
+        qps_cells=[1.0, 2.0],
+        arrival=ArrivalSpec(process="bursty", on_s=1.0, off_s=2.0,
+                            burst_mult=2.0),
+        mixes=[
+            TrafficMix(shape="multi_turn_chat", tier="interactive",
+                       weight=2.0, turns=2),
+            TrafficMix(shape="embeddings", tier="batch", stream=False),
+        ],
+        slos={"interactive": SLOSpec(ttft_ms=100, tpot_ms=10)},
+        chaos=ChaosSpec(faults="decode_step:raise:times=1", at_s=1.0,
+                        cell_index=1),
+        server_env={"VGT_LOGGING__LEVEL": "WARNING"},
+    )
+    p = tmp_path / "rt.yaml"
+    p.write_text(s.to_yaml())
+    back = load_scenario(str(p))
+    assert back.to_dict() == s.to_dict()
+    assert back.content_hash() == s.content_hash()
+    assert back.chaos.cell_index == 1
+    assert back.arrival.process == "bursty"
+
+
+def test_bundled_scenarios_load():
+    names = bundled_scenarios()
+    assert "smoke_mixed" in names and "tpu_mixed_sweep" in names
+    for name in names:
+        s = load_scenario(name)
+        assert s.qps_cells and s.mixes
+        # every bundled scenario must synthesize a valid plan
+        plan = workload.build_plan(s, 0, min(s.qps_cells))
+        assert all(
+            p.endpoint.startswith("/v1/") for p in plan
+        )
+
+
+def test_scenario_rejects_unknowns():
+    with pytest.raises(ValueError):
+        TrafficMix(shape="nope")
+    with pytest.raises(ValueError):
+        TrafficMix(tier="vip")
+    with pytest.raises(ValueError):
+        Scenario(qps_cells=[])
+    with pytest.raises(ValueError):
+        Scenario.from_dict({"name": "x", "typo_field": 1})
+    with pytest.raises(ValueError):
+        Scenario.from_dict(
+            {"slos": {"interactive": {"ttft_p99_ms": 5}}}
+        )
+
+
+def test_plan_determinism_and_prefix_sharing():
+    s = load_scenario("smoke_mixed")
+    p1 = workload.build_plan(s, 0, 4.0)
+    p2 = workload.build_plan(s, 0, 4.0)
+    assert [(r.offset_s, r.body) for r in p1] == [
+        (r.offset_s, r.body) for r in p2
+    ]
+    # rag requests drawing the same doc share their preamble verbatim
+    rag = [r for r in p1 if r.shape == "rag"]
+    if len(rag) >= 2:
+        systems = [r.body["messages"][0]["content"] for r in rag]
+        assert any(
+            a == b for i, a in enumerate(systems)
+            for b in systems[i + 1:]
+        ) or len(set(systems)) == len(systems)
+
+
+# ---------------------------------------------------- artifact + compare
+
+
+def _make_lines(goodputs=(1.0, 0.95), scenario_name="art",
+                fingerprint="f00"):
+    s = Scenario(name=scenario_name, qps_cells=[2.0, 8.0], duration_s=5.0)
+    meta = {
+        "kind": "meta", "schema": slo.SCHEMA, "scenario": s.name,
+        "scenario_hash": s.content_hash(), "seed": s.seed,
+        "ts": "2026-08-03T00:00:00Z", "platform": "cpu",
+        "device": "cpu", "git_sha": "abc123",
+        "config_fingerprint": fingerprint,
+        "base_url": "http://x", "slos": {},
+    }
+    cells = []
+    for qps, g in zip(s.qps_cells, goodputs):
+        n = 40
+        good = int(round(g * n))
+        samples = [
+            _sample(tier="interactive", ttft=0.05) for _ in range(good)
+        ] + [
+            _sample(tier="interactive", ok=False) for _ in range(n - good)
+        ]
+        cell = slo.grade_cell(
+            samples, {"interactive": SLOSpec(ttft_ms=200)},
+            qps=qps, duration_s=5.0,
+        )
+        cell["server"] = None
+        cells.append(cell)
+    summary = slo.summarize(cells)
+    return [meta] + cells + [summary]
+
+
+def test_artifact_schema_stability(tmp_path):
+    lines = _make_lines()
+    assert slo.validate_lines(lines) == []
+    # pinned field lists: additive evolution only
+    assert set(slo.META_REQUIRED) <= set(lines[0])
+    assert set(slo.CELL_REQUIRED) <= set(lines[1])
+    assert set(slo.SUMMARY_REQUIRED) <= set(lines[-1])
+    path = str(tmp_path / "a.jsonl")
+    slo.write_artifact(path, lines)
+    art = slo.load_artifact(path)
+    assert art["meta"]["scenario"] == "art"
+    assert len(art["cells"]) == 2
+    assert art["summary"]["max_goodput_qps"] == 8.0
+
+
+def test_load_artifact_rejects_foreign_files(tmp_path):
+    p = tmp_path / "x.jsonl"
+    p.write_text('{"metric": "output_tokens_per_sec_per_chip"}\n')
+    with pytest.raises(ValueError):
+        slo.load_artifact(str(p))
+
+
+def test_compare_flags_doctored_goodput_regression(tmp_path):
+    old_p = str(tmp_path / "old.jsonl")
+    new_p = str(tmp_path / "new.jsonl")
+    lines = _make_lines(goodputs=(1.0, 0.95))
+    slo.write_artifact(old_p, lines)
+    # identical artifacts: clean pass
+    slo.write_artifact(new_p, lines)
+    assert compare.main([old_p, new_p]) == 0
+    # doctor the overload cell's goodput down 0.35: must exit nonzero
+    doctored = _make_lines(goodputs=(1.0, 0.60))
+    slo.write_artifact(new_p, doctored)
+    rc = compare.main([old_p, new_p])
+    assert rc == 1
+    regs = compare.compare(
+        slo.load_artifact(old_p), slo.load_artifact(new_p)
+    )
+    kinds = {r["kind"] for r in regs}
+    assert "goodput_drop" in kinds
+    # the knee moved down with the same offered cells -> also flagged
+    assert "knee_drop" in kinds
+
+
+def test_compare_refuses_config_fingerprint_change(tmp_path):
+    # same scenario, env-overridden server (7B vs 1.5B): the scenario
+    # hash can't see it but the /stats config fingerprint can
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    slo.write_artifact(a, _make_lines(fingerprint="aaa"))
+    slo.write_artifact(b, _make_lines(fingerprint="bbb"))
+    assert compare.main([a, b]) == 2
+    assert compare.main([a, b, "--allow-config-change"]) == 0
+
+
+def test_compare_gates_knee_qps_drop(tmp_path):
+    # per-cell goodput inside threshold everywhere, but the delivered-
+    # goodput knee halves: documented as a gated regression
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    old_lines = _make_lines(goodputs=(1.0, 0.95))
+    new_lines = _make_lines(goodputs=(1.0, 0.95))
+    new_lines[-1] = dict(new_lines[-1], knee_qps=2.0)
+    slo.write_artifact(a, old_lines)
+    slo.write_artifact(b, new_lines)
+    regs = compare.compare(
+        slo.load_artifact(a), slo.load_artifact(b)
+    )
+    assert any(
+        r["kind"] == "knee_drop" and r.get("metric") == "knee_qps"
+        for r in regs
+    )
+    assert compare.main([a, b]) == 1
+
+
+def test_compare_refuses_cross_scenario(tmp_path):
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    slo.write_artifact(a, _make_lines(scenario_name="one"))
+    slo.write_artifact(b, _make_lines(scenario_name="two"))
+    assert compare.main([a, b]) == 2
+    assert compare.main([a, b, "--allow-cross-scenario"]) == 0
+
+
+def test_compare_ignores_small_tiers_and_invalid_cells(tmp_path):
+    old_lines = _make_lines(goodputs=(1.0, 0.95))
+    new_lines = _make_lines(goodputs=(1.0, 0.60))
+    # mark the regressed cell invalid (client-side lag): gates nothing
+    new_lines[2]["valid"] = False
+    # the summary also drops invalid cells from its knee numbers
+    new_lines[-1] = slo.summarize(new_lines[1:3])
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    slo.write_artifact(a, old_lines)
+    slo.write_artifact(b, new_lines)
+    assert compare.main([a, b]) == 0
+
+
+def test_classify_http_error_taxonomy():
+    assert classify_http_error(
+        503, {"error": {"reason": "overloaded"}}
+    ) == "http_503_overloaded"
+    assert classify_http_error(
+        503, {"error": {"reason": "recovering"}}
+    ) == "http_503_recovering"
+    assert classify_http_error(503, None) == "http_503"
+    assert classify_http_error(429, {}) == "http_429"
+    assert classify_http_error(
+        504, {"error": {"metadata": {"partial_tokens": 3}}}
+    ) == "http_504_partial"
+    assert classify_http_error(504, {"error": {}}) == "http_504"
+    assert classify_http_error(418, {}) == "http_418"
+
+
+def test_parse_histograms_and_delta():
+    text = "\n".join([
+        "# HELP vgt_time_to_first_token_seconds Time to first token",
+        'vgt_time_to_first_token_seconds_bucket{le="0.1"} 3',
+        'vgt_time_to_first_token_seconds_bucket{le="1"} 5',
+        'vgt_time_to_first_token_seconds_bucket{le="+Inf"} 5',
+        "vgt_time_to_first_token_seconds_count 5",
+        "vgt_time_to_first_token_seconds_sum 1.5",
+        "vgt_time_per_output_token_seconds_count 0",
+        "vgt_time_per_output_token_seconds_sum 0",
+    ])
+    before = parse_histograms("")
+    after = parse_histograms(text)
+    d = hist_delta(
+        before["vgt_time_to_first_token_seconds"],
+        after["vgt_time_to_first_token_seconds"],
+    )
+    assert d["count"] == 5
+    assert d["mean_ms"] == pytest.approx(300.0)
+    assert d["p99_ms_le"] == pytest.approx(1000.0)
+
+
+# ------------------------------------------------- dry-run sweep smoke
+
+
+async def test_loadlab_smoke_dry_run(tmp_path):
+    """Seconds-scale end-to-end: a real gateway (dry-run engine) driven
+    through one tiny Poisson cell; the artifact must grade per-tier
+    goodput, stamp the schema, and report zero unhandled errors."""
+    from vgate_tpu.config import load_config
+    from vgate_tpu.server.app import create_app
+
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        batch={"max_batch_size": 4, "max_wait_time_ms": 5.0},
+        logging={"level": "WARNING"},
+    )
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    try:
+        base = str(client.make_url("")).rstrip("/")
+        scenario = Scenario(
+            name="ci_smoke",
+            duration_s=1.5,
+            qps_cells=[8.0],
+            mixes=[
+                TrafficMix(shape="chat", tier="interactive",
+                           prompt_units=6, max_tokens=8, stream=True),
+                TrafficMix(shape="embeddings", tier="standard",
+                           prompt_units=6, stream=False),
+            ],
+            slos={"interactive": SLOSpec(ttft_ms=10000)},
+            request_timeout_s=15.0,
+            warmup_requests=1,
+        )
+        out = str(tmp_path / "smoke.jsonl")
+        result = await run_scenario_async(
+            scenario, base, out_path=out,
+            platform="cpu", device="test",
+            progress=lambda s: None,
+        )
+    finally:
+        await client.close()
+    lines = result["lines"]
+    assert slo.validate_lines(lines) == []
+    art = slo.load_artifact(out)
+    assert art["meta"]["platform"] == "cpu"
+    cell = art["cells"][0]
+    assert cell["offered"] > 0
+    assert cell["unhandled_errors"] == 0, cell
+    assert "interactive" in cell["tiers"]
+    inter = cell["tiers"]["interactive"]
+    assert inter["goodput"] is not None and inter["goodput"] > 0
+    assert art["summary"]["unhandled_errors"] == 0
+
+
+async def test_debug_faults_endpoint_gating(monkeypatch):
+    """POST /debug/faults arms only with VGT_FAULTS_HTTP=1 (the drills'
+    opt-in); DELETE disarms; default is 403."""
+    from vgate_tpu import faults
+    from vgate_tpu.config import load_config
+    from vgate_tpu.server.app import create_app
+
+    config = load_config(
+        model={"engine_type": "dry_run"},
+        logging={"level": "WARNING"},
+    )
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    try:
+        monkeypatch.delenv("VGT_FAULTS_HTTP", raising=False)
+        resp = await client.post(
+            "/debug/faults",
+            json={"faults": "decode_step:raise:times=1"},
+        )
+        assert resp.status == 403
+        monkeypatch.setenv("VGT_FAULTS_HTTP", "1")
+        resp = await client.post(
+            "/debug/faults",
+            json={"faults": "decode_step:raise:times=1"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["armed"] == 1
+        assert any(
+            s["point"] == "decode_step" for s in body["active"]
+        )
+        assert faults.is_active()
+        resp = await client.get("/debug/faults")
+        assert (await resp.json())["armed"]
+        resp = await client.delete("/debug/faults")
+        assert resp.status == 200
+        assert not faults.is_active()
+        # bad spec arms nothing but doesn't 500
+        resp = await client.post(
+            "/debug/faults", json={"faults": "nonsense"}
+        )
+        assert resp.status == 200
+        assert (await resp.json())["armed"] == 0
+        # valid JSON that isn't an object is a typed 400, not a 500
+        resp = await client.post("/debug/faults", json=[1, 2])
+        assert resp.status == 400
+        resp = await client.post(
+            "/debug/faults", data=b"not json",
+            headers={"Content-Type": "application/json"},
+        )
+        assert resp.status == 400
+    finally:
+        await client.close()

@@ -450,6 +450,32 @@ class JaxTPUBackend:
                 seq.request_abort()
         if seq.status is SeqStatus.FAILED:
             raise seq.error  # type: ignore[misc]
+        # streamed requests bypass the batcher, whose _normalize is
+        # where non-streaming TTFT/TPOT land — observe here so the
+        # vgt_* histograms cover the latency-sensitive path too (the
+        # loadlab smoke drill asserts the server's TTFT view tracks the
+        # client-observed one; before this, streams never fed it)
+        from vgate_tpu import metrics as vgt_metrics
+        from vgate_tpu.tracing import context_trace_id
+
+        trace_id = (
+            context_trace_id(request_meta.trace_ctx)
+            if request_meta is not None
+            and getattr(request_meta, "trace_ctx", None) is not None
+            else None
+        )
+        for hist, value in (
+            (vgt_metrics.TTFT, seq.ttft),
+            (vgt_metrics.TPOT, seq.tpot),
+        ):
+            if value is None:
+                continue
+            if trace_id:
+                vgt_metrics.observe_with_exemplar(
+                    hist, value, trace_id=trace_id
+                )
+            else:
+                hist.observe(value)
         if on_usage is not None:
             on_usage({
                 "prompt_tokens": seq.orig_prompt_len,

@@ -1,0 +1,92 @@
+"""CLI: ``python -m vgate_tpu.loadlab run|list|compare ...``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from . import compare as compare_mod
+from .runner import launch_server, run_scenario, scenario_server_env
+from .scenario import bundled_scenarios, load_scenario
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m vgate_tpu.loadlab")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser(
+        "run", help="run a scenario sweep against a live server"
+    )
+    run_p.add_argument(
+        "--scenario", required=True,
+        help="bundled scenario name or YAML path",
+    )
+    run_p.add_argument(
+        "--base-url", default=None,
+        help="server to drive (mutually exclusive with --launch)",
+    )
+    run_p.add_argument(
+        "--launch", action="store_true",
+        help="boot python main.py with the scenario's server_env",
+    )
+    run_p.add_argument("--port", type=int, default=8790)
+    run_p.add_argument("--out", default=None, help="artifact path (jsonl)")
+    run_p.add_argument(
+        "--cells", default=None,
+        help="override qps cells, comma-separated (e.g. 1,2,4)",
+    )
+    run_p.add_argument("--platform", default=None)
+    run_p.add_argument("--device", default=None)
+    run_p.add_argument(
+        "--duration", type=float, default=None,
+        help="override per-cell duration_s",
+    )
+
+    sub.add_parser("list", help="list bundled scenarios")
+
+    cmp_p = sub.add_parser(
+        "compare", help="gate a new artifact against a baseline"
+    )
+    cmp_p.add_argument("old")
+    cmp_p.add_argument("new")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "list":
+        for name in bundled_scenarios():
+            print(name)
+        return 0
+
+    if args.cmd == "compare":
+        return compare_mod.main([args.old, args.new])
+
+    scenario = load_scenario(args.scenario)
+    if args.duration is not None:
+        scenario.duration_s = args.duration
+    cells = (
+        [float(c) for c in args.cells.split(",")] if args.cells else None
+    )
+    kwargs = dict(
+        out_path=args.out,
+        platform=args.platform,
+        device=args.device,
+        cells=cells,
+    )
+    if args.launch:
+        if args.base_url:
+            parser.error("--launch and --base-url are mutually exclusive")
+        with launch_server(
+            scenario_server_env(scenario), port=args.port
+        ) as base:
+            result = run_scenario(scenario, base, **kwargs)
+    elif args.base_url:
+        result = run_scenario(scenario, args.base_url, **kwargs)
+    else:
+        parser.error("one of --base-url or --launch is required")
+    summary = result["summary"]
+    return 0 if summary.get("unhandled_errors", 0) == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

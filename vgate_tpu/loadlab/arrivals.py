@@ -1,0 +1,114 @@
+"""Open-loop arrival processes for the workload lab.
+
+Every generator returns **absolute arrival offsets** (seconds from the
+cell's t0), computed up front from a seeded RNG.  The driver sleeps
+until each offset and fires — it never waits for a previous response —
+so arrivals cannot back off when the server slows down.  That is the
+open-loop property this whole subsystem exists for: a closed-loop
+driver (fire, await, fire) self-throttles under overload and reports a
+flattering, meaningless latency curve exactly when the measurement
+matters most (the comparative vLLM/TGI serving study in PAPERS.md
+grades on open-loop tail latency for the same reason).
+
+Determinism: same (process, rate, duration, seed) -> identical
+timestamps, so two artifact runs compare cell-for-cell.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List
+
+PROCESSES = ("poisson", "constant", "bursty")
+
+
+def poisson(rate_qps: float, duration_s: float, seed: int) -> List[float]:
+    """Homogeneous Poisson process: exponential inter-arrivals at
+    ``rate_qps``, truncated at ``duration_s``."""
+    if rate_qps <= 0 or duration_s <= 0:
+        return []
+    rng = random.Random(seed)
+    out: List[float] = []
+    t = rng.expovariate(rate_qps)
+    while t < duration_s:
+        out.append(t)
+        t += rng.expovariate(rate_qps)
+    return out
+
+
+def constant(rate_qps: float, duration_s: float, seed: int = 0) -> List[float]:
+    """Evenly spaced arrivals (the metronome arm: isolates queueing
+    effects from arrival burstiness).  ``seed`` accepted for signature
+    parity; the process is deterministic by construction."""
+    if rate_qps <= 0 or duration_s <= 0:
+        return []
+    gap = 1.0 / rate_qps
+    n = int(duration_s * rate_qps)
+    return [i * gap for i in range(n) if i * gap < duration_s]
+
+
+def bursty(
+    rate_qps: float,
+    duration_s: float,
+    seed: int,
+    on_s: float = 2.0,
+    off_s: float = 4.0,
+    burst_mult: float = 3.0,
+) -> List[float]:
+    """On/off-modulated Poisson (flash-crowd shape): alternating windows
+    of ``on_s`` seconds at ``rate_qps * burst_mult`` and ``off_s``
+    seconds at a compensating lower rate, chosen so the long-run mean
+    stays ``rate_qps`` (an overload curve swept with bursty arrivals
+    must be comparable to the Poisson sweep at the same offered QPS).
+
+    ``burst_mult`` is clamped so the off-window rate never goes
+    negative: burst_mult <= (on_s + off_s) / on_s.
+    """
+    if rate_qps <= 0 or duration_s <= 0:
+        return []
+    if on_s <= 0 or off_s < 0:
+        raise ValueError("bursty arrivals need on_s > 0 and off_s >= 0")
+    cycle = on_s + off_s
+    burst_mult = min(burst_mult, cycle / on_s)
+    rate_on = rate_qps * burst_mult
+    rate_off = (
+        (rate_qps * cycle - rate_on * on_s) / off_s if off_s > 0 else 0.0
+    )
+    rng = random.Random(seed)
+    out: List[float] = []
+    window_start = 0.0
+    while window_start < duration_s:
+        for width, rate in ((on_s, rate_on), (off_s, rate_off)):
+            if width <= 0 or rate <= 0:
+                window_start += width
+                continue
+            end = min(window_start + width, duration_s)
+            t = window_start + rng.expovariate(rate)
+            while t < end:
+                out.append(t)
+                t += rng.expovariate(rate)
+            window_start = window_start + width
+            if window_start >= duration_s:
+                break
+    return out
+
+
+def generate(
+    process: str,
+    rate_qps: float,
+    duration_s: float,
+    seed: int,
+    **kwargs: float,
+) -> List[float]:
+    """Dispatch by process name (the scenario YAML's ``arrival.process``
+    field).  Unknown names raise so a typo'd scenario fails at load, not
+    after a 30-minute sweep."""
+    if process == "poisson":
+        return poisson(rate_qps, duration_s, seed)
+    if process == "constant":
+        return constant(rate_qps, duration_s, seed)
+    if process == "bursty":
+        return bursty(rate_qps, duration_s, seed, **kwargs)
+    raise ValueError(
+        f"unknown arrival process {process!r}; valid: {PROCESSES}"
+    )

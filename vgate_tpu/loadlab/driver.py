@@ -1,0 +1,272 @@
+"""Async open-loop driver: fires a pre-planned arrival schedule at a
+live vgate-tpu server and measures what a CLIENT observes.
+
+Measured per request (client truth — not the server's self-report):
+
+* **TTFT** — first SSE chunk carrying non-empty delta content (for
+  streams) or the full response (non-streaming), from the moment the
+  request was DUE to be sent.  Late sends (event-loop lag) are folded
+  into latency, not silently excused: an overloaded client host shows
+  up as `send_lag` in the sample, and the lab refuses the cell when lag
+  grows past a bound rather than report corrupted numbers.
+* **TPOT** — mean inter-chunk gap after the first content chunk.
+* **e2e** — due-time to last byte.
+* **error taxonomy** — every failure is a typed `kind`
+  (http_503_overloaded / http_503_recovering / http_429 /
+  http_504_partial / sse_timeout_error / client_timeout / transport
+  ...).  `driver_error` means the lab itself broke — drills assert it
+  never happens.
+
+Open-loop discipline: every arrival is its own task sleeping until its
+ABSOLUTE due time; nothing awaits a previous response.  Server slowness
+changes completions, never offered load.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from .workload import PlannedRequest
+
+# sends this late mean the measuring host (not the server) saturated —
+# cells with a worse p99 send lag are stamped invalid by the runner
+SEND_LAG_BOUND_S = 0.25
+
+
+@dataclass
+class Sample:
+    tier: str
+    shape: str
+    offset_s: float
+    kind: str = "ok"  # typed outcome; "ok" only for clean completions
+    ok: bool = False
+    status: Optional[int] = None
+    ttft_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    tokens: int = 0
+    send_lag_s: float = 0.0
+    stream: bool = False
+    error: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "tier": self.tier, "shape": self.shape, "kind": self.kind,
+            "ok": self.ok, "status": self.status,
+            "ttft_s": self.ttft_s, "tpot_s": self.tpot_s,
+            "e2e_s": self.e2e_s, "tokens": self.tokens,
+            "send_lag_s": round(self.send_lag_s, 4),
+            "stream": self.stream,
+        }
+        if self.error:
+            d["error"] = self.error[:300]
+        return d
+
+
+def classify_http_error(status: int, payload: Any) -> str:
+    """Map an HTTP failure to its typed kind using the server's own
+    machine-readable `reason` taxonomy (PR-4: every RetryableError 503
+    carries one)."""
+    err = payload.get("error", {}) if isinstance(payload, dict) else {}
+    if status == 503:
+        reason = err.get("reason")
+        return f"http_503_{reason}" if reason else "http_503"
+    if status == 429:
+        return "http_429"
+    if status == 504:
+        meta = err.get("metadata") or {}
+        partial = (
+            meta.get("partial_tokens") or err.get("partial_tokens")
+        )
+        return "http_504_partial" if partial else "http_504"
+    return f"http_{status}"
+
+
+async def _consume_sse(
+    resp: aiohttp.ClientResponse, sample: Sample, due_t: float,
+    loop: asyncio.AbstractEventLoop,
+) -> None:
+    """Walk the SSE stream, stamping first/last content-chunk times."""
+    first_t: Optional[float] = None
+    last_t: Optional[float] = None
+    n_chunks = 0
+    error_event: Optional[str] = None
+    done_seen = False
+    async for raw in resp.content:
+        line = raw.strip()
+        if not line.startswith(b"data: "):
+            continue
+        payload = line[len(b"data: "):]
+        if payload == b"[DONE]":
+            done_seen = True
+            break
+        try:
+            event = json.loads(payload)
+        except ValueError:
+            continue
+        if "error" in event:
+            error_event = event["error"].get("type") or "error"
+            continue
+        choices = event.get("choices") or []
+        delta = choices[0].get("delta", {}) if choices else {}
+        if delta.get("content"):
+            now = loop.time()
+            if first_t is None:
+                first_t = now
+            last_t = now
+            n_chunks += 1
+        usage = event.get("usage")
+        if usage and usage.get("completion_tokens"):
+            sample.extra["completion_tokens"] = usage["completion_tokens"]
+    end_t = loop.time()
+    sample.e2e_s = end_t - due_t
+    # chunk count is a floor for tokens (stop-holdback merges tokens
+    # into one delta); prefer the server-reported usage when present
+    sample.tokens = sample.extra.get("completion_tokens", n_chunks)
+    if first_t is not None:
+        sample.ttft_s = first_t - due_t
+        if last_t is not None and n_chunks > 1:
+            sample.tpot_s = (last_t - first_t) / (n_chunks - 1)
+    if error_event is not None:
+        sample.kind = f"sse_{error_event}"
+        sample.error = error_event
+    elif not done_seen:
+        sample.kind = "sse_truncated"
+    elif first_t is None:
+        sample.kind = "sse_empty"
+    else:
+        sample.kind = "ok"
+        sample.ok = True
+
+
+async def _fire(
+    session: aiohttp.ClientSession,
+    base_url: str,
+    req: PlannedRequest,
+    t0: float,
+    timeout_s: float,
+    samples: List[Sample],
+) -> None:
+    loop = asyncio.get_running_loop()
+    due_t = t0 + req.offset_s
+    delay = due_t - loop.time()
+    if delay > 0:
+        await asyncio.sleep(delay)
+    sample = Sample(
+        tier=req.tier, shape=req.shape, offset_s=req.offset_s,
+        stream=req.stream,
+        send_lag_s=max(0.0, loop.time() - due_t),
+    )
+    samples.append(sample)
+    try:
+        async with session.post(
+            base_url + req.endpoint,
+            json=req.body,
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+        ) as resp:
+            sample.status = resp.status
+            ctype = resp.headers.get("Content-Type", "")
+            if resp.status == 200 and "text/event-stream" in ctype:
+                await _consume_sse(resp, sample, due_t, loop)
+            else:
+                try:
+                    payload = await resp.json()
+                except Exception:
+                    payload = None
+                sample.e2e_s = loop.time() - due_t
+                if resp.status == 200:
+                    sample.kind = "ok"
+                    sample.ok = True
+                    # non-streaming: first byte IS the full body
+                    sample.ttft_s = sample.e2e_s
+                    usage = (
+                        payload.get("usage", {})
+                        if isinstance(payload, dict) else {}
+                    )
+                    sample.tokens = usage.get("completion_tokens", 0)
+                else:
+                    sample.kind = classify_http_error(resp.status, payload)
+                    sample.error = json.dumps(payload)[:300] if payload \
+                        else None
+    # both spellings: on py3.10 asyncio.TimeoutError is not the builtin
+    except (TimeoutError, asyncio.TimeoutError):
+        sample.e2e_s = loop.time() - due_t
+        sample.kind = "client_timeout"
+    except aiohttp.ClientError as exc:
+        sample.e2e_s = loop.time() - due_t
+        sample.kind = "transport"
+        sample.error = repr(exc)
+    except asyncio.CancelledError:
+        sample.kind = "cancelled"
+        raise
+    except Exception as exc:  # noqa: BLE001 — the lab must never lose a
+        # sample: an unclassified failure is a typed driver_error the
+        # drills assert to be zero
+        sample.e2e_s = loop.time() - due_t
+        sample.kind = "driver_error"
+        sample.error = repr(exc)
+
+
+async def drive_cell(
+    base_url: str,
+    plan: List[PlannedRequest],
+    *,
+    timeout_s: float = 60.0,
+    headers: Optional[Dict[str, str]] = None,
+    extra_tasks: Optional[List[Any]] = None,
+) -> List[Sample]:
+    """Fire one cell's plan open-loop; returns every sample (len ==
+    len(plan) — no request is ever dropped).  ``extra_tasks`` are
+    awaitables run alongside the load (chaos arming, watchers); their
+    failures are re-raised after the cell completes."""
+    samples: List[Sample] = []
+    connector = aiohttp.TCPConnector(limit=0)  # open loop: no conn cap
+    loop = asyncio.get_running_loop()
+    async with aiohttp.ClientSession(
+        connector=connector, headers=headers
+    ) as session:
+        t0 = loop.time()
+        tasks = [
+            asyncio.ensure_future(
+                _fire(session, base_url, req, t0, timeout_s, samples)
+            )
+            for req in plan
+        ]
+        side = [
+            asyncio.ensure_future(t) for t in (extra_tasks or [])
+        ]
+        await asyncio.gather(*tasks)
+        for s in side:
+            if not s.done():
+                s.cancel()
+        side_results = await asyncio.gather(*side, return_exceptions=True)
+    for r in side_results:
+        if isinstance(r, Exception) and not isinstance(
+            r, asyncio.CancelledError
+        ):
+            raise r
+    return samples
+
+
+async def run_serial(
+    base_url: str,
+    plan: List[PlannedRequest],
+    *,
+    timeout_s: float = 60.0,
+) -> List[Sample]:
+    """Serial (closed-loop, unmeasured) pass — used only for warmup."""
+    samples: List[Sample] = []
+    loop = asyncio.get_running_loop()
+    async with aiohttp.ClientSession() as session:
+        for req in plan:
+            await _fire(
+                session, base_url, req, loop.time() - req.offset_s,
+                timeout_s, samples,
+            )
+    return samples

@@ -1,0 +1,277 @@
+"""SLO grading: samples -> per-tier goodput per QPS cell, knee
+detection, and the machine-readable JSONL artifact the compare tool
+gates on.
+
+Goodput semantics: a request is GOOD when it completed cleanly AND met
+every bound in its tier's SLOSpec (ttft/tpot/e2e).  The denominator is
+every request offered to that tier in the cell — errors, sheds and
+timeouts all count against goodput.  A tier with no SLO grades on
+clean completion alone (availability goodput).
+
+Artifact layout (one JSON object per line):
+
+    {"kind": "meta", "schema": "vgate.loadlab/v1", ...}   # stamp
+    {"kind": "cell", "qps": 2.0, "tiers": {...}, ...}     # per cell
+    {"kind": "summary", "max_goodput_qps": ..., ...}      # knee et al
+
+The schema field list is pinned by tests/test_loadlab.py — additive
+evolution only (compare must keep reading old artifacts).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .driver import Sample
+from .scenario import SLOSpec
+
+SCHEMA = "vgate.loadlab/v1"
+
+# pinned by test_loadlab.py::test_artifact_schema_stability — widen,
+# never narrow or rename
+META_REQUIRED = (
+    "kind", "schema", "scenario", "scenario_hash", "seed", "ts",
+    "platform", "device", "git_sha", "config_fingerprint", "base_url",
+    "slos",
+)
+CELL_REQUIRED = (
+    "kind", "qps", "offered", "completed", "duration_s", "tiers",
+    "overall", "unhandled_errors", "send_lag_p99_s", "valid",
+)
+SUMMARY_REQUIRED = (
+    "kind", "max_goodput_qps", "knee_qps", "per_tier_max_goodput_qps",
+    "unhandled_errors", "cells",
+)
+
+# a cell "sustains" its offered QPS when goodput clears this; the knee
+# summary reports the highest such cell
+GOODPUT_TARGET = 0.9
+
+# failure kinds that mean the LAB (not the server) misbehaved; drills
+# assert the artifact reports zero of these
+UNHANDLED_KINDS = ("driver_error", "transport", "cancelled")
+
+
+def percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile on an ascending list (None when empty)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def dist_ms(values_s: Iterable[Optional[float]]) -> Dict[str, Any]:
+    """{p50,p95,p99,mean,max,n} in milliseconds over the non-None
+    values."""
+    vals = sorted(v for v in values_s if v is not None)
+    if not vals:
+        return {"n": 0}
+    return {
+        "n": len(vals),
+        "mean": round(sum(vals) / len(vals) * 1000, 1),
+        "p50": round(percentile(vals, 0.50) * 1000, 1),
+        "p95": round(percentile(vals, 0.95) * 1000, 1),
+        "p99": round(percentile(vals, 0.99) * 1000, 1),
+        "max": round(vals[-1] * 1000, 1),
+    }
+
+
+def meets_slo(sample: Sample, spec: Optional[SLOSpec]) -> bool:
+    if not sample.ok:
+        return False
+    if spec is None:
+        return True
+    if spec.ttft_ms is not None and (
+        sample.ttft_s is None or sample.ttft_s * 1000 > spec.ttft_ms
+    ):
+        return False
+    if spec.tpot_ms is not None and (
+        sample.tpot_s is not None and sample.tpot_s * 1000 > spec.tpot_ms
+    ):
+        return False
+    if spec.e2e_ms is not None and (
+        sample.e2e_s is None or sample.e2e_s * 1000 > spec.e2e_ms
+    ):
+        return False
+    return True
+
+
+def grade_cell(
+    samples: List[Sample],
+    slos: Dict[str, SLOSpec],
+    *,
+    qps: float,
+    duration_s: float,
+) -> Dict[str, Any]:
+    """One artifact ``cell`` line (minus the server-side block the
+    runner merges in)."""
+    tiers: Dict[str, Dict[str, Any]] = {}
+    by_tier: Dict[str, List[Sample]] = {}
+    for s in samples:
+        by_tier.setdefault(s.tier, []).append(s)
+    for tier, rows in sorted(by_tier.items()):
+        spec = slos.get(tier)
+        good = sum(1 for s in rows if meets_slo(s, spec))
+        errors: Dict[str, int] = {}
+        for s in rows:
+            if s.kind != "ok":
+                errors[s.kind] = errors.get(s.kind, 0) + 1
+        tiers[tier] = {
+            "n": len(rows),
+            "ok": sum(1 for s in rows if s.ok),
+            "slo_met": good,
+            "goodput": round(good / len(rows), 4) if rows else None,
+            "ttft_ms": dist_ms(s.ttft_s for s in rows if s.ok),
+            "tpot_ms": dist_ms(s.tpot_s for s in rows if s.ok),
+            "e2e_ms": dist_ms(s.e2e_s for s in rows if s.ok),
+            "errors": errors,
+            "slo": slos[tier].to_dict() if tier in slos else None,
+        }
+    n = len(samples)
+    good_all = sum(
+        1 for s in samples if meets_slo(s, slos.get(s.tier))
+    )
+    unhandled = sum(1 for s in samples if s.kind in UNHANDLED_KINDS)
+    lag = sorted(s.send_lag_s for s in samples)
+    lag_p99 = percentile(lag, 0.99) or 0.0
+    from .driver import SEND_LAG_BOUND_S
+
+    return {
+        "kind": "cell",
+        "qps": qps,
+        "duration_s": duration_s,
+        "offered": n,
+        "completed": sum(1 for s in samples if s.ok),
+        "tiers": tiers,
+        "overall": {
+            "goodput": round(good_all / n, 4) if n else None,
+            "ok": sum(1 for s in samples if s.ok),
+            "good_qps": round(good_all / duration_s, 3)
+            if duration_s > 0 else None,
+        },
+        "unhandled_errors": unhandled,
+        "send_lag_p99_s": round(lag_p99, 4),
+        # a cell where the measuring host itself lagged is stamped
+        # invalid rather than silently reported (client-side clipping
+        # corrupts tails in the flattering direction)
+        "valid": lag_p99 <= SEND_LAG_BOUND_S,
+    }
+
+
+# -- knee detection -------------------------------------------------------
+
+def max_goodput_qps(
+    cells: List[Tuple[float, Optional[float]]],
+    target: float = GOODPUT_TARGET,
+) -> Optional[float]:
+    """Highest offered QPS whose goodput clears ``target`` (None when no
+    cell does).  This is the headline "max goodput QPS" number."""
+    ok = [q for q, g in cells if g is not None and g >= target]
+    return max(ok) if ok else None
+
+
+def knee_qps(
+    cells: List[Tuple[float, Optional[float]]]
+) -> Optional[float]:
+    """The saturation knee: the offered QPS after which DELIVERED good
+    throughput (qps x goodput) stops improving.  Scanning in offered-QPS
+    order, returns the cell with peak delivered goodput — past the knee,
+    offering more traffic returns less good work."""
+    best_q: Optional[float] = None
+    best_delivered = -1.0
+    for q, g in sorted(cells):
+        if g is None:
+            continue
+        delivered = q * g
+        if delivered > best_delivered:
+            best_delivered = delivered
+            best_q = q
+    return best_q
+
+
+def summarize(
+    cell_lines: List[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """The artifact ``summary`` line from its cell lines."""
+    # lag-invalidated cells carry untrustworthy goodput — they appear
+    # in `cells`/`invalid_cells` but never feed the knee numbers
+    valid_cells = [c for c in cell_lines if c.get("valid", True)]
+    overall = [
+        (c["qps"], (c.get("overall") or {}).get("goodput"))
+        for c in valid_cells
+    ]
+    per_tier: Dict[str, List[Tuple[float, Optional[float]]]] = {}
+    for c in valid_cells:
+        for tier, t in (c.get("tiers") or {}).items():
+            per_tier.setdefault(tier, []).append(
+                (c["qps"], t.get("goodput"))
+            )
+    return {
+        "kind": "summary",
+        "cells": [c["qps"] for c in cell_lines],
+        "max_goodput_qps": max_goodput_qps(overall),
+        "knee_qps": knee_qps(overall),
+        "per_tier_max_goodput_qps": {
+            tier: max_goodput_qps(rows)
+            for tier, rows in sorted(per_tier.items())
+        },
+        "goodput_target": GOODPUT_TARGET,
+        "unhandled_errors": sum(
+            c.get("unhandled_errors", 0) for c in cell_lines
+        ),
+        "invalid_cells": [
+            c["qps"] for c in cell_lines if not c.get("valid", True)
+        ],
+    }
+
+
+# -- artifact io ----------------------------------------------------------
+
+def write_artifact(path: str, lines: List[Dict[str, Any]]) -> None:
+    with open(path, "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    """Parse an artifact back into {meta, cells, summary}; raises on a
+    file that is not a loadlab artifact."""
+    meta: Optional[Dict[str, Any]] = None
+    cells: List[Dict[str, Any]] = []
+    summary: Optional[Dict[str, Any]] = None
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            row = json.loads(raw)
+            kind = row.get("kind")
+            if kind == "meta":
+                meta = row
+            elif kind == "cell":
+                cells.append(row)
+            elif kind == "summary":
+                summary = row
+    if meta is None or meta.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: not a {SCHEMA} artifact (missing/foreign meta line)"
+        )
+    return {"meta": meta, "cells": cells, "summary": summary}
+
+
+def validate_lines(lines: List[Dict[str, Any]]) -> List[str]:
+    """Schema self-check: list of missing-key complaints (empty = ok)."""
+    problems: List[str] = []
+    required = {
+        "meta": META_REQUIRED, "cell": CELL_REQUIRED,
+        "summary": SUMMARY_REQUIRED,
+    }
+    for i, line in enumerate(lines):
+        kind = line.get("kind")
+        for key in required.get(kind, ()):
+            if key not in line:
+                problems.append(f"line {i} ({kind}): missing {key!r}")
+    return problems

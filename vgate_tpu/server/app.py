@@ -1294,6 +1294,86 @@ async def debug_request_detail(request: web.Request) -> web.Response:
     return web.json_response(record)
 
 
+def _faults_http_enabled() -> bool:
+    """The live fault-arming surface is OFF unless the process opted in
+    with ``VGT_FAULTS_HTTP=1`` — drills and the loadlab chaos arm set
+    it; a production deployment never should (an armed fault is a real
+    outage, auth or no auth)."""
+    return os.environ.get("VGT_FAULTS_HTTP") == "1"
+
+
+async def debug_faults(request: web.Request) -> web.Response:
+    """GET /debug/faults — armed-fault inventory (same payload shape as
+    the /stats faults block)."""
+    from vgate_tpu import faults
+
+    return web.json_response(
+        {"enabled": _faults_http_enabled(), "armed": faults.snapshot()}
+    )
+
+
+async def debug_faults_arm(request: web.Request) -> web.Response:
+    """POST /debug/faults {"faults": "point:mode[:k=v...]", "chaos": p}
+    — arm fault points on the LIVE server (the loadlab chaos arm:
+    scenarios replay the PR 1-9 fault drills mid-cell, under measured
+    load).  Parsing is exactly ``VGT_FAULTS``/``VGT_CHAOS`` env syntax
+    via faults.arm_from_env; gated on VGT_FAULTS_HTTP=1 plus the usual
+    auth middleware."""
+    from vgate_tpu import faults
+
+    if not _faults_http_enabled():
+        return _error(
+            403,
+            "live fault arming is disabled (start the server with "
+            "VGT_FAULTS_HTTP=1 to enable this drill-only surface)",
+            "invalid_request_error",
+        )
+    try:
+        body = await request.json()
+    except Exception:
+        body = None
+    if not isinstance(body, dict):
+        return _error(
+            400, "body must be a JSON object", "invalid_request_error"
+        )
+    spec = body.get("faults", "")
+    chaos = body.get("chaos", "")
+    if not spec and not chaos:
+        return _error(
+            400, "provide 'faults' (VGT_FAULTS syntax) and/or 'chaos' "
+            "(probability)", "invalid_request_error",
+        )
+    env: Dict[str, str] = {}
+    if spec:
+        env["VGT_FAULTS"] = str(spec)
+    if chaos:
+        env["VGT_CHAOS"] = str(chaos)
+    armed = faults.arm_from_env(env)
+    logger.warning(
+        "faults armed via HTTP", extra={"extra_data": {
+            "spec": spec, "chaos": chaos, "armed": armed,
+        }},
+    )
+    return web.json_response(
+        {"armed": armed, "active": faults.snapshot()}
+    )
+
+
+async def debug_faults_disarm(request: web.Request) -> web.Response:
+    """DELETE /debug/faults[?point=] — disarm (all points by default)."""
+    from vgate_tpu import faults
+
+    if not _faults_http_enabled():
+        return _error(
+            403,
+            "live fault arming is disabled (start the server with "
+            "VGT_FAULTS_HTTP=1 to enable this drill-only surface)",
+            "invalid_request_error",
+        )
+    faults.disarm(request.query.get("point") or None)
+    return web.json_response({"armed": 0, "active": faults.snapshot()})
+
+
 def _replica_manager_of(app: web.Application):
     """The live dp ReplicatedEngine behind the /admin/replicas surface
     and the SIGUSR1 drain path, or None — dp=1 deployments (EngineCore
@@ -1701,6 +1781,11 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     app.router.add_get("/debug/flight", debug_flight)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{ident}", debug_request_detail)
+    # drill-only chaos surface (403 unless VGT_FAULTS_HTTP=1): the
+    # loadlab chaos arm replays fault drills mid-cell through it
+    app.router.add_get("/debug/faults", debug_faults)
+    app.router.add_post("/debug/faults", debug_faults_arm)
+    app.router.add_delete("/debug/faults", debug_faults_disarm)
     # replica operations (live migration / elastic dp) — auth-gated
     # like every non-exempt path, excluded from drain accounting
     app.router.add_get("/admin/replicas", admin_replicas)
