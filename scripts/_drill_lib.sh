@@ -15,6 +15,40 @@
 # drills, and otherwise fails fast with a clear message instead of
 # letting the drill misattribute failures.
 
+# ---------------------------------------------------------------------
+# Drill port registry — the ONE place a drill's default port is
+# assigned.  slo_check.sh had to hand-resolve a collision (its ISSUE
+# said 8736, which integrity_check already held); with every drill
+# resolving its port by NAME from this table, the next drill takes the
+# next free number instead of guessing.  Secondary servers a drill
+# boots (e.g. prefix_check's cache-off replay) use PORT+40 by
+# convention, well clear of this block.
+#
+#   PORT="${1:-$(drill_port swap)}"
+#
+declare -A VGT_DRILL_PORTS=(
+  [drain]=8731
+  [prefix]=8732
+  [overload]=8733
+  [resume]=8734
+  [migrate]=8735
+  [integrity]=8736
+  [slo]=8737
+  [swap]=8738
+)
+
+drill_port() {
+  local name="$1"
+  local port="${VGT_DRILL_PORTS[$name]:-}"
+  if [[ -z "$port" ]]; then
+    echo "drill_port: unknown drill name '$name' (known:" \
+         "${!VGT_DRILL_PORTS[*]}) — register it in" \
+         "scripts/_drill_lib.sh" >&2
+    return 1
+  fi
+  echo "$port"
+}
+
 _drill_pidfile() {
   echo "/tmp/vgt_drill_port_$1.pid"
 }

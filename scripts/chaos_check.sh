@@ -44,3 +44,6 @@ bash scripts/integrity_check.sh
 
 echo "== SLO-graded workload-lab drill =="
 bash scripts/slo_check.sh
+
+echo "== host-RAM KV swap tier drill =="
+bash scripts/swap_check.sh

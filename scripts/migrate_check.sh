@@ -18,8 +18,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-PORT="${1:-8735}"
 source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port migrate)}"
 ensure_port_free "$PORT"
 export JAX_PLATFORMS=cpu
 # two virtual CPU devices so dp=2 gets disjoint submeshes

@@ -15,8 +15,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-PORT="${1:-8733}"
 source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port overload)}"
 ensure_port_free "$PORT"
 export JAX_PLATFORMS=cpu
 export VGT_DRY_RUN=1

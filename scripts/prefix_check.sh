@@ -24,9 +24,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-PORT="${1:-8732}"
-PORT_OFF="${2:-$((PORT + 40))}"
 source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port prefix)}"
+PORT_OFF="${2:-$((PORT + 40))}"
 ensure_port_free "$PORT"
 ensure_port_free "$PORT_OFF"
 export JAX_PLATFORMS=cpu

@@ -19,8 +19,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-PORT="${1:-8734}"
 source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port resume)}"
 ensure_port_free "$PORT"
 export JAX_PLATFORMS=cpu
 export VGT_SERVER__PORT="$PORT"

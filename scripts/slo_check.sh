@@ -21,10 +21,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# 8737: 8736 already belongs to integrity_check.sh (one port per drill
-# so ensure_port_free's stale-server kill never crosses drills)
-PORT="${1:-8737}"
+# one port per drill so ensure_port_free's stale-server kill never
+# crosses drills; assignments live in _drill_lib.sh's port registry
 source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port slo)}"
 ensure_port_free "$PORT"
 
 # export the scenario's server_env verbatim (the YAML is the single

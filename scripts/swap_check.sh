@@ -1,0 +1,357 @@
+#!/usr/bin/env bash
+# Host-RAM KV swap tier gate (sibling of prefix_check.sh /
+# slo_check.sh): boot a CPU tiny-dense server with a squeezed KV pool
+# and the host swap pool ON, drive min_tokens-pinned concurrent
+# decodes that force preemption, and assert
+#   1. ZERO 5xx through the KV squeeze (preemption under pressure never
+#      becomes a client-visible failure),
+#   2. preempted sequences resumed via SWAP-IN, not recompute:
+#      scheduler.preemptions > 0, swap_preempts == preemptions,
+#      vgt_preempt_recompute_tokens stays 0 while the
+#      vgt_kv_swap_{out,in}_pages counters move,
+#   3. token identity: an UNPRESSURED swap-off server (same
+#      deterministic random-init weights) reproduces byte-identical
+#      completions — the swapped-in KV continued the exact stream
+#      (and host_swap_bytes: 0 remains the pre-PR engine),
+#   4. the swap-off squeezed rerun shows the recompute baseline:
+#      vgt_preempt_recompute_tokens > 0 for the same workload,
+#   5. loadlab goodput: the smoke_mixed overload cell with the swap
+#      tier on grades per-tier goodput >= the swap-off baseline
+#      (python -m vgate_tpu.loadlab.compare, same seed/scenario hash;
+#      --allow-config-change because the kv_cache config fingerprint
+#      legitimately differs between the arms).
+#
+# Usage: scripts/swap_check.sh [port] [--no-loadlab]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port swap)}"
+PORT_B="$((PORT + 40))"
+RUN_LOADLAB=1
+[[ "${2:-}" == "--no-loadlab" ]] && RUN_LOADLAB=0
+ensure_port_free "$PORT"
+ensure_port_free "$PORT_B"
+
+common_env() {
+  export JAX_PLATFORMS=cpu
+  export VGT_LOGGING__LEVEL=WARNING
+  export VGT_MODEL__MODEL_ID=tiny-dense
+  export VGT_MODEL__ENGINE_TYPE=jax_tpu
+  export VGT_MODEL__DTYPE=float32
+  export VGT_MODEL__MAX_MODEL_LEN=96
+  export VGT_TPU__DP=1 VGT_TPU__TP=1 VGT_TPU__EP=1 VGT_TPU__SP=1
+  export VGT_TPU__NUM_DEVICES=1
+  export VGT_TPU__KV_PAGE_SIZE=4
+  export VGT_TPU__MAX_BATCH_SLOTS=4
+  export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+  export VGT_TPU__USE_PALLAS=false
+  export VGT_TPU__PREFIX_CACHE='{"enabled": true, "cow_min_tokens": 2}'
+  export VGT_BATCH__MAX_BATCH_SIZE=8
+  export VGT_BATCH__MAX_WAIT_TIME_MS=10
+  # identity replays must exercise the engine, not the result cache;
+  # admission's kv shed is off so the drill measures the swap ladder,
+  # not door-level shedding
+  export VGT_CACHE__ENABLED=false
+  export VGT_ADMISSION__KV_FREE_WATERMARK=0
+}
+
+wait_ready() {
+  local base="$1"
+  for _ in $(seq 1 300); do
+    if curl -fsS "$base/health/ready" >/dev/null 2>&1; then return 0; fi
+    sleep 0.2
+  done
+  echo "FAIL: server at $base never became ready"; exit 1
+}
+
+TRACE_JSON="$(mktemp /tmp/vgt_swap_trace.XXXXXX.json)"
+
+# ---------------------------------------------------------------------
+echo "== phase 1: squeezed pool + host swap ON (forced preemption) =="
+common_env
+export VGT_SERVER__PORT="$PORT"
+export VGT_TPU__KV_NUM_PAGES=40
+export VGT_KV_CACHE__HOST_SWAP_BYTES=$((16 * 1024 * 1024))
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill -9 $SERVER_PID ${SERVER_B_PID:-} 2>/dev/null || true; clear_drill_pid "$PORT"; clear_drill_pid "$PORT_B"' EXIT
+BASE="http://127.0.0.1:$PORT"
+wait_ready "$BASE"
+snapshot_kv_config "$BASE" swap_check_on
+
+python - "$BASE" "$TRACE_JSON" phase1 <<'EOF'
+import asyncio, json, re, sys
+import aiohttp
+
+BASE, TRACE_JSON, PHASE = sys.argv[1], sys.argv[2], sys.argv[3]
+# 8 concurrent min_tokens-pinned greedy decodes on a 4-slot server
+# with a 40-page pool: each grows to ~52 tokens (13 pages), 4 resident
+# need 52 pages > 40 -> the scheduler MUST preempt mid-decode
+PROMPTS = [
+    f"user {i} asks about topic {i*7%13} with context tail {i}"
+    for i in range(8)
+]
+BODY = {"max_tokens": 40, "min_tokens": 40, "temperature": 0.0}
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        async def one(p):
+            async with session.post(
+                f"{BASE}/v1/completions", json={"prompt": p, **BODY}
+            ) as resp:
+                return resp.status, await resp.json()
+
+        results = await asyncio.gather(*(one(p) for p in PROMPTS))
+        statuses = [s for s, _ in results]
+        assert not [s for s in statuses if s >= 500], (
+            f"5xx under KV pressure: {statuses}"
+        )
+        assert all(s == 200 for s in statuses), statuses
+        outputs = {
+            p: body["choices"][0]["text"]
+            for p, (_, body) in zip(PROMPTS, results)
+        }
+
+        async with session.get(f"{BASE}/stats") as resp:
+            stats = await resp.json()
+        sched = stats["engine"]["scheduler"]
+        swap = stats["engine"].get("kv_swap") or {}
+        async with session.get(f"{BASE}/metrics") as resp:
+            metrics_text = await resp.text()
+
+        def metric(name, default=0.0):
+            total = 0.0
+            found = False
+            for line in metrics_text.splitlines():
+                if line.startswith(name) and not line.startswith("#"):
+                    total += float(line.split()[-1])
+                    found = True
+            return total if found else default
+
+        print(
+            f"preemptions={sched['preemptions']} "
+            f"swap_preempts={sched['swap_preempts']} "
+            f"recompute_tokens={sched['preempt_recompute_tokens']} "
+            f"swap_out={swap.get('swap_out_pages')} "
+            f"swap_in={swap.get('swap_in_pages')} "
+            f"host_bytes={metric('vgt_kv_host_pool_bytes')}"
+        )
+        assert sched["preemptions"] > 0, (
+            "the pool was never squeezed into preempting — the drill "
+            "proves nothing about the swap tier"
+        )
+        assert sched["swap_preempts"] == sched["preemptions"], (
+            "some preemptions fell back to recompute with the host "
+            f"pool on: {sched['swap_preempts']}/{sched['preemptions']}"
+        )
+        assert sched["preempt_recompute_tokens"] == 0, (
+            f"recompute tokens burned with swap on: "
+            f"{sched['preempt_recompute_tokens']}"
+        )
+        assert metric("vgt_preempt_recompute_tokens_total") == 0
+        assert swap["swap_in_pages"]["preempt"] > 0, swap
+        assert metric("vgt_kv_swap_out_pages_total") > 0
+        assert metric("vgt_kv_swap_in_pages_total") > 0
+    with open(TRACE_JSON, "w") as fh:
+        json.dump(outputs, fh)
+    print(f"PASS {PHASE}: 8/8 ok, zero 5xx, "
+          f"{sched['preemptions']} preemptions all swap-resumed, "
+          "0 recompute tokens")
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+clear_drill_pid "$PORT"
+
+# ---------------------------------------------------------------------
+echo "== phase 2: UNPRESSURED swap-off server — token identity =="
+common_env
+export VGT_SERVER__PORT="$PORT_B"
+export VGT_TPU__KV_NUM_PAGES=400
+export VGT_KV_CACHE__HOST_SWAP_BYTES=0
+python main.py &
+SERVER_B_PID=$!
+record_drill_pid "$PORT_B" "$SERVER_B_PID"
+BASE_B="http://127.0.0.1:$PORT_B"
+wait_ready "$BASE_B"
+snapshot_kv_config "$BASE_B" swap_check_off
+
+python - "$BASE_B" "$TRACE_JSON" <<'EOF'
+import asyncio, json, sys
+import aiohttp
+
+BASE, TRACE_JSON = sys.argv[1], sys.argv[2]
+with open(TRACE_JSON) as fh:
+    want = json.load(fh)
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        for prompt, expect in want.items():
+            async with session.post(
+                f"{BASE}/v1/completions",
+                json={"prompt": prompt, "max_tokens": 40,
+                      "min_tokens": 40, "temperature": 0.0},
+            ) as resp:
+                assert resp.status == 200, resp.status
+                body = await resp.json()
+            got = body["choices"][0]["text"]
+            assert got == expect, (
+                "swap-resumed output diverged from the unpressured "
+                f"run:\n  swap: {expect!r}\n  ref:  {got!r}"
+            )
+        async with session.get(f"{BASE}/stats") as resp:
+            stats = await resp.json()
+        assert "kv_swap" not in stats["engine"], (
+            "host_swap_bytes=0 must leave no swap surface"
+        )
+    print(f"PASS phase 2: {len(want)} completions token-identical to "
+          "the unpressured swap-off engine")
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_B_PID" 2>/dev/null || true
+wait "$SERVER_B_PID" 2>/dev/null || true
+clear_drill_pid "$PORT_B"
+
+# ---------------------------------------------------------------------
+echo "== phase 3: squeezed pool, swap OFF — recompute baseline =="
+common_env
+export VGT_SERVER__PORT="$PORT_B"
+export VGT_TPU__KV_NUM_PAGES=40
+export VGT_KV_CACHE__HOST_SWAP_BYTES=0
+python main.py &
+SERVER_B_PID=$!
+record_drill_pid "$PORT_B" "$SERVER_B_PID"
+wait_ready "$BASE_B"
+
+python - "$BASE_B" "$TRACE_JSON" <<'EOF'
+import asyncio, json, sys
+import aiohttp
+
+BASE, TRACE_JSON = sys.argv[1], sys.argv[2]
+with open(TRACE_JSON) as fh:
+    want = json.load(fh)
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        async def one(p):
+            async with session.post(
+                f"{BASE}/v1/completions",
+                json={"prompt": p, "max_tokens": 40, "min_tokens": 40,
+                      "temperature": 0.0},
+            ) as resp:
+                return resp.status, await resp.json()
+
+        results = await asyncio.gather(*(one(p) for p in want))
+        statuses = [s for s, _ in results]
+        assert not [s for s in statuses if s >= 500], statuses
+        for p, (_, body) in zip(want, results):
+            assert body["choices"][0]["text"] == want[p], (
+                "recompute path diverged (it must also be greedy-"
+                "identical)"
+            )
+        async with session.get(f"{BASE}/stats") as resp:
+            stats = await resp.json()
+        sched = stats["engine"]["scheduler"]
+        print(
+            f"preemptions={sched['preemptions']} "
+            f"recompute_tokens={sched['preempt_recompute_tokens']}"
+        )
+        assert sched["preemptions"] > 0
+        assert sched["preempt_recompute_tokens"] > 0, (
+            "swap-off squeezed rerun burned no recompute tokens — the "
+            "baseline comparison proves nothing"
+        )
+    print("PASS phase 3: recompute baseline shows "
+          f"{sched['preempt_recompute_tokens']} wasted tokens for the "
+          "same workload the swap tier served with 0")
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_B_PID" 2>/dev/null || true
+wait "$SERVER_B_PID" 2>/dev/null || true
+clear_drill_pid "$PORT_B"
+rm -f "$TRACE_JSON"
+
+# ---------------------------------------------------------------------
+if [[ "$RUN_LOADLAB" == "1" ]]; then
+  echo "== phase 4: loadlab smoke_mixed goodput, swap vs swap-off =="
+  # the scenario's server_env is the single definition site; the drill
+  # only overrides the KV squeeze (so the overload cell pressures the
+  # PAGED POOL, not just decode speed) and flips the swap arm
+  eval "$(python - <<'PY'
+import shlex
+from vgate_tpu.loadlab import load_scenario
+for k, v in load_scenario("smoke_mixed").server_env.items():
+    print(f"export {k}={shlex.quote(str(v))}")
+PY
+)"
+  export VGT_SERVER__PORT="$PORT"
+  export VGT_TPU__KV_NUM_PAGES=320
+  ART_OFF=/tmp/vgt_swap_check_off.jsonl
+  ART_ON=/tmp/vgt_swap_check_on.jsonl
+  rm -f "$ART_OFF" "$ART_ON"
+
+  for arm in off on; do
+    if [[ "$arm" == "on" ]]; then
+      export VGT_KV_CACHE__HOST_SWAP_BYTES=$((32 * 1024 * 1024))
+      ART="$ART_ON"
+    else
+      export VGT_KV_CACHE__HOST_SWAP_BYTES=0
+      ART="$ART_OFF"
+    fi
+    ensure_port_free "$PORT"
+    python main.py &
+    SERVER_PID=$!
+    record_drill_pid "$PORT" "$SERVER_PID"
+    wait_ready "$BASE"
+    snapshot_kv_config "$BASE" "swap_check_loadlab_$arm"
+    python -m vgate_tpu.loadlab run \
+      --scenario smoke_mixed --base-url "$BASE" \
+      --out "$ART" --platform cpu --device "cpu-swap-$arm"
+    kill -TERM "$SERVER_PID" 2>/dev/null || true
+    for _ in $(seq 1 100); do
+      kill -0 "$SERVER_PID" 2>/dev/null || break
+      sleep 0.3
+    done
+    kill -9 "$SERVER_PID" 2>/dev/null || true
+    wait "$SERVER_PID" 2>/dev/null || true
+    clear_drill_pid "$PORT"
+  done
+
+  # compare old=swap-off new=swap-on on the OVERLOAD cell: exits
+  # nonzero if any tier's goodput DROPPED > 0.05 — i.e. the gate is
+  # "swap >= baseline" exactly where KV pressure bites (the quiet
+  # cell's ~10 samples/tier would only gate noise).  Same seed +
+  # scenario hash by construction; the kv_cache config fingerprint
+  # legitimately differs between the arms.
+  OVERLOAD_QPS="$(python -c \
+    "from vgate_tpu.loadlab import load_scenario; \
+     print(load_scenario('smoke_mixed').qps_cells[-1])")"
+  # the acceptance criterion is GOODPUT; TTFT tails in a chaos-armed
+  # overload cell are dominated by where the mid-cell engine crash
+  # lands in each run, so the tail gate is effectively disarmed here
+  python -m vgate_tpu.loadlab.compare "$ART_OFF" "$ART_ON" \
+    --allow-config-change --cells "$OVERLOAD_QPS" \
+    --max-tail-rise 10.0
+  echo "PASS phase 4: smoke_mixed overload-cell per-tier goodput with" \
+       "swap >= the swap-off baseline (compare tool green)"
+fi
+
+trap - EXIT
+echo "swap_check: OK"

@@ -378,6 +378,35 @@ def test_503_overloaded_is_typed_with_retry_after():
     assert err.value.retry_after == 7.0
 
 
+def test_503_kv_capacity_is_typed_with_retry_after():
+    """ISSUE 12: the engine's KV-exhausted failure surfaces as a typed
+    503 with body reason "kv_capacity" and the SDK maps it — clients
+    retry against a less-loaded replica instead of treating an opaque
+    500 as a server bug."""
+    from vgate_tpu_client import KVCapacityError, ServerOverloadedError
+
+    def handler(request):
+        return httpx.Response(
+            503,
+            headers={"Retry-After": "5"},
+            json={
+                "error": {
+                    "message": "KV pages exhausted: the sequence's "
+                    "grown context cannot fit the pool even alone",
+                    "type": "unavailable_error",
+                    "reason": "kv_capacity",
+                }
+            },
+        )
+
+    client = make_client(handler, max_retries=0)
+    with pytest.raises(KVCapacityError) as err:
+        client.chat.create([{"role": "user", "content": "x"}])
+    assert err.value.status_code == 503
+    assert err.value.retry_after == 5.0
+    assert not isinstance(err.value, ServerOverloadedError)
+
+
 def test_503_draining_stays_plain_server_error():
     from vgate_tpu_client import ServerOverloadedError
 

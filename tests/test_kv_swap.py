@@ -1,0 +1,489 @@
+"""Host-RAM KV swap tier (vgate_tpu/runtime/kv_swap.py).
+
+Three layers, mirroring the subsystem's own split:
+
+* **Manager units** against a fake device executor — budget/ticket
+  accounting, epoch staleness, the seq-over-prefix priority under
+  budget pressure, brownout demote gating.
+* **Scheduler integration** (real allocator, fake executor) — preempt
+  swaps out instead of folding, re-admission returns a SwapInPlan,
+  pool-full falls back to recompute with the waste metric counted,
+  exhaustion failures are typed KVCapacityError.
+* **Engine e2e** (CPU tiny-dense, fast tier) — under forced KV
+  pressure with the host pool on, preempted sequences resume via
+  swap-in with ZERO recompute tokens and token-identical greedy
+  output; the swap-off engine shows the recompute baseline.
+"""
+
+import logging
+
+import pytest
+
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.errors import KVCapacityError
+from vgate_tpu.runtime.kv_cache import PageAllocator
+from vgate_tpu.runtime.kv_swap import KVSwapManager
+from vgate_tpu.runtime.radix_cache import RadixCache
+from vgate_tpu.runtime.scheduler import Scheduler, SwapInPlan
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+
+PS = 4
+PAGE_BYTES = 64
+
+
+class FakeDevice:
+    """Fake executor: page id -> opaque content, so tests can assert
+    the swapped-back content is exactly what was swapped out."""
+
+    def __init__(self):
+        self.content = {}
+        self.reads = 0
+        self.writes = 0
+
+    def read_pages(self, pages):
+        self.reads += 1
+        return [self.content.get(p) for p in pages]
+
+    def write_pages(self, pages, payload):
+        self.writes += 1
+        assert len(pages) == len(payload)
+        for p, c in zip(pages, payload):
+            self.content[p] = c
+
+
+def make_mgr(budget_pages=16, dev=None):
+    dev = dev or FakeDevice()
+    return KVSwapManager(budget_pages * PAGE_BYTES, PAGE_BYTES, dev), dev
+
+
+def running_seq(n_prompt=6, n_out=3, pages=None):
+    seq = Sequence(
+        prompt_ids=list(range(2, 2 + n_prompt)),
+        params=SamplingParams(max_tokens=16),
+    )
+    seq.output_ids = list(range(50, 50 + n_out))
+    seq.generated_ids = list(seq.output_ids)
+    seq.status = SeqStatus.RUNNING
+    seq.pages = pages if pages is not None else [1, 2, 3]
+    seq.slot = 0
+    return seq
+
+
+# -------------------------------------------------------- manager units
+
+
+def test_swap_out_in_roundtrip_content_and_accounting():
+    mgr, dev = make_mgr()
+    for p in (1, 2, 3):
+        dev.content[p] = ("kv", p)
+    seq = running_seq(pages=[1, 2, 3])
+    assert mgr.swap_out_seq(seq, [1, 2, 3])
+    assert mgr.used_bytes == 3 * PAGE_BYTES
+    assert seq.swap_count == 1
+    # the scheduler resets the seq (epoch bump) and re-admits later
+    seq.reset_for_swap()
+    ticket = mgr.ticket_for(seq)
+    assert ticket is not None and ticket.num_pages == 3
+    # swap in to DIFFERENT pages: content must follow
+    seq.status = SeqStatus.RUNNING
+    seq.pages = [7, 8, 9]
+    assert mgr.swap_in_seq(seq, [7, 8, 9]) == 3
+    assert [dev.content[p] for p in (7, 8, 9)] == [
+        ("kv", 1), ("kv", 2), ("kv", 3)
+    ]
+    assert mgr.used_bytes == 0
+    assert getattr(seq, "_swap_ticket", None) is None
+
+
+def test_swap_out_refused_over_budget():
+    mgr, _ = make_mgr(budget_pages=2)
+    seq = running_seq(pages=[1, 2, 3])
+    assert not mgr.swap_out_seq(seq, [1, 2, 3])
+    assert mgr.used_bytes == 0 and mgr.total_refused == 1
+    assert seq.swap_count == 0
+
+
+def test_stale_epoch_discards_ticket():
+    """A containment/migration fold bumps preempt_count past the
+    ticket's epoch: ticket_for must discard, not resume a dead epoch."""
+    mgr, _ = make_mgr()
+    seq = running_seq()
+    assert mgr.swap_out_seq(seq, [1, 2, 3])
+    seq.reset_for_swap()
+    seq.reset_for_recompute()  # e.g. prepare_resume's fold
+    assert mgr.ticket_for(seq) is None
+    assert mgr.used_bytes == 0
+    assert mgr.total_discard_pages.get("stale") == 3
+
+
+def test_settled_seq_swept_to_make_room():
+    mgr, _ = make_mgr(budget_pages=4)
+    a = running_seq(pages=[1, 2, 3])
+    assert mgr.swap_out_seq(a, [1, 2, 3])
+    a.reset_for_swap()
+    a.fail(RuntimeError("client gone"))  # settled elsewhere
+    b = running_seq(pages=[4, 5, 6])
+    assert mgr.swap_out_seq(b, [4, 5, 6])  # room made by the sweep
+    assert mgr.total_discard_pages.get("settled") == 3
+    assert mgr.used_bytes == 3 * PAGE_BYTES
+    # regression: a late settle hook on the ALREADY-swept sequence must
+    # not refund its bytes a second time (the registry, not the seq
+    # attribute, is the accounting truth) — a double refund would let
+    # the pool pin host RAM beyond the budget
+    mgr.discard_for(a, "settled")
+    assert mgr.used_bytes == 3 * PAGE_BYTES
+    assert mgr.total_discard_pages.get("settled") == 3
+
+
+def test_seq_swap_evicts_prefix_lru_but_not_vice_versa():
+    """Client-owed work wins the budget: a preemption swap-out drops
+    victim-cache (prefix) tickets LRU-first; a demotion never rotates
+    other entries out."""
+    mgr, dev = make_mgr(budget_pages=4)
+
+    class Node:
+        pass
+
+    old, new = Node(), Node()
+    t_old = mgr.demote_node(old, [11, 12, 13])
+    assert t_old is not None
+    # a second demotion that would need eviction is refused instead
+    assert mgr.demote_node(new, [14, 15]) is None
+    assert mgr.total_refused == 1
+    # but a preemption swap-out takes the room, dropping the LRU ticket
+    dropped = []
+    mgr.on_drop_node = dropped.append
+    seq = running_seq(pages=[1, 2, 3])
+    assert mgr.swap_out_seq(seq, [1, 2, 3])
+    assert dropped == [old]
+    assert mgr.total_discard_pages.get("capacity") == 3
+
+
+def test_demote_suspended_gates_demotions_not_promotions():
+    mgr, dev = make_mgr()
+
+    class Node:
+        pass
+
+    node = Node()
+    ticket = mgr.demote_node(node, [11, 12])
+    assert ticket is not None
+    mgr.demote_suspended = True  # brownout L4
+    assert mgr.demote_node(Node(), [13]) is None
+    # promotions still served
+    assert mgr.promote_node(ticket, [21, 22])
+    assert mgr.total_swap_in_pages["prefix"] == 2
+    assert mgr.used_bytes == 0
+
+
+def test_signal_block_occupancy():
+    mgr, _ = make_mgr(budget_pages=8)
+    seq = running_seq(pages=[1, 2, 3, 4])
+    assert mgr.swap_out_seq(seq, [1, 2, 3, 4])
+    sig = mgr.signal_block()
+    assert sig["kv_swap_enabled"] is True
+    assert sig["kv_host_pool_bytes"] == 4 * PAGE_BYTES
+    assert sig["kv_host_free_ratio"] == 0.5
+    assert sig["kv_swapped_seqs"] == 1
+
+
+# ------------------------------------------------- scheduler integration
+
+
+def make_sched(num_pages=16, slots=2, budget_pages=32, radix=False):
+    alloc = PageAllocator(num_pages)
+    dev = FakeDevice()
+    mgr = KVSwapManager(budget_pages * PAGE_BYTES, PAGE_BYTES, dev)
+    rx = None
+    if radix:
+        rx = RadixCache(alloc, PS, cow_min_tokens=2)
+        alloc.set_reclaimer(rx)
+        rx.attach_swap(mgr)
+    sched = Scheduler(
+        allocator=alloc,
+        max_slots=slots,
+        page_size=PS,
+        prefill_buckets=[8, 16],
+        max_model_len=64,
+        max_queue_size=8,
+        prefix_cache=radix,
+        radix=rx,
+        swap=mgr,
+    )
+    return sched, alloc, mgr, dev
+
+
+def admit_and_decode(sched, n_prompt=6, steps=8):
+    """Admit one prompt, simulate its prefill + `steps` decode tokens."""
+    seq = Sequence(
+        prompt_ids=list(range(2, 2 + n_prompt)),
+        params=SamplingParams(max_tokens=32),
+    )
+    sched.add(seq)
+    plan = sched.try_admit()
+    assert plan is not None and plan.seq is seq
+    for t in range(steps):
+        seq.append_token(100 + t)
+    return seq
+
+
+def test_preempt_swaps_out_and_swap_in_plan_on_readmission():
+    sched, alloc, mgr, dev = make_sched(num_pages=16, slots=2)
+    for p in range(1, 16):
+        dev.content[p] = ("kv", p)
+    a = admit_and_decode(sched, n_prompt=6, steps=4)
+    b = admit_and_decode(sched, n_prompt=6, steps=4)
+    # grow until the pool forces preemption of the youngest (b)
+    assert sched.prepare_decode([a, b], horizon=32)
+    assert b.status is SeqStatus.WAITING and a.status is SeqStatus.RUNNING
+    assert sched.total_swap_preempts == 1
+    assert b.output_ids, "swap keeps the prompt/output split intact"
+    assert mgr.total_swap_out_pages["preempt"] > 0
+    saved = mgr.ticket_for(b).num_pages
+    # finish a -> b re-admits via swap-in
+    a.status = SeqStatus.RUNNING
+    sched.remove(a)
+    a.finish("stop")
+    plan = sched.try_admit()
+    assert isinstance(plan, SwapInPlan)
+    assert plan.seq is b and b.status is SeqStatus.RUNNING
+    assert len(b.pages) == saved
+    # engine-side consume: content lands in the new pages
+    mgr.swap_in_seq(b, b.pages)
+    assert mgr.total_swap_in_pages["preempt"] == saved
+    assert sched.total_preempt_recompute_tokens == 0
+
+
+def test_pool_full_falls_back_to_recompute_and_counts_waste():
+    sched, alloc, mgr, dev = make_sched(num_pages=16, budget_pages=1)
+    a = admit_and_decode(sched, n_prompt=6, steps=4)
+    b = admit_and_decode(sched, n_prompt=6, steps=4)
+    assert sched.prepare_decode([a, b], horizon=32)
+    assert b.status is SeqStatus.WAITING
+    # pool too small: classic recompute fold
+    assert sched.total_swap_preempts == 0
+    assert not b.output_ids and b.num_prompt_tokens == 10
+    a.status = SeqStatus.RUNNING
+    sched.remove(a)
+    a.finish("stop")
+    plan = sched.try_admit()
+    assert plan is not None and not isinstance(plan, SwapInPlan)
+    # the re-prefilled suffix is counted as preemption waste
+    assert sched.total_preempt_recompute_tokens == 10
+
+
+def test_kv_exhaustion_is_typed_kv_capacity():
+    """The two seq.fail sites must surface KVCapacityError (-> 503 +
+    Retry-After, body reason kv_capacity) instead of an opaque 500."""
+    # site 1: preempt_on_oom off
+    alloc = PageAllocator(6)
+    sched = Scheduler(
+        allocator=alloc, max_slots=2, page_size=PS,
+        prefill_buckets=[8], max_model_len=64, max_queue_size=8,
+        preempt_on_oom=False,
+    )
+    seq = Sequence(
+        prompt_ids=list(range(2, 10)),
+        params=SamplingParams(max_tokens=40),
+    )
+    sched.add(seq)
+    assert sched.try_admit() is not None
+    for t in range(12):
+        seq.append_token(100 + t)
+    sched.prepare_decode([seq], horizon=32)
+    assert seq.status is SeqStatus.FAILED
+    assert isinstance(seq.error, KVCapacityError)
+    assert seq.error.reason == "kv_capacity"
+    assert seq.error.retry_after >= 1.0
+    # site 2: alone and the grown context can never fit
+    alloc2 = PageAllocator(6)
+    sched2 = Scheduler(
+        allocator=alloc2, max_slots=2, page_size=PS,
+        prefill_buckets=[8], max_model_len=64, max_queue_size=8,
+    )
+    seq2 = Sequence(
+        prompt_ids=list(range(2, 10)),
+        params=SamplingParams(max_tokens=40),
+    )
+    sched2.add(seq2)
+    assert sched2.try_admit() is not None
+    for t in range(12):
+        seq2.append_token(100 + t)
+    sched2.prepare_decode([seq2], horizon=32)
+    assert seq2.status is SeqStatus.FAILED
+    assert isinstance(seq2.error, KVCapacityError)
+
+
+def test_has_admissible_waiting_uses_ticket_pages():
+    sched, alloc, mgr, dev = make_sched(num_pages=16, slots=2)
+    a = admit_and_decode(sched, n_prompt=6, steps=4)
+    b = admit_and_decode(sched, n_prompt=6, steps=4)
+    sched.prepare_decode([a, b], horizon=32)
+    assert b.status is SeqStatus.WAITING
+    ticket = mgr.ticket_for(b)
+    assert ticket is not None
+    # pool still hogged by a: not admissible
+    assert sched.has_admissible_waiting() == (
+        alloc.num_free >= ticket.num_pages
+    )
+    a.status = SeqStatus.RUNNING
+    sched.remove(a)
+    a.finish("stop")
+    assert sched.has_admissible_waiting()
+
+
+def test_abort_and_evacuate_discard_parked_kv():
+    sched, alloc, mgr, dev = make_sched(num_pages=16, slots=2)
+    a = admit_and_decode(sched, n_prompt=6, steps=4)
+    b = admit_and_decode(sched, n_prompt=6, steps=4)
+    sched.prepare_decode([a, b], horizon=32)
+    assert mgr.used_bytes > 0
+    b.request_abort()
+    sched._reap_aborted()
+    assert mgr.used_bytes == 0
+    assert mgr.total_discard_pages.get("settled", 0) > 0
+
+
+def test_gateway_503_body_for_kv_capacity():
+    """KVCapacityError rides the generic RetryableError -> 503 mapping
+    with its own body reason, so the SDK's typed KVCapacityError (and
+    LBs) can tell 'this replica's KV is full' from an opaque 500."""
+    import json
+
+    from vgate_tpu.server.app import _unavailable_503
+
+    exc = KVCapacityError("KV pages exhausted", retry_after=5)
+    resp = _unavailable_503(exc, str(exc))
+    assert resp.status == 503
+    body = json.loads(resp.text)
+    assert body["error"]["reason"] == "kv_capacity"
+    assert resp.headers["Retry-After"] == "5"
+
+
+# --------------------------------------------------------- admission
+
+
+def test_admission_swap_relief_runs_pool_hotter():
+    from vgate_tpu.admission import AdmissionController
+    from vgate_tpu.config import load_config
+    from vgate_tpu.errors import ServerOverloadedError
+
+    cfg = load_config(
+        admission={"kv_free_watermark": 0.2, "swap_kv_relief": 0.5}
+    ).admission
+    sig = {"kv_free_ratio": 0.15}
+    ctl = AdmissionController(cfg, signals=lambda: dict(sig))
+    # 0.15 < 0.2 watermark: shed without the swap tier
+    with pytest.raises(ServerOverloadedError) as ei:
+        ctl.admit(10, tier="interactive")
+    assert ei.value.shed_reason == "kv_pressure"
+    # swap tier healthy: watermark relieved to 0.1 -> admitted
+    sig.update(kv_swap_enabled=True, kv_host_free_ratio=0.9)
+    ctl.admit(10, tier="interactive")
+    ctl.release(10)
+    # exhausted host pool restores the full watermark
+    sig.update(kv_host_free_ratio=0.1)
+    with pytest.raises(ServerOverloadedError):
+        ctl.admit(10, tier="interactive")
+
+
+# --------------------------------------------------------- engine e2e
+
+
+def _engine_cfg(num_pages, host_swap_bytes):
+    from vgate_tpu.config import load_config
+
+    return load_config(
+        model={
+            "model_id": "tiny-dense", "engine_type": "jax_tpu",
+            "dtype": "float32", "max_model_len": 96,
+        },
+        kv_cache={"host_swap_bytes": host_swap_bytes},
+        tpu={
+            "dp": 1, "tp": 1, "ep": 1, "sp": 1, "num_devices": 1,
+            "kv_num_pages": num_pages, "kv_page_size": PS,
+            "max_batch_slots": 4, "prefill_buckets": [8, 16, 32],
+            "use_pallas": False,
+            "prefix_cache": {"enabled": True, "cow_min_tokens": 2},
+        },
+        scheduler={"max_queue_size": 16},
+        logging={"level": "ERROR"},
+    )
+
+
+def _drive(core, prompts, params):
+    seqs = [core.submit_tokens(list(p), params) for p in prompts]
+    outs = []
+    for s in seqs:
+        assert s.done_event.wait(timeout=300)
+        assert s.status is SeqStatus.FINISHED, s.error
+        outs.append(list(s.generated_ids))
+    return outs
+
+
+def test_engine_swap_zero_recompute_token_identity():
+    """The acceptance contract: under forced KV pressure with the host
+    pool on, preempted sequences resume via swap-in with zero
+    recompute tokens and token-identical greedy output; the swap-off
+    twin preempts the same way but pays recompute."""
+    import jax
+
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    params = SamplingParams(max_tokens=40, temperature=0.0, min_tokens=40)
+    prompts = [
+        [7 + i, 3, 9, 4 + i, 11, 6, 2, 13 + i, 5, 8, 12, 10 + i]
+        for i in range(4)
+    ]
+
+    big = EngineCore(_engine_cfg(200, 0), devices=jax.devices()[:1])
+    big.start()
+    try:
+        base = _drive(big, prompts, params)
+        assert big.scheduler.total_preemptions == 0, (
+            "baseline must be unpressured"
+        )
+    finally:
+        big.stop()
+
+    on = EngineCore(_engine_cfg(40, 1 << 24), devices=jax.devices()[:1])
+    on.start()
+    try:
+        outs = _drive(on, prompts, params)
+        st = on.get_stats()
+        sched = st["scheduler"]
+        assert sched["preemptions"] > 0, "pool was never squeezed"
+        assert sched["swap_preempts"] == sched["preemptions"]
+        assert sched["preempt_recompute_tokens"] == 0
+        assert st["kv_swap"]["swap_in_pages"]["preempt"] > 0
+        assert outs == base
+    finally:
+        on.stop()
+
+    off = EngineCore(_engine_cfg(40, 0), devices=jax.devices()[:1])
+    off.start()
+    try:
+        outs = _drive(off, prompts, params)
+        sched = off.get_stats()["scheduler"]
+        assert sched["preemptions"] > 0
+        assert sched["preempt_recompute_tokens"] > 0, (
+            "the swap-off baseline must show the recompute waste"
+        )
+        assert "kv_swap" not in off.get_stats()
+        assert outs == base, "recompute path is also token-identical"
+    finally:
+        off.stop()
+
+
+def test_engine_swap_off_pressure_signals_unchanged():
+    import jax
+
+    from vgate_tpu.runtime.engine_core import EngineCore
+
+    core = EngineCore(_engine_cfg(48, 0), devices=jax.devices()[:1])
+    try:
+        sig = core.pressure_signals()
+        assert "kv_swap_enabled" not in sig
+        assert core.kv_swap is None
+    finally:
+        core.stop()

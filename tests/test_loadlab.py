@@ -339,6 +339,26 @@ def test_compare_flags_doctored_goodput_regression(tmp_path):
     assert "knee_drop" in kinds
 
 
+def test_compare_cells_filter_gates_only_listed_cells(tmp_path):
+    """--cells restricts the gate to one regime (swap_check.sh gates
+    the overload cell of an A/B where the quiet cell's handful of
+    samples is pure noise); the summary knee gates are skipped under a
+    filter since a partial view cannot see a knee move."""
+    old_p = str(tmp_path / "old.jsonl")
+    new_p = str(tmp_path / "new.jsonl")
+    slo.write_artifact(old_p, _make_lines(goodputs=(1.0, 0.95)))
+    # quiet cell (2 qps) collapses, overload cell (8 qps) holds
+    slo.write_artifact(new_p, _make_lines(goodputs=(0.5, 0.95)))
+    assert compare.main([old_p, new_p]) == 1
+    assert compare.main([old_p, new_p, "--cells", "8"]) == 0
+    # a regression IN the gated cell still fails under the filter
+    slo.write_artifact(new_p, _make_lines(goodputs=(1.0, 0.60)))
+    assert compare.main([old_p, new_p, "--cells", "8"]) == 1
+    # a filter matching NO common cell is a usage error (exit 2), not
+    # a vacuous pass
+    assert compare.main([old_p, new_p, "--cells", "15"]) == 2
+
+
 def test_compare_refuses_config_fingerprint_change(tmp_path):
     # same scenario, env-overridden server (7B vs 1.5B): the scenario
     # hash can't see it but the /stats config fingerprint can

@@ -10,17 +10,30 @@ import random
 
 import pytest
 
+from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.runtime.kv_cache import PageAllocator
+from vgate_tpu.runtime.kv_swap import KVSwapManager
 from vgate_tpu.runtime.radix_cache import RadixCache
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 
 PS = 4
+PAGE_BYTES = 64
 
 
-def make(num_pages=64, **kw):
+def make(num_pages=64, swap_budget_pages=0, **kw):
+    # the page-id -> content fake executor is shared with the manager's
+    # own suite (one definition of the executor contract under test)
+    from test_kv_swap import FakeDevice
+
     alloc = PageAllocator(num_pages)
     kw.setdefault("cow_min_tokens", 2)
     rx = RadixCache(alloc, PS, **kw)
     alloc.set_reclaimer(rx)
+    if swap_budget_pages:
+        mgr = KVSwapManager(
+            swap_budget_pages * PAGE_BYTES, PAGE_BYTES, FakeDevice()
+        )
+        rx.attach_swap(mgr)
     return alloc, rx
 
 
@@ -286,12 +299,60 @@ def _check_invariants(alloc, rx, live):
             stack.append(child)
     # the incrementally-maintained count never drifts from the truth
     assert rx.evictable_pages() == dfs_evictable
+    # host swap tier invariants (when attached): a node holds device
+    # pages XOR a host ticket; children of a swapped node are swapped;
+    # the pool's byte accounting equals exactly the live tickets
+    if rx.swap is not None:
+        mgr = rx.swap
+        swapped_nodes = 0
+        ticket_pages = 0
+        live_tickets = set()
+        stack = [rx.root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                assert not (child.pages and child.swapped is not None), (
+                    "page simultaneously device-resident and swapped"
+                )
+                assert child.pages or child.swapped is not None, (
+                    "non-root node with neither pages nor a ticket"
+                )
+                if child.swapped is not None:
+                    swapped_nodes += 1
+                    ticket_pages += child.swapped.num_pages
+                    live_tickets.add(id(child.swapped))
+                    assert all(
+                        g.swapped is not None
+                        for g in child.children.values()
+                    ), "resident node below a host-swapped prefix"
+                stack.append(child)
+        assert rx._swapped_nodes == swapped_nodes
+        # every tree ticket is registered, every registered prefix
+        # ticket is in the tree, and bytes == sum of swapped pages
+        assert live_tickets == set(mgr._prefix_lru.keys())
+        seq_bytes = sum(
+            t.nbytes for _, t in mgr._seq_tickets.values()
+        )
+        assert (
+            mgr.used_bytes
+            == ticket_pages * mgr.page_bytes + seq_bytes
+        )
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
-def test_randomized_interleaving_invariants(seed):
+@pytest.mark.parametrize(
+    "seed,swap_pages",
+    [(0, 0), (1, 0), (2, 0), (0, 24), (1, 24), (2, 24)],
+)
+def test_randomized_interleaving_invariants(seed, swap_pages):
+    """The subsystem gate.  With ``swap_pages`` the host swap tier
+    rides along: evict/trim DEMOTE lock-free leaves into the pool,
+    admit's match PROMOTES them back, and a host_squeeze op (a fake
+    preemption swap-out) forces capacity drops of prefix tickets — the
+    invariant check asserts exact byte accounting, pages-XOR-ticket
+    per node, and the unchanged refcount/lock identities across
+    demote->promote cycles."""
     rng = random.Random(seed)
-    alloc, rx = make(num_pages=48)
+    alloc, rx = make(num_pages=48, swap_budget_pages=swap_pages)
     bases = [
         [rng.randrange(3, 99) for _ in range(rng.randrange(8, 40))]
         for _ in range(6)
@@ -369,7 +430,25 @@ def test_randomized_interleaving_invariants(seed):
     def trim():
         rx.trim_to_watermark(rng.randrange(1, 10))
 
+    def host_squeeze():
+        # a fake preemption swap-out claims host-pool room (dropping
+        # prefix tickets LRU-first), then its owner settles — the
+        # transient exercises the capacity-discard and sweep paths
+        s = Sequence(
+            prompt_ids=[1, 2, 3], params=SamplingParams(max_tokens=4)
+        )
+        s.status = SeqStatus.RUNNING
+        s.pages = list(range(900, 900 + rng.randrange(1, 9)))
+        if rx.swap.swap_out_seq(s, s.pages):
+            s.reset_for_swap()
+            if rng.random() < 0.7:
+                rx.swap.discard_for(s, "settled")
+            else:
+                s.fail(RuntimeError("gone"))  # left for the sweep
+
     ops = [admit, admit, finish, evict, trim]
+    if swap_pages:
+        ops.append(host_squeeze)
     for _ in range(400):
         rng.choice(ops)()
         _check_invariants(alloc, rx, live)

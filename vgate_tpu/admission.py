@@ -291,6 +291,22 @@ class AdmissionController:
             and sig.get("kv_free_ratio") is not None
             else None
         )
+        kv_watermark = float(self.cfg.kv_free_watermark)
+        # host-swap pressure relief: with the swap tier on and healthy
+        # (>= 25% host-pool headroom), a KV squeeze no longer means
+        # recompute storms — preempted/demoted work resumes via a
+        # cheap swap-in, so the cost model charges swap-in instead of
+        # full re-prefill and admission can run the device pool hotter
+        # before shedding kv_pressure.  An exhausted host pool restores
+        # the full watermark: degradation stays graceful, not blind.
+        relief = float(getattr(self.cfg, "swap_kv_relief", 0.0))
+        if (
+            kv_free is not None
+            and 0 < relief < 1.0
+            and sig.get("kv_swap_enabled")
+            and float(sig.get("kv_host_free_ratio", 0.0)) >= 0.25
+        ):
+            kv_watermark *= relief
         token_limit = self._token_limit(sig)
         with self._lock:
             reason: Optional[str] = None
@@ -304,7 +320,7 @@ class AdmissionController:
             ):
                 reason = "backlog_tokens"
             elif kv_free is not None and (
-                kv_free < min(1.0, self.cfg.kv_free_watermark / frac)
+                kv_free < min(1.0, kv_watermark / frac)
             ):
                 reason = "kv_pressure"
             elif (

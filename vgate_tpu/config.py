@@ -142,6 +142,29 @@ class KVCacheConfig(BaseModel):
 
     dtype: str = "auto"
 
+    # Host-RAM KV swap tier (runtime/kv_swap.py): a budgeted pinned
+    # host pool under the paged allocator.  > 0 enables it: KV-pressure
+    # preemption swaps the victim's pages device->host and re-admission
+    # swaps them back (token-identical resume, ZERO recompute tokens),
+    # and radix-cache eviction demotes warm prefix pages into the same
+    # pool (victim cache) before truly discarding.  0 (default) = off,
+    # byte-identical to the pre-swap engine.  Requires a plain mesh
+    # (tp/pp/sp/ep == 1; dp composes — each replica owns its pool and
+    # host tier).  Sizing: each page costs geometry.page_bytes of host
+    # RAM (see /stats engine.kv_page_bytes); the pool should hold at
+    # least a few preemption victims' contexts — docs/operations.md
+    # "KV pressure tiers" runbook.
+    host_swap_bytes: int = 0
+
+    @field_validator("host_swap_bytes")
+    @classmethod
+    def _check_swap(cls, v: int) -> int:
+        if v < 0:
+            raise ValueError(
+                "kv_cache.host_swap_bytes must be >= 0 (0 disables)"
+            )
+        return v
+
     @field_validator("dtype")
     @classmethod
     def _check_dtype(cls, v: str) -> str:
@@ -597,6 +620,14 @@ class AdmissionConfig(BaseModel):
     # (tier-scaled — batch tier rejects at a higher free ratio than
     # interactive).  0 disables the check.
     kv_free_watermark: float = 0.05
+    # Host-swap pressure relief (kv_cache.host_swap_bytes > 0): with
+    # the swap tier healthy (host pool has headroom), the kv_pressure
+    # watermark above is multiplied by this factor — admission can run
+    # the device pool hotter because a preemption there now costs a
+    # cheap swap-out/swap-in instead of a full re-prefill (the cost
+    # model charges swap-in, not recompute, for preempted work).
+    # 1.0 = no relief; 0 disables the relief entirely.
+    swap_kv_relief: float = 0.5
     # Per-API-key in-flight cap -> 429 + Retry-After.  0 = unlimited;
     # applies only to authenticated (Bearer-keyed) requests.
     per_key_max_inflight: int = 0

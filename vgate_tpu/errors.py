@@ -170,6 +170,24 @@ class ServerOverloadedError(RetryableError):
         self.tier = tier
 
 
+class KVCapacityError(RetryableError):
+    """The paged KV pool ran out mid-generation and nothing could be
+    preempted to make room (the sequence was alone, or preempt-on-oom
+    is off): the request's context genuinely does not fit the pool
+    RIGHT NOW.  A transient *capacity* condition, not a malformed
+    request — mapped to 503 + ``Retry-After`` with body reason
+    ``"kv_capacity"`` (SDK: typed ``KVCapacityError``) so clients and
+    load balancers retry against a less-loaded replica instead of
+    treating an opaque 500 as a server bug.  With the host-RAM swap
+    tier (``kv_cache.host_swap_bytes``) these exhaustions become rarer
+    still: preemption parks KV instead of destroying it."""
+
+    reason = "kv_capacity"
+
+    def __init__(self, message: str, retry_after: float = 2.0) -> None:
+        super().__init__(message, retry_after=retry_after)
+
+
 class IntegrityError(RetryableError):
     """Silent data corruption detected (vgate_tpu/integrity.py): an
     output sentinel tripped on a decode readback (NaN/Inf, all-zero or

@@ -17,7 +17,10 @@ beyond thresholds, so perf PRs can gate on a recorded baseline:
 
 Cells match on offered QPS; tiers with fewer than ``--min-samples``
 requests on either side are skipped (tail statistics on a handful of
-requests gate nothing).  Artifacts from different scenarios (name or
+requests gate nothing).  ``--cells`` restricts the per-cell gates to
+the listed QPS values when only one regime is under test (e.g.
+``--cells 14`` gates the overload cell; the summary knee gates are
+then skipped — a partial view cannot see a knee move).  Artifacts from different scenarios (name or
 content hash) refuse to compare unless ``--allow-cross-scenario``, and
 different server-config fingerprints refuse unless
 ``--allow-config-change`` (the scenario hash cannot see env-exported
@@ -51,12 +54,21 @@ def compare(
     max_tail_rise: float = 0.25,
     tail_floor_ms: float = 50.0,
     min_samples: int = 8,
+    cells: Optional[List[float]] = None,
 ) -> List[Dict[str, Any]]:
-    """Returns the regression list (empty = gate passes)."""
+    """Returns the regression list (empty = gate passes).  ``cells``
+    restricts the per-cell gates (goodput/tail) to the listed QPS
+    values — for gates that target one regime (e.g. the overload
+    cell), where a quiet cell's handful of samples would only add
+    noise; the summary knee gates are skipped under a filter, since a
+    partial view cannot see a knee move."""
     regressions: List[Dict[str, Any]] = []
     old_cells = _cells_by_qps(old)
     new_cells = _cells_by_qps(new)
-    for qps in sorted(set(old_cells) & set(new_cells)):
+    gated = set(old_cells) & set(new_cells)
+    if cells is not None:
+        gated &= set(cells)
+    for qps in sorted(gated):
         o_cell, n_cell = old_cells[qps], new_cells[qps]
         if not o_cell.get("valid", True) or not n_cell.get("valid", True):
             continue  # a lag-invalidated cell gates nothing
@@ -88,8 +100,14 @@ def compare(
                     ),
                 })
             o_p99, n_p99 = _tier_p99(o_t), _tier_p99(n_t)
+            # the tail gate needs real TTFT samples, not offered
+            # requests: a mostly-shed tier can have n=45 offered but a
+            # p99 computed over 2 completions — noise, not signal
+            o_tn = (o_t.get("ttft_ms") or {}).get("n", 0)
+            n_tn = (n_t.get("ttft_ms") or {}).get("n", 0)
             if (
                 o_p99 is not None and n_p99 is not None
+                and o_tn >= min_samples and n_tn >= min_samples
                 and n_p99 - o_p99 > tail_floor_ms
                 and o_p99 > 0
                 and (n_p99 - o_p99) / o_p99 > max_tail_rise
@@ -114,7 +132,8 @@ def compare(
     # same cells and no cell was lag-invalidated — a partial or
     # corrupted rerun must not read as a knee move
     summaries_comparable = (
-        o_sum.get("cells") == n_sum.get("cells")
+        cells is None
+        and o_sum.get("cells") == n_sum.get("cells")
         and not o_sum.get("invalid_cells")
         and not n_sum.get("invalid_cells")
     )
@@ -153,6 +172,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--max-tail-rise", type=float, default=0.25)
     parser.add_argument("--tail-floor-ms", type=float, default=50.0)
     parser.add_argument("--min-samples", type=int, default=8)
+    parser.add_argument(
+        "--cells", type=float, nargs="+", default=None,
+        help="gate only these QPS cells (e.g. --cells 14 gates the "
+             "overload cell of a 2-cell sweep; summary knee gates are "
+             "skipped under a filter)",
+    )
     parser.add_argument(
         "--allow-cross-scenario", action="store_true",
         help="compare artifacts even when scenario name/hash differ "
@@ -210,12 +235,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             "latency comparisons across platforms are not meaningful",
             file=sys.stderr,
         )
+    if args.cells:
+        # a filter that matches nothing would silently disable every
+        # gate and exit 0 — a typo'd QPS or a scenario whose cells
+        # drifted from the recorded baseline must fail loudly, not
+        # vacuously pass
+        common = {c["qps"] for c in old.get("cells", [])} & {
+            c["qps"] for c in new.get("cells", [])
+        }
+        missing = [q for q in args.cells if q not in common]
+        if missing:
+            print(
+                f"compare: --cells {missing} match no cell present in "
+                f"both artifacts (common cells: {sorted(common)})",
+                file=sys.stderr,
+            )
+            return 2
     regressions = compare(
         old, new,
         max_goodput_drop=args.max_goodput_drop,
         max_tail_rise=args.max_tail_rise,
         tail_floor_ms=args.tail_floor_ms,
         min_samples=args.min_samples,
+        cells=args.cells,
     )
     if regressions:
         print(f"FAIL: {len(regressions)} regression(s)")

@@ -169,6 +169,49 @@ ACTIVE_SEQUENCES = _safe_metric(
 PREEMPTED_SEQUENCES = _safe_metric(
     Counter, "vgt_preempted_sequences", "Sequences preempted for KV pressure"
 )
+PREEMPT_RECOMPUTE_TOKENS = _safe_metric(
+    Counter,
+    "vgt_preempt_recompute_tokens",
+    "Tokens re-prefilled because a KV-pressure preemption destroyed "
+    "their KV (the waste the host swap tier eliminates: with "
+    "kv_cache.host_swap_bytes > 0 preemption parks pages host-side "
+    "and this counter stays flat while vgt_kv_swap_*_pages move)",
+)
+KV_SWAP_OUT_PAGES = _safe_metric(
+    Counter,
+    "vgt_kv_swap_out_pages",
+    "KV pages swapped device->host into the pinned host pool "
+    "(runtime/kv_swap.py): kind=preempt is a preemption victim's "
+    "resident KV, kind=prefix is a radix-cache leaf demoted by "
+    "pressure/LRU eviction (victim cache)",
+    labelnames=("kind",),  # preempt | prefix
+)
+KV_SWAP_IN_PAGES = _safe_metric(
+    Counter,
+    "vgt_kv_swap_in_pages",
+    "KV pages swapped host->device: kind=preempt resumes a preempted "
+    "sequence token-identically with zero recompute, kind=prefix "
+    "promotes a demoted radix leaf back on a prefix match",
+    labelnames=("kind",),  # preempt | prefix
+)
+KV_SWAP_DISCARD_PAGES = _safe_metric(
+    Counter,
+    "vgt_kv_swap_discard_pages",
+    "Host-pool pages discarded without a swap-in, by reason: settled "
+    "(owner finished/failed/aborted), stale (epoch moved under a "
+    "checkpoint/migration fold), capacity (prefix victim-cache LRU "
+    "drop to make room for a preemption swap-out), no_fit (swap-in "
+    "could not allocate and the sequence fell back to recompute)",
+    labelnames=("reason",),
+)
+KV_HOST_POOL_BYTES = _safe_metric(
+    Gauge,
+    "vgt_kv_host_pool_bytes",
+    "Bytes of KV currently parked in the host-RAM swap pool "
+    "(kv_cache.host_swap_bytes is the budget; sustained occupancy "
+    "near the budget with rising discard[capacity] means the pool is "
+    "thrashing — docs/operations.md KV pressure tiers runbook)",
+)
 ENGINE_QUEUE_DEPTH = _safe_metric(
     Gauge, "vgt_engine_queue_depth", "Sequences waiting for engine admission"
 )

@@ -1687,6 +1687,8 @@ class ReplicatedEngine:
         when routing prefers prefix affinity) and summed queue depth."""
         ratios = []
         depth = running = 0
+        swap_used = swap_budget = swapped_seqs = 0
+        swap_free_ratios = []
         with self._topology_lock:
             cores = [
                 c for i, c in enumerate(self.replicas)
@@ -1703,11 +1705,27 @@ class ReplicatedEngine:
                 ratios.append(sig["kv_free_ratio"])
             depth += sig.get("engine_queue_depth", 0)
             running += sig.get("running", 0)
+            if sig.get("kv_swap_enabled"):
+                # host swap tier: summed occupancy, WORST headroom —
+                # admission's swap relief must not run a replica's
+                # device pool hot against a sibling's empty host pool
+                swap_used += sig.get("kv_host_pool_bytes", 0)
+                swap_budget += sig.get("kv_host_pool_budget_bytes", 0)
+                swapped_seqs += sig.get("kv_swapped_seqs", 0)
+                swap_free_ratios.append(
+                    sig.get("kv_host_free_ratio", 0.0)
+                )
         out: Dict[str, Any] = {
             "engine_queue_depth": depth, "running": running,
         }
         if ratios:
             out["kv_free_ratio"] = min(ratios)
+        if swap_free_ratios:
+            out["kv_swap_enabled"] = True
+            out["kv_host_pool_bytes"] = swap_used
+            out["kv_host_pool_budget_bytes"] = swap_budget
+            out["kv_host_free_ratio"] = min(swap_free_ratios)
+            out["kv_swapped_seqs"] = swapped_seqs
         return out
 
     # ----------------------------------------------------------- health
@@ -2065,6 +2083,37 @@ class ReplicatedEngine:
                     )
                     for k2, v2 in val.items()
                 }
+        if "kv_swap" in per_replica[0]:
+            # host swap tier: summed fleet occupancy + counters (the
+            # per-replica blocks stay available under "replicas")
+            swaps = [s["kv_swap"] for s in per_replica if "kv_swap" in s]
+            agg["kv_swap"] = {
+                "enabled": any(s["enabled"] for s in swaps),
+                "budget_bytes": sum(s["budget_bytes"] for s in swaps),
+                "used_bytes": sum(s["used_bytes"] for s in swaps),
+                "swapped_seqs": sum(s["swapped_seqs"] for s in swaps),
+                "prefix_tickets": sum(
+                    s["prefix_tickets"] for s in swaps
+                ),
+                "swap_out_pages": {
+                    k: sum(s["swap_out_pages"].get(k, 0) for s in swaps)
+                    for k in ("preempt", "prefix")
+                },
+                "swap_in_pages": {
+                    k: sum(s["swap_in_pages"].get(k, 0) for s in swaps)
+                    for k in ("preempt", "prefix")
+                },
+                # the thrash-detection counter the runbook keys on
+                # (rising discard[capacity] = pool too small): reasons
+                # are open-ended, so sum over the union of keys
+                "discard_pages": {
+                    k: sum(s["discard_pages"].get(k, 0) for s in swaps)
+                    for k in sorted(
+                        {k for s in swaps for k in s["discard_pages"]}
+                    )
+                },
+                "refused": sum(s["refused"] for s in swaps),
+            }
         agg["model"] = self.spec.name
         agg["dp"] = len(self.replicas)
         # failover accounting mirrors the dp=1 supervisor block's shape

@@ -81,8 +81,9 @@ from vgate_tpu.runtime.kv_cache import (
     auto_num_pages,
     make_kv_buffers,
 )
+from vgate_tpu.runtime.kv_swap import KVSwapManager
 from vgate_tpu.runtime.radix_cache import RadixCache
-from vgate_tpu.runtime.scheduler import PrefillPlan, Scheduler
+from vgate_tpu.runtime.scheduler import PrefillPlan, Scheduler, SwapInPlan
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.runtime.tokenizer import get_tokenizer
 from vgate_tpu.runtime.weights import load_or_init_params
@@ -201,6 +202,97 @@ def _cow_copy_pages(k_pages, v_pages, src, dst, upto):
         copy_page_prefix(k_pages, src, dst, keep),
         copy_page_prefix(v_pages, src, dst, keep),
     )
+
+
+# pages moved per device call when swapping KV to/from host RAM
+# (runtime/kv_swap.py): fixed so each direction compiles exactly one
+# program per pool dtype — short runs pad their index vector with the
+# reserved trash page 0, which absorbs the padding writes on swap-in
+# and whose padding rows are dropped host-side on swap-out
+SWAP_CHUNK_PAGES = 16
+
+
+@jax.jit
+def _gather_swap_pages(k_pages, v_pages, idx):
+    """Device->host half of a KV swap: pull ``idx``'s page slices out
+    of the pools (page axis 2 on data AND int8 scale leaves) in one
+    program; the caller device_gets the result.  NOT donated — the
+    pools stay resident."""
+    return jax.tree.map(
+        lambda x: jnp.take(x, idx, axis=2), (k_pages, v_pages)
+    )
+
+
+@functools.partial(jax.jit, donate_argnames=("k_pages", "v_pages"))
+def _scatter_swap_pages(k_pages, v_pages, idx, k_data, v_data):
+    """Host->device half: scatter saved page content back into freshly
+    allocated pages.  Duplicate padding indices all target trash page
+    0, whose content is never read."""
+    put = lambda x, d: x.at[:, :, idx].set(d)
+    return (
+        jax.tree.map(put, k_pages, k_data),
+        jax.tree.map(put, v_pages, v_data),
+    )
+
+
+class _DeviceSwapExecutor:
+    """The device half of the host swap tier (runtime/kv_swap.py): the
+    manager stays pure host-side policy, and every device touch —
+    chunked ``jax.device_get`` of page slices on swap-out, the jitted
+    scatter on swap-in — happens here, on the engine thread, at tick
+    boundaries.  Reads heartbeat like every other blocking readback so
+    the hang watchdog attributes a wedged transfer correctly."""
+
+    def __init__(self, core: "EngineCore") -> None:
+        self._core = core
+
+    def read_pages(self, pages: List[int]):
+        core = self._core
+        k_chunks: list = []
+        v_chunks: list = []
+        for i in range(0, len(pages), SWAP_CHUNK_PAGES):
+            chunk = pages[i : i + SWAP_CHUNK_PAGES]
+            idx = np.zeros((SWAP_CHUNK_PAGES,), np.int32)
+            idx[: len(chunk)] = chunk
+            core._beat("swap_readback", batch=len(chunk))
+            k_c, v_c = _gather_swap_pages(
+                core.k_pages, core.v_pages, jnp.asarray(idx)
+            )
+            host = jax.device_get((k_c, v_c))
+            trim = lambda x: np.asarray(x)[:, :, : len(chunk)]
+            k_chunks.append(jax.tree.map(trim, host[0]))
+            v_chunks.append(jax.tree.map(trim, host[1]))
+        cat = lambda *xs: np.concatenate(xs, axis=2)
+        return (
+            jax.tree.map(cat, *k_chunks),
+            jax.tree.map(cat, *v_chunks),
+        )
+
+    def write_pages(self, pages: List[int], payload) -> None:
+        core = self._core
+        k_data, v_data = payload
+        for i in range(0, len(pages), SWAP_CHUNK_PAGES):
+            chunk = pages[i : i + SWAP_CHUNK_PAGES]
+            idx = np.zeros((SWAP_CHUNK_PAGES,), np.int32)
+            idx[: len(chunk)] = chunk
+
+            def pad(x):
+                sl = x[:, :, i : i + len(chunk)]
+                if len(chunk) < SWAP_CHUNK_PAGES:
+                    shape = list(sl.shape)
+                    shape[2] = SWAP_CHUNK_PAGES
+                    out = np.zeros(shape, sl.dtype)
+                    out[:, :, : len(chunk)] = sl
+                    return out
+                return sl
+
+            core.k_pages, core.v_pages = _scatter_swap_pages(
+                core.k_pages,
+                core.v_pages,
+                jnp.asarray(idx),
+                jax.tree.map(pad, k_data),
+                jax.tree.map(pad, v_data),
+            )
 
 
 def _decode_step(
@@ -477,6 +569,11 @@ def rebuild_core(
     old._dec_state = None
     old._pending_chunks.clear()
     old._spec_pen = None
+    # the host swap pool dies with its core: every parked ticket's
+    # epoch went stale when containment folded the owners, and the new
+    # core builds a fresh (empty) pool — free the host RAM now rather
+    # than holding both pools across the rebuild
+    old.kv_swap = None
     old_integrity = getattr(old, "integrity", None)
     if (
         not reload_weights
@@ -832,6 +929,29 @@ class EngineCore:
                 cow_min_tokens=pc.cow_min_tokens,
             )
             self.allocator.set_reclaimer(self.radix_cache)
+        # host-RAM KV swap tier (runtime/kv_swap.py): a budgeted pinned
+        # host pool under the paged allocator — preemption parks the
+        # victim's pages device->host instead of recomputing, and
+        # radix eviction demotes warm prefixes into it (victim cache).
+        # 0 = off keeps the engine byte-identical; the device half
+        # (chunked gather/scatter) lives in _DeviceSwapExecutor and the
+        # readback lock shared below epoch-guards swap-out publication
+        # exactly like every other readback.
+        self.kv_swap: Optional[KVSwapManager] = None
+        host_swap_bytes = int(self.config.kv_cache.host_swap_bytes)
+        if host_swap_bytes > 0:
+            swap_axes = {
+                a: int(self.mesh.shape.get(a, 1))
+                for a in ("tp", "pp", "sp", "ep")
+            }
+            bad_axes = {a: n for a, n in swap_axes.items() if n > 1}
+            if bad_axes:
+                raise ValueError(
+                    f"kv_cache.host_swap_bytes requires a plain mesh, "
+                    f"got {bad_axes}: the swap gather/scatter indexes "
+                    "pages globally across an unsharded pool — dp "
+                    "composes (each replica owns its pool + host tier)"
+                )
         # brownout L4 upstream state, carried across supervisor rebuilds
         # exactly like spec_suspended
         self.prefix_insert_suspended = False
@@ -845,6 +965,18 @@ class EngineCore:
         # + per-request post-mortem rings; the supervisor snapshots it
         # on every crash and /debug serves it live
         self.flight = FlightRecorder(self.config.observability)
+        # see the long rationale further down where the readback paths
+        # use it; constructed here so the swap manager can share it
+        self._readback_lock = threading.Lock()
+        if host_swap_bytes > 0:
+            self.kv_swap = KVSwapManager(
+                budget_bytes=host_swap_bytes,
+                page_bytes=self.geometry.page_bytes,
+                executor=_DeviceSwapExecutor(self),
+                lock=self._readback_lock,
+            )
+            if self.radix_cache is not None:
+                self.radix_cache.attach_swap(self.kv_swap)
         self.scheduler = Scheduler(
             allocator=self.allocator,
             max_slots=self.max_slots,
@@ -864,6 +996,7 @@ class EngineCore:
             cache_aware_sched=pc.cache_aware_sched,
             insert_generated=pc.insert_generated,
             evict_watermark=pc.evict_watermark,
+            swap=self.kv_swap,
         )
 
         # host-side mirror of the device page tables, one row per slot
@@ -1108,7 +1241,10 @@ class EngineCore:
         # generation (a token streamed to the client but excluded from
         # the folded prompt gets regenerated by the replay).
         # Uncontended in steady state: one acquire per readback.
-        self._readback_lock = threading.Lock()
+        # Created EARLY (before the scheduler) because the kv-swap
+        # manager's swap-out publication guard shares it: a ticket is
+        # only published under this lock against a re-checked
+        # status/epoch, so a containment fold can never interleave.
         # published at the END of containment (before on_fatal): the dp
         # repair thread polls _fatal, which is set FIRST — acting on a
         # mid-containment core would take an empty checkpoint and then
@@ -2102,16 +2238,24 @@ class EngineCore:
         limit = self.config.tpu.prefill_admit_limit
         decoding = bool(self._running_seqs())
         plans: List[PrefillPlan] = []
+        swap_plans: List[SwapInPlan] = []
         start = time.perf_counter()
         while True:
-            if decoding and limit and len(plans) >= limit:
+            if decoding and limit and len(plans) + len(swap_plans) >= limit:
                 break
             plan = self.scheduler.try_admit()
             if plan is None:
                 break
-            plans.append(plan)
+            if isinstance(plan, SwapInPlan):
+                swap_plans.append(plan)
+            else:
+                plans.append(plan)
+        for plan in swap_plans:
+            # host-swap re-admission: a jitted host->device scatter
+            # replaces the re-prefill entirely — zero recompute tokens
+            self._dispatch_swap_in(plan)
         if not plans:
-            return False
+            return bool(swap_plans)
         # stale-wake epochs: if a watchdog-declared stall checkpoints
         # (preempt_count bump) and replays these sequences while this
         # thread is stuck in the device_get below, the replay may
@@ -2260,6 +2404,40 @@ class EngineCore:
                         tr.start("decode", start_pc=boundary)
                     self._maybe_finish(plan.seq, token)
         return True
+
+    def _dispatch_swap_in(self, plan: SwapInPlan) -> None:
+        """Re-admit a host-swapped preemption victim: scatter its
+        parked KV into the freshly-allocated ``seq.pages``
+        (runtime/kv_swap.py) and let it rejoin decode at the exact
+        position it stopped — token-identical, no prefill program, no
+        first-token readback (its last sampled token is the next
+        decode feed; ``_build_decode_state`` re-uploads it when the
+        membership signature changes this tick)."""
+        seq = plan.seq
+        t0 = time.perf_counter()
+        self._beat("swap_in", batch=1)
+        n = self.kv_swap.swap_in_seq(seq, seq.pages)
+        if self.flight.enabled:
+            self.flight.on_admit(
+                seq, bucket=0, cached_len=seq.total_len - 1
+            )
+            # the sequence is mid-decode, not prefilling: flip the
+            # phase record straight to decode
+            self.flight.on_first_token(seq)
+            if seq.trace is not None:
+                seq.trace.end("queue")
+                seq.trace.start("decode", swapped_in_pages=n)
+        self.flight.record_tick(
+            "swap_in",
+            batch=1,
+            pages=n,
+            step_s=round(time.perf_counter() - t0, 6),
+            kv_used=self.allocator.num_used,
+            kv_free=self.allocator.num_free,
+            queue_depth=len(self.scheduler.waiting),
+            seq_id=seq.seq_id,
+            request_id=seq.request_id,
+        )
 
     def _penalty_arrays(self, B: int, rows):
         """Build (counts [B, V] uint16, freq [B], pres [B]) device arrays
@@ -3486,6 +3664,13 @@ class EngineCore:
         self.prefix_insert_suspended = bool(flag)
         if self.radix_cache is not None:
             self.radix_cache.insert_suspended = bool(flag)
+        if self.kv_swap is not None:
+            # L4 also stops host-pool DEMOTIONS (a demotion is a cache
+            # write) while promotions keep serving — existing warm
+            # content saving prefill is exactly what overload needs.
+            # Preemption swap-outs are NOT gated: parking client-owed
+            # work beats recomputing it at any brownout level.
+            self.kv_swap.demote_suspended = bool(flag)
 
     def pressure_signals(self) -> Dict[str, Any]:
         """Cheap cross-thread gauges for the gateway's admission and
@@ -3496,7 +3681,11 @@ class EngineCore:
         ``kv_truly_free_ratio`` excludes them — the gap between the two
         is the reclaimable cache."""
         total = max(1, self.allocator.num_allocatable)
+        swap_block = (
+            self.kv_swap.signal_block() if self.kv_swap is not None else {}
+        )
         return {
+            **swap_block,
             "kv_free_ratio": round(self.allocator.num_free / total, 4),
             "kv_truly_free_ratio": round(
                 self.allocator.num_truly_free / total, 4
@@ -3549,6 +3738,11 @@ class EngineCore:
                 axis: int(size) for axis, size in self.mesh.shape.items()
             },
             "load_time_s": round(self.load_time_s, 2),
+            **(
+                {"kv_swap": self.kv_swap.get_stats()}
+                if self.kv_swap is not None
+                else {}
+            ),
             **(
                 {"integrity": self.integrity.stats()}
                 if self.integrity is not None

@@ -62,6 +62,7 @@ class RadixNode:
 
     __slots__ = (
         "tokens", "pages", "children", "parent", "lock_ref", "last_access",
+        "swapped",
     )
 
     def __init__(
@@ -76,6 +77,15 @@ class RadixNode:
         self.parent = parent
         self.lock_ref = 0
         self.last_access = 0
+        # host-swap victim cache (runtime/kv_swap.py): when eviction
+        # demoted this leaf's pages to the host pool, `pages` is empty
+        # and this holds the SwapTicket; a later match() promotes the
+        # content back into fresh device pages.  Invariant: a node has
+        # pages XOR a swapped ticket (or neither transiently never —
+        # the root alone is permanently page-less).  Swapped nodes are
+        # always leaves: eviction is leaf-first and neither insert nor
+        # split ever descends below one.
+        self.swapped = None
 
 
 class RadixMatch:
@@ -150,6 +160,24 @@ class RadixCache:
         # lives with the rest of the cache stats)
         self.total_cow_copies = 0
         self.total_nodes = 1  # root
+        # host-RAM swap tier (runtime/kv_swap.py), attached by the
+        # engine via attach_swap(): eviction demotes lock-free leaves
+        # into it (victim cache) and match() promotes them back.  None
+        # keeps eviction = discard, byte-identical to the pre-swap tree.
+        self.swap = None
+        self._swapped_nodes = 0
+        self.total_demoted_pages = 0
+        self.total_promoted_pages = 0
+        # inserts that stopped at a swapped node (we never index below
+        # a host-resident prefix) — observability for victim-cache cost
+        self.total_insert_blocked_on_swap = 0
+
+    def attach_swap(self, swap) -> None:
+        """Wire the host swap manager in (engine init): eviction gains
+        the demote path and the manager's capacity drops unlink nodes
+        through :meth:`drop_swapped`."""
+        self.swap = swap
+        swap.on_drop_node = self.drop_swapped
 
     # ------------------------------------------------------------- clock
 
@@ -189,6 +217,13 @@ class RadixCache:
             child = node.children.get(self._key(tokens, d))
             if child is None:
                 break
+            if child.swapped is not None:
+                # host-swapped victim: promote its pages back into the
+                # device pool so the walk (and the sharing) continues —
+                # a failed promotion (no device pages / executor error)
+                # simply ends the match at the resident prefix
+                if not self._try_promote(child):
+                    break
             # count matching full pages inside the child's run (first
             # page matched via the key)
             j = 1
@@ -224,6 +259,12 @@ class RadixCache:
             if cand is None:
                 best = 0
                 for child in node.children.values():
+                    if child.swapped is not None:
+                        # host-swapped: its first page is not device-
+                        # resident, so there is nothing to COW-copy
+                        # from (promoting a whole run for a sub-page
+                        # tail would cost more than it saves)
+                        continue
                     n = self._common_prefix(child.tokens, tokens, d, limit)
                     if n > best:
                         best, cand = n, child
@@ -255,6 +296,41 @@ class RadixCache:
             pages, deepest, cow_src=cow_src, cow_tokens=cow_tokens,
             cow_node=cow_node,
         )
+
+    def _try_promote(self, child: RadixNode) -> bool:
+        """Restore a host-swapped leaf's pages into the device pool
+        (match-time promotion).  The node's chain is locked around the
+        allocation so the eviction walk ``allocate`` may trigger can
+        never touch the node being promoted; the unlock edge afterwards
+        credits the restored pages back to the evictable count.
+        Refcounts/locks then re-establish through the caller's normal
+        parent-chain walk, exactly like a never-demoted match."""
+        ticket = child.swapped
+        if self.swap is None or ticket is None:
+            return False
+        self._lock_chain(child, +1, self._tick())
+        pages = self.allocator.allocate(ticket.num_pages)
+        if pages is None:
+            self._lock_chain(child, -1, self._tick())
+            return False
+        try:
+            self.swap.promote_node(ticket, pages)
+        except Exception:  # executor failure: drop the dead node
+            logger.warning(
+                "prefix promotion failed; dropping swapped node",
+                exc_info=True,
+            )
+            self.allocator.release(pages)
+            self._lock_chain(child, -1, self._tick())
+            self.drop_swapped(child, reason="stale")
+            return False
+        child.pages = pages
+        child.swapped = None
+        self._swapped_nodes -= 1
+        self._lock_chain(child, -1, self._tick())
+        self.total_promoted_pages += len(pages)
+        self._touch_gauges()
+        return True
 
     def _lock_chain(self, node: RadixNode, delta: int, now: int) -> None:
         while node is not None and node is not self.root:
@@ -296,8 +372,10 @@ class RadixCache:
             child = node.children.get(self._key(tokens, d))
             if child is None:
                 break
+            # token-run length works for resident AND host-swapped
+            # nodes (tokens survive demotion; pages do not)
+            run = len(child.tokens) // ps
             j = 1
-            run = len(child.pages)
             while (
                 j < run
                 and d + (j + 1) * ps <= limit
@@ -306,6 +384,14 @@ class RadixCache:
             ):
                 j += 1
             full += j
+            if child.swapped is not None:
+                # promotable on a real match(), but promotion must
+                # ALLOCATE the pages — counting them as evictable too
+                # keeps the admissibility math honest (num_free -
+                # evictable >= n_pages - full still requires the
+                # device pages the swap-in will claim)
+                evictable += j
+                break
             if child.lock_ref == 0:
                 evictable += j
             d += j * ps
@@ -358,6 +444,14 @@ class RadixCache:
                 self.total_nodes += 1
                 self._evictable += len(new.pages)
                 created = new
+                break
+            if child.swapped is not None:
+                # never index below a host-resident prefix: the walk
+                # cannot split or extend a page-less run, and adopting
+                # pages under it would claim device residency the
+                # prefix doesn't have.  A later match() promotes the
+                # node and re-opens the subtree for indexing.
+                self.total_insert_blocked_on_swap += 1
                 break
             # walk the child's run while it matches
             j = 0
@@ -468,52 +562,133 @@ class RadixCache:
         childless.  Returns pages actually freed."""
         if n <= 0:
             return 0
+
+        def _evict_leaf(node: RadixNode) -> bool:
+            # "leaf" for eviction purposes: nothing device-resident
+            # BELOW it.  Host-swapped children are page-less, so a
+            # node whose children are all swapped must still count —
+            # otherwise a single swapped leaf would pin its whole
+            # ancestor chain out of the walk while _evictable keeps
+            # counting those pages (reclaim would under-deliver and
+            # allocate() would refuse work the accounting promised).
+            return (
+                node.lock_ref == 0
+                and bool(node.pages)
+                and all(
+                    g.swapped is not None
+                    for g in node.children.values()
+                )
+            )
+
         heap: List[Tuple[int, int, RadixNode]] = []
         stack = [self.root]
         serial = 0
         while stack:
             node = stack.pop()
             for child in node.children.values():
-                if not child.children and child.lock_ref == 0:
+                if _evict_leaf(child):
                     serial += 1
                     heapq.heappush(
                         heap, (child.last_access, serial, child)
                     )
-                else:
+                elif child.swapped is None:
                     stack.append(child)
+                # swapped nodes: nothing device-resident in their
+                # subtree (children of a swapped node are themselves
+                # swapped); the host pool's LRU owns their lifetime
         freed = 0
         while heap and freed < n:
             _, _, leaf = heapq.heappop(heap)
-            parent = leaf.parent
-            del parent.children[leaf.tokens[: self.page_size]]
-            self._evictable -= len(leaf.pages)
+            released = leaf.pages
             # count only pages whose tree reference was the LAST one
             # (the lock/ref pairing makes that all of them; defensive
             # against a caller unlocking without releasing)
             gone = sum(
-                1 for p in leaf.pages if self.allocator.refcount(p) == 1
+                1 for p in released if self.allocator.refcount(p) == 1
             )
-            self.allocator.release(leaf.pages)
+            # host swap tier: demote the content before the device
+            # pages go — the node stays in the tree page-less (victim
+            # cache) and a later match() promotes it back.  Demotion
+            # declined (pool off/full, brownout L4) keeps the original
+            # discard.
+            ticket = (
+                self.swap.demote_node(leaf, released)
+                if self.swap is not None
+                else None
+            )
+            self._evictable -= len(released)
+            self.allocator.release(released)
             freed += gone
-            self.total_nodes -= 1
-            self.total_evictions[reason] = (
-                self.total_evictions.get(reason, 0) + len(leaf.pages)
-            )
-            metrics.PREFIX_EVICTIONS.labels(reason=reason).inc(
-                len(leaf.pages)
-            )
+            parent = leaf.parent
+            if ticket is not None:
+                leaf.swapped = ticket
+                leaf.pages = []
+                self._swapped_nodes += 1
+                self.total_demoted_pages += len(released)
+                # the node stays its parent's child (victim cache)
+            else:
+                # truly discard — including any swapped descendants,
+                # whose tickets would otherwise leak in the host pool
+                # with their nodes unreachable
+                self._drop_swapped_descendants(leaf)
+                del parent.children[leaf.tokens[: self.page_size]]
+                self.total_nodes -= 1
             if (
                 parent is not self.root
-                and not parent.children
-                and parent.lock_ref == 0
+                and _evict_leaf(parent)
             ):
+                # cascade: the parent may have just become an eviction
+                # leaf (childless, or all children now swapped)
                 serial += 1
                 heapq.heappush(
                     heap, (parent.last_access, serial, parent)
                 )
+            self.total_evictions[reason] = (
+                self.total_evictions.get(reason, 0) + len(released)
+            )
+            metrics.PREFIX_EVICTIONS.labels(reason=reason).inc(
+                len(released)
+            )
         if freed:
             self._touch_gauges()
         return freed
+
+    def _drop_swapped_descendants(self, node: RadixNode) -> None:
+        """Discard the host tickets of every swapped node under
+        ``node`` (exclusive) — they are about to become unreachable."""
+        stack = list(node.children.values())
+        while stack:
+            child = stack.pop()
+            stack.extend(child.children.values())
+            if child.swapped is not None:
+                ticket = child.swapped
+                child.swapped = None
+                self._swapped_nodes -= 1
+                if self.swap is not None:
+                    self.swap.drop_node_ticket(ticket, "capacity")
+            self.total_nodes -= 1
+
+    def drop_swapped(self, node: RadixNode, reason: str = "capacity") -> None:
+        """Unlink a host-swapped (page-less) node: the manager dropped
+        its ticket to make room for a preemption swap-out, or its
+        promotion failed.  Swapped descendants (demotion chains) go
+        with it — their tickets would otherwise leak unreachable.
+        Idempotent against the manager's own ticket accounting
+        (drop_node_ticket refunds only a still-registered ticket)."""
+        ticket = node.swapped
+        node.swapped = None
+        if ticket is not None:
+            self._swapped_nodes -= 1
+            if self.swap is not None:
+                self.swap.drop_node_ticket(ticket, reason)
+        self._drop_swapped_descendants(node)
+        parent = node.parent
+        if parent is not None:
+            key = node.tokens[: self.page_size]
+            if parent.children.get(key) is node:
+                del parent.children[key]
+                self.total_nodes -= 1
+        node.parent = None
 
     def trim_to_watermark(self, target_free: int) -> int:
         """Proactive pressure trim: top the allocator's *truly free*
@@ -552,4 +727,16 @@ class RadixCache:
             "inserted_pages": self.total_inserted_pages,
             "evictions": dict(self.total_evictions),
             "insert_suspended": self.insert_suspended,
+            **(
+                {
+                    "swapped_nodes": self._swapped_nodes,
+                    "demoted_pages": self.total_demoted_pages,
+                    "promoted_pages": self.total_promoted_pages,
+                    "insert_blocked_on_swap": (
+                        self.total_insert_blocked_on_swap
+                    ),
+                }
+                if self.swap is not None
+                else {}
+            ),
         }

@@ -21,9 +21,10 @@ from typing import Deque, List, Optional, Union
 import numpy as np
 
 from vgate_tpu import metrics
-from vgate_tpu.errors import DeadlineExceededError
+from vgate_tpu.errors import DeadlineExceededError, KVCapacityError
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.kv_cache import PageAllocator
+from vgate_tpu.runtime.kv_swap import KVSwapManager, SwapTicket
 from vgate_tpu.runtime.radix_cache import RadixCache, RadixMatch
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.utils.math import bucket_for, cdiv, round_up
@@ -89,11 +90,24 @@ class PrefillPlan:
 
 
 @dataclass
+class SwapInPlan:
+    """Re-admission of a host-swapped preemption victim
+    (runtime/kv_swap.py): the engine scatters the parked KV into the
+    freshly-allocated ``seq.pages`` and the sequence rejoins decode at
+    the exact position it stopped — no prefill program, no first-token
+    sampling (its last sampled token is the decode feed)."""
+
+    seq: Sequence
+    slot: int
+    ticket: SwapTicket
+
+
+@dataclass
 class DecodePlan:
     seqs: List[Sequence]  # active sequences, indexed by slot in .slot
 
 
-Plan = Union[PrefillPlan, DecodePlan]
+Plan = Union[PrefillPlan, SwapInPlan, DecodePlan]
 
 
 class Scheduler:
@@ -115,6 +129,7 @@ class Scheduler:
         cache_aware_sched: bool = True,
         insert_generated: bool = True,
         evict_watermark: float = 0.0,
+        swap: Optional[KVSwapManager] = None,
     ) -> None:
         # optional flight recorder (observability/flight.py): residency
         # events (preempt/shed/abort) become post-mortem ring entries
@@ -175,7 +190,14 @@ class Scheduler:
         # sequence is queued, admission selection stays head-of-queue
         self._priority_seen = False
         self.slots: List[Optional[Sequence]] = [None] * max_slots
+        # host-RAM KV swap tier (runtime/kv_swap.py): preemption parks
+        # the victim's pages instead of recomputing, re-admission
+        # swaps them back in; None keeps the pre-swap engine
+        # byte-identical (kv_cache.host_swap_bytes = 0)
+        self.swap = swap
         self.total_preemptions = 0
+        self.total_swap_preempts = 0
+        self.total_preempt_recompute_tokens = 0
         self.total_admitted = 0
         self.total_finished = 0
         self.total_aborted = 0
@@ -250,6 +272,18 @@ class Scheduler:
         head = self._select_next()
         if head is None or self._free_slot() is None:
             return False
+        if self.swap is not None:
+            # a swapped-out head re-admits via swap-in: exactly the
+            # parked page count, no prefix sharing (probe only — a
+            # stale ticket falls through to the prefill math below,
+            # which is consistent because staleness implies the fold
+            # already moved the generation into the prompt)
+            ticket = getattr(head, "_swap_ticket", None)
+            if (
+                ticket is not None
+                and head.preempt_count == ticket.epoch
+            ):
+                return self.allocator.num_free >= ticket.num_pages
         n_pages = cdiv(max(1, head.num_prompt_tokens), self.page_size)
         if self.radix is not None:
             # mirror try_admit's radix accounting: matched pages are
@@ -344,6 +378,7 @@ class Scheduler:
                     phases = self.recorder.phases_of(seq)
                 else:
                     phases = {"queue_s": round(waited / 1000.0, 6)}
+                self._discard_swap(seq, "settled")
                 seq.fail(
                     DeadlineExceededError(
                         f"request deadline "
@@ -546,6 +581,13 @@ class Scheduler:
         seq = self._select_next(count_bypass=True)
         if seq is None:
             return None
+        if self.swap is not None:
+            # swapped-out preemption victim: re-admit via host->device
+            # swap-in instead of re-prefill (ticket_for discards a
+            # stale ticket internally, falling through to recompute)
+            ticket = self.swap.ticket_for(seq)
+            if ticket is not None:
+                return self._admit_swap_in(seq, slot, ticket)
         n_pages = cdiv(max(1, seq.num_prompt_tokens), self.page_size)
 
         # prefix cache: match the longest shared prefix already resident;
@@ -608,6 +650,14 @@ class Scheduler:
         self.total_admitted += 1
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
         cached_len = len(matched) * self.page_size + cow_tokens
+        if getattr(seq, "_preempt_recompute", False):
+            # the waste the host swap tier exists to eliminate: suffix
+            # tokens this re-prefill recomputes because a preemption
+            # destroyed (rather than parked) the sequence's KV
+            seq._preempt_recompute = False  # type: ignore[attr-defined]
+            waste = max(0, seq.num_prompt_tokens - cached_len)
+            self.total_preempt_recompute_tokens += waste
+            metrics.PREEMPT_RECOMPUTE_TOKENS.inc(waste)
         self.total_prefix_hit_tokens += cached_len
         # hits count only on successful admission (a failed allocate above
         # rolls the references back and must not inflate the stat)
@@ -661,6 +711,34 @@ class Scheduler:
             register_hashes=register_hashes,
             cow=cow, radix_insert=radix_insert, radix_match=radix_match,
         )
+
+    def _admit_swap_in(
+        self, seq: Sequence, slot: int, ticket: SwapTicket
+    ) -> Optional[SwapInPlan]:
+        """Re-admit a host-swapped sequence: allocate exactly the
+        parked page count (its KV is complete — no radix match, no
+        prefill) and hand the engine a :class:`SwapInPlan` to scatter
+        the content back.  On allocation failure the sequence simply
+        waits, unless nothing is running and nothing can be preempted —
+        then the ticket is dropped and the sequence folds to the
+        recompute path, whose radix sharing may still fit it (and
+        whose own fail-fast gives the definitive answer if not)."""
+        pages = self.allocator.allocate(ticket.num_pages)
+        if pages is None:
+            if self.preempt_on_oom and not self.running:
+                self.swap.discard_for(seq, reason="no_fit")
+                seq.reset_for_recompute()
+                seq._preempt_recompute = True  # type: ignore[attr-defined]
+            return None
+        self._dequeue(seq)
+        metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+        seq.pages = pages
+        seq.slot = slot
+        seq.status = SeqStatus.RUNNING
+        self.slots[slot] = seq
+        self.total_admitted += 1
+        metrics.ACTIVE_SEQUENCES.set(len(self.running))
+        return SwapInPlan(seq=seq, slot=slot, ticket=ticket)
 
     def commit_prefill(self, plan: PrefillPlan, stale: bool = False) -> None:
         """Index the pages a dispatched prefill has made reusable —
@@ -737,7 +815,13 @@ class Scheduler:
                     seq.pages.extend(pages)
                     continue  # horizon may need several pages
                 if not self.preempt_on_oom:
-                    seq.fail(RuntimeError("KV pages exhausted"))
+                    seq.fail(
+                        KVCapacityError(
+                            "KV pages exhausted mid-decode "
+                            "(scheduler.preempt_on_oom is off); retry "
+                            "when resident work completes"
+                        )
+                    )
                     self.remove(seq)
                     break
                 victim = self._pick_victim()
@@ -745,7 +829,15 @@ class Scheduler:
                     victim is seq and len(self.running) == 1
                 ):
                     # alone and still no memory: the context can never fit
-                    seq.fail(RuntimeError("KV pages exhausted"))
+                    seq.fail(
+                        KVCapacityError(
+                            "KV pages exhausted: the sequence's grown "
+                            f"context ({seq.total_len} tokens) cannot "
+                            "fit the pool even alone; retry against a "
+                            "less-loaded replica",
+                            retry_after=5.0,
+                        )
+                    )
                     self.remove(seq)
                     break
                 self._preempt(victim)
@@ -773,6 +865,17 @@ class Scheduler:
             )
 
     def _preempt(self, seq: Sequence) -> None:
+        # host swap tier first, BEFORE anything releases the pages:
+        # park the valid KV (positions 0 .. total_len-2 — the final
+        # sampled token's KV was never written) so re-admission resumes
+        # decode with ZERO recompute.  Page content survives release()
+        # untouched until reallocated, but the read must complete
+        # before any later program could write these pages — both
+        # happen on this engine thread, so reading first is sufficient.
+        swapped = False
+        if self.swap is not None:
+            n_valid = cdiv(max(1, seq.total_len - 1), self.page_size)
+            swapped = self.swap.swap_out_seq(seq, seq.pages[:n_valid])
         logger.warning(
             "preempting sequence for KV pressure",
             extra={
@@ -781,10 +884,14 @@ class Scheduler:
                     "request_id": seq.request_id,
                     "trace_id": getattr(seq.trace, "trace_id", None),
                     "resident_tokens": seq.total_len,
+                    "swapped": swapped,
                 }
             },
         )
-        self._event("preempt", seq, resident_tokens=seq.total_len)
+        self._event(
+            "preempt", seq, resident_tokens=seq.total_len,
+            swapped=swapped,
+        )
         if self.recorder is not None:
             # phase accounting: accrue the interrupted compute phase,
             # re-enter queue time (re-admission resumes at on_admit)
@@ -796,7 +903,16 @@ class Scheduler:
         self.allocator.release(seq.pages)
         if slot is not None:
             self.slots[slot] = None
-        seq.reset_for_recompute()
+        if swapped:
+            seq.reset_for_swap()
+            self.total_swap_preempts += 1
+        else:
+            seq.reset_for_recompute()
+            # marks the re-admission prefill as preemption-caused waste
+            # (vgt_preempt_recompute_tokens — the cost the swap tier
+            # exists to eliminate); counted when the re-prefill is
+            # actually planned, cleared there
+            seq._preempt_recompute = True  # type: ignore[attr-defined]
         self.waiting.appendleft(seq)
         self.total_preemptions += 1
         metrics.PREEMPTED_SEQUENCES.inc()
@@ -843,6 +959,16 @@ class Scheduler:
             stream[: n_full * self.page_size], seq.pages[:n_full]
         )
 
+    def _discard_swap(self, seq: Sequence, reason: str) -> None:
+        """Drop a waiting sequence's parked host-pool KV (idempotent
+        no-op for sequences without a live ticket) — called on every
+        path that settles or re-folds a sequence out from under its
+        ticket.  The manager's stale sweep is the backstop for any
+        path that slips through (e.g. fatal containment, whose pool
+        dies with the core anyway)."""
+        if self.swap is not None:
+            self.swap.discard_for(seq, reason=reason)
+
     def _release_residency(self, seq: Sequence) -> None:
         self._radix_unlock(seq)
         if seq.pages:
@@ -877,6 +1003,10 @@ class Scheduler:
                 self.waiting.remove(seq)
             except ValueError:
                 pass  # already dequeued (racing admission this tick)
+            # a swapped-out waiting sequence folds to the recompute
+            # path on the migration target (the parked KV is local to
+            # this core's pool and cannot travel)
+            self._discard_swap(seq, "stale")
             metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
 
     def abort(self, seq: Sequence) -> None:
@@ -885,6 +1015,7 @@ class Scheduler:
         finish the sequence with reason "abort".  The single owner of
         abort bookkeeping for both the running and queued paths."""
         self._release_residency(seq)
+        self._discard_swap(seq, "settled")
         self.total_aborted += 1
         metrics.CANCELLED_REQUESTS.labels(reason=seq.abort_reason).inc()
         self._event("abort", seq, reason=seq.abort_reason)
@@ -899,6 +1030,7 @@ class Scheduler:
         if seq in self.waiting:
             self.waiting.remove(seq)
         self._release_residency(seq)
+        self._discard_swap(seq, "settled")
         self._event("integrity_fail", seq, error=type(exc).__name__)
         seq.fail(exc)
 
@@ -929,6 +1061,10 @@ class Scheduler:
             "admitted": self.total_admitted,
             "finished": self.total_finished,
             "preemptions": self.total_preemptions,
+            "swap_preempts": self.total_swap_preempts,
+            "preempt_recompute_tokens": (
+                self.total_preempt_recompute_tokens
+            ),
             "deadline_shed": self.total_deadline_shed,
             "aborted": self.total_aborted,
             "prefix_cache": {
@@ -954,6 +1090,21 @@ class Scheduler:
                         ),
                         "cow_copies": self.radix.total_cow_copies,
                         "insert_suspended": self.radix.insert_suspended,
+                        **(
+                            {
+                                "swapped_nodes": (
+                                    self.radix._swapped_nodes
+                                ),
+                                "demoted_pages": (
+                                    self.radix.total_demoted_pages
+                                ),
+                                "promoted_pages": (
+                                    self.radix.total_promoted_pages
+                                ),
+                            }
+                            if self.radix.swap is not None
+                            else {}
+                        ),
                     }
                     if self.radix is not None
                     else {}

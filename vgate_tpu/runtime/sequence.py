@@ -90,6 +90,12 @@ class Sequence:
     # prefix against a bf16 pool (or vice versa) would splice two
     # numerically different streams mid-generation.
     kv_dtype: Optional[str] = None
+    # times this sequence's KV was parked in the host swap pool at
+    # preemption (runtime/kv_swap.py) instead of being recomputed —
+    # the operational twin of preempt_count for the swap tier.  The
+    # live ticket itself rides on the private `_swap_ticket` attribute
+    # (manager-owned; validity is epoch-guarded by preempt_count).
+    swap_count: int = 0
     # integrity canary self-probe (vgate_tpu/integrity.py): ranks ahead
     # of client traffic at admission (a probe stuck behind a deep queue
     # can't verify anything in time) and is NEVER checkpointed/replayed
@@ -191,6 +197,20 @@ class Sequence:
         self.status = SeqStatus.WAITING
         self.preempt_count += 1
 
+    def reset_for_swap(self) -> None:
+        """Preemption with the KV parked in the host swap pool
+        (runtime/kv_swap.py): drop residency but keep the prompt/output
+        split intact — re-admission scatters the saved pages back and
+        decode resumes at the same position with ZERO recompute.  The
+        preempt_count bump is still the staleness epoch: in-flight
+        chunk readbacks discard this sequence's late tokens, and the
+        swap ticket (stamped with the post-bump epoch) goes stale if
+        anything else folds the sequence before re-admission."""
+        self.pages = []
+        self.slot = None
+        self.status = SeqStatus.WAITING
+        self.preempt_count += 1
+
     def checkpoint_summary(self) -> dict:
         """The loggable fields of :meth:`checkpoint` WITHOUT
         materializing the token-list copies — containment-path
@@ -206,6 +226,7 @@ class Sequence:
             "generated_tokens": len(self.generated_ids),
             "resume_count": self.resume_count,
             "migrate_count": self.migrate_count,
+            "swap_count": self.swap_count,
             "deadline_t": self.deadline_t,
             "kv_dtype": self.kv_dtype,
         }
@@ -238,6 +259,7 @@ class Sequence:
             preempt_count=self.preempt_count,
             resume_count=self.resume_count,
             migrate_count=self.migrate_count,
+            swap_count=self.swap_count,
             request_id=self.request_id,
             trace_id=getattr(self.trace, "trace_id", None),
             kv_dtype=self.kv_dtype,
@@ -264,6 +286,7 @@ class Sequence:
             preempt_count=cp.preempt_count,
             resume_count=cp.resume_count + 1,
             migrate_count=cp.migrate_count,
+            swap_count=cp.swap_count,
             request_id=cp.request_id,
             kv_dtype=cp.kv_dtype,
         )
@@ -336,6 +359,10 @@ class SequenceCheckpoint:
     kv_dtype: Optional[str] = None
     # planned movements ridden so far (drain/rebalance/scale-down)
     migrate_count: int = 0
+    # host-swap preemptions ridden so far (runtime/kv_swap.py); the
+    # parked KV itself never travels in a checkpoint — containment
+    # folds a swapped sequence back to the recompute path
+    swap_count: int = 0
 
     def as_dict(self) -> dict:
         """Loggable summary (token *counts*, never token content — the
@@ -349,6 +376,7 @@ class SequenceCheckpoint:
             "generated_tokens": len(self.generated_ids),
             "resume_count": self.resume_count,
             "migrate_count": self.migrate_count,
+            "swap_count": self.swap_count,
             "deadline_t": self.deadline_t,
             "kv_dtype": self.kv_dtype,
         }

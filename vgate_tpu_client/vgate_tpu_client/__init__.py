@@ -5,6 +5,7 @@ from vgate_tpu_client.exceptions import (
     AuthenticationError,
     ConnectionError,
     DeadlineExceeded,
+    KVCapacityError,
     RateLimitError,
     ServerError,
     ServerOverloadedError,
@@ -32,6 +33,7 @@ __all__ = [
     "RateLimitError",
     "ServerError",
     "ServerOverloadedError",
+    "KVCapacityError",
     "ConnectionError",
     "ChatMessage",
     "ChatCompletionRequest",

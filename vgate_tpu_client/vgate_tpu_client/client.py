@@ -23,6 +23,7 @@ from vgate_tpu_client.exceptions import (
     AuthenticationError,
     ConnectionError,
     DeadlineExceeded,
+    KVCapacityError,
     RateLimitError,
     ServerError,
     ServerOverloadedError,
@@ -92,6 +93,19 @@ def _raise_for_status(response: httpx.Response) -> None:
         )
         if reason == "overloaded":
             raise ServerOverloadedError(
+                message,
+                response.status_code,
+                body,
+                retry_after=RateLimitInfo.from_headers(
+                    response.headers
+                ).retry_after,
+            )
+        if reason == "kv_capacity":
+            # the engine's paged KV pool ran out mid-generation with
+            # nothing preemptible — transient capacity, typed so
+            # clients can retry elsewhere instead of treating it as a
+            # server bug
+            raise KVCapacityError(
                 message,
                 response.status_code,
                 body,

@@ -85,5 +85,24 @@ class ServerOverloadedError(ServerError):
         self.retry_after = retry_after
 
 
+class KVCapacityError(ServerError):
+    """503 with ``reason: "kv_capacity"`` — the server's paged KV pool
+    ran out mid-generation and nothing could be preempted to make room
+    (the request's context does not fit the pool *right now*).  A
+    transient capacity condition, not a malformed request: back off
+    ``retry_after`` seconds and retry, ideally against a less-loaded
+    replica, or resend with a smaller context/``max_tokens``."""
+
+    def __init__(
+        self,
+        message: str,
+        status_code: Optional[int] = None,
+        body: Optional[Any] = None,
+        retry_after: Optional[float] = None,
+    ) -> None:
+        super().__init__(message, status_code, body)
+        self.retry_after = retry_after
+
+
 class ConnectionError(VGTError):
     """Transport-level failure reaching the gateway."""
