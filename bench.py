@@ -614,36 +614,27 @@ def main() -> None:
         # honest efficiency next to the proxy ratio (VERDICT r2 weak-2):
         # MFU = achieved FLOP/s over peak (2*params FLOPs per generated
         # token), and the fraction of the HBM decode roofline (every
-        # decode step must stream the full weights).  Peaks keyed by
-        # device_kind; unknown devices omit the fields rather than
-        # mislabel them.
-        DEVICE_PEAKS = {  # (bf16 FLOP/s, HBM GB/s)
-            "TPU v5 lite": (197e12, 819.0),
-            "TPU v5e": (197e12, 819.0),
-            "TPU v6 lite": (918e12, 1640.0),
-            "TPU v6e": (918e12, 1640.0),
-            "TPU v5p": (459e12, 2765.0),
-            "TPU v5": (459e12, 2765.0),
-            "TPU v4": (275e12, 1228.0),
-        }
+        # decode step must stream the full weights).  Peaks come from
+        # the ONE definition site the live gauges also read
+        # (vgate_tpu/observability/roofline.py); unknown devices omit
+        # the fields rather than mislabel them.
+        from vgate_tpu.observability.roofline import (
+            peaks_for,
+            stream_weight_bytes,
+        )
+
         device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
-        peaks = DEVICE_PEAKS.get(device_kind)
+        peaks = peaks_for(device_kind)
         mfu = hbm_frac = None
         if peaks is not None:
             peak_flops, hbm_gbps = peaks
             n_params = core.spec.num_params
             mfu = (2.0 * n_params * toks_per_s) / peak_flops
-            weight_bytes = sum(
-                x.size * x.dtype.itemsize
-                for x in jax.tree.leaves(core.params)
+            # untied embed tables are GATHERED (one row per token), not
+            # streamed; only tied models read them fully as lm_head
+            weight_bytes = stream_weight_bytes(
+                core.params, core.spec.tie_embeddings
             )
-            if not core.spec.tie_embeddings:
-                # an untied embed table is GATHERED (one row per token),
-                # not streamed; only tied models read it fully as lm_head
-                weight_bytes -= sum(
-                    x.size * x.dtype.itemsize
-                    for x in jax.tree.leaves(core.params["embed"])
-                )
             # steps/s at MEASURED average decode concurrency (live
             # decoding slot-seconds over the wall), not the configured
             # slot count — staggered finishes would otherwise understate

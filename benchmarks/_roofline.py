@@ -1,75 +1,28 @@
-"""Shared device-peak table + HBM roofline helpers for the benches.
-
-One definition site (bench.py's headline roofline fraction and
-bench_decode_ablate's per-row achieved-GB/s columns must agree on the
-peaks, or a future part addition would silently skew one of them).
-Peaks are per chip; unknown device kinds return None so callers omit
-the roofline fields rather than mislabel them.
-"""
+"""Thin re-export shim — the roofline definition site moved to
+``vgate_tpu/observability/roofline.py`` so the engine's LIVE MFU /
+HBM-roofline gauges (observability/perf.py) and the offline benches
+(bench.py, bench_decode_ablate.py) share one peak table and one traffic
+model.  Import from here or from the real module; they are the same
+objects, so the two can never disagree on a device's peak."""
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from vgate_tpu.observability.roofline import (  # noqa: F401
+    DEVICE_PEAKS,
+    EngineRoofline,
+    decode_step_bytes,
+    kv_bytes_per_token,
+    peaks_for,
+    roofline_row,
+    stream_weight_bytes,
+)
 
-# device_kind -> (bf16 FLOP/s, HBM GB/s) per chip
-DEVICE_PEAKS = {
-    "TPU v5 lite": (197e12, 819.0),
-    "TPU v5e": (197e12, 819.0),
-    "TPU v6 lite": (918e12, 1640.0),
-    "TPU v6e": (918e12, 1640.0),
-    "TPU v5p": (459e12, 2765.0),
-    "TPU v5": (459e12, 2765.0),
-    "TPU v4": (275e12, 1228.0),
-}
-
-
-def peaks_for(device_kind: str) -> Optional[Tuple[float, float]]:
-    return DEVICE_PEAKS.get(device_kind)
-
-
-def kv_bytes_per_token(
-    num_layers: int,
-    kv_heads: int,
-    head_dim: int,
-    dtype_bytes: int = 2,
-    scale_bytes: int = 0,
-) -> int:
-    """HBM bytes one resident token's K+V occupies across all layers —
-    what every later decode step must READ back per context token.
-    ``scale_bytes`` is the int8-KV per-token-per-head overhead
-    (runtime/kv_cache._page_bytes uses the identical formula per page)."""
-    return 2 * num_layers * kv_heads * (head_dim * dtype_bytes + scale_bytes)
-
-
-def decode_step_bytes(
-    weight_bytes: int,
-    batch: int,
-    ctx_tokens: int,
-    kv_token_bytes: int,
-) -> int:
-    """Approximate HBM traffic of ONE decode step: stream the weights
-    once plus read every slot's live KV context (writes are one token
-    per slot — noise).  An optimistic lower bound (no re-reads, perfect
-    caching), which is exactly what a roofline denominator should be."""
-    return weight_bytes + batch * ctx_tokens * kv_token_bytes
-
-
-def roofline_row(
-    ms_per_step: float,
-    step_bytes: int,
-    device_kind: str,
-) -> dict:
-    """The per-row roofline fields bench_decode_ablate attaches:
-    achieved HBM GB/s over the step's modeled traffic, and the percent
-    of the device's HBM peak that represents.  Empty for unknown
-    devices or non-timed rows."""
-    if ms_per_step <= 0:
-        return {}
-    peaks = peaks_for(device_kind)
-    achieved_gbps = step_bytes / (ms_per_step / 1e3) / 1e9
-    row = {"achieved_hbm_gbps": round(achieved_gbps, 1)}
-    if peaks is not None:
-        row["pct_of_hbm_roofline"] = round(
-            100.0 * achieved_gbps / peaks[1], 1
-        )
-    return row
+__all__ = [
+    "DEVICE_PEAKS",
+    "EngineRoofline",
+    "decode_step_bytes",
+    "kv_bytes_per_token",
+    "peaks_for",
+    "roofline_row",
+    "stream_weight_bytes",
+]

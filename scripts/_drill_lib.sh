@@ -35,6 +35,7 @@ declare -A VGT_DRILL_PORTS=(
   [integrity]=8736
   [slo]=8737
   [swap]=8738
+  [perf]=8739
 )
 
 drill_port() {

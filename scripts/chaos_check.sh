@@ -47,3 +47,6 @@ bash scripts/slo_check.sh
 
 echo "== host-RAM KV swap tier drill =="
 bash scripts/swap_check.sh
+
+echo "== decode-loop perf observatory drill =="
+bash scripts/perf_check.sh

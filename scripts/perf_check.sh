@@ -1,0 +1,218 @@
+#!/usr/bin/env bash
+# Decode-loop perf observatory gate (sibling of swap_check.sh /
+# slo_check.sh): boot a squeezed CPU tiny-dense server, drive
+# concurrent load, and assert the attribution layer tells the truth:
+#   1. /debug/perf reports a per-phase decomposition
+#      (host/dispatch/device/readback/detok) whose sum is within 5% of
+#      the measured tick wall, with a non-empty compile ledger whose
+#      entries each count their first compile exactly once;
+#   2. the recompile ledger moves EXACTLY on bucket changes: repeating
+#      an already-warm request shape adds nothing, a prompt in a new
+#      bucket grows only the prefill family;
+#   3. /debug/perf, the /stats engine.perf block and the /metrics
+#      counters (vgt_recompiles_total{variant},
+#      vgt_tick_phase_seconds_total{phase}) agree on the same numbers;
+#   4. POST /v1/profile links into the layer: the capture lands in
+#      /debug/perf's last_profile AND as a `profile` flight tick.
+#
+# Usage: scripts/perf_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port perf)}"
+ensure_port_free "$PORT"
+
+export JAX_PLATFORMS=cpu
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=96
+export VGT_TPU__DP=1 VGT_TPU__TP=1 VGT_TPU__EP=1 VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=1
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=4
+export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=10
+# identity of the measured engine path matters, not the result cache;
+# prefix cache OFF so a repeated prompt re-runs the SAME prefill
+# program (a cache hit would legitimately compile the suffix variant
+# and blur the "ledger moves only on bucket changes" contract)
+export VGT_CACHE__ENABLED=false
+export VGT_TPU__PREFIX_CACHE='{"enabled": false}'
+export VGT_SERVER__PORT="$PORT"
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; clear_drill_pid "$PORT"' EXIT
+BASE="http://127.0.0.1:$PORT"
+
+for _ in $(seq 1 300); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: server never became ready"; exit 1;
+}
+snapshot_kv_config "$BASE" perf_check
+
+python - "$BASE" <<'EOF'
+import asyncio, json, re, sys
+import aiohttp
+
+BASE = sys.argv[1]
+# a squeezed 4-slot server under 8 concurrent min_tokens-pinned decodes
+# (phase attribution must hold while decode, prefill waves and
+# admission queueing all overlap)
+PROMPTS = [
+    f"perf drill user {i} asks about decode attribution {i}"
+    for i in range(8)
+]
+BODY = {"max_tokens": 24, "min_tokens": 24, "temperature": 0.0}
+
+
+def phase_sum(phases):
+    return sum(phases.values())
+
+
+async def get_json(session, path):
+    async with session.get(f"{BASE}{path}") as resp:
+        assert resp.status == 200, (path, resp.status)
+        return await resp.json()
+
+
+async def metrics_by_label(session, name, label):
+    async with session.get(f"{BASE}/metrics") as resp:
+        text = await resp.text()
+    out = {}
+    for line in text.splitlines():
+        m = re.match(rf'^{name}{{{label}="([^"]+)"}}\s+([0-9eE+.\-]+)', line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        async def one(p):
+            async with session.post(
+                f"{BASE}/v1/completions", json={"prompt": p, **BODY}
+            ) as resp:
+                assert resp.status == 200, resp.status
+                return await resp.json()
+
+        await asyncio.gather(*(one(p) for p in PROMPTS))
+
+        # -- 1. phase decomposition sums to tick wall ----------------
+        perf = await get_json(session, "/debug/perf")
+        assert perf["enabled"] is True, perf
+        totals = perf["totals"]
+        assert totals["ticks"] > 0 and totals["tokens"] >= 8 * 24
+        s, wall = phase_sum(totals["phase_seconds"]), totals["wall_s"]
+        assert abs(s - wall) <= 0.05 * wall, (
+            f"phases sum {s:.4f} vs tick wall {wall:.4f} — "
+            "attribution leaks time"
+        )
+        ledger = perf["compile_ledger"]
+        assert ledger, "no compiles in the ledger"
+        assert all(e["count"] == 1 for e in ledger), (
+            "a variant compiled twice without a rebuild"
+        )
+        fams = {e["program"] for e in ledger}
+        assert "decode" in fams and "prefill" in fams, fams
+        assert perf["window"]["host_overhead_ratio"] is not None
+        print(
+            f"PASS 1: {totals['ticks']} ticks, phase sum {s:.3f}s vs "
+            f"wall {wall:.3f}s ({100*s/wall:.1f}%), "
+            f"{len(ledger)} ledger entries, host_ratio="
+            f"{perf['window']['host_overhead_ratio']}"
+        )
+
+        # -- 2. ledger moves exactly on bucket changes ---------------
+        # warm the serial B=1 shape first (the burst above compiled the
+        # batched variants), then repeat it: the ledger must not move
+        warm = {"prompt": "short probe", "max_tokens": 4,
+                "temperature": 0.0}
+        async with session.post(f"{BASE}/v1/completions", json=warm) as r:
+            assert r.status == 200
+        before = {(e["program"], e["signature"]) for e in (
+            await get_json(session, "/debug/perf"))["compile_ledger"]}
+        async with session.post(f"{BASE}/v1/completions", json=warm) as r:
+            assert r.status == 200
+        mid = {(e["program"], e["signature"]) for e in (
+            await get_json(session, "/debug/perf"))["compile_ledger"]}
+        assert mid == before, (
+            f"repeating a warm shape moved the ledger: {mid - before}"
+        )
+        # a prompt in a NEW bucket (32) must grow ONLY the prefill
+        # family (same decode ladder, same sampling features)
+        long_prompt = " ".join(f"w{i}" for i in range(24))
+        async with session.post(
+            f"{BASE}/v1/completions",
+            json={"prompt": long_prompt, "max_tokens": 4,
+                  "temperature": 0.0},
+        ) as r:
+            assert r.status == 200
+        after = {(e["program"], e["signature"]) for e in (
+            await get_json(session, "/debug/perf"))["compile_ledger"]}
+        new = after - mid
+        assert new, "a new bucket compiled nothing"
+        assert all(p in ("prefill", "suffix_prefill") for p, _ in new), (
+            f"bucket change moved non-prefill families: {new}"
+        )
+        print(f"PASS 2: warm repeat moved 0 entries, new bucket moved "
+              f"{len(new)} prefill entr{'y' if len(new)==1 else 'ies'}")
+
+        # -- 3. /debug/perf, /stats and /metrics agree ----------------
+        perf = await get_json(session, "/debug/perf")
+        stats = (await get_json(session, "/stats"))["engine"]["perf"]
+        assert stats["enabled"] is True
+        assert stats["compiles"] == perf["totals"]["compiles"], (
+            stats["compiles"], perf["totals"]["compiles"])
+        for name, v in perf["totals"]["phase_seconds"].items():
+            sv = stats["phase_seconds"][name]
+            assert abs(sv - v) <= max(0.02, 0.05 * max(v, sv)), (
+                f"/stats vs /debug/perf disagree on {name}: {sv} vs {v}")
+        m_rec = await metrics_by_label(
+            session, "vgt_recompiles_total", "variant")
+        for prog, count in perf["totals"]["compiles"].items():
+            assert m_rec.get(prog) == float(count), (prog, m_rec)
+        m_phase = await metrics_by_label(
+            session, "vgt_tick_phase_seconds_total", "phase")
+        for name, v in perf["totals"]["phase_seconds"].items():
+            mv = m_phase.get(name, 0.0)
+            assert abs(mv - v) <= max(0.02, 0.05 * max(v, mv)), (
+                f"/metrics vs /debug/perf disagree on {name}: {mv} vs {v}")
+        print("PASS 3: /debug/perf, /stats engine.perf and /metrics "
+              "agree on compiles and phase seconds")
+
+        # -- 4. /v1/profile links into the layer ----------------------
+        async with session.post(
+            f"{BASE}/v1/profile", json={"duration_ms": 200}
+        ) as resp:
+            assert resp.status == 200, resp.status
+            capture = await resp.json()
+        perf = await get_json(session, "/debug/perf")
+        lp = perf["last_profile"]
+        assert lp and lp["trace_dir"] == capture["trace_dir"], (
+            lp, capture)
+        flight = await get_json(session, "/debug/flight?n=512")
+        kinds = [t["kind"] for t in flight["ticks"]]
+        assert "profile" in kinds, kinds
+        print(f"PASS 4: profile capture {capture['trace_dir']} linked "
+              "into /debug/perf and the flight ring")
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+clear_drill_pid "$PORT"
+trap - EXIT
+echo "perf_check: OK"

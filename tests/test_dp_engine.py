@@ -90,6 +90,16 @@ def test_dp_stats_aggregate(dp_engine):
         r["prefills"] for r in stats["replicas"]
     )
     assert stats["mesh"]["dp"] == 2
+    # perf attribution aggregates across replicas (_MergedFlight-style)
+    assert stats["perf"]["enabled"] is True
+    assert stats["perf"]["ticks"] == sum(
+        r["perf"]["ticks"] for r in stats["replicas"]
+    )
+    snap = dp_engine.perf_snapshot()
+    assert snap["enabled"] is True
+    assert [r["replica"] for r in snap["replicas"]] == [0, 1]
+    assert snap["totals"]["tokens"] > 0
+    assert snap["totals"]["compiles"]
     health = dp_engine.device_health()
     assert health["alive"] is True
     assert health["replicas"] == 2
@@ -273,35 +283,58 @@ def test_dp_rebalance_moves_long_decode_off_pressured_replica():
     """The rebalance policy moves >= 1 resident off a pressured replica
     to an idle sibling with no client-visible error, and the cooldown
     stops it from immediately moving again (engine-level no-flap; the
-    fake-clock hysteresis contract is pinned in test_migration.py)."""
+    fake-clock hysteresis contract is pinned in test_migration.py).
+
+    Poll-with-deadline (the PR-8 lifecycle deflake pattern): the victim
+    decode races the move — under full-suite load the gap between
+    "seq has >= min_generated tokens" and the evacuation landing can
+    stretch past the sequence FINISHING (evacuate then finds no victim
+    and the policy holds), which made this flake while passing in
+    isolation.  Each attempt submits a fresh victim and a fresh no-hold
+    policy, so one attempt's cooldown/hysteresis state cannot starve
+    the next; per-attempt semantics are unchanged."""
+    import time
+
     from vgate_tpu.runtime.dp_engine import RebalancePolicy
     from vgate_tpu.runtime.sequence import SeqStatus
 
     engine = ReplicatedEngine(dp_config(dp=2), devices=jax.devices()[:2])
     engine.start()
     try:
-        # deterministic policy: no hold (hysteresis is unit-pinned on a
-        # fake clock), long cooldown so exactly ONE move can fire
+        # deterministic policy per attempt: no hold (hysteresis is
+        # unit-pinned on a fake clock), long cooldown so at most ONE
+        # move can fire within an attempt
         mig = load_config(
             migration={
                 "rebalance_hold_s": 0.0,
                 "rebalance_cooldown_s": 3600.0,
             }
         ).migration
-        engine._policy = RebalancePolicy(mig)
-        seq = engine.replicas[0].submit_tokens(
-            list(range(21, 29)), long_greedy()
-        )
-        # older than migration.min_generated_tokens so it is movable
-        assert _wait_generated(seq, 10)
         engine.replicas[0].pressure_signals = lambda: {
             "kv_free_ratio": 0.02, "engine_queue_depth": 0,
         }
         engine.replicas[1].pressure_signals = lambda: {
             "kv_free_ratio": 0.95, "engine_queue_depth": 0,
         }
-        moved = engine.maybe_rebalance()
-        assert moved is not None and moved["moved"] >= 1, moved
+        deadline = time.monotonic() + 120.0
+        moved = seq = None
+        while time.monotonic() < deadline:
+            engine._policy = RebalancePolicy(mig)
+            seq = engine.replicas[0].submit_tokens(
+                list(range(21, 29)), long_greedy()
+            )
+            # older than migration.min_generated_tokens so it is movable
+            assert _wait_generated(seq, 10)
+            moved = engine.maybe_rebalance()
+            if moved is not None and moved["moved"] >= 1:
+                break
+            # the victim finished under our feet (or the evacuation
+            # raced its last chunk): let it settle, retry fresh
+            moved = None
+            assert seq.done_event.wait(timeout=300)
+        assert moved is not None and moved["moved"] >= 1, (
+            "no rebalance landed within the deadline"
+        )
         assert moved["lost"] == 0
         # rate limit: the very next tick must hold (cooldown)
         assert engine.maybe_rebalance() is None

@@ -782,6 +782,20 @@ class ObservabilityConfig(BaseModel):
     # logs, so off only in trusted environments.
     redact_prompts: bool = True
     prompt_preview_chars: int = 48
+    # Perf-attribution stratum (observability/perf.py; /debug/perf):
+    # per-tick phase decomposition (host/dispatch/device/readback/
+    # detok), the compile ledger, and the rolling-window tok/s, MFU and
+    # HBM-roofline gauges.  Gated on the master `enabled` switch too;
+    # off = no per-tick timing calls beyond the pre-perf engine.
+    perf_enabled: bool = True
+    # Rolling window the live gauges (vgt_decode_mfu,
+    # vgt_host_overhead_ratio, ...) and /stats aggregate over.
+    perf_window_s: float = 30.0
+    # Tick profiles kept in the attribution ring (oldest evicted).
+    perf_ticks: int = 4096
+    # Compile-ledger entries kept (one per compiled program variant;
+    # steady state is far below this — hitting it IS a recompile storm).
+    perf_compile_ledger_max: int = 256
 
 
 class SecurityConfig(BaseModel):

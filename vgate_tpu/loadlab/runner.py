@@ -133,6 +133,83 @@ async def _scrape(base_url: str) -> Optional[Dict[str, Dict[str, Any]]]:
         return None
 
 
+async def _fetch_perf(base_url: str) -> Optional[Dict[str, Any]]:
+    """One /debug/perf scrape, or None when the server has no
+    attribution surface (pre-perf servers, disabled recorder)."""
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"{base_url}/debug/perf",
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                body = await resp.json()
+                return body if body.get("enabled") else None
+    except Exception:  # noqa: BLE001 — perf attribution is an extra
+        # evidence column, never a reason to fail the measurement
+        return None
+
+
+def _perf_totals(snap: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The monotone ``totals`` block from a /debug/perf payload —
+    top-level on dp=1, under the merged aggregate on dp>1 (both shapes
+    carry it top-level; the replicas list is ignored here)."""
+    return snap.get("totals")
+
+
+def perf_delta(
+    before: Optional[Dict[str, Any]],
+    after: Optional[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Per-cell perf-attribution delta from two /debug/perf scrapes:
+    where the server's engine time went during THIS cell (phase
+    seconds, recompiles, host-overhead ratio) plus the end-of-cell
+    rolling-window gauges — so every sweep artifact carries a "where
+    did the time go" row next to its tok/s number."""
+    if before is None or after is None:
+        return None
+    b, a = _perf_totals(before), _perf_totals(after)
+    if b is None or a is None:
+        return None
+    phases = {
+        name: round(
+            a["phase_seconds"].get(name, 0.0)
+            - b["phase_seconds"].get(name, 0.0),
+            6,
+        )
+        for name in a.get("phase_seconds", {})
+    }
+    wall = round(a["wall_s"] - b["wall_s"], 6)
+    recompiles = {
+        prog: a["compiles"].get(prog, 0) - b["compiles"].get(prog, 0)
+        for prog in set(a.get("compiles", {})) | set(b.get("compiles", {}))
+    }
+    window = after.get("window") or {}
+    return {
+        "ticks": a["ticks"] - b["ticks"],
+        "tokens": a["tokens"] - b["tokens"],
+        "wall_s": wall,
+        "phase_seconds": phases,
+        "host_overhead_ratio": (
+            round(phases.get("host", 0.0) / wall, 4) if wall > 0 else None
+        ),
+        "recompiles": {k: v for k, v in recompiles.items() if v},
+        "compile_seconds": round(
+            a["compile_seconds"] - b["compile_seconds"], 6
+        ),
+        # end-of-cell rolling-window gauges (the live view the server's
+        # vgt_decode_mfu / vgt_host_overhead_ratio metrics export)
+        "window": {
+            key: window.get(key)
+            for key in (
+                "tokens_per_s", "mfu", "hbm_roofline_pct",
+                "host_overhead_ratio",
+            )
+        },
+    }
+
+
 async def _fetch_stats(base_url: str) -> Dict[str, Any]:
     try:
         async with aiohttp.ClientSession() as session:
@@ -245,6 +322,7 @@ async def run_scenario_async(
             f"({len(plan)} arrivals over {scenario.duration_s:g}s)"
         )
         before = await _scrape(base_url)
+        perf_before = await _fetch_perf(base_url)
         chaos_result: Dict[str, Any] = {}
         extra = []
         armed_here = scenario.chaos is not None and (
@@ -265,6 +343,7 @@ async def run_scenario_async(
         # let stragglers' histogram observations land before the
         # post-cell scrape (the driver already awaited every sample)
         after = await _scrape(base_url)
+        perf_after = await _fetch_perf(base_url)
         line = slo.grade_cell(
             samples, scenario.slos,
             qps=qps, duration_s=scenario.duration_s,
@@ -276,6 +355,10 @@ async def run_scenario_async(
             }
         else:
             line["server"] = None
+        # the attribution delta lands next to the two TTFT views: every
+        # future perf PR's sweep carries a "where did the time go" row,
+        # not just a tok/s number
+        line["perf"] = perf_delta(perf_before, perf_after)
         if armed_here:
             line["chaos"] = {
                 "faults": scenario.chaos.faults,

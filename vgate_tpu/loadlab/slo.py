@@ -38,7 +38,7 @@ META_REQUIRED = (
 )
 CELL_REQUIRED = (
     "kind", "qps", "offered", "completed", "duration_s", "tiers",
-    "overall", "unhandled_errors", "send_lag_p99_s", "valid",
+    "overall", "unhandled_errors", "send_lag_p99_s", "valid", "perf",
 )
 SUMMARY_REQUIRED = (
     "kind", "max_goodput_qps", "knee_qps", "per_tier_max_goodput_qps",
@@ -158,6 +158,10 @@ def grade_cell(
         # invalid rather than silently reported (client-side clipping
         # corrupts tails in the flattering direction)
         "valid": lag_p99 <= SEND_LAG_BOUND_S,
+        # server-side perf attribution for the cell ("where did the
+        # time go"): the runner overwrites this with the /debug/perf
+        # delta; None when the server has no attribution surface
+        "perf": None,
     }
 
 

@@ -222,6 +222,56 @@ RECOMPILES = _safe_metric(
     labelnames=("kind",),
 )
 
+# --- decode-loop perf attribution (observability/perf.py; /debug/perf) ---
+TICK_PHASE_SECONDS = _safe_metric(
+    Counter,
+    "vgt_tick_phase_seconds",
+    "Engine-tick wall time attributed by phase: host (scheduler/"
+    "admission/bookkeeping between dispatches), dispatch (jitted-call "
+    "trace+enqueue; first-compiles land here and in the compile "
+    "ledger), device (host blocked on device execution at the readback "
+    "boundary), readback (device->host transfer), detok (token append/"
+    "stop detection/stream callbacks).  rate() by phase gives the live "
+    "time split the tick->megatick refactor is judged against",
+    labelnames=("phase",),  # host | dispatch | device | readback | detok
+)
+RECOMPILES_BY_VARIANT = _safe_metric(
+    Counter,
+    "vgt_recompiles",
+    "Compile-ledger entries observed at fresh-variant first dispatches, "
+    "by program family (prefill | suffix_prefill | chunked_prefill | "
+    "decode | spec_verify).  Steady state compiles each variant once; "
+    "sustained increase under load is a recompile storm "
+    "(VgtRecompileStorm) — per-variant signatures in /debug/perf",
+    labelnames=("variant",),
+)
+DECODE_MFU = _safe_metric(
+    Gauge,
+    "vgt_decode_mfu",
+    "Live model-FLOPs utilization over the perf window (2 FLOPs per "
+    "param per generated token vs the mesh's peak, "
+    "observability/roofline.py — the same peak table bench.py reads).  "
+    "0 off the peak table (e.g. CPU dry-runs); dp>1 reports the last-"
+    "flushed replica (exact per-replica values: /debug/perf)",
+)
+DECODE_HBM_ROOFLINE_PCT = _safe_metric(
+    Gauge,
+    "vgt_decode_hbm_roofline_pct",
+    "Live percent of the device's HBM roofline achieved by decode over "
+    "the perf window (modeled traffic: weights streamed once per step "
+    "plus resident-context KV reads, over host-observed device time).  "
+    "The ROADMAP target is >=40; dp>1 reports the last-flushed replica",
+)
+HOST_OVERHEAD_RATIO = _safe_metric(
+    Gauge,
+    "vgt_host_overhead_ratio",
+    "Fraction of engine-tick wall spent in the host phase (scheduler/"
+    "admission/bookkeeping between dispatches) over the perf window — "
+    "the overhead a device-resident multi-step decode loop amortizes; "
+    "high values under decode load mean the engine is host-bound "
+    "(VgtHostOverheadHigh, docs/operations.md)",
+)
+
 # --- recovery / health state machine (runtime/supervisor.py) ---
 ENGINE_RESTARTS = _safe_metric(
     Counter, "vgt_engine_restarts", "Supervised engine restarts"

@@ -12,10 +12,20 @@ is absent:
   the HTTP request span across the batcher/engine thread boundary;
 * :mod:`~vgate_tpu.observability.memtrace` — a minimal recording tracer
   provider built on the OTel *API* alone, so span trees are testable
-  (and debuggable in dev) without the OTel SDK installed.
+  (and debuggable in dev) without the OTel SDK installed;
+* :mod:`~vgate_tpu.observability.perf` — per-tick phase attribution
+  (host/dispatch/device/readback/detok), the compile ledger, and the
+  rolling-window MFU / HBM-roofline / host-overhead gauges served via
+  ``/debug/perf``;
+* :mod:`~vgate_tpu.observability.roofline` — the device peak table and
+  roofline/MFU math shared with the benches (benchmarks/_roofline.py is
+  a re-export shim of it).
 """
 
 from vgate_tpu.observability.flight import FlightRecorder
+from vgate_tpu.observability.perf import PerfRecorder
 from vgate_tpu.observability.reqtrace import RequestMeta, RequestTrace
 
-__all__ = ["FlightRecorder", "RequestMeta", "RequestTrace"]
+__all__ = [
+    "FlightRecorder", "PerfRecorder", "RequestMeta", "RequestTrace",
+]

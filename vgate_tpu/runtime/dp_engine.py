@@ -48,6 +48,7 @@ from vgate_tpu.errors import (
 )
 from vgate_tpu.integrity import CanaryKeeper
 from vgate_tpu.logging_config import get_logger
+from vgate_tpu.observability import perf as perf_attr
 from vgate_tpu.runtime.engine_core import (
     EngineCore,
     rebuild_core,
@@ -2143,7 +2144,21 @@ class ReplicatedEngine:
                     else {}
                 ),
             }
+        # perf attribution: pod aggregate next to the per-replica blocks
+        # (observability/perf.py merge — additive sums, wall-weighted
+        # ratios), mirroring the _MergedFlight pattern
+        agg["perf"] = perf_attr.merge_stats(
+            [s["perf"] for s in per_replica if "perf" in s]
+        )
         agg["mesh"] = dict(per_replica[0]["mesh"], dp=len(self.replicas))
         agg["load_time_s"] = round(self.load_time_s, 2)
         agg["replicas"] = per_replica
         return agg
+
+    def perf_snapshot(self) -> Dict[str, Any]:
+        """The dp /debug/perf payload: every replica's attribution
+        snapshot plus the merged pod view (observability/perf.py
+        merge_snapshots — the _MergedFlight pattern for perf)."""
+        return perf_attr.merge_snapshots(
+            [core.perf.snapshot() for core in list(self.replicas)]
+        )

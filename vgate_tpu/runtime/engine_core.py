@@ -67,7 +67,13 @@ from vgate_tpu.ops.sampling import (
     verify_and_sample,
 )
 from vgate_tpu.observability.flight import FlightRecorder
+from vgate_tpu.observability.perf import PerfRecorder
 from vgate_tpu.observability.reqtrace import RequestMeta, RequestTrace
+from vgate_tpu.observability.roofline import (
+    EngineRoofline,
+    kv_bytes_per_token,
+    stream_weight_bytes,
+)
 from vgate_tpu.ops.kv_quant import (
     SCALE_BYTES,
     copy_page_prefix,
@@ -965,6 +971,32 @@ class EngineCore:
         # + per-request post-mortem rings; the supervisor snapshots it
         # on every crash and /debug serves it live
         self.flight = FlightRecorder(self.config.observability)
+        # perf attribution (vgate_tpu/observability/perf.py): per-tick
+        # phase decomposition, compile ledger, live MFU/roofline gauges
+        # from the engine's own geometry — served via /debug/perf and
+        # the /stats perf block.  Rebuilt fresh on supervised restart
+        # like the flight recorder (a rebuilt core recompiles, and the
+        # ledger must say so).
+        self.perf = PerfRecorder(
+            self.config.observability,
+            roofline=EngineRoofline(
+                device_kind=getattr(
+                    self.mesh.devices.flat[0], "device_kind", "unknown"
+                ),
+                num_chips=int(self.mesh.devices.size),
+                num_params=int(self.spec.num_params),
+                weight_stream_bytes=stream_weight_bytes(
+                    self.params, self.spec.tie_embeddings
+                ),
+                kv_token_bytes=kv_bytes_per_token(
+                    self.spec.num_layers,
+                    self.spec.num_kv_heads,
+                    self.spec.head_dim,
+                    dtype_bytes=kv_dtype_bytes,
+                    scale_bytes=kv_scale_bytes,
+                ),
+            ),
+        )
         # see the long rationale further down where the readback paths
         # use it; constructed here so the swap manager can share it
         self._readback_lock = threading.Lock()
@@ -1483,7 +1515,15 @@ class EngineCore:
         while self._running:
             try:
                 self._beat("tick")
-                if not self._tick():
+                # perf attribution brackets the whole tick: phases
+                # measured inside (dispatch/device/readback/detok) are
+                # subtracted from the tick wall, the remainder is
+                # host_s — so the five phases sum to the wall by
+                # construction (observability/perf.py)
+                self.perf.tick_begin()
+                worked = self._tick()
+                self.perf.tick_end(worked)
+                if not worked:
                     self._wakeup.wait(timeout=0.005)
                     self._wakeup.clear()
             except Exception as exc:
@@ -2343,7 +2383,17 @@ class EngineCore:
             )
             self.scheduler.commit_prefill(plan, stale=stale)
         self._beat("prefill_readback", batch=len(plans))
-        firsts = jax.device_get([h for _, h in dispatched])  # [(tok, lp)]
+        # the perf split of the one existing sync (see _process_chunks):
+        # wait-for-compute (device_s), then the device_get transfer
+        # (readback_s)
+        readback_t0 = time.perf_counter()
+        handles = [h for _, h in dispatched]
+        jax.block_until_ready(handles)
+        device_s = time.perf_counter() - readback_t0
+        firsts = jax.device_get(handles)  # [(tok, lp)]
+        readback_s = time.perf_counter() - readback_t0 - device_s
+        self.perf.phase("device", device_s)
+        self.perf.phase("readback", readback_s)
         # batched admission costs one combined dispatch+readback; attribute
         # an equal share to each prefill so observation count stays
         # one-per-prefill and the histogram sum stays the true wall time
@@ -2354,12 +2404,20 @@ class EngineCore:
                 share,
                 trace_id=getattr(plan.seq.trace, "trace_id", None),
             )
+        detok_t0 = time.perf_counter()
+        delivered = 0
         for (group, _), (tokens, lp) in zip(dispatched, firsts):
             self.flight.record_tick(
                 "prefill",
                 batch=len(group),
                 bucket=group[0].bucket,
                 step_s=round(share * len(group), 6),
+                device_s=round(
+                    device_s * len(group) / len(plans), 6
+                ),
+                readback_s=round(
+                    readback_s * len(group) / len(plans), 6
+                ),
                 kv_used=self.allocator.num_used,
                 kv_free=self.allocator.num_free,
                 queue_depth=len(self.scheduler.waiting),
@@ -2392,6 +2450,7 @@ class EngineCore:
                     # first incarnation's first token
                     fresh_first = plan.seq.first_token_t is None
                     plan.seq.append_token(token)
+                    delivered += 1
                     self.flight.on_first_token(plan.seq)
                     tr = plan.seq.trace
                     if tr is not None:
@@ -2403,6 +2462,8 @@ class EngineCore:
                         tr.end("prefill", end_pc=boundary)
                         tr.start("decode", start_pc=boundary)
                     self._maybe_finish(plan.seq, token)
+        self.perf.phase("detok", time.perf_counter() - detok_t0)
+        self.perf.note_tokens(delivered)
         return True
 
     def _dispatch_swap_in(self, plan: SwapInPlan) -> None:
@@ -2594,6 +2655,7 @@ class EngineCore:
                 if plan.seq.trace is not None:
                     plan.seq.trace.event("xla_compile", bucket=bucket)
         self._beat("prefill", compiling=fresh, bucket=bucket, batch=B)
+        dispatch_t0 = time.perf_counter()
         out, self.k_pages, self.v_pages = _prefill_step(
             self.params,
             self.spec,
@@ -2620,6 +2682,12 @@ class EngineCore:
             bias_ids=lb_ids,
             bias_vals=lb_vals,
         )
+        dispatch_s = time.perf_counter() - dispatch_t0
+        self.perf.phase("dispatch", dispatch_s)
+        if fresh:
+            self.perf.record_compile(
+                "prefill", key, dispatch_s, trigger="bucket"
+            )
         return out  # (first tokens [B], logprob triple or None)
 
     @staticmethod
@@ -2734,6 +2802,7 @@ class EngineCore:
                 if plan.seq.trace is not None:
                     plan.seq.trace.event("xla_compile", bucket=bucket)
         self._beat("prefill", compiling=fresh, bucket=bucket, batch=B)
+        dispatch_t0 = time.perf_counter()
         out, self.k_pages, self.v_pages = _suffix_prefill_step(
             self.params,
             self.spec,
@@ -2765,6 +2834,12 @@ class EngineCore:
             mesh=self._mt_mesh,
             unaligned=unaligned,
         )
+        dispatch_s = time.perf_counter() - dispatch_t0
+        self.perf.phase("dispatch", dispatch_s)
+        if fresh:
+            self.perf.record_compile(
+                "suffix_prefill", key, dispatch_s, trigger="bucket"
+            )
         return out  # (first tokens [B], logprob triple or None)
 
     def _dispatch_chunked_prefill(self, plan: PrefillPlan):
@@ -2817,6 +2892,7 @@ class EngineCore:
             self._beat(
                 "prefill_chunk", compiling=fresh, bucket=chunk, batch=1
             )
+            dispatch_t0 = time.perf_counter()
             _out, self.k_pages, self.v_pages = _suffix_prefill_step(
                 self.params,
                 self.spec,
@@ -2837,6 +2913,13 @@ class EngineCore:
                 use_pallas=self.use_pallas,
                 mesh=self._mt_mesh,
             )
+            dispatch_s = time.perf_counter() - dispatch_t0
+            self.perf.phase("dispatch", dispatch_s)
+            if fresh:
+                self.perf.record_compile(
+                    "chunked_prefill", key, dispatch_s,
+                    trigger="ctx_width",
+                )
             start += n
         # final chunk: exactly a B=1 suffix-group dispatch with
         # cached_len=start — delegate so the full sampling surface
@@ -3009,7 +3092,8 @@ class EngineCore:
         guard = (
             self.integrity is not None and self.integrity.guard_enabled
         )
-        start = time.perf_counter()
+        dispatch_t0 = time.perf_counter()
+        start = dispatch_t0
         (
             chunk_tokens,
             chunk_lp,
@@ -3056,6 +3140,15 @@ class EngineCore:
                 self.config.integrity.saturate_threshold if guard else 1.0e4
             ),
         )
+        # the jitted-call return is trace+enqueue (dispatch_s); a fresh
+        # variant's call also compiles synchronously, so its duration
+        # IS the compile cost the ledger records
+        dispatch_s = time.perf_counter() - dispatch_t0
+        self.perf.phase("dispatch", dispatch_s)
+        if fresh:
+            self.perf.record_compile(
+                "decode", chunk_key, dispatch_s, trigger="chunk_variant"
+            )
         self._step_counter += chunk
         # snapshot preempt_count as an epoch: a sequence preempted while
         # this chunk is in flight (and possibly re-admitted before the
@@ -3078,7 +3171,13 @@ class EngineCore:
             # queueing when more than one chunk is in flight
             self._beat("decode_readback", chunk=chunk, batch=len(seqs))
             block_start = time.perf_counter()
-            sampled = np.asarray(tokens_dev)  # [chunk, B]; blocks
+            # perf attribution splits the ONE sync this path already
+            # had: block_until_ready is the wait-for-compute share
+            # (device_s), the asarray transfers after it (readback_s) —
+            # no sync is added the np.asarray would not have paid
+            jax.block_until_ready(tokens_dev)
+            device_t = time.perf_counter()
+            sampled = np.asarray(tokens_dev)  # [chunk, B]
             sampled = faults.corrupt_array("decode_step", sampled)
             lp_np = (
                 None
@@ -3086,6 +3185,15 @@ class EngineCore:
                 else tuple(np.asarray(a) for a in lp_dev)
             )
             block_s = time.perf_counter() - block_start
+            device_s = device_t - block_start
+            self.perf.phase("device", device_s)
+            self.perf.phase("readback", block_s - device_s)
+            if self.perf.enabled:
+                self.perf.note_decode(
+                    steps=chunk,
+                    ctx_tokens=sum(s.total_len for s, _ in seqs),
+                    device_s=device_s,
+                )
             if self.integrity is not None and flags_dev is not None:
                 # the flags readback + fault hooks stay OUTSIDE the
                 # lock (np.asarray blocks on the device)
@@ -3141,6 +3249,8 @@ class EngineCore:
                 batch=len(seqs),
                 chunk=chunk,
                 step_s=round(block_s, 6),
+                device_s=round(device_s, 6),
+                readback_s=round(block_s - device_s, 6),
                 kv_used=self.allocator.num_used,
                 kv_free=self.allocator.num_free,
                 queue_depth=len(self.scheduler.waiting),
@@ -3149,6 +3259,8 @@ class EngineCore:
             # is above): see _admit_and_prefill — the epoch guard is
             # check-then-append, and containment's fold must not
             # interleave with it
+            detok_t0 = time.perf_counter()
+            delivered = 0
             with self._readback_lock:
                 for seq, epoch in seqs:
                     if (
@@ -3163,9 +3275,14 @@ class EngineCore:
                             self._attach_logprob(seq, lp_np, k, slot)
                         seq.append_token(token)
                         self.total_decode_tokens += 1
+                        delivered += 1
                         self._maybe_finish(seq, token)
                         if seq.status is not SeqStatus.RUNNING:
                             break
+            self.perf.phase(
+                "detok", time.perf_counter() - detok_t0
+            )
+            self.perf.note_tokens(delivered)
             self.total_steps += chunk
             if not drain:
                 break
@@ -3319,13 +3436,15 @@ class EngineCore:
         )
         all_greedy = self._all_greedy(active, num_lp)
         spec_key = (S_round, width, num_lp, all_greedy, want_pen)
+        fresh = spec_key not in self._compiled_spec
         self._beat(
             "spec_verify",
-            compiling=spec_key not in self._compiled_spec,
+            compiling=fresh,
             chunk=S_round,
             batch=len(active),
         )
         self._compiled_spec.add(spec_key)
+        dispatch_t0 = time.perf_counter()
         (
             model_toks, accepted, lp_data, counts_out,
             self.k_pages, self.v_pages,
@@ -3367,10 +3486,21 @@ class EngineCore:
                 mesh=self._mt_mesh,
             )
         )
+        dispatch_s = time.perf_counter() - dispatch_t0
+        self.perf.phase("dispatch", dispatch_s)
+        if fresh:
+            self.perf.record_compile(
+                "spec_verify", spec_key, dispatch_s,
+                trigger="spec_width",
+            )
         if want_pen:
             self._spec_pen["counts"] = counts_out
         self._step_counter += 1
-        toks_np = np.asarray(model_toks)  # [B, S]; blocks
+        # perf split of the existing sync (see _process_chunks)
+        device_t0 = time.perf_counter()
+        jax.block_until_ready((model_toks, accepted))
+        device_s = time.perf_counter() - device_t0
+        toks_np = np.asarray(model_toks)  # [B, S]
         acc_np = np.asarray(accepted)
         lp_np = None
         if lp_data is not None:
@@ -3382,6 +3512,15 @@ class EngineCore:
                 np.transpose(np.asarray(lp_data[2]), (1, 0, 2)),
             )
         spec_s = time.perf_counter() - start
+        readback_s = time.perf_counter() - device_t0 - device_s
+        self.perf.phase("device", device_s)
+        self.perf.phase("readback", readback_s)
+        if self.perf.enabled:
+            self.perf.note_decode(
+                steps=1,
+                ctx_tokens=sum(s.total_len for s in active),
+                device_s=device_s,
+            )
         metrics.observe_with_exemplar(
             metrics.ENGINE_STEP_TIME.labels(kind="decode"),
             spec_s,
@@ -3399,12 +3538,16 @@ class EngineCore:
             batch=len(active),
             chunk=S_round,
             step_s=round(spec_s, 6),
+            device_s=round(device_s, 6),
+            readback_s=round(readback_s, 6),
             kv_used=self.allocator.num_used,
             kv_free=self.allocator.num_free,
             queue_depth=len(self.scheduler.waiting),
         )
         # append under the readback lock (device waits all happened
         # above): see _admit_and_prefill for the interleaving hazard
+        detok_t0 = time.perf_counter()
+        delivered = 0
         with self._readback_lock:
             for seq in active:
                 # stale-wake guard (see _admit_and_prefill): status AND
@@ -3428,9 +3571,12 @@ class EngineCore:
                         self._attach_logprob(seq, lp_np, j, slot)
                     seq.append_token(token)
                     self.total_decode_tokens += 1
+                    delivered += 1
                     self._maybe_finish(seq, token)
                     if seq.status is not SeqStatus.RUNNING:
                         break
+        self.perf.phase("detok", time.perf_counter() - detok_t0)
+        self.perf.note_tokens(delivered)
         self.total_steps += 1
         return True
 
@@ -3641,11 +3787,24 @@ class EngineCore:
             for f in files
             if os.path.getmtime(os.path.join(root, f)) >= capture_start - 1
         )
-        return {
+        result = {
             "trace_dir": out_dir,
             "duration_s": duration_s,
             "files": n_files,
         }
+        # link the device-timeline capture to the attribution layer:
+        # the flight ring shows WHEN the capture window sat relative to
+        # recompiles/sheds, and /debug/perf reports the last capture so
+        # operators can line up phase attribution with the XProf trace
+        self.flight.record_tick("profile", **result)
+        self.perf.note_profile(result)
+        return result
+
+    def perf_snapshot(self) -> Dict[str, Any]:
+        """The /debug/perf payload (observability/perf.py): per-tick
+        phase attribution window, compile ledger, live MFU/roofline
+        gauges and the last profile capture."""
+        return self.perf.snapshot()
 
     def set_spec_suspended(self, flag: bool) -> None:
         """Brownout hook (vgate_tpu/admission.py L3): suspend/resume
@@ -3727,6 +3886,7 @@ class EngineCore:
             "decode_tokens": self.total_decode_tokens,
             "state_rebuilds": self.total_state_rebuilds,
             "flight": self.flight.get_stats(),
+            "perf": self.perf.get_stats(),
             "kv_pages_total": self.allocator.num_allocatable,
             "kv_token_capacity": self.geometry.total_tokens,
             # KV storage attribution: drills and bench artifacts read

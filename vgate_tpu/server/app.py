@@ -1294,6 +1294,33 @@ async def debug_request_detail(request: web.Request) -> web.Response:
     return web.json_response(record)
 
 
+async def debug_perf(request: web.Request) -> web.Response:
+    """GET /debug/perf — the engine's perf-attribution snapshot
+    (observability/perf.py): rolling-window phase decomposition +
+    tok/s / MFU / HBM-roofline / host-overhead gauges, the compile
+    ledger, and the last /v1/profile capture.  dp>1 returns the merged
+    pod view with per-replica payloads attached.  Auth-gated like every
+    non-exempt path; excluded from drain accounting like /debug."""
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    core = getattr(engine.backend, "core", None) if engine else None
+    snapshot_fn = getattr(core, "perf_snapshot", None)
+    if snapshot_fn is None:
+        return web.json_response(
+            {"enabled": False,
+             "reason": "engine has no perf recorder"}
+        )
+    try:
+        return web.json_response(snapshot_fn())
+    except Exception as exc:
+        # a mid-rebuild engine must not 500 the attribution surface —
+        # operators read it exactly while chasing a perf problem
+        logger.error("perf snapshot failed", exc_info=True)
+        return web.json_response(
+            {"enabled": False,
+             "error": f"{type(exc).__name__}: {exc}"}
+        )
+
+
 def _faults_http_enabled() -> bool:
     """The live fault-arming surface is OFF unless the process opted in
     with ``VGT_FAULTS_HTTP=1`` — drills and the loadlab chaos arm set
@@ -1781,6 +1808,7 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     app.router.add_get("/debug/flight", debug_flight)
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{ident}", debug_request_detail)
+    app.router.add_get("/debug/perf", debug_perf)
     # drill-only chaos surface (403 unless VGT_FAULTS_HTTP=1): the
     # loadlab chaos arm replays fault drills mid-cell through it
     app.router.add_get("/debug/faults", debug_faults)
