@@ -21,6 +21,9 @@ if [[ "${1:-}" == "--fast" ]]; then
   exec python -m pytest tests/test_faults.py -q -p no:cacheprovider
 fi
 
+echo "== static-analysis gate =="
+bash scripts/lint_check.sh
+
 echo "== deterministic fault-injection suite =="
 python -m pytest tests/test_faults.py tests/test_recovery.py \
   tests/test_resume.py tests/test_integrity.py \

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Static-analysis gate: the vgtlint suite (thread/lock discipline,
+# jit purity, error taxonomy, definition drift, async blocking) plus
+# the metrics/monitoring lint.  Exits nonzero on any violation.
+#
+# Usage:
+#   scripts/lint_check.sh                 # full repo (what CI runs)
+#   scripts/lint_check.sh --changed-only  # only files changed vs the
+#                                         # git merge-base — fast local
+#                                         # iteration while editing
+#
+# Any extra args are passed through to vgt_lint.py (e.g. --checkers).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== vgt_lint (5-checker suite + metrics) =="
+python scripts/vgt_lint.py "$@"
+
+echo "== metrics_lint (standalone entrypoint) =="
+python scripts/metrics_lint.py
+
+echo "lint_check: OK"

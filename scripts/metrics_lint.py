@@ -1,96 +1,46 @@
 #!/usr/bin/env python
 """Lint monitoring assets against the metrics registry.
 
-Fails (exit 1) when:
+Thin shim (kept so chaos_check.sh, CI, and tests/test_metrics_lint.py
+keep working unchanged): the implementation moved into the vgtlint
+framework as the ``metrics`` checker —
+vgate_tpu/analysis/checkers/metrics.py.  Run the whole suite with
+``python scripts/vgt_lint.py``; this entrypoint runs just the
+monitoring check with the original CLI contract:
 
-* ``monitoring/alerts.yml`` or ``monitoring/grafana-dashboard.json``
-  references a ``vgt_*`` metric name that ``vgate_tpu/metrics.py`` does
-  not define (catches alert/dashboard rot when a metric is renamed);
-* a registered ``vgt_*`` metric has no documentation string (operators
-  read these as the metric's only inline docs).
-
-Name matching understands Prometheus exposition suffixes: a Counter
-``vgt_requests`` exports ``vgt_requests_total``, a Histogram adds
-``_bucket``/``_sum``/``_count``, an Info adds ``_info``.
-
-Run directly (``python scripts/metrics_lint.py``) or through the fast
-test tier (tests/test_metrics_lint.py) so CI enforces it.
+* exit 1 when alerts.yml / the Grafana dashboard reference a
+  ``vgt_*`` metric vgate_tpu/metrics.py does not define, or a
+  registered ``vgt_*`` metric lacks a documentation string;
+* errors on stderr, one-line OK summary on stdout.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:  # direct script invocation
+    sys.path.insert(0, REPO_ROOT)
 
-MONITORING_FILES = (
-    os.path.join(REPO_ROOT, "monitoring", "alerts.yml"),
-    os.path.join(REPO_ROOT, "monitoring", "grafana-dashboard.json"),
+from vgate_tpu.analysis.checkers.metrics import (  # noqa: E402,F401
+    _METRIC_RE,
+    _TYPE_SUFFIXES,
+    defined_metric_names,
+    lint_monitoring,
+    referenced_metric_names,
 )
 
-# exposition suffixes each family type emits (prometheus_client)
-_TYPE_SUFFIXES = {
-    "counter": ("", "_total", "_created"),
-    "gauge": ("",),
-    "histogram": ("", "_bucket", "_sum", "_count", "_created"),
-    "summary": ("", "_sum", "_count", "_created"),
-    "info": ("", "_info"),
-}
-
-_METRIC_RE = re.compile(r"\bvgt_[a-z0-9_]+\b")
-
-
-def defined_metric_names():
-    """(exposition-name set, [(family, documentation)]) from the live
-    registry — importing vgate_tpu.metrics registers everything."""
-    from prometheus_client import REGISTRY
-
-    if REPO_ROOT not in sys.path:  # direct script invocation
-        sys.path.insert(0, REPO_ROOT)
-    import vgate_tpu.metrics  # noqa: F401 - registers the vgt_ families
-
-    names = set()
-    families = []
-    for fam in REGISTRY.collect():
-        for suffix in _TYPE_SUFFIXES.get(fam.type, ("",)):
-            names.add(fam.name + suffix)
-        if fam.name.startswith("vgt_"):
-            families.append((fam.name, fam.documentation))
-    return names, families
-
-
-def referenced_metric_names(path: str):
-    with open(path) as fh:
-        text = fh.read()
-    if path.endswith(".json"):
-        # normalize so names inside PromQL strings are still plain text
-        text = json.dumps(json.loads(text))
-    return sorted(set(_METRIC_RE.findall(text)))
+# module-level so tests can monkeypatch the file set (the historical
+# contract of this script)
+MONITORING_FILES = tuple(
+    os.path.join(REPO_ROOT, *rel.split("/"))
+    for rel in ("monitoring/alerts.yml", "monitoring/grafana-dashboard.json")
+)
 
 
 def main(argv=None) -> int:
-    errors = []
-    defined, families = defined_metric_names()
-    for fam, doc in families:
-        if not (doc or "").strip():
-            errors.append(
-                f"metric {fam!r} has no documentation string "
-                "(vgate_tpu/metrics.py)"
-            )
-    for path in MONITORING_FILES:
-        if not os.path.exists(path):
-            errors.append(f"monitoring file missing: {path}")
-            continue
-        rel = os.path.relpath(path, REPO_ROOT)
-        for name in referenced_metric_names(path):
-            if name not in defined:
-                errors.append(
-                    f"{rel} references undefined metric {name!r} "
-                    "(not exported by vgate_tpu/metrics.py)"
-                )
+    errors, families = lint_monitoring(MONITORING_FILES)
     if errors:
         for err in errors:
             print(f"metrics-lint: {err}", file=sys.stderr)

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""vgtlint CLI — run the repo-native static-analysis suite.
+
+Usage:
+
+    python scripts/vgt_lint.py                  # full suite, whole repo
+    python scripts/vgt_lint.py --changed-only   # files changed vs merge-base
+    python scripts/vgt_lint.py --checkers thread-discipline,jit-purity
+    python scripts/vgt_lint.py vgate_tpu/runtime/engine_core.py
+    python scripts/vgt_lint.py --list-checkers
+    python scripts/vgt_lint.py --write-baseline # adopt current findings
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+
+Findings are fixed, inline-suppressed (`# vgt-lint: disable=<checker>
+-- why`), or — for bulk adoption — baselined into
+.vgt_lint_baseline.json with a mandatory justification per entry.
+This repo's baseline is empty and the tier-1 gate
+(tests/test_vgt_lint.py) keeps it that way.  See
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from vgate_tpu.analysis import runner as lint_runner  # noqa: E402
+from vgate_tpu.analysis.checkers import (  # noqa: E402
+    all_checkers,
+    checkers_by_name,
+)
+from vgate_tpu.analysis.core import Baseline  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vgt_lint", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="restrict to these repo-relative files (default: repo)",
+    )
+    parser.add_argument(
+        "--checkers",
+        help="comma-separated checker names (default: all)",
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="lint only files changed vs the git merge-base "
+        "(plus untracked); project checkers run only when their "
+        "scope is touched",
+    )
+    parser.add_argument(
+        "--base-ref",
+        help="merge-base ref for --changed-only "
+        "(default: origin/main, then main)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=os.path.join(
+            REPO_ROOT, lint_runner.DEFAULT_BASELINE
+        ),
+        help="baseline file (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline with TODO "
+        "justifications (each entry must then be justified by hand "
+        "— unjustified entries fail the next run)",
+    )
+    parser.add_argument(
+        "--list-checkers", action="store_true", help="list and exit"
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_checkers:
+        for c in all_checkers():
+            print(f"{c.name:20s} {c.description}")
+        return 0
+
+    if args.checkers:
+        by_name = checkers_by_name()
+        picked = []
+        for name in args.checkers.split(","):
+            name = name.strip()
+            if name not in by_name:
+                print(
+                    f"vgt-lint: unknown checker {name!r} "
+                    f"(known: {', '.join(sorted(by_name))})",
+                    file=sys.stderr,
+                )
+                return 2
+            picked.append(by_name[name])
+        checkers = picked
+    else:
+        checkers = all_checkers()
+
+    only = None
+    if args.paths:
+        only = [
+            os.path.relpath(os.path.abspath(p), REPO_ROOT)
+            for p in args.paths
+        ]
+        missing = [
+            p for p in only
+            if not os.path.exists(os.path.join(REPO_ROOT, p))
+        ]
+        if missing:
+            # a typo'd path would otherwise lint zero files and exit
+            # green forever (the loadlab compare --cells lesson:
+            # vacuous passes are loud usage errors)
+            print(
+                "vgt-lint: no such file(s): " + ", ".join(missing),
+                file=sys.stderr,
+            )
+            return 2
+    if args.changed_only:
+        try:
+            changed = lint_runner.changed_files(
+                REPO_ROOT, base_ref=args.base_ref
+            )
+        except ValueError as exc:
+            print(f"vgt-lint: {exc}", file=sys.stderr)
+            return 2
+        if changed is None:
+            # git unavailable/broken: a gate must fail CLOSED — fall
+            # back to the full run rather than green-exit on nothing
+            print(
+                "vgt-lint: git diff unavailable; --changed-only "
+                "falling back to a full run",
+                file=sys.stderr,
+            )
+        else:
+            only = sorted(set(changed) | set(only or []))
+            if not only:
+                print("vgt-lint: OK — no changed files")
+                return 0
+
+    baseline = Baseline.load(args.baseline)
+    result = lint_runner.run(
+        REPO_ROOT, checkers, only=only, baseline=baseline
+    )
+
+    if args.write_baseline:
+        merged = dict(baseline.entries)
+        for v in result.violations:
+            if v.checker in ("baseline", "suppression", "parse"):
+                continue
+            merged.setdefault(
+                v.fingerprint, "TODO: justify or fix"
+            )
+        Baseline(merged).save(args.baseline)
+        print(
+            f"vgt-lint: wrote {len(merged)} baseline entries to "
+            f"{args.baseline} — justify each (entries left at TODO "
+            "count as unjustified)"
+        )
+        return 0
+
+    report = lint_runner.render_report(result, verbose=args.verbose)
+    print(report, file=sys.stderr if result.violations else sys.stdout)
+    return 1 if result.violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

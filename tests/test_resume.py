@@ -308,6 +308,9 @@ class _FakeReplayCore:
 
 def _bare_supervisor(quarantine=()):
     sup = EngineSupervisor.__new__(EngineSupervisor)
+    # the real __init__ builds the RLock guarding the fields declared
+    # in supervisor.VGT_LOCK_GUARDS; _replay acquires it
+    sup._lock = threading.RLock()
     sup._quarantine = set(quarantine)
     sup._restart_times = []
     sup._recovery = SimpleNamespace(

@@ -1,0 +1,22 @@
+"""vgtlint — repo-native static analysis for concurrency discipline,
+jit purity, and definition-site drift.
+
+Layout:
+
+* :mod:`vgate_tpu.analysis.annotations` — zero-cost runtime decorators
+  (``@engine_thread_only``, ``@requires_lock``) and the per-module
+  registry conventions (``VGT_LOCK_GUARDS``, ``VGT_COMPONENTS``) that
+  runtime code uses to DECLARE its threading contract.  Import-cheap:
+  runtime modules import it on every startup.
+* :mod:`vgate_tpu.analysis.core` — the shared violation / suppression /
+  baseline model and the project file index.
+* :mod:`vgate_tpu.analysis.checkers` — the checker implementations;
+  imported only by the lint runner, never by serving code.
+* :mod:`vgate_tpu.analysis.runner` — walks the repo, runs checkers,
+  applies suppressions + baseline, renders the report.
+
+Entry points: ``python scripts/vgt_lint.py`` (CLI) and
+``tests/test_vgt_lint.py`` (the fast-tier repo gate).  See
+docs/static_analysis.md for the checker catalog and the annotation
+conventions new runtime code is expected to follow.
+"""

@@ -1,0 +1,127 @@
+"""Small AST helpers shared by the vgtlint checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def dec_last_name(node: ast.expr) -> Optional[str]:
+    """Final dotted name of a decorator expression: ``@x`` -> "x",
+    ``@mod.x`` -> "x", ``@x(...)`` -> "x"."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def attr_chain(node: ast.expr) -> Optional[List[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None for anything that is not a
+    pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def str_const(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def dict_of_str(node: ast.expr) -> Optional[Dict[str, str]]:
+    """Parse a ``{"a": "b", ...}`` literal (or a ``lock_guards(a="b")``
+    call) into a plain dict; None if it is anything else."""
+    if isinstance(node, ast.Call):
+        name = dec_last_name(node)
+        if name != "lock_guards":
+            return None
+        out = {}
+        for kw in node.keywords:
+            val = str_const(kw.value)
+            if kw.arg is None or val is None:
+                return None
+            out[kw.arg] = val
+        return out
+    if not isinstance(node, ast.Dict):
+        return None
+    out = {}
+    for k, v in zip(node.keys, node.values):
+        ks, vs = str_const(k), str_const(v)
+        if ks is None or vs is None:
+            return None
+        out[ks] = vs
+    return out
+
+
+def string_tuple(node: ast.expr) -> Optional[Tuple[str, ...]]:
+    """("a", "b") / ["a", "b"] / {"a", "b"} of pure string constants."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        vals = [str_const(e) for e in node.elts]
+        if vals and all(v is not None for v in vals):
+            return tuple(vals)  # type: ignore[arg-type]
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Dotted name of the called expression, e.g. "time.sleep" for
+    ``time.sleep(...)``; None for computed callees."""
+    chain = attr_chain(node.func)
+    return ".".join(chain) if chain else None
+
+
+def iter_target_attrs(target: ast.expr) -> List[ast.expr]:
+    """Flatten assignment targets (tuples/lists/starred) into leaf
+    expressions."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[ast.expr] = []
+        for elt in target.elts:
+            out.extend(iter_target_attrs(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return iter_target_attrs(target.value)
+    return [target]
+
+
+def class_defs(tree: ast.AST) -> List[ast.ClassDef]:
+    return [
+        n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+    ]
+
+
+def module_assign_value(
+    tree: ast.AST, name: str
+) -> Optional[ast.expr]:
+    """Value expression of a module-level ``name = ...`` assignment."""
+    body = getattr(tree, "body", [])
+    for node in body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if (
+                isinstance(node.target, ast.Name)
+                and node.target.id == name
+            ):
+                return node.value
+    return None
+
+
+def func_defs(
+    body: Sequence[ast.stmt],
+) -> List[ast.stmt]:
+    return [
+        n
+        for n in body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]

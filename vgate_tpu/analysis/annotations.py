@@ -1,0 +1,100 @@
+"""Zero-cost threading-contract annotations for runtime code.
+
+The engine's concurrency discipline has always been prose ("engine
+state is mutated on the engine thread; cross-thread callers go through
+the command queues; readback folds hold ``_readback_lock``") enforced
+by review.  These decorators turn the prose into *declarations* the
+static checker (vgate_tpu/analysis/checkers/threads.py) can enforce:
+
+* ``@engine_thread_root`` — this function IS an engine-thread
+  entrypoint (the loop body, or a documented single-threaded phase
+  such as pre-start warmup).  Roots may call engine-thread-only
+  functions; nothing checks who calls a root.
+* ``@engine_thread_only`` — this function touches engine state without
+  synchronization and must only be reached from a root or another
+  engine-thread-only function.  Cross-thread callers must instead go
+  through the command queues (submit/abort/evacuation queues), whose
+  drain sites are themselves engine-thread-only.
+* ``@requires_lock("_name")`` — callers must hold ``self._name``
+  (lexically: the call site sits inside ``with self._name:`` or the
+  calling function carries the same annotation).
+
+Field-level guards are declared per module, next to the class that
+owns the lock::
+
+    VGT_LOCK_GUARDS = {
+        "_checkpointed": "_readback_lock",   # field -> guarding lock
+    }
+
+and component types (so the checker can follow ``self.scheduler.add``
+across modules)::
+
+    VGT_COMPONENTS = {"scheduler": "Scheduler"}
+
+All decorators are identity functions that stamp attributes — zero
+call overhead, no wrapping, signatures/`functools` metadata untouched.
+They are also *runtime-introspectable* (``is_engine_thread_only`` etc.)
+so tests and debug tooling can assert the contract on live objects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+# Attribute names the static checker looks for on FunctionDef
+# decorators; keep in sync with checkers/threads.py.
+ATTR_ENGINE_THREAD_ONLY = "__vgt_engine_thread_only__"
+ATTR_ENGINE_THREAD_ROOT = "__vgt_engine_thread_root__"
+ATTR_REQUIRES_LOCKS = "__vgt_requires_locks__"
+
+
+def engine_thread_only(fn: Callable) -> Callable:
+    """Declare: only the engine thread may call this (no internal
+    synchronization; reaches scheduler/KV/flight state bare)."""
+    setattr(fn, ATTR_ENGINE_THREAD_ONLY, True)
+    return fn
+
+
+def engine_thread_root(fn: Callable) -> Callable:
+    """Declare: this is an engine-thread entrypoint (loop body / thread
+    target) or a documented single-threaded phase; it may call
+    engine-thread-only functions."""
+    setattr(fn, ATTR_ENGINE_THREAD_ROOT, True)
+    return fn
+
+
+def requires_lock(*lock_names: str) -> Callable[[Callable], Callable]:
+    """Declare: callers must already hold ``self.<lock_name>`` for
+    every named lock when calling this function."""
+    if not lock_names or not all(
+        isinstance(n, str) and n for n in lock_names
+    ):
+        raise ValueError("requires_lock needs at least one lock name")
+
+    def deco(fn: Callable) -> Callable:
+        held: Tuple[str, ...] = tuple(
+            getattr(fn, ATTR_REQUIRES_LOCKS, ())
+        ) + tuple(lock_names)
+        setattr(fn, ATTR_REQUIRES_LOCKS, held)
+        return fn
+
+    return deco
+
+
+def is_engine_thread_only(fn: Any) -> bool:
+    return bool(getattr(fn, ATTR_ENGINE_THREAD_ONLY, False))
+
+
+def is_engine_thread_root(fn: Any) -> bool:
+    return bool(getattr(fn, ATTR_ENGINE_THREAD_ROOT, False))
+
+
+def required_locks(fn: Any) -> Tuple[str, ...]:
+    return tuple(getattr(fn, ATTR_REQUIRES_LOCKS, ()))
+
+
+def lock_guards(**field_to_lock: str) -> Dict[str, str]:
+    """Optional constructor for ``VGT_LOCK_GUARDS`` declarations; a
+    plain dict literal works identically — the checker reads the AST,
+    not the object."""
+    return dict(field_to_lock)

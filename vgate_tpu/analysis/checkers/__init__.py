@@ -1,0 +1,38 @@
+"""Checker registry.  Import is deliberately lazy-ish: only the lint
+runner imports this package; serving code imports
+``vgate_tpu.analysis.annotations`` alone."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from vgate_tpu.analysis.core import Checker
+
+
+def all_checkers() -> List[Checker]:
+    # imported here so `import vgate_tpu.analysis` stays featherweight
+    from vgate_tpu.analysis.checkers.async_blocking import (
+        AsyncBlockingChecker,
+    )
+    from vgate_tpu.analysis.checkers.drift import DefinitionDriftChecker
+    from vgate_tpu.analysis.checkers.error_taxonomy import (
+        ErrorTaxonomyChecker,
+    )
+    from vgate_tpu.analysis.checkers.jit_purity import JitPurityChecker
+    from vgate_tpu.analysis.checkers.metrics import MetricsChecker
+    from vgate_tpu.analysis.checkers.threads import (
+        ThreadDisciplineChecker,
+    )
+
+    return [
+        ThreadDisciplineChecker(),
+        JitPurityChecker(),
+        ErrorTaxonomyChecker(),
+        DefinitionDriftChecker(),
+        AsyncBlockingChecker(),
+        MetricsChecker(),
+    ]
+
+
+def checkers_by_name() -> Dict[str, Checker]:
+    return {c.name: c for c in all_checkers()}

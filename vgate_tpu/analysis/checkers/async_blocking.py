@@ -1,0 +1,185 @@
+"""async-blocking — blocking calls inside ``async def`` bodies.
+
+One blocking call in a handler freezes the whole event loop: every
+other in-flight request's SSE stream, the health probes, and the
+drain controller all stall behind it — the tail-latency failure mode
+the serving studies in PAPERS.md measure under load.
+
+Rules (checked in the direct body of every ``async def``; nested sync
+``def``s are excluded — they typically run in an executor — and a
+reference to a blocking function without calling it is fine, that is
+exactly how ``run_in_executor`` receives it):
+
+* **A001** — ``time.sleep`` (use ``asyncio.sleep``).
+* **A002** — synchronous HTTP / sockets: ``requests.*``,
+  ``urllib.request.*``, module-level ``httpx.get/post/...`` (the sync
+  helpers; ``AsyncClient`` methods are awaited and untouched).
+* **A003** — a non-awaited ``.acquire()``: a ``threading.Lock``
+  acquire blocks the loop; ``await lock.acquire()`` (asyncio.Lock)
+  passes.
+* **A004** — subprocess / shell: ``subprocess.run/call/
+  check_output/check_call``, ``os.system``, ``os.popen`` (use
+  ``asyncio.create_subprocess_*`` or an executor).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from vgate_tpu.analysis import _astutil as A
+from vgate_tpu.analysis.core import Checker, Project, Violation
+
+_SYNC_HTTP_PREFIXES = ("requests.", "urllib.request.")
+_HTTPX_SYNC = {
+    "httpx.get",
+    "httpx.post",
+    "httpx.put",
+    "httpx.delete",
+    "httpx.patch",
+    "httpx.head",
+    "httpx.options",
+    "httpx.request",
+    "httpx.stream",
+}
+_SUBPROCESS = {
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_output",
+    "subprocess.check_call",
+    "os.system",
+    "os.popen",
+}
+
+
+class AsyncBlockingChecker(Checker):
+    name = "async-blocking"
+    description = (
+        "time.sleep / sync HTTP / blocking Lock.acquire / "
+        "subprocess inside async def bodies"
+    )
+    scope = (
+        "vgate_tpu/server/**/*.py",
+        "vgate_tpu/loadlab/**/*.py",
+        "vgate_tpu/batcher.py",
+        "vgate_tpu/lifecycle.py",
+        "vgate_tpu_client/**/*.py",
+    )
+
+    def run(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for ctx in project.files(*self.scope):
+            tree = ctx.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.AsyncFunctionDef):
+                    out.extend(
+                        self._check_async(ctx.relpath, node)
+                    )
+        return out
+
+    def _check_async(
+        self, relpath: str, fn: ast.AsyncFunctionDef
+    ) -> Iterable[Violation]:
+        awaited: Set[int] = set()
+        for node in self._walk_async_body(fn):
+            if isinstance(node, ast.Await) and isinstance(
+                node.value, ast.Call
+            ):
+                awaited.add(id(node.value))
+            if not isinstance(node, ast.Call):
+                continue
+            v = self._check_call(
+                relpath, fn.name, node, id(node) in awaited
+            )
+            if v is not None:
+                yield v
+
+    def _walk_async_body(self, fn: ast.AsyncFunctionDef):
+        """Pre-order walk that does NOT descend into nested sync
+        functions or lambdas (they run elsewhere — usually an
+        executor).  Nested async defs are visited by the outer loop
+        independently."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_call(
+        self,
+        relpath: str,
+        fname: str,
+        call: ast.Call,
+        is_awaited: bool,
+    ) -> Optional[Violation]:
+        name = A.call_name(call)
+        if name is None:
+            return None
+        if name == "time.sleep":
+            return self._v(
+                relpath,
+                fname,
+                call,
+                "A001",
+                "time.sleep() blocks the event loop — use "
+                "asyncio.sleep()",
+            )
+        if name in _HTTPX_SYNC or any(
+            name.startswith(p) for p in _SYNC_HTTP_PREFIXES
+        ):
+            return self._v(
+                relpath,
+                fname,
+                call,
+                "A002",
+                f"synchronous HTTP call {name}() blocks the event "
+                "loop — use an async client or run_in_executor",
+            )
+        if (
+            name.endswith(".acquire")
+            and not is_awaited
+        ):
+            return self._v(
+                relpath,
+                fname,
+                call,
+                "A003",
+                f"non-awaited {name}() — a threading lock acquire "
+                "blocks the event loop (asyncio.Lock acquires are "
+                "awaited)",
+            )
+        if name in _SUBPROCESS:
+            return self._v(
+                relpath,
+                fname,
+                call,
+                "A004",
+                f"{name}() blocks the event loop — use "
+                "asyncio.create_subprocess_* or an executor",
+            )
+        return None
+
+    def _v(
+        self,
+        relpath: str,
+        fname: str,
+        call: ast.Call,
+        rule: str,
+        msg: str,
+    ) -> Violation:
+        name = A.call_name(call) or "<call>"
+        return Violation(
+            checker=self.name,
+            path=relpath,
+            line=call.lineno,
+            rule=rule,
+            message=f"in async {fname!r}: {msg}",
+            symbol=f"{fname}:{name}",
+        )

@@ -1,0 +1,211 @@
+"""error-taxonomy — every exception in vgate_tpu/errors.py must be a
+complete, client-explainable citizen:
+
+* **E001** — an HTTP mapping: the class (or an ancestor, for families
+  handled by one ``except`` clause) is referenced in
+  vgate_tpu/server/app.py.  An exception the gateway cannot map
+  surfaces as an opaque 500.
+* **E002** — a machine-readable ``reason`` class attribute (own or
+  inherited): clients and drills branch on ``error.reason``, not on
+  message prose.
+* **E003** — a declared SDK twin: the class (or ancestor) carries
+  ``sdk_twin = "<ClassName>"`` naming a class that actually exists in
+  vgate_tpu_client's exceptions.py, so server and SDK vocabularies
+  cannot drift apart silently.
+* **E004** — a docs mention: the class name appears somewhere under
+  docs/ (operators grep the docs for the error they are looking at).
+
+Never-client-serialized internals (watchdog-only signals and the
+like) justify themselves with an inline suppression in errors.py —
+the justification text is the documentation of WHY the rule does not
+apply, reviewed like code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from vgate_tpu.analysis import _astutil as A
+from vgate_tpu.analysis.core import Checker, Project, Violation
+
+_ERRORS = "vgate_tpu/errors.py"
+_APP = "vgate_tpu/server/app.py"
+_SDK_EXC = "vgate_tpu_client/vgate_tpu_client/exceptions.py"
+_DOCS = "docs/*.md"
+
+
+@dataclass
+class _ErrClass:
+    name: str
+    line: int
+    bases: List[str]
+    reason: Optional[str] = None
+    sdk_twin: Optional[str] = None
+    ancestors: List[str] = field(default_factory=list)
+
+
+def _class_str_attr(node: ast.ClassDef, attr: str) -> Optional[str]:
+    for item in node.body:
+        if isinstance(item, ast.Assign):
+            for t in item.targets:
+                if isinstance(t, ast.Name) and t.id == attr:
+                    return A.str_const(item.value)
+    return None
+
+
+def _collect_errors(tree: ast.AST) -> Dict[str, _ErrClass]:
+    out: Dict[str, _ErrClass] = {}
+    for node in getattr(tree, "body", []):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = []
+        for b in node.bases:
+            chain = A.attr_chain(b)
+            if chain:
+                bases.append(chain[-1])
+        out[node.name] = _ErrClass(
+            name=node.name,
+            line=node.lineno,
+            bases=bases,
+            reason=_class_str_attr(node, "reason"),
+            sdk_twin=_class_str_attr(node, "sdk_twin"),
+        )
+    # resolve ancestor chains within the module
+    for err in out.values():
+        seen: Set[str] = set()
+        frontier = list(err.bases)
+        while frontier:
+            b = frontier.pop()
+            if b in seen or b not in out:
+                continue
+            seen.add(b)
+            err.ancestors.append(b)
+            frontier.extend(out[b].bases)
+    return out
+
+
+def _inherited(
+    errors: Dict[str, _ErrClass], err: _ErrClass, attr: str
+) -> Optional[str]:
+    val = getattr(err, attr)
+    if val is not None:
+        return val
+    for anc in err.ancestors:
+        val = getattr(errors[anc], attr)
+        if val is not None:
+            return val
+    return None
+
+
+class ErrorTaxonomyChecker(Checker):
+    name = "error-taxonomy"
+    description = (
+        "errors.py classes: HTTP mapping in app.py, machine-readable "
+        "reason, declared SDK twin, docs mention"
+    )
+    scope = (_ERRORS, _APP, _SDK_EXC, _DOCS)
+
+    def run(self, project: Project) -> List[Violation]:
+        errors_ctx = project.context(_ERRORS)
+        if errors_ctx.tree is None:
+            return []
+        errors = _collect_errors(errors_ctx.tree)
+        # only exception classes (by suffix convention, matching the
+        # module's own naming), not helpers
+        errors = {
+            k: v
+            for k, v in errors.items()
+            if k.endswith("Error") or k.endswith("Exception")
+        }
+        app_text = project.context(_APP).text
+        sdk_tree = project.context(_SDK_EXC).tree
+        sdk_classes: Set[str] = set()
+        if sdk_tree is not None:
+            sdk_classes = {
+                n.name
+                for n in getattr(sdk_tree, "body", [])
+                if isinstance(n, ast.ClassDef)
+            }
+        docs_text = "\n".join(
+            ctx.text for ctx in project.files(_DOCS)
+        )
+
+        def mentioned(name: str, text: str) -> bool:
+            # word-boundary, not substring: "MigrationError" must not
+            # be satisfied by "MigrationRefusedError"
+            return (
+                re.search(rf"\b{re.escape(name)}\b", text) is not None
+            )
+
+        out: List[Violation] = []
+        for err in sorted(errors.values(), key=lambda e: e.line):
+            mapped = mentioned(err.name, app_text) or any(
+                mentioned(anc, app_text) for anc in err.ancestors
+            )
+            if not mapped:
+                out.append(
+                    self._v(
+                        err,
+                        "E001",
+                        f"exception {err.name!r} has no HTTP mapping: "
+                        "neither it nor an ancestor is referenced in "
+                        f"{_APP} (it would surface as an opaque 500)",
+                    )
+                )
+            if _inherited(errors, err, "reason") is None:
+                out.append(
+                    self._v(
+                        err,
+                        "E002",
+                        f"exception {err.name!r} has no "
+                        "machine-readable `reason` class attribute "
+                        "(own or inherited) — clients branch on "
+                        "reason, not message prose",
+                    )
+                )
+            twin = _inherited(errors, err, "sdk_twin")
+            if twin is None:
+                out.append(
+                    self._v(
+                        err,
+                        "E003",
+                        f"exception {err.name!r} declares no SDK twin "
+                        "(`sdk_twin = \"<Class>\"`, own or "
+                        "inherited) — server and client "
+                        "vocabularies drift silently without it",
+                    )
+                )
+            elif twin not in sdk_classes:
+                out.append(
+                    self._v(
+                        err,
+                        "E003",
+                        f"exception {err.name!r} names SDK twin "
+                        f"{twin!r} which does not exist in "
+                        f"{_SDK_EXC}",
+                    )
+                )
+            if not mentioned(err.name, docs_text):
+                out.append(
+                    self._v(
+                        err,
+                        "E004",
+                        f"exception {err.name!r} is not mentioned "
+                        "anywhere under docs/ — operators grep the "
+                        "docs for the error name they are looking at",
+                    )
+                )
+        return out
+
+    def _v(self, err: _ErrClass, rule: str, msg: str) -> Violation:
+        return Violation(
+            checker=self.name,
+            path=_ERRORS,
+            line=err.line,
+            rule=rule,
+            message=msg,
+            symbol=err.name,
+        )

@@ -1,0 +1,214 @@
+"""metrics — monitoring assets vs the live metrics registry.
+
+The PR-3 ``scripts/metrics_lint.py`` guardrail, folded into the
+vgtlint framework (the script survives as a thin shim so
+chaos_check.sh and existing CI invocations keep working):
+
+* **M001** — monitoring/alerts.yml or monitoring/grafana-dashboard.json
+  references a ``vgt_*`` metric vgate_tpu/metrics.py does not export
+  (alert/dashboard rot when a metric is renamed).
+* **M002** — a registered ``vgt_*`` family has no documentation string.
+* **M003** — a monitoring file is missing outright.
+
+Name matching understands Prometheus exposition suffixes (Counter
+``x`` exports ``x_total``, Histogram adds ``_bucket``/``_sum``/
+``_count``, Info adds ``_info``).
+
+Unlike the AST checkers this one imports the live registry
+(vgate_tpu.metrics) — it lints what the process actually exports, not
+what the source looks like.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Iterable, List, Tuple
+
+from vgate_tpu.analysis.core import Checker, Project, Violation
+
+MONITORING_RELPATHS = (
+    "monitoring/alerts.yml",
+    "monitoring/grafana-dashboard.json",
+)
+
+# exposition suffixes each family type emits (prometheus_client)
+_TYPE_SUFFIXES = {
+    "counter": ("", "_total", "_created"),
+    "gauge": ("",),
+    "histogram": ("", "_bucket", "_sum", "_count", "_created"),
+    "summary": ("", "_sum", "_count", "_created"),
+    "info": ("", "_info"),
+}
+
+_METRIC_RE = re.compile(r"\bvgt_[a-z0-9_]+\b")
+
+
+def defined_metric_names():
+    """(exposition-name set, [(family, documentation)]) from the live
+    registry — importing vgate_tpu.metrics registers everything."""
+    from prometheus_client import REGISTRY
+
+    repo_root = os.path.dirname(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    )
+    if repo_root not in sys.path:  # direct script invocation
+        sys.path.insert(0, repo_root)
+    import vgate_tpu.metrics  # noqa: F401 - registers the vgt_ families
+
+    names = set()
+    families = []
+    for fam in REGISTRY.collect():
+        for suffix in _TYPE_SUFFIXES.get(fam.type, ("",)):
+            names.add(fam.name + suffix)
+        if fam.name.startswith("vgt_"):
+            families.append((fam.name, fam.documentation))
+    return names, families
+
+
+def referenced_metric_names(path: str):
+    with open(path) as fh:
+        text = fh.read()
+    if path.endswith(".json"):
+        try:
+            # normalize so names inside PromQL strings are plain text
+            text = json.dumps(json.loads(text))
+        except ValueError:
+            # lint the raw text; JSON validity is the dashboard
+            # tooling's problem, and crashing the lint run hides every
+            # OTHER finding behind the malformed file
+            pass
+    return sorted(set(_METRIC_RE.findall(text)))
+
+
+def lint_monitoring_records(
+    monitoring_files: Iterable[str],
+) -> Tuple[List[dict], List[Tuple[str, str]]]:
+    """The whole check, ONCE, as structured records — the single
+    implementation behind both the MetricsChecker and the
+    scripts/metrics_lint.py shim (two renderings of one rule set can
+    never diverge).  Each record: ``rule`` (M001/M002/M003), ``path``
+    (as given; M002 uses the metrics module), ``name`` (the metric /
+    file the finding anchors on), ``message``."""
+    records: List[dict] = []
+    defined, families = defined_metric_names()
+    for fam, doc in families:
+        if not (doc or "").strip():
+            records.append(
+                {
+                    "rule": "M002",
+                    "path": "vgate_tpu/metrics.py",
+                    "name": fam,
+                    "message": (
+                        f"metric {fam!r} has no documentation string "
+                        "(vgate_tpu/metrics.py)"
+                    ),
+                }
+            )
+    for path in monitoring_files:
+        if not os.path.exists(path):
+            records.append(
+                {
+                    "rule": "M003",
+                    "path": path,
+                    "name": os.path.basename(path),
+                    "message": f"monitoring file missing: {path}",
+                }
+            )
+            continue
+        rel = os.path.basename(path)
+        parent = os.path.basename(os.path.dirname(path))
+        if parent:
+            rel = f"{parent}/{rel}"
+        if path.endswith(".json"):
+            # a dashboard Grafana cannot parse must fail the lint
+            # loudly (the historical behavior) — but as a finding,
+            # not a crash that hides every other finding
+            try:
+                with open(path) as fh:
+                    json.load(fh)
+            except ValueError as exc:
+                records.append(
+                    {
+                        "rule": "M004",
+                        "path": path,
+                        "name": os.path.basename(path),
+                        "message": (
+                            f"{rel} is not valid JSON ({exc}) — "
+                            "Grafana cannot load it; metric names "
+                            "were still linted from the raw text"
+                        ),
+                    }
+                )
+        for name in referenced_metric_names(path):
+            if name not in defined:
+                records.append(
+                    {
+                        "rule": "M001",
+                        "path": path,
+                        "name": name,
+                        "message": (
+                            f"{rel} references undefined metric "
+                            f"{name!r} (not exported by "
+                            "vgate_tpu/metrics.py)"
+                        ),
+                    }
+                )
+    return records, families
+
+
+def lint_monitoring(
+    monitoring_files: Iterable[str],
+) -> Tuple[List[str], List[Tuple[str, str]]]:
+    """Legacy string rendering for the scripts/metrics_lint.py shim."""
+    records, families = lint_monitoring_records(monitoring_files)
+    return [r["message"] for r in records], families
+
+
+class MetricsChecker(Checker):
+    name = "metrics"
+    description = (
+        "alerts.yml / Grafana dashboard reference only exported "
+        "vgt_* metrics; every family documented (PR-3 metrics_lint)"
+    )
+    scope = MONITORING_RELPATHS + ("vgate_tpu/metrics.py",)
+
+    def run(self, project: Project) -> List[Violation]:
+        files = [
+            os.path.join(project.root, *rel.split("/"))
+            for rel in MONITORING_RELPATHS
+        ]
+        records, _ = lint_monitoring_records(files)
+        out: List[Violation] = []
+        for rec in records:
+            rel = os.path.relpath(rec["path"], project.root).replace(
+                os.sep, "/"
+            )
+            if not rel.startswith("monitoring"):
+                rel = rec["path"]  # M002: already repo-relative
+            line = 1
+            if project.exists(rel):
+                ctx = project.context(rel)
+                line = next(
+                    (
+                        i
+                        for i, ln in enumerate(ctx.lines, start=1)
+                        if rec["name"] in ln
+                    ),
+                    1,
+                )
+            out.append(
+                Violation(
+                    checker=self.name,
+                    path=rel,
+                    line=line,
+                    rule=rec["rule"],
+                    message=rec["message"],
+                    symbol=rec["name"],
+                )
+            )
+        return out

@@ -1,0 +1,523 @@
+"""thread-discipline — statically enforce the engine's threading
+contract as declared by vgate_tpu/analysis/annotations.py.
+
+Rules:
+
+* **T001** — a function annotated ``@engine_thread_only`` may only be
+  called from a function that is itself ``@engine_thread_only`` or an
+  ``@engine_thread_root``.  Cross-thread callers must go through the
+  command queues (submit/abort/evacuation), whose engine-side drain
+  sites carry the annotation.
+* **T002** — a function annotated ``@requires_lock("_l")`` may only be
+  called while ``_l`` is lexically held: the call sits inside
+  ``with self._l:``, or the calling function carries the same
+  ``@requires_lock``, or the calling function uses the bounded
+  ``_l.acquire(timeout=...)`` fail-open idiom anywhere in its body.
+* **T003** — a field declared in the module's ``VGT_LOCK_GUARDS``
+  registry may only be *mutated* (rebound, item-assigned, or mutated
+  via append/clear/update/... calls) under its guarding lock, with
+  the same holding rules as T002 plus ``__init__`` (construction
+  precedes sharing).
+* **T004** — a ``VGT_LOCK_GUARDS`` / ``@requires_lock`` entry naming a
+  lock that never appears in the module is a typo, not a contract.
+
+Call resolution is deliberately name-and-declaration based (no type
+inference): ``self.m()`` resolves within the enclosing class,
+``self.attr.m()`` resolves through the module's ``VGT_COMPONENTS``
+registry (attr -> class name), bare ``m()`` resolves to module-level
+functions.  Unresolvable calls are not checked — the annotations are
+the contract surface, and every annotation site is enforced.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from vgate_tpu.analysis import _astutil as A
+from vgate_tpu.analysis.core import Checker, Project, Violation
+
+_SCOPE = ("vgate_tpu/**/*.py",)
+
+# method names that mutate a collection in place (list/set/dict/deque)
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "extend",
+    "insert",
+    "add",
+    "remove",
+    "discard",
+    "pop",
+    "popleft",
+    "popitem",
+    "clear",
+    "update",
+    "setdefault",
+    "sort",
+    "reverse",
+}
+
+_DEC_ENGINE_ONLY = "engine_thread_only"
+_DEC_ROOT = "engine_thread_root"
+_DEC_REQUIRES = "requires_lock"
+
+
+@dataclass
+class _FuncInfo:
+    name: str
+    qualname: str
+    engine_only: bool = False
+    root: bool = False
+    locks: Tuple[str, ...] = ()
+
+
+@dataclass
+class _ModuleInfo:
+    relpath: str
+    lock_guards: Dict[str, str] = field(default_factory=dict)
+    components: Dict[str, str] = field(default_factory=dict)
+    # class name -> {method name -> _FuncInfo}
+    classes: Dict[str, Dict[str, _FuncInfo]] = field(
+        default_factory=dict
+    )
+    functions: Dict[str, _FuncInfo] = field(default_factory=dict)
+    guards_line: int = 1
+    # every attribute name the module actually accesses (x.<attr>):
+    # the T004 typo check tests registry entries against real usage,
+    # never against raw text (a registry's own string constants would
+    # otherwise self-satisfy the check)
+    attr_names: Set[str] = field(default_factory=set)
+
+
+def _annotations_of(
+    node: ast.stmt, qualname: str
+) -> _FuncInfo:
+    info = _FuncInfo(name=node.name, qualname=qualname)
+    for dec in getattr(node, "decorator_list", []):
+        name = A.dec_last_name(dec)
+        if name == _DEC_ENGINE_ONLY:
+            info.engine_only = True
+        elif name == _DEC_ROOT:
+            info.root = True
+        elif name == _DEC_REQUIRES and isinstance(dec, ast.Call):
+            locks = tuple(
+                v
+                for v in (A.str_const(a) for a in dec.args)
+                if v is not None
+            )
+            info.locks = info.locks + locks
+    return info
+
+
+def _collect_module(tree: ast.AST, relpath: str) -> _ModuleInfo:
+    mod = _ModuleInfo(relpath=relpath)
+    guards = A.module_assign_value(tree, "VGT_LOCK_GUARDS")
+    if guards is not None:
+        mod.lock_guards = A.dict_of_str(guards) or {}
+        mod.guards_line = getattr(guards, "lineno", 1)
+    comps = A.module_assign_value(tree, "VGT_COMPONENTS")
+    if comps is not None:
+        mod.components = A.dict_of_str(comps) or {}
+    mod.attr_names = {
+        node.attr
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Attribute)
+    }
+    for node in getattr(tree, "body", []):
+        if isinstance(node, ast.ClassDef):
+            methods: Dict[str, _FuncInfo] = {}
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    methods[item.name] = _annotations_of(
+                        item, f"{node.name}.{item.name}"
+                    )
+            mod.classes[node.name] = methods
+        elif isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            mod.functions[node.name] = _annotations_of(
+                node, node.name
+            )
+    return mod
+
+
+def _acquired_locks(node: ast.stmt) -> Set[str]:
+    """Lock names this function calls ``.acquire(...)`` on anywhere —
+    the bounded-acquire fail-open idiom (see engine_core
+    ``_contain_body``) counts as holding for the lexical check."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "acquire"
+        ):
+            chain = A.attr_chain(sub.func.value)
+            if chain:
+                out.add(chain[-1])
+    return out
+
+
+class ThreadDisciplineChecker(Checker):
+    name = "thread-discipline"
+    description = (
+        "engine-thread reachability, requires_lock call sites, and "
+        "lock-guarded field mutations (annotations.py contract)"
+    )
+    scope = _SCOPE
+
+    def run(self, project: Project) -> List[Violation]:
+        contexts = [
+            ctx
+            for ctx in project_files(project)
+            if ctx.tree is not None
+        ]
+        modules = {
+            ctx.relpath: _collect_module(ctx.tree, ctx.relpath)
+            for ctx in contexts
+        }
+        # global class index for VGT_COMPONENTS resolution (class
+        # names are unique across the package; a duplicate would merge
+        # conservatively toward "annotated wins")
+        class_index: Dict[str, Dict[str, _FuncInfo]] = {}
+        for mod in modules.values():
+            for cls, methods in mod.classes.items():
+                merged = class_index.setdefault(cls, {})
+                for mname, finfo in methods.items():
+                    prev = merged.get(mname)
+                    if (
+                        prev is None
+                        or finfo.engine_only
+                        or finfo.locks
+                    ):
+                        merged[mname] = finfo
+        violations: List[Violation] = []
+        for ctx in contexts:
+            mod = modules[ctx.relpath]
+            self._check_registry_typos(ctx, mod, violations)
+            _Enforcer(
+                ctx, mod, class_index, violations
+            ).check_module(ctx.tree)
+        return violations
+
+    def _check_registry_typos(
+        self, ctx, mod: _ModuleInfo, out: List[Violation]
+    ) -> None:
+        """A registry entry naming a lock or field the module never
+        accesses as an attribute is a typo (or a rename that left the
+        registry behind) — and a typo'd entry silently disables its
+        guard, so it must be loud.  Checked against AST attribute
+        usage, not raw text: the registry's own string constants are
+        not attribute accesses, so a shared lock name mapped by many
+        fields still fails when nothing really uses it."""
+        for fld, lock in sorted(mod.lock_guards.items()):
+            for kind, name in (("lock", lock), ("field", fld)):
+                if name not in mod.attr_names:
+                    out.append(
+                        Violation(
+                            checker=self.name,
+                            path=ctx.relpath,
+                            line=mod.guards_line,
+                            rule="T004",
+                            message=(
+                                f"VGT_LOCK_GUARDS entry "
+                                f"{fld!r} -> {lock!r}: {kind} "
+                                f"{name!r} is never accessed as an "
+                                "attribute in this module (typo or "
+                                "stale rename — the guard is "
+                                "silently disabled)"
+                            ),
+                            symbol=f"VGT_LOCK_GUARDS.{fld}:{kind}",
+                        )
+                    )
+        for cls, methods in mod.classes.items():
+            for finfo in methods.values():
+                for lock in finfo.locks:
+                    if lock not in mod.attr_names:
+                        out.append(
+                            Violation(
+                                checker=self.name,
+                                path=ctx.relpath,
+                                line=1,
+                                rule="T004",
+                                message=(
+                                    f"@requires_lock({lock!r}) on "
+                                    f"{finfo.qualname} names a lock "
+                                    "never accessed as an attribute "
+                                    "in this module (typo?)"
+                                ),
+                                symbol=f"{finfo.qualname}:{lock}",
+                            )
+                        )
+
+
+def project_files(project: Project):
+    return project.files(*_SCOPE)
+
+
+class _Enforcer:
+    """Per-module lexical walk tracking (class, function, held locks)."""
+
+    def __init__(
+        self,
+        ctx,
+        mod: _ModuleInfo,
+        class_index: Dict[str, Dict[str, _FuncInfo]],
+        out: List[Violation],
+    ) -> None:
+        self.ctx = ctx
+        self.mod = mod
+        self.class_index = class_index
+        self.out = out
+
+    def check_module(self, tree: ast.AST) -> None:
+        for node in getattr(tree, "body", []):
+            self._stmt(node, cls=None, func=None, held=frozenset())
+
+    # -- traversal ----------------------------------------------------
+
+    def _stmt(
+        self,
+        node: ast.stmt,
+        cls: Optional[str],
+        func: Optional[_FuncInfo],
+        held: frozenset,
+    ) -> None:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                self._stmt(item, cls=node.name, func=None, held=held)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = _annotations_of(
+                node,
+                f"{cls}.{node.name}" if cls else node.name,
+            )
+            if func is not None:
+                # a closure defined inside an engine-thread function
+                # runs on the engine thread; it inherits the contract
+                info.engine_only = info.engine_only or func.engine_only
+                info.root = info.root or func.root
+                info.locks = info.locks + func.locks
+            inner_held = (
+                held | set(info.locks) | _acquired_locks(node)
+            )
+            for item in node.body:
+                self._stmt(
+                    item, cls=cls, func=info, held=frozenset(inner_held)
+                )
+            return
+        if isinstance(node, ast.With) or isinstance(
+            node, ast.AsyncWith
+        ):
+            added = set()
+            for item in node.items:
+                chain = A.attr_chain(item.context_expr)
+                if chain:
+                    added.add(chain[-1])
+            for item in node.body:
+                self._stmt(node=item, cls=cls, func=func, held=held | added)
+            # with-item expressions themselves may contain calls
+            for item in node.items:
+                self._expr(item.context_expr, cls, func, held)
+            return
+        # generic statement: check expressions, then recurse into
+        # nested statement bodies with the same held-set
+        self._check_mutations(node, cls, func, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, cls=cls, func=func, held=held)
+            elif isinstance(child, ast.expr):
+                self._expr(child, cls, func, held)
+            elif isinstance(child, ast.ExceptHandler):
+                for sub in child.body:
+                    self._stmt(sub, cls=cls, func=func, held=held)
+            elif isinstance(
+                child, (ast.arguments, ast.keyword, ast.withitem)
+            ):
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call):
+                        self._call(sub, cls, func, held)
+
+    def _expr(
+        self,
+        node: ast.expr,
+        cls: Optional[str],
+        func: Optional[_FuncInfo],
+        held: frozenset,
+    ) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub, cls, func, held)
+            elif isinstance(
+                sub, (ast.Lambda,)
+            ):  # lambdas: same-thread closures, nothing extra to do
+                continue
+
+    # -- resolution ---------------------------------------------------
+
+    def _resolve(
+        self, call: ast.Call, cls: Optional[str]
+    ) -> Optional[_FuncInfo]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self.mod.functions.get(fn.id)
+        chain = A.attr_chain(fn)
+        if not chain or chain[0] != "self":
+            return None
+        if len(chain) == 2 and cls:
+            methods = self.mod.classes.get(cls) or {}
+            info = methods.get(chain[1])
+            if info is not None:
+                return info
+            return (self.class_index.get(cls) or {}).get(chain[1])
+        if len(chain) == 3:
+            target_cls = self.mod.components.get(chain[1])
+            if target_cls:
+                return (self.class_index.get(target_cls) or {}).get(
+                    chain[2]
+                )
+        return None
+
+    # -- rules --------------------------------------------------------
+
+    def _call(
+        self,
+        call: ast.Call,
+        cls: Optional[str],
+        func: Optional[_FuncInfo],
+        held: frozenset,
+    ) -> None:
+        target = self._resolve(call, cls)
+        caller = func.qualname if func else "<module>"
+        if target is not None:
+            if target.engine_only and not (
+                func is not None and (func.engine_only or func.root)
+            ):
+                self.out.append(
+                    Violation(
+                        checker=ThreadDisciplineChecker.name,
+                        path=self.ctx.relpath,
+                        line=call.lineno,
+                        rule="T001",
+                        message=(
+                            f"engine-thread-only {target.qualname!r} "
+                            f"called from {caller!r}, which is "
+                            "neither @engine_thread_only nor "
+                            "@engine_thread_root — cross-thread "
+                            "callers must go through the command "
+                            "queues"
+                        ),
+                        symbol=f"{caller}->{target.qualname}",
+                    )
+                )
+            for lock in target.locks:
+                if lock not in held:
+                    self.out.append(
+                        Violation(
+                            checker=ThreadDisciplineChecker.name,
+                            path=self.ctx.relpath,
+                            line=call.lineno,
+                            rule="T002",
+                            message=(
+                                f"{target.qualname!r} requires lock "
+                                f"{lock!r} but the call site in "
+                                f"{caller!r} does not hold it (wrap "
+                                f"in `with self.{lock}:` or annotate "
+                                "the caller with @requires_lock)"
+                            ),
+                            symbol=(
+                                f"{caller}->{target.qualname}:{lock}"
+                            ),
+                        )
+                    )
+        # T003 via mutator-method calls on guarded fields:
+        # self.<field>.append(...) and friends
+        fn = call.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _MUTATORS
+        ):
+            chain = A.attr_chain(fn.value)
+            if (
+                chain
+                and len(chain) == 2
+                and chain[0] == "self"
+                and chain[1] in self.mod.lock_guards
+            ):
+                self._flag_guarded(
+                    chain[1], call.lineno, cls, func, held,
+                    how=f".{fn.attr}()",
+                )
+
+    def _check_mutations(
+        self,
+        node: ast.stmt,
+        cls: Optional[str],
+        func: Optional[_FuncInfo],
+        held: frozenset,
+    ) -> None:
+        if not self.mod.lock_guards:
+            return
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                targets.extend(A.iter_target_attrs(t))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets.extend(A.iter_target_attrs(node.target))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                targets.extend(A.iter_target_attrs(t))
+        for t in targets:
+            fld = self._guarded_field_of(t)
+            if fld is not None:
+                self._flag_guarded(
+                    fld, node.lineno, cls, func, held, how="assignment"
+                )
+
+    def _guarded_field_of(self, target: ast.expr) -> Optional[str]:
+        # self.F = ... / self.F[k] = ... / del self.F
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        chain = A.attr_chain(target)
+        if (
+            chain
+            and len(chain) == 2
+            and chain[0] == "self"
+            and chain[1] in self.mod.lock_guards
+        ):
+            return chain[1]
+        return None
+
+    def _flag_guarded(
+        self,
+        fld: str,
+        line: int,
+        cls: Optional[str],
+        func: Optional[_FuncInfo],
+        held: frozenset,
+        how: str,
+    ) -> None:
+        lock = self.mod.lock_guards[fld]
+        if lock in held:
+            return
+        if func is not None and func.name == "__init__":
+            return  # construction precedes sharing
+        caller = func.qualname if func else "<module>"
+        self.out.append(
+            Violation(
+                checker=ThreadDisciplineChecker.name,
+                path=self.ctx.relpath,
+                line=line,
+                rule="T003",
+                message=(
+                    f"lock-guarded field {fld!r} mutated ({how}) in "
+                    f"{caller!r} without holding {lock!r} (declared "
+                    "in VGT_LOCK_GUARDS)"
+                ),
+                symbol=f"{caller}.{fld}",
+            )
+        )

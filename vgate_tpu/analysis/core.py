@@ -1,0 +1,396 @@
+"""Shared model for the vgtlint suite: violations, inline
+suppressions, the justification-bearing baseline, and the project file
+index checkers run against.
+
+Design notes:
+
+* **Fingerprints are line-number-free.**  A violation's identity is
+  ``checker:relpath:rule:symbol`` (symbol = the enclosing function /
+  class / config key / metric name, whatever the checker anchors on),
+  so a baseline survives unrelated edits above the finding.  Two
+  identical findings on the same symbol collapse — acceptable: fixing
+  one forces the rerun that surfaces the other.
+* **Suppressions carry mandatory justification.**  ``# vgt-lint:
+  disable=<checker>[,<checker>] -- <why>`` on the offending line or
+  the line directly above.  A suppression with no ``-- why`` is itself
+  a violation (checker ``suppression``), so "quietly turn it off"
+  is not expressible.
+* **The baseline is for adopting the linter on a codebase with known
+  findings**, not for new code: entries are fingerprint+justification
+  pairs, stale entries (matching nothing) fail the run so the file can
+  only shrink.  This repo's baseline is empty — every original finding
+  was fixed or inline-justified — and the tier-1 gate keeps it that
+  way.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Violation",
+    "Suppression",
+    "FileContext",
+    "Project",
+    "Baseline",
+    "Checker",
+    "parse_suppressions",
+]
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding.  ``symbol`` anchors the fingerprint (see module
+    docstring); ``line`` is 1-based and only used for display and for
+    matching inline suppressions."""
+
+    checker: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    rule: str  # short stable id, e.g. "T003"
+    message: str
+    symbol: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.checker}:{self.path}:{self.rule}:{self.symbol}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.checker}/{self.rule}] "
+            f"{self.message}"
+        )
+
+
+@dataclass(frozen=True)
+class Suppression:
+    line: int  # the line the comment sits on
+    checkers: Tuple[str, ...]
+    justification: str
+    # True when the comment shares its line with code: it targets that
+    # line only.  A comment-only line targets the statement BELOW it
+    # (the comment-above idiom) as well as its own line.
+    inline: bool = False
+
+    def covers(self, checker: str, line: int) -> bool:
+        if checker not in self.checkers:
+            return False
+        if self.inline:
+            return line == self.line
+        return line in (self.line, self.line + 1)
+
+
+# `# vgt-lint: disable=a,b -- justification`
+_SUPPRESS_RE = re.compile(
+    r"#\s*vgt-lint:\s*disable=(?P<names>[a-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(?P<why>.*))?\s*$"
+)
+
+
+def parse_suppressions(lines: Sequence[str]) -> List[Suppression]:
+    out: List[Suppression] = []
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            continue
+        names = tuple(
+            n.strip() for n in m.group("names").split(",") if n.strip()
+        )
+        out.append(
+            Suppression(
+                line=i,
+                checkers=names,
+                justification=(m.group("why") or "").strip(),
+                inline=bool(text[: m.start()].strip()),
+            )
+        )
+    return out
+
+
+@dataclass
+class FileContext:
+    """One file the suite may inspect.  ``tree`` is parsed lazily and
+    only for ``.py`` files; non-Python files (yaml, md, sh) still get
+    line-level suppression parsing so a doc/yaml finding can be
+    justified in place."""
+
+    abspath: str
+    relpath: str
+    text: str
+    _tree: Optional[ast.AST] = field(default=None, repr=False)
+    _tree_error: Optional[str] = field(default=None, repr=False)
+    _suppressions: Optional[List[Suppression]] = field(
+        default=None, repr=False
+    )
+
+    @property
+    def lines(self) -> List[str]:
+        return self.text.splitlines()
+
+    @property
+    def is_python(self) -> bool:
+        return self.relpath.endswith(".py")
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if not self.is_python:
+            return None
+        if self._tree is None and self._tree_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=self.relpath)
+            except SyntaxError as exc:  # surfaced by the runner
+                self._tree_error = f"{exc.msg} (line {exc.lineno})"
+        return self._tree
+
+    @property
+    def tree_error(self) -> Optional[str]:
+        self.tree  # force the parse attempt
+        return self._tree_error
+
+    @property
+    def suppressions(self) -> List[Suppression]:
+        if self._suppressions is None:
+            self._suppressions = parse_suppressions(self.lines)
+        return self._suppressions
+
+
+_SKIP_DIRS = {
+    ".git",
+    "__pycache__",
+    ".pytest_cache",
+    "node_modules",
+    ".venv",
+    "venv",
+}
+
+
+class Project:
+    """File index for one lint run: repo root + lazily-loaded
+    contexts.  Checkers ask for files by glob so adding a file to the
+    repo automatically widens the next run.
+
+    The ``only`` restriction (--changed-only / explicit path args)
+    gates which files findings are REPORTED in (applied by the
+    runner) and whether a checker runs at all (``any_selected``) — it
+    must NOT shrink what checkers read: cross-file checkers need
+    their full reference corpora (docs/, the class index, config.py)
+    even when only one side of a relationship changed, or a
+    restricted run mass-false-positives ("errors.py changed, docs
+    didn't load, nothing is documented")."""
+
+    def __init__(
+        self, root: str, only: Optional[Sequence[str]] = None
+    ) -> None:
+        self.root = os.path.abspath(root)
+        self.only = (
+            None
+            if only is None
+            else {p.replace(os.sep, "/") for p in only}
+        )
+        self._all: Optional[List[str]] = None
+        self._ctx: Dict[str, FileContext] = {}
+
+    def _walk(self) -> List[str]:
+        if self._all is None:
+            found: List[str] = []
+            for dirpath, dirnames, filenames in os.walk(self.root):
+                dirnames[:] = [
+                    d for d in dirnames if d not in _SKIP_DIRS
+                ]
+                for name in filenames:
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, name), self.root
+                    ).replace(os.sep, "/")
+                    found.append(rel)
+            self._all = sorted(found)
+        return self._all
+
+    def files(self, *patterns: str) -> List[FileContext]:
+        """Contexts matching any glob — deliberately UNRESTRICTED by
+        ``only`` (see class docstring; the runner filters findings,
+        not inputs)."""
+        out = []
+        for rel in self._walk():
+            if any(_glob_match(rel, p) for p in patterns):
+                out.append(self.context(rel))
+        return out
+
+    def selected(self, relpath: str) -> bool:
+        """May findings in this file be reported?  Pseudo-paths
+        (``<baseline>``) always pass."""
+        if self.only is None or relpath.startswith("<"):
+            return True
+        return relpath in self.only
+
+    def any_selected(self, *patterns: str) -> bool:
+        """Whether the restriction set touches these globs at all —
+        project-level checkers use this to decide if they should run
+        under --changed-only."""
+        if self.only is None:
+            return True
+        return any(
+            _glob_match(rel, p)
+            for rel in self.only
+            for p in patterns
+        )
+
+    def context(self, relpath: str) -> FileContext:
+        rel = relpath.replace(os.sep, "/")
+        if rel not in self._ctx:
+            abspath = os.path.join(self.root, rel)
+            try:
+                with open(abspath, encoding="utf-8") as fh:
+                    text = fh.read()
+            except (OSError, UnicodeDecodeError):
+                text = ""
+            self._ctx[rel] = FileContext(
+                abspath=abspath, relpath=rel, text=text
+            )
+        return self._ctx[rel]
+
+    def exists(self, relpath: str) -> bool:
+        return os.path.exists(os.path.join(self.root, relpath))
+
+
+_GLOB_CACHE: Dict[str, "re.Pattern"] = {}
+
+
+def _glob_regex(pattern: str) -> "re.Pattern":
+    """Proper ``**`` glob semantics (fnmatch's ``*`` crosses ``/`` and
+    its ``**/`` demands a subdirectory): here ``**/`` matches zero or
+    more path segments, ``*``/``?`` stay within one segment."""
+    if pattern not in _GLOB_CACHE:
+        out = []
+        i = 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if pattern[i : i + 3] == "**/":
+                out.append(r"(?:[^/]+/)*")
+                i += 3
+            elif pattern[i : i + 2] == "**":
+                out.append(r".*")
+                i += 2
+            elif ch == "*":
+                out.append(r"[^/]*")
+                i += 1
+            elif ch == "?":
+                out.append(r"[^/]")
+                i += 1
+            else:
+                out.append(re.escape(ch))
+                i += 1
+        _GLOB_CACHE[pattern] = re.compile("".join(out) + r"\Z")
+    return _GLOB_CACHE[pattern]
+
+
+def _glob_match(rel: str, pattern: str) -> bool:
+    return _glob_regex(pattern).match(rel) is not None
+
+
+class Baseline:
+    """Known-finding ledger: fingerprint -> justification.  Loaded
+    from / saved to JSON; see module docstring for semantics."""
+
+    VERSION = 1
+
+    def __init__(
+        self, entries: Optional[Dict[str, str]] = None
+    ) -> None:
+        self.entries: Dict[str, str] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        entries = {
+            e["fingerprint"]: e.get("justification", "")
+            for e in data.get("entries", [])
+        }
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        data = {
+            "version": self.VERSION,
+            "entries": [
+                {"fingerprint": fp, "justification": why}
+                for fp, why in sorted(self.entries.items())
+            ],
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    def apply(
+        self, violations: Iterable[Violation]
+    ) -> Tuple[List[Violation], List[Violation]]:
+        """Split into (kept, meta) where *kept* are violations the
+        baseline does not cover and *meta* are baseline-integrity
+        problems (stale entries, missing justification) reported as
+        violations of the ``baseline`` pseudo-checker."""
+        kept: List[Violation] = []
+        matched: set = set()
+        for v in violations:
+            if v.fingerprint in self.entries:
+                matched.add(v.fingerprint)
+            else:
+                kept.append(v)
+        meta: List[Violation] = []
+        for fp, why in sorted(self.entries.items()):
+            unjustified = (
+                not why.strip()
+                or why.strip().upper().startswith("TODO")
+            )
+            if fp in matched and unjustified:
+                meta.append(
+                    Violation(
+                        checker="baseline",
+                        path="<baseline>",
+                        line=0,
+                        rule="B001",
+                        message=(
+                            f"baseline entry {fp!r} has no "
+                            "justification (every baselined finding "
+                            "must say why it is acceptable)"
+                        ),
+                        symbol=fp,
+                    )
+                )
+            elif fp not in matched:
+                meta.append(
+                    Violation(
+                        checker="baseline",
+                        path="<baseline>",
+                        line=0,
+                        rule="B002",
+                        message=(
+                            f"stale baseline entry {fp!r} matches no "
+                            "current finding — delete it (the "
+                            "baseline may only shrink)"
+                        ),
+                        symbol=fp,
+                    )
+                )
+        return kept, meta
+
+
+class Checker:
+    """Checker interface.  Subclasses set ``name``/``description`` and
+    implement :meth:`run`; ``scope`` lists the globs the checker
+    reads, used both for --changed-only gating and for docs."""
+
+    name: str = "base"
+    description: str = ""
+    scope: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> List[Violation]:
+        raise NotImplementedError
+
+    def should_run(self, project: Project) -> bool:
+        return project.any_selected(*self.scope) if self.scope else True

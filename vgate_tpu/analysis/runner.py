@@ -1,0 +1,244 @@
+"""Lint runner: walk the repo, run checkers, apply suppressions and
+the baseline, render the report.  Used by scripts/vgt_lint.py (CLI)
+and tests/test_vgt_lint.py (the tier-1 repo gate)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from vgate_tpu.analysis.core import (
+    Baseline,
+    Checker,
+    Project,
+    Violation,
+)
+
+DEFAULT_BASELINE = ".vgt_lint_baseline.json"
+
+
+@dataclass
+class RunResult:
+    violations: List[Violation]
+    suppressed: int = 0
+    checkers_run: List[str] = field(default_factory=list)
+    files_seen: int = 0
+    duration_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _apply_suppressions(
+    project: Project, violations: Sequence[Violation]
+) -> tuple:
+    """Filter violations covered by inline suppressions; emit
+    meta-violations for suppressions that lack a justification."""
+    kept: List[Violation] = []
+    suppressed = 0
+    for v in violations:
+        ctx = (
+            project.context(v.path)
+            if not v.path.startswith("<")
+            else None
+        )
+        covered = False
+        if ctx is not None:
+            for sup in ctx.suppressions:
+                # an unjustified suppression does NOT hide the
+                # finding — both surface (S001 + the original)
+                if sup.covers(v.checker, v.line) and sup.justification:
+                    covered = True
+        if covered:
+            suppressed += 1
+        else:
+            kept.append(v)
+    return kept, suppressed
+
+
+def _unjustified_suppressions(
+    project: Project, relpaths: Sequence[str]
+) -> List[Violation]:
+    out: List[Violation] = []
+    for rel in relpaths:
+        ctx = project.context(rel)
+        for sup in ctx.suppressions:
+            if not sup.justification:
+                out.append(
+                    Violation(
+                        checker="suppression",
+                        path=rel,
+                        line=sup.line,
+                        rule="S001",
+                        message=(
+                            "vgt-lint suppression without a "
+                            "justification — append `-- <why>` "
+                            "(unjustified suppressions do not hide "
+                            "findings)"
+                        ),
+                        symbol=f"{rel}:{sup.line}",
+                    )
+                )
+    return out
+
+
+def _syntax_errors(
+    project: Project, checkers: Sequence[Checker]
+) -> List[Violation]:
+    seen: Dict[str, Violation] = {}
+    patterns: List[str] = []
+    for c in checkers:
+        patterns.extend(p for p in c.scope if p.endswith(".py"))
+    for ctx in project.files(*patterns):
+        if ctx.is_python and ctx.tree_error and ctx.relpath not in seen:
+            seen[ctx.relpath] = Violation(
+                checker="parse",
+                path=ctx.relpath,
+                line=1,
+                rule="P001",
+                message=f"syntax error: {ctx.tree_error}",
+                symbol=ctx.relpath,
+            )
+    return list(seen.values())
+
+
+def changed_files(
+    root: str, base_ref: Optional[str] = None
+) -> Optional[List[str]]:
+    """Repo-relative paths changed vs the merge base (for
+    --changed-only), untracked files included.  Falls back
+    progressively: explicit ref -> merge-base with
+    origin/<default>/main/master -> working-tree diff vs HEAD.
+
+    Returns ``None`` — NOT an empty list — when git itself is
+    unavailable or errors: an empty list means "verified nothing
+    changed" and lets the caller exit green, so a git failure must be
+    distinguishable (the CLI falls back to a FULL run; a lint gate
+    must fail closed, never silently skip).  An EXPLICIT ``base_ref``
+    that does not resolve raises ValueError instead of silently
+    narrowing to a working-tree diff — the user named a comparison
+    point; linting something else would be a vacuous pass."""
+    candidates = (
+        [base_ref]
+        if base_ref
+        else ["origin/main", "origin/master", "main", "master"]
+    )
+    base = None
+    for ref in candidates:
+        try:
+            mb = subprocess.run(
+                ["git", "merge-base", "HEAD", ref],
+                cwd=root,
+                capture_output=True,
+                text=True,
+                timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue  # try the remaining fallback refs
+        if mb.returncode == 0:
+            base = mb.stdout.strip()
+            break
+    if base_ref and base is None:
+        raise ValueError(
+            f"--base-ref {base_ref!r} does not resolve to a "
+            "merge-base with HEAD"
+        )
+    out: List[str] = []
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base if base else "HEAD"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=10,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 and untracked.returncode != 0:
+        return None  # not a git checkout / git broken: unknown, not empty
+    for proc in (diff, untracked):
+        if proc.returncode == 0:
+            out.extend(
+                p.strip()
+                for p in proc.stdout.splitlines()
+                if p.strip()
+            )
+    return sorted(set(out))
+
+
+def run(
+    root: str,
+    checkers: Sequence[Checker],
+    only: Optional[Sequence[str]] = None,
+    baseline: Optional[Baseline] = None,
+) -> RunResult:
+    t0 = time.monotonic()
+    project = Project(root, only=only)
+    violations: List[Violation] = []
+    ran: List[str] = []
+    for checker in checkers:
+        if not checker.should_run(project):
+            continue
+        ran.append(checker.name)
+        violations.extend(checker.run(project))
+    violations.extend(_syntax_errors(project, checkers))
+    # the restriction set filters which files findings are REPORTED
+    # in; checkers always read the full repo (reference corpora —
+    # docs/, the class index — must not shrink under --changed-only)
+    violations = [
+        v for v in violations if project.selected(v.path)
+    ]
+    kept, suppressed = _apply_suppressions(project, violations)
+    # scan every selected file a checker could have touched for
+    # broken suppression comments, even when nothing fired there
+    sup_scan = sorted(
+        {
+            ctx.relpath
+            for c in checkers
+            for ctx in project.files(*c.scope)
+            if project.selected(ctx.relpath)
+        }
+    )
+    kept.extend(_unjustified_suppressions(project, sup_scan))
+    if baseline is not None:
+        kept, meta = baseline.apply(kept)
+        kept.extend(meta)
+    kept.sort(key=lambda v: (v.path, v.line, v.rule, v.symbol))
+    return RunResult(
+        violations=kept,
+        suppressed=suppressed,
+        checkers_run=ran,
+        files_seen=len(sup_scan),
+        duration_s=time.monotonic() - t0,
+    )
+
+
+def render_report(result: RunResult, verbose: bool = False) -> str:
+    lines: List[str] = []
+    for v in result.violations:
+        lines.append(v.render())
+    summary = (
+        f"vgt-lint: {'FAILED' if result.violations else 'OK'} — "
+        f"{len(result.violations)} finding(s), "
+        f"{result.suppressed} suppressed, "
+        f"{len(result.checkers_run)} checker(s) over "
+        f"{result.files_seen} file(s) in "
+        f"{result.duration_s:.2f}s"
+    )
+    if verbose:
+        lines.append(
+            "checkers: " + ", ".join(result.checkers_run)
+        )
+    lines.append(summary)
+    return "\n".join(lines)

@@ -19,6 +19,14 @@ The engine's fault handling distinguishes three client-visible outcomes:
 Kept free of imports from the runtime so every layer (scheduler,
 batcher, server, client-facing docs) can reference one taxonomy without
 cycles.
+
+Completeness is enforced statically: the ``error-taxonomy`` checker
+(scripts/vgt_lint.py) requires every class here to carry an HTTP
+mapping in server/app.py, a machine-readable ``reason``, an SDK-twin
+declaration (``sdk_twin`` — the vgate_tpu_client class this surfaces
+as, verified to exist), and a docs mention (the error table in
+docs/operations.md).  Internal-only classes justify themselves with an
+inline ``vgt-lint`` suppression instead — see docs/static_analysis.md.
 """
 
 from __future__ import annotations
@@ -69,6 +77,10 @@ class RetryableError(RuntimeError):
     ``ServerOverloadedError``."""
 
     reason = "unavailable"
+    # SDK class the 503 surfaces as when `reason` carries no more
+    # specific mapping (vgate_tpu_client/exceptions.py); subclasses
+    # with a typed twin override it
+    sdk_twin = "ServerError"
 
     def __init__(self, message: str, retry_after: float = 1.0) -> None:
         super().__init__(message)
@@ -83,6 +95,7 @@ class EngineRecoveringError(RetryableError):
     reason = "recovering"
 
 
+# vgt-lint: disable=error-taxonomy -- watchdog-internal: classified transient and contained before any gateway surface; clients only ever see the EngineRecoveringError the restart produces
 class EngineStalledError(RuntimeError):
     """The engine loop stopped heartbeating: a decode/prefill dispatch
     (or its readback) has been stuck past ``recovery.step_stall_s`` —
@@ -94,6 +107,7 @@ class EngineStalledError(RuntimeError):
     replay."""
 
     fault_kind = "transient"
+    reason = "stalled"  # flight/stats attribution, never a response body
 
     def __init__(
         self,
@@ -157,6 +171,7 @@ class ServerOverloadedError(RetryableError):
     at (batch sheds first, interactive last)."""
 
     reason = "overloaded"
+    sdk_twin = "ServerOverloadedError"
 
     def __init__(
         self,
@@ -183,6 +198,7 @@ class KVCapacityError(RetryableError):
     still: preemption parks KV instead of destroying it."""
 
     reason = "kv_capacity"
+    sdk_twin = "KVCapacityError"
 
     def __init__(self, message: str, retry_after: float = 2.0) -> None:
         super().__init__(message, retry_after=retry_after)
@@ -230,7 +246,12 @@ class MigrationError(RuntimeError):
     rebalance, dp scale-down) could not complete — the operational
     error family behind the /admin/replicas surface.  Operator-facing:
     never sent to generation clients (their sequences either stayed put
-    or already failed typed)."""
+    or already failed typed).  The admin surface maps it to a 500 with
+    type ``migration_error``; operators drive it with curl, so the
+    ``sdk_twin`` is the SDK's generic 5xx class."""
+
+    reason = "migration_error"
+    sdk_twin = "ServerError"
 
 
 class MigrationRefusedError(MigrationError):
@@ -241,7 +262,11 @@ class MigrationRefusedError(MigrationError):
     a different KV storage format would splice two numerically
     different streams mid-stream), or the deployment has no migration
     target at all (dp == 1).  Maps to a 409 on the admin surface —
-    nothing moved, nothing was lost."""
+    nothing moved, nothing was lost (a 409 reaches the SDK as the
+    generic ``VGTError`` fall-through)."""
+
+    reason = "migration_refused"
+    sdk_twin = "VGTError"
 
 
 class ClientQuotaExceededError(RuntimeError):
@@ -250,6 +275,11 @@ class ClientQuotaExceededError(RuntimeError):
     overload, so it maps to a **429** + ``Retry-After`` (the rate-limit
     status the SDK's backoff already understands) rather than the 503
     the admission controller uses for whole-server shedding."""
+
+    # matches the admission controller's shed-reason label for this cap
+    # (vgt_admission_rejections{reason="per_key_inflight"})
+    reason = "per_key_inflight"
+    sdk_twin = "RateLimitError"
 
     def __init__(self, message: str, retry_after: float = 1.0) -> None:
         super().__init__(message)
@@ -266,6 +296,9 @@ class DeadlineExceededError(RuntimeError):
     "slow but working" from "nothing happened".  Not retryable as-is:
     the same request will blow the same budget; the client should raise
     its deadline instead."""
+
+    reason = "deadline_exceeded"
+    sdk_twin = "DeadlineExceeded"
 
     def __init__(
         self,
@@ -286,6 +319,7 @@ class DeadlineExceededError(RuntimeError):
         self.phases = dict(phases or {})
 
 
+# vgt-lint: disable=error-taxonomy -- never serialized: there is no client left to type a response (or an SDK twin) for; it exists so futures/metrics see a typed outcome
 class ClientDisconnectError(RuntimeError):
     """The client went away while its request was queued or decoding;
     the work was cancelled (dequeued, or aborted between decode ticks)
@@ -293,9 +327,15 @@ class ClientDisconnectError(RuntimeError):
     response — there is no one left to read it — but it travels through
     futures so bookkeeping (metrics, logs) sees a typed outcome."""
 
+    reason = "client_disconnect"  # metrics/log attribution only
+
 
 class PoisonRequestError(ValueError):
     """This request was in flight across enough engine crashes (or an
     injected poison fault named it) that the supervisor quarantined it:
     it is rejected at submission so it cannot crash the next engine
-    incarnation.  Not retryable — mapped to a 400."""
+    incarnation.  Not retryable — mapped to a 400 (the SDK's generic
+    ``VGTError`` fall-through for 4xx)."""
+
+    reason = "poison"
+    sdk_twin = "VGTError"

@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
 import jax
 
 from vgate_tpu import faults, metrics
+from vgate_tpu.analysis.annotations import requires_lock
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.errors import (
@@ -63,6 +64,19 @@ from vgate_tpu.runtime.supervisor import (
 )
 
 logger = get_logger(__name__)
+
+# Threading contract (scripts/vgt_lint.py, thread-discipline): fleet
+# topology mutates only under _topology_lock (the PR-8 review-round
+# invariant — structural ops additionally whole-op-serialize on
+# _structural_lock, which this registry does not model).
+VGT_LOCK_GUARDS = {
+    "_draining": "_topology_lock",
+    "_free_slices": "_topology_lock",
+    "_rebuilding": "_topology_lock",
+    "_next_attempt": "_topology_lock",
+    "_rebuild_threads": "_topology_lock",
+    "replicas": "_topology_lock",
+}
 
 
 class _MergedFlight:
@@ -473,6 +487,7 @@ class ReplicatedEngine:
         with self._topology_lock:
             self._sweep_locked(rec)
 
+    @requires_lock("_topology_lock")
     def _sweep_locked(self, rec) -> None:
         for i in range(len(self.replicas)):
             # fresh clock per replica: heartbeat verdicts and backoff
@@ -709,6 +724,7 @@ class ReplicatedEngine:
             rec.backoff_base_s * (2 ** len(self._restart_times)),
         )
 
+    @requires_lock("_topology_lock")
     def _maybe_rebuild(
         self, idx: int, core: EngineCore, now: float
     ) -> None:
@@ -796,9 +812,10 @@ class ReplicatedEngine:
                         extra={"extra_data": {"replica": idx}},
                         exc_info=True,
                     )
-                    self._next_attempt[id(old)] = (
-                        time.monotonic() + self._backoff()
-                    )
+                    with self._topology_lock:
+                        self._next_attempt[id(old)] = (
+                            time.monotonic() + self._backoff()
+                        )
                     return
                 reload_weights = True
             except Exception:
@@ -807,11 +824,13 @@ class ReplicatedEngine:
                     extra={"extra_data": {"replica": idx}},
                     exc_info=True,
                 )
-                self._next_attempt[id(old)] = (
-                    time.monotonic() + self._backoff()
-                )
+                with self._topology_lock:
+                    self._next_attempt[id(old)] = (
+                        time.monotonic() + self._backoff()
+                    )
                 return
-            self._next_attempt.pop(id(old), None)
+            with self._topology_lock:
+                self._next_attempt.pop(id(old), None)
             # swap by IDENTITY, under the topology lock: the fleet may
             # have been renumbered (remove_replica) while this built —
             # a stale index would overwrite the wrong slot
@@ -889,8 +908,9 @@ class ReplicatedEngine:
                 }},
             )
         finally:
-            self._rebuilding.discard(id(old))
-            self._rebuild_threads.pop(id(old), None)
+            with self._topology_lock:
+                self._rebuilding.discard(id(old))
+                self._rebuild_threads.pop(id(old), None)
             self._repair_event.set()  # re-sweep with the fresh state
 
     # ------------------------------- silent-corruption defense helpers

@@ -40,6 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from vgate_tpu import faults, integrity, metrics
+from vgate_tpu.analysis.annotations import (
+    engine_thread_only,
+    engine_thread_root,
+)
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.errors import (
     DeadlineExceededError,
@@ -96,6 +100,20 @@ from vgate_tpu.runtime.weights import load_or_init_params
 from vgate_tpu.utils.math import bucket_for, cdiv
 
 logger = get_logger(__name__)
+
+# Threading contract (enforced by scripts/vgt_lint.py, checker
+# thread-discipline — see docs/static_analysis.md): cross-module call
+# resolution for self.scheduler.*, and the fields only ever mutated
+# under their paired lock.
+VGT_COMPONENTS = {"scheduler": "Scheduler"}
+VGT_LOCK_GUARDS = {
+    # the containment fold vs. token-append readbacks publication
+    # guard (PR-5 hardening): a woken stalled thread must observe
+    # either pre-fold or fully-folded state, never a fold in progress
+    "_checkpointed": "_readback_lock",
+    # first-entry-only containment arbitration
+    "_fatal": "_contain_lock",
+}
 
 # top-alternatives returned per position when a request asks for
 # logprobs (requests may ask for fewer; the schema clamps to this)
@@ -1371,6 +1389,7 @@ class EngineCore:
                     stop_exc = EngineRecoveringError(
                         "engine stopped before the request could finish"
                     )
+                # vgt-lint: disable=thread-discipline -- stop() joined the engine thread above; this is single-threaded teardown
                 self.scheduler._release_residency(seq)
                 seq.fail(stop_exc)
         self.scheduler.waiting.clear()
@@ -1510,6 +1529,7 @@ class EngineCore:
 
     # ------------------------------------------------------------ the loop
 
+    @engine_thread_root
     def _loop(self) -> None:
         logger.info("engine thread started")
         while self._running:
@@ -1531,6 +1551,7 @@ class EngineCore:
                 self._contain_fatal(exc)
         logger.info("engine thread stopped")
 
+    @engine_thread_only
     def _beat(self, kind: str, compiling: bool = False, **fields) -> None:
         """Stamp the watchdog heartbeat (whole-dict store — atomic under
         the GIL).  Call immediately BEFORE any potentially-blocking
@@ -1761,6 +1782,7 @@ class EngineCore:
     def take_checkpointed(self) -> List[Sequence]:
         """Hand the fatal-containment checkpoint to its replayer
         (supervisor restart / dp failover); idempotent-empty after."""
+        # vgt-lint: disable=thread-discipline -- single GIL-atomic swap; callers gate on _containment_done, after which the folding writer is done
         out, self._checkpointed = self._checkpointed, []
         return out
 
@@ -1887,6 +1909,7 @@ class EngineCore:
                     )
             req.event.set()
 
+    @engine_thread_only
     def _process_evacuations(self) -> None:
         """Apply queued evacuation commands (engine thread only)."""
         while True:
@@ -1935,6 +1958,7 @@ class EngineCore:
                     req.error = error
             req.event.set()
 
+    @engine_thread_only
     def _evacuate_now(
         self, seq_ids: Optional[List[int]], reason: str
     ) -> List[Sequence]:
@@ -1996,6 +2020,7 @@ class EngineCore:
             )
         return out
 
+    @engine_thread_only
     def _tick(self) -> bool:
         """One iteration of the engine loop.
 
@@ -2139,12 +2164,14 @@ class EngineCore:
             or self.scheduler.has_admissible_waiting()
         )
 
+    @engine_thread_only
     def _running_seqs(self) -> List[Sequence]:
         return [
             s for s in self.scheduler.running
             if s.status is SeqStatus.RUNNING
         ]
 
+    @engine_thread_only
     def _handle_aborts(self) -> None:
         """Drop RUNNING sequences whose client cancelled (SSE disconnect
         etc.): slot + pages free immediately, finish_reason "abort".
@@ -2163,6 +2190,7 @@ class EngineCore:
                 ):
                     self.scheduler.abort(seq)
 
+    @engine_thread_only
     def _handle_deadlines(self) -> None:
         """Shed RUNNING sequences past their end-to-end deadline between
         decode ticks: the client's budget is blown, so decoding on would
@@ -2183,6 +2211,7 @@ class EngineCore:
             ):
                 self._shed_deadline(seq)
 
+    @engine_thread_only
     def _shed_deadline(self, seq: Sequence) -> None:
         self.scheduler.shed(
             seq,
@@ -2216,6 +2245,7 @@ class EngineCore:
         self._abort_q.put((None, reason))
         self._wakeup.set()
 
+    @engine_thread_only
     def _drain_abort_requests(self) -> None:
         """Apply queued abort commands (engine thread only)."""
         while True:
@@ -2245,6 +2275,7 @@ class EngineCore:
 
     # ------------------------------------------------------------- prefill
 
+    @engine_thread_only
     def _drain_submissions(self) -> None:
         while True:
             try:
@@ -2256,10 +2287,12 @@ class EngineCore:
             except Exception as exc:
                 seq.fail(exc)
 
+    @engine_thread_only
     def _step_key(self):
         self._step_counter += 1
         return jax.random.fold_in(self._base_key, self._step_counter)
 
+    @engine_thread_only
     def _admit_and_prefill(self) -> bool:
         """Admit waiting prompts a free slot + pages exist for, then prefill
         them in **batched programs**: same-bucket admissions stack into one
@@ -2466,6 +2499,7 @@ class EngineCore:
         self.perf.note_tokens(delivered)
         return True
 
+    @engine_thread_only
     def _dispatch_swap_in(self, plan: SwapInPlan) -> None:
         """Re-admit a host-swapped preemption victim: scatter its
         parked KV into the freshly-allocated ``seq.pages``
@@ -2500,6 +2534,7 @@ class EngineCore:
             request_id=seq.request_id,
         )
 
+    @engine_thread_only
     def _penalty_arrays(self, B: int, rows):
         """Build (counts [B, V] uint16, freq [B], pres [B]) device arrays
         from ``rows`` = iterable of (row_index, Sequence) — the one
@@ -2520,6 +2555,7 @@ class EngineCore:
                 )
         return jnp.asarray(counts), jnp.asarray(freq), jnp.asarray(pres)
 
+    @engine_thread_only
     def _min_token_arrays(self, B: int, rows):
         """(min_toks [B], stop_id_mat [B, K]) device arrays, or
         (None, None) when no row sets min_tokens.  Each row's stop set is
@@ -2551,6 +2587,7 @@ class EngineCore:
             min_toks[row] = seq.params.min_tokens
         return jnp.asarray(min_toks), jnp.asarray(mat)
 
+    @engine_thread_only
     def _logit_bias_arrays(self, B: int, rows):
         """(bias_ids [B, K] int32, bias_vals [B, K] f32) device arrays,
         or (None, None) when no row carries a logit_bias.  Padding uses
@@ -2574,6 +2611,7 @@ class EngineCore:
                 vals[row, j] = b
         return jnp.asarray(ids), jnp.asarray(vals)
 
+    @engine_thread_only
     def _group_penalties(self, plans: List[PrefillPlan], B: int):
         """Penalty arrays for a prefill group, or (None, None, None).
         Counts only matter when a penalized plan already generated tokens
@@ -2589,6 +2627,7 @@ class EngineCore:
             B, ((row, p.seq) for row, p in enumerate(plans))
         )
 
+    @engine_thread_only
     def _dispatch_prefill_group(self, plans: List[PrefillPlan], bucket: int):
         """Launch ONE prefill program for up to prefill_batch_max same-
         bucket sequences; returns the (async) [B] first-token device array.
@@ -2691,6 +2730,7 @@ class EngineCore:
         return out  # (first tokens [B], logprob triple or None)
 
     @staticmethod
+    @engine_thread_only
     def _suffix_key(
         bucket, B, ctx_pages, has_pen, mt_width, num_lp, lb_width,
         unaligned=False,
@@ -2703,6 +2743,7 @@ class EngineCore:
             lb_width, unaligned,
         )
 
+    @engine_thread_only
     def _dispatch_suffix_group(
         self, plans: List[PrefillPlan], bucket: int, unaligned: bool = False
     ):
@@ -2842,6 +2883,7 @@ class EngineCore:
             )
         return out  # (first tokens [B], logprob triple or None)
 
+    @engine_thread_only
     def _dispatch_chunked_prefill(self, plan: PrefillPlan):
         """Serial chunked prefill for a (suffix-)prompt longer than the
         bucket cap (scheduler.prefill_chunk): page-aligned passes of up
@@ -2938,6 +2980,7 @@ class EngineCore:
 
     # ------------------------------------------------------------- decode
 
+    @engine_thread_only
     def _decode_signature(self, seqs: List[Sequence]):
         """Cheap membership signature: when unchanged, every device input
         except tokens/positions/counter (which flow device→device) is
@@ -2953,6 +2996,7 @@ class EngineCore:
             for seq in seqs
         )
 
+    @engine_thread_only
     def _build_decode_state(self, seqs: List[Sequence]) -> None:
         self.total_state_rebuilds += 1
         B = self.max_slots
@@ -3012,6 +3056,7 @@ class EngineCore:
             "bias_vals": lb_vals_j,
         }
 
+    @engine_thread_only
     def _refresh_page_tables(self, seqs: List[Sequence]) -> None:
         """Re-upload ONLY the page tables after in-place page growth (same
         sequences, same slots).  In-flight chunks keep their older table,
@@ -3025,6 +3070,7 @@ class EngineCore:
             row[: len(seq.pages)] = seq.pages
         state["page_tables"] = jnp.asarray(self._page_tables_np)
 
+    @engine_thread_only
     def _pick_chunk(self, active: List[Sequence], lead: int = 0) -> int:
         """Chunk length for the next dispatch: the largest power of two that
         neither exceeds ``decode_chunk`` nor overshoots every sequence's
@@ -3054,6 +3100,7 @@ class EngineCore:
             headroom = min(headroom, max(1, self.decode_chunk // 8))
         return 1 << (headroom.bit_length() - 1)
 
+    @engine_thread_only
     def _dispatch_chunk(self, active: List[Sequence], chunk: int) -> None:
         faults.check("decode_step")
         state = self._dec_state
@@ -3158,6 +3205,7 @@ class EngineCore:
              start, chunk_lp, chunk_flags)
         )
 
+    @engine_thread_only
     def _process_chunks(self, drain: bool = False) -> None:
         """Fold the oldest in-flight chunk (all of them when ``drain``) into
         host state: append tokens in order, detect EOS/length stops, discard
@@ -3289,6 +3337,7 @@ class EngineCore:
 
     # --------------------------------------------------------- speculative
 
+    @engine_thread_only
     def _ngram_drafter(self, seq: Sequence, k: int) -> List[int]:
         from vgate_tpu.runtime.speculative import NgramIndex
 
@@ -3298,6 +3347,7 @@ class EngineCore:
             seq._ngram_index = index  # incremental; dies with the seq
         return index.draft(seq.prompt_ids + seq.output_ids, k)
 
+    @engine_thread_only
     def _tick_speculative(self) -> bool:
         """One speculative decode round (tpu.speculative_k > 0): draft up
         to k tokens per greedy sequence from its own history, verify all
@@ -3604,6 +3654,7 @@ class EngineCore:
             for tid, (lp, top) in zip(seq.generated_ids, seq.logprob_data)
         ]
 
+    @engine_thread_only
     def _attach_logprob(self, seq: Sequence, lp_np, k, slot) -> None:
         """Record one delivered token's logprob data from a readback
         triple ``(lp [.., B], top_ids [.., B, K], top_lps [.., B, K])``
@@ -3622,6 +3673,7 @@ class EngineCore:
             )
         )
 
+    @engine_thread_only
     def _maybe_finish(self, seq: Sequence, token: int) -> None:
         reason = None
         # min_tokens gates STOP kinds only (device masking already
@@ -3649,6 +3701,7 @@ class EngineCore:
             self.scheduler.remove(seq)
             seq.finish(reason)
 
+    @engine_thread_only
     def _hit_stop_string(self, seq: Sequence) -> bool:
         """Host-side stop-sequence detection at token readback (the
         reference delegates this to vLLM's ``SamplingParams.stop``,

@@ -44,6 +44,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from vgate_tpu import metrics
+from vgate_tpu.analysis.annotations import engine_thread_only
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 
@@ -131,14 +132,17 @@ class KVSwapManager:
     def free_bytes(self) -> int:
         return max(0, self.budget_bytes - self.used_bytes)
 
+    @engine_thread_only
     def _charge(self, nbytes: int) -> None:
         self.used_bytes += nbytes
         metrics.KV_HOST_POOL_BYTES.set(self.used_bytes)
 
+    @engine_thread_only
     def _refund(self, nbytes: int) -> None:
         self.used_bytes = max(0, self.used_bytes - nbytes)
         metrics.KV_HOST_POOL_BYTES.set(self.used_bytes)
 
+    @engine_thread_only
     def _count_discard(self, ticket: SwapTicket, reason: str) -> None:
         self._refund(ticket.nbytes)
         ticket.payload = None
@@ -149,6 +153,7 @@ class KVSwapManager:
             ticket.num_pages
         )
 
+    @engine_thread_only
     def _sweep_stale(self) -> None:
         """Drop seq tickets whose owner can never claim them: settled
         (finished/failed/aborted elsewhere) or epoch-mismatched (the
@@ -171,6 +176,7 @@ class KVSwapManager:
                 seq._swap_ticket = None  # type: ignore[attr-defined]
             self._count_discard(ticket, reason)
 
+    @engine_thread_only
     def _make_room(self, nbytes: int, evict_prefix: bool) -> bool:
         if nbytes > self.budget_bytes:
             return False
@@ -190,6 +196,7 @@ class KVSwapManager:
 
     # ---------------------------------------------- preempted sequences
 
+    @engine_thread_only
     def swap_out_seq(self, seq: Sequence, pages: List[int]) -> bool:
         """Park a preemption victim's valid KV pages in the host pool.
 
@@ -236,6 +243,7 @@ class KVSwapManager:
         metrics.KV_SWAP_OUT_PAGES.labels(kind="preempt").inc(len(pages))
         return True
 
+    @engine_thread_only
     def ticket_for(self, seq: Sequence) -> Optional[SwapTicket]:
         """The sequence's live swap ticket, or None — an invalid ticket
         (epoch moved under a fold, pool lost it) is discarded here so
@@ -253,6 +261,7 @@ class KVSwapManager:
             return None
         return ticket
 
+    @engine_thread_only
     def swap_in_seq(self, seq: Sequence, pages: List[int]) -> int:
         """Scatter a parked sequence's KV into its freshly-allocated
         device pages (engine thread, at admission).  Returns the page
@@ -272,6 +281,7 @@ class KVSwapManager:
         metrics.KV_SWAP_IN_PAGES.labels(kind="preempt").inc(len(pages))
         return len(pages)
 
+    @engine_thread_only
     def discard_for(self, seq: Sequence, reason: str = "settled") -> None:
         """Drop a sequence's parked KV (idempotent): the sequence
         settled, was evacuated, or folded to the recompute path.  The
@@ -287,6 +297,7 @@ class KVSwapManager:
 
     # --------------------------------------------- radix prefix victims
 
+    @engine_thread_only
     def demote_node(self, node: Any, pages: List[int]) -> Optional[SwapTicket]:
         """Victim-cache a radix leaf's pages before eviction frees
         them.  Only stale tickets are swept to make room — a demotion
@@ -317,6 +328,7 @@ class KVSwapManager:
         metrics.KV_SWAP_OUT_PAGES.labels(kind="prefix").inc(len(pages))
         return ticket
 
+    @engine_thread_only
     def promote_node(self, ticket: SwapTicket, pages: List[int]) -> bool:
         """Restore a demoted leaf's KV into fresh device pages (a
         ``match()`` walked into it).  Consumes the ticket.  Promotion
@@ -334,6 +346,7 @@ class KVSwapManager:
         metrics.KV_SWAP_IN_PAGES.labels(kind="prefix").inc(len(pages))
         return True
 
+    @engine_thread_only
     def drop_node_ticket(
         self, ticket: SwapTicket, reason: str = "settled"
     ) -> None:

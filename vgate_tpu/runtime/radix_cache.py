@@ -46,9 +46,15 @@ import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from vgate_tpu import metrics
+from vgate_tpu.analysis.annotations import engine_thread_only
 from vgate_tpu.logging_config import get_logger
 
 logger = get_logger(__name__)
+
+# Threading contract (scripts/vgt_lint.py, thread-discipline): tree
+# mutation is engine-thread-only; cross-thread readers get plain-int
+# gauges, never tree walks (the PR-6 hardening).
+VGT_COMPONENTS = {"swap": "KVSwapManager"}
 
 
 class RadixNode:
@@ -190,6 +196,7 @@ class RadixCache:
 
     # ------------------------------------------------------------- match
 
+    @engine_thread_only
     def match(self, tokens: Sequence[int]) -> Optional[RadixMatch]:
         """Walk to the longest shared prefix of ``tokens`` and lock it.
 
@@ -297,6 +304,7 @@ class RadixCache:
             cow_node=cow_node,
         )
 
+    @engine_thread_only
     def _try_promote(self, child: RadixNode) -> bool:
         """Restore a host-swapped leaf's pages into the device pool
         (match-time promotion).  The node's chain is locked around the
@@ -332,6 +340,7 @@ class RadixCache:
         self._touch_gauges()
         return True
 
+    @engine_thread_only
     def _lock_chain(self, node: RadixNode, delta: int, now: int) -> None:
         while node is not None and node is not self.root:
             was_free = node.lock_ref == 0
@@ -343,6 +352,7 @@ class RadixCache:
             node.last_access = now
             node = node.parent
 
+    @engine_thread_only
     def _common_prefix(
         self,
         child_tokens: Tuple[int, ...],
@@ -356,6 +366,7 @@ class RadixCache:
             n += 1
         return n
 
+    @engine_thread_only
     def probe(self, tokens: Sequence[int]) -> Tuple[int, int]:
         """Lock-free admissibility probe: (matched full pages, how many
         of them are currently reclaimable).  A real ``match`` would
@@ -402,6 +413,7 @@ class RadixCache:
 
     # ------------------------------------------------------------ insert
 
+    @engine_thread_only
     def insert(
         self, tokens: Sequence[int], pages: List[int]
     ) -> Optional[RadixNode]:
@@ -480,6 +492,7 @@ class RadixCache:
         self._touch_gauges()
         return created
 
+    @engine_thread_only
     def _split(self, child: RadixNode, j: int) -> RadixNode:
         """Split ``child``'s run at page ``j`` (0 < j < len): the head
         becomes a new node in child's place, the tail keeps ``child``'s
@@ -501,6 +514,7 @@ class RadixCache:
 
     # ---------------------------------------------------------- unlock
 
+    @engine_thread_only
     def unlock(self, match: RadixMatch) -> None:
         """Release a sequence's path locks (its allocator page
         references are released separately, with the rest of
@@ -511,6 +525,7 @@ class RadixCache:
             match.node = None
         self._touch_gauges()
 
+    @engine_thread_only
     def lock_node(self, node: RadixNode) -> None:
         """Pin ``node``'s parent chain on behalf of a RUNNING sequence
         whose private pages :meth:`insert` just adopted (commit-time
@@ -522,6 +537,7 @@ class RadixCache:
         can actually obtain."""
         self._lock_chain(node, +1, self._tick())
 
+    @engine_thread_only
     def unlock_node(self, node: RadixNode) -> None:
         """Drop a :meth:`lock_node` pin (chain-walked like every other
         lock, so later splits of the pinned path keep the accounting
@@ -529,6 +545,7 @@ class RadixCache:
         self._lock_chain(node, -1, self._tick())
         self._touch_gauges()
 
+    @engine_thread_only
     def release_cow(self, match: RadixMatch) -> None:
         """Drop the temporary lock on the COW source node — called once
         the copy program has been dispatched (device program order then
@@ -551,11 +568,13 @@ class RadixCache:
         every step)."""
         return self._evictable
 
+    @engine_thread_only
     def reclaim(self, n: int) -> int:
         """PageAllocator's on-demand hook: free at least ``n`` pages if
         reclaimable (LRU leaves first)."""
         return self.evict(n, reason="lru")
 
+    @engine_thread_only
     def evict(self, n: int, reason: str = "lru") -> int:
         """LRU walk over refcount-0 leaves: free up to ``n`` pages back
         to the allocator, cascading into parents as they become
@@ -653,6 +672,7 @@ class RadixCache:
             self._touch_gauges()
         return freed
 
+    @engine_thread_only
     def _drop_swapped_descendants(self, node: RadixNode) -> None:
         """Discard the host tickets of every swapped node under
         ``node`` (exclusive) — they are about to become unreachable."""
@@ -668,6 +688,7 @@ class RadixCache:
                     self.swap.drop_node_ticket(ticket, "capacity")
             self.total_nodes -= 1
 
+    @engine_thread_only
     def drop_swapped(self, node: RadixNode, reason: str = "capacity") -> None:
         """Unlink a host-swapped (page-less) node: the manager dropped
         its ticket to make room for a preemption swap-out, or its
@@ -690,6 +711,7 @@ class RadixCache:
                 self.total_nodes -= 1
         node.parent = None
 
+    @engine_thread_only
     def trim_to_watermark(self, target_free: int) -> int:
         """Proactive pressure trim: top the allocator's *truly free*
         list back up to ``target_free`` pages by evicting cold cache
@@ -703,6 +725,7 @@ class RadixCache:
 
     # ----------------------------------------------------- introspection
 
+    @engine_thread_only
     def _touch_gauges(self) -> None:
         metrics.PREFIX_CACHED_PAGES.set(self.allocator.num_cached)
 

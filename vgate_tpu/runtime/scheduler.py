@@ -21,6 +21,7 @@ from typing import Deque, List, Optional, Union
 import numpy as np
 
 from vgate_tpu import metrics
+from vgate_tpu.analysis.annotations import engine_thread_only
 from vgate_tpu.errors import DeadlineExceededError, KVCapacityError
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.kv_cache import PageAllocator
@@ -30,6 +31,11 @@ from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.utils.math import bucket_for, cdiv, round_up
 
 logger = get_logger(__name__)
+
+# Threading contract (scripts/vgt_lint.py, thread-discipline): the
+# scheduler is engine-thread-owned state; cross-module resolution for
+# self.radix.* / self.swap.* calls.
+VGT_COMPONENTS = {"radix": "RadixCache", "swap": "KVSwapManager"}
 
 
 def _rank(seq: "Sequence") -> int:
@@ -204,6 +210,7 @@ class Scheduler:
 
     # -- admission --
 
+    @engine_thread_only
     def add(self, seq: Sequence) -> None:
         if (
             len(self.waiting) >= self.max_queue_size
@@ -256,12 +263,14 @@ class Scheduler:
             s is not None for s in self.slots
         )
 
+    @engine_thread_only
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.slots):
             if s is None:
                 return i
         return None
 
+    @engine_thread_only
     def has_admissible_waiting(self) -> bool:
         """True when the head-of-queue prompt could actually be admitted
         right now: a free slot exists AND its pages are allocatable.
@@ -319,6 +328,7 @@ class Scheduler:
 
     # -- planning --
 
+    @engine_thread_only
     def schedule(self) -> Optional[Plan]:
         """Pick the next device program: prefill-priority admission, else a
         decode step over the active slots.
@@ -340,6 +350,7 @@ class Scheduler:
                 return DecodePlan(seqs=active)
         return self.try_admit()  # everything preempted; try re-admission
 
+    @engine_thread_only
     def _shed_expired(self) -> None:
         """Fail queued sequences whose deadline has passed (their
         completion would arrive too late to be useful).  Two deadlines
@@ -419,6 +430,7 @@ class Scheduler:
                 extra={"extra_data": {"shed": shed}},
             )
 
+    @engine_thread_only
     def _prefix_chain(self, seq: Sequence) -> List[bytes]:
         """Chain digests, one per full prompt page, cached on the sequence
         (re-admission attempts under memory pressure must not rehash the
@@ -451,6 +463,7 @@ class Scheduler:
         seq._prefix_chain_cache = (key, chain)  # type: ignore[attr-defined]
         return chain
 
+    @engine_thread_only
     def _radix_probe(self, seq: Sequence) -> tuple:
         """(matched full pages, matched-but-reclaimable pages) for a
         waiting sequence, memoized per (prompt epoch, tree clock) —
@@ -480,6 +493,7 @@ class Scheduler:
     # order) — bounds the per-tick probe cost under deep queues
     CACHE_AWARE_LOOKAHEAD = 8
 
+    @engine_thread_only
     def _select_next(self, count_bypass: bool = False) -> Optional[Sequence]:
         """Admission candidate: the oldest sequence of the most
         important waiting tier (rank, then seq_id — FIFO within a
@@ -545,6 +559,7 @@ class Scheduler:
             return warm
         return best
 
+    @engine_thread_only
     def _dequeue(self, seq: Sequence) -> None:
         """Remove a selected sequence from the waiting queue — O(1) for
         the head (the only case without priority tiers in play)."""
@@ -553,6 +568,7 @@ class Scheduler:
         else:
             self.waiting.remove(seq)
 
+    @engine_thread_only
     def _reap_aborted(self) -> None:
         """Settle client-cancelled waiting sequences WHEREVER they sit.
         Head-only reaping is not enough once priority selection admits
@@ -570,6 +586,7 @@ class Scheduler:
         self.waiting = kept
         metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
 
+    @engine_thread_only
     def try_admit(self) -> Optional[PrefillPlan]:
         self._shed_expired()
         self._reap_aborted()
@@ -712,6 +729,7 @@ class Scheduler:
             cow=cow, radix_insert=radix_insert, radix_match=radix_match,
         )
 
+    @engine_thread_only
     def _admit_swap_in(
         self, seq: Sequence, slot: int, ticket: SwapTicket
     ) -> Optional[SwapInPlan]:
@@ -740,6 +758,7 @@ class Scheduler:
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
         return SwapInPlan(seq=seq, slot=slot, ticket=ticket)
 
+    @engine_thread_only
     def commit_prefill(self, plan: PrefillPlan, stale: bool = False) -> None:
         """Index the pages a dispatched prefill has made reusable —
         called by the engine AFTER every writer program of the admission
@@ -771,6 +790,7 @@ class Scheduler:
         for page, h in plan.register_hashes or ():
             self.allocator.register(page, h)
 
+    @engine_thread_only
     def maybe_trim(self) -> None:
         """Proactive cache trim (engine tick): keep the truly-free list
         above the evict watermark by evicting cold tree pages, so
@@ -783,6 +803,7 @@ class Scheduler:
         ):
             self.radix.trim_to_watermark(self._trim_target)
 
+    @engine_thread_only
     def prepare_decode(
         self, active: List[Sequence], horizon: int = 1
     ) -> bool:
@@ -845,6 +866,7 @@ class Scheduler:
                     break  # requester preempted itself; skip its decode
         return any(s is not None for s in self.slots)
 
+    @engine_thread_only
     def _pick_victim(self) -> Optional[Sequence]:
         """Lowest-tier running sequence, youngest within the tier —
         under KV pressure batch work yields to interactive before any
@@ -854,6 +876,7 @@ class Scheduler:
             return None
         return max(running, key=lambda s: (_rank(s), s.seq_id))
 
+    @engine_thread_only
     def _event(self, kind: str, seq: Sequence, **fields) -> None:
         if self.recorder is not None:
             self.recorder.record_tick(
@@ -864,6 +887,7 @@ class Scheduler:
                 **fields,
             )
 
+    @engine_thread_only
     def _preempt(self, seq: Sequence) -> None:
         # host swap tier first, BEFORE anything releases the pages:
         # park the valid KV (positions 0 .. total_len-2 — the final
@@ -921,6 +945,7 @@ class Scheduler:
 
     # -- completion --
 
+    @engine_thread_only
     def _radix_unlock(self, seq: Sequence) -> None:
         """Drop the sequence's tree path locks (idempotent; its page
         references are released with the rest of ``seq.pages``) — both
@@ -937,6 +962,7 @@ class Scheduler:
             self.radix.unlock_node(node)
             seq._radix_insert_node = None  # type: ignore[attr-defined]
 
+    @engine_thread_only
     def _radix_insert_final(self, seq: Sequence) -> None:
         """Index a finishing sequence's GENERATED tokens too: turn N+1
         of a chat re-sends turn N's answer inside its prompt, so the
@@ -959,6 +985,7 @@ class Scheduler:
             stream[: n_full * self.page_size], seq.pages[:n_full]
         )
 
+    @engine_thread_only
     def _discard_swap(self, seq: Sequence, reason: str) -> None:
         """Drop a waiting sequence's parked host-pool KV (idempotent
         no-op for sequences without a live ticket) — called on every
@@ -969,6 +996,7 @@ class Scheduler:
         if self.swap is not None:
             self.swap.discard_for(seq, reason=reason)
 
+    @engine_thread_only
     def _release_residency(self, seq: Sequence) -> None:
         self._radix_unlock(seq)
         if seq.pages:
@@ -979,6 +1007,7 @@ class Scheduler:
         seq.slot = None
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
 
+    @engine_thread_only
     def remove(self, seq: Sequence) -> None:
         """Release residency after finish/failure.  A sequence finishing
         cleanly (the engine calls remove just before ``seq.finish``, so
@@ -988,6 +1017,7 @@ class Scheduler:
         self._release_residency(seq)
         self.total_finished += 1
 
+    @engine_thread_only
     def evacuate(self, seq: Sequence) -> None:
         """Planned migration (engine thread only): release this
         sequence's residency or queue position WITHOUT settling it —
@@ -1009,6 +1039,7 @@ class Scheduler:
             self._discard_swap(seq, "stale")
             metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
 
+    @engine_thread_only
     def abort(self, seq: Sequence) -> None:
         """Client cancellation: release any residency, account it as
         aborted (NOT finished — the two are disjoint outcomes), and
@@ -1021,6 +1052,7 @@ class Scheduler:
         self._event("abort", seq, reason=seq.abort_reason)
         seq.finish("abort")
 
+    @engine_thread_only
     def fail_sequence(self, seq: Sequence, exc: BaseException) -> None:
         """Fail ONE sequence with a typed error, freeing its residency
         this tick (slot + KV pages) — the integrity soft-sentinel path:
@@ -1034,6 +1066,7 @@ class Scheduler:
         self._event("integrity_fail", seq, error=type(exc).__name__)
         seq.fail(exc)
 
+    @engine_thread_only
     def shed(self, seq: Sequence, exc: DeadlineExceededError) -> None:
         """Deadline shed of a RUNNING sequence (the engine detected
         ``past_deadline`` between decode ticks and built the exception,

@@ -60,6 +60,7 @@ from vgate_tpu.errors import (
     state_is_alive,
     state_is_ready,
 )
+from vgate_tpu.analysis.annotations import requires_lock
 from vgate_tpu.integrity import CanaryKeeper
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.engine_core import (
@@ -70,6 +71,17 @@ from vgate_tpu.runtime.engine_core import (
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 
 logger = get_logger(__name__)
+
+# Threading contract (scripts/vgt_lint.py, thread-discipline): state
+# shared between the watcher thread, canary probe threads, and
+# serving-path callers mutates only under the supervisor RLock.
+VGT_LOCK_GUARDS = {
+    "_state": "_lock",
+    "_pending_resume": "_lock",
+    "_quarantine": "_lock",
+    "_suspect_counts": "_lock",
+    "_restart_times": "_lock",
+}
 
 
 class HealthState(enum.Enum):
@@ -457,7 +469,8 @@ class EngineSupervisor:
     def _fail_pending_resume(
         self, exc: BaseException, reason: str
     ) -> None:
-        pending, self._pending_resume = self._pending_resume, []
+        with self._lock:
+            pending, self._pending_resume = self._pending_resume, []
         for seq in pending:
             self.total_lost += 1
             metrics.LOST_SEQUENCES.labels(reason=reason).inc()
@@ -469,6 +482,13 @@ class EngineSupervisor:
             time.sleep(min(0.05, deadline - time.monotonic()))
 
     def _update_quarantine(self, exc: BaseException, kind: str) -> None:
+        with self._lock:
+            self._update_quarantine_locked(exc, kind)
+
+    @requires_lock("_lock")
+    def _update_quarantine_locked(
+        self, exc: BaseException, kind: str
+    ) -> None:
         # (fingerprint, resume_count) pairs of the residents at death
         suspects = list(self.core._fatal_suspects)
         if kind == "poison":
@@ -576,20 +596,21 @@ class EngineSupervisor:
         # claim the checkpointed in-flight sequences BEFORE the rebuild
         # loop (the old core's stop() would otherwise fail them) and
         # record the snapshot for /stats — counts and token counts only
-        self._pending_resume.extend(self.core.take_checkpointed())
-        # containment may have given up on sequences itself
-        # (max_resume_attempts): fold those into the lost total
-        self.total_lost += self.core.take_resume_losses()
-        if self._pending_resume:
-            self.last_resume = {
-                "time": time.time(),
-                "cause": f"{type(exc).__name__}: {exc}",
-                "checkpointed": len(self._pending_resume),
-                "sequences": [
-                    s.checkpoint_summary()
-                    for s in self._pending_resume
-                ],
-            }
+        with self._lock:
+            self._pending_resume.extend(self.core.take_checkpointed())
+            # containment may have given up on sequences itself
+            # (max_resume_attempts): fold those into the lost total
+            self.total_lost += self.core.take_resume_losses()
+            if self._pending_resume:
+                self.last_resume = {
+                    "time": time.time(),
+                    "cause": f"{type(exc).__name__}: {exc}",
+                    "checkpointed": len(self._pending_resume),
+                    "sequences": [
+                        s.checkpoint_summary()
+                        for s in self._pending_resume
+                    ],
+                }
         self._update_quarantine(exc, kind)
         if kind == "unrecoverable":
             self._fail_pending_resume(
@@ -626,10 +647,11 @@ class EngineSupervisor:
         rec = self._recovery
         while not self._stopping:
             now = time.monotonic()
-            self._restart_times = [
-                t for t in self._restart_times
-                if now - t < rec.restart_window_s
-            ]
+            with self._lock:
+                self._restart_times = [
+                    t for t in self._restart_times
+                    if now - t < rec.restart_window_s
+                ]
             if len(self._restart_times) >= rec.max_restarts:
                 logger.error(
                     "restart budget exhausted; engine is DEAD",
@@ -656,7 +678,8 @@ class EngineSupervisor:
             self._sleep(backoff)
             if self._stopping:
                 return
-            self._restart_times.append(time.monotonic())
+            with self._lock:
+                self._restart_times.append(time.monotonic())
             try:
                 # shared teardown/rebuild sequence (engine_core.
                 # rebuild_core): stop, free the dead incarnation's
@@ -761,7 +784,8 @@ class EngineSupervisor:
         budget sheds with the normal 504 + partials on the new core.
         ``core`` only needs submit_existing + flight, so tests drive
         this with fakes."""
-        pending, self._pending_resume = self._pending_resume, []
+        with self._lock:
+            pending, self._pending_resume = self._pending_resume, []
         replayed = 0
         for seq in pending:
             outcome = replay_into(
