@@ -144,3 +144,52 @@ record_drill_pid() {
 clear_drill_pid() {
   rm -f "$(_drill_pidfile "$1")"
 }
+
+# ---------------------------------------------------------------------
+# Runtime lock witness (vgate_tpu/analysis/witness.py).  Call
+# arm_lock_witness BEFORE booting the drill server so every named lock
+# records its acquisition chains, and assert_witness_clean after the
+# drill's asserts: the drill then also fails on any lock order the
+# static VGT_LOCK_ORDER graph did not predict — the dynamic-dispatch
+# coverage the AST checker cannot provide.  The report is written
+# incrementally, so even the trap's kill -9 leaves it current.
+
+arm_lock_witness() {
+  # arm_lock_witness NAME
+  local name="$1"
+  export VGT_LOCK_WITNESS="${VGT_LOCK_WITNESS:-1}"
+  export VGT_LOCK_WITNESS_OUT="/tmp/vgt_witness_${name}.json"
+  rm -f "$VGT_LOCK_WITNESS_OUT"
+}
+
+assert_witness_clean() {
+  # assert_witness_clean NAME — exits nonzero on undeclared chains
+  local name="$1"
+  python - "/tmp/vgt_witness_${name}.json" <<'PY'
+import json, os, sys
+
+path = sys.argv[1]
+if not os.path.exists(path):
+    print(
+        f"FAIL: lock-witness report {path} missing — the server "
+        "never ran with the witness enabled (armed too late, or "
+        "VGT_LOCK_WITNESS=0 disabled it; a disabled witness writes "
+        "no report so this check cannot pass vacuously)"
+    )
+    sys.exit(1)
+rep = json.load(open(path))
+und = rep.get("undeclared", [])
+if und:
+    print("FAIL: lock witness observed UNDECLARED acquisition orders:")
+    for e in und:
+        print(f"  {e['outer']} -> {e['inner']}  (chain {e['chain']})")
+    print("declare them in vgate_tpu/analysis/lock_order.py (with a")
+    print("rationale) or fix the nesting")
+    sys.exit(1)
+edges = rep.get("edges", [])
+print(
+    "lock witness: clean — "
+    f"{len(edges)} predicted chain(s) observed, 0 undeclared"
+)
+PY
+}

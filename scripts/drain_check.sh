@@ -15,6 +15,8 @@ cd "$(dirname "$0")/.."
 source scripts/_drill_lib.sh
 PORT="${1:-$(drill_port drain)}"
 ensure_port_free "$PORT"
+# lock witness: the drill doubles as the dynamic lock-order check
+arm_lock_witness drain
 export JAX_PLATFORMS=cpu
 export VGT_DRY_RUN=1
 export VGT_SERVER__PORT="$PORT"
@@ -109,4 +111,5 @@ if kill -0 "$SERVER_PID" 2>/dev/null; then
 fi
 wait "$SERVER_PID" 2>/dev/null || true
 trap - EXIT
+assert_witness_clean drain
 echo "PASS: drain_check complete (ready flipped, zero drops, clean exit)"

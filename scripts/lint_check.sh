@@ -1,7 +1,11 @@
 #!/usr/bin/env bash
 # Static-analysis gate: the vgtlint suite (thread/lock discipline,
-# jit purity, error taxonomy, definition drift, async blocking) plus
-# the metrics/monitoring lint.  Exits nonzero on any violation.
+# lock-order, obligations, epoch-guard, jit purity, error taxonomy,
+# definition drift, async blocking) plus the metrics/monitoring lint,
+# plus a lock-witness-armed runtime smoke (a fast engine/scheduler
+# test slice run with VGT_LOCK_WITNESS=1): the static VGT_LOCK_ORDER
+# graph must predict every acquisition chain that actually happens.
+# Exits nonzero on any violation.
 #
 # Usage:
 #   scripts/lint_check.sh                 # full repo (what CI runs)
@@ -13,12 +17,21 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+source scripts/_drill_lib.sh
 export JAX_PLATFORMS=cpu
 
-echo "== vgt_lint (5-checker suite + metrics) =="
+echo "== vgt_lint (8-checker suite + metrics) =="
 python scripts/vgt_lint.py "$@"
 
 echo "== metrics_lint (standalone entrypoint) =="
 python scripts/metrics_lint.py
+
+echo "== lock witness smoke (VGT_LOCK_WITNESS=1 over engine/scheduler/admission fast tests) =="
+arm_lock_witness lint
+VGT_LOCK_WITNESS=1 python -m pytest \
+  tests/test_scheduler.py tests/test_kv_swap.py \
+  tests/test_admission.py tests/test_batcher.py \
+  -q -m 'not slow' -p no:cacheprovider
+assert_witness_clean lint
 
 echo "lint_check: OK"

@@ -21,6 +21,8 @@ cd "$(dirname "$0")/.."
 source scripts/_drill_lib.sh
 PORT="${1:-$(drill_port migrate)}"
 ensure_port_free "$PORT"
+# lock witness: the drill doubles as the dynamic lock-order check
+arm_lock_witness migrate
 export JAX_PLATFORMS=cpu
 # two virtual CPU devices so dp=2 gets disjoint submeshes
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2"
@@ -89,6 +91,64 @@ async def get_json(session, path):
         return resp.status, await resp.json()
 
 
+async def undrain_and_wait_serving(session):
+    async with session.post(
+        f"{BASE}/admin/replicas/0/undrain"
+    ) as resp:
+        assert resp.status == 200, await resp.text()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        _, health = await get_json(session, "/health")
+        if health["engine"]["state"] == "serving":
+            return health
+        await asyncio.sleep(0.2)
+    raise AssertionError(
+        f"replica never rejoined SERVING: {health['engine']}"
+    )
+
+
+async def drain_attempt(session):
+    """One wave + drain: fire the pinned decodes, POLL until replica 0
+    provably holds resident decodes (the PR-8/12 poll-with-deadline
+    pattern — the old fixed 1s sleep let the decodes settle before the
+    drain landed on loaded hosts: `migrated 0`, flaky since PR 13),
+    then drain under them.  Returns (results, migrated, resumed)."""
+    wave = asyncio.gather(*(fire(session, p) for p in PROMPTS))
+    resident, health = 0, {}
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        _, health = await get_json(session, "/health")
+        reps = health["engine"].get("replicas") or []
+        resident = (reps[0].get("running") or 0) if reps else 0
+        if resident >= 1:
+            break
+        await asyncio.sleep(0.05)
+    assert resident >= 1, (
+        "replica 0 never showed resident decodes; the drain cannot "
+        f"land mid-flight: {health.get('engine')}"
+    )
+    async with session.post(
+        f"{BASE}/admin/replicas/0/drain"
+    ) as resp:
+        drain = await resp.json()
+        assert resp.status == 200, (resp.status, drain)
+    print(f"drain response (replica 0 had {resident} resident): {drain}")
+
+    # DEGRADED with detail while drained
+    _, health = await get_json(session, "/health")
+    assert health["engine"]["state"] == "degraded", health["engine"]
+    assert health["engine"]["draining"] == [0], health["engine"]
+    assert health["engine"]["replicas"][0]["state"] == "draining"
+
+    results = await wave
+    fivexx = [s for s, _ in results if s >= 500]
+    assert not fivexx, f"client-visible 5xx during drain: {results}"
+    assert all(s == 200 for s, _ in results), results
+    migrated = [b.get("migrated", False) for _, b in results]
+    resumed = [b.get("resumed", False) for _, b in results]
+    return results, migrated, resumed
+
+
 async def main():
     timeout = aiohttp.ClientTimeout(total=600)
     async with aiohttp.ClientSession(timeout=timeout) as session:
@@ -100,35 +160,27 @@ async def main():
         )
         assert all(s == 200 for s, _ in warm), warm
 
-        # the drill wave: fire concurrently, give the engines a moment
-        # to admit and start decoding, then drain replica 0 under them
-        wave = asyncio.gather(*(fire(session, p) for p in PROMPTS))
-        await asyncio.sleep(1.0)
-        async with session.post(
-            f"{BASE}/admin/replicas/0/drain"
-        ) as resp:
-            drain = await resp.json()
-            assert resp.status == 200, (resp.status, drain)
-        print(f"drain response: {drain}")
-
-        # DEGRADED with detail while drained
-        _, health = await get_json(session, "/health")
-        assert health["engine"]["state"] == "degraded", health["engine"]
-        assert health["engine"]["draining"] == [0], health["engine"]
-        assert health["engine"]["replicas"][0]["state"] == "draining"
-
-        results = await wave
-        fivexx = [s for s, _ in results if s >= 500]
-        assert not fivexx, f"client-visible 5xx during drain: {results}"
-        assert all(s == 200 for s, _ in results), results
+        results, migrated_flags, resumed_flags = await drain_attempt(
+            session
+        )
+        if not any(migrated_flags):
+            # bounded retry ONCE: the residents the poll saw can still
+            # settle in the gap before the evacuation lands (engine-
+            # thread scheduling); a second failure is a real regression
+            print(
+                "RETRY: drain landed after the pinned decodes "
+                "settled; undraining and retrying once"
+            )
+            await undrain_and_wait_serving(session)
+            results, migrated_flags, resumed_flags = (
+                await drain_attempt(session)
+            )
         storm_text = [
             b["choices"][0]["message"]["content"] for _, b in results
         ]
-        migrated_flags = [b.get("migrated", False) for _, b in results]
-        resumed_flags = [b.get("resumed", False) for _, b in results]
         assert any(migrated_flags), (
-            "no response carried migrated:true — the drain never "
-            "touched an in-flight request"
+            "no response carried migrated:true in either attempt — "
+            "the drain never touched an in-flight request"
         )
         assert not any(resumed_flags), (
             "a planned drain must surface migrated, never resumed"
@@ -155,20 +207,7 @@ async def main():
         ), "vgt_replicas_draining should be 1 while drained"
 
         # the rolling deploy's rejoin step: undrain -> SERVING
-        async with session.post(
-            f"{BASE}/admin/replicas/0/undrain"
-        ) as resp:
-            assert resp.status == 200, await resp.text()
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
-            _, health = await get_json(session, "/health")
-            if health["engine"]["state"] == "serving":
-                break
-            await asyncio.sleep(0.2)
-        else:
-            raise AssertionError(
-                f"replica never rejoined SERVING: {health['engine']}"
-            )
+        await undrain_and_wait_serving(session)
 
         # token identity: an undisturbed rerun (both replicas serving,
         # cache off, temperature 0) reproduces the drained outputs
@@ -198,4 +237,5 @@ EOF
 
 kill "$SERVER_PID" 2>/dev/null || true
 wait "$SERVER_PID" 2>/dev/null || true
+assert_witness_clean migrate
 echo "migrate_check: OK"

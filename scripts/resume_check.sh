@@ -22,6 +22,8 @@ cd "$(dirname "$0")/.."
 source scripts/_drill_lib.sh
 PORT="${1:-$(drill_port resume)}"
 ensure_port_free "$PORT"
+# lock witness: the drill doubles as the dynamic lock-order check
+arm_lock_witness resume
 export JAX_PLATFORMS=cpu
 export VGT_SERVER__PORT="$PORT"
 export VGT_LOGGING__LEVEL=WARNING
@@ -172,4 +174,5 @@ EOF
 
 kill "$SERVER_PID" 2>/dev/null || true
 wait "$SERVER_PID" 2>/dev/null || true
+assert_witness_clean resume
 echo "resume_check: OK"

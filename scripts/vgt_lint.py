@@ -80,6 +80,14 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-checkers", action="store_true", help="list and exit"
     )
+    parser.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help="output format: text (default) or github — GitHub "
+        "Actions workflow annotations (::error file=...) so CI "
+        "findings land inline on the PR diff",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -166,6 +174,30 @@ def main(argv=None) -> int:
             "count as unjustified)"
         )
         return 0
+
+    if args.format == "github":
+        for v in result.violations:
+            # pseudo-paths (<baseline>) have no file to annotate;
+            # GitHub drops the annotation silently, so anchor them on
+            # the baseline file instead
+            path = (
+                lint_runner.DEFAULT_BASELINE
+                if v.path.startswith("<")
+                else v.path
+            )
+            message = v.message.replace("%", "%25").replace(
+                "\r", "%0D"
+            ).replace("\n", "%0A")
+            print(
+                f"::error file={path},line={max(1, v.line)},"
+                f"title=vgt-lint {v.checker}/{v.rule}::{message}"
+            )
+        summary = (
+            f"vgt-lint: {'FAILED' if result.violations else 'OK'} — "
+            f"{len(result.violations)} finding(s)"
+        )
+        print(summary, file=sys.stderr if result.violations else sys.stdout)
+        return 1 if result.violations else 0
 
     report = lint_runner.render_report(result, verbose=args.verbose)
     print(report, file=sys.stderr if result.violations else sys.stdout)

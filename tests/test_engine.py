@@ -200,7 +200,13 @@ def test_decode_signature_includes_preempt_epoch(engine):
 def test_preemption_under_chunked_pipeline_is_clean():
     """Preemption while chunks are in flight (decode_chunk>1, pipeline 2):
     every sequence still finishes with its exact budget and no pages leak.
-    Exercises the signature-cache invalidation paths in _tick."""
+    Exercises the signature-cache invalidation paths in _tick.
+
+    min_tokens pins the full 10-token budget: on random-init weights
+    greedy argmax occasionally lands on EOS mid-generation, which used
+    to flip finish_reason to "stop" under full-suite ordering (flaky
+    since PR 12) — the invariant under test is preemption cleanliness
+    (exact budget, zero leaks), not where a random model stops."""
     core = EngineCore(
         tiny_config(kv_num_pages=15, decode_chunk=4, decode_pipeline=2),
         devices=jax.devices()[:1],
@@ -208,7 +214,13 @@ def test_preemption_under_chunked_pipeline_is_clean():
     core.start()
     try:
         prompts = ["pipeline one", "pipeline two", "pipeline number three"]
-        seqs = [core.submit_prompt(p, greedy(10)) for p in prompts]
+        params = [
+            SamplingParams(max_tokens=10, min_tokens=10, temperature=0.0)
+            for _ in prompts
+        ]
+        seqs = [
+            core.submit_prompt(p, sp) for p, sp in zip(prompts, params)
+        ]
         for seq in seqs:
             assert seq.done_event.wait(timeout=300)
         assert core.scheduler.total_preemptions >= 1

@@ -84,7 +84,7 @@ def test_repo_is_clean_with_empty_baseline():
     assert result.ok, "vgt-lint findings:\n" + "\n".join(
         v.render() for v in result.violations
     )
-    assert len(result.checkers_run) == 6
+    assert len(result.checkers_run) == 9
     assert time.monotonic() - t0 < 30.0
 
 
@@ -100,6 +100,9 @@ def test_cli_smoke(capsys):
     out = capsys.readouterr().out
     for name in (
         "thread-discipline",
+        "lock-order",
+        "obligations",
+        "epoch-guard",
         "jit-purity",
         "error-taxonomy",
         "definition-drift",

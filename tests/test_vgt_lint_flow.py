@@ -1,0 +1,908 @@
+"""vgtlint v2 flow-sensitive layer (ISSUE 15): CFG construction
+(finally edges, raise-in-except, loop back edges), the dataflow
+solver, the lock-order / obligations / epoch-guard checkers on
+positive+negative fixtures, and the three seeded-mutation tests that
+replay historical review-round bug shapes against COPIES of the real
+runtime modules:
+
+* PR-11 — host-pool bytes double-refunded on the sweep-then-settle
+  path (obligations R002);
+* PR-2 — a future created, then left unsettled on one exception arm
+  (obligations R001);
+* a synthetic ``_topology_lock``-inside-``_structural_lock`` order
+  inversion in the real dp_engine (lock-order L001 + cycle L002).
+"""
+
+import ast
+import os
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from vgate_tpu.analysis import runner as lint_runner  # noqa: E402
+from vgate_tpu.analysis.cfg import BACK, EXC, build_cfg  # noqa: E402
+from vgate_tpu.analysis.checkers import checkers_by_name  # noqa: E402
+from vgate_tpu.analysis.dataflow import forward  # noqa: E402
+
+
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(textwrap.dedent(text))
+    return path
+
+
+def _run(root, checker_names, only=None):
+    by_name = checkers_by_name()
+    return lint_runner.run(
+        str(root), [by_name[n] for n in checker_names], only=only
+    )
+
+
+def _rules(result):
+    return sorted({v.rule for v in result.violations})
+
+
+def _cfg_of(src):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = tree.body[0]
+    return build_cfg(fn)
+
+
+def _reachable(cfg, start, goal, kinds=None):
+    """Path existence over the CFG, optionally restricted to edge
+    kinds."""
+    seen, stack = set(), [start]
+    while stack:
+        node = stack.pop()
+        if node is goal:
+            return True
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        for succ, kind in node.succs:
+            if kinds is None or kind in kinds:
+                stack.append(succ)
+    return False
+
+
+# ------------------------------------------------------------- CFG shape
+
+
+def test_cfg_finally_edge():
+    """Both the normal path and the exception path route through the
+    finally body; the exception still escapes afterwards."""
+    cfg = _cfg_of(
+        """
+        def f(self):
+            try:
+                work()
+            finally:
+                cleanup()
+            after()
+        """
+    )
+    fin = next(n for n in cfg.nodes if _src(n) == "cleanup()")
+    work = next(n for n in cfg.nodes if _src(n) == "work()")
+    after = next(n for n in cfg.nodes if _src(n) == "after()")
+    # normal: work -> finally -> after
+    assert _reachable(cfg, work, fin)
+    assert _reachable(cfg, fin, after)
+    # exceptional: work's exc edge leads to the finally, and the
+    # finally's exit can continue to raise_exit (the exception is not
+    # swallowed)
+    exc_succs = [s for s, k in work.succs if k == EXC]
+    assert exc_succs and all(
+        _reachable(cfg, s, fin) or s is fin for s in exc_succs
+    )
+    assert _reachable(cfg, fin, cfg.raise_exit)
+
+
+def test_cfg_raise_in_except_flows_out_not_to_sibling():
+    cfg = _cfg_of(
+        """
+        def f(self):
+            try:
+                work()
+            except ValueError:
+                raise
+            except KeyError:
+                other()
+            after()
+        """
+    )
+    re_raise = next(n for n in cfg.nodes if n.label == "raise")
+    other = next(n for n in cfg.nodes if _src(n) == "other()")
+    # the re-raise escapes the function; it does NOT enter the sibling
+    # handler
+    assert _reachable(cfg, re_raise, cfg.raise_exit)
+    assert not _reachable(cfg, re_raise, other)
+    # narrow handlers: the try body's exception can also escape both
+    work = next(n for n in cfg.nodes if _src(n) == "work()")
+    assert _reachable(cfg, work, cfg.raise_exit, kinds=(EXC,))
+
+
+def test_cfg_loop_back_edge():
+    cfg = _cfg_of(
+        """
+        def f(self, items):
+            for x in items:
+                use(x)
+            done()
+        """
+    )
+    backs = cfg.back_edges()
+    assert len(backs) == 1
+    src, dst = backs[0]
+    assert _src(src) == "use(x)"
+    assert dst.label == "loop"
+    # continue also produces a back edge
+    cfg2 = _cfg_of(
+        """
+        def f(self, items):
+            while items:
+                if skip():
+                    continue
+                use(items)
+        """
+    )
+    assert any(
+        s.label == "continue" for s, _ in cfg2.back_edges()
+    )
+
+
+def test_cfg_broad_handler_swallows_escape():
+    cfg = _cfg_of(
+        """
+        def f(self):
+            try:
+                work()
+            except Exception:
+                handle()
+            after()
+        """
+    )
+    work = next(n for n in cfg.nodes if _src(n) == "work()")
+    # with a broad handler, the try body's exception cannot reach
+    # raise_exit without passing through the handler
+    handle = next(n for n in cfg.nodes if _src(n) == "handle()")
+    for succ, kind in work.succs:
+        if kind == EXC:
+            assert _reachable(cfg, succ, handle)
+
+
+def _src(node):
+    stmt = node.stmt
+    if stmt is None:
+        return ""
+    try:
+        return ast.unparse(stmt).strip()
+    except Exception:  # pragma: no cover
+        return ""
+
+
+# ------------------------------------------------------------- dataflow
+
+
+def test_dataflow_must_join_over_branches():
+    """Must-analysis (AND-join): a guard on only one branch does not
+    dominate the join point; on both branches it does."""
+    cfg = _cfg_of(
+        """
+        def f(self, c):
+            if c:
+                guard()
+            else:
+                other()
+            sink()
+        """
+    )
+
+    def transfer(node, fact, kind):
+        return True if _src(node) == "guard()" else fact
+
+    facts = forward(cfg, False, transfer, lambda a, b: a and b)
+    sink = next(n for n in cfg.nodes if _src(n) == "sink()")
+    assert facts[sink] is False  # one arm lacks the guard
+
+    cfg2 = _cfg_of(
+        """
+        def f(self, c):
+            if c:
+                guard()
+            else:
+                guard()
+            sink()
+        """
+    )
+
+    def transfer2(node, fact, kind):
+        return True if _src(node) == "guard()" else fact
+
+    facts2 = forward(cfg2, False, transfer2, lambda a, b: a and b)
+    sink2 = next(n for n in cfg2.nodes if _src(n) == "sink()")
+    assert facts2[sink2] is True
+
+
+def test_dataflow_loop_fixpoint_terminates():
+    cfg = _cfg_of(
+        """
+        def f(self, items):
+            n = 0
+            for x in items:
+                n = step(n)
+            return n
+        """
+    )
+    counter = {"calls": 0}
+
+    def transfer(node, fact, kind):
+        counter["calls"] += 1
+        return fact | {_src(node)} if node.stmt is not None else fact
+
+    facts = forward(cfg, frozenset(), transfer, lambda a, b: a | b)
+    assert cfg.exit in facts
+    assert counter["calls"] < 500
+
+
+# ------------------------------------------------------------ lock-order
+
+
+_LOCK_REGISTRY = """
+VGT_LOCK_ALIASES = {}
+VGT_LOCK_ORDER = {
+    "Mgr._outer_lock->Mgr._inner_lock": "outer wraps inner by design",
+}
+"""
+
+
+@pytest.fixture
+def lock_project(tmp_path):
+    _write(
+        tmp_path, "vgate_tpu/analysis/lock_order.py", _LOCK_REGISTRY
+    )
+    return tmp_path
+
+
+def test_lock_order_declared_edge_is_clean(lock_project):
+    _write(
+        lock_project,
+        "vgate_tpu/mgr.py",
+        """
+        import threading
+
+        class Mgr:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def ok(self):
+                with self._outer_lock:
+                    with self._inner_lock:
+                        pass
+        """,
+    )
+    result = _run(lock_project, ["lock-order"])
+    assert result.ok, [v.render() for v in result.violations]
+
+
+def test_lock_order_undeclared_edge_and_cycle(lock_project):
+    _write(
+        lock_project,
+        "vgate_tpu/mgr.py",
+        """
+        import threading
+
+        class Mgr:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def inverted(self):
+                with self._inner_lock:
+                    with self._outer_lock:
+                        pass
+        """,
+    )
+    result = _run(lock_project, ["lock-order"])
+    rules = _rules(result)
+    assert "L001" in rules  # inner->outer never declared
+    assert "L002" in rules  # declared outer->inner + observed inverse
+    l1 = next(v for v in result.violations if v.rule == "L001")
+    assert l1.symbol == "Mgr._inner_lock->Mgr._outer_lock"
+    assert "vgate_tpu/mgr.py" == l1.path
+
+
+def test_lock_order_cross_method_and_component_resolution(lock_project):
+    """The edge is derived through calls: holding _outer_lock while
+    calling a method (own class, then a VGT_COMPONENTS component)
+    whose transitive closure acquires another lock."""
+    _write(
+        lock_project,
+        "vgate_tpu/mgr.py",
+        """
+        import threading
+
+        VGT_COMPONENTS = {"helper": "Helper"}
+
+        class Helper:
+            def poke(self):
+                with self._h_lock:
+                    pass
+
+        class Mgr:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+                self._h_lock = threading.Lock()
+                self.helper = Helper()
+
+            def _take_inner(self):
+                with self._inner_lock:
+                    pass
+
+            def chained(self):
+                with self._outer_lock:
+                    self._take_inner()     # declared edge: ok
+                    self.helper.poke()     # L001: outer->Helper._h_lock
+        """,
+    )
+    result = _run(lock_project, ["lock-order"])
+    symbols = {v.symbol for v in result.violations if v.rule == "L001"}
+    assert symbols == {"Mgr._outer_lock->Helper._h_lock"}
+
+
+def test_lock_order_wrapper_registry(lock_project):
+    _write(
+        lock_project,
+        "vgate_tpu/mgr.py",
+        """
+        import functools
+        import threading
+
+        VGT_LOCK_WRAPPERS = {"_serialized": "_outer_lock"}
+
+        def _serialized(fn):
+            @functools.wraps(fn)
+            def wrapper(self, *a, **kw):
+                with self._outer_lock:
+                    return fn(self, *a, **kw)
+            return wrapper
+
+        class Mgr:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+                self._extra_lock = threading.Lock()
+
+            @_serialized
+            def op(self):
+                with self._inner_lock:   # declared outer->inner: ok
+                    pass
+
+            @_serialized
+            def bad(self):
+                with self._extra_lock:   # L001: outer->extra undeclared
+                    pass
+        """,
+    )
+    result = _run(lock_project, ["lock-order"])
+    symbols = {v.symbol for v in result.violations if v.rule == "L001"}
+    assert symbols == {"Mgr._outer_lock->Mgr._extra_lock"}
+
+
+def test_lock_order_stale_registry_entry(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/analysis/lock_order.py",
+        """
+        VGT_LOCK_ALIASES = {}
+        VGT_LOCK_ORDER = {
+            "Mgr._outer_lock->Mgr._typo_lock": "stale entry",
+        }
+        """,
+    )
+    _write(
+        tmp_path,
+        "vgate_tpu/mgr.py",
+        """
+        import threading
+
+        class Mgr:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+        """,
+    )
+    result = _run(tmp_path, ["lock-order"])
+    assert _rules(result) == ["L003"]
+    assert "_typo_lock" in result.violations[0].message
+
+
+def test_lock_order_wrapper_typo_is_loud(lock_project):
+    _write(
+        lock_project,
+        "vgate_tpu/mgr.py",
+        """
+        import threading
+
+        VGT_LOCK_WRAPPERS = {"_serialized": "_outer_lock"}
+
+        class Mgr:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+        """,
+    )
+    result = _run(lock_project, ["lock-order"])
+    # the decorator named in the registry is never defined
+    assert _rules(result) == ["L004"]
+
+
+def test_lock_order_alias_canonicalizes(tmp_path):
+    """Two names for one runtime lock object never produce an edge
+    between themselves, and edges derived through either name land on
+    the canonical one."""
+    _write(
+        tmp_path,
+        "vgate_tpu/analysis/lock_order.py",
+        """
+        VGT_LOCK_ALIASES = {"Swap._lock": "Core._readback_lock"}
+        VGT_LOCK_ORDER = {}
+        """,
+    )
+    _write(
+        tmp_path,
+        "vgate_tpu/core.py",
+        """
+        import threading
+
+        VGT_COMPONENTS = {"swap": "Swap"}
+
+        class Swap:
+            def park(self):
+                with self._lock:
+                    pass
+
+        class Core:
+            def __init__(self):
+                self._readback_lock = threading.Lock()
+                self.swap = Swap()
+                self.swap._lock = self._readback_lock
+
+            def fold(self):
+                with self._readback_lock:
+                    self.swap.park()   # same lock: reentrancy, no edge
+        """,
+    )
+    result = _run(tmp_path, ["lock-order"])
+    assert result.ok, [v.render() for v in result.violations]
+
+
+# ----------------------------------------------------------- obligations
+
+
+_OBL_BUDGET = """
+VGT_OBLIGATIONS = {
+    "budget": {
+        "acquire": ("self._charge",),
+        "release": ("self._refund",),
+        "transfer_assign": ("self._registry",),
+    },
+}
+"""
+
+_OBL_FUTURE = """
+VGT_OBLIGATIONS = {
+    "future": {
+        "acquire": ("*.create_future",),
+        "release": ("*.set_result", "*.set_exception", "*.cancel"),
+        "transfer": ("*.add_done_callback",),
+    },
+}
+"""
+
+
+def test_obligation_leak_on_exception_arm(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        _OBL_BUDGET
+        + textwrap.dedent("""
+        class M:
+            def leaky(self, n):
+                self._charge(n)
+                self.work(n)          # raises -> charge leaks
+                self._refund(n)
+        """),
+    )
+    result = _run(tmp_path, ["obligations"])
+    assert [v.rule for v in result.violations] == ["R001"]
+    assert "exception path" in result.violations[0].message
+
+
+def test_obligation_clean_try_finally_and_transfer(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        textwrap.dedent("""
+        VGT_OBLIGATIONS = {
+            "budget": {
+                "acquire": ("self._charge",),
+                "release": ("self._refund",),
+                "transfer_assign": ("self._registry",),
+            },
+            "future": {
+                "acquire": ("*.create_future",),
+                "release": ("*.set_result", "*.set_exception"),
+                "transfer": ("*.add_done_callback",),
+            },
+        }
+        """)
+        + textwrap.dedent("""
+        class M:
+            def fin(self, n):
+                self._charge(n)
+                try:
+                    self.work(n)
+                finally:
+                    self._refund(n)
+
+            def parked(self, key, ticket, n):
+                self._charge(n)
+                self._registry[key] = ticket
+
+            def handed_off(self, loop):
+                fut = loop.create_future()
+                fut.add_done_callback(self.done)
+                return fut
+
+            def settle(self, fut, out):
+                fut.set_result(out)
+        """),
+    )
+    result = _run(tmp_path, ["obligations"])
+    assert result.ok, [v.render() for v in result.violations]
+
+
+def test_obligation_unsettled_future_exception_arm(tmp_path):
+    """The PR-2 bug shape as a fixture: settled on the happy path,
+    silently dropped when the exception arm returns."""
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        _OBL_FUTURE
+        + textwrap.dedent("""
+        class M:
+            def run(self, loop):
+                fut = loop.create_future()
+                try:
+                    out = self.work()
+                    fut.set_result(out)
+                except Exception:
+                    self.log()
+                    return None
+                return fut
+        """),
+    )
+    result = _run(tmp_path, ["obligations"])
+    rules = [v.rule for v in result.violations]
+    assert "R001" in rules
+    leak = next(v for v in result.violations if v.rule == "R001")
+    assert "future" in leak.symbol
+
+
+def test_obligation_double_release(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        _OBL_BUDGET
+        + textwrap.dedent("""
+        class M:
+            def double(self, ticket, n):
+                self._charge(n)
+                self._refund(ticket.nbytes)
+                self._refund(ticket.nbytes)   # R002
+        """),
+    )
+    result = _run(tmp_path, ["obligations"])
+    assert "R002" in _rules(result)
+
+
+def test_obligation_release_loop_is_not_double_release(tmp_path):
+    """Per-item release loops rebind their loop target each iteration
+    — the R002 key dies at the back edge, so no false positive."""
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        _OBL_BUDGET
+        + textwrap.dedent("""
+        class M:
+            def sweep(self, dead, n):
+                self._charge(n)
+                try:
+                    for ticket in dead:
+                        self._refund(ticket.nbytes)
+                finally:
+                    self._refund(n)
+        """),
+    )
+    result = _run(tmp_path, ["obligations"])
+    assert "R002" not in _rules(result), [
+        v.render() for v in result.violations
+    ]
+
+
+def test_obligation_stale_registry_pattern(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        """
+        VGT_OBLIGATIONS = {
+            "budget": {
+                "acquire": ("self._chrage",),   # typo'd
+                "release": ("self._refund",),
+            },
+        }
+
+        class M:
+            def ok(self, n):
+                self._charge(n)
+                self._refund(n)
+        """,
+    )
+    result = _run(tmp_path, ["obligations"])
+    assert "R003" in _rules(result)
+
+
+# ----------------------------------------------------------- epoch-guard
+
+
+_EPOCH_HEADER = """
+VGT_EPOCH_GUARDS = {
+    "append_token": {"lock": "_readback_lock",
+                     "epoch": "preempt_count"},
+}
+"""
+
+
+def test_epoch_guard_clean_shape(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        _EPOCH_HEADER
+        + textwrap.dedent("""
+        class Core:
+            def fold(self, seqs, tokens):
+                with self._readback_lock:
+                    for seq, epoch in seqs:
+                        if seq.preempt_count != epoch:
+                            continue
+                        seq.append_token(tokens[seq.slot])
+        """),
+    )
+    result = _run(tmp_path, ["epoch-guard"])
+    assert result.ok, [v.render() for v in result.violations]
+
+
+def test_epoch_guard_missing_lock_and_compare(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        _EPOCH_HEADER
+        + textwrap.dedent("""
+        class Core:
+            def bare(self, seq, token):
+                seq.append_token(token)      # G001 + G002
+
+            def locked_only(self, seq, token):
+                with self._readback_lock:
+                    seq.append_token(token)  # G002 (no epoch check)
+
+            def one_arm(self, seq, token, fresh):
+                with self._readback_lock:
+                    if fresh:
+                        if seq.preempt_count != 0:
+                            return
+                        seq.append_token(token)   # dominated: ok
+                    else:
+                        seq.append_token(token)   # G002: path skips it
+        """),
+    )
+    result = _run(tmp_path, ["epoch-guard"])
+    by_rule = {}
+    for v in result.violations:
+        by_rule.setdefault(v.rule, []).append(v.symbol)
+    assert sorted(by_rule) == ["G001", "G002"]
+    assert by_rule["G001"] == ["Core.bare:append_token:lock"]
+    assert sorted(by_rule["G002"]) == [
+        "Core.bare:append_token:epoch",
+        "Core.locked_only:append_token:epoch",
+        "Core.one_arm:append_token:epoch",
+    ]
+
+
+def test_epoch_guard_stale_entry(tmp_path):
+    _write(
+        tmp_path,
+        "vgate_tpu/mod.py",
+        """
+        VGT_EPOCH_GUARDS = {
+            "append_tokne": {"lock": "_readback_lock",
+                             "epoch": "preempt_count"},
+        }
+
+        class Core:
+            def fold(self, seq):
+                with self._readback_lock:
+                    if seq.preempt_count != 0:
+                        return
+                    seq.append_token(1)
+        """,
+    )
+    result = _run(tmp_path, ["epoch-guard"])
+    assert "G003" in _rules(result)
+
+
+# ------------------------------------------- seeded historical mutations
+
+
+def _copy_real(tmp_path, *relpaths):
+    for rel in relpaths:
+        src = os.path.join(REPO_ROOT, rel)
+        with open(src) as fh:
+            _write(tmp_path, rel, fh.read())
+
+
+def test_seeded_pr11_double_refund_fires_r002(tmp_path):
+    """PR-11's review-round bug: the stale sweep discarded a ticket
+    (refund #1) and the settle hook refunded it again.  Replayed as a
+    single-path shape appended to a COPY of the real kv_swap.py: the
+    unmutated copy is clean, the mutation fires R002."""
+    _copy_real(tmp_path, "vgate_tpu/runtime/kv_swap.py")
+    clean = _run(tmp_path, ["obligations"])
+    assert clean.ok, [v.render() for v in clean.violations]
+
+    with open(
+        os.path.join(tmp_path, "vgate_tpu/runtime/kv_swap.py"), "a"
+    ) as fh:
+        fh.write(
+            "\n\ndef _seeded_sweep_then_settle(self, seq):\n"
+            "    entry = self._seq_tickets.pop(seq.seq_id, None)\n"
+            "    if entry is not None:\n"
+            "        self._count_discard(entry[1], 'settled')\n"
+            "        self._refund(entry[1].nbytes)\n"
+        )
+    mutated = _run(tmp_path, ["obligations"])
+    assert [v.rule for v in mutated.violations] == ["R002"]
+    v = mutated.violations[0]
+    assert "host-pool-bytes" in v.symbol
+    assert "_seeded_sweep_then_settle" in v.symbol
+
+
+def test_seeded_pr2_unsettled_future_fires_r001(tmp_path):
+    """PR-2's review-round bug: an exception arm in the batcher left
+    the request future unsettled (client hangs forever).  Appended to
+    a COPY of the real batcher.py."""
+    _copy_real(tmp_path, "vgate_tpu/batcher.py")
+    clean = _run(tmp_path, ["obligations"])
+    assert clean.ok, [v.render() for v in clean.violations]
+
+    with open(os.path.join(tmp_path, "vgate_tpu/batcher.py"), "a") as fh:
+        fh.write(
+            "\n\nasync def _seeded_exception_arm(self, prompt):\n"
+            "    fut = asyncio.get_running_loop().create_future()\n"
+            "    try:\n"
+            "        out = await self._run_batch_inference([prompt])\n"
+            "        fut.set_result(out)\n"
+            "    except Exception:\n"
+            "        logger.error('batch failed')\n"
+            "        return None\n"
+            "    return fut\n"
+        )
+    mutated = _run(tmp_path, ["obligations"])
+    rules = [v.rule for v in mutated.violations]
+    assert "R001" in rules
+    assert all(
+        "_seeded_exception_arm" in v.symbol for v in mutated.violations
+    )
+    leak = next(v for v in mutated.violations if v.rule == "R001")
+    assert "request-future" in leak.symbol
+
+
+def test_seeded_lock_inversion_fires_l001_and_cycle(tmp_path):
+    """A synthetic ``_topology_lock``-inside-``_structural_lock``
+    INVERSION seeded into a copy of the real dp_engine.py (inside the
+    class, so lock qualification matches the declared registry): the
+    undeclared reverse edge fires L001 and, unioned with the declared
+    structural->topology edge, a cycle fires L002."""
+    _copy_real(
+        tmp_path,
+        "vgate_tpu/runtime/dp_engine.py",
+        "vgate_tpu/analysis/lock_order.py",
+    )
+    clean = _run(tmp_path, ["lock-order"])
+    assert clean.ok, [v.render() for v in clean.violations]
+
+    path = os.path.join(tmp_path, "vgate_tpu/runtime/dp_engine.py")
+    with open(path) as fh:
+        src = fh.read()
+    anchor = "    def _pick_replica("
+    assert anchor in src
+    seeded = (
+        "    def _seeded_inversion(self):\n"
+        "        with self._topology_lock:\n"
+        "            with self._structural_lock:\n"
+        "                pass\n\n"
+    )
+    with open(path, "w") as fh:
+        fh.write(src.replace(anchor, seeded + anchor, 1))
+    mutated = _run(tmp_path, ["lock-order"])
+    rules = _rules(mutated)
+    assert rules == ["L001", "L002"], [
+        v.render() for v in mutated.violations
+    ]
+    l1 = next(v for v in mutated.violations if v.rule == "L001")
+    assert l1.symbol == (
+        "ReplicatedEngine._topology_lock->"
+        "ReplicatedEngine._structural_lock"
+    )
+    l2 = next(v for v in mutated.violations if v.rule == "L002")
+    assert "_structural_lock" in l2.symbol
+    assert "_topology_lock" in l2.symbol
+
+
+# ------------------------------------------------------------ repo truth
+
+
+def test_real_registries_are_declared():
+    """The contracts this PR applies to the runtime stay declared —
+    deleting a registry would silently disable its checker."""
+    import vgate_tpu.analysis.lock_order as lo
+    from vgate_tpu.runtime import dp_engine, kv_swap
+    from vgate_tpu import batcher
+    from vgate_tpu.server import app
+    import vgate_tpu.runtime.engine_core as ec
+
+    assert lo.declared_edges()  # at least the dp edges
+    assert dp_engine.VGT_LOCK_WRAPPERS == {
+        "_structural": "_structural_lock"
+    }
+    assert "host-pool-bytes" in kv_swap.VGT_OBLIGATIONS
+    assert "admission-backlog" in batcher.VGT_OBLIGATIONS
+    assert "request-future" in batcher.VGT_OBLIGATIONS
+    assert "inflight-slot" in app.VGT_OBLIGATIONS
+    assert "append_token" in ec.VGT_EPOCH_GUARDS
+
+
+def test_github_format_output(tmp_path, capsys):
+    import importlib.util
+
+    _write(
+        tmp_path,
+        "vgate_tpu/server/h.py",
+        "import time\n\nasync def a(r):\n    time.sleep(1)\n",
+    )
+    spec = importlib.util.spec_from_file_location(
+        "vgt_lint_cli_gh",
+        os.path.join(REPO_ROOT, "scripts", "vgt_lint.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # the CLI pins its project root to the real repo; drive the
+    # formatter through runner results instead
+    by_name = checkers_by_name()
+    result = lint_runner.run(
+        str(tmp_path), [by_name["async-blocking"]]
+    )
+    assert not result.ok
+    # reuse the CLI's formatting contract by emulating one line
+    v = result.violations[0]
+    line = (
+        f"::error file={v.path},line={max(1, v.line)},"
+        f"title=vgt-lint {v.checker}/{v.rule}::{v.message}"
+    )
+    assert line.startswith("::error file=vgate_tpu/server/h.py,line=4")
+    assert "async-blocking/A001" in line

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 from vgate_tpu import metrics
 from vgate_tpu.errors import ClientQuotaExceededError, ServerOverloadedError
 from vgate_tpu.logging_config import get_logger
+from vgate_tpu.analysis.witness import named_lock
 
 logger = get_logger(__name__)
 
@@ -149,7 +150,7 @@ class AdmissionController:
         self.cfg = cfg
         self._signals = signals or (lambda: {})
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("AdmissionController._lock")
         self._queued_tokens = 0
         self._queued_requests = 0
         self._inflight_by_key: Dict[str, int] = {}
@@ -625,7 +626,7 @@ class PressureController:
         self._signals = signals or (lambda: {})
         self.on_transition = on_transition
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("PressureController._lock")
         self.level = 0
         self.score = 0.0
         self._last_update = 0.0

@@ -8,8 +8,20 @@ Layout:
   registry conventions (``VGT_LOCK_GUARDS``, ``VGT_COMPONENTS``) that
   runtime code uses to DECLARE its threading contract.  Import-cheap:
   runtime modules import it on every startup.
+* :mod:`vgate_tpu.analysis.lock_order` — THE declared lock-acquisition
+  order (``VGT_LOCK_ORDER``/``VGT_LOCK_ALIASES``; single definition
+  site, D006).  Pure data; both the static checker and the runtime
+  witness read it.
+* :mod:`vgate_tpu.analysis.witness` — the runtime lock witness:
+  ``named_lock(...)`` builds plain locks when ``VGT_LOCK_WITNESS`` is
+  unset and chain-recording wrappers when armed.  Import-cheap like
+  annotations: runtime modules import it on every startup.
 * :mod:`vgate_tpu.analysis.core` — the shared violation / suppression /
   baseline model and the project file index.
+* :mod:`vgate_tpu.analysis.cfg` / :mod:`vgate_tpu.analysis.dataflow` —
+  the v2 flow-sensitive substrate: per-function CFGs (exception
+  edges, finally routing, loop back edges) and the worklist fixpoint
+  solver the lock-order / obligations / epoch-guard checkers run on.
 * :mod:`vgate_tpu.analysis.checkers` — the checker implementations;
   imported only by the lint runner, never by serving code.
 * :mod:`vgate_tpu.analysis.runner` — walks the repo, runs checkers,

@@ -1,0 +1,398 @@
+"""Intraprocedural control-flow graphs over stdlib ``ast`` — the
+flow-sensitive substrate for the L/R/G checkers (lock-order,
+obligations, epoch-guard).
+
+One :class:`Node` per *statement* (compound statements contribute a
+header node — the ``if``/``while`` test, the ``for`` iterable, the
+``with`` items, the ``try`` entry — plus nodes for their nested
+statements).  Three synthetic nodes frame every function: ``entry``,
+``exit`` (normal return / fall-off-the-end) and ``raise_exit`` (an
+exception escaping the function).  Edges carry a kind:
+
+* ``normal`` — sequential flow, branch arms, loop entry/exit.
+* ``exc`` — the statement raised: to the innermost handler dispatch,
+  the enclosing ``finally``, or ``raise_exit``.  Only statements that
+  can plausibly raise get one: anything containing a call, an explicit
+  ``raise``, an ``assert``, or a ``with`` header.  Plain assignments /
+  attribute stores are treated as non-raising — the checkers trade
+  that sliver of soundness for a usable signal-to-noise ratio.
+* ``back`` — a loop back edge (body end -> header), tagged so tests
+  and future widening can see it; dataflow treats it as normal flow.
+
+``try/except/else/finally`` modelling:
+
+* every raising statement in the try body edges to a synthetic
+  handler-dispatch node fanning out to each ``except`` entry;
+* when no handler is *broad* (bare ``except`` / ``except Exception`` /
+  ``except BaseException``), the dispatch also escapes to the
+  enclosing context — a narrow handler set does not swallow arbitrary
+  exceptions;
+* a ``raise`` inside an ``except`` body flows to the ENCLOSING
+  context (or the ``finally``), never back into the sibling handlers;
+* ``finally`` bodies are built once; their exits fan out to every
+  continuation that can actually route through them (normal fall-
+  through, a ``return`` heading for ``exit``, an exception heading
+  out).  This merges those paths through the finally — a deliberate,
+  documented over-approximation (may-analyses stay sound for "exists
+  a path"; must-analyses stay conservative).
+
+``return`` routes through enclosing ``finally`` blocks to ``exit``;
+``break``/``continue`` go straight to their loop targets (finally
+interplay with loop control is not modelled — the runtime code this
+lints does not use it).
+
+Nested ``def``/``lambda`` bodies are NOT inlined: each function gets
+its own CFG; a closure's deferred body must not look like inline flow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = ["Node", "CFG", "build_cfg"]
+
+NORMAL = "normal"
+EXC = "exc"
+BACK = "back"
+
+_BROAD_HANDLER_NAMES = {"Exception", "BaseException"}
+
+
+class Node:
+    """One CFG node.  ``stmt`` is the underlying ast statement for
+    ``kind == "stmt"`` nodes, None for synthetic ones."""
+
+    __slots__ = ("kind", "stmt", "label", "succs", "idx")
+
+    def __init__(
+        self,
+        kind: str,
+        stmt: Optional[ast.stmt] = None,
+        label: str = "",
+    ) -> None:
+        self.kind = kind  # entry | exit | raise_exit | stmt | join
+        self.stmt = stmt
+        self.label = label
+        self.succs: List[Tuple["Node", str]] = []
+        self.idx = -1  # assigned by CFG for stable ordering
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+    def add(self, succ: "Node", kind: str = NORMAL) -> None:
+        edge = (succ, kind)
+        if edge not in self.succs:
+            self.succs.append(edge)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        what = self.label or type(self.stmt).__name__ if self.stmt else ""
+        return f"<Node {self.idx} {self.kind} {what} L{self.line}>"
+
+
+class CFG:
+    def __init__(self, func: ast.AST) -> None:
+        self.func = func
+        self.entry = Node("entry", label="entry")
+        self.exit = Node("exit", label="exit")
+        self.raise_exit = Node("raise_exit", label="raise")
+        self.nodes: List[Node] = [self.entry, self.exit, self.raise_exit]
+
+    def new(self, stmt: Optional[ast.stmt], label: str = "") -> Node:
+        node = Node("stmt" if stmt is not None else "join", stmt, label)
+        self.nodes.append(node)
+        return node
+
+    def finalize(self) -> "CFG":
+        for i, n in enumerate(self.nodes):
+            n.idx = i
+        return self
+
+    def preds(self) -> dict:
+        out: dict = {n: [] for n in self.nodes}
+        for n in self.nodes:
+            for succ, kind in n.succs:
+                out[succ].append((n, kind))
+        return out
+
+    def back_edges(self) -> List[Tuple[Node, Node]]:
+        return [
+            (n, succ)
+            for n in self.nodes
+            for succ, kind in n.succs
+            if kind == BACK
+        ]
+
+
+def _can_raise(stmt: ast.stmt) -> bool:
+    """Whether this statement gets an exception edge (see module
+    docstring: calls, raise, assert, with headers)."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    for sub in ast.walk(stmt):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # a nested def's body is deferred; its calls don't raise HERE.
+            # (walk still descends; good enough: we only check the
+            # header-level nodes of compound stmts, see _header_only)
+            continue
+        if isinstance(sub, ast.Call):
+            return True
+    return False
+
+
+def _header_can_raise(stmt: ast.stmt) -> bool:
+    """For compound statements, only the header expressions execute at
+    the header node — nested statements get their own nodes."""
+    if isinstance(stmt, ast.If):
+        exprs: Sequence[ast.AST] = [stmt.test]
+    elif isinstance(stmt, ast.While):
+        exprs = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        exprs = [stmt.iter, stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return True  # __enter__ runs here
+    else:
+        return _can_raise(stmt)
+    for e in exprs:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Lambda):
+                continue
+            if isinstance(sub, ast.Call):
+                return True
+    return False
+
+
+class _Ctx:
+    """Where control transfers out of the current statement go."""
+
+    __slots__ = ("exc", "loop_head", "loop_after", "finallies")
+
+    def __init__(self, exc, loop_head, loop_after, finallies):
+        self.exc = exc  # Node exceptions flow to
+        self.loop_head = loop_head
+        self.loop_after = loop_after
+        # stack of _FinallyInfo a return must thread through
+        self.finallies = finallies
+
+    def replace(self, **kw) -> "_Ctx":
+        new = _Ctx(self.exc, self.loop_head, self.loop_after, self.finallies)
+        for k, v in kw.items():
+            setattr(new, k, v)
+        return new
+
+
+class _FinallyInfo:
+    __slots__ = ("entry", "exits", "continuations")
+
+    def __init__(self, entry: Node, exits: List[Node]):
+        self.entry = entry
+        self.exits = exits
+        self.continuations: List[Node] = []
+
+    def route(self, target: Node) -> Node:
+        """Route a control transfer through this finally toward
+        ``target``; returns the node the transfer should edge to (the
+        finally entry), wiring the finally exits to the target."""
+        if target not in self.continuations:
+            self.continuations.append(target)
+            for e in self.exits:
+                e.add(target)
+        return self.entry
+
+
+def _through_finallies(
+    finallies: List[_FinallyInfo], target: Node
+) -> Node:
+    """Thread a non-local transfer (return / escaping raise) through
+    the enclosing finally blocks, innermost first."""
+    for info in reversed(finallies):
+        target = info.route(target)
+    return target
+
+
+class _Builder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+
+    def seq(
+        self, stmts: Sequence[ast.stmt], frontier: List[Node], ctx: _Ctx
+    ) -> List[Node]:
+        """Wire ``stmts`` after every node in ``frontier``; return the
+        new frontier (nodes whose normal exit continues past the
+        list).  An empty frontier means the code is unreachable — we
+        still build nodes (checkers may anchor on them) but nothing
+        links in."""
+        for stmt in stmts:
+            frontier = self.stmt(stmt, frontier, ctx)
+        return frontier
+
+    def _link(self, frontier: List[Node], node: Node, kind: str = NORMAL):
+        for f in frontier:
+            f.add(node, kind)
+
+    def stmt(
+        self, stmt: ast.stmt, frontier: List[Node], ctx: _Ctx
+    ) -> List[Node]:
+        cfg = self.cfg
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            # a def/class statement executes (binds a name) but its body
+            # does not; treat as a plain non-raising statement node
+            node = cfg.new(stmt, label=f"def {stmt.name}")
+            self._link(frontier, node)
+            return [node]
+
+        if isinstance(stmt, ast.If):
+            node = cfg.new(stmt, label="if")
+            self._link(frontier, node)
+            if _header_can_raise(stmt):
+                node.add(ctx.exc, EXC)
+            body_out = self.seq(stmt.body, [node], ctx)
+            else_out = self.seq(stmt.orelse, [node], ctx) if stmt.orelse else [node]
+            return body_out + else_out
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = cfg.new(stmt, label="loop")
+            self._link(frontier, head)
+            if _header_can_raise(stmt):
+                head.add(ctx.exc, EXC)
+            breaks: List[Node] = []  # break stmts append themselves
+            loop_ctx = ctx.replace(loop_head=head, loop_after=breaks)
+            body_out = self.seq(stmt.body, [head], loop_ctx)
+            for n in body_out:
+                n.add(head, BACK)
+            # orelse runs on normal exhaustion only; breaks skip it
+            tail = (
+                self.seq(stmt.orelse, [head], ctx)
+                if stmt.orelse
+                else [head]
+            )
+            return tail + breaks
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            node = cfg.new(stmt, label="with")
+            self._link(frontier, node)
+            node.add(ctx.exc, EXC)  # __enter__ may raise
+            return self.seq(stmt.body, [node], ctx)
+
+        if isinstance(stmt, ast.Try):
+            return self.try_stmt(stmt, frontier, ctx)
+
+        if isinstance(stmt, ast.Return):
+            node = cfg.new(stmt, label="return")
+            self._link(frontier, node)
+            if _can_raise(stmt):
+                node.add(ctx.exc, EXC)
+            node.add(_through_finallies(ctx.finallies, cfg.exit))
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            node = cfg.new(stmt, label="raise")
+            self._link(frontier, node)
+            node.add(ctx.exc, EXC)
+            return []
+
+        if isinstance(stmt, ast.Break):
+            node = cfg.new(stmt, label="break")
+            self._link(frontier, node)
+            if ctx.loop_after is not None:
+                ctx.loop_after.append(node)
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            node = cfg.new(stmt, label="continue")
+            self._link(frontier, node)
+            if ctx.loop_head is not None:
+                node.add(ctx.loop_head, BACK)
+            return []
+
+        # plain statement (assign, expr, assert, pass, del, global, ...)
+        node = cfg.new(stmt)
+        self._link(frontier, node)
+        if _can_raise(stmt):
+            node.add(ctx.exc, EXC)
+        return [node]
+
+    def try_stmt(
+        self, stmt: ast.Try, frontier: List[Node], ctx: _Ctx
+    ) -> List[Node]:
+        cfg = self.cfg
+        entry = cfg.new(None, label="try")
+        entry.stmt = stmt  # anchor for line numbers
+        entry.kind = "stmt"
+        self._link(frontier, entry)
+
+        # finally body (built once; exits fan to used continuations)
+        fin: Optional[_FinallyInfo] = None
+        if stmt.finalbody:
+            fentry = cfg.new(None, label="finally")
+            fexits = self.seq(
+                stmt.finalbody, [fentry], ctx
+            )
+            fin = _FinallyInfo(fentry, fexits)
+
+        # where exceptions ESCAPING this try (uncaught / raised in a
+        # handler) go: through the finally, then the outer context
+        outer_exc = ctx.exc
+        if fin is not None:
+            escape = fin.route(outer_exc)
+        else:
+            escape = outer_exc
+
+        # handler dispatch: raising try-body statements edge here
+        broad = any(
+            h.type is None
+            or (
+                isinstance(h.type, ast.Name)
+                and h.type.id in _BROAD_HANDLER_NAMES
+            )
+            for h in stmt.handlers
+        )
+        if stmt.handlers:
+            dispatch = cfg.new(None, label="except-dispatch")
+            if not broad:
+                dispatch.add(escape, EXC)
+        else:
+            dispatch = escape
+
+        body_ctx = ctx.replace(
+            exc=dispatch,
+            finallies=ctx.finallies + ([fin] if fin else []),
+        )
+        body_out = self.seq(stmt.body, [entry], body_ctx)
+        if stmt.orelse:
+            body_out = self.seq(stmt.orelse, body_out, body_ctx)
+
+        handler_ctx = ctx.replace(
+            exc=escape,
+            finallies=ctx.finallies + ([fin] if fin else []),
+        )
+        handler_out: List[Node] = []
+        for h in stmt.handlers:
+            hnode = cfg.new(h, label="except")  # type: ignore[arg-type]
+            dispatch.add(hnode, EXC)
+            handler_out.extend(self.seq(h.body, [hnode], handler_ctx))
+
+        after = body_out + handler_out
+        if fin is not None and after:
+            # normal completion routes through the finally
+            for n in after:
+                n.add(fin.entry)
+            return list(fin.exits)
+        if fin is not None:
+            # try/handlers never complete normally; the finally still
+            # exists on the exceptional route (already wired)
+            return []
+        return after
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef body."""
+    cfg = CFG(fn)
+    ctx = _Ctx(cfg.raise_exit, None, None, [])
+    out = _Builder(cfg).seq(
+        getattr(fn, "body", []), [cfg.entry], ctx
+    )
+    for n in out:
+        n.add(cfg.exit)
+    return cfg.finalize()

@@ -15,17 +15,25 @@ def all_checkers() -> List[Checker]:
         AsyncBlockingChecker,
     )
     from vgate_tpu.analysis.checkers.drift import DefinitionDriftChecker
+    from vgate_tpu.analysis.checkers.epoch_guard import EpochGuardChecker
     from vgate_tpu.analysis.checkers.error_taxonomy import (
         ErrorTaxonomyChecker,
     )
     from vgate_tpu.analysis.checkers.jit_purity import JitPurityChecker
+    from vgate_tpu.analysis.checkers.lock_order import LockOrderChecker
     from vgate_tpu.analysis.checkers.metrics import MetricsChecker
+    from vgate_tpu.analysis.checkers.obligations import (
+        ObligationsChecker,
+    )
     from vgate_tpu.analysis.checkers.threads import (
         ThreadDisciplineChecker,
     )
 
     return [
         ThreadDisciplineChecker(),
+        LockOrderChecker(),
+        ObligationsChecker(),
+        EpochGuardChecker(),
         JitPurityChecker(),
         ErrorTaxonomyChecker(),
         DefinitionDriftChecker(),

@@ -19,6 +19,11 @@ agree; known single-definition-site registries must stay single.
 * **D005** — drill scripts must take their ports from the
   ``VGT_DRILL_PORTS`` registry in scripts/_drill_lib.sh; a literal
   ``873x`` port in any other script is the foot-gun PR 6 removed.
+* **D006** — ``VGT_LOCK_ORDER`` / ``VGT_LOCK_ALIASES`` (the lock-
+  acquisition order contract) are assigned only in
+  vgate_tpu/analysis/lock_order.py; the lock-order checker and the
+  runtime witness both read that one site, so a second copy would
+  let them disagree about which orders are legal.
 """
 
 from __future__ import annotations
@@ -35,6 +40,8 @@ _CONFIG_YAML = "config.yaml"
 _TIER_SET = {"interactive", "standard", "batch"}
 _TIERS_HOME = "vgate_tpu/admission.py"
 _PEAKS_HOME = "vgate_tpu/observability/roofline.py"
+_LOCK_ORDER_HOME = "vgate_tpu/analysis/lock_order.py"
+_LOCK_ORDER_NAMES = {"VGT_LOCK_ORDER", "VGT_LOCK_ALIASES"}
 _PORT_RE = re.compile(r"\b873[0-9]\b")
 
 # container annotations whose yaml value is free-form (operator-keyed
@@ -327,6 +334,38 @@ class DefinitionDriftChecker(Checker):
                                     symbol=(
                                         f"{ctx.relpath}:DEVICE_PEAKS"
                                     ),
+                                )
+                            )
+            if ctx.relpath != _LOCK_ORDER_HOME:
+                for node in getattr(tree, "body", []):
+                    names = []
+                    if isinstance(node, ast.Assign):
+                        names = [
+                            (t.id, node.lineno)
+                            for t in node.targets
+                            if isinstance(t, ast.Name)
+                        ]
+                    elif isinstance(
+                        node, ast.AnnAssign
+                    ) and isinstance(node.target, ast.Name):
+                        names = [(node.target.id, node.lineno)]
+                    for name, line in names:
+                        if name in _LOCK_ORDER_NAMES:
+                            out.append(
+                                Violation(
+                                    checker=self.name,
+                                    path=ctx.relpath,
+                                    line=line,
+                                    rule="D006",
+                                    message=(
+                                        f"{name} assigned outside "
+                                        "analysis/lock_order.py — "
+                                        "the lock-order checker and "
+                                        "the runtime witness must "
+                                        "read ONE registry (import "
+                                        "it instead)"
+                                    ),
+                                    symbol=f"{ctx.relpath}:{name}",
                                 )
                             )
         for ctx in project.files("scripts/*.sh"):

@@ -1,0 +1,295 @@
+"""epoch-guard — readback-side sequence mutation must happen under its
+publication lock AND be dominated by a staleness-epoch comparison.
+
+The recurring PR-5/8/11 bug shape: an engine thread wakes from a
+blocking device call after a watchdog containment already folded its
+sequences — appending the late tokens corrupts the replay (which may
+already be RUNNING on the rebuilt core).  The defense, re-verified by
+hand every PR until now, is always the same two-part guard::
+
+    with self._readback_lock:
+        for seq, epoch in seqs:
+            if seq.status is not RUNNING or seq.preempt_count != epoch:
+                continue          # stale wake — discard
+            seq.append_token(token)
+
+Modules declare which mutators need the guard::
+
+    VGT_EPOCH_GUARDS = {
+        "append_token": {"lock": "_readback_lock",
+                         "epoch": "preempt_count"},
+    }
+
+Rules:
+
+* **G001** — a registered mutator called without the declared lock
+  lexically held (``with self.<lock>:``, ``@requires_lock``, or the
+  bounded ``.acquire(timeout=)`` idiom — the T002 holding rules).
+* **G002** — a registered mutator call not *dominated* by an epoch
+  comparison: on some CFG path from function entry to the call, no
+  comparison mentioning the declared epoch attribute (``==``, ``!=``,
+  ``is``, ``is not``) executes first.  Dominance is a must-dataflow
+  over the CFG — branch structure, loops and exception edges all
+  count, which is exactly what "checked it somewhere above" by eye
+  gets wrong.
+* **G003** — a registry entry naming a mutator the module never calls,
+  or a lock/epoch attribute it never accesses (stale entry = silently
+  unenforced; the T004 discipline).
+
+``__init__`` is exempt (construction precedes sharing), as are
+functions annotated ``@engine_thread_root`` when the root is a
+documented single-threaded phase — warmup appends before the engine
+thread exists cannot race a containment fold.  (No such site exists
+today; the exemption is declared so the next one is a decision, not
+an accident.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from vgate_tpu.analysis import _astutil as A
+from vgate_tpu.analysis.cfg import Node, build_cfg
+from vgate_tpu.analysis.core import Checker, Project, Violation
+from vgate_tpu.analysis.dataflow import forward
+from vgate_tpu.analysis.checkers.obligations import (
+    _own_exprs,
+    _walk_pruned,
+)
+
+_SCOPE = ("vgate_tpu/**/*.py",)
+_CMP_OPS = (ast.Eq, ast.NotEq, ast.Is, ast.IsNot)
+
+
+def _parse_registry(
+    tree: ast.AST,
+) -> Tuple[Dict[str, Dict[str, str]], int]:
+    node = A.module_assign_value(tree, "VGT_EPOCH_GUARDS")
+    out: Dict[str, Dict[str, str]] = {}
+    if not isinstance(node, ast.Dict):
+        return out, 1
+    for k, v in zip(node.keys, node.values):
+        mname = A.str_const(k)
+        spec = A.dict_of_str(v) if isinstance(v, ast.Dict) else None
+        if mname and spec and "lock" in spec and "epoch" in spec:
+            out[mname] = spec
+    return out, getattr(node, "lineno", 1)
+
+
+def _mentions_epoch_compare(exprs, epoch_attr: str) -> bool:
+    for sub in _walk_pruned(exprs):
+        if isinstance(sub, ast.Compare) and any(
+            isinstance(op, _CMP_OPS) for op in sub.ops
+        ):
+            for part in [sub.left] + list(sub.comparators):
+                for leaf in ast.walk(part):
+                    if (
+                        isinstance(leaf, ast.Attribute)
+                        and leaf.attr == epoch_attr
+                    ):
+                        return True
+    return False
+
+
+def _mutator_calls(node: Node, mutators) -> List[Tuple[str, int]]:
+    out = []
+    for sub in _walk_pruned(_own_exprs(node)):
+        if isinstance(sub, ast.Call) and isinstance(
+            sub.func, ast.Attribute
+        ):
+            if sub.func.attr in mutators:
+                out.append((sub.func.attr, sub.lineno))
+    return out
+
+
+def _held_locks_at(
+    fn: ast.AST, target_line: int
+) -> set:
+    """Locks lexically held at ``target_line`` inside ``fn``: with-
+    blocks covering the line, plus requires_lock annotations and the
+    bounded-acquire idiom anywhere in the function (the T002 rules)."""
+    held = set()
+    for dec in getattr(fn, "decorator_list", []):
+        if A.dec_last_name(dec) == "requires_lock" and isinstance(
+            dec, ast.Call
+        ):
+            for arg in dec.args:
+                val = A.str_const(arg)
+                if val:
+                    held.add(val)
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            end = getattr(sub, "end_lineno", sub.lineno)
+            if sub.lineno <= target_line <= end:
+                for item in sub.items:
+                    chain = A.attr_chain(item.context_expr)
+                    if chain and len(chain) == 2 and chain[0] == "self":
+                        held.add(chain[1])
+        elif (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "acquire"
+        ):
+            chain = A.attr_chain(sub.func.value)
+            if chain:
+                held.add(chain[-1])
+    return held
+
+
+class EpochGuardChecker(Checker):
+    name = "epoch-guard"
+    description = (
+        "readback-side mutators run under their publication lock and "
+        "dominated by a staleness-epoch comparison "
+        "(VGT_EPOCH_GUARDS registries)"
+    )
+    scope = _SCOPE
+
+    def run(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for ctx in project.files(*_SCOPE):
+            tree = ctx.tree
+            if tree is None:
+                continue
+            registry, reg_line = _parse_registry(tree)
+            if not registry:
+                continue
+            self._check_module(ctx, tree, registry, reg_line, out)
+        return out
+
+    def _check_module(self, ctx, tree, registry, reg_line, out):
+        attr_names = {
+            n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)
+        }
+        called: set = set()
+        for fn, qual in _functions(tree):
+            if fn.name == "__init__":
+                continue
+            root_exempt = any(
+                A.dec_last_name(d) == "engine_thread_root"
+                and _single_threaded_root(fn)
+                for d in getattr(fn, "decorator_list", [])
+            )
+            cfg = build_cfg(fn)
+            per_node = {
+                node: _mutator_calls(node, registry)
+                for node in cfg.nodes
+            }
+            if not any(per_node.values()):
+                continue
+            for calls in per_node.values():
+                for mname, _ in calls:
+                    called.add(mname)
+            if root_exempt:
+                continue
+            # one must-dominance solve per distinct epoch attribute
+            epochs = {
+                spec["epoch"]
+                for mname, spec in registry.items()
+                if any(
+                    m == mname
+                    for calls in per_node.values()
+                    for m, _ in calls
+                )
+            }
+            dominated: Dict[str, Dict[Node, bool]] = {}
+            for epoch_attr in epochs:
+                def transfer(node, fact, kind, _e=epoch_attr):
+                    if _mentions_epoch_compare(_own_exprs(node), _e):
+                        return True
+                    return fact
+
+                dominated[epoch_attr] = forward(
+                    cfg, False, transfer, lambda a, b: a and b
+                )
+            for node, calls in per_node.items():
+                for mname, line in calls:
+                    spec = registry[mname]
+                    held = _held_locks_at(fn, line)
+                    if spec["lock"] not in held:
+                        out.append(
+                            Violation(
+                                checker=self.name,
+                                path=ctx.relpath,
+                                line=line,
+                                rule="G001",
+                                message=(
+                                    f"readback mutator .{mname}() "
+                                    f"called in {qual!r} without "
+                                    f"holding {spec['lock']!r} "
+                                    "(declared in VGT_EPOCH_GUARDS) "
+                                    "— a containment fold can "
+                                    "interleave with this mutation"
+                                ),
+                                symbol=f"{qual}:{mname}:lock",
+                            )
+                        )
+                    in_fact = dominated[spec["epoch"]].get(node)
+                    if in_fact is not True:
+                        out.append(
+                            Violation(
+                                checker=self.name,
+                                path=ctx.relpath,
+                                line=line,
+                                rule="G002",
+                                message=(
+                                    f"readback mutator .{mname}() "
+                                    f"in {qual!r} is not dominated "
+                                    "by a staleness comparison on "
+                                    f"{spec['epoch']!r} — a path "
+                                    "reaches this mutation without "
+                                    "re-checking the epoch, so a "
+                                    "stale wake can publish dead-"
+                                    "epoch state"
+                                ),
+                                symbol=f"{qual}:{mname}:epoch",
+                            )
+                        )
+        # G003: stale registry entries
+        for mname, spec in sorted(registry.items()):
+            problems = []
+            if mname not in called and mname not in attr_names:
+                problems.append(
+                    f"mutator {mname!r} is never called"
+                )
+            for role in ("lock", "epoch"):
+                if spec[role] not in attr_names:
+                    problems.append(
+                        f"{role} {spec[role]!r} is never accessed"
+                    )
+            for why in problems:
+                out.append(
+                    Violation(
+                        checker=self.name,
+                        path=ctx.relpath,
+                        line=reg_line,
+                        rule="G003",
+                        message=(
+                            f"VGT_EPOCH_GUARDS entry {mname!r}: {why} "
+                            "in this module (typo or stale rename — "
+                            "the guard is silently unenforced)"
+                        ),
+                        symbol=f"VGT_EPOCH_GUARDS.{mname}",
+                    )
+                )
+
+
+def _single_threaded_root(fn: ast.AST) -> bool:
+    """An @engine_thread_root qualifies for the epoch exemption only
+    when its docstring declares the single-threaded phase — the loop
+    body itself is emphatically NOT exempt."""
+    doc = ast.get_docstring(fn) or ""
+    return "single-threaded" in doc
+
+
+def _functions(tree: ast.AST):
+    for node in getattr(tree, "body", []):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.name
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield item, f"{node.name}.{item.name}"

@@ -1,0 +1,543 @@
+"""lock-order — derive the static lock-acquisition graph and check it
+against the declared ``VGT_LOCK_ORDER`` registry
+(vgate_tpu/analysis/lock_order.py, the single definition site).
+
+The repo holds ~10 interacting locks whose nesting order was, until
+this checker, enforced by reviewer memory.  A deadlock needs two
+threads acquiring two locks in opposite orders — so the static
+invariant is: every *acquired-while-holding* pair must be declared,
+and the declared graph must be acyclic.
+
+Rules:
+
+* **L001** — an acquisition edge observed in the AST (lock B acquired
+  while lock A is held, same thread, possibly through resolvable
+  calls) that ``VGT_LOCK_ORDER`` does not declare.  Declare it (with a
+  rationale) or restructure the code.
+* **L002** — the union of declared and observed edges contains a
+  cycle: a potential deadlock by construction, never acceptable.
+* **L003** — a registry entry (order edge or alias) naming a lock
+  ``Class.attr`` that no module defines — a typo or a stale rename
+  would silently stop enforcing that edge.
+* **L004** — a ``VGT_LOCK_WRAPPERS`` entry naming a decorator or lock
+  the module never defines/accesses (same silent-disable hazard as
+  T004).
+
+What counts as *holding*: a lexical ``with self.<x>:`` block (``x``
+ending in ``lock``), the bounded ``self.<x>.acquire(timeout=...)``
+fail-open idiom (held for the remainder of the function),
+``@requires_lock("<x>")`` (held on entry), and a decorator declared in
+the module's ``VGT_LOCK_WRAPPERS`` registry (``{"_structural":
+"_structural_lock"}`` — the decorator body acquires the lock around
+the wrapped call, which plain name resolution cannot see).
+
+What counts as *acquiring*: the same events, resolved transitively
+through calls — ``self.m()`` within the class, ``self.attr.m()`` via
+``VGT_COMPONENTS``, bare ``f()`` to module functions (same module
+first, then a package-wide function index).  Lock identity is
+``ClassName.attr``; ``VGT_LOCK_ALIASES`` canonicalizes locks that are
+one runtime object (the swap manager's guard IS the engine readback
+lock).  Unresolvable calls (locals, list elements, dynamic dispatch)
+are invisible here — the runtime lock witness
+(vgate_tpu/analysis/witness.py) closes that gap during drills.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from vgate_tpu.analysis import _astutil as A
+from vgate_tpu.analysis.core import Checker, Project, Violation
+
+_SCOPE = ("vgate_tpu/**/*.py",)
+_REGISTRY_PATH = "vgate_tpu/analysis/lock_order.py"
+
+
+def _is_lock_attr(name: str) -> bool:
+    return name.endswith("lock")
+
+
+@dataclass
+class _FnRecord:
+    qualname: str  # "Class.method" or "function"
+    cls: Optional[str]
+    relpath: str
+    # locks held on entry (qualified)
+    entry_held: Set[str] = field(default_factory=set)
+    # (lock, line, frozenset(held-at-that-point)) acquisition events
+    acquires: List[Tuple[str, int, frozenset]] = field(
+        default_factory=list
+    )
+    # (callee_key, line, frozenset(held)) resolvable call sites
+    calls: List[Tuple[str, int, frozenset]] = field(default_factory=list)
+
+
+@dataclass
+class _Mod:
+    relpath: str
+    components: Dict[str, str] = field(default_factory=dict)
+    wrappers: Dict[str, str] = field(default_factory=dict)
+    wrappers_line: int = 1
+    attr_names: Set[str] = field(default_factory=set)
+    # class -> set of lock attrs it ever acquires/constructs
+    classes: Dict[str, Set[str]] = field(default_factory=dict)
+    decorator_names: Set[str] = field(default_factory=set)
+
+
+def _fn_key(cls: Optional[str], name: str, relpath: str) -> str:
+    return f"{cls}.{name}" if cls else f"{relpath}:{name}"
+
+
+class _FnWalker:
+    """Linear lexical walk of one function body: scoped ``with`` holds,
+    function-scope-permanent bounded acquires, call recording."""
+
+    def __init__(
+        self,
+        rec: _FnRecord,
+        mod: _Mod,
+        aliases: Dict[str, str],
+    ) -> None:
+        self.rec = rec
+        self.mod = mod
+        self.aliases = aliases
+
+    def _qual(self, lock_attr: str) -> str:
+        name = (
+            f"{self.rec.cls}.{lock_attr}"
+            if self.rec.cls
+            else f"{self.rec.relpath}:{lock_attr}"
+        )
+        return self.aliases.get(name, name)
+
+    def walk(self, fn: ast.AST) -> None:
+        self._stmts(getattr(fn, "body", []), set(self.rec.entry_held))
+
+    def _stmts(self, stmts: Sequence[ast.stmt], held: Set[str]) -> None:
+        # ``held`` is mutated in place by permanent (bounded-acquire)
+        # events so later siblings see them; ``with`` scopes copy.
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: Set[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are deferred; not inline flow
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            added: Set[str] = set()
+            for item in stmt.items:
+                chain = A.attr_chain(item.context_expr)
+                if (
+                    chain
+                    and len(chain) == 2
+                    and chain[0] == "self"
+                    and _is_lock_attr(chain[1])
+                ):
+                    lock = self._qual(chain[1])
+                    self._acquire(lock, stmt.lineno, held | added)
+                    added.add(lock)
+                else:
+                    self._exprs([item.context_expr], held)
+            self._stmts(stmt.body, set(held) | added)
+            return
+        # header expressions / plain statement expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                continue
+            if isinstance(child, ast.ExceptHandler):
+                continue
+            self._exprs([child], held)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, held)
+            elif isinstance(child, ast.ExceptHandler):
+                self._stmts(child.body, held)
+
+    def _exprs(self, exprs: Sequence[ast.AST], held: Set[str]) -> None:
+        # manual walk pruning nested def/lambda bodies (deferred
+        # execution must not look like an under-lock call)
+        stack = list(exprs)
+        while stack:
+            sub = stack.pop()
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(sub, ast.Call):
+                self._call(sub, held)
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _call(self, call: ast.Call, held: Set[str]) -> None:
+        chain = A.attr_chain(call.func)
+        if not chain:
+            return
+        # bounded-acquire idiom: self.<lock>.acquire(...) — held for
+        # the remainder of the function (the fail-open pattern releases
+        # in a finally; lexical scoping of that is not worth modelling)
+        if (
+            chain[-1] == "acquire"
+            and len(chain) == 3
+            and chain[0] == "self"
+            and _is_lock_attr(chain[1])
+        ):
+            lock = self._qual(chain[1])
+            self._acquire(lock, call.lineno, frozenset(held))
+            held.add(lock)
+            return
+        key = self._resolve(chain)
+        if key is not None:
+            self.rec.calls.append((key, call.lineno, frozenset(held)))
+
+    def _resolve(self, chain: List[str]) -> Optional[str]:
+        if len(chain) == 1:
+            return f"name:{chain[0]}"  # module fn, resolved globally
+        if chain[0] != "self":
+            return None
+        if len(chain) == 2 and self.rec.cls:
+            return f"{self.rec.cls}.{chain[1]}"
+        if len(chain) == 3:
+            target = self.mod.components.get(chain[1])
+            if target:
+                return f"{target}.{chain[2]}"
+        return None
+
+    def _acquire(self, lock: str, line: int, held) -> None:
+        self.rec.acquires.append((lock, line, frozenset(held)))
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+    description = (
+        "static lock-acquisition graph vs the declared VGT_LOCK_ORDER "
+        "registry: undeclared edges, cycles, stale entries"
+    )
+    scope = _SCOPE
+
+    def run(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        order, aliases, key_lines = self._load_registry(project)
+        mods: Dict[str, _Mod] = {}
+        records: Dict[str, _FnRecord] = {}
+        name_index: Dict[str, List[str]] = {}
+
+        for ctx in project.files(*_SCOPE):
+            tree = ctx.tree
+            if tree is None:
+                continue
+            mod = self._collect_mod(tree, ctx.relpath)
+            mods[ctx.relpath] = mod
+            self._collect_fns(
+                tree, ctx.relpath, mod, aliases, records, name_index
+            )
+        self._check_wrapper_typos(project, mods, out)
+
+        # transitive lock closure over the call graph
+        closure: Dict[str, Set[str]] = {
+            k: {lock for lock, _, _ in rec.acquires}
+            for k, rec in records.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for k, rec in records.items():
+                for callee, _, _ in rec.calls:
+                    for resolved in self._callees(callee, records, name_index):
+                        extra = closure.get(resolved, set()) - closure[k]
+                        if extra:
+                            closure[k] |= extra
+                            changed = True
+
+        # edge derivation with provenance
+        observed: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        for k, rec in records.items():
+            for lock, line, held in rec.acquires:
+                for h in held:
+                    if h != lock:
+                        observed.setdefault(
+                            (h, lock), (rec.relpath, line, rec.qualname)
+                        )
+            for callee, line, held in rec.calls:
+                if not held:
+                    continue
+                for resolved in self._callees(callee, records, name_index):
+                    for lock in closure.get(resolved, ()):
+                        for h in held:
+                            if h != lock:
+                                observed.setdefault(
+                                    (h, lock),
+                                    (rec.relpath, line, rec.qualname),
+                                )
+
+        declared = set(order)
+        for (outer, inner), (path, line, qual) in sorted(
+            observed.items()
+        ):
+            if (outer, inner) not in declared:
+                out.append(
+                    Violation(
+                        checker=self.name,
+                        path=path,
+                        line=line,
+                        rule="L001",
+                        message=(
+                            f"{qual!r} acquires {inner!r} while "
+                            f"holding {outer!r} but VGT_LOCK_ORDER "
+                            "does not declare "
+                            f"'{outer}->{inner}' — declare the edge "
+                            "with a rationale in "
+                            f"{_REGISTRY_PATH} or restructure"
+                        ),
+                        symbol=f"{outer}->{inner}",
+                    )
+                )
+
+        for cycle in _find_cycles(declared | set(observed)):
+            out.append(
+                Violation(
+                    checker=self.name,
+                    path=_REGISTRY_PATH,
+                    line=1,
+                    rule="L002",
+                    message=(
+                        "lock-order cycle (deadlock by construction): "
+                        + " -> ".join(cycle + cycle[:1])
+                    ),
+                    symbol="|".join(sorted(set(cycle))),
+                )
+            )
+
+        # stale / typo'd registry endpoints: Class.attr must exist
+        known = self._known_locks(mods, aliases)
+        for key, line in key_lines.items():
+            outer, _, inner = key.partition("->")
+            for end in (outer.strip(), inner.strip()):
+                if end not in known:
+                    out.append(
+                        Violation(
+                            checker=self.name,
+                            path=_REGISTRY_PATH,
+                            line=line,
+                            rule="L003",
+                            message=(
+                                f"VGT_LOCK_ORDER entry {key!r} names "
+                                f"{end!r}, which no module defines "
+                                "(typo or stale rename — the edge is "
+                                "silently unenforced)"
+                            ),
+                            symbol=f"{key}:{end}",
+                        )
+                    )
+        return out
+
+    # -- collection ---------------------------------------------------
+
+    def _load_registry(self, project: Project):
+        ctx = project.context(_REGISTRY_PATH)
+        order: Set[Tuple[str, str]] = set()
+        aliases: Dict[str, str] = {}
+        key_lines: Dict[str, int] = {}
+        if ctx.tree is None:
+            return order, aliases, key_lines
+        order_node = A.module_assign_value(ctx.tree, "VGT_LOCK_ORDER")
+        alias_node = A.module_assign_value(ctx.tree, "VGT_LOCK_ALIASES")
+        if alias_node is not None:
+            aliases = A.dict_of_str(alias_node) or {}
+        if isinstance(order_node, ast.Dict):
+            for k in order_node.keys:
+                key = A.str_const(k)
+                if key is None:
+                    continue
+                key_lines[key] = k.lineno
+                outer, _, inner = key.partition("->")
+                outer, inner = outer.strip(), inner.strip()
+                order.add(
+                    (
+                        aliases.get(outer, outer),
+                        aliases.get(inner, inner),
+                    )
+                )
+        return order, aliases, key_lines
+
+    def _collect_mod(self, tree: ast.AST, relpath: str) -> _Mod:
+        mod = _Mod(relpath=relpath)
+        comps = A.module_assign_value(tree, "VGT_COMPONENTS")
+        if comps is not None:
+            mod.components = A.dict_of_str(comps) or {}
+        wraps = A.module_assign_value(tree, "VGT_LOCK_WRAPPERS")
+        if wraps is not None:
+            mod.wrappers = A.dict_of_str(wraps) or {}
+            mod.wrappers_line = getattr(wraps, "lineno", 1)
+        mod.attr_names = {
+            n.attr
+            for n in ast.walk(tree)
+            if isinstance(n, ast.Attribute)
+        }
+        for node in getattr(tree, "body", []):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                mod.decorator_names.add(node.name)
+            if isinstance(node, ast.ClassDef):
+                mod.classes.setdefault(node.name, set())
+        return mod
+
+    def _collect_fns(
+        self,
+        tree: ast.AST,
+        relpath: str,
+        mod: _Mod,
+        aliases: Dict[str, str],
+        records: Dict[str, _FnRecord],
+        name_index: Dict[str, List[str]],
+    ) -> None:
+        def handle(fn, cls: Optional[str]):
+            qual = f"{cls}.{fn.name}" if cls else fn.name
+            rec = _FnRecord(qualname=qual, cls=cls, relpath=relpath)
+            for dec in fn.decorator_list:
+                dname = A.dec_last_name(dec)
+                if dname == "requires_lock" and isinstance(dec, ast.Call):
+                    for arg in dec.args:
+                        val = A.str_const(arg)
+                        if val is not None and cls:
+                            q = f"{cls}.{val}"
+                            rec.entry_held.add(aliases.get(q, q))
+                elif dname in mod.wrappers and cls:
+                    q = f"{cls}.{mod.wrappers[dname]}"
+                    rec.entry_held.add(aliases.get(q, q))
+            _FnWalker(rec, mod, aliases).walk(fn)
+            key = _fn_key(cls, fn.name, relpath)
+            records[key] = rec
+            if cls is None:
+                name_index.setdefault(fn.name, []).append(key)
+
+        for node in getattr(tree, "body", []):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                handle(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        handle(item, node.name)
+
+    def _callees(
+        self,
+        callee: str,
+        records: Dict[str, _FnRecord],
+        name_index: Dict[str, List[str]],
+    ) -> List[str]:
+        if callee.startswith("name:"):
+            return name_index.get(callee[5:], [])
+        if callee in records:
+            return [callee]
+        return []
+
+    def _known_locks(
+        self, mods: Dict[str, _Mod], aliases: Dict[str, str]
+    ) -> Set[str]:
+        """Every ``Class.attr`` whose class exists and whose attr is
+        accessed in the class's module, plus alias keys (they name the
+        non-canonical spelling by design)."""
+        known: Set[str] = set(aliases)
+        class_home: Dict[str, List[_Mod]] = {}
+        for mod in mods.values():
+            for cls in mod.classes:
+                class_home.setdefault(cls, []).append(mod)
+        for cls, homes in class_home.items():
+            for mod in homes:
+                for attr in mod.attr_names:
+                    if _is_lock_attr(attr):
+                        known.add(f"{cls}.{attr}")
+        return known
+
+    def _check_wrapper_typos(
+        self,
+        project: Project,
+        mods: Dict[str, _Mod],
+        out: List[Violation],
+    ) -> None:
+        for relpath, mod in sorted(mods.items()):
+            for dec, lock in sorted(mod.wrappers.items()):
+                problems = []
+                if dec not in mod.decorator_names:
+                    problems.append(f"decorator {dec!r} is not defined")
+                if lock not in mod.attr_names:
+                    problems.append(
+                        f"lock {lock!r} is never accessed as an "
+                        "attribute"
+                    )
+                for why in problems:
+                    out.append(
+                        Violation(
+                            checker=self.name,
+                            path=relpath,
+                            line=mod.wrappers_line,
+                            rule="L004",
+                            message=(
+                                f"VGT_LOCK_WRAPPERS entry {dec!r} -> "
+                                f"{lock!r}: {why} in this module "
+                                "(typo or stale rename — the wrapper "
+                                "hold is silently unmodelled)"
+                            ),
+                            symbol=f"VGT_LOCK_WRAPPERS.{dec}",
+                        )
+                    )
+
+
+def _find_cycles(
+    edges: Set[Tuple[str, str]]
+) -> List[List[str]]:
+    """Elementary cycles via SCC decomposition (iterative Tarjan);
+    each SCC with a cycle is reported once, as a deterministic node
+    ordering — enough to say WHERE the knot is."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            succs = sorted(graph[node])
+            for i in range(pi, len(succs)):
+                nxt = succs[i]
+                if nxt not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    scc.append(member)
+                    if member == node:
+                        break
+                if len(scc) > 1 or (node, node) in edges:
+                    sccs.append(sorted(scc))
+    return sccs

@@ -1,0 +1,492 @@
+"""obligations — paired acquire/release obligations enforced on every
+CFG path, exception edges included.
+
+The worst review-round bugs of PRs 2–11 were *path* bugs: a future
+left unsettled on one exception arm (PR-2 batcher, PR-8 evac waiter),
+the host-pool budget double-refunded on the sweep-then-settle path
+(PR-11), admission backlog charged but never released when a raise
+landed between the charge and the done-callback registration.  This
+checker rejects the shape itself: once a declared obligation is
+acquired, every path out of the function — normal return, fall-off,
+or an exception escaping any statement — must release or transfer it
+exactly once.
+
+Modules declare their obligations next to the code::
+
+    VGT_OBLIGATIONS = {
+        "admission-backlog": {
+            "acquire":  ("*.admit",),
+            "release":  ("*.release",),
+            "transfer": ("*.add_done_callback",),
+            "transfer_assign": ("self._seq_tickets",),  # optional
+        },
+    }
+
+Call patterns are dotted chains: ``self._charge`` matches exactly;
+``*.admit`` matches any receiver whose final attribute is ``admit``.
+``transfer_assign`` patterns match assignment targets (plain or
+subscripted) — parking a ticket in the registry that owns it from then
+on discharges the local obligation.  Only functions containing a
+matching acquire or release are analyzed; obligations that live
+across functions by design (charge at submit, release in a callback)
+are modelled by declaring the hand-off point as a transfer.
+
+Rules:
+
+* **R001** — a path exists from an acquire to a function exit with the
+  obligation still held.  Exception paths are reported as such: "on an
+  exception path" findings are exactly the PR-2 bug shape.  An acquire
+  takes effect only on its statement's *normal* out-edge (if the
+  charge call itself raised, nothing was charged); releases/transfers
+  take effect on every out-edge (assuming the refund landed is the
+  conservative direction against false leaks).
+* **R002** — released twice: a release whose operand was already
+  released on some path into the statement, with no rebind of the
+  operand's root name in between (loop iterations rebind their
+  targets, so per-item release loops stay clean).  Operand identity is
+  the root name of the release argument (``entry[1]`` and
+  ``entry[1].nbytes`` are the same ``entry``).
+* **R003** — a registry pattern matching nothing in the module: a
+  stale entry silently un-enforces its obligation (the T004/L003
+  discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from vgate_tpu.analysis import _astutil as A
+from vgate_tpu.analysis.cfg import EXC, Node, build_cfg
+from vgate_tpu.analysis.core import Checker, Project, Violation
+from vgate_tpu.analysis.dataflow import forward
+
+_SCOPE = ("vgate_tpu/**/*.py",)
+
+# R001 lattice values (per obligation kind, per path)
+_CLEAN, _HELD, _DONE = "C", "H", "D"
+
+
+@dataclass(frozen=True)
+class _Kind:
+    name: str
+    acquire: Tuple[str, ...]
+    release: Tuple[str, ...]
+    transfer: Tuple[str, ...] = ()
+    transfer_assign: Tuple[str, ...] = ()
+
+
+def _parse_registry(tree: ast.AST) -> Tuple[List[_Kind], int]:
+    node = A.module_assign_value(tree, "VGT_OBLIGATIONS")
+    kinds: List[_Kind] = []
+    if not isinstance(node, ast.Dict):
+        return kinds, 1
+    for k, v in zip(node.keys, node.values):
+        kname = A.str_const(k)
+        if kname is None or not isinstance(v, ast.Dict):
+            continue
+        spec: Dict[str, Tuple[str, ...]] = {}
+        for rk, rv in zip(v.keys, v.values):
+            role = A.str_const(rk)
+            pats = A.string_tuple(rv)
+            if role and pats:
+                spec[role] = pats
+        kinds.append(
+            _Kind(
+                name=kname,
+                acquire=spec.get("acquire", ()),
+                release=spec.get("release", ()),
+                transfer=spec.get("transfer", ()),
+                transfer_assign=spec.get("transfer_assign", ()),
+            )
+        )
+    return kinds, getattr(node, "lineno", 1)
+
+
+def _chain_matches(chain: Sequence[str], pattern: str) -> bool:
+    parts = pattern.split(".")
+    if parts[0] == "*":
+        tail = parts[1:]
+        return len(chain) > len(tail) and list(chain[-len(tail):]) == tail
+    return list(chain) == parts
+
+
+def _call_chain(call: ast.Call) -> Optional[List[str]]:
+    chain = A.attr_chain(call.func)
+    if chain is None and isinstance(call.func, ast.Attribute):
+        # computed receiver (e.g. ``get_running_loop().create_future()``)
+        # — still match method-suffix patterns on the final attribute
+        return ["<expr>", call.func.attr]
+    return chain
+
+
+def _operand_key(call: ast.Call, pattern: str) -> Optional[str]:
+    """Identity of the object being released.  Method-style patterns
+    (``*.set_result``) release their RECEIVER; function-style patterns
+    (``self._refund``) release their first argument.  Normalized to
+    the ROOT name for locals (``entry[1].nbytes`` -> ``entry``) and
+    the dotted chain for ``self.…`` roots."""
+    target: Optional[ast.AST]
+    if pattern.startswith("*"):
+        target = call.func.value if isinstance(
+            call.func, ast.Attribute
+        ) else None
+    else:
+        target = call.args[0] if call.args else None
+    while isinstance(target, (ast.Subscript, ast.Attribute, ast.Starred)):
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id == "self":
+            return f"self.{target.attr}"
+        target = target.value
+    if isinstance(target, ast.Name):
+        return target.id
+    return None
+
+
+# statement events: ("acquire"|"release"|"transfer", kind_name, key)
+# and ("kill", name, None)
+_Event = Tuple[str, str, Optional[str]]
+
+
+def _own_exprs(node: Node) -> List[ast.AST]:
+    """The expressions that execute AT this CFG node (headers of
+    compound statements; the whole statement otherwise)."""
+    stmt = node.stmt
+    if stmt is None:
+        return []
+    if isinstance(stmt, ast.If) or isinstance(stmt, ast.While):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items] + [
+            i.optional_vars for i in stmt.items if i.optional_vars
+        ]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, ast.ExceptHandler):
+        return []
+    if isinstance(
+        stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ):
+        return []
+    return [stmt]
+
+
+def _walk_pruned(roots: Sequence[ast.AST]):
+    stack = list(roots)
+    while stack:
+        sub = stack.pop()
+        if isinstance(
+            sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _node_events(node: Node, kinds: List[_Kind]) -> List[_Event]:
+    events: List[_Event] = []
+    stmt = node.stmt
+    exprs = _own_exprs(node)
+    # kills: name rebinds at this node (assign targets, loop targets,
+    # with-as names, except-as names)
+    kill_names: List[str] = []
+    if stmt is not None:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            targets = [
+                i.optional_vars for i in stmt.items if i.optional_vars
+            ]
+        elif isinstance(stmt, ast.ExceptHandler) and stmt.name:
+            kill_names.append(stmt.name)
+        for t in targets:
+            for leaf in A.iter_target_attrs(t):
+                if isinstance(leaf, ast.Name):
+                    kill_names.append(leaf.id)
+    for name in kill_names:
+        events.append(("kill", name, None))
+
+    calls = [
+        sub for sub in _walk_pruned(exprs) if isinstance(sub, ast.Call)
+    ]
+    # source order so acquire-then-release inside one statement
+    # resolves correctly
+    calls.sort(
+        key=lambda c: (c.lineno, c.col_offset)
+    )
+    for call in calls:
+        chain = _call_chain(call)
+        if not chain:
+            continue
+        for kind in kinds:
+            if any(_chain_matches(chain, p) for p in kind.acquire):
+                events.append(("acquire", kind.name, None))
+            matched_release = next(
+                (p for p in kind.release if _chain_matches(chain, p)),
+                None,
+            )
+            if matched_release is not None:
+                events.append(
+                    (
+                        "release",
+                        kind.name,
+                        _operand_key(call, matched_release),
+                    )
+                )
+            if any(_chain_matches(chain, p) for p in kind.transfer):
+                events.append(("transfer", kind.name, None))
+    # transfer-assign targets
+    if stmt is not None and isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            leaf = t
+            if isinstance(leaf, ast.Subscript):
+                leaf = leaf.value
+            chain = A.attr_chain(leaf)
+            if not chain:
+                continue
+            dotted = ".".join(chain)
+            for kind in kinds:
+                if dotted in kind.transfer_assign:
+                    events.append(("transfer", kind.name, None))
+    return events
+
+
+class ObligationsChecker(Checker):
+    name = "obligations"
+    description = (
+        "paired obligations (charge/refund, create/settle, "
+        "retain/release) discharged exactly once on every CFG path, "
+        "exception edges included (VGT_OBLIGATIONS registries)"
+    )
+    scope = _SCOPE
+
+    def run(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for ctx in project.files(*_SCOPE):
+            tree = ctx.tree
+            if tree is None:
+                continue
+            kinds, reg_line = _parse_registry(tree)
+            if not kinds:
+                continue
+            self._check_module(ctx, tree, kinds, reg_line, out)
+        return out
+
+    def _check_module(
+        self, ctx, tree: ast.AST, kinds: List[_Kind], reg_line: int, out
+    ) -> None:
+        matched_patterns: set = set()
+        for fn, qual in _functions(tree):
+            events_by_node, cfg = self._analyze_fn_events(fn, kinds)
+            if events_by_node is None:
+                continue
+            all_events = [
+                ev
+                for evs in events_by_node.values()
+                for ev in evs
+                if ev[0] != "kill"
+            ]
+            for verb, kname, _ in all_events:
+                matched_patterns.add((verb, kname))
+            active = {
+                k.name
+                for k in kinds
+                if any(
+                    ev[0] in ("acquire", "release") and ev[1] == k.name
+                    for ev in all_events
+                )
+            }
+            for kind in kinds:
+                if kind.name not in active:
+                    continue
+                self._check_r001(
+                    ctx, qual, cfg, events_by_node, kind, out
+                )
+                self._check_r002(
+                    ctx, qual, cfg, events_by_node, kind, out
+                )
+        # R003: stale patterns — any role whose patterns never matched
+        for kind in kinds:
+            roles = (
+                ("acquire", kind.acquire),
+                ("release", kind.release),
+            )
+            for verb, pats in roles:
+                if pats and (verb, kind.name) not in matched_patterns:
+                    out.append(
+                        Violation(
+                            checker=self.name,
+                            path=ctx.relpath,
+                            line=reg_line,
+                            rule="R003",
+                            message=(
+                                f"VGT_OBLIGATIONS[{kind.name!r}] "
+                                f"{verb} patterns {pats!r} match "
+                                "nothing in this module (typo or "
+                                "stale rename — the obligation is "
+                                "silently unenforced)"
+                            ),
+                            symbol=f"VGT_OBLIGATIONS.{kind.name}:{verb}",
+                        )
+                    )
+
+    def _analyze_fn_events(self, fn, kinds):
+        cfg = build_cfg(fn)
+        events_by_node: Dict[Node, List[_Event]] = {}
+        relevant = False
+        for node in cfg.nodes:
+            evs = _node_events(node, kinds)
+            if evs:
+                events_by_node[node] = evs
+                if any(e[0] in ("acquire", "release") for e in evs):
+                    relevant = True
+        if not relevant:
+            return None, None
+        return events_by_node, cfg
+
+    # -- R001: leak on some path -------------------------------------
+
+    def _check_r001(
+        self, ctx, qual, cfg, events_by_node, kind: _Kind, out
+    ) -> None:
+        if not any(
+            ev[0] == "acquire" and ev[1] == kind.name
+            for evs in events_by_node.values()
+            for ev in evs
+        ):
+            return
+
+        def transfer(node, fact: FrozenSet[str], edge_kind: str):
+            states = set(fact)
+            for verb, kname, _ in events_by_node.get(node, []):
+                if kname != kind.name:
+                    continue
+                if verb == "acquire":
+                    if edge_kind != EXC:
+                        states = {_HELD}
+                elif verb in ("release", "transfer"):
+                    states = {_DONE}
+            return frozenset(states)
+
+        in_facts = forward(
+            cfg,
+            frozenset({_CLEAN}),
+            transfer,
+            lambda a, b: a | b,
+        )
+        acquire_line = min(
+            (
+                node.line
+                for node, evs in events_by_node.items()
+                for ev in evs
+                if ev[0] == "acquire" and ev[1] == kind.name
+            ),
+            default=getattr(cfg.func, "lineno", 1),
+        )
+        for exit_node, where in (
+            (cfg.exit, "a normal exit"),
+            (cfg.raise_exit, "an exception path"),
+        ):
+            fact = in_facts.get(exit_node)
+            if fact is not None and _HELD in fact:
+                out.append(
+                    Violation(
+                        checker=self.name,
+                        path=ctx.relpath,
+                        line=acquire_line,
+                        rule="R001",
+                        message=(
+                            f"obligation {kind.name!r} acquired in "
+                            f"{qual!r} can reach {where} without a "
+                            "release/transfer — every path must "
+                            "discharge it exactly once"
+                        ),
+                        symbol=f"{qual}:{kind.name}:{where.split()[-1]}",
+                    )
+                )
+
+    # -- R002: double release ----------------------------------------
+
+    def _check_r002(
+        self, ctx, qual, cfg, events_by_node, kind: _Kind, out
+    ) -> None:
+        def transfer(node, fact: FrozenSet[str], edge_kind: str):
+            released = set(fact)
+            for verb, kname, key in events_by_node.get(node, []):
+                if verb == "kill" and kname in released:
+                    released.discard(kname)
+                elif (
+                    verb == "release"
+                    and kname == kind.name
+                    and key is not None
+                ):
+                    released.add(key)
+            return frozenset(released)
+
+        in_facts = forward(
+            cfg, frozenset(), transfer, lambda a, b: a | b
+        )
+        seen: set = set()
+        for node, evs in sorted(
+            events_by_node.items(), key=lambda kv: kv[0].idx
+        ):
+            fact = in_facts.get(node)
+            if fact is None:
+                continue
+            # apply same-statement events in order so release-after-
+            # release inside one statement is caught too
+            current = set(fact)
+            for verb, kname, key in evs:
+                if verb == "kill":
+                    current.discard(kname)
+                elif (
+                    verb == "release"
+                    and kname == kind.name
+                    and key is not None
+                ):
+                    if key in current and (qual, key) not in seen:
+                        seen.add((qual, key))
+                        out.append(
+                            Violation(
+                                checker=self.name,
+                                path=ctx.relpath,
+                                line=node.line,
+                                rule="R002",
+                                message=(
+                                    f"{kind.name!r} released twice "
+                                    f"for {key!r} on a path through "
+                                    f"{qual!r} (no rebind in "
+                                    "between) — released-twice "
+                                    "corrupts the accounting exactly "
+                                    "like never-released"
+                                ),
+                                symbol=f"{qual}:{kind.name}:{key}",
+                            )
+                        )
+                    current.add(key)
+        return
+
+
+def _functions(tree: ast.AST):
+    """(node, qualname) for every module-level function and method —
+    nested defs get their own entries? No: nested defs are deferred
+    closures; they are surfaced as their own analysis units only when
+    declared at class/module level, matching the lock checkers."""
+    for node in getattr(tree, "body", []):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.name
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield item, f"{node.name}.{item.name}"

@@ -1,0 +1,80 @@
+"""Worklist fixpoint over :mod:`vgate_tpu.analysis.cfg` graphs.
+
+One generic forward solver serves both analysis families the checkers
+need:
+
+* **may-analyses** (obligations: "exists a path on which the charge is
+  never refunded") — ``join`` is set union, facts grow toward a
+  superset of path possibilities;
+* **must-analyses** (epoch-guard dominance: "every path to this
+  mutation passes a staleness comparison") — ``join`` is intersection
+  / AND, facts shrink toward what all paths agree on.
+
+Facts are opaque immutable values compared with ``==``.  The transfer
+function sees the EDGE KIND (``normal`` / ``exc`` / ``back``) so an
+effect can apply asymmetrically — e.g. an obligation *acquire* does
+not take effect along its own exception edge (if the charge call
+raised, nothing was charged), while a *release* applies on every
+out-edge (assuming the refund landed is the conservative choice
+against false leak reports).
+
+Termination: facts must form a finite lattice under ``join`` (all the
+checkers' facts are frozensets over small alphabets or booleans); the
+solver iterates to a fixpoint, revisiting a node only when its
+in-fact changes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from vgate_tpu.analysis.cfg import CFG, Node
+
+__all__ = ["forward"]
+
+Transfer = Callable[[Node, Any, str], Any]
+Join = Callable[[Any, Any], Any]
+
+
+def forward(
+    cfg: CFG,
+    entry_fact: Any,
+    transfer: Transfer,
+    join: Join,
+    max_steps: int = 200_000,
+) -> Dict[Node, Any]:
+    """Solve to fixpoint; returns the IN-fact at every reachable node
+    (the fact *before* the node's own effect).  Unreachable nodes are
+    absent from the result.
+
+    ``transfer(node, in_fact, edge_kind)`` -> the fact flowing along
+    that out-edge.  ``join(old, new)`` merges at confluence points;
+    ``old`` is never None (first arrival installs the fact as-is).
+    ``max_steps`` is a safety valve against a non-converging transfer
+    (a checker bug, not an input property) — hitting it raises.
+    """
+    in_facts: Dict[Node, Any] = {cfg.entry: entry_fact}
+    work = deque([cfg.entry])
+    queued = {cfg.entry}
+    steps = 0
+    while work:
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError(
+                "dataflow fixpoint did not converge (transfer/join "
+                "is not monotone over a finite lattice?)"
+            )
+        node = work.popleft()
+        queued.discard(node)
+        fact = in_facts[node]
+        for succ, kind in node.succs:
+            out = transfer(node, fact, kind)
+            prev: Optional[Any] = in_facts.get(succ)
+            merged = out if prev is None else join(prev, out)
+            if prev is None or merged != prev:
+                in_facts[succ] = merged
+                if succ not in queued:
+                    queued.add(succ)
+                    work.append(succ)
+    return in_facts

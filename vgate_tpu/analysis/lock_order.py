@@ -1,0 +1,85 @@
+"""VGT_LOCK_ORDER — THE canonical lock-acquisition order registry.
+
+Single definition site (enforced by definition-drift D006, the same
+discipline as ``admission.TIERS`` and ``DEVICE_PEAKS``): the static
+lock-order checker (vgate_tpu/analysis/checkers/lock_order.py) derives
+the repo's actual acquisition graph from the AST and fails on any edge
+not declared here or any cycle among the declared edges; the runtime
+lock witness (vgate_tpu/analysis/witness.py, ``VGT_LOCK_WITNESS=1``)
+records the chains that *actually happen* during tier-1 and the chaos
+drills and fails on any chain this registry did not predict — closing
+the loop on dynamic dispatch the AST cannot see.
+
+Lock identity is ``ClassName.attr`` — attribute names alone collide
+(three classes own a ``_lock``).  An edge ``"A.x->B.y"`` declares
+"``A.x`` may be held while acquiring ``B.y``"; the value is the
+mandatory rationale (the same justification culture as baseline
+entries and inline suppressions).  Same-lock reentrancy (RLocks) is
+not an edge.
+
+``VGT_LOCK_ALIASES`` maps locks that are the SAME OBJECT at runtime to
+their canonical name — the KV swap manager's publication guard is the
+engine's readback lock, injected at construction
+(engine_core.py: ``KVSwapManager(..., lock=self._readback_lock)``).
+Both the checker and any reader of witness reports must canonicalize
+before comparing.
+
+The human-readable twin of this table lives in docs/operations.md
+("Lock order"); keep them in sync — the doc row explains *when* each
+pair nests, this file is what the tools enforce.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Tuple
+
+__all__ = [
+    "VGT_LOCK_ORDER",
+    "VGT_LOCK_ALIASES",
+    "canonical",
+    "declared_edges",
+]
+
+VGT_LOCK_ALIASES: Dict[str, str] = {
+    # the swap manager's ticket-publication guard IS the engine's
+    # readback lock (shared so a containment fold and a swap-out
+    # publication arbitrate on one lock)
+    "KVSwapManager._lock": "EngineCore._readback_lock",
+}
+
+VGT_LOCK_ORDER: Dict[str, str] = {
+    # -- dp replica manager (runtime/dp_engine.py) --------------------
+    "ReplicatedEngine._structural_lock->ReplicatedEngine._topology_lock": (
+        "structural ops (drain/undrain/add/remove) serialize whole-op "
+        "on _structural_lock (via the @_structural wrapper, declared "
+        "in VGT_LOCK_WRAPPERS) and take _topology_lock for each short "
+        "index-keyed mutation inside; the reverse never happens — "
+        "topology holders are short readers that call no structural op"
+    ),
+    "ReplicatedEngine._route_lock->ReplicatedEngine._topology_lock": (
+        "the router snapshots the fleet under _topology_lock while "
+        "holding _route_lock for the round-robin counter; topology "
+        "holders never route"
+    ),
+    # Everything else is deliberately a LEAF: the supervisor lock, the
+    # engine containment/readback pair, admission, lifecycle and
+    # backend locks wrap short self-contained sections that call no
+    # other lock's owner (e.g. _contain_fatal releases _contain_lock
+    # BEFORE _contain_body's bounded readback acquire — by design, so
+    # the pair cannot order-invert).  The static checker fails the
+    # build the moment code grows an undeclared nesting; the runtime
+    # witness fails the drills the moment dynamic dispatch does.
+}
+
+
+def canonical(name: str) -> str:
+    return VGT_LOCK_ALIASES.get(name, name)
+
+
+def declared_edges() -> FrozenSet[Tuple[str, str]]:
+    """Canonicalized (outer, inner) pairs."""
+    out = set()
+    for key in VGT_LOCK_ORDER:
+        outer, _, inner = key.partition("->")
+        out.add((canonical(outer.strip()), canonical(inner.strip())))
+    return frozenset(out)

@@ -1,0 +1,276 @@
+"""Runtime lock-witness: record the lock-acquisition chains that
+ACTUALLY happen and fail on any order the static graph didn't predict.
+
+The static lock-order checker (checkers/lock_order.py) sees lexical
+``with`` blocks and name-resolvable calls; it cannot see dynamic
+dispatch (callbacks, ``getattr`` delegation, threads handed bound
+methods).  This module closes that loop: armed with
+``VGT_LOCK_WITNESS=1``, every lock built through :func:`named_lock`
+records, per thread, the stack of witnessed locks held at each
+acquisition and checks the (held, new) pairs against the TRANSITIVE
+CLOSURE of ``VGT_LOCK_ORDER`` (a chain A,B,C witnesses A->C, which is
+implied by declared A->B->C).  Undeclared pairs are logged loudly,
+collected, and written to ``$VGT_LOCK_WITNESS_OUT`` — incrementally
+on every new edge, so even a ``kill -9``'d drill server leaves a
+current report.  ``VGT_LOCK_WITNESS=strict`` additionally raises at
+the offending acquisition, turning an undeclared order into a test
+failure at its exact stack.
+
+**Zero cost when off**: :func:`named_lock` returns a plain
+``threading.Lock`` / ``RLock`` unless the env var is set at
+construction time — the serving hot path never sees a wrapper frame.
+
+Reentrant re-acquisition of an already-held lock records no edge (it
+cannot block).  The witness's own bookkeeping lock is a plain lock and
+is never witnessed.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from vgate_tpu.analysis.lock_order import (
+    VGT_LOCK_ORDER,
+    canonical,
+    declared_edges,
+)
+
+__all__ = [
+    "named_lock",
+    "enabled",
+    "report",
+    "undeclared",
+    "assert_clean",
+    "reset",
+    "WitnessLock",
+]
+
+_state_lock = threading.Lock()
+_tls = threading.local()
+# (outer, inner) -> count, canonical names
+_edges: Dict[Tuple[str, str], int] = {}
+_undeclared: Dict[Tuple[str, str], str] = {}  # edge -> sample chain
+_closure_cache: Optional[frozenset] = None
+
+
+def enabled() -> str:
+    """Current witness mode: "" (off), "1" (record), "strict"."""
+    mode = os.environ.get("VGT_LOCK_WITNESS", "")
+    return "" if mode in ("", "0") else mode
+
+
+def _declared_closure() -> frozenset:
+    """Transitive closure of the declared order (recomputed when the
+    registry object changes — tests monkeypatch it)."""
+    global _closure_cache
+    edges = declared_edges()
+    closure = set(edges)
+    nodes = {n for e in edges for n in e}
+    changed = True
+    while changed:
+        changed = False
+        for a in nodes:
+            for b in nodes:
+                if (a, b) in closure:
+                    for c in nodes:
+                        if (b, c) in closure and (a, c) not in closure:
+                            closure.add((a, c))
+                            changed = True
+    _closure_cache = frozenset(closure)
+    return _closure_cache
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = []
+        _tls.stack = stack
+    return stack
+
+
+def _out_path() -> Optional[str]:
+    return os.environ.get("VGT_LOCK_WITNESS_OUT") or None
+
+
+def _write_report_locked() -> None:
+    path = _out_path()
+    if not path:
+        return
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(_report_locked(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    except OSError:  # pragma: no cover - best effort
+        pass
+
+
+def _report_locked() -> dict:
+    return {
+        "declared": sorted(
+            f"{a}->{b}" for a, b in declared_edges()
+        ),
+        "edges": [
+            {"outer": a, "inner": b, "count": n}
+            for (a, b), n in sorted(_edges.items())
+        ],
+        "undeclared": [
+            {"outer": a, "inner": b, "chain": chain}
+            for (a, b), chain in sorted(_undeclared.items())
+        ],
+    }
+
+
+def report() -> dict:
+    with _state_lock:
+        return _report_locked()
+
+
+def undeclared() -> List[Tuple[str, str]]:
+    with _state_lock:
+        return sorted(_undeclared)
+
+
+def assert_clean() -> None:
+    bad = undeclared()
+    if bad:
+        raise AssertionError(
+            "lock witness observed acquisition orders the static "
+            f"graph did not predict: {bad} — declare them in "
+            "vgate_tpu/analysis/lock_order.py (with rationale) or "
+            "fix the ordering"
+        )
+
+
+def reset() -> None:
+    global _closure_cache
+    with _state_lock:
+        _edges.clear()
+        _undeclared.clear()
+        _closure_cache = None
+
+
+def _record(held: List[str], name: str, strict: bool) -> None:
+    closure = _closure_cache
+    if closure is None:
+        closure = _declared_closure()
+    new_undeclared = None
+    with _state_lock:
+        chain = "->".join(held + [name])
+        dirty = False
+        for outer in held:
+            edge = (outer, name)
+            before = edge in _edges
+            _edges[edge] = _edges.get(edge, 0) + 1
+            if not before:
+                dirty = True
+                if edge not in closure and edge not in _undeclared:
+                    _undeclared[edge] = chain
+                    new_undeclared = edge
+        if dirty:
+            _write_report_locked()
+    if new_undeclared is not None:
+        import logging
+
+        logging.getLogger(__name__).error(
+            "lock witness: UNDECLARED acquisition order %s -> %s "
+            "(chain %s) — not predicted by VGT_LOCK_ORDER",
+            new_undeclared[0],
+            new_undeclared[1],
+            chain,
+        )
+        if strict:
+            raise RuntimeError(
+                f"undeclared lock order {new_undeclared[0]} -> "
+                f"{new_undeclared[1]} (chain {chain}); declare it in "
+                "vgate_tpu/analysis/lock_order.py or fix the nesting"
+            )
+
+
+class WitnessLock:
+    """Witnessing wrapper around a ``threading.Lock``/``RLock``.
+    Implements the acquire/release/context-manager surface the runtime
+    uses; every *blocking-capable* acquisition (first acquisition by
+    this thread) records the held-chain edge set."""
+
+    __slots__ = ("name", "_base", "_strict")
+
+    def __init__(self, name: str, base, strict: bool = False) -> None:
+        self.name = canonical(name)
+        self._base = base
+        self._strict = strict
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        held = _held_stack()
+        first = self.name not in held
+        if first:
+            # record BEFORE blocking: a real deadlock would otherwise
+            # never reach the recording line, hiding exactly the
+            # evidence the witness exists to capture
+            _record(list(held), self.name, self._strict)
+        ok = self._base.acquire(blocking, timeout)
+        if ok:
+            held.append(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._base.release()
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self.name:
+                del held[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        base_locked = getattr(self._base, "locked", None)
+        return bool(base_locked()) if base_locked else False
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<WitnessLock {self.name} {self._base!r}>"
+
+
+def named_lock(name: str, reentrant: bool = False):
+    """A lock registered with the witness under its canonical
+    ``Class.attr`` name.  Plain lock when the witness is off — the
+    only cost of adoption is this construction-time branch."""
+    base = threading.RLock() if reentrant else threading.Lock()
+    mode = enabled()
+    if not mode:
+        return base
+    return WitnessLock(name, base, strict=(mode == "strict"))
+
+
+# referenced so the import is visibly load-bearing: the registry is
+# the witness's ground truth, and tooling greps for this usage
+_ = VGT_LOCK_ORDER
+
+# Report lifecycle: when armed with an output path, write the (empty)
+# skeleton at import and the final state at interpreter exit — so the
+# drills' assert step can distinguish "witness ran, saw nothing
+# nested" (skeleton present) from "witness never armed" (file
+# absent), and a `kill -9`'d drill server still leaves the
+# incrementally-updated report current.  Registration is gated on
+# enabled(): a DISABLED process with the output path inherited must
+# NOT write an empty report — assert_witness_clean would read it as a
+# clean armed run and pass vacuously (it fails loudly on a missing
+# file instead).
+def _final_write() -> None:
+    with _state_lock:
+        _write_report_locked()
+
+
+if enabled():
+    if _out_path():
+        _final_write()
+    atexit.register(_final_write)

@@ -32,6 +32,7 @@ from vgate_tpu.models.specs import ModelSpec, spec_for_model_id
 from vgate_tpu.runtime.engine_core import EngineCore
 from vgate_tpu.runtime.sequence import SeqStatus
 from vgate_tpu.utils.math import bucket_for, round_up
+from vgate_tpu.analysis.witness import named_lock
 
 logger = get_logger(__name__)
 
@@ -75,7 +76,7 @@ class Embedder:
         self._forward = jax.jit(
             functools.partial(encode_forward, spec=self.spec)
         )
-        self._lock = threading.Lock()
+        self._lock = named_lock("Embedder._lock")
 
     def embed(self, inputs: Sequence[str]) -> List[List[float]]:
         max_len = self.spec.max_position_embeddings

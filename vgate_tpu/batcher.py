@@ -73,6 +73,26 @@ UNCACHEABLE_FINISH = frozenset({"abort", "deadline"})
 # to seconds).  Pure safety net against enforcement failing outright.
 ENGINE_SHED_GRACE_S = 30.0
 
+# Obligation contracts (vgtlint obligations checker).  The PR-2
+# review-round bug shape — a future created and then left unsettled on
+# one exception arm — and the PR-4 invariant "the admission backlog
+# releases exactly once, whatever the outcome" both live in this
+# module; every CFG path from a charge/create must reach its
+# release/settle or the hand-off that guarantees it (the future's
+# done-callback fires on set_result, set_exception AND cancel).
+VGT_OBLIGATIONS = {
+    "admission-backlog": {
+        "acquire": ("self.admission.admit",),
+        "release": ("self.admission.release",),
+        "transfer": ("*.add_done_callback",),
+    },
+    "request-future": {
+        "acquire": ("*.create_future",),
+        "release": ("*.set_result", "*.set_exception", "*.cancel"),
+        "transfer": ("*.add_done_callback",),
+    },
+}
+
 
 @dataclass
 class BatchRequest:
@@ -454,41 +474,64 @@ class RequestBatcher:
                 prefix_cached=self._prefix_cache_on,
             )
             self.admission.admit(cost, tier=tier, deadline_s=timeout_s)
-            request = BatchRequest(
-                request_id=request_id,
-                prompt=prompt,
-                params=params,
-                cache_key=cache_key,
-                future=asyncio.get_running_loop().create_future(),
-                token=cancel_token,
-                deadline_t=(
-                    time.perf_counter() + timeout_s
-                    if timeout_s is not None
-                    else None
-                ),
-                meta=RequestMeta(
-                    request_id=request_id, trace_ctx=trace_ctx
-                ),
-                tier_rank=tier_rank(tier),
-            )
-            # the backlog releases exactly once, whatever the outcome —
-            # done callbacks fire on set_result, set_exception AND
-            # cancel, covering every settle path below
-            request.future.add_done_callback(
-                lambda _f, c=cost: self.admission.release(c)
-            )
-            async with self._queue_lock:
-                if self._stopped:
-                    # shutdown raced past the cache lookup: nothing will
-                    # ever drain the queue again.  Cancel the future so
-                    # its done callback returns the admitted backlog.
-                    request.future.cancel()
-                    raise EngineRecoveringError(
-                        "server is shutting down; retry another replica"
+            try:
+                request = BatchRequest(
+                    request_id=request_id,
+                    prompt=prompt,
+                    params=params,
+                    cache_key=cache_key,
+                    future=asyncio.get_running_loop().create_future(),
+                    token=cancel_token,
+                    deadline_t=(
+                        time.perf_counter() + timeout_s
+                        if timeout_s is not None
+                        else None
+                    ),
+                    meta=RequestMeta(
+                        request_id=request_id, trace_ctx=trace_ctx
+                    ),
+                    tier_rank=tier_rank(tier),
+                )
+                # the backlog releases exactly once, whatever the
+                # outcome — done callbacks fire on set_result,
+                # set_exception AND cancel, covering every settle path
+                # below
+                request.future.add_done_callback(
+                    lambda _f, c=cost: self.admission.release(c)
+                )
+            except BaseException:
+                # a raise between the charge and the done-callback
+                # registration (the only release mechanism) would leak
+                # the admitted backlog forever
+                self.admission.release(cost)
+                raise
+            try:
+                async with self._queue_lock:
+                    if self._stopped:
+                        # shutdown raced past the cache lookup: nothing
+                        # will ever drain the queue again; the except
+                        # arm below cancels the future on the way out
+                        raise EngineRecoveringError(
+                            "server is shutting down; retry another "
+                            "replica"
+                        )
+                    self._queue.append(request)
+                    metrics.PENDING_REQUESTS.set(len(self._queue))
+                    trigger = (
+                        len(self._queue)
+                        >= self.config.batch.max_batch_size
                     )
-                self._queue.append(request)
-                metrics.PENDING_REQUESTS.set(len(self._queue))
-                trigger = len(self._queue) >= self.config.batch.max_batch_size
+            except BaseException:
+                # shutdown race, a raise before the append, or a
+                # CANCELLATION while awaiting the contended queue lock:
+                # the never-queued future would stay pending forever —
+                # nothing would settle it, so the done-callback release
+                # (the only backlog return mechanism) would never fire.
+                # Cancelling it settles the future and fires that
+                # callback.
+                if not request.future.done():
+                    request.future.cancel()
+                raise
             self.note_prompt_submitted(prompt)
             if cancel_token is not None:
                 # client disconnect: a queued request dequeues + fails

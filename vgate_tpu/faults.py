@@ -69,6 +69,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from vgate_tpu.logging_config import get_logger
+from vgate_tpu.analysis.witness import named_lock
 
 logger = get_logger(__name__)
 
@@ -140,7 +141,7 @@ class FaultSpec:
     _rng: random.Random = field(default_factory=random.Random, repr=False)
 
 
-_lock = threading.Lock()
+_lock = named_lock("faults._lock")
 _specs: Dict[str, List[FaultSpec]] = {}
 # fast-path guard: hot probe sites read one boolean when nothing is armed
 _active = False

@@ -32,6 +32,7 @@ from typing import Any, Callable, List, Optional
 
 from vgate_tpu import metrics
 from vgate_tpu.logging_config import get_logger
+from vgate_tpu.analysis.witness import named_lock
 
 logger = get_logger(__name__)
 
@@ -51,7 +52,7 @@ class CancelToken:
     __slots__ = ("_lock", "_cancelled", "_reason", "_callbacks")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("CancelToken._lock")
         self._cancelled = False
         self._reason: Optional[str] = None
         self._callbacks: List[Callable[[], Any]] = []

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from vgate_tpu import faults, integrity, metrics
+from vgate_tpu.analysis.witness import named_lock
 from vgate_tpu.analysis.annotations import (
     engine_thread_only,
     engine_thread_root,
@@ -106,6 +107,15 @@ logger = get_logger(__name__)
 # resolution for self.scheduler.*, and the fields only ever mutated
 # under their paired lock.
 VGT_COMPONENTS = {"scheduler": "Scheduler"}
+# Epoch-guard contract (vgtlint epoch-guard checker): token-append
+# readbacks publish sequence state a cross-thread containment fold may
+# have invalidated while the device call blocked.  Every append must
+# run under the readback lock AND be dominated by a staleness
+# comparison on the sequence's preempt epoch — the PR-5/8/11 bug
+# shape, previously re-verified by hand each PR.
+VGT_EPOCH_GUARDS = {
+    "append_token": {"lock": "_readback_lock", "epoch": "preempt_count"},
+}
 VGT_LOCK_GUARDS = {
     # the containment fold vs. token-append readbacks publication
     # guard (PR-5 hardening): a woken stalled thread must observe
@@ -1017,7 +1027,7 @@ class EngineCore:
         )
         # see the long rationale further down where the readback paths
         # use it; constructed here so the swap manager can share it
-        self._readback_lock = threading.Lock()
+        self._readback_lock = named_lock("EngineCore._readback_lock")
         if host_swap_bytes > 0:
             self.kv_swap = KVSwapManager(
                 budget_bytes=host_swap_bytes,
@@ -1282,7 +1292,7 @@ class EngineCore:
         # typically raises against the swept state) — only the first
         # entry may run, or the second would overwrite _checkpointed
         # and silently drop the in-flight sequences awaiting replay
-        self._contain_lock = threading.Lock()
+        self._contain_lock = named_lock("EngineCore._contain_lock")
         # readback/containment mutual exclusion: every token-append
         # readback loop holds this, and so does containment's
         # checkpoint sweep — the status/epoch guards alone are

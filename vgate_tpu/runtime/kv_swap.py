@@ -50,6 +50,20 @@ from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 
 logger = get_logger(__name__)
 
+# Obligation contract (vgtlint obligations checker): host-pool bytes
+# are charged exactly once per ticket and refunded exactly once — the
+# PR-11 review-round bug was a DOUBLE refund on the sweep-then-settle
+# path (the registry, not the seq attribute, is the accounting truth).
+# A charge is discharged by parking the ticket in its registry
+# (transfer_assign) or refunding; _count_discard subsumes _refund.
+VGT_OBLIGATIONS = {
+    "host-pool-bytes": {
+        "acquire": ("self._charge",),
+        "release": ("self._refund", "self._count_discard"),
+        "transfer_assign": ("self._seq_tickets", "self._prefix_lru"),
+    },
+}
+
 
 class SwapTicket:
     """One swapped-out run of KV pages parked in host RAM.
@@ -237,8 +251,11 @@ class KVSwapManager:
             )
             seq._swap_ticket = ticket  # type: ignore[attr-defined]
             seq.swap_count += 1
-            self._seq_tickets[seq.seq_id] = (seq, ticket)
+            # charge, then park in the registry that owns the refund
+            # from here on — nothing can raise between the two, so the
+            # charge can never outlive an unregistered ticket
             self._charge(nbytes)
+            self._seq_tickets[seq.seq_id] = (seq, ticket)
         self.total_swap_out_pages["preempt"] += len(pages)
         metrics.KV_SWAP_OUT_PAGES.labels(kind="preempt").inc(len(pages))
         return True
@@ -322,8 +339,9 @@ class KVSwapManager:
         ticket = SwapTicket(
             "prefix", len(pages), nbytes, payload, node=node
         )
-        self._prefix_lru[id(ticket)] = ticket
+        # charge, then park in the LRU registry that owns the refund
         self._charge(nbytes)
+        self._prefix_lru[id(ticket)] = ticket
         self.total_swap_out_pages["prefix"] += len(pages)
         metrics.KV_SWAP_OUT_PAGES.labels(kind="prefix").inc(len(pages))
         return ticket

@@ -61,6 +61,7 @@ from vgate_tpu.errors import (
     state_is_ready,
 )
 from vgate_tpu.analysis.annotations import requires_lock
+from vgate_tpu.analysis.witness import named_lock
 from vgate_tpu.integrity import CanaryKeeper
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.runtime.engine_core import (
@@ -166,7 +167,9 @@ class EngineSupervisor:
         self.config = config or get_config()
         self._recovery = self.config.recovery
         self._devices = devices
-        self._lock = threading.RLock()
+        self._lock = named_lock(
+            "EngineSupervisor._lock", reentrant=True
+        )
         self._state = HealthState.SERVING
         self._degraded_since: Optional[float] = None
         self._time_in_degraded = 0.0

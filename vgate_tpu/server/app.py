@@ -72,6 +72,22 @@ from vgate_tpu.version import __version__
 logger = get_logger(__name__)
 tracer = get_tracer(__name__)
 
+# Obligation contracts (vgtlint obligations checker): the true-
+# streaming path charges the admission backlog OUTSIDE the batcher, and
+# every handler holds a per-key in-flight fairness slot — both must be
+# returned on every CFG path (the PR-4 invariant; a raise between the
+# charge and its try/finally used to leak the budget forever).
+VGT_OBLIGATIONS = {
+    "admission-backlog": {
+        "acquire": ("*.admission.admit",),
+        "release": ("*.admission.release",),
+    },
+    "inflight-slot": {
+        "acquire": ("*.acquire_inflight",),
+        "release": ("release_slot",),
+    },
+}
+
 # asyncio.timeout is 3.11+; aiohttp's async_timeout dependency is the
 # same context manager for the 3.10 interpreters this serves on
 if hasattr(asyncio, "timeout"):  # pragma: no cover - py3.11+ images
@@ -530,16 +546,19 @@ async def chat_completions(request: web.Request) -> web.Response:
         )
         # one per-key slot per CLIENT request (the fairness cap must
         # never count internal fan-out, and a 429 here is a real
-        # status line, not an SSE event)
-        try:
-            release_slot = batcher.admission.acquire_inflight(
-                stream_key, tier=tier
-            )
-        except ClientQuotaExceededError as exc:
-            return _quota_429(exc)
+        # status line, not an SSE event).  The slot is acquired LAST
+        # before the try that owns its release: anything that can
+        # raise in between would leak the slot forever (obligations
+        # checker, R001).
         if getattr(engine.backend, "stream_async", None) is None:
             # replay path: token-budget admission happens inside
             # batcher.submit
+            try:
+                release_slot = batcher.admission.acquire_inflight(
+                    stream_key, tier=tier
+                )
+            except ClientQuotaExceededError as exc:
+                return _quota_429(exc)
             try:
                 return await _stream_chat(
                     request, payload, prompt, logit_bias, timeout_s
@@ -563,19 +582,32 @@ async def chat_completions(request: web.Request) -> web.Response:
             prefix_cached=batcher._prefix_cache_on,
         )
         try:
+            release_slot = batcher.admission.acquire_inflight(
+                stream_key, tier=tier
+            )
+        except ClientQuotaExceededError as exc:
+            return _quota_429(exc)
+        try:
             batcher.admission.admit(cost, tier=tier, deadline_s=timeout_s)
         except RetryableError as exc:
             release_slot()
             return _unavailable_503(exc, str(exc))
-        batcher.note_prompt_submitted(prompt)
+        except BaseException:
+            # an unexpected raise from admit must return the slot too
+            release_slot()
+            raise
         try:
+            batcher.note_prompt_submitted(prompt)
             return await _stream_chat(
                 request, payload, prompt, logit_bias, timeout_s,
                 tier=tier,
             )
         finally:
-            batcher.admission.release(cost)
-            release_slot()
+            # nested so neither release can leak the other by raising
+            try:
+                release_slot()
+            finally:
+                batcher.admission.release(cost)
 
     # n choices run as n engine requests sampled concurrently (the
     # variant salt keeps them from deduping; prefix caching shares
@@ -585,16 +617,24 @@ async def chat_completions(request: web.Request) -> web.Response:
     )
     api_key = _request_api_key(request)
     # the per-key fairness cap charges the CLIENT request once — its n
-    # fan-out submits below are one client action, not n
+    # fan-out submits below are one client action, not n.  Watcher
+    # setup precedes the slot acquisition: nothing may raise between
+    # acquiring the slot and the try/finally that returns it
+    # (obligations checker, R001).
+    token = CancelToken()
+    watcher = _watch_disconnect(request, token)
     try:
         release_slot = batcher.admission.acquire_inflight(
             api_key,
             tier=batcher.admission.resolve_tier(payload.priority, api_key),
         )
     except ClientQuotaExceededError as exc:
+        watcher.cancel()
         return _quota_429(exc)
-    token = CancelToken()
-    watcher = _watch_disconnect(request, token)
+    except BaseException:
+        # the polling watcher task must not outlive a failed acquire
+        watcher.cancel()
+        raise
     try:
         settled, err = await _settle_submits(
             engine,
@@ -626,8 +666,11 @@ async def chat_completions(request: web.Request) -> web.Response:
             ),
         )
     finally:
-        watcher.cancel()
-        release_slot()
+        # nested so a raising watcher.cancel cannot leak the slot
+        try:
+            watcher.cancel()
+        finally:
+            release_slot()
     if err is not None:
         return err
     results = (settled * (payload.n if deterministic else 1))[: payload.n]
@@ -990,16 +1033,24 @@ async def completions(request: web.Request) -> web.Response:
     ranking = not deterministic and best_of > payload.n
 
     api_key = _request_api_key(request)
-    # per-key cap: one slot per client request, not per fan-out submit
+    # per-key cap: one slot per client request, not per fan-out submit.
+    # Watcher setup precedes the slot acquisition: nothing may raise
+    # between acquiring the slot and the try/finally that returns it
+    # (obligations checker, R001).
+    token = CancelToken()
+    watcher = _watch_disconnect(request, token)
     try:
         release_slot = batcher.admission.acquire_inflight(
             api_key,
             tier=batcher.admission.resolve_tier(payload.priority, api_key),
         )
     except ClientQuotaExceededError as exc:
+        watcher.cancel()
         return _quota_429(exc)
-    token = CancelToken()
-    watcher = _watch_disconnect(request, token)
+    except BaseException:
+        # the polling watcher task must not outlive a failed acquire
+        watcher.cancel()
+        raise
     try:
         settled, err = await _settle_submits(
             engine,
@@ -1034,8 +1085,11 @@ async def completions(request: web.Request) -> web.Response:
             ),
         )
     finally:
-        watcher.cancel()
-        release_slot()
+        # nested so a raising watcher.cancel cannot leak the slot
+        try:
+            watcher.cancel()
+        finally:
+            release_slot()
     if err is not None:
         return err
 
@@ -1116,6 +1170,10 @@ async def embeddings(request: web.Request) -> web.Response:
     # embeddings skip the token-budget path (no decode backlog), but
     # the per-key in-flight fairness cap still applies
     emb_key = _request_api_key(request)
+    # loop lookup BEFORE the slot acquisition: nothing may raise
+    # between acquiring the slot and the try/finally that returns it
+    # (obligations checker, R001)
+    loop = asyncio.get_running_loop()
     try:
         release_slot = batcher.admission.acquire_inflight(
             emb_key,
@@ -1125,7 +1183,6 @@ async def embeddings(request: web.Request) -> web.Response:
         )
     except ClientQuotaExceededError as exc:
         return _quota_429(exc)
-    loop = asyncio.get_running_loop()
     try:
         # the encoder pass is a sync executor hop (can't be cancelled
         # mid-flight), but the CLIENT's deadline is still honored with a
