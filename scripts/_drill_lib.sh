@@ -36,6 +36,7 @@ declare -A VGT_DRILL_PORTS=(
   [slo]=8737
   [swap]=8738
   [perf]=8739
+  [worker]=8740
 )
 
 drill_port() {

@@ -53,3 +53,6 @@ bash scripts/swap_check.sh
 
 echo "== decode-loop perf observatory drill =="
 bash scripts/perf_check.sh
+
+echo "== process-isolated worker pod drill =="
+bash scripts/worker_check.sh

@@ -1,0 +1,245 @@
+#!/usr/bin/env bash
+# Process-isolated worker pod drill (ISSUE 16): boot a CPU tiny-dense
+# server in pod mode (pod.workers=2 — a gateway process routing over
+# two engine WORKER processes on unix sockets), then run the two
+# acceptance storms:
+#
+#   A. worker loss — 8 concurrent min_tokens-pinned greedy decodes,
+#      SIGKILL one worker mid-decode, and assert:
+#        1. ZERO client-visible 5xx — every request completes 200,
+#        2. /health showed DEGRADED with per-worker detail (pid, epoch,
+#           last_fatal) while the worker was down, then SERVING again
+#           after the canary-gated respawn,
+#        3. completions are token-identical to an undisturbed rerun
+#           (cache off, temperature 0 — the checkpoint/replay fold
+#           reproduced the exact stream),
+#   B. zombie fencing — SIGSTOP a worker (wedged, not dead: the process
+#      survives but stops answering heartbeats), let the gateway fence
+#      it out and respawn a replacement, then SIGCONT the zombie so its
+#      buffered late frames hit the gateway, and assert:
+#        4. vgt_pod_fenced_frames > 0 (the stale-epoch discard fired),
+#        5. the zombie's frames corrupted nothing: pod back to SERVING
+#           and a final rerun still token-identical.
+#
+# Usage: scripts/worker_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port worker)}"
+ensure_port_free "$PORT"
+arm_lock_witness worker
+export JAX_PLATFORMS=cpu
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=64
+export VGT_TPU__DP=1
+export VGT_TPU__TP=1
+export VGT_TPU__EP=1
+export VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=1
+export VGT_TPU__KV_NUM_PAGES=128
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=8
+export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=20
+# identical reruns must recompute, not replay a cached body
+export VGT_CACHE__ENABLED=false
+# the pod: two worker processes, snappy liveness so the drill's kills
+# are declared in seconds (production default is 10s)
+export VGT_POD__WORKERS=2
+export VGT_POD__HEARTBEAT_INTERVAL_S=0.3
+export VGT_POD__HEARTBEAT_TIMEOUT_S=3
+export VGT_RECOVERY__BACKOFF_BASE_S=0.05
+export VGT_RECOVERY__BACKOFF_CAP_S=0.2
+export VGT_RECOVERY__MAX_RESTARTS=8
+export VGT_RECOVERY__STEP_STALL_S=120
+export VGT_RECOVERY__COMPILE_GRACE_S=600
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+# the gateway's stop() reaps its worker processes; kill -9 on the
+# gateway would orphan them, so TERM first and 9 only as a last resort
+trap 'kill "$SERVER_PID" 2>/dev/null || true; sleep 2; \
+      kill -9 "$SERVER_PID" 2>/dev/null || true; \
+      clear_drill_pid "$PORT"' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+# pod boot = two engine builds + canary gates; allow a couple minutes
+for _ in $(seq 1 900); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: pod server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" worker_check
+
+python - "$BASE" <<'EOF'
+import asyncio, json, os, signal, sys, time
+import aiohttp
+
+BASE = sys.argv[1]
+N = 8
+PROMPTS = [f"worker drill prompt {i}" for i in range(N)]
+
+
+async def fire(session, prompt):
+    async with session.post(
+        f"{BASE}/v1/chat/completions",
+        json={
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": 24,
+            "min_tokens": 24,  # pin decode length: the kill lands mid-stream
+            "temperature": 0.0,
+        },
+    ) as resp:
+        return resp.status, await resp.json()
+
+
+async def engine_health(session):
+    async with session.get(f"{BASE}/health") as resp:
+        return (await resp.json())["engine"]
+
+
+async def wait_state(session, want, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = await engine_health(session)
+        if last["state"] == want:
+            return last
+        await asyncio.sleep(0.3)
+    raise AssertionError(f"engine never reached {want!r}; last: {last}")
+
+
+async def metric(session, name):
+    async with session.get(f"{BASE}/metrics") as resp:
+        text = await resp.text()
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            return float(line.split()[-1])
+    return None
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        eng = await engine_health(session)
+        assert eng["state"] == "serving", eng
+        assert eng["replicas_alive"] == 2, eng
+        pids = {r["replica"]: r["pid"] for r in eng["replicas"]}
+        assert all(pids.values()), eng["replicas"]
+
+        # ---- storm A: SIGKILL worker 0 mid-decode -------------------
+        async def killer():
+            await asyncio.sleep(1.0)
+            os.kill(pids[0], signal.SIGKILL)
+
+        results, _ = await asyncio.gather(
+            asyncio.gather(*(fire(session, p) for p in PROMPTS)),
+            killer(),
+        )
+        fivexx = [s for s, _ in results if s >= 500]
+        assert not fivexx, f"client-visible 5xx during worker loss: {results}"
+        storm_text = [
+            b["choices"][0]["message"]["content"] for _, b in results
+        ]
+
+        # the loss was observed with per-worker detail, and the pod
+        # healed through the canary gate
+        degraded = await engine_health(session)
+        if degraded["state"] == "degraded":
+            down = [
+                r for r in degraded["replicas"] if r["state"] != "serving"
+            ]
+            assert down and down[0]["replica"] == 0, degraded["replicas"]
+            assert "last_fatal" in down[0], down[0]
+        else:
+            # respawn already finished — the failover counters must
+            # still prove the DEGRADED window happened
+            assert degraded["failovers"] >= 1, degraded
+        healed = await wait_state(session, "serving")
+        assert healed["restarts"] >= 1, healed
+        assert healed["resumed"] >= 1, healed
+        new_epoch = [
+            r["epoch"] for r in healed["replicas"] if r["replica"] == 0
+        ][0]
+        assert new_epoch > 1, healed["replicas"]
+
+        # token identity: undisturbed rerun reproduces the storm output
+        rerun = await asyncio.gather(*(fire(session, p) for p in PROMPTS))
+        for (s, b), want in zip(rerun, storm_text):
+            assert s == 200, (s, b)
+            got = b["choices"][0]["message"]["content"]
+            assert got == want, (
+                f"resumed output diverged:\n  storm: {want!r}\n"
+                f"  clean: {got!r}"
+            )
+
+        # ---- storm B: SIGSTOP zombie + fencing ----------------------
+        eng = await engine_health(session)
+        pids = {r["replica"]: r["pid"] for r in eng["replicas"]}
+        fenced_before = eng.get("fenced_frames", 0)
+
+        async def stopper():
+            await asyncio.sleep(1.0)
+            os.kill(pids[1], signal.SIGSTOP)
+
+        results_b, _ = await asyncio.gather(
+            asyncio.gather(*(fire(session, p) for p in PROMPTS)),
+            stopper(),
+        )
+        fivexx = [s for s, _ in results_b if s >= 500]
+        assert not fivexx, f"5xx during zombie wedge: {results_b}"
+        healed = await wait_state(session, "serving")
+
+        # wake the zombie: its buffered mid-decode frames (stamped with
+        # the fenced incarnation's epoch) now reach the gateway
+        os.kill(pids[1], signal.SIGCONT)
+        deadline = time.monotonic() + 30
+        fenced_after = fenced_before
+        while time.monotonic() < deadline:
+            eng = await engine_health(session)
+            fenced_after = eng.get("fenced_frames", 0)
+            if fenced_after > fenced_before:
+                break
+            await asyncio.sleep(0.3)
+        assert fenced_after > fenced_before, (
+            f"zombie frames never counted as fenced "
+            f"(before={fenced_before} after={fenced_after})"
+        )
+        m = await metric(session, "vgt_pod_fenced_frames")
+        assert m and m > 0, f"vgt_pod_fenced_frames not exported: {m}"
+
+        # no corruption: pod serving, and outputs still reproduce
+        final = await wait_state(session, "serving")
+        rerun2 = await asyncio.gather(*(fire(session, p) for p in PROMPTS))
+        for (s, b), want in zip(rerun2, storm_text):
+            assert s == 200, (s, b)
+            got = b["choices"][0]["message"]["content"]
+            assert got == want, (
+                f"post-zombie output diverged:\n  want: {want!r}\n"
+                f"  got:  {got!r}"
+            )
+        print(
+            f"PASS: {N}/{N} through SIGKILL with zero 5xx, "
+            f"token-identical rerun; zombie fenced "
+            f"({fenced_after - fenced_before} late frames discarded), "
+            f"restarts={final['restarts']} resumed={final['resumed']} "
+            f"failovers={final['failovers']}"
+        )
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+assert_witness_clean worker
+echo "worker_check: OK"

@@ -1,0 +1,296 @@
+"""Frame-protocol hardening for the gateway ↔ worker RPC plane
+(ISSUE 16 satellite: protocol fuzz).
+
+The contract under test (vgate_tpu/runtime/rpc.py): every structural
+violation — truncated stream, bad magic, oversized length, undecodable
+or non-object payload — raises the typed ``FrameError`` (teardown);
+well-formed frames with a wrong fencing epoch raise ``StaleEpochError``
+(discard-and-count); and NOTHING the peer can put on the wire makes the
+reader hang.  The seeded randomized suite mutates valid frames and
+asserts the reader always terminates with a frame, clean EOF, or a
+typed error.
+"""
+
+import random
+import socket
+import struct
+import threading
+
+import pytest
+
+from vgate_tpu import faults
+from vgate_tpu.runtime import rpc
+
+CAP = 64 * 1024
+
+
+def pair():
+    a, b = socket.socketpair()
+    # backstop only: a hang in recv_frame fails the test as
+    # socket.timeout instead of wedging the suite
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def feed(data: bytes):
+    """One closed-writer socket preloaded with raw bytes."""
+    a, b = pair()
+    a.sendall(data)
+    a.close()
+    return b
+
+
+# --------------------------------------------------------- happy path
+
+
+def test_round_trip():
+    a, b = pair()
+    rpc.send_frame(a, {"op": "ping", "id": 1, "e": 3}, CAP)
+    assert rpc.recv_frame(b, CAP) == {"op": "ping", "id": 1, "e": 3}
+    a.close()
+    b.close()
+
+
+def test_clean_eof_returns_none():
+    a, b = pair()
+    a.close()
+    assert rpc.recv_frame(b, CAP) is None
+    b.close()
+
+
+def test_back_to_back_frames():
+    a, b = pair()
+    for i in range(5):
+        rpc.send_frame(a, {"op": "tok", "t": i, "e": 1}, CAP)
+    a.close()
+    got = [rpc.recv_frame(b, CAP)["t"] for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+    assert rpc.recv_frame(b, CAP) is None
+    b.close()
+
+
+# ------------------------------------------------- structural violations
+
+
+def test_truncated_header():
+    b = feed(b"\x56\x47")
+    with pytest.raises(rpc.FrameError, match="truncated"):
+        rpc.recv_frame(b, CAP)
+    b.close()
+
+
+def test_truncated_payload():
+    whole = rpc.encode_frame({"op": "ping", "e": 1}, CAP)
+    b = feed(whole[:-3])
+    with pytest.raises(rpc.FrameError, match="truncated"):
+        rpc.recv_frame(b, CAP)
+    b.close()
+
+
+def test_bad_magic():
+    b = feed(struct.pack(">II", 0xDEADBEEF, 4) + b"null")
+    with pytest.raises(rpc.FrameError, match="magic"):
+        rpc.recv_frame(b, CAP)
+    b.close()
+
+
+def test_oversized_inbound_rejected_before_allocation():
+    # length field claims 1 GiB; the reader must refuse from the header
+    # alone (never attempt the allocation/read)
+    b = feed(struct.pack(">II", rpc.MAGIC, 1 << 30))
+    with pytest.raises(rpc.FrameError, match="exceeds cap"):
+        rpc.recv_frame(b, CAP)
+    b.close()
+
+
+def test_oversized_outbound_rejected():
+    with pytest.raises(rpc.FrameError, match="exceeds cap"):
+        rpc.encode_frame({"blob": "x" * (CAP + 1)}, CAP)
+
+
+def test_garbage_payload():
+    raw = b"\xff\xfe\x00garbage"
+    b = feed(struct.pack(">II", rpc.MAGIC, len(raw)) + raw)
+    with pytest.raises(rpc.FrameError, match="undecodable"):
+        rpc.recv_frame(b, CAP)
+    b.close()
+
+
+def test_non_object_payload():
+    raw = b"[1,2,3]"
+    b = feed(struct.pack(">II", rpc.MAGIC, len(raw)) + raw)
+    with pytest.raises(rpc.FrameError, match="JSON object"):
+        rpc.recv_frame(b, CAP)
+    b.close()
+
+
+# ------------------------------------------------------- fencing epochs
+
+
+def test_check_epoch_accepts_current():
+    rpc.check_epoch({"op": "tok", "e": 7}, 7)
+
+
+def test_check_epoch_missing_is_structural():
+    with pytest.raises(rpc.FrameError, match="missing fencing epoch"):
+        rpc.check_epoch({"op": "tok"}, 7)
+
+
+def test_check_epoch_stale_is_fencing():
+    with pytest.raises(rpc.StaleEpochError) as ei:
+        rpc.check_epoch({"op": "tok", "e": 6}, 7)
+    assert ei.value.got == 6
+    assert ei.value.want == 7
+
+
+# ------------------------------------------------------- wire fault modes
+
+
+def test_rpc_send_drop_discards_frame():
+    a, b = pair()
+    spec = faults.arm("rpc_send", mode="drop", times=1)
+    rpc.send_frame(a, {"op": "tok", "t": 1, "e": 1}, CAP)  # dropped
+    rpc.send_frame(a, {"op": "tok", "t": 2, "e": 1}, CAP)  # delivered
+    assert spec.fired == 1
+    assert rpc.recv_frame(b, CAP)["t"] == 2
+    a.close()
+    b.close()
+
+
+def test_rpc_send_garble_hits_peer_framing_path():
+    a, b = pair()
+    faults.arm("rpc_send", mode="garble", times=1)
+    rpc.send_frame(a, {"op": "tok", "t": 1, "e": 1}, CAP)
+    with pytest.raises(rpc.FrameError):
+        rpc.recv_frame(b, CAP)
+    a.close()
+    b.close()
+
+
+def test_rpc_recv_drop_consumes_and_delivers_next():
+    a, b = pair()
+    rpc.send_frame(a, {"op": "tok", "t": 1, "e": 1}, CAP)
+    rpc.send_frame(a, {"op": "tok", "t": 2, "e": 1}, CAP)
+    spec = faults.arm("rpc_recv", mode="drop", times=1)
+    # the dropped frame's bytes are consumed so framing stays intact
+    assert rpc.recv_frame(b, CAP)["t"] == 2
+    assert spec.fired == 1
+    a.close()
+    b.close()
+
+
+def test_rpc_recv_garble_is_framing_violation():
+    a, b = pair()
+    rpc.send_frame(a, {"op": "tok", "t": 1, "e": 1}, CAP)
+    faults.arm("rpc_recv", mode="garble", times=1)
+    with pytest.raises(rpc.FrameError):
+        rpc.recv_frame(b, CAP)
+    a.close()
+    b.close()
+
+
+def test_wire_delay_delivers_after_sleep():
+    a, b = pair()
+    faults.arm("rpc_send", mode="delay", delay_s=0.01, times=1)
+    rpc.send_frame(a, {"op": "tok", "t": 1, "e": 1}, CAP)
+    assert rpc.recv_frame(b, CAP)["t"] == 1
+    a.close()
+    b.close()
+
+
+# ---------------------------------------------------------- seeded fuzz
+
+
+def _mutate(rng: random.Random, frame: bytes) -> bytes:
+    """One random corruption of a valid frame: byte flips, truncation,
+    garbage prefix/suffix, or a rewritten length field."""
+    kind = rng.randrange(5)
+    data = bytearray(frame)
+    if kind == 0:  # flip 1-4 bytes anywhere (header included)
+        for _ in range(rng.randint(1, 4)):
+            i = rng.randrange(len(data))
+            data[i] ^= rng.randint(1, 255)
+        return bytes(data)
+    if kind == 1:  # truncate
+        return bytes(data[: rng.randrange(len(data))])
+    if kind == 2:  # garbage prefix (desyncs the stream)
+        return bytes(rng.randbytes(rng.randint(1, 16))) + bytes(data)
+    if kind == 3:  # garbage suffix (trailing junk after a valid frame)
+        return bytes(data) + bytes(rng.randbytes(rng.randint(1, 16)))
+    # kind == 4: lie about the length
+    length = rng.randrange(0, CAP * 2)
+    struct.pack_into(">I", data, 4, length)
+    return bytes(data)
+
+
+def test_fuzz_reader_never_hangs():
+    """200 seeded mutations of valid frames: the reader must terminate
+    every time — with frames, clean EOF, or FrameError — and never
+    socket.timeout (which would mean a hang against a closed writer)."""
+    rng = random.Random(0x56471601)
+    for i in range(200):
+        frame = rpc.encode_frame(
+            {
+                "op": "tok",
+                "sid": i,
+                "e": rng.randrange(3),
+                "pad": "x" * rng.randrange(64),
+            },
+            CAP,
+        )
+        b = feed(_mutate(rng, frame))
+        try:
+            # drain until EOF: trailing-junk mutations park extra bytes
+            # after a valid first frame
+            for _ in range(4):
+                if rpc.recv_frame(b, CAP) is None:
+                    break
+        except rpc.FrameError:
+            pass
+        except socket.timeout:  # pragma: no cover - the failure mode
+            pytest.fail(f"reader hung on mutation #{i}")
+        finally:
+            b.close()
+
+
+def test_fuzz_wrong_epoch_frames_are_typed():
+    """Well-formed frames with randomized epochs: structurally valid,
+    so the reader delivers them and ONLY check_epoch complains."""
+    rng = random.Random(0xE16)
+    for _ in range(50):
+        want = rng.randrange(1, 5)
+        got = rng.randrange(0, 5)
+        a, b = pair()
+        rpc.send_frame(a, {"op": "tok", "t": 0, "e": got}, CAP)
+        frame = rpc.recv_frame(b, CAP)
+        if got == want:
+            rpc.check_epoch(frame, want)
+        else:
+            with pytest.raises(rpc.StaleEpochError):
+                rpc.check_epoch(frame, want)
+        a.close()
+        b.close()
+
+
+def test_fuzz_concurrent_writer_teardown():
+    """A writer that dies mid-frame (socket closed partway through a
+    send) must yield FrameError or EOF, never a hang."""
+    rng = random.Random(7)
+    for _ in range(20):
+        a, b = pair()
+        frame = rpc.encode_frame({"op": "tok", "pad": "y" * 256, "e": 1}, CAP)
+        cut = rng.randrange(1, len(frame))
+
+        def write_and_die(sock=a, n=cut, data=frame):
+            sock.sendall(data[:n])
+            sock.close()
+
+        t = threading.Thread(target=write_and_die)
+        t.start()
+        try:
+            assert rpc.recv_frame(b, CAP) is None
+        except rpc.FrameError:
+            pass
+        t.join()
+        b.close()

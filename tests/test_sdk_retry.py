@@ -1,0 +1,217 @@
+"""SDK stream-open retry (ISSUE 16 satellite: the streaming bugfix).
+
+``_request`` always retried connection-level failures; ``_stream`` did
+not — a gateway restart or a dying worker's connection reset at stream
+OPEN surfaced as a raw httpx error even though re-running the request
+was perfectly safe.  The fix retries refused/reset/garbage-answered
+opens (and 429/5xx answers) with the existing equal-jitter backoff, and
+NEVER retries once the first event has been yielded: a partial token
+stream is non-idempotent, so mid-stream failures must propagate.
+"""
+
+import sys
+from pathlib import Path
+
+import httpx
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "vgate_tpu_client"))
+
+from vgate_tpu_client import VGT, AsyncVGT  # noqa: E402
+from vgate_tpu_client.exceptions import (  # noqa: E402
+    ConnectionError as SDKConnectionError,
+    DeadlineExceeded,
+)
+
+SSE = (
+    b'data: {"chunk": 1}\n\n'
+    b'data: {"chunk": 2}\n\n'
+    b"data: [DONE]\n\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep(monkeypatch):
+    monkeypatch.setattr(
+        "vgate_tpu_client.client._retry_delay", lambda *a, **k: 0.0
+    )
+    monkeypatch.setattr("time.sleep", lambda s: None)
+
+
+def make_client(handler, **kwargs) -> VGT:
+    client = VGT(base_url="http://testserver", **kwargs)
+    client._http = httpx.Client(
+        base_url="http://testserver", transport=httpx.MockTransport(handler)
+    )
+    return client
+
+
+def make_async_client(handler, **kwargs) -> AsyncVGT:
+    client = AsyncVGT(base_url="http://testserver", **kwargs)
+    client._http = httpx.AsyncClient(
+        base_url="http://testserver", transport=httpx.MockTransport(handler)
+    )
+    return client
+
+
+def sse_response():
+    return httpx.Response(
+        200, content=SSE, headers={"content-type": "text/event-stream"}
+    )
+
+
+def test_stream_open_connect_refused_retried():
+    calls = []
+
+    def handler(request):
+        calls.append(1)
+        if len(calls) == 1:
+            raise httpx.ConnectError("connection refused", request=request)
+        return sse_response()
+
+    client = make_client(handler)
+    chunks = list(client._stream("/v1/chat/completions", {}))
+    assert [c["chunk"] for c in chunks] == [1, 2]
+    assert len(calls) == 2
+
+
+def test_stream_open_reset_retried():
+    calls = []
+
+    def handler(request):
+        calls.append(1)
+        if len(calls) == 1:
+            raise httpx.ReadError("connection reset by peer", request=request)
+        return sse_response()
+
+    client = make_client(handler)
+    assert len(list(client._stream("/v1/chat/completions", {}))) == 2
+    assert len(calls) == 2
+
+
+def test_stream_open_incomplete_read_retried():
+    calls = []
+
+    def handler(request):
+        calls.append(1)
+        if len(calls) == 1:
+            raise httpx.RemoteProtocolError(
+                "peer closed connection without sending complete message",
+                request=request,
+            )
+        return sse_response()
+
+    client = make_client(handler)
+    assert len(list(client._stream("/v1/chat/completions", {}))) == 2
+
+
+def test_stream_open_503_retried_with_retry_after():
+    calls = []
+
+    def handler(request):
+        calls.append(1)
+        if len(calls) == 1:
+            return httpx.Response(
+                503,
+                json={"error": {"message": "draining"}},
+                headers={"Retry-After": "0"},
+            )
+        return sse_response()
+
+    client = make_client(handler)
+    assert len(list(client._stream("/v1/chat/completions", {}))) == 2
+    assert len(calls) == 2
+
+
+def test_stream_open_504_not_retried():
+    calls = []
+
+    def handler(request):
+        calls.append(1)
+        return httpx.Response(
+            504, json={"error": {"message": "deadline", "type": "deadline"}}
+        )
+
+    client = make_client(handler)
+    with pytest.raises(DeadlineExceeded):
+        list(client._stream("/v1/chat/completions", {}))
+    assert len(calls) == 1  # the same request would blow the same budget
+
+
+def test_stream_retries_exhausted_typed():
+    calls = []
+
+    def handler(request):
+        calls.append(1)
+        raise httpx.ConnectError("connection refused", request=request)
+
+    client = make_client(handler, max_retries=2)
+    with pytest.raises(SDKConnectionError):
+        list(client._stream("/v1/chat/completions", {}))
+    assert len(calls) == 3  # initial + 2 retries
+
+
+def test_midstream_failure_never_retried():
+    """The non-idempotency guard: once a token chunk has been yielded,
+    a connection failure must propagate — a silent replay would hand
+    the caller duplicated tokens."""
+    calls = []
+
+    def content():
+        yield b'data: {"chunk": 1}\n\n'
+        raise httpx.ReadError("connection reset mid-stream")
+
+    def handler(request):
+        calls.append(1)
+        return httpx.Response(
+            200,
+            content=content(),
+            headers={"content-type": "text/event-stream"},
+        )
+
+    client = make_client(handler)
+    got = []
+    with pytest.raises(SDKConnectionError):
+        for chunk in client._stream("/v1/chat/completions", {}):
+            got.append(chunk)
+    assert got == [{"chunk": 1}]
+    assert len(calls) == 1  # no second attempt
+
+
+async def test_async_stream_open_retried():
+    calls = []
+
+    def handler(request):
+        calls.append(1)
+        if len(calls) == 1:
+            raise httpx.ConnectError("connection refused", request=request)
+        return sse_response()
+
+    client = make_async_client(handler)
+    chunks = [c async for c in client._stream("/v1/chat/completions", {})]
+    assert [c["chunk"] for c in chunks] == [1, 2]
+    assert len(calls) == 2
+
+
+async def test_async_midstream_failure_never_retried():
+    calls = []
+
+    async def content():
+        yield b'data: {"chunk": 1}\n\n'
+        raise httpx.ReadError("connection reset mid-stream")
+
+    def handler(request):
+        calls.append(1)
+        return httpx.Response(
+            200,
+            content=content(),
+            headers={"content-type": "text/event-stream"},
+        )
+
+    client = make_async_client(handler)
+    got = []
+    with pytest.raises(SDKConnectionError):
+        async for chunk in client._stream("/v1/chat/completions", {}):
+            got.append(chunk)
+    assert got == [{"chunk": 1}]
+    assert len(calls) == 1

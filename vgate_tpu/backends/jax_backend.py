@@ -126,7 +126,14 @@ class JaxTPUBackend:
         # accept the full VGTConfig through the seam; fall back to the global
         # for callers that still pass only the model section
         self._config = config if hasattr(config, "tpu") else get_config()
-        if self._config.tpu.dp > 1:
+        if getattr(self._config, "pod", None) and self._config.pod.workers > 0:
+            # process-isolated workers: the gateway routes over N engine
+            # processes with fencing/failover; takes precedence over
+            # in-process dp (each worker is its own full engine stack)
+            from vgate_tpu.runtime.pod_engine import PodEngine
+
+            self.core = PodEngine(self._config)
+        elif self._config.tpu.dp > 1:
             # dp replicas have their own failover; unsupervised
             from vgate_tpu.runtime.dp_engine import ReplicatedEngine
 

@@ -562,6 +562,68 @@ class MigrationConfig(BaseModel):
     min_generated_tokens: int = 8
 
 
+class PodConfig(BaseModel):
+    """Process-isolated engine workers (runtime/pod_engine.py +
+    runtime/worker.py): the gateway process runs the HTTP surface,
+    batcher and admission; each engine lives in its own worker
+    process, reached over a length-prefixed frame protocol on a
+    unix-domain (or localhost TCP) socket.  One wedged engine, native
+    crash or OOM then costs one worker — the pod degrades and heals
+    (heartbeats → route-around → supervised respawn → canary gate)
+    instead of dying.  ``workers=0`` (the default) keeps today's
+    in-process engines byte-identical; the restart budget/backoff and
+    the canary gate reuse ``recovery.*`` / ``integrity.*``."""
+
+    # Engine worker processes.  0 = in-process engines (EngineCore /
+    # EngineSupervisor / ReplicatedEngine exactly as before); N >= 1
+    # spawns N single-engine worker processes behind a PodEngine
+    # router presenting the ReplicatedEngine surface.
+    workers: int = 0
+    # uds = unix-domain sockets under socket_dir (default: a private
+    # tempdir); tcp = 127.0.0.1:port_base+i (environments without UDS).
+    transport: str = "uds"
+    socket_dir: str = ""
+    port_base: int = 9310
+    # Worker interpreter override (tests/drills); empty = sys.executable.
+    python: str = ""
+    # Bounded RPC plane: every connect and every call carries a
+    # deadline — a wedged worker must cost a timeout, never a hang.
+    connect_timeout_s: float = 10.0
+    call_timeout_s: float = 30.0
+    # Worker boot → hello budget (imports + weight init + first pools;
+    # generous because CPU CI machines are slow and real boots compile).
+    spawn_timeout_s: float = 180.0
+    # Heartbeat liveness: the gateway pings every worker at this
+    # cadence; a worker whose last successful ping is older than
+    # heartbeat_timeout_s is declared lost (its in-flight requests
+    # resubmit to survivors and a respawn begins).  The worker-side
+    # engine beat rides back on each ping and is judged with the PR-5
+    # classifier (recovery.step_stall_s / compile_grace_s), so a
+    # first-compile pause never reads as death.
+    heartbeat_interval_s: float = 0.5
+    heartbeat_timeout_s: float = 10.0
+    # Frame-size ceiling both directions: an oversized length prefix is
+    # a protocol violation (typed error + connection teardown), never
+    # an attempted allocation.
+    max_frame_bytes: int = 8 * 1024 * 1024
+
+    @field_validator("transport")
+    @classmethod
+    def _check_transport(cls, v: str) -> str:
+        if v not in ("uds", "tcp"):
+            raise ValueError(
+                f"pod.transport must be 'uds' or 'tcp', got {v!r}"
+            )
+        return v
+
+    @field_validator("workers")
+    @classmethod
+    def _check_workers(cls, v: int) -> int:
+        if v < 0:
+            raise ValueError("pod.workers must be >= 0")
+        return v
+
+
 class LifecycleConfig(BaseModel):
     """Graceful shutdown/drain (server/app.py + vgate_tpu/lifecycle.py):
     SIGTERM flips /health/ready to 503 ("draining"), admission stops
@@ -844,6 +906,7 @@ class VGTConfig(BaseModel):
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     lifecycle: LifecycleConfig = Field(default_factory=LifecycleConfig)
     migration: MigrationConfig = Field(default_factory=MigrationConfig)
+    pod: PodConfig = Field(default_factory=PodConfig)
     integrity: IntegrityConfig = Field(default_factory=IntegrityConfig)
     admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
     inference: InferenceConfig = Field(default_factory=InferenceConfig)

@@ -204,6 +204,38 @@ class KVCapacityError(RetryableError):
         super().__init__(message, retry_after=retry_after)
 
 
+class WorkerLostError(RetryableError):
+    """An engine worker process went away mid-request (crash, kill -9,
+    OOM, socket EOF, or heartbeat timeout) and the request could not be
+    resubmitted to a surviving worker (no survivor, resume budget
+    exhausted, or the resubmit itself failed).  Retryable: the pod is
+    DEGRADED while the supervised respawn runs, and another worker (or
+    the respawned one, once canary-gated back in) serves the retry.
+    The common case never raises this at all — in-flight sequences are
+    checkpoint-folded and resubmitted to survivors with zero 5xx
+    (runtime/pod_engine.py)."""
+
+    reason = "worker_lost"
+
+    def __init__(self, message: str, retry_after: float = 2.0) -> None:
+        super().__init__(message, retry_after=retry_after)
+
+
+class WorkerFencedError(RetryableError):
+    """An RPC frame carried a stale fencing epoch: the sender belongs
+    to a previous incarnation of the worker slot (a zombie the gateway
+    already declared lost and replaced, or a gateway talking to a
+    restarted worker with pre-restart state).  The frame was rejected
+    — late work from a fenced incarnation must never interleave with
+    the live one's token stream (the PR-5 stale-wake epoch guard,
+    cross-process).  Clients only ever see this as a routine retryable
+    503 if a fenced rejection reaches a submission path; zombie frames
+    the gateway discards are counted by ``vgt_pod_fenced_frames``
+    instead of surfacing anywhere."""
+
+    reason = "worker_fenced"
+
+
 class IntegrityError(RetryableError):
     """Silent data corruption detected (vgate_tpu/integrity.py): an
     output sentinel tripped on a decode readback (NaN/Inf, all-zero or

@@ -34,6 +34,15 @@ Probed sites (each calls :func:`check` with the point name):
                     the on-device logit-guard flag word so the output
                     sentinels trip exactly as they would on NaN logits
                     (requires ``integrity.logit_guard``)
+``rpc_send``        worker RPC plane (runtime/rpc.py), outbound frame —
+                    wire modes apply: ``drop`` discards the frame
+                    unsent, ``garble`` scrambles its bytes on the wire
+                    (the peer sees a framing violation → typed error +
+                    connection teardown), ``delay`` stalls the send,
+                    ``raise`` fails it
+``rpc_recv``        worker RPC plane, inbound frame — same wire modes,
+                    applied after a frame decodes (``garble`` instead
+                    corrupts the raw bytes before decoding)
 ==================  ====================================================
 
 Arming — programmatic (tests)::
@@ -82,7 +91,14 @@ FAULT_POINTS = (
     "stall",
     "weight_corrupt",
     "logit_corrupt",
+    "rpc_send",
+    "rpc_recv",
 )
+
+# wire modes only make sense where there is a wire: the RPC plane probes
+# via wire_action(), everything else probes via check()/corrupt_array()
+WIRE_POINTS = ("rpc_send", "rpc_recv")
+WIRE_MODES = ("drop", "garble")
 
 # `corrupt` routes the supervisor/dp repair to the RELOAD rebuild path
 # (weights-kept restarts would preserve the corruption) — see
@@ -129,7 +145,7 @@ def fingerprint(payload: Any) -> str:
 @dataclass
 class FaultSpec:
     point: str
-    mode: str = "raise"  # raise | delay | corrupt
+    mode: str = "raise"  # raise | delay | corrupt | drop | garble
     kind: str = "transient"  # transient | poison | unrecoverable
     times: int = 1  # fires remaining; -1 = unlimited
     probability: float = 1.0
@@ -171,8 +187,12 @@ def arm(
         raise ValueError(
             f"unknown fault point {point!r}; valid: {FAULT_POINTS}"
         )
-    if mode not in ("raise", "delay", "corrupt"):
+    if mode not in ("raise", "delay", "corrupt") + WIRE_MODES:
         raise ValueError(f"unknown fault mode {mode!r}")
+    if mode in WIRE_MODES and point not in WIRE_POINTS:
+        raise ValueError(
+            f"mode {mode!r} is wire-only; valid points: {WIRE_POINTS}"
+        )
     if kind not in FAULT_KINDS:
         raise ValueError(f"unknown fault kind {kind!r}")
     spec = FaultSpec(
@@ -234,16 +254,16 @@ def snapshot() -> List[Dict[str, Any]]:
         ]
 
 
-def _take(
-    point: str, payload: Any, want_corrupt: bool
-) -> Optional[FaultSpec]:
-    """Pick the first armed spec at ``point`` that matches and fires,
-    consuming one charge.  Called with the registry lock held.
-    ``want_corrupt`` splits the two probe families: ``check`` consumes
-    raise/delay specs, ``corrupt_array`` consumes corrupt specs."""
+def _take(point: str, payload: Any, modes) -> Optional[FaultSpec]:
+    """Pick the first armed spec at ``point`` whose mode is in ``modes``,
+    matches, and fires — consuming one charge.  Called with the registry
+    lock held.  The mode filter splits the probe families: ``check``
+    consumes raise/delay specs, ``corrupt_array``/``take_corrupt``
+    consume corrupt specs, and ``wire_action`` (the RPC plane) consumes
+    raise/delay/drop/garble specs."""
     global _active
     for spec in _specs.get(point, ()):
-        if (spec.mode == "corrupt") is not want_corrupt:
+        if spec.mode not in modes:
             continue
         if spec.times == 0:
             continue
@@ -279,7 +299,7 @@ def check(point: str, payload: Any = None) -> None:
     if not _active:
         return
     with _lock:
-        spec = _take(point, payload, want_corrupt=False)
+        spec = _take(point, payload, modes=("raise", "delay"))
     if spec is None:
         return
     from vgate_tpu import metrics
@@ -288,6 +308,38 @@ def check(point: str, payload: Any = None) -> None:
     if spec.mode == "delay":
         time.sleep(spec.delay_s)
         return
+    fp = fingerprint(payload) if payload is not None else None
+    raise InjectedFault(point, kind=spec.kind, fingerprint=fp)
+
+
+def wire_action(point: str, payload: Any = None) -> Optional[str]:
+    """Probe call for the worker RPC plane (vgate_tpu/runtime/rpc.py).
+    Returns the wire verdict for one frame: ``None`` (send/deliver it
+    untouched, the overwhelmingly common disarmed fast path), ``"drop"``
+    (discard the frame silently — the peer sees a missing reply and its
+    call deadline fires), or ``"garble"`` (the caller scrambles the raw
+    frame bytes so the peer hits a framing violation and tears the
+    connection down).  ``delay`` specs sleep here and then deliver;
+    ``raise`` specs raise :class:`InjectedFault` at the wire call site."""
+    if not _active:
+        return None
+    if point not in WIRE_POINTS:
+        raise ValueError(
+            f"wire_action probed at non-wire point {point!r}; "
+            f"valid: {WIRE_POINTS}"
+        )
+    with _lock:
+        spec = _take(point, payload, modes=("raise", "delay") + WIRE_MODES)
+    if spec is None:
+        return None
+    from vgate_tpu import metrics
+
+    metrics.FAULTS_INJECTED.labels(point=point, mode=spec.mode).inc()
+    if spec.mode == "delay":
+        time.sleep(spec.delay_s)
+        return None
+    if spec.mode in WIRE_MODES:
+        return spec.mode
     fp = fingerprint(payload) if payload is not None else None
     raise InjectedFault(point, kind=spec.kind, fingerprint=fp)
 
@@ -301,7 +353,7 @@ def corrupt_array(point: str, array):
     if not _active:
         return array
     with _lock:
-        spec = _take(point, None, want_corrupt=True)
+        spec = _take(point, None, modes=("corrupt",))
         if spec is None:
             return array
     from vgate_tpu import metrics
@@ -319,7 +371,7 @@ def take_corrupt(point: str) -> bool:
     if not _active:
         return False
     with _lock:
-        spec = _take(point, None, want_corrupt=True)
+        spec = _take(point, None, modes=("corrupt",))
     if spec is None:
         return False
     from vgate_tpu import metrics

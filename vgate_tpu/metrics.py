@@ -365,6 +365,38 @@ REPLICAS_DRAINING = _safe_metric(
     "residents migrated to survivors)",
 )
 
+# --- process-isolated worker pod (pod.workers > 0): gateway/worker split ---
+POD_WORKERS_ALIVE = _safe_metric(
+    Gauge,
+    "vgt_pod_workers_alive",
+    "Engine worker PROCESSES currently serving (passed the canary "
+    "gate, heartbeat fresh)",
+)
+POD_WORKERS_TOTAL = _safe_metric(
+    Gauge,
+    "vgt_pod_workers_total",
+    "Configured engine worker processes (pod.workers)",
+)
+POD_WORKER_RESTARTS = _safe_metric(
+    Counter,
+    "vgt_pod_worker_restarts",
+    "Worker processes respawned by the gateway supervisor and admitted "
+    "back through the canary gate",
+)
+POD_WORKER_LOSSES = _safe_metric(
+    Counter,
+    "vgt_pod_worker_losses",
+    "Worker incarnations declared lost by the gateway, by signal",
+    # crash (pid exited) | heartbeat (wedged/zombie) | eof (conn died)
+    labelnames=("reason",),
+)
+POD_FENCED_FRAMES = _safe_metric(
+    Counter,
+    "vgt_pod_fenced_frames",
+    "Late frames from a fenced (replaced) worker incarnation discarded "
+    "by the gateway's epoch check instead of corrupting live streams",
+)
+
 # --- request lifecycle: deadlines, cancellation, graceful drain ---
 CANCELLED_REQUESTS = _safe_metric(
     Counter,

@@ -1,0 +1,1450 @@
+"""Gateway-side pod of process-isolated engine workers.
+
+``pod.workers > 0`` replaces the in-process engine stack behind the
+backend seam with this router: N worker *processes* (each running the
+full EngineCore/EngineSupervisor stack — runtime/worker.py), reached
+over the length-prefixed frame protocol (runtime/rpc.py) on unix-domain
+or localhost-TCP sockets.  PodEngine presents the SAME surface
+ReplicatedEngine does — submit/stream/abort, health/stats/pressure,
+/admin/replicas drain — so the batcher, admission, metrics and the
+server never learn which mode they are in; ``pod.workers = 0`` keeps
+the in-process path byte-identical.
+
+Robustness contracts (the point of the process boundary):
+
+* **Heartbeat liveness** — a monitor thread pings every worker at
+  ``pod.heartbeat_interval_s``; the worker's engine beat rides back on
+  each ping and is judged with the PR-5 classifier
+  (``recovery.step_stall_s`` / ``compile_grace_s``), so a first-compile
+  pause never reads as death.  No successful ping for
+  ``pod.heartbeat_timeout_s`` → the worker is declared lost.
+* **Fencing epochs** — every incarnation of a worker slot gets a
+  monotonically-increasing epoch; declaring a worker lost bumps the
+  slot's epoch IMMEDIATELY, so every late frame from the zombie
+  (token, done, reply) mis-stamps against the current epoch and is
+  discarded and counted (``vgt_pod_fenced_frames``) instead of
+  corrupting the replacement's token streams — the PR-5 stale-wake
+  epoch guard, cross-process.
+* **Zero-5xx worker loss** — the gateway holds every in-flight
+  request's full state (prompt + generated so far), so a crash/kill -9
+  /heartbeat loss folds each affected sequence (``prepare_resume``,
+  the PR-1/5 checkpoint fold) and resubmits it to a survivor; RNG
+  continuation is implicit (see SequenceCheckpoint), so greedy and
+  seeded streams stay token-identical.  Only an exhausted resume
+  budget or a fully-dead pod surfaces the typed retryable
+  ``WorkerLostError``.
+* **Supervised respawn + canary gate** — losses draw on the SAME
+  sliding restart budget dp uses (``recovery.max_restarts`` /
+  ``restart_window_s``, shared across slots: one sick pod, one
+  budget), respawns back off exponentially, and a respawned worker
+  must answer the PR-9 pinned-greedy canary with the pod's recorded
+  fingerprint before it becomes routable.
+* **Drain / migrate per worker** — /admin/replicas drain maps to the
+  ``evacuate`` RPC verb; the returned sequences replay onto survivors
+  exactly like dp's ``_redistribute`` (``prepare_migrate``: never
+  spends the crash-resume budget).  A worker dying mid-drain falls
+  back to the loss path — same fold, same replay, crash counters.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import zlib
+from types import SimpleNamespace
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+
+from vgate_tpu import metrics
+from vgate_tpu.config import VGTConfig, get_config
+from vgate_tpu.errors import (
+    MigrationRefusedError,
+    ResumeExhaustedError,
+    WorkerLostError,
+    raise_for_state,
+    state_is_alive,
+    state_is_ready,
+)
+from vgate_tpu.logging_config import get_logger
+from vgate_tpu.models.specs import spec_for_model_id
+from vgate_tpu.observability import perf as perf_attr
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+from vgate_tpu.runtime.supervisor import (
+    HealthState,
+    classify_heartbeat,
+    restart_budget_remaining,
+)
+from vgate_tpu.runtime.tokenizer import get_tokenizer
+from vgate_tpu.runtime.worker import params_to_wire, unwire_error
+from vgate_tpu.runtime.worker_client import WorkerClient
+
+logger = get_logger(__name__)
+
+# Threading contract (scripts/vgt_lint.py, checker thread-discipline).
+# ONE reentrant pod lock guards topology (worker handles, epochs) and
+# the in-flight table together — loss handling moves sequences between
+# both atomically.  RPC calls NEVER run under it (snapshot-then-call),
+# so a wedged worker can stall an RPC thread but never the pod lock.
+VGT_COMPONENTS: Dict[str, str] = {}
+VGT_LOCK_GUARDS = {
+    "_inflight": "_lock",
+    "_orphans": "_lock",
+    "_restart_times": "_lock",
+}
+
+# spawn-time connect poll cadence (the worker binds its listener before
+# building the engine, so the socket appears in milliseconds; the slow
+# part — engine build — is budgeted by the hello call's timeout)
+_CONNECT_POLL_S = 0.05
+
+
+class _PodSequence(Sequence):
+    """Gateway-side sequence whose abort propagates to the owning
+    worker.  Inherits the dataclass-generated ``__init__``; the pod
+    wiring rides on class-level defaults overwritten per instance."""
+
+    _pod: Optional["PodEngine"] = None
+    _sid: int = -1
+    _worker_idx: int = -1
+
+    def request_abort(self, reason: str = "client_disconnect") -> None:
+        super().request_abort(reason)
+        pod = self._pod
+        if pod is not None:
+            pod._abort_remote(self, reason)
+
+
+class _Worker:
+    """One worker slot's handle: process + connection + incarnation."""
+
+    def __init__(self, idx: int) -> None:
+        self.idx = idx
+        self.epoch = 0  # bumps on every (re)spawn AND on declared loss
+        self.proc: Optional[subprocess.Popen] = None
+        self.client: Optional[WorkerClient] = None
+        self.hello: Dict[str, Any] = {}
+        # down | spawning | serving | dead (budget exhausted)
+        self.state = "down"
+        self.draining = False
+        self.last_fatal: Optional[str] = None
+        self.last_ping: Dict[str, Any] = {}
+        self.last_ok_t = time.monotonic()
+        self.respawning = False
+        self.address: Any = None
+
+    @property
+    def alive(self) -> bool:
+        return self.state == "serving"
+
+
+class PodEngine:
+    """ReplicatedEngine's surface over worker processes."""
+
+    def __init__(self, config: Optional[VGTConfig] = None) -> None:
+        self.config = config or get_config()
+        pod = self.config.pod
+        if pod.workers < 1:
+            raise ValueError("PodEngine requires pod.workers >= 1")
+        self._pod_cfg = pod
+        self._recovery = self.config.recovery
+        self.spec = spec_for_model_id(self.config.model.model_id)
+        self.tokenizer = get_tokenizer(
+            self.spec,
+            self.config.model.tokenizer_path
+            or self.config.model.checkpoint_path,
+        )
+        self._lock = threading.RLock()
+        self._inflight: Dict[int, _PodSequence] = {}
+        self._orphans: List[_PodSequence] = []
+        self._sids = itertools.count(1)
+        self._rr = itertools.count()
+        self._restart_times: List[float] = []
+        self._fenced_clients: List[WorkerClient] = []
+        self._zombie_procs: List[subprocess.Popen] = []
+        self._stopping = False
+        self._monitor: Optional[threading.Thread] = None
+        self.total_failovers = 0
+        self.total_restarts = 0
+        self.total_stalls = 0
+        self.total_resumed = 0
+        self.total_migrated = 0
+        self.total_lost = 0
+        self.fenced_frames = 0
+        self._canary_expected: Optional[str] = None
+
+        self._own_socket_dir = not pod.socket_dir
+        self.socket_dir = pod.socket_dir or tempfile.mkdtemp(
+            prefix="vgt-pod-"
+        )
+        self._config_path = self._write_worker_config()
+        self.workers = [_Worker(i) for i in range(pod.workers)]
+        try:
+            self._boot_all()
+        except BaseException:
+            self.stop()
+            raise
+        lead = self.workers[0].hello
+        # the backend seam logs core.mesh.shape.items() and
+        # core.geometry.num_pages; present the lead worker's view plus
+        # the pod axis, like dp presents dp=N
+        self.mesh = SimpleNamespace(
+            shape=dict(lead.get("mesh", {}), workers=pod.workers)
+        )
+        geo = lead.get("geometry", {})
+        self.geometry = SimpleNamespace(
+            num_pages=int(geo.get("num_pages", 0)) * pod.workers,
+            page_size=int(geo.get("page_size", 0)),
+            kv_dtype=geo.get("kv_dtype"),
+        )
+        self.load_time_s = sum(
+            float(w.hello.get("load_time_s", 0.0)) for w in self.workers
+        )
+        logger.info(
+            "pod engine ready",
+            extra={
+                "extra_data": {
+                    "workers": pod.workers,
+                    "transport": pod.transport,
+                    "model": self.spec.name,
+                }
+            },
+        )
+
+    # ------------------------------------------------------------ boot
+
+    def _write_worker_config(self) -> str:
+        """Dump the RESOLVED gateway config for workers (JSON is valid
+        YAML, so load_config-style tooling can read it too).  Workers
+        must not recurse into pod mode and host exactly one engine."""
+        dump = self.config.model_dump()
+        dump["pod"]["workers"] = 0
+        dump["tpu"]["dp"] = 1
+        fd, path = tempfile.mkstemp(
+            prefix="vgt-worker-cfg-", suffix=".json", dir=self.socket_dir
+        )
+        with os.fdopen(fd, "w") as fh:
+            json.dump(dump, fh)
+        return path
+
+    def _boot_all(self) -> None:
+        errors: List[BaseException] = []
+
+        def boot(w: _Worker) -> None:
+            try:
+                self._spawn_and_gate(w)
+            except BaseException as exc:  # noqa: BLE001 — collected
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(
+                target=boot, args=(w,), daemon=True,
+                name=f"vgt-pod-boot-{w.idx}",
+            )
+            for w in self.workers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self._pod_cfg.spawn_timeout_s + 30.0)
+        if errors:
+            raise RuntimeError(
+                f"pod boot failed: {errors[0]}"
+            ) from errors[0]
+        if any(not w.alive for w in self.workers):
+            raise RuntimeError("pod boot failed: worker never became ready")
+
+    def _worker_env(self, w: _Worker) -> Dict[str, str]:
+        env = dict(os.environ)
+        # `-m vgate_tpu.runtime.worker` must resolve THIS vgate_tpu no
+        # matter what cwd the gateway was launched from
+        import vgate_tpu as _pkg
+
+        pkg_root = os.path.dirname(os.path.dirname(_pkg.__file__))
+        paths = env.get("PYTHONPATH", "")
+        if pkg_root not in paths.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + paths if paths else "")
+            )
+        # the gateway's own chaos config must not leak into workers —
+        # a fault armed for the gateway wire would double-fire
+        env.pop("VGT_FAULTS", None)
+        env.pop("VGT_CHAOS", None)
+        # drills target a SPECIFIC worker's FIRST incarnation:
+        # VGT_POD_WORKER_FAULTS="0=decode_step:raise;1=rpc_send:delay:delay=30"
+        # (respawned incarnations boot clean — the fault made its point)
+        spec = os.environ.get("VGT_POD_WORKER_FAULTS", "")
+        if spec and w.epoch == 1:
+            for part in spec.split(";"):
+                if "=" not in part:
+                    continue
+                idx_s, fault = part.split("=", 1)
+                try:
+                    if int(idx_s) == w.idx:
+                        env["VGT_FAULTS"] = fault
+                except ValueError:
+                    continue
+        return env
+
+    def _spawn(self, w: _Worker) -> None:
+        """Launch one worker incarnation (caller holds no RPCs; the
+        epoch was already bumped by the caller)."""
+        pod = self._pod_cfg
+        if pod.transport == "uds":
+            path = os.path.join(
+                self.socket_dir, f"w{w.idx}.e{w.epoch}.sock"
+            )
+            w.address = path
+            sock_args = ["--socket", path]
+        else:
+            # TCP reuses a stable per-slot port, so any previous
+            # incarnation still bound to it must die first
+            port = pod.port_base + w.idx
+            w.address = ("127.0.0.1", port)
+            sock_args = ["--port", str(port)]
+        cmd = [
+            pod.python or sys.executable,
+            "-m",
+            "vgate_tpu.runtime.worker",
+            *sock_args,
+            "--epoch",
+            str(w.epoch),
+            "--config",
+            self._config_path,
+            "--index",
+            str(w.idx),
+        ]
+        w.proc = subprocess.Popen(cmd, env=self._worker_env(w))
+        logger.info(
+            "spawned engine worker",
+            extra={
+                "extra_data": {
+                    "worker": w.idx, "epoch": w.epoch, "pid": w.proc.pid,
+                }
+            },
+        )
+
+    def _connect(self, w: _Worker) -> WorkerClient:
+        """Connect to the freshly-spawned worker: poll until its
+        listener exists (bound before the engine builds, so this is
+        fast), bounded by spawn_timeout_s; a worker that dies while we
+        wait fails immediately instead of burning the budget."""
+        pod = self._pod_cfg
+        deadline = time.monotonic() + pod.spawn_timeout_s
+        epoch = w.epoch
+        idx = w.idx
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            if w.proc is not None and w.proc.poll() is not None:
+                raise WorkerLostError(
+                    f"worker {idx} (epoch {epoch}) exited with "
+                    f"{w.proc.returncode} during boot"
+                )
+            try:
+                return WorkerClient(
+                    w.address,
+                    epoch,
+                    max_frame_bytes=pod.max_frame_bytes,
+                    connect_timeout_s=pod.connect_timeout_s,
+                    call_timeout_s=pod.call_timeout_s,
+                    on_notify=lambda f, i=idx, e=epoch: self._on_frame(
+                        i, e, f
+                    ),
+                    on_lost=lambda exc, i=idx, e=epoch: self._on_lost(
+                        i, e, exc
+                    ),
+                    label=f"worker{idx}.e{epoch}",
+                )
+            except (FileNotFoundError, ConnectionRefusedError, OSError) as exc:
+                last = exc
+                time.sleep(_CONNECT_POLL_S)
+        raise WorkerLostError(
+            f"worker {idx} (epoch {epoch}) never accepted a connection "
+            f"within {pod.spawn_timeout_s:.0f}s: {last}"
+        ) from last
+
+    def _spawn_and_gate(self, w: _Worker) -> None:
+        """Spawn → connect → hello → canary gate → routable.  Raises on
+        any step failing; the caller owns retry/budget policy."""
+        with self._lock:
+            w.epoch += 1
+            w.state = "spawning"
+            w.draining = False
+        self._spawn(w)
+        client = self._connect(w)
+        try:
+            hello = client.call(
+                "hello", timeout=self._pod_cfg.spawn_timeout_s
+            )
+            self._canary_gate(w, client)
+        except BaseException:
+            client.close()
+            raise
+        with self._lock:
+            w.client = client
+            w.hello = hello
+            w.last_ok_t = time.monotonic()
+            w.last_fatal = None
+            w.state = "serving"
+        self._set_alive_gauge()
+        self._drain_orphans()
+
+    def _canary_gate(self, w: _Worker, client: WorkerClient) -> None:
+        """PR-9 pinned-greedy gate before the worker becomes routable:
+        identical weights + greedy decode ⇒ identical fingerprint
+        across every worker and every incarnation.  First answer
+        records; every later one must match."""
+        icfg = self.config.integrity
+        timeout = (
+            icfg.canary_timeout_s + icfg.canary_compile_grace_s + 30.0
+        )
+        reply = client.call("canary", timeout=timeout)
+        fp = reply.get("fingerprint")
+        with self._lock:
+            if self._canary_expected is None:
+                self._canary_expected = fp
+                return
+            expected = self._canary_expected
+        if fp != expected:
+            metrics.CANARY_FAILURES.inc()
+            raise RuntimeError(
+                f"worker {w.idx} (epoch {w.epoch}) failed the canary "
+                f"gate: fingerprint {fp} != recorded {expected}"
+            )
+
+    def start(self) -> None:
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="vgt-pod-monitor"
+        )
+        self._monitor.start()
+
+    # ------------------------------------------------------- frame dispatch
+
+    def _on_frame(self, idx: int, client_epoch: int, frame: Dict[str, Any]) -> None:
+        w = self.workers[idx]
+        fe = frame.get("e")
+        if not isinstance(fe, int) or fe != w.epoch:
+            # late frame from a fenced incarnation (zombie declared
+            # lost, or replaced after a drain): discard + count — it
+            # must never interleave into the live incarnation's streams
+            with self._lock:
+                self.fenced_frames += 1
+            metrics.POD_FENCED_FRAMES.inc()
+            return
+        op = frame.get("op")
+        if op == "tok":
+            self._on_token(idx, frame)
+        elif op == "done":
+            self._on_done(idx, frame)
+        elif op == "err":
+            self._on_err(idx, frame)
+        elif op == "evacuated":
+            self._on_evacuated(idx, frame)
+
+    def _seq_for(self, idx: int, frame: Dict[str, Any]) -> Optional[_PodSequence]:
+        with self._lock:
+            seq = self._inflight.get(frame.get("sid"))
+        if seq is None or seq._worker_idx != idx:
+            return None  # settled, aborted, or resubmitted elsewhere
+        return seq
+
+    def _on_token(self, idx: int, frame: Dict[str, Any]) -> None:
+        seq = self._seq_for(idx, frame)
+        if seq is None:
+            return
+        lp = frame.get("lp")
+        if lp is not None and seq.params.logprobs:
+            # raw (chosen_lp, [(tid, lp), ...]) data — the gateway's
+            # lp_entry renders it with its own tokenizer
+            seq.logprob_data.append(
+                (float(lp[0]), [(int(t), float(l)) for t, l in lp[1]])
+            )
+        seq.append_token(int(frame["t"]))
+
+    def _on_done(self, idx: int, frame: Dict[str, Any]) -> None:
+        seq = self._seq_for(idx, frame)
+        if seq is None:
+            return
+        with self._lock:
+            self._inflight.pop(seq._sid, None)
+        text = frame.get("text")
+        if text is not None:
+            # the worker's final text is authoritative (stop-string
+            # truncation happened against ITS decode state)
+            seq.text_override = text
+        lp = frame.get("lp")
+        if lp is not None and seq.params.logprobs:
+            seq.logprob_data = [
+                (float(e[0]), [(int(t), float(l)) for t, l in e[1]])
+                for e in lp
+            ]
+        # worker-internal supervisor restarts also bump these; take the
+        # max of both views so neither hop under-reports
+        seq.resume_count = max(
+            seq.resume_count, int(frame.get("resume_count", 0))
+        )
+        seq.migrate_count = max(
+            seq.migrate_count, int(frame.get("migrate_count", 0))
+        )
+        seq.finish(str(frame.get("finish_reason", "stop")))
+
+    def _on_err(self, idx: int, frame: Dict[str, Any]) -> None:
+        seq = self._seq_for(idx, frame)
+        if seq is None:
+            return
+        with self._lock:
+            self._inflight.pop(seq._sid, None)
+        seq.fail(unwire_error(frame.get("error") or {}))
+
+    def _on_evacuated(self, idx: int, frame: Dict[str, Any]) -> None:
+        """Worker-initiated drain (SIGTERM straight to the worker —
+        rolling OS-level restarts): replay its evacuated sequences onto
+        survivors as planned movements."""
+        sids = [int(e["sid"]) for e in frame.get("evacuated") or []]
+        seqs: List[_PodSequence] = []
+        with self._lock:
+            for sid in sids:
+                seq = self._inflight.pop(sid, None)
+                if seq is not None:
+                    seqs.append(seq)
+        for seq in seqs:
+            self._replay(seq, exclude=idx, planned=True)
+
+    # ------------------------------------------------------------- routing
+
+    def _alive_workers(self, exclude: Optional[int] = None) -> List[_Worker]:
+        with self._lock:
+            return [
+                w
+                for w in self.workers
+                if w.alive and not w.draining and w.idx != exclude
+            ]
+
+    def _pick_worker(
+        self,
+        prompt_ids: Optional[List[int]] = None,
+        exclude: Optional[int] = None,
+    ) -> _Worker:
+        """dp's router, over worker handles: least-loaded among routable
+        workers with prefix affinity (each worker's KV prefix cache is
+        private — requests sharing a first page stick together unless
+        that costs real queueing headroom)."""
+        candidates = self._alive_workers(exclude=exclude)
+        if not candidates:
+            # fall back to any live worker (a fully-draining pod still
+            # serves rather than 500s)
+            with self._lock:
+                live = [w for w in self.workers if w.alive]
+            if not live:
+                raise WorkerLostError(
+                    "no live engine worker (pod respawning or dead); "
+                    "retry shortly",
+                    retry_after=self.retry_after_s,
+                )
+            candidates = live
+        offset = next(self._rr) % len(candidates)
+        ordered = candidates[offset:] + candidates[:offset]
+        best = min(ordered, key=self._load)
+        page = self.config.tpu.kv_page_size
+        if (
+            prompt_ids is not None
+            and len(prompt_ids) >= page
+            and self.config.tpu.prefix_cache.enabled
+        ):
+            block = bytes(
+                b
+                for t in prompt_ids[:page]
+                for b in int(t).to_bytes(4, "little")
+            )
+            sticky = self.workers[zlib.crc32(block) % len(self.workers)]
+            if (
+                sticky.alive
+                and not sticky.draining
+                and sticky.idx != exclude
+                and self._load(sticky)
+                <= self._load(best)
+                + max(2, self.config.tpu.max_batch_slots // 4)
+            ):
+                return sticky
+        return best
+
+    @staticmethod
+    def _load(w: _Worker) -> int:
+        sig = w.last_ping.get("pressure") or {}
+        return int(sig.get("engine_queue_depth", 0)) + int(
+            sig.get("running", 0)
+        )
+
+    # ---------------------------------------------------------- submission
+
+    def submit_tokens(
+        self,
+        prompt_ids: List[int],
+        params: Any,
+        stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[Any] = None,
+    ) -> Sequence:
+        raise_for_state(
+            self.state.value, retry_after=self.retry_after_s
+        )
+        seq = _PodSequence(
+            prompt_ids=list(prompt_ids),
+            params=params,
+            stream_cb=stream_cb,
+        )
+        seq._pod = self
+        seq._sid = next(self._sids)
+        if meta is not None:
+            seq.request_id = getattr(meta, "request_id", None)
+        self._dispatch_submit(seq)
+        return seq
+
+    def _dispatch_submit(
+        self, seq: _PodSequence, exclude: Optional[int] = None
+    ) -> None:
+        """Place a sequence on a worker, retrying over the remaining
+        alive workers on connection-level failures (a typed engine
+        error — quarantine, overload — propagates immediately)."""
+        prompt = seq.prompt_ids[: seq.orig_prompt_len]
+        tried: set = set()
+        last: Optional[BaseException] = None
+        for _ in range(len(self.workers)):
+            try:
+                w = self._pick_worker(prompt, exclude=exclude)
+            except WorkerLostError as exc:
+                last = exc
+                break
+            if w.idx in tried:
+                break
+            tried.add(w.idx)
+            client = w.client
+            if client is None:
+                continue
+            remaining = None
+            if seq.deadline_t is not None:
+                remaining = seq.deadline_t - time.perf_counter()
+                if remaining <= 0:
+                    remaining = 0.01  # let the worker shed it typed
+            with self._lock:
+                seq._worker_idx = w.idx
+                self._inflight[seq._sid] = seq
+            try:
+                client.call(
+                    "submit",
+                    sid=seq._sid,
+                    prompt_ids=[int(t) for t in prompt],
+                    generated_ids=[int(t) for t in seq.generated_ids],
+                    params=params_to_wire(seq.params),
+                    remaining_s=remaining,
+                    request_id=seq.request_id,
+                    resume_count=seq.resume_count,
+                    migrate_count=seq.migrate_count,
+                    preempt_count=seq.preempt_count,
+                    kv_dtype=seq.kv_dtype,
+                )
+                return
+            except (WorkerLostError, TimeoutError) as exc:
+                # connection-level failure: unregister and try the next
+                # worker (the loss machinery handles the dead one)
+                last = exc
+                with self._lock:
+                    self._inflight.pop(seq._sid, None)
+                continue
+            except BaseException:
+                with self._lock:
+                    self._inflight.pop(seq._sid, None)
+                raise
+        raise last or WorkerLostError(
+            "no engine worker accepted the request; retry shortly",
+            retry_after=self.retry_after_s,
+        )
+
+    def encode_prompt(self, prompt: str) -> List[int]:
+        ids = self.tokenizer.encode(prompt)
+        max_prompt = self.config.model.max_model_len - 1
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        return ids or [self.tokenizer.bos_id]
+
+    def submit_prompt(
+        self,
+        prompt: str,
+        params: Any,
+        stream_cb: Optional[Callable[[int], Any]] = None,
+        meta: Optional[Any] = None,
+    ) -> Sequence:
+        return self.submit_tokens(
+            self.encode_prompt(prompt), params, stream_cb, meta=meta
+        )
+
+    def generate(
+        self, prompts: Seq[str], params: Seq[Any]
+    ) -> List[Dict[str, Any]]:
+        """Blocking batch API (mirrors EngineCore.generate's shape)."""
+        seqs = [self.submit_prompt(p, sp) for p, sp in zip(prompts, params)]
+        results = []
+        for seq in seqs:
+            seq.done_event.wait()
+            if seq.status is SeqStatus.FAILED:
+                raise seq.error  # type: ignore[misc]
+            results.append(
+                {
+                    "text": self.final_text(seq),
+                    "token_ids": list(seq.generated_ids),
+                    "num_tokens": seq.num_output_tokens,
+                    "prompt_tokens": seq.orig_prompt_len,
+                    "finish_reason": seq.finish_reason,
+                    "metrics": {
+                        "ttft": seq.ttft or 0.0,
+                        "tpot": seq.tpot or 0.0,
+                        "gen_time": (seq.finish_t or 0.0) - seq.arrival_t,
+                        **seq.resume_metrics(),
+                    },
+                    **(
+                        {"logprobs": self.logprob_entries(seq)}
+                        if seq.params.logprobs
+                        else {}
+                    ),
+                }
+            )
+        return results
+
+    # ----------------------------------------------------- result assembly
+
+    def final_text(self, seq: Sequence) -> str:
+        if seq.text_override is not None:
+            return seq.text_override
+        return self.tokenizer.decode(seq.generated_ids)
+
+    def lp_entry(self, tid: int, lp: float, top) -> Dict[str, Any]:
+        return {
+            "token": self.tokenizer.decode([tid]),
+            "token_id": tid,
+            "logprob": lp,
+            "top_logprobs": [
+                {
+                    "token": self.tokenizer.decode([i]),
+                    "token_id": i,
+                    "logprob": l,
+                }
+                for i, l in top
+            ],
+        }
+
+    def logprob_entries(self, seq: Sequence) -> List[Dict[str, Any]]:
+        return [
+            self.lp_entry(tid, lp, top)
+            for tid, (lp, top) in zip(seq.generated_ids, seq.logprob_data)
+        ]
+
+    # -------------------------------------------------------------- aborts
+
+    def _abort_remote(self, seq: _PodSequence, reason: str) -> None:
+        with self._lock:
+            if seq._sid not in self._inflight:
+                return
+            w = (
+                self.workers[seq._worker_idx]
+                if 0 <= seq._worker_idx < len(self.workers)
+                else None
+            )
+            client = w.client if w is not None and w.alive else None
+        if client is None:
+            return
+        try:
+            client.notify("abort", sid=seq._sid, reason=reason)
+        except WorkerLostError:
+            pass  # loss path owns the sequence from here
+
+    def abort_in_flight(self, reason: str = "drain") -> None:
+        for w in self._alive_workers():
+            client = w.client
+            if client is None:
+                continue
+            try:
+                client.notify("abort_all", reason=reason)
+            except WorkerLostError:
+                pass
+
+    def set_spec_suspended(self, flag: bool) -> None:
+        self._broadcast("set_spec_suspended", flag=bool(flag))
+
+    def set_prefix_insert_suspended(self, flag: bool) -> None:
+        self._broadcast("set_prefix_insert_suspended", flag=bool(flag))
+
+    def _broadcast(self, op: str, **fields: Any) -> None:
+        # ALL workers, draining included (dp fans brownout toggles out
+        # the same way — a draining replica still decodes residents)
+        for w in list(self.workers):
+            client = w.client
+            if client is None or client.dead:
+                continue
+            try:
+                client.notify(op, **fields)
+            except WorkerLostError:
+                pass
+
+    # ----------------------------------------------------------- liveness
+
+    def _monitor_loop(self) -> None:
+        pod = self._pod_cfg
+        rec = self._recovery
+        while not self._stopping:
+            time.sleep(pod.heartbeat_interval_s)
+            for w in list(self.workers):
+                if self._stopping:
+                    return
+                if not w.alive:
+                    continue
+                # crash detection beats the heartbeat timeout: a dead
+                # pid is a fact, not a suspicion
+                if w.proc is not None and w.proc.poll() is not None:
+                    self._handle_loss(
+                        w.idx,
+                        w.epoch,
+                        "crash",
+                        f"worker exited with {w.proc.returncode}",
+                    )
+                    continue
+                client = w.client
+                if client is None or client.dead:
+                    continue  # loss callback owns it
+                try:
+                    ping = client.call(
+                        "ping", timeout=pod.heartbeat_interval_s * 2
+                    )
+                    w.last_ping = ping
+                    w.last_ok_t = time.monotonic()
+                except (WorkerLostError, TimeoutError):
+                    pass
+                now = time.monotonic()
+                if now - w.last_ok_t > pod.heartbeat_timeout_s:
+                    # unresponsive but process alive: the zombie case —
+                    # fence it out and replace it; its late frames are
+                    # discarded by the epoch check
+                    self._handle_loss(
+                        w.idx,
+                        w.epoch,
+                        "heartbeat",
+                        f"no ping reply for "
+                        f"{now - w.last_ok_t:.1f}s",
+                    )
+                    continue
+                beat = (w.last_ping or {}).get("beat")
+                if beat and rec.enabled:
+                    verdict = classify_heartbeat(
+                        {
+                            "t": now - float(beat.get("age_s", 0.0)),
+                            "kind": beat.get("kind"),
+                            "compiling": beat.get("compiling", False),
+                        },
+                        now,
+                        rec.step_stall_s,
+                        rec.compile_grace_s,
+                    )
+                    if verdict is not None:
+                        # the worker's OWN supervisor also sees this
+                        # stall and restarts in-process; only declare
+                        # the worker lost when the wedge outlives the
+                        # cross-process budget too
+                        if (
+                            verdict["stalled_s"]
+                            > pod.heartbeat_timeout_s
+                        ):
+                            with self._lock:
+                                self.total_stalls += 1
+                            self._handle_loss(
+                                w.idx,
+                                w.epoch,
+                                "heartbeat",
+                                f"engine beat stalled "
+                                f"{verdict['stalled_s']:.1f}s in "
+                                f"{verdict['phase']}",
+                            )
+
+    def _on_lost(self, idx: int, epoch: int, exc: Optional[BaseException]) -> None:
+        reason = "eof"
+        if exc is not None and not isinstance(exc, ConnectionError):
+            reason = "crash"
+        self._handle_loss(idx, epoch, reason, str(exc) if exc else "EOF")
+
+    def _handle_loss(
+        self, idx: int, epoch: int, reason: str, detail: str
+    ) -> None:
+        """Declare one worker incarnation lost: fence it, fail over its
+        in-flight sequences, start the supervised respawn.  Idempotent
+        per incarnation — the epoch check makes late/duplicate loss
+        signals (reader EOF racing the monitor) no-ops."""
+        with self._lock:
+            if self._stopping:
+                return
+            w = self.workers[idx]
+            if w.epoch != epoch or w.state not in ("serving",):
+                return  # already handled (or a fenced zombie's echo)
+            # bump the epoch NOW: from this instant every frame the old
+            # incarnation still emits mis-stamps and is discarded
+            w.epoch += 1
+            w.state = "down"
+            w.last_fatal = f"{reason}: {detail}"
+            self.total_failovers += 1
+            old_client, w.client = w.client, None
+            old_proc, w.proc = w.proc, None
+            victims = [
+                s for s in self._inflight.values() if s._worker_idx == idx
+            ]
+            for s in victims:
+                self._inflight.pop(s._sid, None)
+        metrics.POD_WORKER_LOSSES.labels(reason=reason).inc()
+        self._set_alive_gauge()
+        logger.error(
+            "engine worker lost",
+            extra={
+                "extra_data": {
+                    "worker": idx,
+                    "epoch": epoch,
+                    "reason": reason,
+                    "detail": detail,
+                    "inflight": len(victims),
+                }
+            },
+        )
+        if old_client is not None:
+            if reason == "heartbeat" and not old_client.dead:
+                # zombie: keep its connection DRAINING so late frames
+                # are observed (and counted as fenced) rather than
+                # buffered in the kernel; the process is reaped at
+                # stop() — killing it here would also kill the drill's
+                # evidence that fencing works
+                self._fenced_clients.append(old_client)
+            else:
+                old_client.close()
+        if old_proc is not None:
+            if reason == "heartbeat" and self._pod_cfg.transport == "uds":
+                self._zombie_procs.append(old_proc)
+            else:
+                # TCP respawn rebinds the same port; a lingering
+                # process would hold it
+                self._kill_proc(old_proc)
+        for s in victims:
+            self._replay(s, exclude=idx, planned=False)
+        threading.Thread(
+            target=self._respawn_loop,
+            args=(idx,),
+            daemon=True,
+            name=f"vgt-pod-respawn-{idx}",
+        ).start()
+
+    def _replay(
+        self, seq: _PodSequence, exclude: int, planned: bool
+    ) -> None:
+        """Fold one orphaned sequence and resubmit it to a survivor —
+        dp's ``_redistribute``, cross-process.  ``planned`` movements
+        (drain/evacuate) never spend the crash-resume budget."""
+        if seq.done_event.is_set():
+            return
+        if seq.abort_requested:
+            # the client already walked away; don't burn a survivor's
+            # slots replaying it
+            seq.finish("abort")
+            return
+        if planned:
+            seq.prepare_migrate()
+        else:
+            if seq.resume_count >= self._recovery.max_resume_attempts:
+                with self._lock:
+                    self.total_lost += 1
+                metrics.LOST_SEQUENCES.labels(reason="max_attempts").inc()
+                seq.fail(
+                    ResumeExhaustedError(
+                        f"request rode {seq.resume_count} worker losses "
+                        "and still never finished; giving up "
+                        "(retryable)",
+                        retry_after=self.retry_after_s,
+                    )
+                )
+                return
+            seq.prepare_resume()
+        try:
+            self._dispatch_submit(seq, exclude=exclude)
+        except WorkerLostError:
+            # no survivor right now: park it — the respawn completion
+            # replays orphans, and stop()/budget-exhaustion fails them
+            with self._lock:
+                self._orphans.append(seq)
+            return
+        except BaseException as exc:  # noqa: BLE001 — typed refusal
+            seq.fail(exc)
+            return
+        with self._lock:
+            if planned:
+                self.total_migrated += 1
+            else:
+                self.total_resumed += 1
+        if planned:
+            metrics.MIGRATIONS.labels(reason="drain").inc()
+        else:
+            metrics.RESUMED_SEQUENCES.inc()
+
+    def _drain_orphans(self) -> None:
+        with self._lock:
+            orphans, self._orphans = self._orphans, []
+        for seq in orphans:
+            if not seq.done_event.is_set():
+                try:
+                    self._dispatch_submit(seq)
+                except BaseException as exc:  # noqa: BLE001
+                    seq.fail(
+                        exc
+                        if isinstance(exc, WorkerLostError)
+                        else WorkerLostError(
+                            f"orphan replay failed: {exc}",
+                            retry_after=self.retry_after_s,
+                        )
+                    )
+
+    def _fail_orphans(self, detail: str) -> None:
+        with self._lock:
+            orphans, self._orphans = self._orphans, []
+        for seq in orphans:
+            if not seq.done_event.is_set():
+                with self._lock:
+                    self.total_lost += 1
+                metrics.LOST_SEQUENCES.labels(reason="no_replica").inc()
+                seq.fail(WorkerLostError(detail))
+
+    def _respawn_loop(self, idx: int) -> None:
+        """Supervised respawn with the shared sliding restart budget and
+        capped exponential backoff; a respawned worker passes the
+        canary gate before it becomes routable."""
+        w = self.workers[idx]
+        rec = self._recovery
+        while not self._stopping:
+            now = time.monotonic()
+            with self._lock:
+                if w.respawning:
+                    return
+                if restart_budget_remaining(
+                    self._restart_times, rec, now
+                ) <= 0:
+                    w.state = "dead"
+                    budget_gone = True
+                else:
+                    budget_gone = False
+                    w.respawning = True
+                    self._restart_times.append(now)
+                    backoff = min(
+                        rec.backoff_cap_s,
+                        rec.backoff_base_s
+                        * (2 ** len(self._restart_times)),
+                    )
+            if budget_gone:
+                logger.error(
+                    "worker respawn budget exhausted",
+                    extra={"extra_data": {"worker": idx}},
+                )
+                if self.state is HealthState.DEAD:
+                    self._fail_orphans(
+                        "pod is dead: worker respawn budget exhausted"
+                    )
+                return
+            time.sleep(backoff)
+            try:
+                self._spawn_and_gate(w)
+                with self._lock:
+                    w.respawning = False
+                    self.total_restarts += 1
+                metrics.POD_WORKER_RESTARTS.inc()
+                logger.warning(
+                    "engine worker respawned",
+                    extra={
+                        "extra_data": {
+                            "worker": idx, "epoch": w.epoch,
+                        }
+                    },
+                )
+                return
+            except BaseException as exc:  # noqa: BLE001 — retry loop
+                logger.error(
+                    "worker respawn attempt failed",
+                    extra={
+                        "extra_data": {
+                            "worker": idx, "error": str(exc),
+                        }
+                    },
+                )
+                with self._lock:
+                    w.respawning = False
+                if w.proc is not None:
+                    self._kill_proc(w.proc)
+                if w.client is not None:
+                    w.client.close()
+                continue
+
+    # ------------------------------------------------------------- health
+
+    @property
+    def state(self) -> HealthState:
+        alive = sum(1 for w in self.workers if w.alive)
+        if alive == 0:
+            return HealthState.DEAD
+        if alive < len(self.workers) or any(
+            w.draining for w in self.workers
+        ):
+            return HealthState.DEGRADED
+        return HealthState.SERVING
+
+    @property
+    def retry_after_s(self) -> float:
+        rec = self._recovery
+        with self._lock:
+            n = len(self._restart_times)
+        return max(
+            1.0, min(rec.backoff_cap_s, rec.backoff_base_s * (2 ** n))
+        )
+
+    def _set_alive_gauge(self) -> None:
+        alive = sum(1 for w in self.workers if w.alive)
+        metrics.POD_WORKERS_ALIVE.set(alive)
+        metrics.POD_WORKERS_TOTAL.set(len(self.workers))
+
+    def _worker_entry(self, w: _Worker, now: float) -> Dict[str, Any]:
+        if w.draining:
+            state = "draining"
+        elif w.alive:
+            state = "serving"
+        elif w.state == "dead":
+            state = "dead"
+        elif w.state in ("spawning",) or w.respawning:
+            state = "recovering"
+        else:
+            with self._lock:
+                remaining = restart_budget_remaining(
+                    self._restart_times, self._recovery, now
+                )
+            state = "recovering" if remaining > 0 else "dead"
+        entry: Dict[str, Any] = {
+            "replica": w.idx,
+            "state": state,
+            "epoch": w.epoch,
+            "pid": w.proc.pid if w.proc is not None else None,
+        }
+        if w.last_fatal:
+            entry["last_fatal"] = w.last_fatal
+        sig = (w.last_ping or {}).get("pressure") or {}
+        if sig:
+            entry["queue_depth"] = sig.get("engine_queue_depth", 0)
+            entry["running"] = sig.get("running", 0)
+        beat = (w.last_ping or {}).get("beat")
+        if beat:
+            entry["beat_age_s"] = round(float(beat.get("age_s", 0.0)), 3)
+            entry["compiling"] = bool(beat.get("compiling", False))
+        return entry
+
+    def health(self) -> Dict[str, Any]:
+        """The /health engine block — ReplicatedEngine's shape with
+        per-WORKER detail (state, epoch, pid, last fatal, beat age) so
+        operators see which process is out and which incarnation is
+        live."""
+        now = time.monotonic()
+        state = self.state
+        self._set_alive_gauge()
+        with self._lock:
+            draining = sorted(
+                w.idx for w in self.workers if w.draining
+            )
+            restarts_remaining = restart_budget_remaining(
+                self._restart_times, self._recovery, now
+            )
+        return {
+            "state": state.value,
+            "alive": state_is_alive(state.value),
+            "ready": state_is_ready(state.value),
+            "dp": len(self.workers),
+            "workers": len(self.workers),
+            "replicas_alive": sum(1 for w in self.workers if w.alive),
+            "replicas_draining": len(draining),
+            "draining": draining,
+            "replicas": [
+                self._worker_entry(w, now) for w in self.workers
+            ],
+            "failovers": self.total_failovers,
+            "restarts": self.total_restarts,
+            "restarts_remaining": restarts_remaining,
+            "stalls": self.total_stalls,
+            "resumed": self.total_resumed,
+            "migrated": self.total_migrated,
+            "lost": self.total_lost,
+            "quarantined": 0,
+            "fenced_frames": self.fenced_frames,
+        }
+
+    def device_health(self) -> Dict[str, Any]:
+        entries = []
+        for w in self.workers:
+            dev = dict(w.hello.get("device_health") or {})
+            dev["worker"] = w.idx
+            dev["alive"] = bool(dev.get("alive", False)) and w.alive
+            entries.append(dev)
+        return {
+            "alive": any(e.get("alive") for e in entries),
+            "workers": entries,
+        }
+
+    # ---------------------------------------------------------- stats/perf
+
+    def _collect(
+        self, op: str, timeout: float = 5.0, **fields: Any
+    ) -> List[Dict[str, Any]]:
+        out = []
+        for w in self._alive_workers():
+            client = w.client
+            if client is None:
+                continue
+            try:
+                out.append(client.call(op, timeout=timeout, **fields))
+            except Exception:  # noqa: BLE001 — introspection best-effort
+                continue
+        return out
+
+    def get_stats(self) -> Dict[str, Any]:
+        per_worker = self._collect("stats")
+        agg: Dict[str, Any] = {
+            key: sum(int(s.get(key, 0)) for s in per_worker)
+            for key in (
+                "steps",
+                "prefills",
+                "decode_tokens",
+                "state_rebuilds",
+                "kv_pages_total",
+                "kv_token_capacity",
+            )
+        }
+        agg["scheduler"] = {}
+        if per_worker:
+            for key, val in (per_worker[0].get("scheduler") or {}).items():
+                if isinstance(val, bool):
+                    agg["scheduler"][key] = val
+                elif isinstance(val, (int, float)):
+                    agg["scheduler"][key] = sum(
+                        s.get("scheduler", {}).get(key, 0)
+                        for s in per_worker
+                    )
+                elif isinstance(val, dict):
+                    agg["scheduler"][key] = {
+                        k2: (
+                            sum(
+                                s.get("scheduler", {})
+                                .get(key, {})
+                                .get(k2, 0)
+                                for s in per_worker
+                            )
+                            if isinstance(v2, (int, float))
+                            and not isinstance(v2, bool)
+                            else v2
+                        )
+                        for k2, v2 in val.items()
+                    }
+        agg["model"] = self.spec.name
+        agg["dp"] = len(self.workers)
+        agg["failover"] = {
+            "failovers": self.total_failovers,
+            "restarts": self.total_restarts,
+            "stalls": self.total_stalls,
+            "resumed": self.total_resumed,
+            "lost": self.total_lost,
+            "replicas_alive": sum(1 for w in self.workers if w.alive),
+        }
+        agg["migration"] = {
+            "migrated": self.total_migrated,
+            "draining": sorted(
+                w.idx for w in self.workers if w.draining
+            ),
+            "free_slices": 0,
+        }
+        perfs = [s["perf"] for s in per_worker if "perf" in s]
+        if perfs:
+            agg["perf"] = perf_attr.merge_stats(perfs)
+        agg["mesh"] = dict(self.mesh.shape)
+        agg["load_time_s"] = round(self.load_time_s, 2)
+        agg["pod"] = {
+            "workers": [
+                {
+                    "worker": w.idx,
+                    "epoch": w.epoch,
+                    "state": w.state,
+                    "draining": w.draining,
+                    "pid": w.proc.pid if w.proc is not None else None,
+                }
+                for w in self.workers
+            ],
+            "transport": self._pod_cfg.transport,
+            "fenced_frames": self.fenced_frames,
+            "inflight": len(self._inflight),
+            "orphans": len(self._orphans),
+        }
+        agg["replicas"] = per_worker
+        return agg
+
+    def pressure_signals(self) -> Dict[str, Any]:
+        """Worst-of / summed admission gauges from the cached heartbeat
+        payloads (never an extra RPC on the admission path)."""
+        ratios = []
+        depth = running = 0
+        for w in self._alive_workers():
+            sig = (w.last_ping or {}).get("pressure") or {}
+            if "kv_free_ratio" in sig:
+                ratios.append(sig["kv_free_ratio"])
+            depth += int(sig.get("engine_queue_depth", 0))
+            running += int(sig.get("running", 0))
+        out: Dict[str, Any] = {
+            "engine_queue_depth": depth,
+            "running": running,
+        }
+        if ratios:
+            out["kv_free_ratio"] = min(ratios)
+        return out
+
+    def perf_snapshot(self) -> Dict[str, Any]:
+        snaps = self._collect("perf")
+        return perf_attr.merge_snapshots(snaps) if snaps else {}
+
+    def warmup(self, buckets: Optional[List[int]] = None) -> float:
+        return sum(
+            float(r.get("seconds", 0.0))
+            for r in self._collect(
+                "warmup",
+                timeout=self._pod_cfg.spawn_timeout_s,
+                buckets=buckets,
+            )
+        )
+
+    # ---------------------------------------------------- admin / topology
+
+    def drain_replica(self, idx: int, timeout: float = 30.0) -> Dict[str, Any]:
+        """/admin/replicas drain, per worker: evacuate its residents
+        over RPC and replay them onto the other workers as planned
+        movements.  A worker dying mid-drain falls back to the loss
+        path — same fold, same replay, crash counters instead."""
+        if not 0 <= idx < len(self.workers):
+            raise MigrationRefusedError(f"no worker {idx}")
+        w = self.workers[idx]
+        if not w.alive:
+            raise MigrationRefusedError(
+                f"worker {idx} is not serving (state {w.state!r})"
+            )
+        if not self._alive_workers(exclude=idx):
+            raise MigrationRefusedError(
+                "no drain target: every other worker is down or "
+                "draining"
+            )
+        with self._lock:
+            w.draining = True
+        client = w.client
+        try:
+            reply = client.call(
+                "evacuate", timeout=timeout, reason="drain",
+                sids=None, timeout_s=timeout,
+            )
+        except (WorkerLostError, TimeoutError) as exc:
+            # the loss machinery (triggered by the same failure) owns
+            # the residents; report the drain as degraded-but-handled
+            return {
+                "drained": 0,
+                "fell_back_to_failover": True,
+                "error": str(exc),
+            }
+        moved = 0
+        for entry in reply.get("evacuated") or []:
+            with self._lock:
+                seq = self._inflight.pop(int(entry["sid"]), None)
+            if seq is not None:
+                self._replay(seq, exclude=idx, planned=True)
+                moved += 1
+        metrics.REPLICAS_DRAINING.set(
+            sum(1 for x in self.workers if x.draining)
+        )
+        return {"drained": moved, "worker": idx, "epoch": w.epoch}
+
+    def undrain_replica(self, idx: int) -> Dict[str, Any]:
+        if not 0 <= idx < len(self.workers):
+            raise MigrationRefusedError(f"no worker {idx}")
+        with self._lock:
+            self.workers[idx].draining = False
+        metrics.REPLICAS_DRAINING.set(
+            sum(1 for x in self.workers if x.draining)
+        )
+        return {"worker": idx, "draining": False}
+
+    def add_replica(self, *args: Any, **kwargs: Any) -> None:
+        raise MigrationRefusedError(
+            "pod.workers is fixed at boot: worker processes own device "
+            "slices assigned at spawn; scale the pod by restarting with "
+            "a new pod.workers"
+        )
+
+    def remove_replica(self, *args: Any, **kwargs: Any) -> None:
+        raise MigrationRefusedError(
+            "pod.workers is fixed at boot; drain a worker instead "
+            "(POST /admin/replicas/{i}/drain) to take it out of "
+            "rotation"
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _kill_proc(self, proc: subprocess.Popen) -> None:
+        try:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stopping = True
+        with self._lock:
+            workers = list(getattr(self, "workers", []))
+            fenced = list(self._fenced_clients)
+            zombies = list(self._zombie_procs)
+            self._fenced_clients.clear()
+            self._zombie_procs.clear()
+        self._fail_orphans("pod is shutting down")
+        for w in workers:
+            client = w.client
+            if client is not None and not client.dead:
+                try:
+                    client.call("stop", timeout=2.0)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                client.close()
+            w.client = None
+            w.state = "down"
+            if w.proc is not None:
+                self._kill_proc(w.proc)
+        for client in fenced:
+            client.close()
+        for proc in zombies:
+            self._kill_proc(proc)
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+        try:
+            os.unlink(self._config_path)
+        except OSError:
+            pass
+        if self._own_socket_dir:
+            try:
+                for name in os.listdir(self.socket_dir):
+                    try:
+                        os.unlink(os.path.join(self.socket_dir, name))
+                    except OSError:
+                        pass
+                os.rmdir(self.socket_dir)
+            except OSError:
+                pass

@@ -1,0 +1,791 @@
+"""Engine worker process — one engine behind a frame-protocol socket.
+
+``python -m vgate_tpu.runtime.worker`` is the process the gateway's
+PodEngine (runtime/pod_engine.py) spawns per worker slot when
+``pod.workers > 0``: it builds the SAME engine stack the in-process
+path builds (EngineCore, wrapped in EngineSupervisor + stall watchdog
+when ``recovery.enabled``), binds a unix-domain or localhost-TCP
+listener, and serves the length-prefixed JSON frame protocol
+(runtime/rpc.py) to exactly one gateway connection.
+
+Process-level contracts:
+
+* **Fencing epoch** — the gateway assigns each worker *incarnation* a
+  monotonically-increasing epoch (``--epoch``).  Every frame this
+  process sends is stamped with it, and every inbound request frame is
+  checked against it: a stale RPC (addressed to a previous incarnation
+  of this slot) is answered with a typed ``WorkerFencedError`` reply
+  and never touches the engine — the PR-5 stale-wake epoch guard,
+  cross-process.
+* **One connection, then exit** — the gateway owns the worker's
+  lifecycle.  When the gateway connection reaches EOF (gateway died or
+  declared this worker lost and moved on), the worker drains and
+  exits rather than lingering as an unsupervised orphan; a respawn is
+  always a fresh process with a fresh epoch.
+* **SIGTERM drain** — evacuate resident sequences (the PR-8 planned
+  checkpoint fold), ship their checkpoints to the gateway in an
+  ``evacuated`` notification, stop the engine, exit 0.
+* **Engine thread never blocks on the network** — token/done/err
+  frames are enqueued to a dedicated sender thread; a slow or dead
+  gateway costs queue memory, never a stalled decode tick.
+
+Wire protocol (all frames carry the fencing epoch ``"e"``):
+
+* request:      ``{"op": <verb>, "id": n, "e": E, ...}`` → one reply
+  ``{"op": "reply", "id": n, "e": E, "ok": bool, "data"|"error": ...}``
+* notification (no ``"id"``, no reply): gateway→worker ``abort``,
+  ``set_spec_suspended``, ``set_prefix_insert_suspended``;
+  worker→gateway ``tok`` / ``done`` / ``err`` (keyed by the gateway's
+  ``sid``) and ``evacuated``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import queue
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from vgate_tpu import faults
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.config import VGTConfig, set_config
+from vgate_tpu.errors import WorkerFencedError, state_is_alive, state_is_ready
+from vgate_tpu.runtime import rpc
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+
+logger = logging.getLogger(__name__)
+
+# Threading contract (scripts/vgt_lint.py, checker thread-discipline).
+# Lock order: _send_lock is a LEAF — frame assembly happens before
+# acquisition and nothing is called under it but socket.sendall.
+# _seq_lock guards the sid→entry map; snapshot under it, act outside.
+VGT_COMPONENTS: Dict[str, str] = {}
+VGT_LOCK_GUARDS = {
+    "_seqs": "_seq_lock",
+}
+
+# Sender-queue ceiling: a gateway that stopped reading gets its worker
+# torn down (queue overflow → connection abandoned) instead of growing
+# the heap without bound.
+_SEND_QUEUE_MAX = 8192
+
+
+def wire_error(exc: BaseException) -> Dict[str, Any]:
+    """Serialize an exception for a reply/err frame — class name keyed
+    into the errors-module taxonomy so the gateway rebuilds the TYPED
+    error (503-with-reason mapping intact), plus the retryable hint."""
+    out: Dict[str, Any] = {
+        "type": type(exc).__name__,
+        "message": str(exc),
+        "reason": getattr(exc, "reason", None),
+    }
+    retry_after = getattr(exc, "retry_after", None)
+    if retry_after is not None:
+        out["retry_after"] = float(retry_after)
+    return out
+
+
+def unwire_error(err: Dict[str, Any]) -> BaseException:
+    """Rebuild a typed exception from a wire error dict.  Unknown or
+    unconstructible types degrade to a generic RuntimeError carrying
+    the original class name — never a crash in the error path."""
+    from vgate_tpu import errors as _errors
+
+    name = str(err.get("type", "RuntimeError"))
+    message = str(err.get("message", ""))
+    retry_after = err.get("retry_after")
+    cls = getattr(_errors, name, None)
+    if isinstance(cls, type) and issubclass(cls, BaseException):
+        try:
+            if retry_after is not None:
+                return cls(message, retry_after=float(retry_after))
+            return cls(message)
+        except TypeError:
+            try:
+                return cls(message)
+            except TypeError:
+                pass
+    if retry_after is not None:
+        return _errors.RetryableError(
+            f"{name}: {message}", retry_after=float(retry_after)
+        )
+    return RuntimeError(f"{name}: {message}")
+
+
+def params_from_wire(raw: Dict[str, Any]) -> SamplingParams:
+    """SamplingParams from a JSON dict: unknown keys dropped (version
+    skew tolerance), ``logit_bias`` keys re-coerced to int (JSON object
+    keys are strings)."""
+    import dataclasses
+
+    fields = {f.name for f in dataclasses.fields(SamplingParams)}
+    kwargs = {k: v for k, v in raw.items() if k in fields}
+    bias = kwargs.get("logit_bias")
+    if bias:
+        kwargs["logit_bias"] = {int(k): float(v) for k, v in bias.items()}
+    return SamplingParams(**kwargs)
+
+
+def params_to_wire(params: SamplingParams) -> Dict[str, Any]:
+    import dataclasses
+
+    return dataclasses.asdict(params)
+
+
+class _Entry:
+    """One in-flight sequence's worker-side bookkeeping."""
+
+    __slots__ = ("sid", "seq", "cancelled")
+
+    def __init__(self, sid: int, seq: Sequence) -> None:
+        self.sid = sid
+        self.seq = seq
+        self.cancelled = False  # evacuated/aborted: waiter stays silent
+
+
+class WorkerServer:
+    """The worker main object: engine + one-connection frame server."""
+
+    def __init__(self, config: VGTConfig, epoch: int, index: int) -> None:
+        self.config = config
+        self.epoch = int(epoch)
+        self.index = int(index)
+        self.max_frame_bytes = int(config.pod.max_frame_bytes)
+        self._build_engine()
+        self._seq_lock = threading.Lock()
+        self._seqs: Dict[int, _Entry] = {}
+        self._send_lock = threading.Lock()
+        self._send_q: "queue.Queue[Optional[bytes]]" = queue.Queue(
+            maxsize=_SEND_QUEUE_MAX
+        )
+        self._conn: Optional[socket.socket] = None
+        self._stopping = threading.Event()
+        self._fenced_rejects = 0
+
+    # ------------------------------------------------------------ engine
+
+    def _build_engine(self) -> None:
+        # import here so ``--help`` / unit tests of the wire helpers
+        # never pay the jax import
+        from vgate_tpu.runtime.engine_core import EngineCore
+
+        t0 = time.perf_counter()
+        if self.config.recovery.enabled:
+            from vgate_tpu.runtime.supervisor import EngineSupervisor
+
+            self.engine: Any = EngineSupervisor(self.config)
+        else:
+            self.engine = EngineCore(self.config)
+        self.engine.start()
+        self.boot_s = time.perf_counter() - t0
+
+    def _inner(self) -> Any:
+        """The live EngineCore behind an optional supervisor wrapper —
+        for surfaces the supervisor deliberately refuses or does not
+        re-export (evacuate, the raw heartbeat)."""
+        return getattr(self.engine, "core", self.engine)
+
+    # ------------------------------------------------------------- wire out
+
+    def _stamp(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        frame["e"] = self.epoch
+        return frame
+
+    def _enqueue(self, frame: Dict[str, Any]) -> None:
+        """Queue a frame for the sender thread (never blocks the engine
+        thread; overflow abandons the connection — the gateway has
+        stopped reading and will declare us lost anyway)."""
+        try:
+            data = rpc.encode_frame(self._stamp(frame), self.max_frame_bytes)
+        except rpc.FrameError:
+            logger.error("outbound frame oversized; dropped", exc_info=True)
+            return
+        try:
+            self._send_q.put_nowait(data)
+        except queue.Full:
+            logger.error(
+                "sender queue overflow (gateway not reading); "
+                "abandoning connection"
+            )
+            self._teardown_conn()
+
+    def _sender_loop(self) -> None:
+        while True:
+            data = self._send_q.get()
+            if data is None:
+                return
+            conn = self._conn
+            if conn is None:
+                continue
+            try:
+                # faults wire probe applies at the frame layer via
+                # send_frame for requests; raw pre-encoded frames go
+                # through the same probe here so token streams are
+                # chaos-coverable too
+                if faults.is_active():
+                    verdict = faults.wire_action("rpc_send")
+                    if verdict == "drop":
+                        continue
+                    if verdict == "garble":
+                        data = rpc._garble(data)
+                with self._send_lock:
+                    conn.sendall(data)
+            except OSError:
+                self._teardown_conn()
+
+    def _teardown_conn(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reply(self, cid: Any, data: Any) -> None:
+        self._enqueue({"op": "reply", "id": cid, "ok": True, "data": data})
+
+    def _reply_err(self, cid: Any, exc: BaseException) -> None:
+        self._enqueue(
+            {"op": "reply", "id": cid, "ok": False, "error": wire_error(exc)}
+        )
+
+    # ------------------------------------------------------------- verbs
+
+    def _verb_hello(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        inner = self._inner()
+        geometry = inner.geometry
+        return {
+            "pid": os.getpid(),
+            "epoch": self.epoch,
+            "index": self.index,
+            "model": inner.spec.name,
+            "vocab_size": int(inner.spec.vocab_size),
+            "mesh": {k: int(v) for k, v in inner.mesh.shape.items()},
+            "geometry": {
+                "num_pages": int(geometry.num_pages),
+                "page_size": int(getattr(geometry, "page_size", 0)),
+                "kv_dtype": getattr(geometry, "kv_dtype", None),
+            },
+            "kv_dtype": getattr(geometry, "kv_dtype", None),
+            "load_time_s": float(
+                getattr(inner, "load_time_s", 0.0) or 0.0
+            ),
+            "boot_s": self.boot_s,
+            "device_health": inner.device_health(),
+        }
+
+    def _verb_ping(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Liveness + engine beat + pressure in one cheap round-trip —
+        the gateway's monitor classifies the beat with the PR-5
+        classifier (compile-grace-aware), so the worker only reports
+        raw age, never a verdict."""
+        inner = self._inner()
+        now = time.monotonic()
+        beat = getattr(inner, "_heartbeat", None) or {}
+        data: Dict[str, Any] = {
+            "state": self._state(),
+            "fenced_rejects": self._fenced_rejects,
+        }
+        if beat:
+            data["beat"] = {
+                "age_s": max(0.0, now - float(beat.get("t", now))),
+                "kind": beat.get("kind"),
+                "compiling": bool(beat.get("compiling", False)),
+            }
+        try:
+            data["pressure"] = self.engine.pressure_signals()
+        except Exception:
+            pass
+        with self._seq_lock:
+            data["inflight"] = len(self._seqs)
+        return data
+
+    def _state(self) -> str:
+        state = getattr(self.engine, "state", None)
+        if state is not None:
+            return state.value
+        if getattr(self.engine, "_fatal", None) is not None:
+            return "dead"
+        return "serving"
+
+    def _verb_submit(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        sid = int(frame["sid"])
+        raw_params = dict(frame.get("params") or {})
+        remaining_s = frame.get("remaining_s")
+        if remaining_s is not None:
+            # the gateway ships the REMAINING budget so the absolute
+            # deadline survives the process hop (clock domains differ);
+            # fold it in before construction — SamplingParams is frozen
+            raw_params["timeout_s"] = max(0.01, float(remaining_s))
+        params = params_from_wire(raw_params)
+        prompt_ids = [int(t) for t in frame.get("prompt_ids") or []]
+        generated = [int(t) for t in frame.get("generated_ids") or []]
+
+        entry_cell: List[_Entry] = []
+
+        def on_token(token: int) -> None:
+            entry = entry_cell[0]
+            if entry.cancelled:
+                return
+            lp = None
+            seq = entry.seq
+            # _attach_logprob runs before append_token on every engine
+            # path, so the just-appended token's data is the last entry
+            if seq.params.logprobs and len(seq.logprob_data) >= len(
+                seq.generated_ids
+            ):
+                lp = seq.logprob_data[len(seq.generated_ids) - 1]
+            self._enqueue(
+                {"op": "tok", "sid": sid, "t": int(token), "lp": lp}
+            )
+
+        # Build the Sequence ourselves (both fresh and resubmit paths)
+        # and admit it via submit_existing: the entry is fully wired
+        # BEFORE the engine thread can fire on_token, and a resubmit's
+        # fold (prefill-continue; RNG continuation is implicit — see
+        # SequenceCheckpoint's docstring) is just the generated prefix.
+        seq = Sequence(
+            prompt_ids=prompt_ids + generated,
+            params=params,
+            generated_ids=list(generated),
+            orig_prompt_len=len(prompt_ids),
+            resume_count=int(frame.get("resume_count", 0)),
+            migrate_count=int(frame.get("migrate_count", 0)),
+            preempt_count=int(frame.get("preempt_count", 0)),
+            request_id=frame.get("request_id"),
+            kv_dtype=frame.get("kv_dtype"),
+            stream_cb=on_token,
+        )
+        entry = _Entry(sid, seq)
+        entry_cell.append(entry)
+        # supervisor deployments: apply the same admission gate
+        # submit_tokens runs (health state + poison quarantine) —
+        # submit_existing deliberately skips it for in-process replays,
+        # but a gateway submit is client traffic
+        gate = getattr(self.engine, "_gate", None)
+        if gate is not None:
+            gate(list(prompt_ids))
+        with self._seq_lock:
+            self._seqs[sid] = entry
+        try:
+            self.engine.submit_existing(seq)
+        except BaseException:
+            with self._seq_lock:
+                self._seqs.pop(sid, None)
+            raise
+        threading.Thread(
+            target=self._waiter, args=(entry,), daemon=True,
+            name=f"vgt-worker-waiter-{sid}",
+        ).start()
+        return {"sid": sid, "seq_id": seq.seq_id}
+
+    def _waiter(self, entry: _Entry) -> None:
+        """Settle observer for one sequence: ships the terminal frame
+        when the engine finishes/fails it.  Polling wait so an
+        evacuation (which never settles the sequence) releases the
+        thread via the cancelled flag."""
+        seq = entry.seq
+        while not seq.done_event.wait(timeout=0.5):
+            if entry.cancelled or self._stopping.is_set():
+                return
+        if entry.cancelled:
+            return
+        with self._seq_lock:
+            self._seqs.pop(entry.sid, None)
+        if seq.status is SeqStatus.FAILED:
+            self._enqueue(
+                {
+                    "op": "err",
+                    "sid": entry.sid,
+                    "error": wire_error(
+                        seq.error or RuntimeError("unknown failure")
+                    ),
+                }
+            )
+            return
+        lp = list(seq.logprob_data) if seq.params.logprobs else None
+        self._enqueue(
+            {
+                "op": "done",
+                "sid": entry.sid,
+                "finish_reason": seq.finish_reason,
+                "text": self.engine.final_text(seq),
+                "lp": lp,
+                "resume_count": seq.resume_count,
+                "migrate_count": seq.migrate_count,
+                "preempt_count": seq.preempt_count,
+            }
+        )
+
+    def _verb_abort(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        sid = int(frame["sid"])
+        reason = str(frame.get("reason", "client_disconnect"))
+        with self._seq_lock:
+            entry = self._seqs.get(sid)
+        if entry is not None and entry.seq is not None:
+            entry.seq.request_abort(reason)
+        return {"aborted": entry is not None}
+
+    def _verb_abort_all(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        fn = getattr(self.engine, "abort_in_flight", None)
+        if fn is not None:
+            fn(str(frame.get("reason", "drain")))
+        return {}
+
+    def _verb_evacuate(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """PR-8 planned movement across the process boundary: checkpoint
+        the named (or all) resident sequences without a fatal; the
+        gateway owns the replay.  Bypasses the supervisor's dp=1
+        refusal deliberately — here there IS a migration target, it
+        just lives in another process."""
+        sids = frame.get("sids")
+        reason = str(frame.get("reason", "drain"))
+        # "timeout_s" on the wire: the bare name would collide with the
+        # client-side call() deadline kwarg
+        timeout = float(frame.get("timeout_s", 30.0))
+        with self._seq_lock:
+            entries = dict(self._seqs)
+        if sids is not None:
+            wanted = {int(s) for s in sids}
+            entries = {s: e for s, e in entries.items() if s in wanted}
+        seq_ids = [
+            e.seq.seq_id for e in entries.values() if e.seq is not None
+        ]
+        evacuated = self._inner().evacuate(
+            None if sids is None else seq_ids,
+            reason=reason,
+            timeout=timeout,
+        )
+        out = []
+        by_seq_id = {
+            e.seq.seq_id: e for e in entries.values() if e.seq is not None
+        }
+        for seq in evacuated:
+            entry = by_seq_id.get(seq.seq_id)
+            if entry is None:
+                continue
+            entry.cancelled = True
+            with self._seq_lock:
+                self._seqs.pop(entry.sid, None)
+            out.append({"sid": entry.sid, **seq.checkpoint().as_dict()})
+        return {"evacuated": out}
+
+    def _verb_health(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        health_fn = getattr(self.engine, "health", None)
+        if health_fn is not None:
+            return health_fn()
+        state = self._state()
+        return {
+            "state": state,
+            "alive": state_is_alive(state),
+            "ready": state_is_ready(state),
+        }
+
+    def _verb_stats(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        return self.engine.get_stats()
+
+    def _verb_pressure(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        return self.engine.pressure_signals()
+
+    def _verb_perf(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        fn = getattr(self._inner(), "perf_snapshot", None)
+        return fn() if fn is not None else {}
+
+    def _verb_warmup(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        buckets = frame.get("buckets")
+        return {"seconds": float(self._inner().warmup(buckets))}
+
+    def _verb_canary(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Pinned greedy self-probe (PR-9), run on demand for the
+        gateway's respawn gate: returns the output fingerprint; the
+        gateway compares against the fleet's recorded one."""
+        from vgate_tpu.integrity import (
+            canary_fingerprint,
+            canary_prompt_ids,
+        )
+
+        inner = self._inner()
+        cfg = self.config.integrity
+        ids = canary_prompt_ids(
+            inner.spec.vocab_size, cfg.canary_prompt_len
+        )
+        params = SamplingParams(
+            temperature=0.0, max_tokens=cfg.canary_max_tokens
+        )
+        seq = Sequence(prompt_ids=ids, params=params, canary=True)
+        timeout = cfg.canary_timeout_s
+        if getattr(inner, "total_steps", 1) == 0:
+            timeout += cfg.canary_compile_grace_s
+        inner.submit_existing(seq)
+        if not seq.done_event.wait(timeout=timeout):
+            seq.request_abort(reason="drain")
+            raise TimeoutError(
+                f"canary self-probe timed out after {timeout}s"
+            )
+        if seq.status is SeqStatus.FAILED:
+            raise RuntimeError(
+                f"canary self-probe failed: {seq.error}"
+            )
+        out = list(seq.generated_ids)
+        return {
+            "fingerprint": canary_fingerprint(out),
+            "tokens": len(out),
+        }
+
+    def _verb_set_spec_suspended(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        fn = getattr(self.engine, "set_spec_suspended", None)
+        if fn is not None:
+            fn(bool(frame.get("flag", False)))
+        return {}
+
+    def _verb_set_prefix_insert_suspended(
+        self, frame: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        fn = getattr(self.engine, "set_prefix_insert_suspended", None)
+        if fn is not None:
+            fn(bool(frame.get("flag", False)))
+        return {}
+
+    def _verb_stop(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        self._stopping.set()
+        return {"stopping": True}
+
+    _SLOW_VERBS = frozenset(
+        {"evacuate", "warmup", "canary", "stats", "perf"}
+    )
+
+    _VERBS = {
+        "hello": _verb_hello,
+        "ping": _verb_ping,
+        "submit": _verb_submit,
+        "abort": _verb_abort,
+        "abort_all": _verb_abort_all,
+        "evacuate": _verb_evacuate,
+        "health": _verb_health,
+        "stats": _verb_stats,
+        "pressure": _verb_pressure,
+        "perf": _verb_perf,
+        "warmup": _verb_warmup,
+        "canary": _verb_canary,
+        "set_spec_suspended": _verb_set_spec_suspended,
+        "set_prefix_insert_suspended": _verb_set_prefix_insert_suspended,
+        "stop": _verb_stop,
+    }
+
+    # ------------------------------------------------------------ dispatch
+
+    def _dispatch(self, frame: Dict[str, Any]) -> None:
+        cid = frame.get("id")
+        try:
+            rpc.check_epoch(frame, self.epoch)
+        except rpc.StaleEpochError as exc:
+            # a gateway (or tool) addressing a previous incarnation of
+            # this slot: reject typed, never touch the engine
+            self._fenced_rejects += 1
+            logger.warning(
+                "fenced stale RPC",
+                extra={
+                    "extra_data": {
+                        "op": frame.get("op"),
+                        "got": exc.got,
+                        "want": exc.want,
+                    }
+                },
+            )
+            if cid is not None:
+                self._reply_err(
+                    cid,
+                    WorkerFencedError(
+                        f"stale fencing epoch {exc.got} "
+                        f"(worker incarnation is {exc.want})"
+                    ),
+                )
+            return
+        op = frame.get("op")
+        handler = self._VERBS.get(op)  # type: ignore[arg-type]
+        if handler is None:
+            if cid is not None:
+                self._reply_err(cid, ValueError(f"unknown verb {op!r}"))
+            return
+        if op in self._SLOW_VERBS:
+            threading.Thread(
+                target=self._run_verb,
+                args=(handler, frame, cid),
+                daemon=True,
+                name=f"vgt-worker-{op}",
+            ).start()
+        else:
+            # fast verbs run inline on the reader thread — ping latency
+            # IS the liveness signal, it must not queue behind warmup
+            self._run_verb(handler, frame, cid)
+
+    def _run_verb(self, handler, frame: Dict[str, Any], cid: Any) -> None:
+        try:
+            data = handler(self, frame)
+        except BaseException as exc:  # noqa: BLE001 — must reach the wire
+            if cid is not None:
+                self._reply_err(cid, exc)
+            else:
+                logger.error(
+                    "notification verb failed",
+                    extra={"extra_data": {"op": frame.get("op")}},
+                    exc_info=True,
+                )
+            return
+        if cid is not None:
+            self._reply(cid, data)
+
+    # -------------------------------------------------------------- serve
+
+    def serve(self, listener: socket.socket) -> None:
+        """Accept the gateway connection and serve frames until EOF,
+        protocol violation, or drain — then exit (the gateway respawns
+        a fresh incarnation; this process never serves two)."""
+        sender = threading.Thread(
+            target=self._sender_loop, daemon=True, name="vgt-worker-send"
+        )
+        sender.start()
+        listener.settimeout(1.0)
+        conn: Optional[socket.socket] = None
+        while conn is None and not self._stopping.is_set():
+            try:
+                conn, _ = listener.accept()
+            except socket.timeout:
+                continue
+        listener.close()
+        if conn is None:
+            return
+        self._conn = conn
+        try:
+            while not self._stopping.is_set():
+                try:
+                    frame = rpc.recv_frame(conn, self.max_frame_bytes)
+                except rpc.FrameError:
+                    logger.error(
+                        "frame protocol violation from gateway; "
+                        "tearing down",
+                        exc_info=True,
+                    )
+                    break
+                except OSError:
+                    break
+                if frame is None:
+                    break  # gateway closed: we are orphaned or replaced
+                self._dispatch(frame)
+        finally:
+            self.shutdown()
+
+    def drain(self, reason: str = "sigterm") -> None:
+        """SIGTERM path: checkpoint residents, ship them to the gateway
+        (``evacuated`` notification), then stop.  Worker-loss during a
+        pod drain therefore degrades exactly like ``_redistribute`` —
+        the gateway replays from its own request state either way."""
+        try:
+            out = self._verb_evacuate({"reason": reason, "timeout_s": 10.0})
+        except Exception:
+            logger.warning("drain evacuation failed", exc_info=True)
+            out = {"evacuated": []}
+        self._enqueue({"op": "evacuated", "reason": reason, **out})
+        # let the sender flush before teardown
+        deadline = time.monotonic() + 2.0
+        while not self._send_q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        self._stopping.set()
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        self._teardown_conn()
+        self._send_q.put(None)
+        try:
+            self.engine.stop()
+        except Exception:
+            pass
+        # release any waiter threads whose sequences will never settle
+        with self._seq_lock:
+            for entry in self._seqs.values():
+                entry.cancelled = True
+            self._seqs.clear()
+
+
+def _bind_listener(args: argparse.Namespace) -> socket.socket:
+    if args.socket:
+        try:
+            os.unlink(args.socket)
+        except FileNotFoundError:
+            pass
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(args.socket)
+    else:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", args.port))
+    listener.listen(1)
+    return listener
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="vgate-tpu engine worker process"
+    )
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument("--socket", help="unix-domain socket path to bind")
+    group.add_argument("--port", type=int, help="localhost TCP port to bind")
+    parser.add_argument(
+        "--epoch", type=int, required=True,
+        help="fencing epoch of this incarnation (gateway-assigned)",
+    )
+    parser.add_argument(
+        "--config", required=True,
+        help="resolved gateway config, JSON (pod.workers forced to 0)",
+    )
+    parser.add_argument("--index", type=int, default=0, help="worker slot")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as fh:
+        config = VGTConfig(**json.load(fh))
+    # belt and braces: a worker must never recurse into pod mode, and a
+    # worker process hosts exactly one engine
+    config.pod.workers = 0
+    config.tpu.dp = 1
+    set_config(config)
+    faults.arm_from_env()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=(
+            f"%(asctime)s worker[{args.index}"
+            f".e{args.epoch}] %(levelname)s %(name)s: %(message)s"
+        ),
+        stream=sys.stderr,
+    )
+
+    listener = _bind_listener(args)
+    server = WorkerServer(config, epoch=args.epoch, index=args.index)
+
+    def _on_sigterm(signum, _frame) -> None:
+        threading.Thread(
+            target=server.drain, daemon=True, name="vgt-worker-drain"
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    try:
+        server.serve(listener)
+    finally:
+        server.shutdown()
+        if args.socket:
+            try:
+                os.unlink(args.socket)
+            except OSError:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

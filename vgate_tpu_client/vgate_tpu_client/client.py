@@ -325,24 +325,58 @@ class VGT:
         extra: Dict[str, Any] = {}
         if timeout is not None:
             extra["timeout"] = timeout
-        with self._http.stream(
-            "POST", path, json=payload,
-            headers={**self._headers(), **(headers or {})}, **extra,
-        ) as response:
-            if response.status_code >= 400:
-                # read the body first: _raise_for_status parses it for
-                # the typed error, and an unread streamed response
-                # raises httpx.ResponseNotRead instead (routine now
-                # that stream-open can meet a draining replica's 503)
-                response.read()
-            _raise_for_status(response)
-            for line in response.iter_lines():
-                if not line.startswith("data: "):
-                    continue
-                data = line[len("data: "):]
-                if data == "[DONE]":
-                    return
-                yield json.loads(data)
+        for attempt in range(self.max_retries + 1):
+            # Retry is legal only while the stream is side-effect-free
+            # for the caller: a refused/reset/garbage-answered OPEN (the
+            # gateway restarting, a dying worker's last gasp) re-runs
+            # the request like _request does.  The moment the first
+            # event has been yielded the stream is non-idempotent —
+            # tokens were delivered — so mid-stream failures always
+            # propagate, never silently replay.
+            yielded = False
+            try:
+                with self._http.stream(
+                    "POST", path, json=payload,
+                    headers={**self._headers(), **(headers or {})},
+                    **extra,
+                ) as response:
+                    status = response.status_code
+                    if status >= 400:
+                        # read the body first: _raise_for_status parses
+                        # it for the typed error, and an unread streamed
+                        # response raises httpx.ResponseNotRead instead
+                        # (routine now that stream-open can meet a
+                        # draining replica's 503)
+                        response.read()
+                    self.last_rate_limit = RateLimitInfo.from_headers(
+                        response.headers
+                    )
+                    if (
+                        status == 429
+                        or (status >= 500 and status != 504)
+                    ) and attempt < self.max_retries:
+                        time.sleep(
+                            _retry_delay(
+                                attempt, self.last_rate_limit.retry_after
+                            )
+                        )
+                        continue
+                    _raise_for_status(response)
+                    for line in response.iter_lines():
+                        if not line.startswith("data: "):
+                            continue
+                        data = line[len("data: "):]
+                        if data == "[DONE]":
+                            return
+                        yielded = True
+                        yield json.loads(data)
+                return
+            except httpx.HTTPError as exc:
+                if yielded or attempt >= self.max_retries:
+                    raise ConnectionError(
+                        f"stream failed: {exc}"
+                    ) from exc
+                time.sleep(_retry_delay(attempt))
 
     def health(self) -> HealthResponse:
         return HealthResponse.model_validate(self._request("GET", "/health"))
@@ -548,21 +582,49 @@ class AsyncVGT:
         extra: Dict[str, Any] = {}
         if timeout is not None:
             extra["timeout"] = timeout
-        async with self._http.stream(
-            "POST", path, json=payload,
-            headers={**self._headers(), **(headers or {})}, **extra,
-        ) as response:
-            if response.status_code >= 400:
-                # read before raising (see sync _stream)
-                await response.aread()
-            _raise_for_status(response)
-            async for line in response.aiter_lines():
-                if not line.startswith("data: "):
-                    continue
-                data = line[len("data: "):]
-                if data == "[DONE]":
-                    return
-                yield json.loads(data)
+        for attempt in range(self.max_retries + 1):
+            # open-retry only; see sync _stream for the idempotency
+            # argument
+            yielded = False
+            try:
+                async with self._http.stream(
+                    "POST", path, json=payload,
+                    headers={**self._headers(), **(headers or {})},
+                    **extra,
+                ) as response:
+                    status = response.status_code
+                    if status >= 400:
+                        # read before raising (see sync _stream)
+                        await response.aread()
+                    self.last_rate_limit = RateLimitInfo.from_headers(
+                        response.headers
+                    )
+                    if (
+                        status == 429
+                        or (status >= 500 and status != 504)
+                    ) and attempt < self.max_retries:
+                        await asyncio.sleep(
+                            _retry_delay(
+                                attempt, self.last_rate_limit.retry_after
+                            )
+                        )
+                        continue
+                    _raise_for_status(response)
+                    async for line in response.aiter_lines():
+                        if not line.startswith("data: "):
+                            continue
+                        data = line[len("data: "):]
+                        if data == "[DONE]":
+                            return
+                        yielded = True
+                        yield json.loads(data)
+                return
+            except httpx.HTTPError as exc:
+                if yielded or attempt >= self.max_retries:
+                    raise ConnectionError(
+                        f"stream failed: {exc}"
+                    ) from exc
+                await asyncio.sleep(_retry_delay(attempt))
 
     async def health(self) -> HealthResponse:
         return HealthResponse.model_validate(
