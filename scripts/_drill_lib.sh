@@ -37,6 +37,8 @@ declare -A VGT_DRILL_PORTS=(
   [swap]=8738
   [perf]=8739
   [worker]=8740
+  [disagg]=8741
+  [disagg_ab]=8742
 )
 
 drill_port() {

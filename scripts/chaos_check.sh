@@ -56,3 +56,6 @@ bash scripts/perf_check.sh
 
 echo "== process-isolated worker pod drill =="
 bash scripts/worker_check.sh
+
+echo "== disaggregated prefill/decode handoff drill =="
+bash scripts/disagg_check.sh

@@ -1,0 +1,289 @@
+#!/usr/bin/env bash
+# Disaggregated prefill/decode pod drill (ISSUE 17): boot a CPU
+# tiny-dense pod with pod.roles = 1 prefill + 2 decode workers, so
+# every request prefills on worker 0 and is handed off (chunked,
+# checksummed, epoch-fenced KV transfer) to a decode worker, then run
+# the acceptance storms:
+#
+#   A. happy path — min_tokens-pinned greedy decodes; every request
+#      completes 200 with disaggregated:true provenance, the gateway
+#      counts completed handoffs, vgt_handoff_total{outcome="ok"} and
+#      vgt_pool_workers{role=...} export,
+#   B. prefill loss mid-transfer — arm kv_transfer:delay to widen the
+#      transfer window, SIGKILL the prefill worker mid-storm: ZERO
+#      client-visible 5xx, and the rerun is token-identical (the loss
+#      path re-prefills on a survivor),
+#   C. decode loss post-accept — SIGKILL a decode worker while it owns
+#      handed-off streams: zero 5xx, token-identical (PR-16
+#      checkpoint-fold failover),
+#   D. degraded transfer — arm kv_transfer:drop so every chunk is
+#      discarded and retries exhaust: requests still complete 200
+#      token-identically via monolithic decode on the prefill worker,
+#      and vgt_handoff_total{outcome="fallback_monolithic"} counts it.
+#
+# Token identity across ALL storms uses one fixed prompt set at
+# temperature 0 with the result cache off: disaggregated, failed-over
+# and fallback-monolithic decodes must produce the same streams.
+#
+# Usage: scripts/disagg_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port disagg)}"
+ensure_port_free "$PORT"
+arm_lock_witness disagg
+export JAX_PLATFORMS=cpu
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=64
+export VGT_TPU__DP=1
+export VGT_TPU__TP=1
+export VGT_TPU__EP=1
+export VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=1
+export VGT_TPU__KV_NUM_PAGES=128
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=8
+export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=20
+# identical reruns must recompute, not replay a cached body
+export VGT_CACHE__ENABLED=false
+# the disaggregated pod: worker 0 prefills, workers 1-2 decode
+export VGT_POD__WORKERS=3
+export VGT_POD__ROLES='["prefill","decode","decode"]'
+# small chunks so transfers span multiple frames (the drop/delay
+# faults and the mid-transfer kill need a real window to land in)
+export VGT_POD__TRANSFER_CHUNK_BYTES=8192
+export VGT_POD__TRANSFER_MAX_RETRIES=2
+export VGT_POD__TRANSFER_TIMEOUT_S=20
+export VGT_POD__HEARTBEAT_INTERVAL_S=0.3
+export VGT_POD__HEARTBEAT_TIMEOUT_S=3
+export VGT_RECOVERY__BACKOFF_BASE_S=0.05
+export VGT_RECOVERY__BACKOFF_CAP_S=0.2
+export VGT_RECOVERY__MAX_RESTARTS=8
+export VGT_RECOVERY__STEP_STALL_S=120
+export VGT_RECOVERY__COMPILE_GRACE_S=600
+# storms B/D arm kv_transfer faults on the live gateway
+export VGT_FAULTS_HTTP=1
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill "$SERVER_PID" 2>/dev/null || true; sleep 2; \
+      kill -9 "$SERVER_PID" 2>/dev/null || true; \
+      clear_drill_pid "$PORT"' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+# pod boot = three engine builds + canary gates; allow a few minutes
+for _ in $(seq 1 1200); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: disagg pod server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" disagg_check
+
+python - "$BASE" <<'EOF'
+import asyncio, json, os, signal, sys, time
+import aiohttp
+
+BASE = sys.argv[1]
+N = 6
+PROMPTS = [f"disagg drill prompt {i}" for i in range(N)]
+
+
+async def fire(session, prompt):
+    async with session.post(
+        f"{BASE}/v1/chat/completions",
+        json={
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": 24,
+            "min_tokens": 24,  # pin decode length: kills land mid-stream
+            "temperature": 0.0,
+        },
+    ) as resp:
+        return resp.status, await resp.json()
+
+
+async def engine_health(session):
+    async with session.get(f"{BASE}/health") as resp:
+        return (await resp.json())["engine"]
+
+
+async def wait_state(session, want, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = await engine_health(session)
+        if last["state"] == want:
+            return last
+        await asyncio.sleep(0.3)
+    raise AssertionError(f"engine never reached {want!r}; last: {last}")
+
+
+async def metric(session, name, label_sub=""):
+    async with session.get(f"{BASE}/metrics") as resp:
+        text = await resp.text()
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            if label_sub and label_sub not in line:
+                continue
+            return float(line.split()[-1])
+    return None
+
+
+async def arm(session, spec):
+    async with session.post(
+        f"{BASE}/debug/faults", json={"faults": spec}
+    ) as resp:
+        assert resp.status == 200, (resp.status, await resp.text())
+
+
+async def disarm(session):
+    async with session.delete(f"{BASE}/debug/faults") as resp:
+        assert resp.status == 200, resp.status
+
+
+def pid_of(eng, role, skip=()):
+    for r in eng["replicas"]:
+        if r.get("role") == role and r["state"] == "serving" \
+                and r["replica"] not in skip:
+            return r["replica"], r["pid"]
+    raise AssertionError(f"no serving {role} worker: {eng['replicas']}")
+
+
+def texts(results):
+    return [b["choices"][0]["message"]["content"] for _, b in results]
+
+
+def assert_no_5xx(results, what):
+    bad = [s for s, _ in results if s >= 500]
+    assert not bad, f"client-visible 5xx during {what}: {results}"
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        eng = await engine_health(session)
+        assert eng["state"] == "serving", eng
+        assert eng["replicas_alive"] == 3, eng
+        roles = {r["replica"]: r.get("role") for r in eng["replicas"]}
+        assert roles == {0: "prefill", 1: "decode", 2: "decode"}, roles
+
+        # ---- storm A: happy-path disaggregation ---------------------
+        results = await asyncio.gather(*(fire(session, p) for p in PROMPTS))
+        assert_no_5xx(results, "happy path")
+        assert all(s == 200 for s, _ in results), results
+        baseline = texts(results)
+        disagg_flags = [b.get("disaggregated") for _, b in results]
+        assert any(disagg_flags), (
+            f"no request carried disaggregated:true: {disagg_flags}"
+        )
+        eng = await engine_health(session)
+        ho = eng["handoffs"]
+        assert ho["completed"] >= 1, ho
+        assert ho["roles"] == ["prefill", "decode", "decode"], ho
+        m_ok = await metric(session, "vgt_handoff_total", 'outcome="ok"')
+        assert m_ok and m_ok >= 1, f"vgt_handoff_total ok missing: {m_ok}"
+        m_pool = await metric(
+            session, "vgt_pool_workers", 'role="prefill"'
+        )
+        assert m_pool == 1.0, f"vgt_pool_workers prefill: {m_pool}"
+        completed_a = ho["completed"]
+
+        # ---- storm B: SIGKILL the prefill worker mid-transfer -------
+        # delay every kv_transfer chunk so transfers are provably in
+        # flight when the kill lands
+        await arm(session, "kv_transfer:delay:delay=0.8:times=12")
+        pidx, ppid = pid_of(eng, "prefill")
+
+        async def kill_prefill():
+            await asyncio.sleep(1.2)
+            os.kill(ppid, signal.SIGKILL)
+
+        results_b, _ = await asyncio.gather(
+            asyncio.gather(*(fire(session, p) for p in PROMPTS)),
+            kill_prefill(),
+        )
+        assert_no_5xx(results_b, "prefill loss mid-transfer")
+        for got, want in zip(texts(results_b), baseline):
+            assert got == want, (
+                f"prefill-loss output diverged:\n  want: {want!r}\n"
+                f"  got:  {got!r}"
+            )
+        await disarm(session)
+        healed = await wait_state(session, "serving")
+        assert healed["restarts"] >= 1, healed
+
+        # ---- storm C: SIGKILL a decode worker post-accept -----------
+        eng = await engine_health(session)
+        didx, dpid = pid_of(eng, "decode")
+
+        async def kill_decode():
+            await asyncio.sleep(2.0)  # past prefill+handoff, mid-decode
+            os.kill(dpid, signal.SIGKILL)
+
+        results_c, _ = await asyncio.gather(
+            asyncio.gather(*(fire(session, p) for p in PROMPTS)),
+            kill_decode(),
+        )
+        assert_no_5xx(results_c, "decode loss post-accept")
+        for got, want in zip(texts(results_c), baseline):
+            assert got == want, (
+                f"decode-loss output diverged:\n  want: {want!r}\n"
+                f"  got:  {got!r}"
+            )
+        healed = await wait_state(session, "serving")
+        assert healed["restarts"] >= 2, healed
+
+        # ---- storm D: every transfer chunk dropped ⇒ fallback -------
+        await arm(session, "kv_transfer:drop:times=100000")
+        results_d = await asyncio.gather(
+            *(fire(session, p) for p in PROMPTS)
+        )
+        assert_no_5xx(results_d, "degraded transfer")
+        assert all(s == 200 for s, _ in results_d), results_d
+        for got, want in zip(texts(results_d), baseline):
+            assert got == want, (
+                f"fallback-monolithic output diverged:\n"
+                f"  want: {want!r}\n  got:  {got!r}"
+            )
+        # fallback requests decode monolithically on the prefill
+        # worker: no disaggregated provenance
+        assert not any(b.get("disaggregated") for _, b in results_d), (
+            "fallback requests must not claim disaggregated:true"
+        )
+        await disarm(session)
+        eng = await engine_health(session)
+        ho = eng["handoffs"]
+        assert ho["fallback_monolithic"] >= 1, ho
+        m_fb = await metric(
+            session, "vgt_handoff_total", 'outcome="fallback_monolithic"'
+        )
+        assert m_fb and m_fb >= 1, (
+            f"vgt_handoff_total fallback_monolithic missing: {m_fb}"
+        )
+        final = await wait_state(session, "serving")
+        print(
+            f"PASS: {N} prompts token-identical across happy-path "
+            f"disaggregation ({completed_a} handoffs), prefill SIGKILL "
+            f"mid-transfer, decode SIGKILL post-accept, and "
+            f"drop-everything fallback ({ho['fallback_monolithic']} "
+            f"monolithic fallbacks) — zero 5xx throughout; "
+            f"restarts={final['restarts']}"
+        )
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+assert_witness_clean disagg
+echo "disagg_check: OK"

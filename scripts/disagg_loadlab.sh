@@ -1,0 +1,157 @@
+#!/usr/bin/env bash
+# Disaggregation A/B under load (ISSUE 17): run the bundled
+# `disagg_vs_monolithic` scenario twice against the same 3-worker pod —
+#
+#   arm A (disagg):     pod.roles = 1 prefill + 2 decode, every request
+#                       crosses the chunked epoch-fenced KV handoff,
+#   arm B (monolithic): VGT_POD__ROLES='[]' exported over the
+#                       scenario's server_env (operator env wins), so
+#                       the same three workers serve mixed,
+#
+# and emit one comparison artifact with per-cell, per-tier TTFT/TPOT
+# for both arms plus the disagg deltas.  Both runs are SLO-graded by
+# the normal loadlab pipeline; the drill asserts zero unhandled client
+# errors in both arms and that arm A really disaggregated
+# (vgt_handoff_total{outcome="ok"} > 0, >0 disaggregated responses).
+#
+# Usage: scripts/disagg_loadlab.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port disagg_ab)}"
+BASE="http://127.0.0.1:$PORT"
+ART_DISAGG=/tmp/vgt_disagg_ab_disagg.jsonl
+ART_MONO=/tmp/vgt_disagg_ab_monolithic.jsonl
+ART_CMP=/tmp/vgt_disagg_vs_monolithic.json
+rm -f "$ART_DISAGG" "$ART_MONO" "$ART_CMP"
+
+# the scenario's server_env is the single definition site for the
+# experiment's server configuration
+scenario_env() {
+  python - <<'PY'
+import shlex
+from vgate_tpu.loadlab import load_scenario
+for k, v in load_scenario("disagg_vs_monolithic").server_env.items():
+    print(f"export {k}={shlex.quote(str(v))}")
+PY
+}
+
+run_arm() {
+  # run_arm NAME ARTIFACT [extra exports already in env]
+  local name="$1" artifact="$2"
+  ensure_port_free "$PORT"
+  python main.py &
+  local server_pid=$!
+  record_drill_pid "$PORT" "$server_pid"
+  local ok=0
+  for _ in $(seq 1 1200); do
+    if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then ok=1; break; fi
+    if ! kill -0 "$server_pid" 2>/dev/null; then break; fi
+    sleep 0.2
+  done
+  if [[ "$ok" != 1 ]]; then
+    echo "FAIL: $name pod never became ready"
+    kill -9 "$server_pid" 2>/dev/null || true
+    clear_drill_pid "$PORT"
+    return 1
+  fi
+  snapshot_kv_config "$BASE" "disagg_ab_$name"
+  python -m vgate_tpu.loadlab run \
+    --scenario disagg_vs_monolithic --base-url "$BASE" \
+    --out "$artifact" --platform cpu --device "cpu-pod-$name"
+  # arm-level provenance before teardown: did the pod actually hand off?
+  curl -fsS "$BASE/metrics" | grep '^vgt_handoff_total' \
+    > "/tmp/vgt_disagg_ab_${name}_handoffs.prom" || true
+  kill "$server_pid" 2>/dev/null || true
+  for _ in $(seq 1 50); do
+    kill -0 "$server_pid" 2>/dev/null || break
+    sleep 0.2
+  done
+  kill -9 "$server_pid" 2>/dev/null || true
+  clear_drill_pid "$PORT"
+}
+
+echo "== arm A: disaggregated (pod.roles = prefill/decode/decode) =="
+(
+  eval "$(scenario_env)"
+  export VGT_SERVER__PORT="$PORT"
+  run_arm disagg "$ART_DISAGG"
+)
+
+echo "== arm B: monolithic (VGT_POD__ROLES='[]', same 3 workers) =="
+(
+  eval "$(scenario_env)"
+  export VGT_SERVER__PORT="$PORT"
+  export VGT_POD__ROLES='[]'
+  run_arm monolithic "$ART_MONO"
+)
+
+echo "== comparison artifact =="
+python - "$ART_DISAGG" "$ART_MONO" "$ART_CMP" <<'PY'
+import json, sys
+from vgate_tpu.loadlab import slo
+
+disagg = slo.load_artifact(sys.argv[1])
+mono = slo.load_artifact(sys.argv[2])
+
+# zero unhandled client errors in BOTH arms — typed sheds are fine,
+# crashes are not
+for name, art in (("disagg", disagg), ("monolithic", mono)):
+    for cell in art["cells"]:
+        unh = cell.get("unhandled_errors", 0)
+        assert not unh, f"{name} cell {cell['qps']}: unhandled={unh}"
+
+# arm A really exercised the handoff plane
+ok_handoffs = 0.0
+for line in open("/tmp/vgt_disagg_ab_disagg_handoffs.prom"):
+    if 'outcome="ok"' in line:
+        ok_handoffs = float(line.split()[-1])
+assert ok_handoffs > 0, "disagg arm completed zero handoffs"
+
+def tiers(art):
+    out = {}
+    for cell in art["cells"]:
+        for tier, row in cell["tiers"].items():
+            out[(cell["qps"], tier)] = row
+    return out
+
+d, m = tiers(disagg), tiers(mono)
+rows = []
+for key in sorted(set(d) & set(m)):
+    qps, tier = key
+    dr, mr = d[key], m[key]
+    row = {"qps": qps, "tier": tier}
+    for metric in ("ttft_ms", "tpot_ms"):
+        for p in ("p50", "p95"):
+            dv = (dr.get(metric) or {}).get(p)
+            mv = (mr.get(metric) or {}).get(p)
+            row[f"{metric}_{p}_disagg"] = dv
+            row[f"{metric}_{p}_monolithic"] = mv
+            if dv is not None and mv is not None:
+                row[f"{metric}_{p}_delta_pct"] = round(
+                    100.0 * (dv - mv) / mv, 1
+                ) if mv else None
+    row["goodput_disagg"] = dr.get("goodput")
+    row["goodput_monolithic"] = mr.get("goodput")
+    rows.append(row)
+assert rows, "no comparable (cell, tier) rows between the arms"
+
+out = {
+    "artifact": "disagg_vs_monolithic",
+    "scenario": disagg["meta"].get("scenario"),
+    "handoffs_ok": ok_handoffs,
+    "arms": {
+        "disagg": disagg["meta"].get("device"),
+        "monolithic": mono["meta"].get("device"),
+    },
+    "rows": rows,
+}
+with open(sys.argv[3], "w") as f:
+    json.dump(out, f, indent=1)
+    f.write("\n")
+print(json.dumps(out, indent=1))
+print(f"comparison artifact: {sys.argv[3]}")
+PY
+
+echo "disagg_loadlab: OK"

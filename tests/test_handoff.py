@@ -1,0 +1,606 @@
+"""Disaggregated prefill→decode handoff unit tests (fast tier).
+
+Covers the pure handoff plane from ISSUE 17: the explicit state machine
+(every legal transition, idempotent duplicate-ACCEPT, illegal jumps),
+the payload codec (roundtrip + every typed malformation), the chunk
+assembler (out-of-order / duplicate / overlap / gap semantics), the
+worker-side wire verbs against a process-free WorkerServer shell
+(stale-epoch frame rejection, staged-fetch invalidation, duplicate
+commit idempotence), the ``kv_transfer`` fault point, and a seeded fuzz
+of the transfer framing — truncated/garbled/reordered chunks must
+produce typed errors or byte-identical reassembly, never a hang.
+"""
+
+import base64
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from vgate_tpu import faults
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.errors import HandoffStaleError, HandoffTransferError
+from vgate_tpu.ops.kv_quant import QuantPages
+from vgate_tpu.runtime import handoff
+from vgate_tpu.runtime import rpc
+from vgate_tpu.runtime.sequence import Sequence, SeqStatus
+from vgate_tpu.runtime.worker import WorkerServer, _Staged
+
+
+# ------------------------------------------------------- state machine
+
+
+class TestStateMachine:
+    def test_happy_path(self):
+        path = [
+            handoff.PREFILLING, handoff.STAGED, handoff.TRANSFERRING,
+            handoff.ACCEPTED, handoff.DECODING,
+        ]
+        for cur, nxt in zip(path, path[1:]):
+            assert handoff.advance(cur, nxt) is True
+
+    def test_every_legal_transition(self):
+        for cur, nexts in handoff.TRANSITIONS.items():
+            for nxt in nexts:
+                assert handoff.advance(cur, nxt) is True
+
+    def test_idempotent_reentry_is_noop(self):
+        # a duplicated ACCEPT (or any re-delivered control frame) must
+        # not double-apply: advance() reports "already there"
+        for state in handoff.STATES:
+            assert handoff.advance(state, state) is False
+
+    @pytest.mark.parametrize("cur,nxt", [
+        (handoff.PREFILLING, handoff.TRANSFERRING),
+        (handoff.PREFILLING, handoff.ACCEPTED),
+        (handoff.PREFILLING, handoff.DECODING),
+        (handoff.STAGED, handoff.ACCEPTED),
+        (handoff.STAGED, handoff.PREFILLING),
+        (handoff.TRANSFERRING, handoff.DECODING),
+        (handoff.TRANSFERRING, handoff.STAGED),
+        (handoff.ACCEPTED, handoff.FALLBACK),
+        (handoff.ACCEPTED, handoff.TRANSFERRING),
+    ])
+    def test_illegal_jumps_raise(self, cur, nxt):
+        with pytest.raises(handoff.HandoffStateError):
+            handoff.advance(cur, nxt)
+
+    def test_terminal_states_have_no_exits(self):
+        assert handoff.TERMINAL == {
+            handoff.DECODING, handoff.FALLBACK,
+            handoff.CANCELLED, handoff.FAILED,
+        }
+        for term in handoff.TERMINAL:
+            for other in handoff.STATES:
+                if other == term:
+                    continue
+                with pytest.raises(handoff.HandoffStateError):
+                    handoff.advance(term, other)
+
+    def test_unknown_states_raise(self):
+        with pytest.raises(handoff.HandoffStateError):
+            handoff.advance("BOGUS", handoff.STAGED)
+        with pytest.raises(handoff.HandoffStateError):
+            handoff.advance(handoff.STAGED, "BOGUS")
+
+
+# ------------------------------------------------------- payload codec
+
+
+def _payload():
+    """A representative KV pytree: nested containers, several dtypes,
+    a QuantPages NamedTuple leaf, scalars, and None."""
+    rng = np.random.default_rng(7)
+    return {
+        "layers": [
+            (
+                rng.standard_normal((2, 4, 8)).astype(np.float32),
+                rng.integers(-128, 127, (2, 4, 8), dtype=np.int8),
+            ),
+            QuantPages(
+                data=rng.integers(-128, 127, (4, 8), dtype=np.int8),
+                scale=rng.standard_normal((4, 1)).astype(np.float32),
+            ),
+        ],
+        "meta": {"pages": 3, "ratio": 0.5, "tag": "kv", "ok": True},
+        "hole": None,
+    }
+
+
+def _tree_equal(a, b):
+    if isinstance(a, np.ndarray):
+        return (
+            isinstance(b, np.ndarray)
+            and a.dtype == b.dtype
+            and a.shape == b.shape
+            and np.array_equal(a, b)
+        )
+    if isinstance(a, dict):
+        return (
+            isinstance(b, dict)
+            and sorted(a) == sorted(b)
+            and all(_tree_equal(a[k], b[k]) for k in a)
+        )
+    if isinstance(a, (list, tuple)):
+        return (
+            type(b) is type(a) or (
+                isinstance(a, tuple) and isinstance(b, tuple)
+            )
+        ) and len(a) == len(b) and all(
+            _tree_equal(x, y) for x, y in zip(a, b)
+        )
+    return type(a) is type(b) and a == b
+
+
+class TestPayloadCodec:
+    def test_roundtrip(self):
+        payload = _payload()
+        buf = handoff.pack_payload(payload)
+        out = handoff.unpack_payload(buf)
+        assert _tree_equal(payload, out)
+        # the NamedTuple leaf reconstructs as the real class
+        assert isinstance(out["layers"][1], QuantPages)
+
+    def test_pack_is_deterministic(self):
+        payload = _payload()
+        assert handoff.pack_payload(payload) == handoff.pack_payload(payload)
+
+    def test_non_string_dict_key_refused(self):
+        with pytest.raises(HandoffTransferError, match="dict key"):
+            handoff.pack_payload({1: np.zeros(2)})
+
+    def test_unpackable_leaf_refused(self):
+        with pytest.raises(HandoffTransferError, match="unpackable"):
+            handoff.pack_payload({"x": object()})
+
+    def test_bad_magic(self):
+        buf = bytearray(handoff.pack_payload(_payload()))
+        buf[:4] = b"NOPE"
+        with pytest.raises(HandoffTransferError, match="magic"):
+            handoff.unpack_payload(bytes(buf))
+
+    def test_truncated_header(self):
+        with pytest.raises(HandoffTransferError, match="truncated"):
+            handoff.unpack_payload(b"VGK")
+
+    def test_manifest_length_out_of_bounds(self):
+        buf = bytearray(handoff.pack_payload(_payload()))
+        buf[4:8] = (2 ** 31).to_bytes(4, "big")
+        with pytest.raises(HandoffTransferError, match="manifest length"):
+            handoff.unpack_payload(bytes(buf))
+
+    def test_garbled_manifest_json(self):
+        buf = bytearray(handoff.pack_payload({"x": 1}))
+        buf[8] ^= 0xFF  # first manifest byte
+        with pytest.raises(HandoffTransferError):
+            handoff.unpack_payload(bytes(buf))
+
+    def test_truncated_blob(self):
+        buf = handoff.pack_payload(np.arange(64, dtype=np.int32))
+        with pytest.raises(HandoffTransferError, match="out of bounds"):
+            handoff.unpack_payload(buf[:-8])
+
+    def test_foreign_namedtuple_refused(self):
+        import collections
+
+        Evil = collections.namedtuple("Evil", ["a"])
+        buf = handoff.pack_payload(Evil(a=np.zeros(2)))
+        # packs fine (it IS a tuple) but its import path is outside
+        # vgate_tpu, so reconstruction is refused
+        with pytest.raises(HandoffTransferError, match="vgate_tpu"):
+            handoff.unpack_payload(buf)
+
+    def test_digest_stable_and_sensitive(self):
+        buf = handoff.pack_payload(_payload())
+        d0 = handoff.payload_digest(buf)
+        assert handoff.payload_digest(buf) == d0
+        garbled = bytearray(buf)
+        garbled[len(garbled) // 2] ^= 0x55
+        assert handoff.payload_digest(bytes(garbled)) != d0
+
+
+# ------------------------------------------------------ chunk assembler
+
+
+class TestChunkAssembler:
+    def test_ctor_bounds(self):
+        with pytest.raises(HandoffTransferError):
+            handoff.ChunkAssembler(0, 100)
+        with pytest.raises(HandoffTransferError):
+            handoff.ChunkAssembler(-4, 100)
+        with pytest.raises(HandoffTransferError):
+            handoff.ChunkAssembler(101, 100)
+        assert handoff.ChunkAssembler(100, 100).total == 100
+
+    def test_in_order_reassembly(self):
+        blob = bytes(range(256)) * 4
+        asm = handoff.ChunkAssembler(len(blob), 1 << 20)
+        for off, n in handoff.chunk_offsets(len(blob), 100):
+            asm.put(off, blob[off:off + n])
+        assert asm.complete() == blob
+
+    def test_out_of_order_reassembly(self):
+        blob = bytes(range(256)) * 4
+        asm = handoff.ChunkAssembler(len(blob), 1 << 20)
+        offsets = handoff.chunk_offsets(len(blob), 96)
+        for off, n in reversed(offsets):
+            asm.put(off, blob[off:off + n])
+        assert asm.complete() == blob
+
+    def test_duplicate_chunk_idempotent(self):
+        blob = b"abcdefgh" * 8
+        asm = handoff.ChunkAssembler(len(blob), 1 << 20)
+        got = asm.put(0, blob[:32])
+        assert asm.put(0, blob[:32]) == got  # byte-identical redelivery
+        asm.put(32, blob[32:])
+        assert asm.complete() == blob
+
+    def test_conflicting_overlap_raises(self):
+        asm = handoff.ChunkAssembler(64, 1 << 20)
+        asm.put(0, b"\x01" * 32)
+        with pytest.raises(HandoffTransferError, match="conflicting"):
+            asm.put(16, b"\x02" * 32)
+
+    def test_out_of_bounds_chunk_raises(self):
+        asm = handoff.ChunkAssembler(64, 1 << 20)
+        with pytest.raises(HandoffTransferError, match="outside"):
+            asm.put(60, b"\x00" * 8)
+        with pytest.raises(HandoffTransferError, match="outside"):
+            asm.put(-4, b"\x00" * 8)
+
+    def test_empty_chunk_raises(self):
+        asm = handoff.ChunkAssembler(64, 1 << 20)
+        with pytest.raises(HandoffTransferError, match="empty"):
+            asm.put(0, b"")
+
+    def test_gaps_named_on_complete(self):
+        asm = handoff.ChunkAssembler(100, 1 << 20)
+        asm.put(0, b"\x00" * 10)
+        asm.put(50, b"\x00" * 10)
+        with pytest.raises(HandoffTransferError) as ei:
+            asm.complete()
+        assert "(10, 50)" in str(ei.value)
+        assert "(60, 100)" in str(ei.value)
+
+    def test_received_property(self):
+        asm = handoff.ChunkAssembler(100, 1 << 20)
+        assert asm.received == 0
+        asm.put(0, b"\x00" * 40)
+        assert asm.received == 40
+        asm.put(20, b"\x00" * 40)  # overlapping extension, identical bytes
+        assert asm.received == 60
+
+
+class TestChunkOffsets:
+    def test_partition_covers_total(self):
+        for total, chunk in [(1, 1), (10, 3), (100, 100), (257, 64)]:
+            offs = handoff.chunk_offsets(total, chunk)
+            assert offs[0][0] == 0
+            assert sum(n for _, n in offs) == total
+            for (o1, n1), (o2, _) in zip(offs, offs[1:]):
+                assert o1 + n1 == o2
+
+    def test_zero_total_is_empty(self):
+        assert handoff.chunk_offsets(0, 64) == []
+
+    def test_bad_chunk_bytes(self):
+        with pytest.raises(ValueError):
+            handoff.chunk_offsets(100, 0)
+
+
+# ------------------------------------------------- worker wire verbs
+
+
+def _worker_shell(epoch=3):
+    """A WorkerServer with no engine and no socket — just the wire-verb
+    state, so the handoff verbs can be exercised in-process."""
+    ws = WorkerServer.__new__(WorkerServer)
+    ws.epoch = epoch
+    ws.index = 0
+    ws.max_frame_bytes = 1 << 20
+    ws._seq_lock = threading.Lock()
+    ws._seqs = {}
+    ws._staged = {}
+    ws._xfers = {}
+    ws._xfer_committed = set()
+    ws._xfer_committing = set()
+    ws._staging_cap = 1 << 20
+    ws._fenced_rejects = 0
+    return ws
+
+
+def _stage(ws, sid=7, payload=None):
+    seq = Sequence(
+        prompt_ids=[1, 2, 3, 4], params=SamplingParams(max_tokens=8)
+    )
+    seq._handoff_hold = True
+    st = _Staged(
+        sid=sid, seq=seq, payload=payload or _payload(),
+        num_pages=3, nbytes=1234, epoch=seq.preempt_count,
+    )
+    ws._staged[sid] = st
+    return seq, st
+
+
+class TestWorkerHandoffVerbs:
+    def test_fetch_serves_staged_blob_chunked(self):
+        ws = _worker_shell()
+        payload = _payload()
+        _stage(ws, sid=7, payload=payload)
+        want = handoff.pack_payload(payload)
+
+        first = ws._verb_handoff_fetch({"sid": 7, "off": 0, "n": 100})
+        assert first["total"] == len(want)
+        assert first["pages"] == 3
+        assert first["digest"] == handoff.payload_digest(want)
+
+        asm = handoff.ChunkAssembler(first["total"], 1 << 24)
+        off = 0
+        while off < first["total"]:
+            rep = ws._verb_handoff_fetch({"sid": 7, "off": off, "n": 999})
+            data = base64.b64decode(rep["data"], validate=True)
+            off = asm.put(off, data)
+        assert asm.complete() == want
+
+    def test_fetch_unknown_sid_is_stale(self):
+        ws = _worker_shell()
+        with pytest.raises(HandoffStaleError):
+            ws._verb_handoff_fetch({"sid": 99, "off": 0})
+
+    def test_fetch_after_fold_is_stale_and_pops_staging(self):
+        # a supervisor replay (or any re-prefill) bumps preempt_count;
+        # the staged bytes describe a dead incarnation of the KV and
+        # must never leave the process
+        ws = _worker_shell()
+        seq, _ = _stage(ws, sid=7)
+        seq.preempt_count += 1
+        with pytest.raises(HandoffStaleError, match="invalidated"):
+            ws._verb_handoff_fetch({"sid": 7, "off": 0})
+        assert 7 not in ws._staged
+
+    def test_fetch_after_hold_release_is_stale(self):
+        ws = _worker_shell()
+        seq, _ = _stage(ws, sid=7)
+        seq._handoff_hold = False
+        with pytest.raises(HandoffStaleError):
+            ws._verb_handoff_fetch({"sid": 7, "off": 0})
+
+    def test_fetch_on_running_seq_is_stale(self):
+        ws = _worker_shell()
+        seq, _ = _stage(ws, sid=7)
+        seq.status = SeqStatus.RUNNING
+        with pytest.raises(HandoffStaleError):
+            ws._verb_handoff_fetch({"sid": 7, "off": 0})
+
+    def test_fetch_offset_out_of_bounds(self):
+        ws = _worker_shell()
+        _stage(ws, sid=7)
+        with pytest.raises(HandoffTransferError, match="out of bounds"):
+            ws._verb_handoff_fetch({"sid": 7, "off": 10 ** 9})
+
+    def test_put_reassembles(self):
+        ws = _worker_shell()
+        blob = b"kvkvkvkv" * 16
+        for off, n in handoff.chunk_offsets(len(blob), 32):
+            chunk = base64.b64encode(blob[off:off + n]).decode()
+            rep = ws._verb_handoff_put({
+                "xfer": "h7.1", "off": off, "total": len(blob),
+                "data": chunk,
+            })
+        assert rep["got"] == len(blob)
+        assert ws._xfers["h7.1"].complete() == blob
+
+    def test_put_undecodable_b64_is_typed(self):
+        ws = _worker_shell()
+        with pytest.raises(HandoffTransferError, match="undecodable"):
+            ws._verb_handoff_put({
+                "xfer": "h7.1", "off": 0, "total": 8, "data": "!!!not-b64",
+            })
+
+    def test_put_total_mismatch_is_typed(self):
+        ws = _worker_shell()
+        chunk = base64.b64encode(b"abcd").decode()
+        ws._verb_handoff_put(
+            {"xfer": "h7.1", "off": 0, "total": 64, "data": chunk}
+        )
+        with pytest.raises(HandoffTransferError, match="mismatch"):
+            ws._verb_handoff_put(
+                {"xfer": "h7.1", "off": 4, "total": 65, "data": chunk}
+            )
+
+    def test_put_after_commit_is_dup_ack(self):
+        ws = _worker_shell()
+        ws._xfer_committed.add("h7.1")
+        rep = ws._verb_handoff_put({
+            "xfer": "h7.1", "off": 0, "total": 8,
+            "data": base64.b64encode(b"x" * 8).decode(),
+        })
+        assert rep["dup"] is True
+
+    def test_commit_retry_after_lost_reply_is_idempotent(self):
+        # the duplicate-ACCEPT case: gateway retried a commit whose
+        # reply was lost — the worker must ack, not double-admit
+        ws = _worker_shell()
+        ws._xfer_committed.add("h7.1")
+        rep = ws._verb_handoff_commit({"xfer": "h7.1", "sid": 7})
+        assert rep == {"accepted": True, "dup": True}
+
+    def test_commit_with_live_seq_is_idempotent(self):
+        ws = _worker_shell()
+        ws._seqs[7] = object()  # sequence already admitted
+        rep = ws._verb_handoff_commit({"xfer": "h7.2", "sid": 7})
+        assert rep == {"accepted": True, "dup": True}
+
+    def test_concurrent_duplicate_commit_refused(self):
+        ws = _worker_shell()
+        ws._xfer_committing.add("h7.1")
+        with pytest.raises(HandoffTransferError, match="in progress"):
+            ws._verb_handoff_commit({"xfer": "h7.1", "sid": 7})
+
+    def test_commit_unknown_transfer_is_typed(self):
+        ws = _worker_shell()
+        with pytest.raises(HandoffTransferError, match="unknown transfer"):
+            ws._verb_handoff_commit({"xfer": "h9.9", "sid": 9})
+
+    def test_commit_incomplete_transfer_names_gaps(self):
+        ws = _worker_shell()
+        ws._verb_handoff_put({
+            "xfer": "h7.1", "off": 0, "total": 64,
+            "data": base64.b64encode(b"x" * 16).decode(),
+        })
+        with pytest.raises(HandoffTransferError, match="missing byte"):
+            ws._verb_handoff_commit({"xfer": "h7.1", "sid": 7})
+
+    def test_commit_digest_mismatch_drops_assembler(self):
+        ws = _worker_shell()
+        blob = handoff.pack_payload(_payload())
+        ws._verb_handoff_put({
+            "xfer": "h7.1", "off": 0, "total": len(blob),
+            "data": base64.b64encode(blob).decode(),
+        })
+        with pytest.raises(HandoffTransferError, match="digest mismatch"):
+            ws._verb_handoff_commit({
+                "xfer": "h7.1", "sid": 7,
+                "digest": handoff.payload_digest(blob) ^ 0xDEAD,
+            })
+        # the retry must rebuild from scratch — we can't tell which
+        # chunk was garbled
+        assert "h7.1" not in ws._xfers
+
+    def test_abort_drops_partial_transfer(self):
+        ws = _worker_shell()
+        ws._verb_handoff_put({
+            "xfer": "h7.1", "off": 0, "total": 64,
+            "data": base64.b64encode(b"x" * 16).decode(),
+        })
+        assert ws._verb_handoff_abort({"xfer": "h7.1"}) == {"dropped": True}
+        assert ws._verb_handoff_abort({"xfer": "h7.1"}) == {"dropped": False}
+
+    def test_stale_epoch_frame_fenced_before_verb(self):
+        # a frame stamped with a previous incarnation's fencing epoch
+        # must be rejected typed at dispatch — the verb never runs
+        ws = _worker_shell(epoch=5)
+        errors = []
+        ws._reply_err = lambda cid, exc: errors.append((cid, exc))
+        ws._reply = lambda cid, data: pytest.fail("verb ran on stale frame")
+        ws._dispatch({
+            "op": "handoff_put", "id": 1, "e": 4,
+            "xfer": "h7.1", "off": 0, "total": 8,
+            "data": base64.b64encode(b"x" * 8).decode(),
+        })
+        assert ws._fenced_rejects == 1
+        assert len(errors) == 1
+        assert "stale fencing epoch 4" in str(errors[0][1])
+        assert ws._xfers == {}  # the put never happened
+
+    def test_missing_epoch_frame_rejected(self):
+        ws = _worker_shell(epoch=5)
+        with pytest.raises(rpc.FrameError, match="missing fencing epoch"):
+            rpc.check_epoch({"op": "handoff_put"}, 5)
+
+
+# ------------------------------------------------------- fault point
+
+
+class TestKvTransferFaultPoint:
+    def test_all_wire_modes_armable(self):
+        for mode in ("drop", "garble", "duplicate"):
+            faults.reset()
+            faults.arm("kv_transfer", mode=mode, times=1)
+            assert faults.is_active()
+            assert faults.wire_action("kv_transfer") == mode
+            # budget exhausted — subsequent traffic is clean
+            assert faults.wire_action("kv_transfer") is None
+
+    def test_duplicate_mode_rejected_elsewhere(self):
+        with pytest.raises(ValueError):
+            faults.arm("rpc_send", mode="duplicate")
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError):
+            faults.arm("kv_teleport", mode="drop")
+
+
+# ------------------------------------------------------- framing fuzz
+
+
+class TestFramingFuzz:
+    def test_reordered_and_duplicated_chunks_reassemble(self):
+        rng = random.Random(0)
+        payload = _payload()
+        blob = handoff.pack_payload(payload)
+        digest = handoff.payload_digest(blob)
+        for _ in range(20):
+            chunk = rng.randrange(64, 4096)
+            offsets = handoff.chunk_offsets(len(blob), chunk)
+            rng.shuffle(offsets)
+            # duplicate a random prefix of the schedule (re-delivery)
+            offsets += offsets[:rng.randrange(0, len(offsets))]
+            asm = handoff.ChunkAssembler(len(blob), 1 << 24)
+            for off, n in offsets:
+                asm.put(off, blob[off:off + n])
+            out = asm.complete()
+            assert out == blob
+            assert handoff.payload_digest(out) == digest
+            assert _tree_equal(handoff.unpack_payload(out), payload)
+
+    def test_dropped_chunks_are_typed_gaps(self):
+        rng = random.Random(1)
+        blob = handoff.pack_payload(_payload())
+        for _ in range(10):
+            offsets = handoff.chunk_offsets(
+                len(blob), rng.randrange(128, 2048)
+            )
+            dropped = rng.randrange(len(offsets))
+            asm = handoff.ChunkAssembler(len(blob), 1 << 24)
+            for i, (off, n) in enumerate(offsets):
+                if i != dropped:
+                    asm.put(off, blob[off:off + n])
+            with pytest.raises(HandoffTransferError, match="missing"):
+                asm.complete()
+
+    def test_garbled_chunks_never_escape_detection(self):
+        # a garbled chunk either trips the assembler (conflicting
+        # redelivery) or survives to a digest mismatch — both typed
+        rng = random.Random(2)
+        blob = handoff.pack_payload(_payload())
+        digest = handoff.payload_digest(blob)
+        for _ in range(10):
+            offsets = handoff.chunk_offsets(
+                len(blob), rng.randrange(128, 2048)
+            )
+            victim = rng.randrange(len(offsets))
+            asm = handoff.ChunkAssembler(len(blob), 1 << 24)
+            for i, (off, n) in enumerate(offsets):
+                data = bytearray(blob[off:off + n])
+                if i == victim:
+                    data[rng.randrange(len(data))] ^= 0x55
+                asm.put(off, bytes(data))
+            out = asm.complete()
+            assert handoff.payload_digest(out) != digest
+
+    def test_byte_flip_fuzz_unpack_never_hangs_or_leaks(self):
+        # single-byte corruptions anywhere in the wire buffer must
+        # yield either a successful (different) unpack or a typed
+        # HandoffTransferError — never any other exception type
+        rng = random.Random(3)
+        blob = handoff.pack_payload(_payload())
+        for _ in range(300):
+            garbled = bytearray(blob)
+            pos = rng.randrange(len(garbled))
+            garbled[pos] ^= rng.randrange(1, 256)
+            try:
+                handoff.unpack_payload(bytes(garbled))
+            except HandoffTransferError:
+                pass
+
+    def test_truncation_fuzz_is_typed(self):
+        rng = random.Random(4)
+        blob = handoff.pack_payload(_payload())
+        for _ in range(100):
+            cut = rng.randrange(len(blob))
+            try:
+                handoff.unpack_payload(blob[:cut])
+            except HandoffTransferError:
+                pass

@@ -97,6 +97,7 @@ def _bare_pod(current_epoch=3):
     pod = object.__new__(PodEngine)
     pod._lock = threading.RLock()
     pod._inflight = {}
+    pod._handoffs = {}
     pod.fenced_frames = 0
     w = _Worker(0)
     w.epoch = current_epoch

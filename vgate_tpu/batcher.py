@@ -772,7 +772,8 @@ class RequestBatcher:
                         {
                             k: v
                             for k, v in payload.items()
-                            if k not in ("resumed", "migrated")
+                            if k not in ("resumed", "migrated",
+                                         "disaggregated")
                         },
                     )
                 for req in groups[lead.cache_key]:
@@ -919,6 +920,10 @@ class RequestBatcher:
             # rebalance / scale-down): per-delivery provenance, never
             # cache content
             out["migrated"] = True
+        if m.pop("disaggregated", 0):
+            # prefill→decode KV handoff (pod.roles): this generation
+            # prefilled on one worker and decoded on another
+            out["disaggregated"] = True
         out["request_id"] = req.request_id
         return out
 

@@ -606,6 +606,27 @@ class PodConfig(BaseModel):
     # a protocol violation (typed error + connection teardown), never
     # an attempted allocation.
     max_frame_bytes: int = 8 * 1024 * 1024
+    # Disaggregated prefill/decode pools: one role per worker, each
+    # "prefill" | "decode" | "mixed".  Empty (the default) keeps every
+    # worker "mixed" — byte-identical routing to the symmetric pod.
+    # With roles set, new requests route to the prefill pool; after the
+    # first token the sequence's KV pages hand off to a least-loaded
+    # decode worker over a chunked, checksummed, epoch-stamped RPC
+    # transfer.  A dead/empty decode pool degrades to monolithic decode
+    # on the prefill worker — latency, never a 5xx.
+    roles: List[str] = Field(default_factory=list)
+    # KV handoff transfer plane.  Chunks must fit max_frame_bytes with
+    # base64 + JSON envelope headroom.
+    transfer_chunk_bytes: int = 1 * 1024 * 1024
+    # Bounded retries per handoff before falling back to monolithic
+    # decode on the prefill worker (each retry may re-pick the target).
+    transfer_max_retries: int = 3
+    # Per-RPC deadline for fetch/put/commit calls during a handoff.
+    transfer_timeout_s: float = 30.0
+    # Host staging-pool floor injected into role-split workers whose
+    # config has kv_cache.host_swap_bytes=0 — the handoff stages KV
+    # through that pool, so it must exist on both sides.
+    transfer_staging_bytes: int = 64 * 1024 * 1024
 
     @field_validator("transport")
     @classmethod
@@ -622,6 +643,37 @@ class PodConfig(BaseModel):
         if v < 0:
             raise ValueError("pod.workers must be >= 0")
         return v
+
+    @field_validator("roles")
+    @classmethod
+    def _check_roles(cls, v: List[str]) -> List[str]:
+        for r in v:
+            if r not in ("prefill", "decode", "mixed"):
+                raise ValueError(
+                    "pod.roles entries must be 'prefill', 'decode' or "
+                    f"'mixed', got {r!r}"
+                )
+        return v
+
+    @field_validator(
+        "transfer_chunk_bytes", "transfer_max_retries",
+        "transfer_timeout_s", "transfer_staging_bytes",
+    )
+    @classmethod
+    def _check_transfer(cls, v, info):
+        if v <= 0:
+            raise ValueError(f"pod.{info.field_name} must be > 0")
+        return v
+
+    @model_validator(mode="after")
+    def _check_roles_len(self) -> "PodConfig":
+        if self.roles and len(self.roles) != self.workers:
+            raise ValueError(
+                f"pod.roles has {len(self.roles)} entries but "
+                f"pod.workers={self.workers}; give one role per worker "
+                "(or leave roles empty for an all-mixed pod)"
+            )
+        return self
 
 
 class LifecycleConfig(BaseModel):

@@ -301,6 +301,37 @@ class MigrationRefusedError(MigrationError):
     sdk_twin = "VGTError"
 
 
+class HandoffError(MigrationError):
+    """A disaggregated prefill→decode KV handoff (pod.roles;
+    runtime/pod_engine.py) failed.  Internal to the handoff plane:
+    NEVER client-visible — every failure branch either retries, falls
+    back to monolithic decode on the prefill worker, or rides the
+    worker-loss replay, all of which keep the request streaming."""
+
+    reason = "handoff_error"
+    sdk_twin = "ServerError"
+
+
+class HandoffTransferError(HandoffError):
+    """The chunked KV transfer itself broke: coverage gap (dropped
+    chunk), digest mismatch (garbled bytes), oversized/overlapping
+    frame, or an undecodable payload.  The gateway retries the transfer
+    (bounded by ``pod.transfer_max_retries``, possibly to a different
+    decode worker) and then falls back to monolithic decode."""
+
+    reason = "handoff_transfer_error"
+
+
+class HandoffStaleError(HandoffError):
+    """The staged handoff no longer matches the live sequence: the
+    prefill worker's engine restarted and replayed it, the hold was
+    released, or the staging epoch moved on.  Not retryable against the
+    same staging — the gateway abandons the handoff (the sequence is
+    already decoding monolithically or riding the loss replay)."""
+
+    reason = "handoff_stale"
+
+
 class ClientQuotaExceededError(RuntimeError):
     """This API key already has ``admission.per_key_max_inflight``
     requests in flight — a per-client fairness cap, not server-wide

@@ -43,6 +43,14 @@ Probed sites (each calls :func:`check` with the point name):
 ``rpc_recv``        worker RPC plane, inbound frame — same wire modes,
                     applied after a frame decodes (``garble`` instead
                     corrupts the raw bytes before decoding)
+``kv_transfer``     disaggregated prefill→decode KV handoff (runtime/
+                    pod_engine.py), probed per transfer chunk — wire
+                    modes apply: ``drop`` loses the chunk (coverage gap
+                    at commit → typed error → bounded retry), ``garble``
+                    corrupts its bytes (digest mismatch at commit),
+                    ``duplicate`` ships the chunk twice (idempotent-put
+                    drill), ``delay`` widens the kill window, ``raise``
+                    fails the transfer call outright
 ==================  ====================================================
 
 Arming — programmatic (tests)::
@@ -93,12 +101,16 @@ FAULT_POINTS = (
     "logit_corrupt",
     "rpc_send",
     "rpc_recv",
+    "kv_transfer",
 )
 
 # wire modes only make sense where there is a wire: the RPC plane probes
 # via wire_action(), everything else probes via check()/corrupt_array()
-WIRE_POINTS = ("rpc_send", "rpc_recv")
-WIRE_MODES = ("drop", "garble")
+WIRE_POINTS = ("rpc_send", "rpc_recv", "kv_transfer")
+WIRE_MODES = ("drop", "garble", "duplicate")
+# frame duplication only makes sense on the chunked KV-handoff plane —
+# the request/reply RPC verbs have no idempotent-redelivery semantics
+DUPLICATE_POINTS = ("kv_transfer",)
 
 # `corrupt` routes the supervisor/dp repair to the RELOAD rebuild path
 # (weights-kept restarts would preserve the corruption) — see
@@ -192,6 +204,11 @@ def arm(
     if mode in WIRE_MODES and point not in WIRE_POINTS:
         raise ValueError(
             f"mode {mode!r} is wire-only; valid points: {WIRE_POINTS}"
+        )
+    if mode == "duplicate" and point not in DUPLICATE_POINTS:
+        raise ValueError(
+            f"mode 'duplicate' is chunk-transfer-only; valid points: "
+            f"{DUPLICATE_POINTS}"
         )
     if kind not in FAULT_KINDS:
         raise ValueError(f"unknown fault kind {kind!r}")
@@ -317,9 +334,11 @@ def wire_action(point: str, payload: Any = None) -> Optional[str]:
     Returns the wire verdict for one frame: ``None`` (send/deliver it
     untouched, the overwhelmingly common disarmed fast path), ``"drop"``
     (discard the frame silently — the peer sees a missing reply and its
-    call deadline fires), or ``"garble"`` (the caller scrambles the raw
+    call deadline fires), ``"garble"`` (the caller scrambles the raw
     frame bytes so the peer hits a framing violation and tears the
-    connection down).  ``delay`` specs sleep here and then deliver;
+    connection down), or ``"duplicate"`` (kv_transfer only: the caller
+    ships the chunk twice to drill idempotent redelivery).  ``delay``
+    specs sleep here and then deliver;
     ``raise`` specs raise :class:`InjectedFault` at the wire call site."""
     if not _active:
         return None

@@ -397,6 +397,47 @@ POD_FENCED_FRAMES = _safe_metric(
     "by the gateway's epoch check instead of corrupting live streams",
 )
 
+# --- disaggregated prefill/decode pools (pod.roles): KV handoff plane ---
+POOL_WORKERS = _safe_metric(
+    Gauge,
+    "vgt_pool_workers",
+    "Live engine workers per disaggregation role (pod.roles; "
+    "prefill | decode | mixed)",
+    labelnames=("role",),
+)
+HANDOFF_TOTAL = _safe_metric(
+    Counter,
+    "vgt_handoff_total",
+    "Prefill→decode KV handoffs by terminal outcome: ok (decode worker "
+    "accepted and continued the stream), retried (one bounded transfer "
+    "retry consumed), fallback_monolithic (handoff abandoned, decode "
+    "continued on the prefill worker — latency, never a 5xx), failed "
+    "(handoff raced a loss/abort; the request rides the replay path)",
+    labelnames=("outcome",),  # ok | retried | fallback_monolithic | failed
+)
+HANDOFF_ACTIVE = _safe_metric(
+    Gauge,
+    "vgt_handoff_active",
+    "KV handoffs currently in flight (PREFILLING..ACCEPTED, not yet "
+    "settled to an outcome)",
+)
+HANDOFF_SECONDS = _safe_metric(
+    Histogram,
+    "vgt_handoff_seconds",
+    "Wall time of one successful KV handoff (staged on the prefill "
+    "worker → decode worker accepted and resumed the stream)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30),
+)
+HANDOFF_BYTES = _safe_metric(
+    Histogram,
+    "vgt_handoff_bytes",
+    "Packed KV payload size of one successful handoff transfer",
+    buckets=(
+        64 * 1024, 256 * 1024, 1024 * 1024, 4 * 1024 * 1024,
+        16 * 1024 * 1024, 64 * 1024 * 1024, 256 * 1024 * 1024,
+    ),
+)
+
 # --- request lifecycle: deadlines, cancellation, graceful drain ---
 CANCELLED_REQUESTS = _safe_metric(
     Counter,

@@ -1272,6 +1272,20 @@ class EngineCore:
         # request's event while the engine thread checkpoints the
         # selected sequences between ticks.  See evacuate().
         self._evac_q: "queue.Queue[_EvacRequest]" = queue.Queue()
+        # disaggregated prefill→decode handoff (runtime/handoff.py):
+        # sequences submitted with handoff_requested are watched here
+        # until their first token exists, then folded + staged via
+        # scheduler.hold_for_handoff and announced through
+        # on_handoff_staged (the pod worker wires it to a gateway
+        # notification).  _handoff_q carries the cross-thread verdicts
+        # back in — ("done", seq): the decode worker accepted, evacuate;
+        # ("cancel", seq): transfer fell through, release the hold and
+        # resume monolithic decode here.
+        self._handoff_pending: List[Sequence] = []
+        self._handoff_q: "queue.Queue[tuple]" = queue.Queue()
+        self.on_handoff_staged: Optional[Callable[[Sequence, bool], None]] = (
+            None
+        )
         self._wakeup = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -2030,6 +2044,95 @@ class EngineCore:
             )
         return out
 
+    # ------------------ disaggregated prefill→decode handoff staging
+
+    def handoff_done(self, seq: Sequence) -> None:
+        """Cross-thread (worker RPC plane): the decode worker ACCEPTED
+        this sequence's KV transfer — drop its queue slot and local
+        staged ticket WITHOUT settling it (the decode worker owns the
+        stream now).  Processed on the engine thread next tick."""
+        self._handoff_q.put(("done", seq))
+        self._wakeup.set()
+
+    def handoff_cancel(self, seq: Sequence) -> None:
+        """Cross-thread: the transfer fell through (retries exhausted,
+        decode pool drained, gateway raced a loss) — lift the hold so
+        the next try_admit swap-ins the staged KV and decode continues
+        MONOLITHICALLY here with zero recompute."""
+        self._handoff_q.put(("cancel", seq))
+        self._wakeup.set()
+
+    @engine_thread_only
+    def _process_handoffs(self) -> None:
+        """Handoff staging pump (runtime/handoff.py), run each tick
+        after evacuations: apply cross-thread done/cancel verdicts,
+        then fold+stage any watched sequence whose first token now
+        exists and announce it via ``on_handoff_staged``."""
+        while True:
+            try:
+                verb, seq = self._handoff_q.get_nowait()
+            except queue.Empty:
+                break
+            if verb == "done":
+                if getattr(seq, "_handoff_hold", False):
+                    seq._handoff_hold = False  # type: ignore[attr-defined]
+                    self.scheduler.evacuate(seq)
+                    self.flight.record_tick(
+                        "handoff_done", seq_id=seq.seq_id,
+                        request_id=seq.request_id,
+                    )
+            else:  # "cancel"
+                self.scheduler.release_hold(seq)
+        if not self._handoff_pending:
+            return
+        pending: List[Sequence] = []
+        ready: List[Sequence] = []
+        for seq in self._handoff_pending:
+            if (
+                not seq.handoff_requested
+                or seq.status not in (SeqStatus.WAITING, SeqStatus.RUNNING)
+                or seq.abort_requested
+            ):
+                continue  # settled/cancelled — stop watching
+            if seq.status is SeqStatus.RUNNING and seq.num_generated >= 1:
+                ready.append(seq)
+            else:
+                pending.append(seq)  # still queued or mid-prefill
+        self._handoff_pending = pending
+        if not ready:
+            return
+        if self._pending_chunks:
+            # fold in-flight decode chunks first (like _evacuate_now):
+            # the staged KV must cover every token already streamed
+            self._process_chunks(drain=True)
+            self._decode_signature_cache = None
+        for seq in ready:
+            seq.handoff_requested = False
+            if seq.status is not SeqStatus.RUNNING or seq.abort_requested:
+                staged = False  # settled while the chunks drained
+            else:
+                # stamp the KV storage format like every checkpoint
+                # path — submit_existing on the decode worker refuses
+                # a mismatched pool
+                geo = getattr(self, "geometry", None)
+                if geo is not None:
+                    seq.kv_dtype = geo.kv_dtype
+                staged = self.scheduler.hold_for_handoff(seq)
+            if staged:
+                self._decode_signature_cache = None
+                self.flight.record_tick(
+                    "handoff_stage", seq_id=seq.seq_id,
+                    request_id=seq.request_id, tokens=seq.num_generated,
+                )
+            cb = self.on_handoff_staged
+            if cb is not None:
+                try:
+                    cb(seq, staged)
+                except Exception:  # pragma: no cover - defensive
+                    logger.error(
+                        "on_handoff_staged callback failed", exc_info=True
+                    )
+
     @engine_thread_only
     def _tick(self) -> bool:
         """One iteration of the engine loop.
@@ -2050,6 +2153,10 @@ class EngineCore:
         # rebalance coordinator is blocked on this, and the selected
         # sequences must not burn another decode chunk here first
         self._process_evacuations()
+        # then handoff staging (disaggregated prefill→decode): fold
+        # first-token'd handoff candidates off the device before they
+        # burn decode chunks that belong on the decode pool
+        self._process_handoffs()
         # stall fault probe (vgate_tpu/faults.py): a `delay` armed here
         # past recovery.step_stall_s simulates a wedged loop for the
         # hang watchdog.  Only probed while work is resident, so chaos
@@ -2292,10 +2399,37 @@ class EngineCore:
                 seq = self._submit_q.get_nowait()
             except queue.Empty:
                 return
+            adopt = getattr(seq, "_handoff_adopt", None)
+            if adopt is not None:
+                # decode-side arrival of a prefill→decode handoff: park
+                # the shipped KV payload as a local swap ticket so
+                # try_admit swap-ins with ZERO recompute.  A refusal
+                # (no swap tier / pool full) folds to the recompute
+                # path instead — slower, still token-identical.
+                seq._handoff_adopt = None  # type: ignore[attr-defined]
+                payload, num_pages = adopt
+                adopted = (
+                    self.kv_swap is not None
+                    and self.kv_swap.adopt_remote(seq, payload, num_pages)
+                )
+                if not adopted:
+                    seq.reset_for_recompute()
+                    logger.warning(
+                        "handoff payload adoption refused; falling back "
+                        "to re-prefill",
+                        extra={"extra_data": {
+                            "seq_id": seq.seq_id,
+                            "request_id": seq.request_id,
+                            "pages": num_pages,
+                        }},
+                    )
             try:
                 self.scheduler.add(seq)
             except Exception as exc:
                 seq.fail(exc)
+                continue
+            if seq.handoff_requested:
+                self._handoff_pending.append(seq)
 
     @engine_thread_only
     def _step_key(self):

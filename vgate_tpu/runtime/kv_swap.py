@@ -261,6 +261,41 @@ class KVSwapManager:
         return True
 
     @engine_thread_only
+    def adopt_remote(
+        self, seq: Sequence, payload: Any, num_pages: int
+    ) -> bool:
+        """Park a payload that arrived OVER THE WIRE (disaggregated
+        prefill→decode handoff, runtime/handoff.py) as ``seq``'s swap
+        ticket, exactly as if this worker had swapped it out itself —
+        the normal ``try_admit`` swap-in path then restores the pages
+        with zero recompute.  The sequence is WAITING (never ran here),
+        so the ticket epoch is its CURRENT preempt_count; any later
+        containment fold bumps the epoch and invalidates the ticket,
+        and the fold's prompt then carries the generation instead.
+        False (no budget / no room) sends the caller to the recompute
+        fallback — correct, just slower."""
+        if self.budget_bytes <= 0 or num_pages <= 0:
+            return False
+        nbytes = num_pages * self.page_bytes
+        if not self._make_room(nbytes, evict_prefix=True):
+            self.total_refused += 1
+            return False
+        with self._lock:
+            ticket = SwapTicket(
+                "seq", num_pages, nbytes, payload,
+                seq_id=seq.seq_id, epoch=seq.preempt_count,
+            )
+            seq._swap_ticket = ticket  # type: ignore[attr-defined]
+            seq.swap_count += 1
+            self._charge(nbytes)
+            self._seq_tickets[seq.seq_id] = (seq, ticket)
+        self.total_swap_in_pages["handoff"] = (
+            self.total_swap_in_pages.get("handoff", 0) + num_pages
+        )
+        metrics.KV_SWAP_IN_PAGES.labels(kind="handoff").inc(num_pages)
+        return True
+
+    @engine_thread_only
     def ticket_for(self, seq: Sequence) -> Optional[SwapTicket]:
         """The sequence's live swap ticket, or None — an invalid ticket
         (epoch moved under a fold, pool lost it) is discarded here so

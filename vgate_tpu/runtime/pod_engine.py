@@ -44,10 +44,25 @@ Robustness contracts (the point of the process boundary):
   exactly like dp's ``_redistribute`` (``prepare_migrate``: never
   spends the crash-resume budget).  A worker dying mid-drain falls
   back to the loss path — same fold, same replay, crash counters.
+* **Disaggregated prefill/decode pools** (``pod.roles``) — workers can
+  be pinned ``prefill`` / ``decode`` / ``mixed``.  New requests route
+  to the prefill pool; when the prefill finishes, the worker folds the
+  sequence and stages its KV through the PR-11 host pool, and the
+  gateway runs an epoch-fenced, checksummed, chunked pull transfer to
+  the least-loaded decode worker (runtime/handoff.py state machine:
+  PREFILLING → STAGED → TRANSFERRING → ACCEPTED → DECODING).  Every
+  failure mode degrades, never 5xxs: transfer garble/timeout retries
+  then falls back to *monolithic* decode on the prefill worker
+  (swap-in, zero recompute); prefill death mid-transfer re-prefills on
+  a survivor via the normal loss path; decode death after ACCEPTED
+  rides the existing checkpoint-fold failover.  Tokens stay identical
+  either way.
 """
 
 from __future__ import annotations
 
+import base64
+import binascii
 import itertools
 import json
 import os
@@ -61,9 +76,11 @@ import zlib
 from types import SimpleNamespace
 from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
 
-from vgate_tpu import metrics
+from vgate_tpu import faults, metrics
 from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.errors import (
+    HandoffStaleError,
+    HandoffTransferError,
     MigrationRefusedError,
     ResumeExhaustedError,
     WorkerLostError,
@@ -74,6 +91,7 @@ from vgate_tpu.errors import (
 from vgate_tpu.logging_config import get_logger
 from vgate_tpu.models.specs import spec_for_model_id
 from vgate_tpu.observability import perf as perf_attr
+from vgate_tpu.runtime import handoff as handoff_mod
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.runtime.supervisor import (
     HealthState,
@@ -96,6 +114,7 @@ VGT_LOCK_GUARDS = {
     "_inflight": "_lock",
     "_orphans": "_lock",
     "_restart_times": "_lock",
+    "_handoffs": "_lock",
 }
 
 # spawn-time connect poll cadence (the worker binds its listener before
@@ -143,6 +162,56 @@ class _Worker:
         return self.state == "serving"
 
 
+class _SourceLost(Exception):
+    """Internal marker: the prefill-side connection died mid-transfer.
+    The pod loss path owns the sequence (fold + replay on a survivor);
+    the transfer thread just stands down."""
+
+
+class _HandoffRec:
+    """Gateway-side record of one prefill→decode handoff transaction
+    (state machine in runtime/handoff.py).  Guarded by the pod lock;
+    the transfer thread snapshots under it and calls outside it.
+
+    ``buffered``/``terminal`` absorb frames the decode target emits
+    between its commit landing and the gateway flipping sequence
+    ownership — they replay in order at accept so the client stream
+    never drops or reorders a token."""
+
+    __slots__ = (
+        "sid", "seq", "prefill_idx", "prefill_epoch", "state",
+        "cancelled", "target_idx", "buffered", "terminal", "pages",
+        "nbytes", "base_len", "generated_ids", "resume_count",
+        "migrate_count", "preempt_count", "swap_count", "kv_dtype",
+        "attempts", "t0",
+    )
+
+    def __init__(
+        self, sid: int, seq: "_PodSequence", prefill_idx: int,
+        prefill_epoch: int,
+    ) -> None:
+        self.sid = sid
+        self.seq = seq
+        self.prefill_idx = prefill_idx
+        self.prefill_epoch = prefill_epoch
+        self.state = handoff_mod.PREFILLING
+        self.cancelled = False
+        self.target_idx = -1
+        self.buffered: List[Dict[str, Any]] = []
+        self.terminal: Optional[Any] = None
+        self.pages = 0
+        self.nbytes = 0
+        self.base_len = 0
+        self.generated_ids: List[int] = []
+        self.resume_count = 0
+        self.migrate_count = 0
+        self.preempt_count = 0
+        self.swap_count = 0
+        self.kv_dtype: Optional[str] = None
+        self.attempts = 0
+        self.t0 = time.monotonic()
+
+
 class PodEngine:
     """ReplicatedEngine's surface over worker processes."""
 
@@ -153,6 +222,12 @@ class PodEngine:
             raise ValueError("PodEngine requires pod.workers >= 1")
         self._pod_cfg = pod
         self._recovery = self.config.recovery
+        # disaggregated pools: roles default to all-mixed, which keeps
+        # routing and submission byte-identical to a role-less pod
+        self._roles: List[str] = (
+            list(pod.roles) if pod.roles else ["mixed"] * pod.workers
+        )
+        self._roles_active = any(r != "mixed" for r in self._roles)
         self.spec = spec_for_model_id(self.config.model.model_id)
         self.tokenizer = get_tokenizer(
             self.spec,
@@ -162,8 +237,10 @@ class PodEngine:
         self._lock = threading.RLock()
         self._inflight: Dict[int, _PodSequence] = {}
         self._orphans: List[_PodSequence] = []
+        self._handoffs: Dict[int, _HandoffRec] = {}
         self._sids = itertools.count(1)
         self._rr = itertools.count()
+        self._xfer_ids = itertools.count(1)
         self._restart_times: List[float] = []
         self._fenced_clients: List[WorkerClient] = []
         self._zombie_procs: List[subprocess.Popen] = []
@@ -176,6 +253,9 @@ class PodEngine:
         self.total_migrated = 0
         self.total_lost = 0
         self.fenced_frames = 0
+        self.total_handoffs = 0
+        self.total_handoff_fallbacks = 0
+        self.total_handoff_failed = 0
         self._canary_expected: Optional[str] = None
 
         self._own_socket_dir = not pod.socket_dir
@@ -224,7 +304,19 @@ class PodEngine:
         must not recurse into pod mode and host exactly one engine."""
         dump = self.config.model_dump()
         dump["pod"]["workers"] = 0
+        # roles are gateway routing state; a one-engine worker config
+        # with roles but workers=0 would fail the per-worker validator
+        dump["pod"]["roles"] = []
         dump["tpu"]["dp"] = 1
+        if self._roles_active:
+            # both sides of a KV handoff need the PR-11 pinned host
+            # pool (prefill stages out of it, decode adopts into it);
+            # floor it at the transfer staging budget so roles work
+            # without the operator separately enabling host swap
+            dump["kv_cache"]["host_swap_bytes"] = max(
+                int(dump["kv_cache"].get("host_swap_bytes") or 0),
+                int(dump["pod"].get("transfer_staging_bytes") or 0),
+            )
         fd, path = tempfile.mkstemp(
             prefix="vgt-worker-cfg-", suffix=".json", dir=self.socket_dir
         )
@@ -445,6 +537,10 @@ class PodEngine:
             self._on_err(idx, frame)
         elif op == "evacuated":
             self._on_evacuated(idx, frame)
+        elif op == "handoff_staged":
+            self._on_handoff_staged(idx, frame)
+        elif op == "handoff_fallback":
+            self._on_handoff_fallback(idx, frame)
 
     def _seq_for(self, idx: int, frame: Dict[str, Any]) -> Optional[_PodSequence]:
         with self._lock:
@@ -453,10 +549,44 @@ class PodEngine:
             return None  # settled, aborted, or resubmitted elsewhere
         return seq
 
-    def _on_token(self, idx: int, frame: Dict[str, Any]) -> None:
-        seq = self._seq_for(idx, frame)
-        if seq is None:
-            return
+    def _handoff_intercept(self, idx: int, frame: Dict[str, Any]) -> bool:
+        """Pre-dispatch hook for tok/done/err frames while a handoff
+        record exists for the sid.  Two cases:
+
+        * frame from the DECODE TARGET before ownership flipped —
+          buffer it on the record (replayed in order at accept) and
+          consume it (return True);
+        * frame from the PREFILL worker while the sequence is staged or
+          transferring — the worker's own supervisor replayed it
+          locally (the fold clears the hold), so the handoff is moot:
+          cancel the record and let the frame flow (monolithic decode
+          continues on the prefill worker, token-identically).
+        """
+        sid = frame.get("sid")
+        fallback = False
+        with self._lock:
+            rec = self._handoffs.get(sid)
+            if rec is None:
+                return False
+            if rec.target_idx == idx and not rec.cancelled:
+                if frame.get("op") == "tok":
+                    rec.buffered.append(frame)
+                else:
+                    rec.terminal = (frame.get("op"), frame)
+                return True
+            if rec.prefill_idx == idx and rec.state in (
+                handoff_mod.STAGED, handoff_mod.TRANSFERRING
+            ):
+                self._handoffs.pop(sid, None)
+                rec.cancelled = True
+                self.total_handoff_fallbacks += 1
+                fallback = True
+        if fallback:
+            metrics.HANDOFF_TOTAL.labels(outcome="fallback_monolithic").inc()
+        return False
+
+    @staticmethod
+    def _apply_token(seq: _PodSequence, frame: Dict[str, Any]) -> None:
         lp = frame.get("lp")
         if lp is not None and seq.params.logprobs:
             # raw (chosen_lp, [(tid, lp), ...]) data — the gateway's
@@ -466,12 +596,28 @@ class PodEngine:
             )
         seq.append_token(int(frame["t"]))
 
+    def _on_token(self, idx: int, frame: Dict[str, Any]) -> None:
+        if self._handoff_intercept(idx, frame):
+            return
+        seq = self._seq_for(idx, frame)
+        if seq is None:
+            return
+        self._apply_token(seq, frame)
+
     def _on_done(self, idx: int, frame: Dict[str, Any]) -> None:
+        if self._handoff_intercept(idx, frame):
+            return
         seq = self._seq_for(idx, frame)
         if seq is None:
             return
         with self._lock:
             self._inflight.pop(seq._sid, None)
+            # a sequence that finished before its handoff ever staged
+            # (short decode) retires the record silently — nothing to
+            # transfer, nothing degraded
+            rec = self._handoffs.pop(seq._sid, None)
+            if rec is not None:
+                rec.cancelled = True
         text = frame.get("text")
         if text is not None:
             # the worker's final text is authoritative (stop-string
@@ -494,11 +640,16 @@ class PodEngine:
         seq.finish(str(frame.get("finish_reason", "stop")))
 
     def _on_err(self, idx: int, frame: Dict[str, Any]) -> None:
+        if self._handoff_intercept(idx, frame):
+            return
         seq = self._seq_for(idx, frame)
         if seq is None:
             return
         with self._lock:
             self._inflight.pop(seq._sid, None)
+            rec = self._handoffs.pop(seq._sid, None)
+            if rec is not None:
+                rec.cancelled = True
         seq.fail(unwire_error(frame.get("error") or {}))
 
     def _on_evacuated(self, idx: int, frame: Dict[str, Any]) -> None:
@@ -515,6 +666,446 @@ class PodEngine:
         for seq in seqs:
             self._replay(seq, exclude=idx, planned=True)
 
+    # ------------------------------------------- KV handoff (pod.roles)
+
+    def _on_handoff_staged(self, idx: int, frame: Dict[str, Any]) -> None:
+        """The prefill worker folded + staged the sequence's KV: record
+        the transfer metadata (PREFILLING → STAGED) and launch the
+        transfer thread.  A staging notification with no live record
+        (the request was replayed/aborted meanwhile) is answered with a
+        cancel so the worker resumes monolithic decode immediately."""
+        sid = int(frame.get("sid", -1))
+        with self._lock:
+            rec = self._handoffs.get(sid)
+            seq = self._inflight.get(sid)
+            ok = (
+                rec is not None
+                and not rec.cancelled
+                and seq is not None
+                and seq is rec.seq
+                and seq._worker_idx == idx
+                and rec.state == handoff_mod.PREFILLING
+            )
+            if ok:
+                handoff_mod.advance(rec.state, handoff_mod.STAGED)
+                rec.state = handoff_mod.STAGED
+                rec.pages = int(frame.get("pages", 0))
+                rec.nbytes = int(frame.get("nbytes", 0))
+                rec.base_len = int(frame.get("base_len", 0))
+                rec.generated_ids = [
+                    int(t) for t in frame.get("generated_ids") or []
+                ]
+                rec.resume_count = int(frame.get("resume_count", 0))
+                rec.migrate_count = int(frame.get("migrate_count", 0))
+                rec.preempt_count = int(frame.get("preempt_count", 0))
+                rec.swap_count = int(frame.get("swap_count", 0))
+                rec.kv_dtype = frame.get("kv_dtype")
+                rec.t0 = time.monotonic()
+        if not ok:
+            w = self.workers[idx]
+            client = w.client
+            if client is not None and not client.dead:
+                try:
+                    client.notify("handoff_cancel", sid=sid)
+                except WorkerLostError:
+                    pass
+            return
+        threading.Thread(
+            target=self._run_handoff, args=(rec,), daemon=True,
+            name=f"vgt-pod-handoff-{sid}",
+        ).start()
+
+    def _on_handoff_fallback(self, idx: int, frame: Dict[str, Any]) -> None:
+        """The prefill worker could not stage (host pool refused, abort
+        raced the fold): it keeps decoding monolithically."""
+        sid = int(frame.get("sid", -1))
+        with self._lock:
+            rec = self._handoffs.pop(sid, None)
+            if rec is not None:
+                rec.cancelled = True
+                self.total_handoff_fallbacks += 1
+        if rec is not None:
+            metrics.HANDOFF_TOTAL.labels(outcome="fallback_monolithic").inc()
+
+    def _run_handoff(self, rec: _HandoffRec) -> None:
+        metrics.HANDOFF_ACTIVE.inc()
+        try:
+            self._handoff_attempts(rec)
+        except BaseException:  # noqa: BLE001 — thread must not die loud
+            logger.error(
+                "handoff transfer thread crashed",
+                extra={"extra_data": {"sid": rec.sid}},
+                exc_info=True,
+            )
+            self._handoff_abandon(rec, "failed")
+        finally:
+            metrics.HANDOFF_ACTIVE.dec()
+
+    def _handoff_attempts(self, rec: _HandoffRec) -> None:
+        """Bounded-retry transfer loop.  Every exit is terminal for the
+        record: accept (ownership flips to the decode worker), fallback
+        (prefill worker resumes monolithic decode, zero recompute), or
+        abandon (the loss path owns the sequence)."""
+        pod = self._pod_cfg
+        while True:
+            with self._lock:
+                if rec.cancelled or rec.sid not in self._handoffs:
+                    return
+                if rec.state == handoff_mod.STAGED:
+                    handoff_mod.advance(
+                        rec.state, handoff_mod.TRANSFERRING
+                    )
+                    rec.state = handoff_mod.TRANSFERRING
+            target = self._decode_target(exclude=rec.prefill_idx)
+            if target is None:
+                self._handoff_fallback_monolithic(
+                    rec, "no decode-capable worker alive"
+                )
+                return
+            xid = f"h{rec.sid}.{next(self._xfer_ids)}"
+            with self._lock:
+                rec.target_idx = target.idx
+                rec.buffered = []
+                rec.terminal = None
+            try:
+                self._transfer_once(rec, target, xid)
+            except HandoffStaleError:
+                # the prefill side invalidated the staging (abort, or a
+                # worker-internal replay cleared the hold): whoever
+                # invalidated it owns the sequence now
+                self._handoff_abandon(rec, "fallback_monolithic")
+                return
+            except _SourceLost:
+                # prefill connection died: the pod loss path folds and
+                # replays the sequence on a survivor
+                self._handoff_abandon(rec, "failed")
+                return
+            except (
+                HandoffTransferError,
+                WorkerLostError,
+                TimeoutError,
+                faults.InjectedFault,
+            ) as exc:
+                rec.attempts += 1
+                with self._lock:
+                    committed = bool(rec.buffered or rec.terminal)
+                if committed:
+                    # the commit actually landed (the target is already
+                    # streaming tokens) — the error was a lost/slow
+                    # reply.  Finalize instead of retrying.
+                    self._finalize_accept(rec, target)
+                    return
+                # kill any partial/ghost admission on the target before
+                # the next attempt or the fallback
+                self._kill_target_copy(target, xid, rec.sid)
+                if rec.attempts > pod.transfer_max_retries:
+                    self._handoff_fallback_monolithic(rec, str(exc))
+                    return
+                metrics.HANDOFF_TOTAL.labels(outcome="retried").inc()
+                logger.warning(
+                    "handoff transfer attempt failed; retrying",
+                    extra={
+                        "extra_data": {
+                            "sid": rec.sid,
+                            "attempt": rec.attempts,
+                            "target": target.idx,
+                            "error": str(exc),
+                        }
+                    },
+                )
+                continue
+            self._finalize_accept(rec, target)
+            return
+
+    def _transfer_once(
+        self, rec: _HandoffRec, target: _Worker, xid: str
+    ) -> None:
+        """One pull-relay attempt: fetch chunks from the prefill worker,
+        put them to the decode worker, commit.  The ``kv_transfer``
+        fault point probes once per chunk (drop/garble/duplicate/delay
+        — drills for every framing failure mode)."""
+        pod = self._pod_cfg
+        pw = self.workers[rec.prefill_idx]
+        with self._lock:
+            stale_src = pw.epoch != rec.prefill_epoch
+        pclient = pw.client
+        tclient = target.client
+        if stale_src or pclient is None or pclient.dead:
+            raise _SourceLost()
+        if tclient is None or tclient.dead:
+            raise HandoffTransferError(
+                f"decode worker {target.idx} has no live connection"
+            )
+        deadline = time.monotonic() + pod.transfer_timeout_s
+        chunk = max(1, int(pod.transfer_chunk_bytes))
+        off = 0
+        total: Optional[int] = None
+        digest = 0
+        while total is None or off < total:
+            with self._lock:
+                if rec.cancelled:
+                    raise HandoffStaleError("handoff record cancelled")
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise HandoffTransferError(
+                    f"transfer timed out after "
+                    f"{pod.transfer_timeout_s:.0f}s"
+                )
+            try:
+                reply = pclient.call(
+                    "handoff_fetch", sid=rec.sid, off=off, n=chunk,
+                    timeout=budget,
+                )
+            except WorkerLostError as exc:
+                raise _SourceLost() from exc
+            total = int(reply.get("total", 0))
+            digest = int(reply.get("digest", 0))
+            try:
+                data = base64.b64decode(
+                    str(reply.get("data", "")), validate=True
+                )
+            except (binascii.Error, ValueError) as exc:
+                raise HandoffTransferError(
+                    f"undecodable fetch chunk: {exc}"
+                ) from exc
+            if not data:
+                if off >= total:
+                    break
+                raise HandoffTransferError(
+                    f"empty fetch chunk at offset {off}/{total}"
+                )
+            verdict = (
+                faults.wire_action("kv_transfer")
+                if faults.is_active()
+                else None
+            )
+            if verdict != "drop":
+                out = data
+                if verdict == "garble":
+                    out = bytes(b ^ 0x55 for b in data[:64]) + data[64:]
+                payload = base64.b64encode(out).decode("ascii")
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    raise HandoffTransferError("transfer timed out")
+                tclient.call(
+                    "handoff_put", xfer=xid, off=off, total=total,
+                    data=payload, timeout=budget,
+                )
+                if verdict == "duplicate":
+                    tclient.call(
+                        "handoff_put", xfer=xid, off=off, total=total,
+                        data=payload,
+                        timeout=max(1.0, deadline - time.monotonic()),
+                    )
+            # a dropped chunk leaves a gap: commit raises typed, the
+            # attempt retries with a fresh transfer id
+            off += len(data)
+        if not total:
+            raise HandoffTransferError("staged blob is empty")
+        seq = rec.seq
+        remaining = None
+        if seq.deadline_t is not None:
+            remaining = max(
+                0.01, seq.deadline_t - time.perf_counter()
+            )
+        budget = deadline - time.monotonic()
+        if budget <= 0:
+            raise HandoffTransferError("transfer timed out before commit")
+        reply = tclient.call(
+            "handoff_commit",
+            xfer=xid,
+            sid=rec.sid,
+            digest=digest,
+            pages=rec.pages,
+            base_len=rec.base_len,
+            prompt_ids=[
+                int(t) for t in seq.prompt_ids[: seq.orig_prompt_len]
+            ],
+            generated_ids=[int(t) for t in rec.generated_ids],
+            params=params_to_wire(seq.params),
+            remaining_s=remaining,
+            request_id=seq.request_id,
+            resume_count=rec.resume_count,
+            migrate_count=rec.migrate_count,
+            preempt_count=rec.preempt_count,
+            swap_count=rec.swap_count,
+            handoff_count=seq.handoff_count + 1,
+            kv_dtype=rec.kv_dtype,
+            timeout=budget,
+        )
+        if not reply.get("accepted"):
+            raise HandoffTransferError(
+                f"decode worker {target.idx} refused commit"
+            )
+
+    def _finalize_accept(self, rec: _HandoffRec, target: _Worker) -> bool:
+        """Atomically flip sequence ownership to the decode worker
+        (TRANSFERRING → ACCEPTED → DECODING), reconcile the client
+        token stream to the fold point, replay buffered target frames
+        in order, and release the prefill worker's surplus copy."""
+        with self._lock:
+            seq = self._inflight.get(rec.sid)
+            ok = (
+                not rec.cancelled
+                and rec.sid in self._handoffs
+                and seq is rec.seq
+                and seq is not None
+                and seq._worker_idx == rec.prefill_idx
+                and not seq.done_event.is_set()
+            )
+            if ok:
+                handoff_mod.advance(rec.state, handoff_mod.ACCEPTED)
+                rec.state = handoff_mod.ACCEPTED
+                seq._worker_idx = target.idx
+                seq.handoff_count += 1
+                # tok frames still in flight from the prefill worker are
+                # fenced by the ownership flip: append the fold-point
+                # suffix here so the client stream loses nothing
+                for t in rec.generated_ids[len(seq.generated_ids):]:
+                    seq.append_token(int(t))
+                handoff_mod.advance(rec.state, handoff_mod.DECODING)
+                rec.state = handoff_mod.DECODING
+        if not ok:
+            # the sequence moved under us (loss replay / abort): the
+            # current owner's stream is authoritative — kill the
+            # decode-side admission so no ghost burns slots
+            tclient = target.client
+            if tclient is not None and not tclient.dead:
+                try:
+                    tclient.notify(
+                        "abort", sid=rec.sid, reason="handoff_superseded"
+                    )
+                except WorkerLostError:
+                    pass
+            self._handoff_abandon(rec, "failed")
+            return False
+        # drain buffered decode-side frames in arrival order; keep the
+        # record registered until the buffer runs dry so the reader
+        # thread keeps buffering instead of racing these appends
+        terminal = None
+        while True:
+            with self._lock:
+                frames, rec.buffered = rec.buffered, []
+                if not frames:
+                    terminal = rec.terminal
+                    self._handoffs.pop(rec.sid, None)
+                    break
+            for f in frames:
+                self._apply_token(seq, f)
+        if terminal is not None:
+            kind, f = terminal
+            if kind == "done":
+                self._on_done(target.idx, f)
+            elif kind == "err":
+                self._on_err(target.idx, f)
+        pw = self.workers[rec.prefill_idx]
+        pclient = pw.client
+        if pclient is not None and not pclient.dead:
+            try:
+                pclient.notify("handoff_done", sid=rec.sid)
+            except WorkerLostError:
+                pass  # dead prefill worker frees the copy by dying
+        with self._lock:
+            self.total_handoffs += 1
+        metrics.HANDOFF_TOTAL.labels(outcome="ok").inc()
+        metrics.HANDOFF_SECONDS.observe(time.monotonic() - rec.t0)
+        metrics.HANDOFF_BYTES.observe(rec.nbytes)
+        logger.info(
+            "kv handoff complete",
+            extra={
+                "extra_data": {
+                    "sid": rec.sid,
+                    "prefill": rec.prefill_idx,
+                    "decode": target.idx,
+                    "pages": rec.pages,
+                    "nbytes": rec.nbytes,
+                    "attempts": rec.attempts,
+                }
+            },
+        )
+        return True
+
+    def _kill_target_copy(
+        self, target: _Worker, xid: str, sid: int
+    ) -> None:
+        """Best-effort ghost cleanup on the decode worker after a failed
+        attempt: drop the partial reassembly AND abort any admission a
+        lost commit reply may have left running (its frames are fenced
+        by `_seq_for`'s ownership check either way)."""
+        tclient = target.client
+        if tclient is None or tclient.dead:
+            return
+        try:
+            tclient.notify("handoff_abort", xfer=xid)
+            tclient.notify("abort", sid=sid, reason="handoff_retry")
+        except WorkerLostError:
+            pass
+
+    def _handoff_fallback_monolithic(
+        self, rec: _HandoffRec, detail: str
+    ) -> None:
+        """Terminal degrade: release the hold on the prefill worker so
+        it swap-ins the staged KV and decodes monolithically — zero
+        recompute, zero 5xx, token-identical."""
+        with self._lock:
+            existed = self._handoffs.pop(rec.sid, None) is not None
+            rec.cancelled = True
+            if existed:
+                self.total_handoff_fallbacks += 1
+            pw = self.workers[rec.prefill_idx]
+            stale_src = pw.epoch != rec.prefill_epoch
+        if not existed:
+            return
+        metrics.HANDOFF_TOTAL.labels(outcome="fallback_monolithic").inc()
+        logger.warning(
+            "handoff degraded to monolithic decode",
+            extra={
+                "extra_data": {
+                    "sid": rec.sid,
+                    "prefill": rec.prefill_idx,
+                    "detail": detail,
+                }
+            },
+        )
+        pclient = pw.client
+        if stale_src or pclient is None or pclient.dead:
+            return  # the loss path already owns the sequence
+        try:
+            pclient.call(
+                "handoff_cancel", sid=rec.sid,
+                timeout=self._pod_cfg.call_timeout_s,
+            )
+        except (WorkerLostError, TimeoutError):
+            # the frame is queued on a live-but-slow connection and
+            # will still release the hold when processed; a truly dead
+            # worker routes through the loss path instead
+            pass
+
+    def _handoff_abandon(self, rec: _HandoffRec, outcome: str) -> None:
+        """Drop a record whose sequence somebody else now owns (loss
+        replay, abort, worker-local resume).  Counted once."""
+        with self._lock:
+            existed = self._handoffs.pop(rec.sid, None) is not None
+            rec.cancelled = True
+            if existed:
+                if outcome == "failed":
+                    self.total_handoff_failed += 1
+                elif outcome == "fallback_monolithic":
+                    self.total_handoff_fallbacks += 1
+        if existed:
+            metrics.HANDOFF_TOTAL.labels(outcome=outcome).inc()
+
+    def _handoff_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            active = len(self._handoffs)
+            return {
+                "active": active,
+                "completed": self.total_handoffs,
+                "fallback_monolithic": self.total_handoff_fallbacks,
+                "failed": self.total_handoff_failed,
+                "roles": list(self._roles) if self._roles_active else [],
+            }
+
     # ------------------------------------------------------------- routing
 
     def _alive_workers(self, exclude: Optional[int] = None) -> List[_Worker]:
@@ -525,16 +1116,41 @@ class PodEngine:
                 if w.alive and not w.draining and w.idx != exclude
             ]
 
+    def _role(self, idx: int) -> str:
+        return self._roles[idx] if 0 <= idx < len(self._roles) else "mixed"
+
+    def _decode_target(self, exclude: Optional[int] = None) -> Optional[_Worker]:
+        """Least-loaded decode-capable worker, or None — the caller
+        degrades to monolithic decode rather than 5xx."""
+        cands = [
+            w
+            for w in self._alive_workers(exclude=exclude)
+            if self._role(w.idx) in ("decode", "mixed")
+        ]
+        return min(cands, key=self._load) if cands else None
+
     def _pick_worker(
         self,
         prompt_ids: Optional[List[int]] = None,
         exclude: Optional[int] = None,
+        role: Optional[str] = None,
     ) -> _Worker:
         """dp's router, over worker handles: least-loaded among routable
         workers with prefix affinity (each worker's KV prefix cache is
         private — requests sharing a first page stick together unless
-        that costs real queueing headroom)."""
+        that costs real queueing headroom).  With ``pod.roles`` active,
+        ``role`` names the preferred pool (prefill/decode; ``mixed``
+        workers belong to both); an empty pool falls through to every
+        routable worker — a drained pool degrades, never 500s."""
         candidates = self._alive_workers(exclude=exclude)
+        if role is not None and candidates:
+            pooled = [
+                w
+                for w in candidates
+                if self._role(w.idx) in (role, "mixed")
+            ]
+            if pooled:
+                candidates = pooled
         if not candidates:
             # fall back to any live worker (a fully-draining pod still
             # serves rather than 500s)
@@ -566,6 +1182,7 @@ class PodEngine:
                 sticky.alive
                 and not sticky.draining
                 and sticky.idx != exclude
+                and any(w.idx == sticky.idx for w in candidates)
                 and self._load(sticky)
                 <= self._load(best)
                 + max(2, self.config.tpu.max_batch_slots // 4)
@@ -611,11 +1228,21 @@ class PodEngine:
         alive workers on connection-level failures (a typed engine
         error — quarantine, overload — propagates immediately)."""
         prompt = seq.prompt_ids[: seq.orig_prompt_len]
+        role: Optional[str] = None
+        if self._roles_active:
+            # fresh (prefill-heavy) work goes to the prefill pool;
+            # replays already carrying generated tokens — including
+            # post-handoff continuations — belong with the decode pool
+            role = (
+                "decode"
+                if (seq.generated_ids or seq.handoff_count)
+                else "prefill"
+            )
         tried: set = set()
         last: Optional[BaseException] = None
         for _ in range(len(self.workers)):
             try:
-                w = self._pick_worker(prompt, exclude=exclude)
+                w = self._pick_worker(prompt, exclude=exclude, role=role)
             except WorkerLostError as exc:
                 last = exc
                 break
@@ -630,9 +1257,22 @@ class PodEngine:
                 remaining = seq.deadline_t - time.perf_counter()
                 if remaining <= 0:
                     remaining = 0.01  # let the worker shed it typed
+            # request a staged handoff only when the chosen worker is a
+            # dedicated prefill worker AND a decode-capable target
+            # exists right now — otherwise decode monolithically
+            want_handoff = (
+                role == "prefill"
+                and self._role(w.idx) == "prefill"
+                and self._decode_target(exclude=w.idx) is not None
+            )
+            extra = {"handoff": True} if want_handoff else {}
             with self._lock:
                 seq._worker_idx = w.idx
                 self._inflight[seq._sid] = seq
+                if want_handoff:
+                    self._handoffs[seq._sid] = _HandoffRec(
+                        seq._sid, seq, w.idx, w.epoch
+                    )
             try:
                 client.call(
                     "submit",
@@ -646,6 +1286,7 @@ class PodEngine:
                     migrate_count=seq.migrate_count,
                     preempt_count=seq.preempt_count,
                     kv_dtype=seq.kv_dtype,
+                    **extra,
                 )
                 return
             except (WorkerLostError, TimeoutError) as exc:
@@ -654,10 +1295,16 @@ class PodEngine:
                 last = exc
                 with self._lock:
                     self._inflight.pop(seq._sid, None)
+                    rec = self._handoffs.pop(seq._sid, None)
+                    if rec is not None:
+                        rec.cancelled = True
                 continue
             except BaseException:
                 with self._lock:
                     self._inflight.pop(seq._sid, None)
+                    rec = self._handoffs.pop(seq._sid, None)
+                    if rec is not None:
+                        rec.cancelled = True
                 raise
         raise last or WorkerLostError(
             "no engine worker accepted the request; retry shortly",
@@ -897,8 +1544,19 @@ class PodEngine:
             victims = [
                 s for s in self._inflight.values() if s._worker_idx == idx
             ]
+            lost_handoffs = 0
             for s in victims:
                 self._inflight.pop(s._sid, None)
+                # a handoff whose prefill side just died: cancel the
+                # record so the transfer thread stands down — the
+                # replay below re-prefills on a survivor (budgeted)
+                rec = self._handoffs.pop(s._sid, None)
+                if rec is not None:
+                    rec.cancelled = True
+                    self.total_handoff_failed += 1
+                    lost_handoffs += 1
+        for _ in range(lost_handoffs):
+            metrics.HANDOFF_TOTAL.labels(outcome="failed").inc()
         metrics.POD_WORKER_LOSSES.labels(reason=reason).inc()
         self._set_alive_gauge()
         logger.error(
@@ -1111,6 +1769,12 @@ class PodEngine:
         alive = sum(1 for w in self.workers if w.alive)
         metrics.POD_WORKERS_ALIVE.set(alive)
         metrics.POD_WORKERS_TOTAL.set(len(self.workers))
+        counts = {"prefill": 0, "decode": 0, "mixed": 0}
+        for w in self.workers:
+            if w.alive:
+                counts[self._role(w.idx)] += 1
+        for role, n in counts.items():
+            metrics.POOL_WORKERS.labels(role=role).set(n)
 
     def _worker_entry(self, w: _Worker, now: float) -> Dict[str, Any]:
         if w.draining:
@@ -1131,6 +1795,7 @@ class PodEngine:
             "replica": w.idx,
             "state": state,
             "epoch": w.epoch,
+            "role": self._role(w.idx),
             "pid": w.proc.pid if w.proc is not None else None,
         }
         if w.last_fatal:
@@ -1181,6 +1846,7 @@ class PodEngine:
             "lost": self.total_lost,
             "quarantined": 0,
             "fenced_frames": self.fenced_frames,
+            "handoffs": self._handoff_stats(),
         }
 
     def device_health(self) -> Dict[str, Any]:
@@ -1277,6 +1943,7 @@ class PodEngine:
                     "worker": w.idx,
                     "epoch": w.epoch,
                     "state": w.state,
+                    "role": self._role(w.idx),
                     "draining": w.draining,
                     "pid": w.proc.pid if w.proc is not None else None,
                 }
@@ -1286,6 +1953,8 @@ class PodEngine:
             "fenced_frames": self.fenced_frames,
             "inflight": len(self._inflight),
             "orphans": len(self._orphans),
+            "roles": list(self._roles),
+            "handoffs": self._handoff_stats(),
         }
         agg["replicas"] = per_worker
         return agg

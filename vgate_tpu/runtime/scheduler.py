@@ -207,6 +207,8 @@ class Scheduler:
         self.total_admitted = 0
         self.total_finished = 0
         self.total_aborted = 0
+        # sequences folded + staged for a prefill→decode handoff
+        self.total_handoff_holds = 0
 
     # -- admission --
 
@@ -216,6 +218,9 @@ class Scheduler:
             len(self.waiting) >= self.max_queue_size
             and seq.resume_count == 0
             and seq.migrate_count == 0
+            # a handoff-adopted sequence (disaggregated prefill→decode)
+            # was likewise already admitted on its prefill worker
+            and seq.handoff_count == 0
             # integrity canaries bypass too: a self-probe rejected by an
             # overload gate would read as a corruption verdict and tear
             # down a merely-busy replica (one tiny greedy probe cannot
@@ -512,14 +517,18 @@ class Scheduler:
         (``has_admissible_waiting``) must not age the head."""
         if not self._priority_seen:
             best = None
-            for seq in self.waiting:  # head modulo an aborted prefix
-                if not seq.abort_requested:
+            for seq in self.waiting:  # head modulo an aborted/held prefix
+                if not seq.abort_requested and not getattr(
+                    seq, "_handoff_hold", False
+                ):
                     best = seq
                     break
         else:
             best = None
             for seq in self.waiting:
-                if seq.abort_requested:
+                if seq.abort_requested or getattr(
+                    seq, "_handoff_hold", False
+                ):
                     continue
                 if best is None or (_rank(seq), seq.seq_id) < (
                     _rank(best), best.seq_id
@@ -541,7 +550,11 @@ class Scheduler:
         warm, warm_pages = best, best_pages
         seen = 0
         for seq in self.waiting:
-            if seq.abort_requested or _rank(seq) != best_rank:
+            if (
+                seq.abort_requested
+                or getattr(seq, "_handoff_hold", False)
+                or _rank(seq) != best_rank
+            ):
                 continue
             seen += 1
             if seen > self.CACHE_AWARE_LOOKAHEAD:
@@ -942,6 +955,65 @@ class Scheduler:
         metrics.PREEMPTED_SEQUENCES.inc()
         metrics.ACTIVE_SEQUENCES.set(len(self.running))
         metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+
+    # -- disaggregated prefill→decode handoff (runtime/handoff.py) --
+
+    @engine_thread_only
+    def hold_for_handoff(self, seq: Sequence) -> bool:
+        """Fold a RUNNING sequence off the device and park its valid KV
+        in the host pool for a prefill→decode handoff — mechanically a
+        swap-preemption (same valid-KV bound, same ticket), but the
+        sequence then sits in ``waiting`` marked HELD: ``_select_next``
+        skips it, so it neither re-admits locally nor blocks admission,
+        while every existing settle path (abort reap, deadline shed,
+        containment fold) still finds it.  The exit paths:
+
+        * transfer accepted → :meth:`evacuate` (dequeue + discard the
+          local ticket; the decode worker owns the sequence now),
+        * transfer failed / cancelled → :meth:`release_hold` (clear the
+          mark; ``try_admit`` swap-ins the local ticket and decode
+          continues monolithically with zero recompute).
+
+        False = could not stage (no swap tier / pool full / readback
+        raced a fold): the sequence keeps running untouched and the
+        caller reports the monolithic fallback."""
+        if self.swap is None or seq.status is not SeqStatus.RUNNING:
+            return False
+        n_valid = cdiv(max(1, seq.total_len - 1), self.page_size)
+        if not self.swap.swap_out_seq(seq, seq.pages[:n_valid]):
+            return False
+        self._event(
+            "handoff_hold", seq, resident_tokens=seq.total_len,
+        )
+        if self.recorder is not None:
+            # phase accounting: accrue the interrupted compute phase;
+            # re-enters queue time until the decode worker resumes it
+            # (or release_hold re-admits it here)
+            self.recorder.on_preempt(seq)
+        slot = seq.slot
+        self._radix_unlock(seq)
+        self.allocator.release(seq.pages)
+        if slot is not None:
+            self.slots[slot] = None
+        seq.reset_for_swap()
+        seq._handoff_hold = True  # type: ignore[attr-defined]
+        self.waiting.appendleft(seq)
+        self.total_handoff_holds += 1
+        metrics.ACTIVE_SEQUENCES.set(len(self.running))
+        metrics.ENGINE_QUEUE_DEPTH.set(len(self.waiting))
+        return True
+
+    @engine_thread_only
+    def release_hold(self, seq: Sequence) -> None:
+        """Lift a handoff hold: the transfer fell through (retries
+        exhausted, decode pool drained, raced a cancel), so the
+        sequence becomes an ordinary swapped-out waiting sequence —
+        the next ``try_admit`` finds its live ticket and swap-ins for
+        a monolithic local decode with zero recompute.  Idempotent;
+        a no-op for settled or never-held sequences."""
+        if getattr(seq, "_handoff_hold", False):
+            seq._handoff_hold = False  # type: ignore[attr-defined]
+            self._event("handoff_release", seq)
 
     # -- completion --
 

@@ -96,6 +96,15 @@ class Sequence:
     # live ticket itself rides on the private `_swap_ticket` attribute
     # (manager-owned; validity is epoch-guarded by preempt_count).
     swap_count: int = 0
+    # Disaggregated prefill→decode handoff (pod.roles; runtime/
+    # handoff.py).  handoff_requested is the submit-time wire flag: the
+    # engine stages the sequence's KV for transfer once the first token
+    # exists (then clears the flag).  handoff_count is bumped by the
+    # GATEWAY when a decode worker accepts the transfer; >0 surfaces as
+    # `disaggregated` on the final result.  The engine-side hold marker
+    # rides on the private `_handoff_hold` attribute (scheduler-owned).
+    handoff_requested: bool = False
+    handoff_count: int = 0
     # integrity canary self-probe (vgate_tpu/integrity.py): ranks ahead
     # of client traffic at admission (a probe stuck behind a deep queue
     # can't verify anything in time) and is NEVER checkpointed/replayed
@@ -242,6 +251,8 @@ class Sequence:
             out["resumed"] = float(self.resume_count)
         if self.migrate_count:
             out["migrated"] = float(self.migrate_count)
+        if self.handoff_count:
+            out["disaggregated"] = float(self.handoff_count)
         return out
 
     def checkpoint(self) -> "SequenceCheckpoint":
@@ -305,6 +316,12 @@ class Sequence:
         deadline) stays valid.  The preempt_count bump doubles as the
         staleness epoch: an engine thread with this sequence still in
         flight discards its late readbacks against it."""
+        # a handoff hold does not survive a fold: the staged ticket is
+        # invalidated by the epoch bump below, and a replayed sequence
+        # still marked held would be skipped by admission forever
+        if getattr(self, "_handoff_hold", False):
+            self._handoff_hold = False
+        self.handoff_requested = False
         if self.status is SeqStatus.RUNNING or self.output_ids:
             self.reset_for_recompute()
         else:

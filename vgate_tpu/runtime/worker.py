@@ -42,6 +42,8 @@ Wire protocol (all frames carry the fencing epoch ``"e"``):
 from __future__ import annotations
 
 import argparse
+import base64
+import binascii
 import json
 import logging
 import os
@@ -51,12 +53,19 @@ import socket
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set
 
 from vgate_tpu import faults
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.config import VGTConfig, set_config
-from vgate_tpu.errors import WorkerFencedError, state_is_alive, state_is_ready
+from vgate_tpu.errors import (
+    HandoffStaleError,
+    HandoffTransferError,
+    WorkerFencedError,
+    state_is_alive,
+    state_is_ready,
+)
+from vgate_tpu.runtime import handoff as handoff_mod
 from vgate_tpu.runtime import rpc
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 
@@ -69,6 +78,10 @@ logger = logging.getLogger(__name__)
 VGT_COMPONENTS: Dict[str, str] = {}
 VGT_LOCK_GUARDS = {
     "_seqs": "_seq_lock",
+    "_staged": "_seq_lock",
+    "_xfers": "_seq_lock",
+    "_xfer_committed": "_seq_lock",
+    "_xfer_committing": "_seq_lock",
 }
 
 # Sender-queue ceiling: a gateway that stopped reading gets its worker
@@ -150,6 +163,35 @@ class _Entry:
         self.cancelled = False  # evacuated/aborted: waiter stays silent
 
 
+class _Staged:
+    """One staged prefill→decode handoff (runtime/handoff.py) awaiting
+    the gateway's pull transfer.  ``payload`` is a direct reference to
+    the swap ticket's KV pytree taken ON the engine thread at stage
+    time, so a later discard nulling the ticket's own reference cannot
+    race the packing; ``epoch`` is the sequence's preempt_count at
+    stage — any fold since invalidates every fetch (HandoffStaleError).
+    ``blob``/``digest`` cache the packed wire form lazily (first
+    fetch)."""
+
+    __slots__ = (
+        "sid", "seq", "payload", "num_pages", "nbytes", "epoch",
+        "blob", "digest",
+    )
+
+    def __init__(
+        self, sid: int, seq: Sequence, payload: Any,
+        num_pages: int, nbytes: int, epoch: int,
+    ) -> None:
+        self.sid = sid
+        self.seq = seq
+        self.payload = payload
+        self.num_pages = num_pages
+        self.nbytes = nbytes
+        self.epoch = epoch
+        self.blob: Optional[bytes] = None
+        self.digest: Optional[int] = None
+
+
 class WorkerServer:
     """The worker main object: engine + one-connection frame server."""
 
@@ -161,6 +203,23 @@ class WorkerServer:
         self._build_engine()
         self._seq_lock = threading.Lock()
         self._seqs: Dict[int, _Entry] = {}
+        # Disaggregated prefill/decode (pod.roles) handoff state.  On a
+        # prefill worker, _staged holds packed-KV staging records keyed
+        # by sid; on a decode worker, _xfers holds in-progress chunk
+        # reassemblies keyed by the gateway's per-attempt transfer id.
+        # _xfer_committed remembers recently-committed transfer ids so
+        # a gateway retry after a lost commit reply is answered
+        # idempotently instead of double-admitting; _xfer_committing
+        # rejects a CONCURRENT duplicate commit (two admissions of the
+        # same sequence would diverge).
+        self._staged: Dict[int, _Staged] = {}
+        self._xfers: Dict[str, handoff_mod.ChunkAssembler] = {}
+        self._xfer_committed: Set[str] = set()
+        self._xfer_committing: Set[str] = set()
+        self._staging_cap = max(
+            int(config.pod.transfer_staging_bytes),
+            int(config.kv_cache.host_swap_bytes),
+        )
         self._send_lock = threading.Lock()
         self._send_q: "queue.Queue[Optional[bytes]]" = queue.Queue(
             maxsize=_SEND_QUEUE_MAX
@@ -327,6 +386,12 @@ class WorkerServer:
         params = params_from_wire(raw_params)
         prompt_ids = [int(t) for t in frame.get("prompt_ids") or []]
         generated = [int(t) for t in frame.get("generated_ids") or []]
+        handoff = bool(frame.get("handoff"))
+        if handoff:
+            # re-arm on every handoff submit: cheap, and it survives a
+            # supervisor core rebuild (the rebuilt core starts with the
+            # callback unset)
+            self._inner().on_handoff_staged = self._on_handoff_staged
 
         entry_cell: List[_Entry] = []
 
@@ -363,6 +428,7 @@ class WorkerServer:
             kv_dtype=frame.get("kv_dtype"),
             stream_cb=on_token,
         )
+        seq.handoff_requested = handoff
         entry = _Entry(sid, seq)
         entry_cell.append(entry)
         # supervisor deployments: apply the same admission gate
@@ -429,9 +495,319 @@ class WorkerServer:
         reason = str(frame.get("reason", "client_disconnect"))
         with self._seq_lock:
             entry = self._seqs.get(sid)
+            # an aborted staged handoff will never be fetched again; the
+            # scheduler's abort path reaps the swap ticket itself
+            self._staged.pop(sid, None)
         if entry is not None and entry.seq is not None:
             entry.seq.request_abort(reason)
         return {"aborted": entry is not None}
+
+    # ------------------------------------------------- handoff (pod.roles)
+    #
+    # Prefill side: the engine stages a finished prefill (KV folded to
+    # the PR-11 host pool) and fires on_handoff_staged on its own
+    # thread; we notify the gateway, which pulls the packed KV in
+    # chunks (handoff_fetch) and finally tells us the outcome
+    # (handoff_done / handoff_cancel).  Decode side: the gateway pushes
+    # chunks (handoff_put) and commits (handoff_commit) — an atomic,
+    # idempotent admission that adopts the KV pages with zero
+    # recompute.  All transfer corruption surfaces as TYPED errors
+    # (HandoffTransferError / HandoffStaleError); the gateway owns
+    # retry and monolithic fallback.
+
+    def _on_handoff_staged(self, seq: Sequence, staged: bool) -> None:
+        """EngineCore callback, runs ON the engine thread: register the
+        staging record and notify the gateway (or report fallback if
+        the engine could not stage)."""
+        with self._seq_lock:
+            entry = None
+            for e in self._seqs.values():
+                if e.seq is seq:
+                    entry = e
+                    break
+        if entry is None or entry.cancelled:
+            return
+        if not staged:
+            self._enqueue({"op": "handoff_fallback", "sid": entry.sid})
+            return
+        ticket = getattr(seq, "_swap_ticket", None)
+        if ticket is None or ticket.payload is None:
+            # staged but the ticket vanished (defensive): tell the
+            # gateway to fall back; the engine's release path resumes
+            # local decode
+            self._inner().handoff_cancel(seq)
+            self._enqueue({"op": "handoff_fallback", "sid": entry.sid})
+            return
+        st = _Staged(
+            entry.sid, seq, ticket.payload, int(ticket.num_pages),
+            int(ticket.nbytes), int(seq.preempt_count),
+        )
+        with self._seq_lock:
+            self._staged[entry.sid] = st
+        self._enqueue(
+            {
+                "op": "handoff_staged",
+                "sid": entry.sid,
+                "pages": st.num_pages,
+                "nbytes": st.nbytes,
+                "base_len": len(seq.prompt_ids),
+                "generated_ids": [int(t) for t in seq.generated_ids],
+                "resume_count": seq.resume_count,
+                "migrate_count": seq.migrate_count,
+                "preempt_count": seq.preempt_count,
+                "swap_count": seq.swap_count,
+                "kv_dtype": seq.kv_dtype,
+            }
+        )
+
+    def _verb_handoff_fetch(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Serve one chunk of the staged, packed KV blob.  Validity is
+        re-checked per fetch: any fold/abort since staging (supervisor
+        replay, deadline abort) invalidates the bytes — stale KV must
+        never leave this process."""
+        sid = int(frame["sid"])
+        off = int(frame.get("off", 0))
+        n = int(frame.get("n", 0))
+        with self._seq_lock:
+            st = self._staged.get(sid)
+        if st is None:
+            raise HandoffStaleError(f"no staged handoff for sid {sid}")
+        seq = st.seq
+        if (
+            not getattr(seq, "_handoff_hold", False)
+            or seq.preempt_count != st.epoch
+            or seq.status is not SeqStatus.WAITING
+        ):
+            with self._seq_lock:
+                self._staged.pop(sid, None)
+            raise HandoffStaleError(
+                f"staged handoff for sid {sid} invalidated "
+                f"(status={seq.status.name}, epoch {seq.preempt_count} "
+                f"vs staged {st.epoch})"
+            )
+        blob = st.blob
+        digest = st.digest
+        if blob is None:
+            packed = handoff_mod.pack_payload(st.payload)
+            packed_digest = handoff_mod.payload_digest(packed)
+            # CAS under the lock: a retry racing a timed-out fetch may
+            # pack concurrently; first publication wins so every chunk
+            # of one transfer comes from ONE byte-identical blob
+            with self._seq_lock:
+                if st.blob is None:
+                    st.blob = packed
+                    st.digest = packed_digest
+                blob = st.blob
+                digest = st.digest
+        if off < 0 or off > len(blob):
+            raise HandoffTransferError(
+                f"fetch offset {off} out of bounds (blob {len(blob)}B)"
+            )
+        # b64 expands 4/3; leave frame headroom for the JSON envelope
+        limit = max(1, (self.max_frame_bytes * 3) // 5)
+        n = min(n if n > 0 else limit, limit)
+        data = base64.b64encode(blob[off:off + n]).decode("ascii")
+        return {
+            "total": len(blob),
+            "digest": digest,
+            "pages": st.num_pages,
+            "data": data,
+        }
+
+    def _verb_handoff_cancel(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Gateway gave up on the transfer: drop staging and resume the
+        sequence locally (monolithic decode via swap-in, zero
+        recompute)."""
+        sid = int(frame["sid"])
+        with self._seq_lock:
+            st = self._staged.pop(sid, None)
+            entry = self._seqs.get(sid)
+        if st is not None and entry is not None and not entry.cancelled:
+            self._inner().handoff_cancel(st.seq)
+            return {"resumed": True}
+        return {"resumed": False}
+
+    def _verb_handoff_done(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Transfer accepted by the decode worker: this worker's copy is
+        now surplus.  Cancel the entry (the waiter stays silent — the
+        sequence never settles here; the gateway owns the client) and
+        let the engine evacuate the held sequence + discard its swap
+        ticket."""
+        sid = int(frame["sid"])
+        with self._seq_lock:
+            st = self._staged.pop(sid, None)
+            entry = self._seqs.pop(sid, None)
+        if entry is not None:
+            entry.cancelled = True
+        if st is not None:
+            self._inner().handoff_done(st.seq)
+        return {"ok": st is not None}
+
+    def _verb_handoff_put(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Accept one chunk of an inbound KV transfer (decode side).
+        Byte-identical redelivery is idempotent; conflicting overlap,
+        truncation past total, or undecodable data is a typed error."""
+        xid = str(frame["xfer"])
+        off = int(frame.get("off", 0))
+        total = int(frame.get("total", 0))
+        try:
+            data = base64.b64decode(
+                str(frame.get("data", "")), validate=True
+            )
+        except (binascii.Error, ValueError) as exc:
+            raise HandoffTransferError(
+                f"undecodable transfer chunk: {exc}"
+            ) from exc
+        with self._seq_lock:
+            if xid in self._xfer_committed:
+                return {"got": total, "dup": True}
+            asm = self._xfers.get(xid)
+            if asm is None:
+                asm = handoff_mod.ChunkAssembler(total, self._staging_cap)
+                self._xfers[xid] = asm
+        if asm.total != total:
+            raise HandoffTransferError(
+                f"transfer {xid}: total mismatch "
+                f"({total} vs first-seen {asm.total})"
+            )
+        got = asm.put(off, data)
+        return {"got": got}
+
+    def _verb_handoff_commit(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Finalize an inbound transfer: verify completeness + digest,
+        unpack the KV pytree, and admit the sequence with the adopted
+        pages (zero recompute).  Idempotent on retry; a concurrent
+        duplicate is refused (double admission would diverge)."""
+        xid = str(frame["xfer"])
+        sid = int(frame["sid"])
+        with self._seq_lock:
+            if xid in self._xfer_committed or sid in self._seqs:
+                # retry of a commit whose reply was lost — the sequence
+                # is already (or still) admitted; re-accepting is a
+                # no-op for the gateway
+                return {"accepted": True, "dup": True}
+            if xid in self._xfer_committing:
+                raise HandoffTransferError(
+                    f"transfer {xid}: commit already in progress"
+                )
+            self._xfer_committing.add(xid)
+        try:
+            return self._handoff_commit_locked_out(xid, sid, frame)
+        finally:
+            with self._seq_lock:
+                self._xfer_committing.discard(xid)
+
+    def _handoff_commit_locked_out(
+        self, xid: str, sid: int, frame: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        with self._seq_lock:
+            asm = self._xfers.get(xid)
+        if asm is None:
+            raise HandoffTransferError(f"unknown transfer {xid}")
+        blob = asm.complete()  # typed error on gaps → gateway retries
+        want_digest = int(frame.get("digest", 0))
+        got_digest = handoff_mod.payload_digest(blob)
+        if got_digest != want_digest:
+            # drop the assembler so the retry rebuilds from scratch —
+            # we cannot tell WHICH chunk was garbled
+            with self._seq_lock:
+                self._xfers.pop(xid, None)
+            raise HandoffTransferError(
+                f"transfer {xid}: payload digest mismatch "
+                f"(got {got_digest}, want {want_digest})"
+            )
+        payload = handoff_mod.unpack_payload(blob)
+
+        raw_params = dict(frame.get("params") or {})
+        remaining_s = frame.get("remaining_s")
+        if remaining_s is not None:
+            raw_params["timeout_s"] = max(0.01, float(remaining_s))
+        params = params_from_wire(raw_params)
+        prompt_ids = [int(t) for t in frame.get("prompt_ids") or []]
+        generated = [int(t) for t in frame.get("generated_ids") or []]
+        base_len = int(frame.get("base_len", len(prompt_ids)))
+        num_pages = int(frame.get("pages", 0))
+        full = prompt_ids + generated
+        if base_len <= 0 or base_len > len(full):
+            raise HandoffTransferError(
+                f"transfer {xid}: base_len {base_len} out of range"
+            )
+        inner = self._inner()
+        page_size = int(getattr(inner.geometry, "page_size", 0) or 1)
+        want_pages = (max(1, len(full) - 1) + page_size - 1) // page_size
+        if num_pages != want_pages:
+            raise HandoffTransferError(
+                f"transfer {xid}: page-count mismatch "
+                f"({num_pages} shipped, geometry wants {want_pages})"
+            )
+
+        entry_cell: List[_Entry] = []
+
+        def on_token(token: int) -> None:
+            entry = entry_cell[0]
+            if entry.cancelled:
+                return
+            lp = None
+            seq = entry.seq
+            if seq.params.logprobs and len(seq.logprob_data) >= len(
+                seq.generated_ids
+            ):
+                lp = seq.logprob_data[len(seq.generated_ids) - 1]
+            self._enqueue(
+                {"op": "tok", "sid": sid, "t": int(token), "lp": lp}
+            )
+
+        # swap-shape construction: prompt/output split at the PREFILL
+        # worker's fold point so total_len ↔ shipped page count agree;
+        # orig_prompt_len keeps the client-visible text boundary
+        seq = Sequence(
+            prompt_ids=full[:base_len],
+            params=params,
+            output_ids=full[base_len:],
+            generated_ids=list(generated),
+            orig_prompt_len=len(prompt_ids),
+            resume_count=int(frame.get("resume_count", 0)),
+            migrate_count=int(frame.get("migrate_count", 0)),
+            preempt_count=int(frame.get("preempt_count", 0)),
+            swap_count=int(frame.get("swap_count", 0)),
+            handoff_count=int(frame.get("handoff_count", 1)),
+            request_id=frame.get("request_id"),
+            kv_dtype=frame.get("kv_dtype"),
+            stream_cb=on_token,
+        )
+        seq._handoff_adopt = (payload, num_pages)
+        entry = _Entry(sid, seq)
+        entry_cell.append(entry)
+        gate = getattr(self.engine, "_gate", None)
+        if gate is not None:
+            gate(list(prompt_ids))
+        with self._seq_lock:
+            self._seqs[sid] = entry
+        try:
+            self.engine.submit_existing(seq)
+        except BaseException:
+            with self._seq_lock:
+                self._seqs.pop(sid, None)
+            raise
+        threading.Thread(
+            target=self._waiter, args=(entry,), daemon=True,
+            name=f"vgt-worker-waiter-{sid}",
+        ).start()
+        with self._seq_lock:
+            self._xfers.pop(xid, None)
+            self._xfer_committed.add(xid)
+            if len(self._xfer_committed) > 4096:
+                self._xfer_committed.clear()
+        return {"accepted": True, "seq_id": seq.seq_id}
+
+    def _verb_handoff_abort(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Drop a partial inbound transfer (gateway retry or give-up).
+        Post-commit cancellation goes through the normal abort verb —
+        the sequence is registered in _seqs by then."""
+        xid = str(frame["xfer"])
+        with self._seq_lock:
+            dropped = self._xfers.pop(xid, None) is not None
+        return {"dropped": dropped}
 
     def _verb_abort_all(self, frame: Dict[str, Any]) -> Dict[str, Any]:
         fn = getattr(self.engine, "abort_in_flight", None)
@@ -558,7 +934,12 @@ class WorkerServer:
         return {"stopping": True}
 
     _SLOW_VERBS = frozenset(
-        {"evacuate", "warmup", "canary", "stats", "perf"}
+        {
+            "evacuate", "warmup", "canary", "stats", "perf",
+            # fetch packs the KV pytree (CPU-bound, MBs); commit
+            # unpacks + admits — neither may stall the ping path
+            "handoff_fetch", "handoff_commit",
+        }
     )
 
     _VERBS = {
@@ -568,6 +949,12 @@ class WorkerServer:
         "abort": _verb_abort,
         "abort_all": _verb_abort_all,
         "evacuate": _verb_evacuate,
+        "handoff_fetch": _verb_handoff_fetch,
+        "handoff_cancel": _verb_handoff_cancel,
+        "handoff_done": _verb_handoff_done,
+        "handoff_put": _verb_handoff_put,
+        "handoff_commit": _verb_handoff_commit,
+        "handoff_abort": _verb_handoff_abort,
         "health": _verb_health,
         "stats": _verb_stats,
         "pressure": _verb_pressure,

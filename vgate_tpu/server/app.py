@@ -705,6 +705,7 @@ async def chat_completions(request: web.Request) -> web.Response:
         cached=result.get("cached", False),
         resumed=result.get("resumed", False),
         migrated=result.get("migrated", False),
+        disaggregated=result.get("disaggregated", False),
         metrics=result.get("metrics", {}),
     )
     return web.json_response(completion.model_dump())

@@ -164,6 +164,10 @@ class ChatCompletion(BaseModel):
     # operation (replica drain / rebalance / scale-down) — explains a
     # one-off latency blip during a rolling deploy
     migrated: bool = False
+    # generation prefilled on one pod worker and decoded on another via
+    # the epoch-fenced KV handoff (pod.roles disaggregation) — the
+    # per-request provenance flag for the disagg_vs_monolithic A/B
+    disaggregated: bool = False
     metrics: Dict[str, float] = Field(default_factory=dict)
 
 
