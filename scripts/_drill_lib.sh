@@ -39,6 +39,7 @@ declare -A VGT_DRILL_PORTS=(
   [worker]=8740
   [disagg]=8741
   [disagg_ab]=8742
+  [pod_obs]=8743
 )
 
 drill_port() {

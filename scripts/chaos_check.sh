@@ -59,3 +59,6 @@ bash scripts/worker_check.sh
 
 echo "== disaggregated prefill/decode handoff drill =="
 bash scripts/disagg_check.sh
+
+echo "== pod-scope distributed observability drill =="
+bash scripts/pod_obs_check.sh

@@ -1,0 +1,295 @@
+#!/usr/bin/env bash
+# Pod-scope distributed observability drill (ISSUE 18): boot the same
+# 3-worker disaggregated CPU pod as disagg_check.sh with the in-memory
+# span recorder armed in every process (VGT_MEMTRACE=1), then assert
+# the cross-process evidence chain:
+#
+#   A. one traced chat request (X-Request-ID pinned) produces ONE
+#      trace on /debug/spans: the gateway HTTP span is the root, the
+#      prefill worker's engine spans, the gateway's handoff.transfer
+#      span, and the decode worker's engine spans all share its trace
+#      id and their parent ids resolve inside the tree,
+#   B. /debug/requests/{X-Request-ID} finds the merged record with a
+#      non-zero transfer_s phase (queue → prefill → transfer → decode),
+#   C. /debug/pod reports the live topology (roles, pids, epochs,
+#      beat ages) and the handoff ledger; /debug/perf serves the
+#      merged pod snapshot (the loadlab per-cell scrape contract);
+#      vgt_build_info and vgt_rpc_call_seconds export on /metrics,
+#   D. a decode-worker SIGKILL mid-storm: zero client-visible 5xx,
+#      the dead incarnation's flight ticks stay on /debug/flight
+#      epoch-marked fenced:true, and /stats surfaces the gateway-
+#      synthesized engine.last_crash for it.
+#
+# Usage: scripts/pod_obs_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port pod_obs)}"
+ensure_port_free "$PORT"
+arm_lock_witness pod_obs
+export JAX_PLATFORMS=cpu
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=64
+export VGT_TPU__DP=1
+export VGT_TPU__TP=1
+export VGT_TPU__EP=1
+export VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=1
+export VGT_TPU__KV_NUM_PAGES=128
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=8
+export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=20
+export VGT_CACHE__ENABLED=false
+# the disaggregated pod: worker 0 prefills, workers 1-2 decode — every
+# request crosses three processes, which is the whole point here
+export VGT_POD__WORKERS=3
+export VGT_POD__ROLES='["prefill","decode","decode"]'
+export VGT_POD__HEARTBEAT_INTERVAL_S=0.3
+export VGT_POD__HEARTBEAT_TIMEOUT_S=3
+export VGT_RECOVERY__BACKOFF_BASE_S=0.05
+export VGT_RECOVERY__BACKOFF_CAP_S=0.2
+export VGT_RECOVERY__MAX_RESTARTS=8
+export VGT_RECOVERY__STEP_STALL_S=120
+export VGT_RECOVERY__COMPILE_GRACE_S=600
+# arm the in-memory span recorder in the gateway AND (inherited env)
+# every worker process — /debug/spans merges all three recorders
+export VGT_MEMTRACE=1
+export VGT_FAULTS_HTTP=1
+
+python main.py &
+SERVER_PID=$!
+record_drill_pid "$PORT" "$SERVER_PID"
+trap 'kill "$SERVER_PID" 2>/dev/null || true; sleep 2; \
+      kill -9 "$SERVER_PID" 2>/dev/null || true; \
+      clear_drill_pid "$PORT"' EXIT
+
+BASE="http://127.0.0.1:$PORT"
+# pod boot = three engine builds + canary gates; allow a few minutes
+for _ in $(seq 1 1200); do
+  if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then break; fi
+  sleep 0.2
+done
+curl -fsS "$BASE/health/ready" >/dev/null || {
+  echo "FAIL: pod-obs server never became ready"; exit 1; }
+snapshot_kv_config "$BASE" pod_obs_check
+
+python - "$BASE" <<'EOF'
+import asyncio, os, signal, sys, time
+import aiohttp
+
+BASE = sys.argv[1]
+RID = "pod-obs-trace-1"
+N = 6
+PROMPTS = [f"pod obs drill prompt {i}" for i in range(N)]
+
+
+async def fire(session, prompt, rid=None):
+    headers = {"X-Request-ID": rid} if rid else {}
+    async with session.post(
+        f"{BASE}/v1/chat/completions",
+        headers=headers,
+        json={
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": 24,
+            "min_tokens": 24,
+            "temperature": 0.0,
+        },
+    ) as resp:
+        return resp.status, await resp.json()
+
+
+async def get_json(session, path):
+    async with session.get(f"{BASE}{path}") as resp:
+        assert resp.status == 200, (path, resp.status)
+        return await resp.json()
+
+
+async def engine_health(session):
+    return (await get_json(session, "/health"))["engine"]
+
+
+async def wait_state(session, want, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = await engine_health(session)
+        if last["state"] == want:
+            return last
+        await asyncio.sleep(0.3)
+    raise AssertionError(f"engine never reached {want!r}; last: {last}")
+
+
+async def metric_line(session, prefix):
+    async with session.get(f"{BASE}/metrics") as resp:
+        text = await resp.text()
+    return [
+        line for line in text.splitlines()
+        if line.startswith(prefix) and not line.startswith("#")
+    ]
+
+
+def assert_no_5xx(results, what):
+    bad = [s for s, _ in results if s >= 500]
+    assert not bad, f"client-visible 5xx during {what}: {results}"
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=600)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        eng = await engine_health(session)
+        assert eng["state"] == "serving", eng
+        assert eng["replicas_alive"] == 3, eng
+
+        # ---- A+B: one traced request across three processes ---------
+        status, body = await fire(session, PROMPTS[0], rid=RID)
+        assert status == 200, (status, body)
+        assert body.get("disaggregated") is True, (
+            f"traced request did not disaggregate: {body.keys()}"
+        )
+
+        rec = await get_json(session, f"/debug/requests/{RID}")
+        assert rec["request_id"] == RID, rec
+        assert rec.get("transfer_s", 0) > 0, (
+            f"merged record lacks a non-zero transfer_s phase: {rec}"
+        )
+        assert rec.get("handoff") == "ok", rec
+        assert rec.get("prefill_worker") == 0, rec
+        assert rec.get("decode_worker") in (1, 2), rec
+        for phase in ("queue_s", "prefill_s", "decode_s"):
+            assert phase in rec, (phase, rec)
+
+        spans = (await get_json(session, "/debug/spans"))["spans"]
+        xfer = [
+            s for s in spans
+            if s["name"] == "handoff.transfer"
+            and s["attributes"].get("request.id") == RID
+        ]
+        assert xfer, (
+            f"no handoff.transfer span for {RID}: "
+            f"{sorted({s['name'] for s in spans})}"
+        )
+        trace = [s for s in spans if s["trace_id"] == xfer[0]["trace_id"]]
+        by_name = {}
+        for s in trace:
+            by_name.setdefault(s["name"], []).append(s)
+        roots = [s for s in trace if s["worker"] == "gateway"
+                 and s["name"].startswith("POST ")]
+        assert roots, f"no gateway HTTP root span in trace: {by_name.keys()}"
+        root = roots[0]
+        assert root["parent_span_id"] is None, root
+        # engine spans from BOTH sides of the handoff, same trace
+        prefill_w = {s["worker"] for s in by_name.get("engine.prefill", [])}
+        decode_w = {s["worker"] for s in by_name.get("engine.decode", [])}
+        assert prefill_w and decode_w, by_name.keys()
+        assert prefill_w != decode_w or len(prefill_w | decode_w) > 1, (
+            f"prefill and decode spans came from one worker: "
+            f"{prefill_w} / {decode_w}"
+        )
+        # parentage: every span in the tree resolves to another span in
+        # the same trace, ultimately the gateway HTTP span
+        ids = {s["span_id"] for s in trace}
+        dangling = [
+            s["name"] for s in trace
+            if s["parent_span_id"] is not None
+            and s["parent_span_id"] not in ids
+        ]
+        assert not dangling, f"spans with out-of-trace parents: {dangling}"
+        assert any(s["parent_span_id"] == root["span_id"] for s in trace), (
+            "nothing parents directly onto the HTTP span"
+        )
+
+        # ---- C: /debug/pod, merged /debug/perf, build + RPC metrics -
+        pod = await get_json(session, "/debug/pod")
+        assert len(pod["workers"]) == 3, pod
+        roles = [w["role"] for w in pod["workers"]]
+        assert roles == ["prefill", "decode", "decode"], roles
+        for w in pod["workers"]:
+            assert w["state"] == "serving", w
+            assert w["pid"] and w["epoch"] >= 1, w
+            assert "beat_age_s" in w, w
+        assert pod["handoffs"]["completed"] >= 1, pod["handoffs"]
+
+        perf = await get_json(session, "/debug/perf")
+        assert perf.get("enabled") is True, perf.keys()
+        assert "totals" in perf, perf.keys()
+        assert perf["pod"]["workers"] == 3, perf.get("pod")
+        assert perf["pod"]["workers_alive"] == 3, perf.get("pod")
+        assert perf["pod"]["handoffs"]["completed"] >= 1, perf["pod"]
+
+        build = await metric_line(session, "vgt_build_info")
+        assert build and "git_sha=" in build[0], build
+        rpc = await metric_line(session, "vgt_rpc_call_seconds_count")
+        assert any('verb="ping"' in line for line in rpc), rpc
+        stats = await get_json(session, "/stats")
+        assert set(stats["build"]) == {"version", "git_sha", "jax"}, (
+            stats.get("build")
+        )
+
+        # ---- D: decode SIGKILL — fenced flight + crash snapshot -----
+        # prime the per-slot flight cache so the post-mortem has the
+        # dead incarnation's timeline to keep
+        flight = await get_json(session, "/debug/flight?n=2048")
+        victim = next(
+            w for w in pod["workers"] if w["role"] == "decode"
+        )
+        vidx, vpid, vepoch = victim["replica"], victim["pid"], victim["epoch"]
+        assert any(t.get("worker") == vidx for t in flight["ticks"]), (
+            f"no cached ticks for worker {vidx} before the kill"
+        )
+
+        async def kill_decode():
+            await asyncio.sleep(2.0)  # past prefill+handoff, mid-decode
+            os.kill(vpid, signal.SIGKILL)
+
+        results, _ = await asyncio.gather(
+            asyncio.gather(*(fire(session, p) for p in PROMPTS)),
+            kill_decode(),
+        )
+        assert_no_5xx(results, "decode SIGKILL mid-storm")
+        assert all(s == 200 for s, _ in results), results
+
+        flight = await get_json(session, "/debug/flight?n=2048")
+        fenced = [
+            t for t in flight["ticks"]
+            if t.get("worker") == vidx and t.get("fenced")
+        ]
+        assert fenced, (
+            f"dead incarnation's ticks missing from /debug/flight "
+            f"(worker {vidx})"
+        )
+        assert all(t["epoch"] == vepoch for t in fenced), fenced[:3]
+
+        stats = await get_json(session, "/stats")
+        crash = stats["engine"].get("last_crash")
+        assert crash, "no engine.last_crash on /stats after worker loss"
+        assert "WorkerLost" in (crash.get("error") or ""), crash
+        assert crash.get("worker") == vidx, crash
+        assert crash.get("epoch") == vepoch, crash
+
+        healed = await wait_state(session, "serving")
+        assert healed["restarts"] >= 1, healed
+        print(
+            f"PASS: one trace across 3 processes ({len(trace)} spans, "
+            f"root={root['name']!r}), transfer_s={rec['transfer_s']}s "
+            f"on /debug/requests/{RID}, /debug/pod + merged /debug/perf "
+            f"serving, and worker {vidx} SIGKILL left {len(fenced)} "
+            f"epoch-{vepoch} fenced ticks + a crash snapshot — zero 5xx "
+            f"throughout"
+        )
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+assert_witness_clean pod_obs
+echo "pod_obs_check: OK"

@@ -1,0 +1,501 @@
+"""Pod-scope distributed observability (ISSUE 18): W3C traceparent
+codec over the RPC plane, the merged ``_PodFlight`` view (worker/epoch
+stamping, fenced incarnations, handoff-ledger grafting), /debug/pod
+payload shape, build fingerprint, RPC-plane metrics — and (slow tier)
+the real 2-worker CPU pod producing ONE trace across three processes
+plus a fenced flight timeline after a SIGKILL.
+"""
+
+import os
+import signal
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from vgate_tpu import metrics, tracing
+from vgate_tpu.backends.base import SamplingParams
+from vgate_tpu.observability.memtrace import MemorySpanRecorder
+from vgate_tpu.observability.reqtrace import RequestMeta
+from vgate_tpu.runtime.pod_engine import (
+    PodEngine,
+    _HandoffRec,
+    _PodFlight,
+    _Worker,
+    _pc_to_ns,
+)
+
+from tests.test_worker_pod import greedy, pod_config, wait_for
+
+
+# ------------------------------------------------- traceparent codec
+
+
+def test_traceparent_round_trip_preserves_identity():
+    rec = MemorySpanRecorder().install()
+    tracer = tracing.get_tracer("t")
+    with tracer.start_as_current_span("POST /v1/completions"):
+        ctx = tracing.capture_context()
+        header = tracing.context_to_traceparent(ctx)
+    root = rec.spans("POST /v1/completions")[0]
+    assert header == f"00-{root.trace_id_hex}-{root.span_id_hex}-01"
+    back = tracing.context_from_traceparent(header)
+    assert tracing.context_trace_id(back) == root.trace_id_hex
+    # the worker-side half: a span opened under the decoded context
+    # parents onto the gateway's span — one trace, two processes
+    child = tracer.start_span("engine.queue", context=back)
+    child.end()
+    span = rec.spans("engine.queue")[0]
+    assert span.trace_id_hex == root.trace_id_hex
+    assert span.parent_span_id_hex == root.span_id_hex
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None,
+        "",
+        "junk",
+        "00-only-three",
+        "00-a-b-c-d-e",  # too many segments
+        "01-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+        "00-zzzz651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+        "00-0af7651916cd43dd8448eb211c80319c-b7ad6b71692033-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # invalid trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # invalid span id
+    ],
+)
+def test_traceparent_malformed_inputs_decode_to_none(bad):
+    # a worker must never fail a submit over a bad trace header
+    assert tracing.context_from_traceparent(bad) is None
+
+
+def test_traceparent_none_context_encodes_to_none():
+    assert tracing.context_to_traceparent(None) is None
+
+
+def test_pc_to_ns_anchors_perf_counter_on_wall_clock():
+    ns = _pc_to_ns(time.perf_counter())
+    assert abs(ns - time.time_ns()) < 100_000_000  # within 100ms
+
+
+# ------------------------------------------------- merged pod flight
+
+
+class _FakeClient:
+    """Answers the flight/requests verbs from canned replies (or raises
+    to model an unreachable worker)."""
+
+    def __init__(self, replies):
+        self.replies = replies
+
+    def call(self, verb, timeout=None, **kw):
+        reply = self.replies[verb]
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+
+def _flight_worker(idx, epoch, t, rid=None):
+    ticks = [{"n": 0, "t": t, "kind": "decode", "batch": 1}]
+    completed = []
+    if rid is not None:
+        completed.append(
+            {
+                "request_id": rid,
+                "seq_id": 100 + idx,
+                "arrival_t": t - 1.0,
+                "queue_s": 0.01,
+                "status": "finished",
+            }
+        )
+    return SimpleNamespace(
+        idx=idx,
+        epoch=epoch,
+        alive=True,
+        client=_FakeClient(
+            {
+                "flight": {"ticks": ticks, "stats": {"ticks_recorded": 1}},
+                "requests": {"live": [], "completed": completed},
+            }
+        ),
+    )
+
+
+def _flight_pod(workers):
+    pod = SimpleNamespace(
+        config=SimpleNamespace(
+            observability=SimpleNamespace(enabled=True)
+        ),
+        _lock=threading.RLock(),
+        _flight_cache={},
+        _req_ledger={},
+        workers=workers,
+    )
+    pod._alive_workers = lambda: [w for w in workers if w.alive]
+    return pod
+
+
+def test_pod_flight_merges_stamps_and_sorts():
+    w0 = _flight_worker(0, 1, t=10.0)
+    w1 = _flight_worker(1, 3, t=11.0)
+    fl = _PodFlight(_flight_pod([w0, w1]))
+    fl.record_tick("overload", level="shed")
+    ticks = fl.ticks()
+    # wall-time merge: worker ticks first, the gateway event (t = now)
+    # last, each stamped with its origin
+    assert [t["worker"] for t in ticks] == [0, 1, "gateway"]
+    assert ticks[0]["epoch"] == 1
+    assert ticks[1]["epoch"] == 3
+    assert not any(t.get("fenced") for t in ticks)
+    assert ticks[-1]["kind"] == "overload"
+
+
+def test_pod_flight_keeps_dead_incarnation_epoch_marked():
+    w0 = _flight_worker(0, 1, t=10.0, rid="r-dead")
+    w1 = _flight_worker(1, 1, t=11.0)
+    pod = _flight_pod([w0, w1])
+    fl = _PodFlight(pod)
+    fl.ticks()  # primes the per-slot cache
+    w0.alive = False  # SIGKILL / heartbeat fencing
+    ticks = fl.ticks()
+    dead = [t for t in ticks if t["worker"] == 0]
+    assert dead, "dead incarnation's timeline must survive"
+    assert all(t["fenced"] and t["epoch"] == 1 for t in dead)
+    # the surviving worker stays unfenced
+    assert not any(t.get("fenced") for t in ticks if t["worker"] == 1)
+    # its request record survives fenced too
+    rec = fl.find_request("r-dead")
+    assert rec is not None and rec["fenced"] and rec["epoch"] == 1
+
+
+def test_pod_flight_fences_cached_view_of_older_epoch():
+    w0 = _flight_worker(0, 1, t=10.0)
+    pod = _flight_pod([w0])
+    fl = _PodFlight(pod)
+    fl.ticks()  # cache holds the epoch-1 view
+    # the slot respawned (epoch bump) but its live fetch fails — the
+    # cached snapshot belongs to the PREVIOUS incarnation
+    w0.epoch = 2
+    w0.client = _FakeClient(
+        {"flight": OSError("unreachable"), "requests": OSError("x")}
+    )
+    ticks = fl.ticks()
+    assert ticks and all(
+        t["fenced"] and t["epoch"] == 1 for t in ticks
+    )
+
+
+def test_pod_flight_grafts_gateway_ledger_onto_records():
+    w0 = _flight_worker(0, 1, t=10.0, rid="r1")
+    pod = _flight_pod([w0])
+    pod._req_ledger["r1"] = {
+        "transfer_s": 0.25,
+        "handoff": "ok",
+        "prefill_worker": 0,
+        "decode_worker": 1,
+    }
+    fl = _PodFlight(pod)
+    rec = fl.find_request("r1")
+    assert rec["transfer_s"] == 0.25
+    assert rec["handoff"] == "ok"
+    assert (rec["prefill_worker"], rec["decode_worker"]) == (0, 1)
+    assert fl.requests()[0]["transfer_s"] == 0.25
+    # lookups work by seq_id and find nothing for unknown idents
+    assert fl.find_request("100")["request_id"] == "r1"
+    assert fl.find_request("no-such") is None
+
+
+def test_pod_flight_newest_attempt_wins_across_workers():
+    w0 = _flight_worker(0, 1, t=10.0, rid="r1")
+    w1 = _flight_worker(1, 1, t=50.0, rid="r1")
+    fl = _PodFlight(_flight_pod([w0, w1]))
+    assert fl.find_request("r1")["worker"] == 1
+
+
+def test_pod_flight_get_stats_shape():
+    fl = _PodFlight(_flight_pod([_flight_worker(0, 2, t=1.0)]))
+    st = fl.get_stats()
+    assert st["enabled"] is True
+    assert st["workers"] == [
+        {"worker": 0, "epoch": 2, "fenced": False, "ticks_recorded": 1}
+    ]
+
+
+def test_pod_flight_disabled_recorder_drops_gateway_ticks():
+    pod = _flight_pod([])
+    pod.config.observability.enabled = False
+    fl = _PodFlight(pod)
+    fl.record_tick("overload")
+    assert fl.enabled is False
+    assert fl.ticks() == []
+
+
+# ------------------------------------------------- gateway req ledger
+
+
+def _ledger_shell(cap=4):
+    pod = object.__new__(PodEngine)
+    pod._lock = threading.RLock()
+    pod._req_ledger = {}
+    pod._ledger_cap = cap
+    return pod
+
+
+def test_ledger_note_merges_and_ignores_anonymous():
+    pod = _ledger_shell()
+    pod._ledger_note("r1", transfer_s=0.5)
+    pod._ledger_note("r1", handoff="ok")
+    assert pod._req_ledger["r1"] == {"transfer_s": 0.5, "handoff": "ok"}
+    pod._ledger_note(None, handoff="ok")  # no request id → no entry
+    assert len(pod._req_ledger) == 1
+
+
+def test_ledger_note_evicts_fifo_at_cap():
+    pod = _ledger_shell(cap=3)
+    for i in range(5):
+        pod._ledger_note(f"r{i}", handoff="ok")
+    assert list(pod._req_ledger) == ["r2", "r3", "r4"]
+
+
+# ----------------------------------------------------- /debug surfaces
+
+
+def _debug_shell():
+    pod = object.__new__(PodEngine)
+    pod._lock = threading.RLock()
+    pod._inflight = {}
+    pod._orphans = []
+    pod._handoffs = {}
+    pod._restart_times = []
+    pod.fenced_frames = 3
+    pod._last_crash = None
+    pod._pod_cfg = SimpleNamespace(transport="uds")
+    pod._roles = ["prefill", "decode"]
+    pod._roles_active = True
+    pod.total_handoffs = 5
+    pod.total_handoff_fallbacks = 1
+    pod.total_handoff_failed = 0
+    w0, w1 = _Worker(0), _Worker(1)
+    w0.epoch, w0.state = 2, "serving"
+    w0.last_fatal = "SIGKILL"
+    w0.last_ping = {
+        "pressure": {"engine_queue_depth": 1, "running": 2},
+        "beat": {"age_s": 0.1234, "compiling": False},
+    }
+    w1.epoch, w1.state = 1, "serving"
+    pod.workers = [w0, w1]
+    return pod
+
+
+def test_pod_debug_payload_shape():
+    pod = _debug_shell()
+    pod._inflight = {
+        7: SimpleNamespace(_worker_idx=0),
+        8: SimpleNamespace(_worker_idx=0),
+        9: SimpleNamespace(_worker_idx=1),
+    }
+    rec = _HandoffRec(7, SimpleNamespace(request_id="r9"), 0, 2)
+    rec.pages, rec.nbytes, rec.attempts = 4, 4096, 1
+    pod._handoffs[7] = rec
+    out = pod.pod_debug()
+    assert out["transport"] == "uds"
+    assert out["roles"] == ["prefill", "decode"]
+    assert out["inflight"] == 3 and out["orphans"] == 0
+    assert out["fenced_frames"] == 3
+    w0, w1 = out["workers"]
+    assert (w0["replica"], w0["epoch"], w0["role"]) == (0, 2, "prefill")
+    assert (w0["state"], w0["inflight"]) == ("serving", 2)
+    assert w0["last_fatal"] == "SIGKILL"
+    assert w0["beat_age_s"] == 0.123 and w0["compiling"] is False
+    assert (w0["queue_depth"], w0["running"]) == (1, 2)
+    assert (w1["replica"], w1["inflight"]) == (1, 1)
+    assert "last_fatal" not in w1
+    ho = out["handoffs"]
+    assert (ho["completed"], ho["fallback_monolithic"]) == (5, 1)
+    row = ho["table"][0]
+    assert (row["sid"], row["request_id"]) == (7, "r9")
+    assert row["state"] == "PREFILLING"
+    assert (row["prefill"], row["prefill_epoch"]) == (0, 2)
+    assert row["target"] is None  # no decode target picked yet
+    assert (row["pages"], row["nbytes"], row["attempts"]) == (4, 4096, 1)
+    assert row["age_s"] >= 0.0
+    assert out["last_crash"] is None
+
+
+def test_build_fingerprint_fields():
+    fp = metrics.build_fingerprint()
+    assert set(fp) == {"version", "git_sha", "jax"}
+    assert all(isinstance(v, str) and v for v in fp.values())
+
+
+def test_rpc_plane_metrics_registered():
+    from prometheus_client import REGISTRY
+
+    for name in (
+        "vgt_rpc_call_seconds",
+        "vgt_rpc_bytes",
+        "vgt_pod_heartbeat_age_seconds",
+        "vgt_pod_worker_inflight",
+        "vgt_handoff_state_seconds",
+    ):
+        assert name in REGISTRY._names_to_collectors, name
+    before = REGISTRY.get_sample_value(
+        "vgt_rpc_call_seconds_count", {"verb": "ping"}
+    ) or 0.0
+    metrics.RPC_CALL_SECONDS.labels(verb="ping").observe(0.001)
+    after = REGISTRY.get_sample_value(
+        "vgt_rpc_call_seconds_count", {"verb": "ping"}
+    )
+    assert after == before + 1
+
+
+# -------------------------------------------- loadlab pod perf column
+
+
+def test_loadlab_perf_delta_lands_pod_block():
+    from vgate_tpu.loadlab.runner import perf_delta
+
+    def snap(completed, fallbacks, window=None):
+        return {
+            "enabled": True,
+            "totals": {
+                "ticks": 10,
+                "tokens": 100,
+                "wall_s": 1.0,
+                "phase_seconds": {"host": 0.1},
+                "compiles": {},
+                "compile_seconds": 0.0,
+            },
+            "window": window or {},
+            "pod": {
+                "workers": 3,
+                "workers_alive": 3,
+                "handoffs": {
+                    "completed": completed,
+                    "fallback_monolithic": fallbacks,
+                    "failed": 0,
+                },
+            },
+        }
+
+    out = perf_delta(snap(2, 0), snap(9, 1))
+    assert out["pod"]["workers"] == 3
+    assert out["pod"]["workers_alive"] == 3
+    assert out["pod"]["handoffs"]["completed"] == 7
+    assert out["pod"]["handoffs"]["fallback_monolithic"] == 1
+    assert out["pod"]["handoffs"]["failed"] == 0
+
+
+def test_loadlab_perf_delta_without_pod_block():
+    from vgate_tpu.loadlab.runner import perf_delta
+
+    snap = {
+        "enabled": True,
+        "totals": {
+            "ticks": 1,
+            "tokens": 1,
+            "wall_s": 1.0,
+            "phase_seconds": {},
+            "compiles": {},
+            "compile_seconds": 0.0,
+        },
+        "window": {},
+    }
+    assert "pod" not in perf_delta(snap, snap)
+
+
+# ------------------------------------------- real pod on CPU (slow tier)
+
+
+@pytest.mark.slow
+def test_pod_single_trace_across_processes(monkeypatch):
+    """Acceptance core: one request produces ONE trace — the gateway's
+    span is the root, and the worker process's engine spans (shipped
+    back over the ``spans`` verb) carry the same trace id and parent
+    onto it.  The merged flight view finds the request by its id, and
+    /debug/pod reports the live topology."""
+    monkeypatch.setenv("VGT_MEMTRACE", "1")  # workers inherit the env
+    rec = MemorySpanRecorder().install()
+    pod = PodEngine(pod_config())
+    pod.start()
+    try:
+        tracer = tracing.get_tracer("vgate_tpu.server")
+        with tracer.start_as_current_span("POST /v1/completions"):
+            meta = RequestMeta(
+                request_id="req-obs-1",
+                trace_ctx=tracing.capture_context(),
+            )
+            seq = pod.submit_tokens(
+                [5, 9, 13, 17, 21], greedy(8), meta=meta
+            )
+        assert seq.done_event.wait(120)
+        assert seq.error is None
+        root = rec.spans("POST /v1/completions")[0]
+
+        worker_spans = pod.collect_spans()
+        ours = [
+            s for s in worker_spans if s["trace_id"] == root.trace_id_hex
+        ]
+        names = {s["name"] for s in ours}
+        assert {"engine.queue", "engine.prefill", "engine.decode"} <= names
+        # every span in the trace ultimately parents onto the gateway
+        # HTTP span: parent ids resolve within the trace or to the root
+        ids = {s["span_id"] for s in ours} | {root.span_id_hex}
+        assert all(s["parent_span_id"] in ids for s in ours)
+        assert any(
+            s["parent_span_id"] == root.span_id_hex for s in ours
+        )
+        assert all(isinstance(s["worker"], int) for s in ours)
+
+        found = pod.flight.find_request("req-obs-1")
+        assert found is not None
+        assert found["request_id"] == "req-obs-1"
+        assert found["worker"] in (0, 1) and found["epoch"] == 1
+        assert not found.get("fenced")
+
+        dbg = pod.pod_debug()
+        assert len(dbg["workers"]) == 2
+        assert all(w["state"] == "serving" for w in dbg["workers"])
+        assert dbg["handoffs"]["table"] == []
+    finally:
+        pod.stop()
+
+
+@pytest.mark.slow
+def test_pod_flight_survives_worker_sigkill_epoch_marked():
+    """After a SIGKILL the dead incarnation's cached timeline stays in
+    the merged flight view, epoch-stamped and marked fenced, and the
+    gateway synthesizes a crash snapshot for /stats."""
+    pod = PodEngine(pod_config())
+    pod.start()
+    try:
+        seqs = [
+            pod.submit_tokens([5, 9, 13 + i, 17, 21], greedy(8))
+            for i in range(4)
+        ]
+        for s in seqs:
+            assert s.done_event.wait(120)
+            assert s.error is None
+        # prime the per-slot cache — the post-mortem merges from it
+        ticks = pod.flight.ticks()
+        assert any(t["worker"] == 0 for t in ticks)
+
+        os.kill(pod.workers[0].proc.pid, signal.SIGKILL)
+        assert wait_for(
+            lambda: pod.get_stats().get("last_crash") is not None, 60
+        )
+        merged = pod.flight.ticks()
+        dead = [
+            t for t in merged if t["worker"] == 0 and t.get("fenced")
+        ]
+        assert dead, "dead incarnation's ticks must stay inspectable"
+        assert all(t["epoch"] == 1 for t in dead)
+
+        crash = pod.get_stats()["last_crash"]
+        assert "WorkerLost" in crash["error"]
+        assert crash["worker"] == 0 and crash["epoch"] == 1
+        assert isinstance(crash["ticks"], list)
+    finally:
+        pod.stop()

@@ -186,7 +186,7 @@ def perf_delta(
         for prog in set(a.get("compiles", {})) | set(b.get("compiles", {}))
     }
     window = after.get("window") or {}
-    return {
+    out = {
         "ticks": a["ticks"] - b["ticks"],
         "tokens": a["tokens"] - b["tokens"],
         "wall_s": wall,
@@ -208,6 +208,23 @@ def perf_delta(
             )
         },
     }
+    # pod-mode servers stamp topology + handoff outcome counters onto
+    # the merged snapshot; land the per-cell handoff outcome DELTAS so
+    # a disaggregated sweep row shows how many KV transfers (and how
+    # many monolithic fallbacks) this cell's tok/s actually paid for
+    pod_after = after.get("pod")
+    if pod_after is not None:
+        ho_b = (before.get("pod") or {}).get("handoffs") or {}
+        ho_a = pod_after.get("handoffs") or {}
+        out["pod"] = {
+            "workers": pod_after.get("workers"),
+            "workers_alive": pod_after.get("workers_alive"),
+            "handoffs": {
+                key: ho_a.get(key, 0) - ho_b.get(key, 0)
+                for key in set(ho_a) | set(ho_b)
+            },
+        }
+    return out
 
 
 async def _fetch_stats(base_url: str) -> Dict[str, Any]:

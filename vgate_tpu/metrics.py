@@ -11,6 +11,8 @@ correlation, reference main.py:142-153) is supported through the
 
 from __future__ import annotations
 
+import os
+import subprocess
 from typing import Any, Dict, Optional
 
 from prometheus_client import (
@@ -438,6 +440,59 @@ HANDOFF_BYTES = _safe_metric(
     ),
 )
 
+# --- RPC plane telemetry: every gateway↔worker verb is now on the
+# --- request critical path, so it gets the same latency/size evidence
+# --- as the HTTP plane ---
+RPC_CALL_SECONDS = _safe_metric(
+    Histogram,
+    "vgt_rpc_call_seconds",
+    "Gateway-observed round-trip latency of one worker RPC call, by "
+    "verb (send → typed reply; includes worker queueing and execution)",
+    labelnames=("verb",),
+    buckets=(
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.25, 0.5, 1, 2.5, 5, 10, 30,
+    ),
+)
+RPC_BYTES = _safe_metric(
+    Histogram,
+    "vgt_rpc_bytes",
+    "Encoded frame payload size on the gateway↔worker plane, by "
+    "direction (sent = gateway→worker calls/notifies, received = "
+    "worker→gateway replies and stream frames)",
+    labelnames=("direction",),  # sent | received
+    buckets=(
+        256, 1024, 4096, 16 * 1024, 64 * 1024, 256 * 1024,
+        1024 * 1024, 4 * 1024 * 1024,
+    ),
+)
+POD_HEARTBEAT_AGE = _safe_metric(
+    Gauge,
+    "vgt_pod_heartbeat_age_seconds",
+    "Gateway-observed age of the freshest heartbeat reply per worker "
+    "index (approaches pod.heartbeat_timeout_s before a liveness "
+    "declaration; a sawtooth near the ping interval is healthy)",
+    labelnames=("worker",),
+)
+POD_WORKER_INFLIGHT = _safe_metric(
+    Gauge,
+    "vgt_pod_worker_inflight",
+    "Sequences resident on each worker as self-reported in its last "
+    "heartbeat reply (imbalance across decode workers signals a "
+    "placement or handoff problem)",
+    labelnames=("worker",),
+)
+HANDOFF_STATE_SECONDS = _safe_metric(
+    Histogram,
+    "vgt_handoff_state_seconds",
+    "Dwell time of one KV handoff in each state-machine state "
+    "(staged = prefill done → transfer begun, transfer = chunks moving "
+    "gateway-relayed, accept = commit sent → decode worker resumed); "
+    "attributes WHERE a slow handoff spends its time",
+    labelnames=("state",),  # staged | transfer | accept
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10),
+)
+
 # --- request lifecycle: deadlines, cancellation, graceful drain ---
 CANCELLED_REQUESTS = _safe_metric(
     Counter,
@@ -595,10 +650,54 @@ PREFIX_COW_COPIES = _safe_metric(
 INFO = _safe_metric(Info, "vgt_build", "Framework build information")
 
 
+def build_fingerprint() -> Dict[str, str]:
+    """Deploy-identifying facts stamped once at startup: version, git
+    sha, and the jax build actually loaded.  One authoritative dict
+    feeds both ``vgt_build_info`` and the ``/stats`` ``build`` block so
+    Grafana panels and loadlab artifacts correlate perf deltas with
+    deploys from the same fingerprint.  Every field degrades to
+    "unknown" rather than failing startup — a server without a .git
+    directory (container image) still exports the metric."""
+    git_sha = os.environ.get("VGT_BUILD_GIT_SHA") or ""
+    if not git_sha:
+        try:
+            repo_root = os.path.dirname(os.path.dirname(__file__))
+            out = subprocess.run(
+                ["git", "-C", repo_root, "rev-parse", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+            )
+            if out.returncode == 0:
+                git_sha = out.stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            git_sha = ""
+    jax_version = ""
+    try:
+        import jax
+
+        jax_version = getattr(jax, "__version__", "") or ""
+    except Exception:
+        jax_version = ""
+    from vgate_tpu.version import __version__
+
+    return {
+        "version": __version__,
+        "git_sha": git_sha or "unknown",
+        "jax": jax_version or "unknown",
+    }
+
+
 def init_app_info(version: str, model_id: str, engine_type: str) -> None:
-    """Populate the info metric (reference: vgate/metrics.py:199-204)."""
+    """Populate the info metric (reference: vgate/metrics.py:199-204),
+    extended with the deploy fingerprint (git sha + jax build)."""
+    fp = build_fingerprint()
     INFO.info(
-        {"version": version, "model": model_id, "engine_type": engine_type}
+        {
+            "version": version,
+            "model": model_id,
+            "engine_type": engine_type,
+            "git_sha": fp["git_sha"],
+            "jax": fp["jax"],
+        }
     )
 
 

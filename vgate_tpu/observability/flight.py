@@ -181,7 +181,11 @@ class FlightRecorder:
         self._accrue(view, now)
         return {
             key: view[key]
-            for key in ("queue_s", "prefill_s", "decode_s")
+            # transfer_s exists only on disaggregated-pod records (the
+            # gateway grafts the KV-handoff wall time onto the merged
+            # view); include it so shed metadata decomposes the same
+            # way /debug/requests does
+            for key in ("queue_s", "prefill_s", "transfer_s", "decode_s")
             if key in view
         }
 

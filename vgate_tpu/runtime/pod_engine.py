@@ -73,10 +73,11 @@ import tempfile
 import threading
 import time
 import zlib
+from collections import deque
 from types import SimpleNamespace
 from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
 
-from vgate_tpu import faults, metrics
+from vgate_tpu import faults, metrics, tracing
 from vgate_tpu.config import VGTConfig, get_config
 from vgate_tpu.errors import (
     HandoffStaleError,
@@ -115,12 +116,23 @@ VGT_LOCK_GUARDS = {
     "_orphans": "_lock",
     "_restart_times": "_lock",
     "_handoffs": "_lock",
+    "_req_ledger": "_lock",
+    "_flight_cache": "_lock",
+    "_last_crash": "_lock",
 }
 
 # spawn-time connect poll cadence (the worker binds its listener before
 # building the engine, so the socket appears in milliseconds; the slow
 # part — engine build — is budgeted by the hello call's timeout)
 _CONNECT_POLL_S = 0.05
+
+
+def _pc_to_ns(pc: float) -> int:
+    """Epoch nanoseconds for a (recent) perf_counter reading — the same
+    anchoring reqtrace's _NsClock does, re-anchored per call so gateway
+    handoff spans carry real wall timestamps without a long-lived
+    clock object per transfer."""
+    return time.time_ns() + int((pc - time.perf_counter()) * 1e9)
 
 
 class _PodSequence(Sequence):
@@ -131,6 +143,11 @@ class _PodSequence(Sequence):
     _pod: Optional["PodEngine"] = None
     _sid: int = -1
     _worker_idx: int = -1
+    # the gateway's captured OTel context (the HTTP span rides in it)
+    # and its W3C encoding — stamped on every submit / handoff_commit
+    # frame so worker engine spans parent onto the HTTP span
+    _trace_ctx: Any = None
+    _traceparent: Optional[str] = None
 
     def request_abort(self, reason: str = "client_disconnect") -> None:
         super().request_abort(reason)
@@ -183,7 +200,7 @@ class _HandoffRec:
         "cancelled", "target_idx", "buffered", "terminal", "pages",
         "nbytes", "base_len", "generated_ids", "resume_count",
         "migrate_count", "preempt_count", "swap_count", "kv_dtype",
-        "attempts", "t0",
+        "attempts", "t0", "t_staged_pc", "t_transfer_pc",
     )
 
     def __init__(
@@ -210,6 +227,173 @@ class _HandoffRec:
         self.kv_dtype: Optional[str] = None
         self.attempts = 0
         self.t0 = time.monotonic()
+        # state-dwell anchors (perf_counter, for span timestamps and
+        # vgt_handoff_state_seconds attribution)
+        self.t_staged_pc = 0.0
+        self.t_transfer_pc = 0.0
+
+
+class _PodFlight:
+    """dp's ``_MergedFlight`` across PROCESS boundaries: fans the worker
+    ``flight`` / ``requests`` verbs out to live workers and merges the
+    rings by wall time, stamping every entry with its worker index and
+    fencing epoch.  Each successful fetch refreshes a per-slot cache;
+    when a slot's live view is unavailable (the incarnation crashed, was
+    SIGKILLed, or was fenced out on heartbeat loss) the cached entries
+    are still merged, marked ``fenced: true`` — the dead incarnation's
+    last-known timeline is exactly what a post-mortem needs.  Request
+    records additionally get the gateway's per-request handoff ledger
+    grafted on (``transfer_s``, outcome, worker pair) so disaggregated
+    TTFT decomposes into queue → prefill → transfer → decode.
+
+    Gateway-side events (the batcher's overload tick) land in a local
+    ring stamped ``worker: "gateway"`` — there is no RPC verb for
+    writing ticks, and the event genuinely happened in this process."""
+
+    def __init__(self, pod: "PodEngine") -> None:
+        self._pod = pod
+        self._gateway_ticks: "deque[Dict[str, Any]]" = deque(maxlen=512)
+        self._tick_counter = itertools.count()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._pod.config.observability.enabled)
+
+    def record_tick(self, kind: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        entry: Dict[str, Any] = {
+            "n": next(self._tick_counter),
+            "t": time.time(),
+            "kind": kind,
+            "worker": "gateway",
+        }
+        entry.update(fields)
+        self._gateway_ticks.append(entry)
+
+    # ------------------------------------------------------------ fetch
+
+    def _fetch(self) -> List[Dict[str, Any]]:
+        """One fan-out round: per worker slot, the live reply (cache
+        refreshed under the pod lock) or the cached snapshot of an
+        unreachable/fenced incarnation."""
+        pod = self._pod
+        views: Dict[int, Dict[str, Any]] = {}
+        for w in pod._alive_workers():
+            client = w.client
+            if client is None:
+                continue
+            try:
+                flight = client.call("flight", n=1024)
+                reqs = client.call("requests", n=1024)
+            except Exception:  # noqa: BLE001 — introspection best-effort
+                continue
+            view = {
+                "worker": w.idx, "epoch": w.epoch, "fenced": False,
+                "ticks": flight.get("ticks") or [],
+                "stats": flight.get("stats") or {},
+                "live": reqs.get("live") or [],
+                "completed": reqs.get("completed") or [],
+            }
+            views[w.idx] = view
+            with pod._lock:
+                pod._flight_cache[w.idx] = view
+        with pod._lock:
+            cached = dict(pod._flight_cache)
+        for idx, view in cached.items():
+            if idx in views:
+                continue
+            w = pod.workers[idx]
+            stale = dict(view)
+            stale["fenced"] = (
+                not w.alive or stale.get("epoch") != w.epoch
+            )
+            views[idx] = stale
+        return [views[i] for i in sorted(views)]
+
+    def _stamp(
+        self, entry: Dict[str, Any], view: Dict[str, Any], graft: bool
+    ) -> Dict[str, Any]:
+        entry = dict(entry)
+        entry["worker"] = view["worker"]
+        entry["epoch"] = view["epoch"]
+        if view["fenced"]:
+            entry["fenced"] = True
+        if graft:
+            self._graft(entry)
+        return entry
+
+    def _graft(self, rec: Dict[str, Any]) -> None:
+        """Attach the gateway's handoff ledger entry (transfer_s, the
+        handoff outcome, the prefill/decode worker pair) to a request
+        record — the worker-side recorder cannot know any of it."""
+        rid = rec.get("request_id")
+        if not rid:
+            return
+        with self._pod._lock:
+            note = self._pod._req_ledger.get(rid)
+            note = dict(note) if note else None
+        if note:
+            rec.update(note)
+
+    def _merged(
+        self, key: str, n: Optional[int], graft: bool = False
+    ) -> List[Dict[str, Any]]:
+        out = []
+        for view in self._fetch():
+            for entry in view[key]:
+                out.append(self._stamp(entry, view, graft))
+        if key == "ticks":
+            out.extend(dict(e) for e in self._gateway_ticks)
+        out.sort(key=lambda e: e.get("t") or e.get("arrival_t") or 0.0)
+        if n is not None and n >= 0:
+            out = out[-n:]
+        return out
+
+    # --------------------------------------- FlightRecorder's surface
+
+    def ticks(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        return self._merged("ticks", n)
+
+    def requests(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        return self._merged("completed", n, graft=True)
+
+    def live_requests(self) -> List[Dict[str, Any]]:
+        return self._merged("live", None, graft=True)
+
+    def find_request(self, ident: str) -> Optional[Dict[str, Any]]:
+        # newest attempt wins ACROSS workers too (a handoff or failover
+        # leaves records for the same request id on several workers)
+        best: Optional[Dict[str, Any]] = None
+        for view in self._fetch():
+            for key in ("live", "completed"):
+                for rec in view[key]:
+                    if ident not in (
+                        rec.get("request_id"),
+                        rec.get("trace_id"),
+                        str(rec.get("seq_id")),
+                    ):
+                        continue
+                    rec = self._stamp(rec, view, graft=True)
+                    if best is None or (rec.get("arrival_t") or 0.0) >= (
+                        best.get("arrival_t") or 0.0
+                    ):
+                        best = rec
+        return best
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "workers": [
+                {
+                    "worker": v["worker"],
+                    "epoch": v["epoch"],
+                    "fenced": v["fenced"],
+                    **(v["stats"] or {}),
+                }
+                for v in self._fetch()
+            ],
+        }
 
 
 class PodEngine:
@@ -238,6 +422,20 @@ class PodEngine:
         self._inflight: Dict[int, _PodSequence] = {}
         self._orphans: List[_PodSequence] = []
         self._handoffs: Dict[int, _HandoffRec] = {}
+        # per-request gateway annotations (KV-handoff transfer_s and
+        # outcome) grafted onto merged flight records; insertion-ordered
+        # dict with FIFO eviction so it stays bounded
+        self._req_ledger: Dict[str, Dict[str, Any]] = {}
+        self._ledger_cap = 2048
+        # last-known per-slot flight snapshot (refreshed on every
+        # /debug scrape) — survives the incarnation so a crashed
+        # worker's timeline stays inspectable, epoch-marked
+        self._flight_cache: Dict[int, Dict[str, Any]] = {}
+        # gateway-synthesized post-mortem for the most recent worker
+        # loss (same shape as FlightRecorder.crash_snapshot)
+        self._last_crash: Optional[Dict[str, Any]] = None
+        self._tracer = tracing.get_tracer("vgate_tpu.pod")
+        self._flight = _PodFlight(self)
         self._sids = itertools.count(1)
         self._rr = itertools.count()
         self._xfer_ids = itertools.count(1)
@@ -583,6 +781,9 @@ class PodEngine:
                 fallback = True
         if fallback:
             metrics.HANDOFF_TOTAL.labels(outcome="fallback_monolithic").inc()
+            self._ledger_note(
+                rec.seq.request_id, handoff="fallback_monolithic"
+            )
         return False
 
     @staticmethod
@@ -701,6 +902,7 @@ class PodEngine:
                 rec.swap_count = int(frame.get("swap_count", 0))
                 rec.kv_dtype = frame.get("kv_dtype")
                 rec.t0 = time.monotonic()
+                rec.t_staged_pc = time.perf_counter()
         if not ok:
             w = self.workers[idx]
             client = w.client
@@ -726,6 +928,54 @@ class PodEngine:
                 self.total_handoff_fallbacks += 1
         if rec is not None:
             metrics.HANDOFF_TOTAL.labels(outcome="fallback_monolithic").inc()
+            self._ledger_note(
+                rec.seq.request_id, handoff="fallback_monolithic"
+            )
+
+    def _handoff_span(
+        self,
+        seq: _PodSequence,
+        stage: str,
+        start_pc: float,
+        end_pc: float,
+        **attrs: Any,
+    ) -> None:
+        """Gateway-side ``handoff.<stage>`` span parented on the
+        request's captured HTTP-span context — the explicit middle of
+        the cross-process trace (prefill worker spans on one side,
+        decode worker spans on the other).  No-op without a valid
+        trace context, same gate reqtrace uses."""
+        ctx = seq._trace_ctx
+        if tracing.context_trace_id(ctx) is None:
+            return
+        span = self._tracer.start_span(
+            f"handoff.{stage}",
+            context=ctx,
+            start_time=_pc_to_ns(start_pc),
+        )
+        if seq.request_id:
+            span.set_attribute("request.id", seq.request_id)
+        for key, val in attrs.items():
+            span.set_attribute(key, val)
+        span.end(end_time=_pc_to_ns(end_pc))
+
+    def _ledger_note(
+        self, request_id: Optional[str], **fields: Any
+    ) -> None:
+        """Record a gateway-side per-request annotation for the merged
+        flight view (bounded FIFO; requests without an id — direct
+        generate() calls — have no flight record to graft onto)."""
+        if not request_id:
+            return
+        with self._lock:
+            entry = self._req_ledger.get(request_id)
+            if entry is None:
+                while len(self._req_ledger) >= self._ledger_cap:
+                    self._req_ledger.pop(
+                        next(iter(self._req_ledger))
+                    )
+                entry = self._req_ledger[request_id] = {}
+            entry.update(fields)
 
     def _run_handoff(self, rec: _HandoffRec) -> None:
         metrics.HANDOFF_ACTIVE.inc()
@@ -748,6 +998,7 @@ class PodEngine:
         abandon (the loss path owns the sequence)."""
         pod = self._pod_cfg
         while True:
+            staged_dwell = False
             with self._lock:
                 if rec.cancelled or rec.sid not in self._handoffs:
                     return
@@ -756,6 +1007,21 @@ class PodEngine:
                         rec.state, handoff_mod.TRANSFERRING
                     )
                     rec.state = handoff_mod.TRANSFERRING
+                    rec.t_transfer_pc = time.perf_counter()
+                    staged_dwell = True
+            if staged_dwell and rec.t_staged_pc:
+                # STAGED → TRANSFERRING happens once per record (a
+                # retry stays TRANSFERRING), so the stage dwell and its
+                # span are emitted exactly once
+                metrics.HANDOFF_STATE_SECONDS.labels(
+                    state="staged"
+                ).observe(rec.t_transfer_pc - rec.t_staged_pc)
+                self._handoff_span(
+                    rec.seq, "stage", rec.t_staged_pc,
+                    rec.t_transfer_pc, sid=rec.sid,
+                    prefill=rec.prefill_idx, pages=rec.pages,
+                    nbytes=rec.nbytes,
+                )
             target = self._decode_target(exclude=rec.prefill_idx)
             if target is None:
                 self._handoff_fallback_monolithic(
@@ -925,6 +1191,7 @@ class PodEngine:
             params=params_to_wire(seq.params),
             remaining_s=remaining,
             request_id=seq.request_id,
+            traceparent=seq._traceparent,
             resume_count=rec.resume_count,
             migrate_count=rec.migrate_count,
             preempt_count=rec.preempt_count,
@@ -943,6 +1210,7 @@ class PodEngine:
         (TRANSFERRING → ACCEPTED → DECODING), reconcile the client
         token stream to the fold point, replay buffered target frames
         in order, and release the prefill worker's surplus copy."""
+        accept_pc = time.perf_counter()
         with self._lock:
             seq = self._inflight.get(rec.sid)
             ok = (
@@ -1010,6 +1278,36 @@ class PodEngine:
         metrics.HANDOFF_TOTAL.labels(outcome="ok").inc()
         metrics.HANDOFF_SECONDS.observe(time.monotonic() - rec.t0)
         metrics.HANDOFF_BYTES.observe(rec.nbytes)
+        end_pc = time.perf_counter()
+        if rec.t_transfer_pc:
+            metrics.HANDOFF_STATE_SECONDS.labels(
+                state="transfer"
+            ).observe(accept_pc - rec.t_transfer_pc)
+            self._handoff_span(
+                seq, "transfer", rec.t_transfer_pc, accept_pc,
+                sid=rec.sid, prefill=rec.prefill_idx,
+                decode=target.idx, pages=rec.pages,
+                nbytes=rec.nbytes, attempts=rec.attempts,
+            )
+        metrics.HANDOFF_STATE_SECONDS.labels(state="accept").observe(
+            end_pc - accept_pc
+        )
+        self._handoff_span(
+            seq, "accept", accept_pc, end_pc,
+            sid=rec.sid, decode=target.idx,
+        )
+        # graft target for the merged flight view: the worker-side
+        # recorders each see only their half of the request, so the
+        # gateway owns the transfer_s phase and the outcome
+        self._ledger_note(
+            seq.request_id,
+            transfer_s=round(
+                end_pc - (rec.t_staged_pc or accept_pc), 6
+            ),
+            handoff="ok",
+            prefill_worker=rec.prefill_idx,
+            decode_worker=target.idx,
+        )
         logger.info(
             "kv handoff complete",
             extra={
@@ -1057,6 +1355,9 @@ class PodEngine:
         if not existed:
             return
         metrics.HANDOFF_TOTAL.labels(outcome="fallback_monolithic").inc()
+        self._ledger_note(
+            rec.seq.request_id, handoff="fallback_monolithic"
+        )
         logger.warning(
             "handoff degraded to monolithic decode",
             extra={
@@ -1094,6 +1395,7 @@ class PodEngine:
                     self.total_handoff_fallbacks += 1
         if existed:
             metrics.HANDOFF_TOTAL.labels(outcome=outcome).inc()
+            self._ledger_note(rec.seq.request_id, handoff=outcome)
 
     def _handoff_stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -1218,6 +1520,14 @@ class PodEngine:
         seq._sid = next(self._sids)
         if meta is not None:
             seq.request_id = getattr(meta, "request_id", None)
+            # capture the gateway's OTel context ONCE (the HTTP span
+            # rides in it); the W3C encoding travels on every frame
+            # that creates engine work in a worker process, so the
+            # worker's engine spans parent onto the HTTP span
+            seq._trace_ctx = getattr(meta, "trace_ctx", None)
+            seq._traceparent = tracing.context_to_traceparent(
+                seq._trace_ctx
+            )
         self._dispatch_submit(seq)
         return seq
 
@@ -1282,6 +1592,7 @@ class PodEngine:
                     params=params_to_wire(seq.params),
                     remaining_s=remaining,
                     request_id=seq.request_id,
+                    traceparent=seq._traceparent,
                     resume_count=seq.resume_count,
                     migrate_count=seq.migrate_count,
                     preempt_count=seq.preempt_count,
@@ -1470,6 +1781,22 @@ class PodEngine:
                 except (WorkerLostError, TimeoutError):
                     pass
                 now = time.monotonic()
+                # gateway-OBSERVED liveness (how long since this worker
+                # last answered a ping), as opposed to the worker's own
+                # self-reported engine beat — the gap between the two
+                # is exactly what diagnoses a wedged RPC plane
+                metrics.POD_HEARTBEAT_AGE.labels(
+                    worker=str(w.idx)
+                ).set(round(max(0.0, now - w.last_ok_t), 3))
+                with self._lock:
+                    inflight = sum(
+                        1
+                        for s in self._inflight.values()
+                        if s._worker_idx == w.idx
+                    )
+                metrics.POD_WORKER_INFLIGHT.labels(
+                    worker=str(w.idx)
+                ).set(inflight)
                 if now - w.last_ok_t > pod.heartbeat_timeout_s:
                     # unresponsive but process alive: the zombie case —
                     # fence it out and replace it; its late frames are
@@ -1555,6 +1882,29 @@ class PodEngine:
                     rec.cancelled = True
                     self.total_handoff_failed += 1
                     lost_handoffs += 1
+            # gateway-synthesized post-mortem (the incarnation can no
+            # longer report its own): same shape the monolithic
+            # supervisor keeps for /stats → engine.last_crash, with
+            # the dead incarnation's last cached flight ticks attached
+            cache = self._flight_cache.get(idx)
+            self._last_crash = {
+                "time": time.time(),
+                "error": (
+                    f"WorkerLost: worker {idx} (epoch {epoch}) — "
+                    f"{reason}: {detail}"
+                ),
+                "worker": idx,
+                "epoch": epoch,
+                "ticks": (
+                    (cache.get("ticks") or [])[-32:]
+                    if cache and cache.get("epoch") == epoch
+                    else []
+                ),
+                "in_flight": [
+                    {"sid": s._sid, "request_id": s.request_id}
+                    for s in victims
+                ],
+            }
         for _ in range(lost_handoffs):
             metrics.HANDOFF_TOTAL.labels(outcome="failed").inc()
         metrics.POD_WORKER_LOSSES.labels(reason=reason).inc()
@@ -1956,6 +2306,22 @@ class PodEngine:
             "roles": list(self._roles),
             "handoffs": self._handoff_stats(),
         }
+        crashes = [
+            s["last_crash"]
+            for s in per_worker
+            if isinstance(s.get("last_crash"), dict)
+        ]
+        with self._lock:
+            if self._last_crash is not None:
+                crashes.append(self._last_crash)
+        if crashes:
+            # newest post-mortem wins the top-level slot the monolithic
+            # supervisor exposes, so /stats → engine.last_crash reads
+            # the same in pod mode (worker-internal engine crashes and
+            # gateway-declared worker losses both land here)
+            agg["last_crash"] = max(
+                crashes, key=lambda c: float(c.get("time") or 0.0)
+            )
         agg["replicas"] = per_worker
         return agg
 
@@ -1980,7 +2346,96 @@ class PodEngine:
 
     def perf_snapshot(self) -> Dict[str, Any]:
         snaps = self._collect("perf")
-        return perf_attr.merge_snapshots(snaps) if snaps else {}
+        merged = perf_attr.merge_snapshots(snaps) if snaps else {}
+        # stamp the pod topology + handoff outcome counters onto the
+        # merged view: loadlab's per-cell /debug/perf delta then lands
+        # worker count and handoff outcomes next to the phase seconds,
+        # so a disaggregated sweep row shows how many transfers the
+        # cell's tok/s number actually paid for
+        stats = self._handoff_stats()
+        merged["pod"] = {
+            "workers": len(self.workers),
+            "workers_alive": len(self._alive_workers()),
+            "handoffs": {
+                key: stats[key]
+                for key in ("completed", "fallback_monolithic", "failed")
+            },
+        }
+        return merged
+
+    @property
+    def flight(self) -> _PodFlight:
+        """The merged pod flight view — app.py's ``_flight_recorder``
+        picks this up exactly like dp's ``_MergedFlight``, so
+        /debug/flight and /debug/requests work unchanged in pod mode."""
+        return self._flight
+
+    def collect_spans(self) -> List[Dict[str, Any]]:
+        """Workers' in-memory span recorders (``spans`` verb, armed by
+        ``VGT_MEMTRACE=1`` in the worker env), worker-stamped — the
+        gateway's /debug/spans merges these with its own recorder so a
+        drill can assert cross-process span parentage from one page."""
+        out: List[Dict[str, Any]] = []
+        for w in self._alive_workers():
+            client = w.client
+            if client is None:
+                continue
+            try:
+                reply = client.call("spans")
+            except Exception:  # noqa: BLE001 — introspection best-effort
+                continue
+            for span in reply.get("spans") or []:
+                span = dict(span)
+                span["worker"] = w.idx
+                out.append(span)
+        return out
+
+    def pod_debug(self) -> Dict[str, Any]:
+        """The /debug/pod payload: live topology (per-worker
+        incarnation + liveness detail + in-flight load), the mid-air
+        handoff table, and the fencing/orphan counters — one page
+        answering "which process is sick and what is in the air"."""
+        now = time.monotonic()
+        entries = [self._worker_entry(w, now) for w in self.workers]
+        with self._lock:
+            by_worker: Dict[int, int] = {}
+            for s in self._inflight.values():
+                by_worker[s._worker_idx] = (
+                    by_worker.get(s._worker_idx, 0) + 1
+                )
+            table = [
+                {
+                    "sid": rec.sid,
+                    "request_id": rec.seq.request_id,
+                    "state": rec.state,
+                    "prefill": rec.prefill_idx,
+                    "prefill_epoch": rec.prefill_epoch,
+                    "target": (
+                        rec.target_idx if rec.target_idx >= 0 else None
+                    ),
+                    "pages": rec.pages,
+                    "nbytes": rec.nbytes,
+                    "attempts": rec.attempts,
+                    "age_s": round(now - rec.t0, 3),
+                }
+                for rec in self._handoffs.values()
+            ]
+            inflight = len(self._inflight)
+            orphans = len(self._orphans)
+            fenced = self.fenced_frames
+            last_crash = self._last_crash
+        for entry in entries:
+            entry["inflight"] = by_worker.get(entry["replica"], 0)
+        return {
+            "workers": entries,
+            "transport": self._pod_cfg.transport,
+            "roles": list(self._roles),
+            "inflight": inflight,
+            "orphans": orphans,
+            "fenced_frames": fenced,
+            "handoffs": {**self._handoff_stats(), "table": table},
+            "last_crash": last_crash,
+        }
 
     def warmup(self, buckets: Optional[List[int]] = None) -> float:
         return sum(

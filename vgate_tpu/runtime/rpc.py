@@ -83,18 +83,22 @@ def send_frame(
     sock: socket.socket,
     obj: Dict[str, Any],
     max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
-) -> None:
-    """Serialize and send one frame.  NOT thread-safe per socket — both
-    pod_engine and worker serialize writers behind a per-connection send
-    lock so a token frame can never interleave into a reply frame."""
+) -> int:
+    """Serialize and send one frame; returns the encoded byte count
+    (header + payload) so callers can account wire volume
+    (vgt_rpc_bytes) without re-encoding.  NOT thread-safe per socket —
+    both pod_engine and worker serialize writers behind a per-connection
+    send lock so a token frame can never interleave into a reply
+    frame."""
     data = encode_frame(obj, max_frame_bytes)
     if faults.is_active():
         verdict = faults.wire_action("rpc_send", obj.get("op"))
         if verdict == "drop":
-            return
+            return len(data)
         if verdict == "garble":
             data = _garble(data)
     sock.sendall(data)
+    return len(data)
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -130,11 +134,14 @@ def decode_payload(payload: bytes) -> Dict[str, Any]:
 def recv_frame(
     sock: socket.socket,
     max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+    size_cb: Optional[Any] = None,
 ) -> Optional[Dict[str, Any]]:
     """Read one frame.  Returns the decoded dict, or ``None`` on clean
     EOF at a frame boundary (peer closed deliberately).  Raises
     :class:`FrameError` on any structural violation — the caller must
-    tear the connection down, not retry the read."""
+    tear the connection down, not retry the read.  ``size_cb`` (when
+    given) is invoked with the frame's on-wire byte count for telemetry;
+    its failures never fail the read."""
     try:
         first = sock.recv(HEADER_BYTES)
     except ConnectionResetError as exc:
@@ -165,7 +172,12 @@ def recv_frame(
     payload = recv_exact(sock, length)
     if raw == "drop":
         # consume the bytes (framing stays intact) but discard the frame
-        return recv_frame(sock, max_frame_bytes)
+        return recv_frame(sock, max_frame_bytes, size_cb)
+    if size_cb is not None:
+        try:
+            size_cb(HEADER_BYTES + length)
+        except Exception:  # noqa: BLE001 — telemetry never fails a read
+            pass
     return decode_payload(payload)
 
 

@@ -55,7 +55,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Set
 
-from vgate_tpu import faults
+from vgate_tpu import faults, tracing
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.config import VGTConfig, set_config
 from vgate_tpu.errors import (
@@ -65,11 +65,18 @@ from vgate_tpu.errors import (
     state_is_alive,
     state_is_ready,
 )
+from vgate_tpu.logging_config import bound_request
+from vgate_tpu.observability.reqtrace import RequestMeta, RequestTrace
 from vgate_tpu.runtime import handoff as handoff_mod
 from vgate_tpu.runtime import rpc
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 
 logger = logging.getLogger(__name__)
+
+# In-memory span recorder installed when the worker starts with
+# VGT_MEMTRACE=1 (drills/tests): the ``spans`` verb exports what it
+# recorded so cross-process span parentage is verifiable end to end.
+_MEMTRACE: Optional[Any] = None
 
 # Threading contract (scripts/vgt_lint.py, checker thread-discipline).
 # Lock order: _send_lock is a LEAF — frame assembly happens before
@@ -374,6 +381,29 @@ class WorkerServer:
             return "dead"
         return "serving"
 
+    def _attach_trace(self, seq: Sequence, frame: Dict[str, Any]) -> None:
+        """Rebuild the gateway's trace identity on a submitted sequence.
+
+        ``submit_existing`` (unlike ``submit_tokens``) constructs no
+        RequestTrace — it was built for in-process replays that already
+        carry one.  A gateway submit is client traffic crossing a
+        process boundary, so the engine spans this worker emits
+        (engine.queue/prefill/decode/detokenize) would otherwise be
+        orphaned roots: decode the W3C ``traceparent`` the gateway
+        stamped on the frame into a remote parent context and open the
+        queue span at the sequence's local arrival anchor.  Degrades to
+        a silent no-op when the recorder is off or the frame carries no
+        (or a malformed) trace header."""
+        flight = getattr(self._inner(), "flight", None)
+        if flight is None or not flight.enabled:
+            return
+        ctx = tracing.context_from_traceparent(frame.get("traceparent"))
+        meta = RequestMeta(
+            request_id=frame.get("request_id"), trace_ctx=ctx
+        )
+        seq.trace = RequestTrace(meta)
+        seq.trace.start("queue", start_pc=seq.arrival_t)
+
     def _verb_submit(self, frame: Dict[str, Any]) -> Dict[str, Any]:
         sid = int(frame["sid"])
         raw_params = dict(frame.get("params") or {})
@@ -429,6 +459,7 @@ class WorkerServer:
             stream_cb=on_token,
         )
         seq.handoff_requested = handoff
+        self._attach_trace(seq, frame)
         entry = _Entry(sid, seq)
         entry_cell.append(entry)
         # supervisor deployments: apply the same admission gate
@@ -446,6 +477,22 @@ class WorkerServer:
             with self._seq_lock:
                 self._seqs.pop(sid, None)
             raise
+        with bound_request(
+            seq.request_id, getattr(seq.trace, "trace_id", None)
+        ):
+            # bound so a grep by the gateway's X-Request-ID finds the
+            # worker-side admission too, not just the gateway log line
+            logger.info(
+                "submitted gateway sequence",
+                extra={
+                    "extra_data": {
+                        "sid": sid,
+                        "seq_id": seq.seq_id,
+                        "prompt_tokens": len(prompt_ids),
+                        "handoff": handoff,
+                    }
+                },
+            )
         threading.Thread(
             target=self._waiter, args=(entry,), daemon=True,
             name=f"vgt-worker-waiter-{sid}",
@@ -465,6 +512,20 @@ class WorkerServer:
             return
         with self._seq_lock:
             self._seqs.pop(entry.sid, None)
+        with bound_request(
+            seq.request_id, getattr(seq.trace, "trace_id", None)
+        ):
+            logger.info(
+                "sequence settled",
+                extra={
+                    "extra_data": {
+                        "sid": entry.sid,
+                        "status": seq.status.name,
+                        "generated_tokens": seq.num_generated,
+                        "finish_reason": seq.finish_reason,
+                    }
+                },
+            )
         if seq.status is SeqStatus.FAILED:
             self._enqueue(
                 {
@@ -776,6 +837,7 @@ class WorkerServer:
             stream_cb=on_token,
         )
         seq._handoff_adopt = (payload, num_pages)
+        self._attach_trace(seq, frame)
         entry = _Entry(sid, seq)
         entry_cell.append(entry)
         gate = getattr(self.engine, "_gate", None)
@@ -789,6 +851,20 @@ class WorkerServer:
             with self._seq_lock:
                 self._seqs.pop(sid, None)
             raise
+        with bound_request(
+            seq.request_id, getattr(seq.trace, "trace_id", None)
+        ):
+            logger.info(
+                "handoff commit: adopted sequence",
+                extra={
+                    "extra_data": {
+                        "sid": sid,
+                        "xfer": xid,
+                        "pages": num_pages,
+                        "generated_tokens": len(generated),
+                    }
+                },
+            )
         threading.Thread(
             target=self._waiter, args=(entry,), daemon=True,
             name=f"vgt-worker-waiter-{sid}",
@@ -870,6 +946,62 @@ class WorkerServer:
     def _verb_pressure(self, frame: Dict[str, Any]) -> Dict[str, Any]:
         return self.engine.pressure_signals()
 
+    def _verb_flight(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Flight-recorder tick ring + stats for the gateway's merged
+        pod view (/debug/flight).  Bounded by the recorder's own ring
+        size, so the reply always fits the frame cap."""
+        flight = getattr(self._inner(), "flight", None)
+        if flight is None:
+            return {"enabled": False, "ticks": [], "stats": {}}
+        n = frame.get("n")
+        return {
+            "enabled": bool(flight.enabled),
+            "ticks": flight.ticks(int(n) if n is not None else None),
+            "stats": flight.get_stats(),
+        }
+
+    def _verb_requests(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-request flight records (live + completed) for the
+        gateway's merged /debug/requests view."""
+        flight = getattr(self._inner(), "flight", None)
+        if flight is None:
+            return {"enabled": False, "live": [], "completed": []}
+        n = frame.get("n")
+        return {
+            "enabled": bool(flight.enabled),
+            "live": flight.live_requests(),
+            "completed": flight.requests(
+                int(n) if n is not None else None
+            ),
+        }
+
+    def _verb_spans(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Export memtrace-recorded spans (drill/test tooling: empty
+        unless the pod was launched with VGT_MEMTRACE=1) so span
+        parentage across the RPC boundary is verifiable from outside
+        this process."""
+        rec = _MEMTRACE
+        if rec is None:
+            return {"enabled": False, "spans": []}
+        out = []
+        for s in rec.spans():
+            out.append(
+                {
+                    "name": s.name,
+                    "trace_id": s.trace_id_hex,
+                    "span_id": s.span_id_hex,
+                    "parent_span_id": s.parent_span_id_hex,
+                    "start_ns": s.start_time,
+                    "end_ns": s.end_time,
+                    "attributes": {
+                        k: v
+                        for k, v in s.attributes.items()
+                        if isinstance(v, (str, int, float, bool))
+                    },
+                }
+            )
+        return {"enabled": True, "spans": out}
+
     def _verb_perf(self, frame: Dict[str, Any]) -> Dict[str, Any]:
         fn = getattr(self._inner(), "perf_snapshot", None)
         return fn() if fn is not None else {}
@@ -939,6 +1071,8 @@ class WorkerServer:
             # fetch packs the KV pytree (CPU-bound, MBs); commit
             # unpacks + admits — neither may stall the ping path
             "handoff_fetch", "handoff_commit",
+            # span export can serialize thousands of records
+            "spans",
         }
     )
 
@@ -959,6 +1093,9 @@ class WorkerServer:
         "stats": _verb_stats,
         "pressure": _verb_pressure,
         "perf": _verb_perf,
+        "flight": _verb_flight,
+        "requests": _verb_requests,
+        "spans": _verb_spans,
         "warmup": _verb_warmup,
         "canary": _verb_canary,
         "set_spec_suspended": _verb_set_spec_suspended,
@@ -1143,6 +1280,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     config.tpu.dp = 1
     set_config(config)
     faults.arm_from_env()
+
+    if os.environ.get("VGT_MEMTRACE"):
+        # drill/test span evidence: record this process's spans so the
+        # ``spans`` verb can export them for parentage assertions
+        global _MEMTRACE
+        try:
+            from vgate_tpu.observability.memtrace import MemorySpanRecorder
+
+            _MEMTRACE = MemorySpanRecorder().install()
+        except Exception:
+            logger.warning(
+                "VGT_MEMTRACE set but span recorder install failed",
+                exc_info=True,
+            )
 
     logging.basicConfig(
         level=logging.INFO,

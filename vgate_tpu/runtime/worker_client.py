@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple, Union
 
+from vgate_tpu import metrics
 from vgate_tpu.errors import WorkerLostError
 from vgate_tpu.runtime import rpc
 from vgate_tpu.runtime.worker import unwire_error
@@ -100,7 +102,10 @@ class WorkerClient:
         frame["e"] = self.epoch
         try:
             with self._send_lock:
-                rpc.send_frame(self._sock, frame, self.max_frame_bytes)
+                sent = rpc.send_frame(
+                    self._sock, frame, self.max_frame_bytes
+                )
+            metrics.RPC_BYTES.labels(direction="sent").observe(sent)
         except OSError as exc:
             self._mark_dead(exc)
             raise WorkerLostError(
@@ -126,6 +131,7 @@ class WorkerClient:
             cid = self._next_cid
             pending = _Pending()
             self._pending[cid] = pending
+        t0 = time.perf_counter()
         try:
             # the wire carries the remaining budget so the worker can
             # bound its own work against the caller's deadline
@@ -140,6 +146,11 @@ class WorkerClient:
         finally:
             with self._lock:
                 self._pending.pop(cid, None)
+            # gateway-observed verb latency: success, typed error, and
+            # timeout all count — a wedged verb must show in the tail
+            metrics.RPC_CALL_SECONDS.labels(verb=op).observe(
+                time.perf_counter() - t0
+            )
         reply = pending.reply
         if reply is None:
             raise WorkerLostError(
@@ -153,9 +164,13 @@ class WorkerClient:
 
     def _read_loop(self) -> None:
         exc: Optional[BaseException] = None
+        recv_bytes = metrics.RPC_BYTES.labels(direction="received")
         try:
             while True:
-                frame = rpc.recv_frame(self._sock, self.max_frame_bytes)
+                frame = rpc.recv_frame(
+                    self._sock, self.max_frame_bytes,
+                    size_cb=recv_bytes.observe,
+                )
                 if frame is None:
                     break  # clean EOF: worker exited
                 if frame.get("op") == "reply":

@@ -661,6 +661,14 @@ async def chat_completions(request: web.Request) -> web.Response:
                     cancel_token=token,
                     priority=payload.priority,
                     api_key=api_key,
+                    # the gateway's X-Request-ID (middleware-assigned
+                    # when absent) so /debug/requests/{X-Request-ID}
+                    # finds the engine record; extra n-variants get a
+                    # disambiguating suffix
+                    request_id=(
+                        request["request_id"] if i == 0
+                        else f"{request['request_id']}:{i}"
+                    ),
                 )
                 for i in range(n_submits)
             ),
@@ -1080,6 +1088,14 @@ async def completions(request: web.Request) -> web.Response:
                     cancel_token=token,
                     priority=payload.priority,
                     api_key=api_key,
+                    request_id=(
+                        request["request_id"]
+                        if pi == 0 and i == 0
+                        else (
+                            f"{request['request_id']}"
+                            f":{pi * best_of + i}"
+                        )
+                    ),
                 )
                 for pi, p in enumerate(prompts)
                 for i in range(n_submits)
@@ -1250,6 +1266,10 @@ async def get_stats(request: web.Request) -> web.Response:
     batcher: RequestBatcher = request.app["batcher"]
     engine: VGTEngine = request.app["engine"]
     stats = {
+        # build identity (version / git sha / jax) — the same labels
+        # vgt_build_info exports, so a scrape and a /stats curl agree
+        # on exactly which build is serving
+        "build": metrics.build_fingerprint(),
         "batcher": batcher.get_metrics(),
         "cache": batcher.cache.get_stats(),
         "admission": {
@@ -1377,6 +1397,71 @@ async def debug_perf(request: web.Request) -> web.Response:
             {"enabled": False,
              "error": f"{type(exc).__name__}: {exc}"}
         )
+
+
+async def debug_pod(request: web.Request) -> web.Response:
+    """GET /debug/pod — pod topology and RPC-plane detail: per-worker
+    pid/epoch/role/state/beat-age/compiling/last-fatal plus in-flight
+    load, the live KV-handoff table (state, worker pair, age), and the
+    fencing/orphan counters.  Auth-gated like every non-exempt path;
+    answers ``enabled: false`` (not 404) when the engine is not a
+    worker pod so probes read the same shape in every mode."""
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    core = getattr(engine.backend, "core", None) if engine else None
+    pod_fn = getattr(core, "pod_debug", None)
+    if pod_fn is None:
+        return web.json_response(
+            {"enabled": False,
+             "reason": "engine is not a worker pod (pod.workers = 0)"}
+        )
+    try:
+        return web.json_response({"enabled": True, **pod_fn()})
+    except Exception as exc:
+        # a pod mid-failover must not 500 its own diagnosis surface
+        logger.error("pod debug failed", exc_info=True)
+        return web.json_response(
+            {"enabled": True,
+             "error": f"{type(exc).__name__}: {exc}"}
+        )
+
+
+async def debug_spans(request: web.Request) -> web.Response:
+    """GET /debug/spans — in-memory span export (gateway recorder +
+    every worker's, via the ``spans`` verb), for drills and tests that
+    assert cross-process trace parentage.  Empty unless the server was
+    launched with ``VGT_MEMTRACE=1`` (the env rides into worker
+    processes, so one flag arms the whole pod)."""
+    recorder = request.app.get("memtrace")
+    spans = []
+    if recorder is not None:
+        for s in recorder.spans():
+            spans.append(
+                {
+                    "name": s.name,
+                    "trace_id": s.trace_id_hex,
+                    "span_id": s.span_id_hex,
+                    "parent_span_id": s.parent_span_id_hex,
+                    "start_ns": s.start_time,
+                    "end_ns": s.end_time,
+                    "worker": "gateway",
+                    "attributes": {
+                        k: v
+                        for k, v in (s.attributes or {}).items()
+                        if isinstance(v, (str, int, float, bool))
+                    },
+                }
+            )
+    engine: Optional[VGTEngine] = request.app.get("engine")
+    core = getattr(engine.backend, "core", None) if engine else None
+    collect = getattr(core, "collect_spans", None)
+    if collect is not None:
+        try:
+            spans.extend(collect())
+        except Exception:
+            logger.error("worker span collection failed", exc_info=True)
+    return web.json_response(
+        {"enabled": recorder is not None, "spans": spans}
+    )
 
 
 def _faults_http_enabled() -> bool:
@@ -1814,6 +1899,22 @@ async def _on_startup(app: web.Application) -> None:
             app["replica_drain_signal_installed"] = True
         except (NotImplementedError, RuntimeError, ValueError):
             app["replica_drain_signal_installed"] = False
+    if os.environ.get("VGT_MEMTRACE"):
+        # drill/test span evidence without the OTel SDK: record this
+        # process's spans (the HTTP span among them) so /debug/spans
+        # can merge them with the workers' exports — the env rides
+        # into worker processes, so one flag arms the whole pod
+        try:
+            from vgate_tpu.observability.memtrace import (
+                MemorySpanRecorder,
+            )
+
+            app["memtrace"] = MemorySpanRecorder().install()
+        except Exception:
+            logger.warning(
+                "VGT_MEMTRACE set but span recorder install failed",
+                exc_info=True,
+            )
     metrics.init_app_info(
         __version__, config.model.model_id, config.model.engine_type
     )
@@ -1867,6 +1968,8 @@ def create_app(config: Optional[VGTConfig] = None) -> web.Application:
     app.router.add_get("/debug/requests", debug_requests)
     app.router.add_get("/debug/requests/{ident}", debug_request_detail)
     app.router.add_get("/debug/perf", debug_perf)
+    app.router.add_get("/debug/pod", debug_pod)
+    app.router.add_get("/debug/spans", debug_spans)
     # drill-only chaos surface (403 unless VGT_FAULTS_HTTP=1): the
     # loadlab chaos arm replays fault drills mid-cell through it
     app.router.add_get("/debug/faults", debug_faults)

@@ -184,6 +184,58 @@ def context_span_id(ctx: Any) -> Optional[str]:
     return format(sc.span_id, "016x")
 
 
+def context_to_traceparent(ctx: Any) -> Optional[str]:
+    """Encode a captured context's active span as a W3C ``traceparent``
+    header value (``00-<trace>-<span>-<flags>``) for the gateway →
+    worker RPC plane.  The pod frame protocol is JSON, not HTTP, so the
+    value rides as a plain frame field; the W3C wire format keeps it
+    interoperable with anything downstream that speaks trace context.
+    None when OTel is absent or the context carries no valid span."""
+    if ctx is None or _otel_trace is None:
+        return None
+    span = _otel_trace.get_current_span(ctx)
+    sc = span.get_span_context()
+    if sc is None or not sc.is_valid:
+        return None
+    return (
+        f"00-{sc.trace_id:032x}-{sc.span_id:016x}-"
+        f"{int(sc.trace_flags):02x}"
+    )
+
+
+def context_from_traceparent(header: Optional[str]) -> Optional[Any]:
+    """Decode a W3C ``traceparent`` value into an OTel context carrying
+    a remote ``NonRecordingSpan`` — the worker-side half of
+    :func:`context_to_traceparent`.  Spans started under the returned
+    context parent onto the gateway's span, so one trace spans all pod
+    processes.  Returns None (spans stay local roots) on any malformed
+    input: a worker must never fail a submit over a bad trace header."""
+    if not header or _otel_trace is None:
+        return None
+    try:
+        parts = header.split("-")
+        if len(parts) != 4 or parts[0] != "00":
+            return None
+        trace_id = int(parts[1], 16)
+        span_id = int(parts[2], 16)
+        flags = int(parts[3], 16)
+        if len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        sc = _otel_trace.SpanContext(
+            trace_id=trace_id,
+            span_id=span_id,
+            is_remote=True,
+            trace_flags=_otel_trace.TraceFlags(flags),
+        )
+        if not sc.is_valid:
+            return None
+        return _otel_trace.set_span_in_context(
+            _otel_trace.NonRecordingSpan(sc)
+        )
+    except (ValueError, AttributeError):
+        return None
+
+
 def get_current_trace_id() -> Optional[str]:
     """Hex trace id of the active span for logs/exemplars
     (reference: vgate/tracing.py:123-136)."""
