@@ -40,6 +40,7 @@ declare -A VGT_DRILL_PORTS=(
   [disagg]=8741
   [disagg_ab]=8742
   [pod_obs]=8743
+  [gateway]=8744
 )
 
 drill_port() {

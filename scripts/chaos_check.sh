@@ -62,3 +62,6 @@ bash scripts/disagg_check.sh
 
 echo "== pod-scope distributed observability drill =="
 bash scripts/pod_obs_check.sh
+
+echo "== gateway crash survivability drill =="
+bash scripts/gateway_check.sh

@@ -1,0 +1,339 @@
+#!/usr/bin/env bash
+# Gateway crash survivability drill (ISSUE 20): boot a 3-worker CPU
+# pod with orphan grace + a durable request journal, SIGKILL the
+# GATEWAY mid-decode (the one process every other drill keeps alive),
+# restart it, and assert the crash was invisible:
+#
+#   1. the restarted gateway ADOPTS all three workers — same pids,
+#      zero respawns (warm weights, compile ledger, radix cache all
+#      survive: /debug/perf compile count unchanged),
+#   2. retrying the storm's Idempotency-Keys serves every request 200
+#      and token-identical to an undisturbed rerun — completed
+#      generations replay from the journal/adopted done frames with
+#      zero recompute (vgt_journal_replays{outcome="served"} > 0),
+#   3. zero duplicate tokens: every retried completion carries EXACTLY
+#      the pinned decode length, never a padded-plus-replayed double
+#      count,
+#   4. the lock witness stays clean across orphan mode, adoption and
+#      the journal (no undeclared acquisition orders).
+#
+# Usage: scripts/gateway_check.sh [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+source scripts/_drill_lib.sh
+PORT="${1:-$(drill_port gateway)}"
+ensure_port_free "$PORT"
+arm_lock_witness gateway
+
+# stable rendezvous across the two gateway lifetimes: the registry dir
+# the workers beat into, and the journal file the successor replays
+DRILL_DIR="$(mktemp -d /tmp/vgt_gateway_drill.XXXXXX)"
+SOCKET_DIR="$DRILL_DIR/sockets"
+mkdir -p "$SOCKET_DIR"
+
+export JAX_PLATFORMS=cpu
+export VGT_SERVER__PORT="$PORT"
+export VGT_LOGGING__LEVEL=WARNING
+export VGT_MODEL__MODEL_ID=tiny-dense
+export VGT_MODEL__ENGINE_TYPE=jax_tpu
+export VGT_MODEL__DTYPE=float32
+export VGT_MODEL__MAX_MODEL_LEN=64
+export VGT_TPU__DP=1
+export VGT_TPU__TP=1
+export VGT_TPU__EP=1
+export VGT_TPU__SP=1
+export VGT_TPU__NUM_DEVICES=1
+export VGT_TPU__KV_NUM_PAGES=128
+export VGT_TPU__KV_PAGE_SIZE=4
+export VGT_TPU__MAX_BATCH_SLOTS=8
+export VGT_TPU__PREFILL_BUCKETS='[8,16,32]'
+export VGT_TPU__USE_PALLAS=false
+export VGT_BATCH__MAX_BATCH_SIZE=8
+export VGT_BATCH__MAX_WAIT_TIME_MS=20
+# identical reruns must recompute, not replay a cached body
+export VGT_CACHE__ENABLED=false
+# the pod: three workers, orphan grace long enough to survive the
+# restart window, snappy liveness
+export VGT_POD__WORKERS=3
+export VGT_POD__SOCKET_DIR="$SOCKET_DIR"
+export VGT_POD__ORPHAN_GRACE_S=120
+export VGT_POD__HEARTBEAT_INTERVAL_S=0.3
+export VGT_POD__HEARTBEAT_TIMEOUT_S=5
+export VGT_RECOVERY__BACKOFF_BASE_S=0.05
+export VGT_RECOVERY__BACKOFF_CAP_S=0.2
+export VGT_RECOVERY__MAX_RESTARTS=8
+export VGT_RECOVERY__STEP_STALL_S=120
+export VGT_RECOVERY__COMPILE_GRACE_S=600
+# the durable journal (fsync'd) the successor replays
+export VGT_GATEWAY__JOURNAL_PATH="$DRILL_DIR/journal.jsonl"
+
+BASE="http://127.0.0.1:$PORT"
+
+boot_gateway() {
+  python main.py &
+  SERVER_PID=$!
+  record_drill_pid "$PORT" "$SERVER_PID"
+}
+
+wait_ready() {
+  for _ in $(seq 1 1200); do
+    if curl -fsS "$BASE/health/ready" >/dev/null 2>&1; then return 0; fi
+    sleep 0.2
+  done
+  echo "FAIL: gateway never became ready"; return 1
+}
+
+cleanup() {
+  kill "$SERVER_PID" 2>/dev/null || true
+  sleep 2
+  kill -9 "$SERVER_PID" 2>/dev/null || true
+  clear_drill_pid "$PORT"
+  # reap any worker the gateway's stop could not (orphan grace would
+  # hold them for 120s otherwise)
+  for rec in "$SOCKET_DIR"/w*.json; do
+    [ -f "$rec" ] || continue
+    pid="$(python -c "import json,sys;print(json.load(open(sys.argv[1])).get('pid',''))" "$rec" 2>/dev/null || true)"
+    [ -n "$pid" ] && kill -9 "$pid" 2>/dev/null || true
+  done
+  rm -rf "$DRILL_DIR"
+}
+trap cleanup EXIT
+
+boot_gateway
+wait_ready || exit 1
+snapshot_kv_config "$BASE" gateway_check
+
+# phase 1: storm under gateway A, SIGKILL it mid-decode.  The heredoc
+# python runs in the BACKGROUND so the killer below lands while the 8
+# decodes are still in flight — that is the whole drill.
+python - "$BASE" "$DRILL_DIR/phase1.json" <<'EOF' &
+import asyncio, json, sys
+import aiohttp
+
+BASE, OUT = sys.argv[1], sys.argv[2]
+N = 8
+
+
+def body(i):
+    return {
+        "messages": [
+            {"role": "user", "content": f"gateway drill prompt {i}"}
+        ],
+        "max_tokens": 24,
+        "min_tokens": 24,  # pin decode: the kill lands mid-stream
+        "temperature": 0.0,
+    }
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        async with session.get(f"{BASE}/health") as resp:
+            eng = (await resp.json())["engine"]
+        assert eng["state"] == "serving", eng
+        pids = {r["replica"]: r["pid"] for r in eng["replicas"]}
+        assert len(pids) == 3 and all(pids.values()), eng["replicas"]
+        async with session.get(f"{BASE}/debug/perf") as resp:
+            perf = await resp.json()
+        compiles = sum(
+            (perf.get("totals") or {}).get("compiles", {}).values()
+        )
+
+        async def fire(i):
+            # connection death IS the expected outcome for most of
+            # these: the gateway gets SIGKILLed under them
+            try:
+                async with session.post(
+                    f"{BASE}/v1/chat/completions",
+                    json=body(i),
+                    headers={"Idempotency-Key": f"gwdrill-{i}"},
+                ) as resp:
+                    return resp.status
+            except aiohttp.ClientError:
+                return None
+
+        results = await asyncio.gather(
+            *(fire(i) for i in range(N)), return_exceptions=False
+        )
+        json.dump(
+            {"pids": pids, "compiles": compiles, "statuses": results},
+            open(OUT, "w"),
+        )
+        print(f"phase1: storm fired, statuses={results}")
+
+
+asyncio.run(main())
+EOF
+PHASE1_PY=$!
+
+# give the storm ~1.5s to journal + reach the workers, then murder the
+# gateway (kill -9: no drain, no goodbye — the workers see raw EOF)
+sleep 1.5
+kill -9 "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+clear_drill_pid "$PORT"
+# the storm's asserts (3 live workers, compile baseline) must have
+# passed, and phase1.json must exist for the successor's comparisons
+wait "$PHASE1_PY"
+test -f "$DRILL_DIR/phase1.json"
+
+echo "gateway SIGKILLed; workers orphaned; restarting..."
+boot_gateway
+wait_ready || exit 1
+
+# phase 2: the successor — adoption, idempotent replay, token identity
+python - "$BASE" "$DRILL_DIR/phase1.json" <<'EOF'
+import asyncio, json, sys, time
+import aiohttp
+
+BASE, P1 = sys.argv[1], sys.argv[2]
+phase1 = json.load(open(P1))
+OLD_PIDS = {int(k): v for k, v in phase1["pids"].items()}
+N = 8
+
+
+def body(i, ident):
+    return {
+        "messages": [
+            {"role": "user", "content": f"gateway drill prompt {i}"}
+        ],
+        "max_tokens": 24,
+        "min_tokens": 24,
+        "temperature": 0.0,
+    }
+
+
+async def metric(session, prefix):
+    # prometheus counters expose as <name>_total; pass the full
+    # exposition prefix, label block included for labeled families
+    async with session.get(f"{BASE}/metrics") as resp:
+        text = await resp.text()
+    for line in text.splitlines():
+        if not line.startswith("#") and line.startswith(prefix):
+            return float(line.split()[-1])
+    return None
+
+
+async def main():
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        # -- 1. adopted, not respawned --------------------------------
+        async with session.get(f"{BASE}/health") as resp:
+            h = await resp.json()
+        eng = h["engine"]
+        assert eng["state"] == "serving", eng
+        new_pids = {r["replica"]: r["pid"] for r in eng["replicas"]}
+        assert new_pids == OLD_PIDS, (
+            f"workers were respawned, not adopted:\n"
+            f"  before: {OLD_PIDS}\n  after:  {new_pids}"
+        )
+        adoption = eng.get("adoption") or {}
+        assert adoption.get("adopted") == 3, adoption
+        restarts = await metric(session, "vgt_gateway_restarts_total")
+        assert restarts and restarts >= 1, restarts
+        adopted_m = await metric(session, "vgt_workers_adopted_total")
+        assert adopted_m and adopted_m >= 3, adopted_m
+
+        async def compile_total():
+            async with session.get(f"{BASE}/debug/perf") as resp:
+                perf = await resp.json()
+            return sum(
+                (perf.get("totals") or {})
+                .get("compiles", {})
+                .values()
+            )
+
+        # -- 2. retry the storm's keys: all served, zero recompute for
+        #       everything the predecessor had journaled.  Each retry's
+        #       await-loop blocks until its record settles, so after
+        #       this gather the startup resubmission is fully drained --
+        async def retry(i):
+            async with session.post(
+                f"{BASE}/v1/chat/completions",
+                json=body(i, i),
+                headers={"Idempotency-Key": f"gwdrill-{i}"},
+            ) as resp:
+                return resp.status, await resp.json()
+
+        retried = await asyncio.gather(*(retry(i) for i in range(N)))
+        for i, (status, rbody) in enumerate(retried):
+            assert status == 200, (i, status, rbody)
+        replayed = [b for _, b in retried if b.get("replayed")]
+        assert replayed, (
+            "no retry was served from the journal — the crash lost "
+            "every accepted request"
+        )
+        served = await metric(
+            session, 'vgt_journal_replays_total{outcome="served"}'
+        )
+        assert served and served >= 1, served
+
+        # -- 3. compile ledger: the workers' LIFETIME compile counters
+        #       survived adoption (a respawn would have reset them, and
+        #       perf-off would read 0 — both fail the > 0 gate), and a
+        #       full second retry round adds EXACTLY zero compiles:
+        #       journal replays never touch the engine ----------------
+        c1 = await compile_total()
+        assert c1 > 0, (
+            "compile totals read 0 after a full storm — either the "
+            "workers were respawned (counters reset) or perf "
+            "attribution is off and this check is vacuous"
+        )
+        again = await asyncio.gather(*(retry(i) for i in range(N)))
+        for i, (status, rbody) in enumerate(again):
+            assert status == 200 and rbody.get("replayed"), (
+                i, status, rbody,
+            )
+        c2 = await compile_total()
+        assert c2 == c1, (
+            f"replaying settled keys recompiled something: compile "
+            f"totals moved {c1} -> {c2} across a pure-replay round"
+        )
+
+        # -- 4. token identity + zero duplicate tokens ----------------
+        # an undisturbed rerun (fresh keys, cache off, temperature 0)
+        # is the canonical output; every retried body must match it
+        async def fresh(i):
+            async with session.post(
+                f"{BASE}/v1/chat/completions",
+                json=body(i, f"fresh-{i}"),
+                headers={"Idempotency-Key": f"gwdrill-fresh-{i}"},
+            ) as resp:
+                return resp.status, await resp.json()
+
+        canon = await asyncio.gather(*(fresh(i) for i in range(N)))
+        for i, ((rs, rb), (cs, cb)) in enumerate(zip(retried, canon)):
+            assert cs == 200, (i, cs, cb)
+            want = cb["choices"][0]["message"]["content"]
+            got = rb["choices"][0]["message"]["content"]
+            assert got == want, (
+                f"replayed output diverged for key gwdrill-{i}:\n"
+                f"  canonical: {want!r}\n  replayed:  {got!r}"
+            )
+            ct = rb.get("usage", {}).get("completion_tokens")
+            assert ct == 24, (
+                f"duplicate/lost tokens for key gwdrill-{i}: "
+                f"completion_tokens={ct}, want exactly 24"
+            )
+
+        orphaned_m = await metric(
+            session, "vgt_workers_orphaned_total"
+        )
+        print(
+            f"PASS: 3/3 workers adopted (pids unchanged), compile "
+            f"totals stable at {c1} across a pure-replay round, "
+            f"{len(replayed)}/{N} retries replayed zero-recompute "
+            f"(served={served:.0f}, "
+            f"orphaned={(orphaned_m or 0):.0f}), all {N} "
+            f"token-identical at exactly 24 tokens"
+        )
+
+
+asyncio.run(main())
+EOF
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+assert_witness_clean gateway
+echo "gateway_check: OK"

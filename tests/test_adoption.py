@@ -1,0 +1,454 @@
+"""Worker orphan mode + gateway re-adoption (ISSUE 20 tentpole).
+
+Drives ``WorkerServer`` verbs directly on a bare shell (no engine, no
+jax import) plus the real ``serve()`` accept loop on a loopback
+listener — covering the adoption handshake fencing, the buffered-frame
+replay ordering contract, the registry records, the grace-0
+byte-identical exit-on-EOF regression, and seeded fuzz of the
+handshake (stale-epoch re-hello, double adopt, concurrent replay) with
+the invariants: typed errors or fenced frames, never a hang, never a
+duplicate token.
+"""
+
+import json
+import os
+import random
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from vgate_tpu.errors import WorkerFencedError
+from vgate_tpu.runtime import rpc
+from vgate_tpu.runtime import worker as worker_mod
+from vgate_tpu.runtime.worker import WorkerServer, _Entry
+
+
+def bare_worker(tmp_path=None, epoch=1, grace=5.0):
+    """A WorkerServer shell with just enough state for the orphan /
+    adoption surface — engine untouched (an engine call would raise
+    AttributeError, which doubles as the 'never touch the engine on a
+    fenced frame' assertion)."""
+    w = object.__new__(WorkerServer)
+    w.epoch = epoch
+    w.index = 0
+    w.max_frame_bytes = 1 << 20
+    w.registry_dir = str(tmp_path) if tmp_path is not None else None
+    w.address = "127.0.0.1:0"
+    w.orphan_grace_s = grace
+    w._orphan_lock = threading.Lock()
+    w._orphan_frames = []
+    w._orphan_tok_count = 0
+    w._orphan_buffering = False
+    w._orphaned = False
+    w._orphan_deadline = None
+    w._adoptions = 0
+    w._exit_reason = None
+    w._exit_recorded = False
+    w._started_t = time.time()
+    w._seq_lock = threading.Lock()
+    w._seqs = {}
+    w._send_lock = threading.Lock()
+    import queue
+
+    w._send_q = queue.Queue(maxsize=4096)
+    w._conn = None
+    w._stopping = threading.Event()
+    w._fenced_rejects = 0
+    w._state = lambda: "serving"
+    # capture what would hit the wire, stamped like _enqueue_wire stamps
+    w.sent = []
+    w._enqueue_wire = lambda frame: w.sent.append({**frame, "e": w.epoch})
+    return w
+
+
+def entry(sid, request_id, num_generated):
+    return _Entry(
+        sid,
+        SimpleNamespace(request_id=request_id, num_generated=num_generated),
+    )
+
+
+# ------------------------------------------------------ handshake fencing
+
+
+def test_adopt_stale_epoch_fenced():
+    w = bare_worker(epoch=5)
+    with pytest.raises(WorkerFencedError):
+        w._verb_adopt({"op": "adopt", "e": 4})
+    with pytest.raises(WorkerFencedError):
+        w._verb_adopt({"op": "adopt", "e": 5})  # not strictly newer
+    with pytest.raises(ValueError):
+        w._verb_adopt({"op": "adopt"})  # no epoch at all
+    assert w.epoch == 5 and w._adoptions == 0
+
+
+def test_double_adopt_second_fenced():
+    """Two successors racing for one orphan: adoption is serialized on
+    the reader thread (adopt is a fast verb), so the first bump wins
+    and the replayed/equal epoch of the loser is fenced typed."""
+    w = bare_worker(epoch=1)
+    out = w._verb_adopt({"op": "adopt", "e": 2})
+    assert out["epoch"] == 2 and out["adoptions"] == 1
+    with pytest.raises(WorkerFencedError):
+        w._verb_adopt({"op": "adopt", "e": 2})
+    # a genuinely fresher successor still can take over
+    assert w._verb_adopt({"op": "adopt", "e": 3})["epoch"] == 3
+
+
+def test_dispatch_exempts_handshake_but_fences_work_verbs():
+    w = bare_worker(epoch=7)
+    # orphan_status with an epoch this incarnation has never seen is
+    # answered (the successor probes BEFORE it adopts)
+    w._dispatch({"op": "orphan_status", "id": 1, "e": 99})
+    reply = w.sent[-1]
+    assert reply["ok"] and reply["data"]["epoch"] == 7
+    # a work verb with the same stale epoch is fenced, engine untouched
+    w._dispatch({"op": "submit", "id": 2, "e": 99})
+    reply = w.sent[-1]
+    assert reply["ok"] is False
+    assert reply["error"]["type"] == "WorkerFencedError"
+    assert w._fenced_rejects == 1
+
+
+def test_adopt_reports_delivered_tokens():
+    """Adopt-time progress counts tokens DELIVERED to the predecessor:
+    total generated minus tok frames still in the orphan buffer — the
+    successor pads to this and the flush replay appends the rest, so
+    the reconciled count is the true total (no double count)."""
+    w = bare_worker(epoch=1)
+    w._seqs = {7: entry(7, "req-7", 5), 9: entry(9, "req-9", 2)}
+    w._orphan_buffering = True
+    for t in (103, 104):
+        w._enqueue({"op": "tok", "sid": 7, "t": t})
+    w._enqueue({"op": "done", "sid": 9, "text": "done"})
+    out = w._verb_adopt({"op": "adopt", "e": 2})
+    by_sid = {i["sid"]: i for i in out["inflight"]}
+    assert by_sid[7]["generated_tokens"] == 3  # 5 total - 2 buffered
+    assert by_sid[9]["generated_tokens"] == 2  # done is not a tok frame
+    assert by_sid[7]["request_id"] == "req-7"
+    assert out["buffered_frames"] == 3
+
+
+# ----------------------------------------------------- buffered replay
+
+
+def test_orphan_flush_replays_in_order_with_adopted_epoch():
+    w = bare_worker(epoch=1)
+    w._orphan_buffering = True
+    for t in range(4):
+        w._enqueue({"op": "tok", "sid": 1, "t": 100 + t})
+    w._enqueue({"op": "done", "sid": 1, "text": "x"})
+    assert w.sent == []  # buffered, nothing hit the wire
+    w._verb_adopt({"op": "adopt", "e": 6})
+    w.sent.clear()
+    w._verb_orphan_flush({"op": "orphan_flush"})
+    assert [f["op"] for f in w.sent] == ["tok"] * 4 + ["done"]
+    assert [f["t"] for f in w.sent[:4]] == [100, 101, 102, 103]
+    # frames are buffered UN-encoded so replay carries the SUCCESSOR's
+    # epoch — a frame stamped with the dead gateway's epoch would be
+    # fenced by the very gateway that asked for it
+    assert all(f["e"] == 6 for f in w.sent)
+    assert w._orphan_buffering is False
+    # post-flush frames go straight to the wire
+    w._enqueue({"op": "tok", "sid": 1, "t": 104})
+    assert w.sent[-1]["t"] == 104
+
+
+def test_orphan_ring_drops_oldest_tok_keeps_done(monkeypatch):
+    monkeypatch.setattr(worker_mod, "_ORPHAN_BUF_MAX", 8)
+    w = bare_worker()
+    w._orphan_buffering = True
+    w._enqueue({"op": "done", "sid": 2, "text": "early"})
+    for t in range(20):
+        w._enqueue({"op": "tok", "sid": 1, "t": t})
+    w._verb_orphan_flush({"op": "orphan_flush"})
+    toks = [f["t"] for f in w.sent if f["op"] == "tok"]
+    assert toks == list(range(12, 20))  # newest 8 survive, in order
+    # the done frame (full text) is never sacrificed to the ring
+    assert [f["sid"] for f in w.sent if f["op"] == "done"] == [2]
+
+
+def test_flush_vs_concurrent_enqueue_fuzz():
+    """Seeded fuzz: the engine thread keeps emitting tok frames while
+    the successor's orphan_flush drains the buffer.  The drain-loop
+    contract: every token reaches the wire exactly once, in order — a
+    concurrently-enqueued frame can never jump ahead of a buffered
+    one."""
+    for seed in range(8):
+        rng = random.Random(seed)
+        w = bare_worker(epoch=1)
+        w._orphan_buffering = True
+        total = 200
+        pre = rng.randrange(0, total)
+        for t in range(pre):
+            w._enqueue({"op": "tok", "sid": 1, "t": t})
+
+        def emit(start=pre):
+            for t in range(start, total):
+                w._enqueue({"op": "tok", "sid": 1, "t": t})
+                if t % 17 == 0:
+                    time.sleep(0)
+
+        w.epoch = 2  # adopted
+        emitter = threading.Thread(target=emit)
+        emitter.start()
+        w._verb_orphan_flush({"op": "orphan_flush"})
+        emitter.join(10)
+        assert not emitter.is_alive(), "hang: emitter never finished"
+        # anything still buffered after the join is a bug: flush
+        # dropped the buffering flag only once the buffer was empty,
+        # and the emitter had finished by then
+        w._verb_orphan_flush({"op": "orphan_flush"})
+        toks = [f["t"] for f in w.sent if f["op"] == "tok"]
+        assert toks == list(range(total)), f"seed {seed}: {toks[:10]}..."
+
+
+def test_adopt_handshake_fuzz_typed_never_hangs():
+    """Seeded fuzz of the handshake via _dispatch: random interleave of
+    adopts (random epochs around the current one), stale re-hellos, and
+    status probes.  Invariants: every call gets a reply (no hang), the
+    epoch never moves backwards, an adopt succeeds iff strictly newer,
+    and failures are the typed fence."""
+    rng = random.Random(2020)
+    w = bare_worker(epoch=3)
+    cid = 0
+    for _ in range(300):
+        cid += 1
+        before = w.epoch
+        op = rng.choice(["adopt", "orphan_status", "ping", "submit"])
+        e = rng.choice(
+            [before - 1, before, before + 1, before + 5, 1, None]
+        )
+        frame = {"op": op, "id": cid}
+        if e is not None:
+            frame["e"] = e
+        n_sent = len(w.sent)
+        w._dispatch(frame)
+        assert len(w.sent) == n_sent + 1, f"no reply for {frame}"
+        reply = w.sent[-1]
+        assert reply["id"] == cid
+        if op == "adopt":
+            if isinstance(e, int) and e > before:
+                assert reply["ok"] and w.epoch == e
+            else:
+                assert not reply["ok"]
+                assert reply["error"]["type"] in (
+                    "WorkerFencedError", "ValueError",
+                )
+                assert w.epoch == before
+        elif op == "orphan_status":
+            assert reply["ok"]  # exempt: probe always answered
+        else:
+            # work verbs (ping/submit) with a non-current epoch are
+            # fenced; current-epoch ones would touch the missing
+            # engine and error typed — either way, a reply, no hang
+            if e != w.epoch:
+                assert not reply["ok"]
+                assert reply["error"]["type"] == "WorkerFencedError"
+        assert w.epoch >= before
+
+
+# ------------------------------------------------------ registry records
+
+
+def test_registry_orphan_then_adopt_rewrites_status(tmp_path):
+    w = bare_worker(tmp_path=tmp_path, epoch=1, grace=30.0)
+    w._enter_orphan_mode("gateway_eof")
+    rec = json.loads((tmp_path / "w0.json").read_text())
+    assert rec["status"] == "orphaned"
+    assert rec["pid"] == os.getpid()
+    assert rec["epoch"] == 1
+    assert 0.0 < rec["grace_remaining_s"] <= 30.0
+    assert w._orphan_buffering  # EOF starts buffering immediately
+
+    w._verb_adopt({"op": "adopt", "e": 2})
+    rec = json.loads((tmp_path / "w0.json").read_text())
+    assert rec["status"] == "serving"
+    assert rec["epoch"] == 2
+    assert rec["adoptions"] == 1
+    assert w._orphaned is False and w._orphan_deadline is None
+
+
+# ------------------------------------------------- serve() accept loop
+
+
+def _serving_worker(tmp_path, grace):
+    w = bare_worker(tmp_path=tmp_path, epoch=1, grace=grace)
+    w.engine = SimpleNamespace(stop=lambda: None)
+    # drain()'s checkpoint fold without an engine: canned evacuation
+    w._verb_evacuate = lambda frame: {
+        "evacuated": [
+            {"sid": 3, "request_id": "req-3", "generated_tokens": 4},
+        ]
+    }
+    del w._enqueue_wire  # serve() uses the real sender path
+    del w._state
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    port = listener.getsockname()[1]
+    t = threading.Thread(target=w.serve, args=(listener,), daemon=True)
+    t.start()
+    return w, t, port
+
+
+def _connect(port):
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    return c
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_grace0_eof_is_exit_through_drain_fold(tmp_path):
+    """``pod.orphan_grace_s: 0`` regression pin: gateway EOF ends the
+    process exactly as before orphan mode existed (one connection per
+    lifetime, no re-accept) — while still routing through drain()'s
+    checkpoint fold, leaving the final checkpoint summary and exit
+    reason in the registry record."""
+    w, t, port = _serving_worker(tmp_path, grace=0.0)
+    conn = _connect(port)
+    conn.close()  # the gateway dies
+    assert _wait(lambda: not t.is_alive())
+    rec = json.loads((tmp_path / "w0.json").read_text())
+    assert rec["status"] == "exited"
+    assert rec["exit_reason"] == "gateway_eof"
+    assert rec["checkpoints"] == [
+        {"sid": 3, "request_id": "req-3", "generated_tokens": 4},
+    ]
+    # byte-identical contract: the listener is gone, no successor can
+    # re-accept a grace-0 worker
+    with pytest.raises(OSError):
+        _connect(port)
+
+
+def test_grace_eof_orphans_then_successor_adopts(tmp_path):
+    """grace > 0: EOF enters orphan mode, the listener stays open, a
+    successor re-accepts, probes (orphan_status), adopts with a bumped
+    epoch, and flushes — the full re-adoption handshake over a real
+    socket."""
+    w, t, port = _serving_worker(tmp_path, grace=60.0)
+    conn = _connect(port)
+    conn.close()
+    assert _wait(lambda: w._orphaned)
+    assert t.is_alive()
+
+    succ = _connect(port)
+    assert _wait(lambda: w._conn is not None)
+
+    def call(frame):
+        succ.sendall(rpc.encode_frame(frame, w.max_frame_bytes))
+        reply = rpc.recv_frame(succ, w.max_frame_bytes)
+        assert reply is not None and reply["op"] == "reply"
+        return reply
+
+    probe = call({"op": "orphan_status", "id": 1, "e": 99})
+    assert probe["ok"] and probe["data"]["orphaned"]
+
+    adopted = call({"op": "adopt", "id": 2, "e": 2})
+    assert adopted["ok"] and adopted["data"]["epoch"] == 2
+    assert adopted["data"]["was_orphaned"]
+    assert w._orphaned is False
+
+    stop = call({"op": "stop", "id": 3, "e": 2})
+    assert stop["ok"]
+    assert _wait(lambda: not t.is_alive())
+    rec = json.loads((tmp_path / "w0.json").read_text())
+    assert rec["status"] == "exited"
+    assert rec["exit_reason"] == "gateway_stop"
+    succ.close()
+
+
+def test_orphan_grace_expiry_self_terminates(tmp_path):
+    w, t, port = _serving_worker(tmp_path, grace=0.3)
+    conn = _connect(port)
+    conn.close()
+    assert _wait(lambda: w._orphaned, 5)
+    # nobody adopts: the worker drains itself when the grace expires
+    assert _wait(lambda: not t.is_alive(), 15)
+    rec = json.loads((tmp_path / "w0.json").read_text())
+    assert rec["status"] == "exited"
+    assert rec["exit_reason"] == "orphan_expired"
+
+
+# ------------------------------------------- gateway-side registry scan
+
+
+def _bare_scan_pod(tmp_path, n=1):
+    from vgate_tpu.runtime.pod_engine import PodEngine, _Worker
+
+    pod = object.__new__(PodEngine)
+    pod.workers = [_Worker(i) for i in range(n)]
+    pod.socket_dir = str(tmp_path)
+    pod.total_orphans_found = 0
+    pod.total_orphans_expired = 0
+    return pod
+
+
+def _write_rec(tmp_path, idx, **over):
+    rec = {
+        "pid": os.getpid(),
+        "index": idx,
+        "epoch": 1,
+        "address": "127.0.0.1:19999",
+        "status": "orphaned",
+        "beat": time.time(),
+    }
+    rec.update(over)
+    (tmp_path / f"w{idx}.json").write_text(json.dumps(rec))
+    return rec
+
+
+def test_scan_registry_classifies_records(tmp_path):
+    pod = _bare_scan_pod(tmp_path, n=4)
+    _write_rec(tmp_path, 0)  # live pid + fresh beat → adoptable
+    _write_rec(tmp_path, 1, status="exited")  # clean post-mortem
+    _write_rec(tmp_path, 2, pid=2 ** 22 + 12345)  # pid gone → expired
+    _write_rec(tmp_path, 3, beat=time.time() - 3600)  # beat stale
+    # slot 3's stale-beat pid must not be OUR pid (the scan SIGTERMs
+    # wedged-but-breathing orphans); park a disposable process there
+    import subprocess
+
+    sleeper = subprocess.Popen(["sleep", "60"])
+    _write_rec(tmp_path, 3, pid=sleeper.pid, beat=time.time() - 3600)
+    try:
+        found = pod._scan_registry()
+        assert sorted(found) == [0]
+        assert found[0]["status"] == "orphaned"
+        assert pod.total_orphans_found == 1
+        # dead pid + wedged both count as expired orphan work
+        assert pod.total_orphans_expired == 2
+        # the wedged one was cleared for a fresh spawn
+        assert sleeper.wait(timeout=10) != 0
+    finally:
+        if sleeper.poll() is None:
+            sleeper.kill()
+
+
+def test_scan_registry_empty_dir_not_a_restart(tmp_path):
+    pod = _bare_scan_pod(tmp_path)
+    from vgate_tpu import metrics as m
+
+    before = m.GATEWAY_RESTARTS._value.get()
+    assert pod._scan_registry() == {}
+    assert m.GATEWAY_RESTARTS._value.get() == before
+
+
+def test_scan_registry_any_record_counts_restart(tmp_path):
+    pod = _bare_scan_pod(tmp_path)
+    _write_rec(tmp_path, 0, status="exited")
+    from vgate_tpu import metrics as m
+
+    before = m.GATEWAY_RESTARTS._value.get()
+    assert pod._scan_registry() == {}
+    assert m.GATEWAY_RESTARTS._value.get() == before + 1

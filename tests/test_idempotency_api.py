@@ -1,0 +1,224 @@
+"""Gateway idempotency end-to-end on the dry-run engine (ISSUE 20):
+keyed requests journal + replay token-identically, duplicate in-flight
+keys 409 typed, ineligible shapes bypass the journal, and a successor
+gateway resubmits a predecessor's accepted-but-unsettled records at
+startup."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vgate_tpu.config import load_config
+from vgate_tpu.runtime.journal import PENDING, SETTLED, RequestJournal
+from vgate_tpu.server.app import create_app
+
+KEY = "Idempotency-Key"
+
+
+async def _client(**overrides):
+    overrides.setdefault("model", {"engine_type": "dry_run"})
+    overrides.setdefault(
+        "batch", {"max_batch_size": 4, "max_wait_time_ms": 5.0}
+    )
+    overrides.setdefault("logging", {"level": "WARNING"})
+    config = load_config(**overrides)
+    client = TestClient(TestServer(create_app(config)))
+    await client.start_server()
+    return client
+
+
+CHAT = {
+    "messages": [{"role": "user", "content": "Say hi"}],
+    "max_tokens": 8,
+}
+
+
+async def test_same_key_replays_identical_body():
+    client = await _client()
+    try:
+        r1 = await client.post(
+            "/v1/chat/completions", json=CHAT, headers={KEY: "k-1"}
+        )
+        assert r1.status == 200
+        body1 = await r1.json()
+        assert "replayed" not in body1
+
+        r2 = await client.post(
+            "/v1/chat/completions", json=CHAT, headers={KEY: "k-1"}
+        )
+        assert r2.status == 200
+        body2 = await r2.json()
+        assert body2.pop("replayed") is True
+        # token-identical, zero recompute: the SAME body, id and all
+        assert body2 == body1
+    finally:
+        await client.close()
+
+
+async def test_unkeyed_requests_bypass_journal():
+    client = await _client()
+    try:
+        r1 = await client.post("/v1/chat/completions", json=CHAT)
+        r2 = await client.post("/v1/chat/completions", json=CHAT)
+        assert r1.status == r2.status == 200
+        assert (await r1.json())["id"] != (await r2.json())["id"]
+        assert client.server.app["journal"].stats()["records"] == 0
+    finally:
+        await client.close()
+
+
+async def test_duplicate_inflight_key_409_typed():
+    client = await _client()
+    try:
+        journal = client.server.app["journal"]
+        # a same-lifetime pending key (the original attempt is mid-
+        # flight on this very gateway)
+        journal.begin("k-dup", "r0", "/v1/chat/completions", {"x": 1})
+        resp = await client.post(
+            "/v1/chat/completions", json=CHAT, headers={KEY: "k-dup"}
+        )
+        assert resp.status == 409
+        body = await resp.json()
+        assert body["error"]["type"] == "duplicate_request_error"
+        assert body["error"]["reason"] == "duplicate_request"
+        assert "Retry-After" in resp.headers
+    finally:
+        await client.close()
+
+
+async def test_multi_sample_request_not_journaled():
+    client = await _client()
+    try:
+        payload = {**CHAT, "n": 2, "temperature": 0.5, "seed": 7}
+        r1 = await client.post(
+            "/v1/chat/completions", json=payload, headers={KEY: "k-n2"}
+        )
+        assert r1.status == 200
+        # no snapshot → no journal record → a retry runs fresh
+        assert client.server.app["journal"].lookup("k-n2") is None
+    finally:
+        await client.close()
+
+
+async def test_embeddings_keyed_replay():
+    client = await _client()
+    try:
+        payload = {"input": ["hello world"]}
+        r1 = await client.post(
+            "/v1/embeddings", json=payload, headers={KEY: "k-emb"}
+        )
+        assert r1.status == 200
+        body1 = await r1.json()
+        r2 = await client.post(
+            "/v1/embeddings", json=payload, headers={KEY: "k-emb"}
+        )
+        body2 = await r2.json()
+        assert body2.pop("replayed") is True
+        assert body2["data"] == body1["data"]
+    finally:
+        await client.close()
+
+
+async def test_journal_survives_restart_and_serves_retry(tmp_path):
+    """The full crash story on one journal file: gateway A journals a
+    completed request and dies; gateway B loads the file and serves a
+    retry of the key verbatim, zero recompute."""
+    path = str(tmp_path / "journal.jsonl")
+    a = await _client(gateway={"journal_path": path})
+    try:
+        r1 = await a.post(
+            "/v1/completions",
+            json={"prompt": "hi", "max_tokens": 4},
+            headers={KEY: "k-surv"},
+        )
+        assert r1.status == 200
+        body1 = await r1.json()
+    finally:
+        await a.close()
+
+    b = await _client(gateway={"journal_path": path})
+    try:
+        r2 = await b.post(
+            "/v1/completions",
+            json={"prompt": "hi", "max_tokens": 4},
+            headers={KEY: "k-surv"},
+        )
+        assert r2.status == 200
+        body2 = await r2.json()
+        assert body2.pop("replayed") is True
+        assert body2 == body1
+    finally:
+        await b.close()
+
+
+async def test_startup_resubmits_inherited_pending(tmp_path):
+    """Gateway A died between accept and settle.  Gateway B's startup
+    replay resubmits the snapshot through admission and settles the
+    record — a retry (or nobody at all) finds the promise kept."""
+    path = str(tmp_path / "journal.jsonl")
+    pre = RequestJournal(path)
+    pre.begin(
+        "k-pend", "req-orig", "/v1/completions",
+        {
+            "model": "m",
+            "prompt": "resurrect me",
+            "submit": {"max_tokens": 4, "temperature": 0.0},
+        },
+    )
+    pre.close()  # crash before settle
+
+    b = await _client(gateway={"journal_path": path})
+    try:
+        journal = b.server.app["journal"]
+        rec = journal.lookup("k-pend")
+        assert rec is not None and rec.inherited
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if journal.lookup("k-pend").state != PENDING:
+                break
+            await asyncio.sleep(0.05)
+        rec = journal.lookup("k-pend")
+        assert rec.state == SETTLED
+        # the retry now serves the resubmitted generation
+        resp = await b.post(
+            "/v1/completions",
+            json={"prompt": "resurrect me", "max_tokens": 4},
+            headers={KEY: "k-pend"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["replayed"] is True
+        assert body["choices"][0]["text"]
+    finally:
+        await b.close()
+
+
+async def test_startup_fails_unreplayable_pending(tmp_path):
+    """An inherited pending embeddings record has no replayable shape:
+    startup releases the key as failed (counted), and a retry runs
+    fresh instead of hanging on the await loop."""
+    path = str(tmp_path / "journal.jsonl")
+    pre = RequestJournal(path)
+    pre.begin("k-emb-pend", "req-e", "/v1/embeddings", {"inputs": ["x"]})
+    pre.close()
+
+    b = await _client(gateway={"journal_path": path})
+    try:
+        journal = b.server.app["journal"]
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if journal.lookup("k-emb-pend").state != PENDING:
+                break
+            await asyncio.sleep(0.05)
+        assert journal.lookup("k-emb-pend").state == "failed"
+        resp = await b.post(
+            "/v1/embeddings",
+            json={"input": ["x"]},
+            headers={KEY: "k-emb-pend"},
+        )
+        assert resp.status == 200
+        assert "replayed" not in await resp.json()
+    finally:
+        await b.close()

@@ -1,0 +1,212 @@
+"""Durable request journal + idempotency keys (ISSUE 20 tentpole).
+
+Covers the admission state machine (fresh → pending → settled/failed),
+replay-vs-duplicate-vs-await semantics, crash durability (torn tail,
+truncated-mid-record fuzz), compaction, and retention expiry.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from vgate_tpu.errors import DuplicateRequestError
+from vgate_tpu.runtime.journal import (
+    FAILED,
+    PENDING,
+    SETTLED,
+    RequestJournal,
+)
+
+SNAP = {"model": "m", "prompt": "p", "submit": {"max_tokens": 4}}
+RESULT = {"id": "cmpl-1", "choices": [{"text": "hello"}]}
+
+
+def _path(tmp_path):
+    return str(tmp_path / "journal.jsonl")
+
+
+# ------------------------------------------------------- state machine
+
+
+def test_fresh_then_duplicate_then_replay(tmp_path):
+    j = RequestJournal(_path(tmp_path))
+    outcome, result = j.begin("k1", "r1", "/v1/completions", SNAP)
+    assert (outcome, result) == ("fresh", None)
+    # same key, same lifetime, still pending → typed duplicate
+    with pytest.raises(DuplicateRequestError):
+        j.begin("k1", "r2", "/v1/completions", SNAP)
+    j.settle("k1", RESULT)
+    outcome, result = j.begin("k1", "r3", "/v1/completions", SNAP)
+    assert outcome == "replay"
+    assert result == RESULT  # the IDENTICAL stored body, zero recompute
+    j.close()
+
+
+def test_failed_key_released_for_fresh_run(tmp_path):
+    j = RequestJournal(_path(tmp_path))
+    j.begin("k1", "r1", "/v1/completions", SNAP)
+    j.fail("k1")
+    outcome, _ = j.begin("k1", "r2", "/v1/completions", SNAP)
+    assert outcome == "fresh"  # a failure is not replayed
+    j.close()
+
+
+def test_in_memory_mode_no_path():
+    j = RequestJournal(None)
+    assert j.begin("k", "r", "/v1/completions", SNAP)[0] == "fresh"
+    with pytest.raises(DuplicateRequestError):
+        j.begin("k", "r", "/v1/completions", SNAP)
+    j.settle("k", RESULT)
+    assert j.begin("k", "r", "/v1/completions", SNAP) == ("replay", RESULT)
+    j.close()
+
+
+def test_retention_expired_settle_treated_fresh(tmp_path):
+    j = RequestJournal(_path(tmp_path), retention_s=0.0)
+    j.begin("k", "r", "/v1/completions", SNAP)
+    j.settle("k", RESULT)
+    # retention 0: instantly past the replay window
+    assert j.begin("k", "r", "/v1/completions", SNAP)[0] == "fresh"
+    j.close()
+
+
+# ----------------------------------------------------- restart semantics
+
+
+def test_restart_pending_is_inherited_await(tmp_path):
+    path = _path(tmp_path)
+    j = RequestJournal(path)
+    j.begin("k1", "r1", "/v1/completions", SNAP)
+    j.close()  # crash between accept and settle
+
+    j2 = RequestJournal(path)
+    pending = j2.pending()
+    assert [r.key for r in pending] == ["k1"]
+    assert pending[0].inherited
+    assert pending[0].snapshot == SNAP
+    # a retry of an inherited pending key WAITS (the original attempt
+    # died with the predecessor — a 409 would dead-end the client)
+    assert j2.begin("k1", "r1", "/v1/completions", SNAP) == ("await", None)
+    # the startup replay settles it; the poll then serves
+    j2.settle("k1", RESULT)
+    assert j2.begin("k1", "r1", "/v1/completions", SNAP) == (
+        "replay", RESULT,
+    )
+    j2.close()
+
+
+def test_restart_settled_replays_identically(tmp_path):
+    path = _path(tmp_path)
+    j = RequestJournal(path)
+    j.begin("k1", "r1", "/v1/chat/completions", SNAP)
+    j.settle("k1", RESULT)
+    j.close()
+
+    j2 = RequestJournal(path)
+    assert j2.begin("k1", "r1", "/v1/chat/completions", SNAP) == (
+        "replay", RESULT,
+    )
+    j2.close()
+
+
+def test_torn_tail_dropped_and_recovered(tmp_path):
+    path = _path(tmp_path)
+    j = RequestJournal(path)
+    j.begin("k1", "r1", "/v1/completions", SNAP)
+    j.settle("k1", RESULT)
+    j.close()
+    # simulate a crash mid-append: half a record, no newline
+    with open(path, "ab") as fh:
+        fh.write(b'{"op":"accept","key":"k2","request')
+
+    j2 = RequestJournal(path)
+    assert j2.stats()["torn_tail_recovered"]
+    assert j2.lookup("k1").state == SETTLED
+    assert j2.lookup("k2") is None
+    # the rewrite leaves a clean boundary: appends + reload still work
+    j2.begin("k3", "r3", "/v1/completions", SNAP)
+    j2.close()
+    j3 = RequestJournal(path)
+    assert j3.lookup("k3").state == PENDING
+    j3.close()
+
+
+def test_corruption_mid_file_raises(tmp_path):
+    path = _path(tmp_path)
+    j = RequestJournal(path)
+    j.begin("k1", "r1", "/v1/completions", SNAP)
+    j.close()
+    with open(path, "ab") as fh:
+        fh.write(b"garbage not json\n")
+        fh.write(
+            json.dumps({
+                "op": "accept", "key": "k2", "request_id": "r2",
+                "endpoint": "/v1/completions", "snapshot": {}, "t": 1.0,
+            }).encode() + b"\n"
+        )
+    with pytest.raises(RuntimeError, match="corrupt"):
+        RequestJournal(path)
+
+
+def test_truncation_fuzz_never_crashes(tmp_path):
+    """Seeded fuzz (ISSUE 20 satellite): truncate the journal at every
+    kind of byte offset a crash can leave and assert the loader either
+    recovers (dropping at most the torn tail) or raises the typed
+    corruption error — never a hang, never an unhandled exception."""
+    rng = random.Random(2020)
+    path = _path(tmp_path)
+    j = RequestJournal(path)
+    for i in range(20):
+        j.begin(f"k{i}", f"r{i}", "/v1/completions", SNAP)
+        if i % 2 == 0:
+            j.settle(f"k{i}", {"i": i})
+    j.close()
+    blob = open(path, "rb").read()
+    assert len(blob) > 200
+    for _ in range(60):
+        cut = rng.randrange(1, len(blob))
+        with open(path, "wb") as fh:
+            fh.write(blob[:cut])
+        j2 = RequestJournal(path)
+        # whatever survived is internally consistent: every settled
+        # record still carries its result body
+        for rec in j2._records.values():
+            if rec.state == SETTLED:
+                assert rec.result is not None
+            assert rec.inherited
+        j2.close()
+
+
+# ------------------------------------------------------------ compaction
+
+
+def test_compaction_drops_failed_keeps_pending(tmp_path):
+    path = _path(tmp_path)
+    j = RequestJournal(path, max_bytes=1)  # compact on every append
+    j.begin("pend", "r1", "/v1/completions", SNAP)
+    j.begin("done", "r2", "/v1/completions", SNAP)
+    j.settle("done", RESULT)
+    j.begin("dead", "r3", "/v1/completions", SNAP)
+    j.fail("dead")
+    j.close()
+
+    j2 = RequestJournal(path)
+    assert j2.lookup("pend").state == PENDING
+    assert j2.lookup("done").state == SETTLED
+    assert j2.lookup("dead") is None  # failed records compact away
+    j2.close()
+    # FAILED constant is part of the public surface even though
+    # compaction removes those records from disk
+    assert FAILED == "failed"
+
+
+def test_compaction_bounds_file_size(tmp_path):
+    path = _path(tmp_path)
+    j = RequestJournal(path, max_bytes=4096, retention_s=0.0)
+    for i in range(200):
+        j.begin(f"k{i}", f"r{i}", "/v1/completions", SNAP)
+        j.fail(f"k{i}")
+    j.close()
+    assert os.path.getsize(path) < 4096 * 2

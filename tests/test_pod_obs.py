@@ -277,6 +277,10 @@ def _debug_shell():
     pod.total_handoffs = 5
     pod.total_handoff_fallbacks = 1
     pod.total_handoff_failed = 0
+    pod.total_adopted = 0
+    pod.total_orphans_found = 0
+    pod.total_orphans_expired = 0
+    pod.adopted_request_ids = {}
     w0, w1 = _Worker(0), _Worker(1)
     w0.epoch, w0.state = 2, "serving"
     w0.last_fatal = "SIGKILL"

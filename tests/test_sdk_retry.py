@@ -215,3 +215,116 @@ async def test_async_midstream_failure_never_retried():
             got.append(chunk)
     assert got == [{"chunk": 1}]
     assert len(calls) == 1
+
+
+# --------------------------------------------------------- idempotency keys
+# (ISSUE 20 satellite: the SDK half of gateway crash survivability.)
+# Non-streaming generation POSTs auto-mint an Idempotency-Key; a
+# connection-failure retry resends the SAME key (the server may have
+# journaled the request before the socket died, so the retry replays
+# instead of recomputing); a status-code retry (429/5xx) mints a NEW
+# key (the server answered — the old key settled as failed).
+
+CHAT_BODY = {
+    "id": "cmpl-1",
+    "object": "chat.completion",
+    "choices": [
+        {
+            "index": 0,
+            "message": {"role": "assistant", "content": "hi"},
+            "finish_reason": "stop",
+        }
+    ],
+}
+
+
+def test_idempotency_key_minted_on_chat():
+    keys = []
+
+    def handler(request):
+        keys.append(request.headers.get("Idempotency-Key"))
+        return httpx.Response(200, json=CHAT_BODY)
+
+    client = make_client(handler)
+    client.chat.create([{"role": "user", "content": "x"}])
+    assert len(keys) == 1 and keys[0]
+    assert client.last_idempotency_key == keys[0]
+
+
+def test_connection_failure_retry_reuses_key():
+    keys = []
+
+    def handler(request):
+        keys.append(request.headers.get("Idempotency-Key"))
+        if len(keys) == 1:
+            raise httpx.ConnectError("connection refused", request=request)
+        return httpx.Response(200, json=CHAT_BODY)
+
+    client = make_client(handler)
+    client.chat.create([{"role": "user", "content": "x"}])
+    assert len(keys) == 2
+    assert keys[0] and keys[0] == keys[1]  # SAME key across the retry
+
+
+def test_status_retry_mints_new_key():
+    keys = []
+
+    def handler(request):
+        keys.append(request.headers.get("Idempotency-Key"))
+        if len(keys) == 1:
+            return httpx.Response(503, json={"error": {"message": "shed"}})
+        return httpx.Response(200, json=CHAT_BODY)
+
+    client = make_client(handler)
+    client.chat.create([{"role": "user", "content": "x"}])
+    assert len(keys) == 2
+    assert keys[0] and keys[1] and keys[0] != keys[1]  # fresh key
+
+
+def test_new_request_mints_new_key():
+    keys = []
+
+    def handler(request):
+        keys.append(request.headers.get("Idempotency-Key"))
+        return httpx.Response(200, json=CHAT_BODY)
+
+    client = make_client(handler)
+    client.chat.create([{"role": "user", "content": "x"}])
+    client.chat.create([{"role": "user", "content": "x"}])
+    assert len(keys) == 2
+    assert keys[0] != keys[1]  # one key per LOGICAL request, not per client
+
+
+def test_replayed_flag_surfaces():
+    def handler(request):
+        return httpx.Response(200, json={**CHAT_BODY, "replayed": True})
+
+    client = make_client(handler)
+    completion = client.chat.create([{"role": "user", "content": "x"}])
+    assert completion.replayed is True
+
+
+def test_stream_sends_no_idempotency_key():
+    keys = []
+
+    def handler(request):
+        keys.append(request.headers.get("Idempotency-Key"))
+        return sse_response()
+
+    client = make_client(handler)
+    list(client._stream("/v1/chat/completions", {}))
+    assert keys == [None]  # partial streams are not replayable
+
+
+async def test_async_connection_failure_retry_reuses_key():
+    keys = []
+
+    def handler(request):
+        keys.append(request.headers.get("Idempotency-Key"))
+        if len(keys) == 1:
+            raise httpx.ConnectError("connection refused", request=request)
+        return httpx.Response(200, json=CHAT_BODY)
+
+    client = make_async_client(handler)
+    await client.chat.create([{"role": "user", "content": "x"}])
+    assert len(keys) == 2 and keys[0] == keys[1]

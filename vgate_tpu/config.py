@@ -627,6 +627,19 @@ class PodConfig(BaseModel):
     # config has kv_cache.host_swap_bytes=0 — the handoff stages KV
     # through that pool, so it must exist on both sides.
     transfer_staging_bytes: int = 64 * 1024 * 1024
+    # Gateway-crash survivability: how long a worker outlives its
+    # gateway.  0 (the default) keeps today's behavior byte-identical —
+    # gateway EOF means the worker drains and exits.  > 0 makes gateway
+    # EOF enter an explicit ORPHANED state instead: in-flight decodes
+    # run to completion (frames buffered for replay), new submits are
+    # refused with a typed retryable error, idle residents checkpoint,
+    # and the worker keeps listening so a restarted gateway can adopt
+    # it (warm weights, compile ledger and radix cache all survive a
+    # gateway crash).  Only after the grace expires does the worker
+    # self-terminate through the normal drain fold.  Requires a stable
+    # pod.socket_dir — a successor gateway finds orphans through the
+    # registry records written there.
+    orphan_grace_s: float = 0.0
 
     @field_validator("transport")
     @classmethod
@@ -665,6 +678,13 @@ class PodConfig(BaseModel):
             raise ValueError(f"pod.{info.field_name} must be > 0")
         return v
 
+    @field_validator("orphan_grace_s")
+    @classmethod
+    def _check_orphan_grace(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError("pod.orphan_grace_s must be >= 0")
+        return v
+
     @model_validator(mode="after")
     def _check_roles_len(self) -> "PodConfig":
         if self.roles and len(self.roles) != self.workers:
@@ -674,6 +694,39 @@ class PodConfig(BaseModel):
                 "(or leave roles empty for an all-mixed pod)"
             )
         return self
+
+
+class GatewayConfig(BaseModel):
+    """Gateway-process survivability (runtime/journal.py +
+    server/app.py): a durable request journal keyed by the client's
+    ``Idempotency-Key`` header.  Accepted-but-unsettled requests are
+    appended (fsync'd) before dispatch and settled with their result
+    body on completion; a restarted gateway replays the journal so a
+    retried request whose generation already completed (possibly on an
+    orphaned worker, see ``pod.orphan_grace_s``) returns the identical
+    result with zero recompute, an incomplete one re-submits through
+    normal admission, and a duplicate in-flight key gets a typed 409."""
+
+    # Journal file path; "" disables journaling (idempotency keys are
+    # then honored only within one gateway lifetime, in memory).
+    journal_path: str = ""
+    # fsync every append.  Off trades durability of the last few
+    # records against write latency (the OS still flushes eventually).
+    journal_fsync: bool = True
+    # Compaction trigger: when the file exceeds this, settled/expired
+    # records are dropped and the journal is rewritten in place.
+    journal_max_bytes: int = 16 * 1024 * 1024
+    # Settled records older than this are eligible for compaction and
+    # no longer replayable — bounds both file growth and how long a
+    # client may retry with the same key and expect a replay.
+    journal_retention_s: float = 3600.0
+
+    @field_validator("journal_max_bytes", "journal_retention_s")
+    @classmethod
+    def _check_positive(cls, v, info):
+        if v <= 0:
+            raise ValueError(f"gateway.{info.field_name} must be > 0")
+        return v
 
 
 class LifecycleConfig(BaseModel):
@@ -957,6 +1010,7 @@ class VGTConfig(BaseModel):
     scheduler: SchedulerConfig = Field(default_factory=SchedulerConfig)
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     lifecycle: LifecycleConfig = Field(default_factory=LifecycleConfig)
+    gateway: GatewayConfig = Field(default_factory=GatewayConfig)
     migration: MigrationConfig = Field(default_factory=MigrationConfig)
     pod: PodConfig = Field(default_factory=PodConfig)
     integrity: IntegrityConfig = Field(default_factory=IntegrityConfig)

@@ -236,6 +236,22 @@ class WorkerFencedError(RetryableError):
     reason = "worker_fenced"
 
 
+class WorkerOrphanedError(RetryableError):
+    """A submit reached a worker that has outlived its gateway
+    (``pod.orphan_grace_s`` > 0, gateway socket gone): the worker is
+    finishing its in-flight decodes and waiting for a successor gateway
+    to adopt it, and accepts no new work in between — an orphan that
+    kept taking submits could never be reconciled against the
+    successor's journal.  Retryable: by the time the client retries,
+    either a new gateway has adopted the worker or the orphan grace
+    expired and the pod respawned it."""
+
+    reason = "worker_orphaned"
+
+    def __init__(self, message: str, retry_after: float = 2.0) -> None:
+        super().__init__(message, retry_after=retry_after)
+
+
 class IntegrityError(RetryableError):
     """Silent data corruption detected (vgate_tpu/integrity.py): an
     output sentinel tripped on a decode readback (NaN/Inf, all-zero or
@@ -402,3 +418,19 @@ class PoisonRequestError(ValueError):
 
     reason = "poison"
     sdk_twin = "VGTError"
+
+
+class DuplicateRequestError(ValueError):
+    """An ``Idempotency-Key`` arrived while a request carrying the same
+    key is still in flight on this gateway — a concurrent duplicate,
+    not a retry of a settled one (that replays the stored result) and
+    not a fresh request (that mints a new key).  Mapped to a 409: the
+    client should wait for its original attempt rather than race two
+    generations under one key.  ``retry_after`` hints how long."""
+
+    reason = "duplicate_request"
+    sdk_twin = "VGTError"
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after

@@ -399,6 +399,54 @@ POD_FENCED_FRAMES = _safe_metric(
     "by the gateway's epoch check instead of corrupting live streams",
 )
 
+# --- gateway survivability (pod.orphan_grace_s + gateway.journal_*) ---
+GATEWAY_RESTARTS = _safe_metric(
+    Counter,
+    "vgt_gateway_restarts",
+    "Gateway boots that found survivable state left by a predecessor "
+    "(orphaned-worker registry records and/or a non-empty request "
+    "journal) — incremented by the successor, since the dead gateway "
+    "cannot",
+)
+WORKERS_ADOPTED = _safe_metric(
+    Counter,
+    "vgt_workers_adopted",
+    "Orphaned worker incarnations a restarting gateway re-helloed with "
+    "a bumped fencing epoch and took back into routing (warm weights, "
+    "compile ledger and radix cache preserved — no respawn)",
+)
+WORKERS_ORPHANED = _safe_metric(
+    Counter,
+    "vgt_workers_orphaned",
+    "Live orphaned workers discovered in the registry at gateway boot "
+    "(workers that outlived their gateway under pod.orphan_grace_s and "
+    "were still within grace when the successor scanned)",
+)
+ORPHAN_EXPIRED = _safe_metric(
+    Counter,
+    "vgt_orphan_expired",
+    "Registry records of orphaned workers whose grace expired (or that "
+    "died) before a successor gateway could adopt them — each one is a "
+    "full engine re-warm the orphan grace failed to prevent",
+)
+JOURNAL_REPLAYS = _safe_metric(
+    Counter,
+    "vgt_journal_replays",
+    "Idempotency-journal replay decisions: served (retried key "
+    "answered from the settled result, zero recompute), resubmitted "
+    "(accepted-but-unsettled record re-entered admission at startup), "
+    "duplicate (key still in flight -> typed 409), failed (record "
+    "unreplayable and skipped)",
+    labelnames=("outcome",),  # served | resubmitted | duplicate | failed
+)
+JOURNAL_BYTES = _safe_metric(
+    Gauge,
+    "vgt_journal_bytes",
+    "Current on-disk size of the idempotency request journal "
+    "(compaction past gateway.journal_max_bytes drops settled/expired "
+    "records and rewrites the file)",
+)
+
 # --- disaggregated prefill/decode pools (pod.roles): KV handoff plane ---
 POOL_WORKERS = _safe_metric(
     Gauge,

@@ -66,6 +66,7 @@ import binascii
 import itertools
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -75,7 +76,15 @@ import time
 import zlib
 from collections import deque
 from types import SimpleNamespace
-from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence as Seq,
+    Tuple,
+)
 
 from vgate_tpu import faults, metrics, tracing
 from vgate_tpu.config import VGTConfig, get_config
@@ -93,6 +102,7 @@ from vgate_tpu.logging_config import get_logger
 from vgate_tpu.models.specs import spec_for_model_id
 from vgate_tpu.observability import perf as perf_attr
 from vgate_tpu.runtime import handoff as handoff_mod
+from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.runtime.sequence import Sequence, SeqStatus
 from vgate_tpu.runtime.supervisor import (
     HealthState,
@@ -119,12 +129,19 @@ VGT_LOCK_GUARDS = {
     "_req_ledger": "_lock",
     "_flight_cache": "_lock",
     "_last_crash": "_lock",
+    "_adopted_sids": "_lock",
+    "adopted_request_ids": "_lock",
+    "adopted_results": "_lock",
 }
 
 # spawn-time connect poll cadence (the worker binds its listener before
 # building the engine, so the socket appears in milliseconds; the slow
 # part — engine build — is budgeted by the hello call's timeout)
 _CONNECT_POLL_S = 0.05
+
+# an orphan's registry beat refreshes every second; a record older than
+# this with a live pid means the process is wedged, not adoptable
+_ADOPT_BEAT_FRESH_S = 10.0
 
 
 def _pc_to_ns(pc: float) -> int:
@@ -177,6 +194,67 @@ class _Worker:
     @property
     def alive(self) -> bool:
         return self.state == "serving"
+
+
+def _pid_alive(pid: int) -> bool:
+    """Signal-0 liveness probe.  EPERM means the pid exists but isn't
+    ours to signal — still alive for adoption purposes."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
+class _AdoptedProc:
+    """Popen-shaped handle for a worker process this gateway did NOT
+    spawn (an orphan adopted from a crashed predecessor's registry).
+
+    The adopted worker is not our child, so ``waitpid`` semantics are
+    unavailable; every Popen surface the pod machinery touches —
+    ``pid``, ``poll()``, ``returncode``, ``terminate()``, ``kill()``,
+    ``wait(timeout)`` — is re-implemented over signal-0 probes so the
+    monitor, loss path, ``_kill_proc`` and ``stop()`` treat adopted and
+    spawned incarnations identically."""
+
+    __slots__ = ("pid", "returncode")
+
+    def __init__(self, pid: int) -> None:
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is None and not _pid_alive(self.pid):
+            # exit status belongs to whoever reaps it (init); -1 marks
+            # "gone, status unknown" without pretending to know more
+            self.returncode = -1
+        return self.returncode
+
+    def _signal(self, sig: int) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except OSError:
+            pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired(
+                    f"adopted pid {self.pid}", timeout or 0.0
+                )
+            time.sleep(0.05)
+        return self.returncode  # type: ignore[return-value]
 
 
 class _SourceLost(Exception):
@@ -455,6 +533,33 @@ class PodEngine:
         self.total_handoff_fallbacks = 0
         self.total_handoff_failed = 0
         self._canary_expected: Optional[str] = None
+        # gateway-crash survivability (pod.orphan_grace_s): workers
+        # adopted from a predecessor's registry instead of respawned
+        self.total_adopted = 0
+        self.total_orphans_found = 0
+        self.total_orphans_expired = 0
+        # sid floor across concurrent adoptions — fresh sids must start
+        # above every sid the predecessor ever issued to an adoptee
+        self._sid_floor = 1
+        # sids whose sequence is an adopted SHELL: the gateway holds no
+        # prompt for them, so they can finish or fail typed but never
+        # replay onto a survivor
+        self._adopted_sids: set = set()
+        # request_id → sid for adopted in-flight work; app.py reconciles
+        # its journal's pending records against this at startup
+        self.adopted_request_ids: Dict[str, int] = {}
+        # app.py hook: (request_id, result|None, error|None), fired when
+        # an adopted shell settles so the journal can settle/fail the
+        # matching idempotency record.  Settles that land BEFORE the
+        # hook is attached (a short decode finishing during boot) park
+        # in adopted_results until drain_adopted_results() collects
+        # them — results must never race the app's startup wiring.
+        self.on_adopted_done: Optional[
+            Callable[[str, Optional[Dict[str, Any]], Optional[str]], Any]
+        ] = None
+        self.adopted_results: Dict[
+            str, Tuple[Optional[Dict[str, Any]], Optional[str]]
+        ] = {}
 
         self._own_socket_dir = not pod.socket_dir
         self.socket_dir = pod.socket_dir or tempfile.mkdtemp(
@@ -524,9 +629,29 @@ class PodEngine:
 
     def _boot_all(self) -> None:
         errors: List[BaseException] = []
+        adoptable = self._scan_registry()
 
         def boot(w: _Worker) -> None:
             try:
+                rec = adoptable.get(w.idx)
+                if rec is not None:
+                    try:
+                        self._try_adopt(w, rec)
+                        return
+                    except BaseException as exc:  # noqa: BLE001
+                        # adoption is best-effort: fence + kill the
+                        # orphan and fall through to a fresh spawn
+                        logger.warning(
+                            "worker adoption failed; respawning",
+                            extra={
+                                "extra_data": {
+                                    "worker": w.idx,
+                                    "pid": rec.get("pid"),
+                                    "error": str(exc),
+                                }
+                            },
+                        )
+                        self._abandon_adoption(w, rec)
                 self._spawn_and_gate(w)
             except BaseException as exc:  # noqa: BLE001 — collected
                 errors.append(exc)
@@ -608,6 +733,10 @@ class PodEngine:
             self._config_path,
             "--index",
             str(w.idx),
+            # liveness/adoption registry rides in the shared socket dir
+            # so a successor gateway (stable pod.socket_dir) finds it
+            "--registry-dir",
+            self.socket_dir,
         ]
         w.proc = subprocess.Popen(cmd, env=self._worker_env(w))
         logger.info(
@@ -706,6 +835,188 @@ class PodEngine:
                 f"worker {w.idx} (epoch {w.epoch}) failed the canary "
                 f"gate: fingerprint {fp} != recorded {expected}"
             )
+
+    # ----------------------------------- adoption (gateway restart)
+
+    def _scan_registry(self) -> Dict[int, Dict[str, Any]]:
+        """Scan the registry a predecessor gateway shared with its
+        workers (stable ``pod.socket_dir``).  A record whose pid is
+        alive and whose liveness beat is fresh is an adoption
+        candidate; a record that PROMISED a survivor (status serving/
+        orphaned) without delivering one counts as an expired orphan —
+        that is real work lost to the crash, and the alert rides on
+        it.  Any record at all means a prior gateway lifetime ended in
+        this registry dir and we are its successor."""
+        found: Dict[int, Dict[str, Any]] = {}
+        saw_any = False
+        for w in self.workers:
+            path = os.path.join(self.socket_dir, f"w{w.idx}.json")
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    rec = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            saw_any = True
+            status = rec.get("status")
+            pid = rec.get("pid")
+            alive = (
+                isinstance(pid, int) and pid > 0 and _pid_alive(pid)
+            )
+            try:
+                beat_age = time.time() - float(rec.get("beat") or 0.0)
+            except (TypeError, ValueError):
+                beat_age = float("inf")
+            if status not in ("serving", "orphaned"):
+                continue  # clean exit post-mortem — nothing to adopt
+            if alive and beat_age < _ADOPT_BEAT_FRESH_S:
+                found[w.idx] = rec
+                self.total_orphans_found += 1
+                metrics.WORKERS_ORPHANED.inc()
+            else:
+                self.total_orphans_expired += 1
+                metrics.ORPHAN_EXPIRED.inc()
+                if alive:
+                    # beat-stale but breathing: wedged — don't adopt,
+                    # clear the slot for a fresh spawn
+                    try:
+                        os.kill(pid, signal.SIGTERM)
+                    except OSError:
+                        pass
+        if saw_any:
+            metrics.GATEWAY_RESTARTS.inc()
+            logger.warning(
+                "predecessor gateway registry found",
+                extra={
+                    "extra_data": {
+                        "adoptable": sorted(found),
+                        "expired": self.total_orphans_expired,
+                    }
+                },
+            )
+        return found
+
+    def _try_adopt(self, w: _Worker, rec: Dict[str, Any]) -> None:
+        """Adopt a live orphan left by a crashed predecessor: connect
+        to its persisted address, re-hello it under a bumped fencing
+        epoch, inherit its in-flight decodes as shell sequences,
+        canary-gate it, then ask it to flush the frames it buffered
+        while orphaned.  Warm weights, the compile ledger and the
+        radix cache all survive — zero respawns.  Raises on any step
+        failing; the caller falls back to a fresh spawn."""
+        pod = self._pod_cfg
+        with self._lock:
+            # strictly newer than every epoch the orphan has seen, and
+            # monotonic within this gateway's own bookkeeping
+            w.epoch = max(w.epoch, int(rec.get("epoch") or 0)) + 1
+            w.state = "spawning"
+            w.draining = False
+        addr = str(rec.get("address") or "")
+        if pod.transport == "uds":
+            w.address = addr
+        else:
+            host, _, port_s = addr.rpartition(":")
+            w.address = (host or "127.0.0.1", int(port_s))
+        w.proc = _AdoptedProc(int(rec["pid"]))
+        client = self._connect(w)
+        try:
+            adopt = client.call(
+                "adopt", timeout=pod.connect_timeout_s + 10.0
+            )
+            hello = client.call(
+                "hello", timeout=pod.spawn_timeout_s
+            )
+            self._canary_gate(w, client)
+        except BaseException:
+            client.close()
+            raise
+        inflight = adopt.get("inflight") or []
+        with self._lock:
+            max_sid = 0
+            for ent in inflight:
+                try:
+                    sid = int(ent["sid"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                max_sid = max(max_sid, sid)
+                if ent.get("cancelled"):
+                    continue  # already aborted; let the worker reap it
+                # shell sequence: the gateway holds no prompt for it —
+                # it can finish (done carries the authoritative text)
+                # or fail typed, but never replay onto a survivor
+                shell = _PodSequence(
+                    prompt_ids=[0], params=SamplingParams()
+                )
+                shell._pod = self
+                shell._sid = sid
+                shell._worker_idx = w.idx
+                shell.request_id = ent.get("request_id")
+                # pad to the delivered-token count; the orphan_flush
+                # replay appends the buffered remainder, so usage
+                # totals reconcile
+                shell.generated_ids = [0] * int(
+                    ent.get("generated_tokens") or 0
+                )
+                self._inflight[sid] = shell
+                self._adopted_sids.add(sid)
+                rid = ent.get("request_id")
+                if rid:
+                    self.adopted_request_ids[str(rid)] = sid
+            # fresh sids must start above everything the predecessor
+            # ever issued to any adoptee (adoptions run concurrently)
+            self._sid_floor = max(self._sid_floor, max_sid + 1)
+            self._sids = itertools.count(self._sid_floor)
+            w.client = client
+            w.hello = hello
+            w.last_ok_t = time.monotonic()
+            w.last_fatal = None
+            w.state = "serving"
+            self.total_adopted += 1
+        metrics.WORKERS_ADOPTED.inc()
+        logger.info(
+            "adopted orphan worker",
+            extra={
+                "extra_data": {
+                    "worker": w.idx,
+                    "epoch": w.epoch,
+                    "pid": rec.get("pid"),
+                    "inflight": len(inflight),
+                    "buffered_frames": adopt.get("buffered_frames"),
+                    "was_orphaned": adopt.get("was_orphaned"),
+                }
+            },
+        )
+        try:
+            # sids are registered — frames buffered during orphanhood
+            # may now replay, in order, re-stamped with the new epoch
+            client.notify("orphan_flush")
+        except WorkerLostError:
+            pass  # connection died post-adopt: the loss path owns it
+        self._set_alive_gauge()
+        self._drain_orphans()
+
+    def _abandon_adoption(
+        self, w: _Worker, rec: Dict[str, Any]
+    ) -> None:
+        """A failed adoption leaves a live-but-unadoptable orphan.  Its
+        epoch is already behind the slot's, so it is fenced; kill it so
+        the fresh spawn can take the slot (TCP: rebind the port) and
+        count the in-flight work it carried as expired."""
+        with self._lock:
+            old_client, w.client = w.client, None
+            old_proc, w.proc = w.proc, None
+            w.state = "down"
+        if old_client is not None:
+            old_client.close()
+        proc = old_proc
+        if proc is None:
+            pid = rec.get("pid")
+            if isinstance(pid, int) and pid > 0:
+                proc = _AdoptedProc(pid)
+        if proc is not None:
+            self._kill_proc(proc)
+        with self._lock:
+            self.total_orphans_expired += 1
+        metrics.ORPHAN_EXPIRED.inc()
 
     def start(self) -> None:
         self._monitor = threading.Thread(
@@ -813,6 +1124,8 @@ class PodEngine:
             return
         with self._lock:
             self._inflight.pop(seq._sid, None)
+            adopted = seq._sid in self._adopted_sids
+            self._adopted_sids.discard(seq._sid)
             # a sequence that finished before its handoff ever staged
             # (short decode) retires the record silently — nothing to
             # transfer, nothing degraded
@@ -839,6 +1152,51 @@ class PodEngine:
             seq.migrate_count, int(frame.get("migrate_count", 0))
         )
         seq.finish(str(frame.get("finish_reason", "stop")))
+        if adopted:
+            self._notify_adopted_done(
+                seq,
+                result={
+                    "request_id": seq.request_id,
+                    "text": text if text is not None else "",
+                    "finish_reason": str(
+                        frame.get("finish_reason", "stop")
+                    ),
+                    "generated_tokens": len(seq.generated_ids),
+                },
+                error=None,
+            )
+
+    def _notify_adopted_done(
+        self,
+        seq: _PodSequence,
+        result: Optional[Dict[str, Any]],
+        error: Optional[str],
+    ) -> None:
+        """Tell the app layer an ADOPTED shell settled so it can settle
+        or fail the matching journal record (idempotent replay)."""
+        if not seq.request_id:
+            return
+        rid = str(seq.request_id)
+        with self._lock:
+            self.adopted_request_ids.pop(rid, None)
+            cb = self.on_adopted_done
+            if cb is None:
+                self.adopted_results[rid] = (result, error)
+                return
+        try:
+            cb(rid, result, error)
+        except Exception:  # noqa: BLE001 — observer must not wedge I/O
+            logger.exception("on_adopted_done callback failed")
+
+    def drain_adopted_results(
+        self,
+    ) -> Dict[str, Tuple[Optional[Dict[str, Any]], Optional[str]]]:
+        """Adopted settles that landed before ``on_adopted_done`` was
+        attached — the app layer collects them right after wiring the
+        hook, closing the boot-time race."""
+        with self._lock:
+            out, self.adopted_results = self.adopted_results, {}
+        return out
 
     def _on_err(self, idx: int, frame: Dict[str, Any]) -> None:
         if self._handoff_intercept(idx, frame):
@@ -848,10 +1206,15 @@ class PodEngine:
             return
         with self._lock:
             self._inflight.pop(seq._sid, None)
+            adopted = seq._sid in self._adopted_sids
+            self._adopted_sids.discard(seq._sid)
             rec = self._handoffs.pop(seq._sid, None)
             if rec is not None:
                 rec.cancelled = True
-        seq.fail(unwire_error(frame.get("error") or {}))
+        err = unwire_error(frame.get("error") or {})
+        seq.fail(err)
+        if adopted:
+            self._notify_adopted_done(seq, result=None, error=str(err))
 
     def _on_evacuated(self, idx: int, frame: Dict[str, Any]) -> None:
         """Worker-initiated drain (SIGTERM straight to the worker —
@@ -1955,6 +2318,25 @@ class PodEngine:
         (drain/evacuate) never spend the crash-resume budget."""
         if seq.done_event.is_set():
             return
+        with self._lock:
+            adopted = seq._sid in self._adopted_sids
+            if adopted:
+                self._adopted_sids.discard(seq._sid)
+                self.total_lost += 1
+        if adopted:
+            # an adopted SHELL has no prompt on this gateway — it rode
+            # a predecessor's crash once already and its worker just
+            # died too; fail typed (clients retry with their
+            # idempotency key) instead of replaying garbage
+            metrics.LOST_SEQUENCES.labels(reason="adopted").inc()
+            err = WorkerLostError(
+                "adopted in-flight request lost its worker before "
+                "finishing; retry with the same Idempotency-Key",
+                retry_after=self.retry_after_s,
+            )
+            seq.fail(err)
+            self._notify_adopted_done(seq, result=None, error=str(err))
+            return
         if seq.abort_requested:
             # the client already walked away; don't burn a survivor's
             # slots replaying it
@@ -2197,6 +2579,12 @@ class PodEngine:
             "quarantined": 0,
             "fenced_frames": self.fenced_frames,
             "handoffs": self._handoff_stats(),
+            "adoption": {
+                "adopted": self.total_adopted,
+                "orphans_found": self.total_orphans_found,
+                "orphans_expired": self.total_orphans_expired,
+                "adopted_inflight": len(self.adopted_request_ids),
+            },
         }
 
     def device_health(self) -> Dict[str, Any]:
@@ -2305,6 +2693,8 @@ class PodEngine:
             "orphans": len(self._orphans),
             "roles": list(self._roles),
             "handoffs": self._handoff_stats(),
+            "adopted": self.total_adopted,
+            "orphans_expired": self.total_orphans_expired,
         }
         crashes = [
             s["last_crash"]
@@ -2434,6 +2824,14 @@ class PodEngine:
             "orphans": orphans,
             "fenced_frames": fenced,
             "handoffs": {**self._handoff_stats(), "table": table},
+            "adoption": {
+                "adopted": self.total_adopted,
+                "orphans_found": self.total_orphans_found,
+                "orphans_expired": self.total_orphans_expired,
+                "adopted_inflight": sorted(
+                    self.adopted_request_ids.values()
+                ),
+            },
             "last_crash": last_crash,
         }
 

@@ -17,11 +17,21 @@ Process-level contracts:
   of this slot) is answered with a typed ``WorkerFencedError`` reply
   and never touches the engine — the PR-5 stale-wake epoch guard,
   cross-process.
-* **One connection, then exit** — the gateway owns the worker's
-  lifecycle.  When the gateway connection reaches EOF (gateway died or
-  declared this worker lost and moved on), the worker drains and
+* **One connection, then exit (or orphan mode)** — the gateway owns
+  the worker's lifecycle.  When the gateway connection reaches EOF
+  (gateway died or declared this worker lost and moved on) and
+  ``pod.orphan_grace_s`` is 0 (the default), the worker drains and
   exits rather than lingering as an unsupervised orphan; a respawn is
-  always a fresh process with a fresh epoch.
+  always a fresh process with a fresh epoch.  With a grace > 0 the
+  worker instead enters an explicit ORPHANED state: in-flight decodes
+  run to completion (their token/done/err frames buffered, bounded,
+  for ordered replay), new submits are refused with the typed
+  retryable ``WorkerOrphanedError``, the registry record under the
+  pod's socket dir keeps a liveness beat, and a successor gateway may
+  re-accept the listener and take the incarnation over with the
+  ``adopt`` verb (a bumped fencing epoch — stale successors are
+  fenced).  Only when the grace expires does the worker self-terminate
+  through the same drain fold as SIGTERM.
 * **SIGTERM drain** — evacuate resident sequences (the PR-8 planned
   checkpoint fold), ship their checkpoints to the gateway in an
   ``evacuated`` notification, stop the engine, exit 0.
@@ -56,12 +66,14 @@ import time
 from typing import Any, Dict, List, Optional, Set
 
 from vgate_tpu import faults, tracing
+from vgate_tpu.analysis.annotations import requires_lock
 from vgate_tpu.backends.base import SamplingParams
 from vgate_tpu.config import VGTConfig, set_config
 from vgate_tpu.errors import (
     HandoffStaleError,
     HandoffTransferError,
     WorkerFencedError,
+    WorkerOrphanedError,
     state_is_alive,
     state_is_ready,
 )
@@ -89,12 +101,25 @@ VGT_LOCK_GUARDS = {
     "_xfers": "_seq_lock",
     "_xfer_committed": "_seq_lock",
     "_xfer_committing": "_seq_lock",
+    "_orphan_frames": "_orphan_lock",
 }
 
 # Sender-queue ceiling: a gateway that stopped reading gets its worker
 # torn down (queue overflow → connection abandoned) instead of growing
 # the heap without bound.
 _SEND_QUEUE_MAX = 8192
+
+# Orphan-mode frame buffer ceiling (token frames only — done/err
+# frames are kept unconditionally because the done frame carries the
+# authoritative full text, which is what the successor's idempotency
+# replay serves).  Overflow drops the OLDEST token frame: ring
+# semantics, bounded memory, and the terminal frame still reconstructs
+# the result.
+_ORPHAN_BUF_MAX = 4096
+
+# notification ops that buffer while orphaned; replies never do — the
+# adoption handshake itself must reach the wire
+_ORPHAN_BUFFERED_OPS = frozenset({"tok", "done", "err", "evacuated"})
 
 
 def wire_error(exc: BaseException) -> Dict[str, Any]:
@@ -202,11 +227,35 @@ class _Staged:
 class WorkerServer:
     """The worker main object: engine + one-connection frame server."""
 
-    def __init__(self, config: VGTConfig, epoch: int, index: int) -> None:
+    def __init__(
+        self,
+        config: VGTConfig,
+        epoch: int,
+        index: int,
+        registry_dir: Optional[str] = None,
+        address: Optional[str] = None,
+    ) -> None:
         self.config = config
         self.epoch = int(epoch)
         self.index = int(index)
         self.max_frame_bytes = int(config.pod.max_frame_bytes)
+        # Gateway-crash survivability (pod.orphan_grace_s): registry
+        # record + liveness beat so a successor gateway can find and
+        # adopt this incarnation; orphan frame buffer for ordered
+        # replay after adoption.
+        self.registry_dir = registry_dir
+        self.address = address
+        self.orphan_grace_s = float(config.pod.orphan_grace_s)
+        self._orphan_lock = threading.Lock()
+        self._orphan_frames: List[Dict[str, Any]] = []
+        self._orphan_tok_count = 0
+        self._orphan_buffering = False
+        self._orphaned = False
+        self._orphan_deadline: Optional[float] = None
+        self._adoptions = 0
+        self._exit_reason: Optional[str] = None
+        self._exit_recorded = False
+        self._started_t = time.time()
         self._build_engine()
         self._seq_lock = threading.Lock()
         self._seqs: Dict[int, _Entry] = {}
@@ -267,7 +316,32 @@ class WorkerServer:
     def _enqueue(self, frame: Dict[str, Any]) -> None:
         """Queue a frame for the sender thread (never blocks the engine
         thread; overflow abandons the connection — the gateway has
-        stopped reading and will declare us lost anyway)."""
+        stopped reading and will declare us lost anyway).  While
+        orphaned, notification frames are buffered UN-encoded instead
+        (the epoch is stamped at encode time, so replay after adoption
+        carries the successor's epoch, not the dead gateway's)."""
+        if frame.get("op") in _ORPHAN_BUFFERED_OPS:
+            with self._orphan_lock:
+                if self._orphan_buffering:
+                    self._buffer_orphan_frame_locked(frame)
+                    return
+        self._enqueue_wire(frame)
+
+    @requires_lock("_orphan_lock")
+    def _buffer_orphan_frame_locked(self, frame: Dict[str, Any]) -> None:
+        if frame.get("op") == "tok":
+            if self._orphan_tok_count >= _ORPHAN_BUF_MAX:
+                # ring: drop the OLDEST token frame; the done frame's
+                # full text survives regardless
+                for i, old in enumerate(self._orphan_frames):
+                    if old.get("op") == "tok":
+                        del self._orphan_frames[i]
+                        self._orphan_tok_count -= 1
+                        break
+            self._orphan_tok_count += 1
+        self._orphan_frames.append(frame)
+
+    def _enqueue_wire(self, frame: Dict[str, Any]) -> None:
         try:
             data = rpc.encode_frame(self._stamp(frame), self.max_frame_bytes)
         except rpc.FrameError:
@@ -358,6 +432,8 @@ class WorkerServer:
         data: Dict[str, Any] = {
             "state": self._state(),
             "fenced_rejects": self._fenced_rejects,
+            "orphaned": self._orphaned,
+            "adoptions": self._adoptions,
         }
         if beat:
             data["beat"] = {
@@ -405,6 +481,13 @@ class WorkerServer:
         seq.trace.start("queue", start_pc=seq.arrival_t)
 
     def _verb_submit(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        if self._orphaned:
+            # an orphan that took new work could never be reconciled
+            # against the successor gateway's journal
+            raise WorkerOrphanedError(
+                "worker is orphaned (gateway gone, grace running): "
+                "finishing in-flight decodes, accepting no new submits"
+            )
         sid = int(frame["sid"])
         raw_params = dict(frame.get("params") or {})
         remaining_s = frame.get("remaining_s")
@@ -1062,8 +1145,208 @@ class WorkerServer:
         return {}
 
     def _verb_stop(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        self._exit_reason = self._exit_reason or "gateway_stop"
         self._stopping.set()
         return {"stopping": True}
+
+    # ------------------------------------- orphan mode / adoption (PR 20)
+
+    def _verb_orphan_status(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Read-only adoption-handshake probe — epoch-EXEMPT (a
+        successor gateway holding a bumped epoch must be able to ask
+        before it adopts)."""
+        with self._seq_lock:
+            inflight = len(self._seqs)
+        with self._orphan_lock:
+            buffered = len(self._orphan_frames)
+        remaining = None
+        if self._orphan_deadline is not None:
+            remaining = max(0.0, self._orphan_deadline - time.monotonic())
+        return {
+            "pid": os.getpid(),
+            "index": self.index,
+            "epoch": self.epoch,
+            "orphaned": self._orphaned,
+            "orphan_grace_s": self.orphan_grace_s,
+            "grace_remaining_s": remaining,
+            "inflight": inflight,
+            "buffered_frames": buffered,
+            "adoptions": self._adoptions,
+            "state": self._state(),
+        }
+
+    def _verb_adopt(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Take this incarnation over for a successor gateway.  Epoch-
+        exempt from the strict-equality check, but the proposed epoch
+        must be STRICTLY NEWER than the current one — a stale successor
+        (or a double adopt racing a fresher one) is fenced exactly like
+        a zombie worker frame.  The reply carries everything the
+        successor needs to reconcile: in-flight sids with their request
+        ids and progress, plus the buffered-frame count.  Buffered
+        frames do NOT flush here — the successor registers the adopted
+        sequences first and then sends ``orphan_flush``, so no frame
+        can arrive before its sid is routable."""
+        proposed = frame.get("e")
+        if not isinstance(proposed, int):
+            raise ValueError("adopt frame missing a fencing epoch")
+        if proposed <= self.epoch:
+            raise WorkerFencedError(
+                f"adopt epoch {proposed} is not newer than the current "
+                f"incarnation epoch {self.epoch}"
+            )
+        with self._orphan_lock:
+            buffered = len(self._orphan_frames)
+            buffered_toks: Dict[int, int] = {}
+            for f in self._orphan_frames:
+                if f.get("op") == "tok":
+                    sid = f.get("sid")
+                    buffered_toks[sid] = buffered_toks.get(sid, 0) + 1
+        with self._seq_lock:
+            inflight = [
+                {
+                    "sid": entry.sid,
+                    "request_id": entry.seq.request_id,
+                    # tokens already DELIVERED to the predecessor (total
+                    # minus still-buffered): the successor pads its shell
+                    # to this and the orphan_flush replay appends the
+                    # rest, so its count reconciles to the true total
+                    "generated_tokens": max(
+                        0,
+                        entry.seq.num_generated
+                        - buffered_toks.get(entry.sid, 0),
+                    ),
+                    "cancelled": entry.cancelled,
+                }
+                for entry in self._seqs.values()
+            ]
+        was_orphaned = self._orphaned
+        self.epoch = proposed
+        self._orphaned = False
+        self._orphan_deadline = None
+        self._adoptions += 1
+        logger.warning(
+            "adopted by successor gateway",
+            extra={
+                "extra_data": {
+                    "epoch": proposed,
+                    "inflight": len(inflight),
+                    "buffered_frames": buffered,
+                    "was_orphaned": was_orphaned,
+                }
+            },
+        )
+        self._write_registry("serving")
+        return {
+            "pid": os.getpid(),
+            "index": self.index,
+            "epoch": self.epoch,
+            "was_orphaned": was_orphaned,
+            "inflight": inflight,
+            "buffered_frames": buffered,
+            "adoptions": self._adoptions,
+        }
+
+    def _verb_orphan_flush(self, frame: Dict[str, Any]) -> Dict[str, Any]:
+        """Replay the orphan-buffered frames in order (notification —
+        the successor sends it AFTER registering the adopted sids).
+        Drain-loop shape (the PR-17 handoff-buffer pattern): keep
+        draining until a pass finds the buffer empty, THEN drop the
+        buffering flag under the lock, so a frame enqueued concurrently
+        by the engine thread can never jump ahead of a buffered one."""
+        while True:
+            with self._orphan_lock:
+                frames = self._orphan_frames
+                if not frames:
+                    self._orphan_buffering = False
+                    self._orphan_tok_count = 0
+                    break
+                self._orphan_frames = []
+                self._orphan_tok_count = 0
+            for buffered in frames:
+                self._enqueue_wire(buffered)
+        return {}
+
+    def _enter_orphan_mode(self, reason: str) -> None:
+        self._teardown_conn()
+        with self._orphan_lock:
+            self._orphan_buffering = True
+        self._orphaned = True
+        self._orphan_deadline = time.monotonic() + self.orphan_grace_s
+        logger.warning(
+            "gateway connection lost; entering orphan mode",
+            extra={
+                "extra_data": {
+                    "reason": reason,
+                    "grace_s": self.orphan_grace_s,
+                    "epoch": self.epoch,
+                }
+            },
+        )
+        self._write_registry("orphaned")
+
+    # ------------------------------------------------- registry records
+
+    def _registry_path(self) -> Optional[str]:
+        if not self.registry_dir:
+            return None
+        return os.path.join(self.registry_dir, f"w{self.index}.json")
+
+    def _write_registry(
+        self,
+        status: Optional[str] = None,
+        exit_reason: Optional[str] = None,
+        checkpoints: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
+        """Atomically (re)write this worker's registry record.  The
+        record is how a successor gateway finds a live orphan (socket
+        path + pid + epoch + a liveness beat) and how an exited worker
+        leaves post-mortem evidence (exit reason + final checkpoint
+        summary) instead of silently vanishing from /debug/pod."""
+        path = self._registry_path()
+        if path is None:
+            return
+        if status is None:
+            status = "orphaned" if self._orphaned else "serving"
+        with self._seq_lock:
+            inflight = len(self._seqs)
+        remaining = None
+        if self._orphan_deadline is not None:
+            remaining = max(0.0, self._orphan_deadline - time.monotonic())
+        record: Dict[str, Any] = {
+            "pid": os.getpid(),
+            "index": self.index,
+            "epoch": self.epoch,
+            "address": self.address,
+            "status": status,
+            "beat": time.time(),
+            "started_t": self._started_t,
+            "orphan_grace_s": self.orphan_grace_s,
+            "grace_remaining_s": remaining,
+            "inflight": inflight,
+            "adoptions": self._adoptions,
+        }
+        if exit_reason is not None:
+            record["exit_reason"] = exit_reason
+        if checkpoints is not None:
+            record["checkpoints"] = checkpoints
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(record, fh, separators=(",", ":"))
+            os.replace(tmp, path)
+        except OSError:
+            logger.warning("registry record write failed", exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _registry_beat_loop(self) -> None:
+        """Refresh the registry beat while the worker lives — serving
+        AND orphaned alike (a successor judges orphan liveness by this
+        beat plus the pid)."""
+        while not self._stopping.wait(1.0):
+            self._write_registry()
 
     _SLOW_VERBS = frozenset(
         {
@@ -1101,14 +1384,24 @@ class WorkerServer:
         "set_spec_suspended": _verb_set_spec_suspended,
         "set_prefix_insert_suspended": _verb_set_prefix_insert_suspended,
         "stop": _verb_stop,
+        "orphan_status": _verb_orphan_status,
+        "adopt": _verb_adopt,
+        "orphan_flush": _verb_orphan_flush,
     }
+
+    # Adoption-handshake verbs are exempt from the strict-equality
+    # epoch check: a successor gateway NECESSARILY holds an epoch this
+    # incarnation has never seen (it bumps before it adopts).  adopt
+    # enforces strictly-newer itself; orphan_status is read-only.
+    _EPOCH_EXEMPT_VERBS = frozenset({"adopt", "orphan_status"})
 
     # ------------------------------------------------------------ dispatch
 
     def _dispatch(self, frame: Dict[str, Any]) -> None:
         cid = frame.get("id")
         try:
-            rpc.check_epoch(frame, self.epoch)
+            if frame.get("op") not in self._EPOCH_EXEMPT_VERBS:
+                rpc.check_epoch(frame, self.epoch)
         except rpc.StaleEpochError as exc:
             # a gateway (or tool) addressing a previous incarnation of
             # this slot: reject typed, never touch the engine
@@ -1131,6 +1424,14 @@ class WorkerServer:
                         f"(worker incarnation is {exc.want})"
                     ),
                 )
+            return
+        except rpc.FrameError as exc:
+            # epoch MISSING (vs merely stale): a structural violation —
+            # same treatment, typed fence, never touch the engine, and
+            # never let it escape into the reader loop
+            self._fenced_rejects += 1
+            if cid is not None:
+                self._reply_err(cid, WorkerFencedError(str(exc)))
             return
         op = frame.get("op")
         handler = self._VERBS.get(op)  # type: ignore[arg-type]
@@ -1170,52 +1471,109 @@ class WorkerServer:
 
     def serve(self, listener: socket.socket) -> None:
         """Accept the gateway connection and serve frames until EOF,
-        protocol violation, or drain — then exit (the gateway respawns
-        a fresh incarnation; this process never serves two)."""
+        protocol violation, or drain.  At ``pod.orphan_grace_s == 0``
+        (the default) that is the end of the process — the gateway
+        respawns a fresh incarnation; this process never serves two
+        connections.  With a grace > 0, EOF enters orphan mode instead
+        and the listener stays open so a successor gateway can
+        re-accept and adopt this incarnation; the process exits only
+        when the grace expires unclaimed (or on drain/stop)."""
         sender = threading.Thread(
             target=self._sender_loop, daemon=True, name="vgt-worker-send"
         )
         sender.start()
+        self._write_registry("serving")
+        threading.Thread(
+            target=self._registry_beat_loop, daemon=True,
+            name="vgt-worker-beat",
+        ).start()
         listener.settimeout(1.0)
-        conn: Optional[socket.socket] = None
-        while conn is None and not self._stopping.is_set():
-            try:
-                conn, _ = listener.accept()
-            except socket.timeout:
-                continue
-        listener.close()
-        if conn is None:
-            return
-        self._conn = conn
         try:
             while not self._stopping.is_set():
-                try:
-                    frame = rpc.recv_frame(conn, self.max_frame_bytes)
-                except rpc.FrameError:
-                    logger.error(
-                        "frame protocol violation from gateway; "
-                        "tearing down",
-                        exc_info=True,
-                    )
-                    break
-                except OSError:
-                    break
-                if frame is None:
-                    break  # gateway closed: we are orphaned or replaced
-                self._dispatch(frame)
+                conn: Optional[socket.socket] = None
+                while conn is None and not self._stopping.is_set():
+                    if (
+                        self._orphaned
+                        and self._orphan_deadline is not None
+                        and time.monotonic() >= self._orphan_deadline
+                    ):
+                        logger.warning(
+                            "orphan grace expired unclaimed; draining"
+                        )
+                        self.drain(reason="orphan_expired")
+                        return
+                    try:
+                        conn, _ = listener.accept()
+                    except socket.timeout:
+                        continue
+                if conn is None:
+                    return
+                if self.orphan_grace_s <= 0:
+                    # pre-orphan contract, byte-identical: one
+                    # connection for the process lifetime
+                    listener.close()
+                self._conn = conn
+                reason = self._read_conn(conn)
+                if self._stopping.is_set():
+                    return
+                if self.orphan_grace_s <= 0:
+                    # grace-0 gateway EOF still routes through the
+                    # drain fold so the registry keeps post-mortem
+                    # evidence (final checkpoint summary + exit reason)
+                    self.drain(reason="gateway_eof")
+                    return
+                self._enter_orphan_mode(reason)
         finally:
             self.shutdown()
 
+    def _read_conn(self, conn: socket.socket) -> str:
+        """Serve one gateway connection until EOF / violation / stop;
+        returns why the read loop ended."""
+        while not self._stopping.is_set():
+            try:
+                frame = rpc.recv_frame(conn, self.max_frame_bytes)
+            except rpc.FrameError:
+                logger.error(
+                    "frame protocol violation from gateway; "
+                    "tearing down",
+                    exc_info=True,
+                )
+                return "frame_error"
+            except OSError:
+                return "socket_error"
+            if frame is None:
+                return "gateway_eof"  # gateway closed: orphaned/replaced
+            self._dispatch(frame)
+        return "stopping"
+
     def drain(self, reason: str = "sigterm") -> None:
-        """SIGTERM path: checkpoint residents, ship them to the gateway
-        (``evacuated`` notification), then stop.  Worker-loss during a
-        pod drain therefore degrades exactly like ``_redistribute`` —
-        the gateway replays from its own request state either way."""
+        """The one checkpoint-fold exit path — SIGTERM, gateway EOF at
+        grace 0, and orphan-grace expiry all route through it:
+        checkpoint residents, ship them to the gateway (``evacuated``
+        notification — buffered when there is no gateway left), write
+        the final checkpoint summary + exit reason into the registry
+        record (post-mortem evidence even when nobody is listening),
+        then stop.  Worker-loss during a pod drain therefore degrades
+        exactly like ``_redistribute`` — the gateway replays from its
+        own request state either way."""
         try:
             out = self._verb_evacuate({"reason": reason, "timeout_s": 10.0})
         except Exception:
             logger.warning("drain evacuation failed", exc_info=True)
             out = {"evacuated": []}
+        summary = [
+            {
+                "sid": ck.get("sid"),
+                "request_id": ck.get("request_id"),
+                "generated_tokens": ck.get("generated_tokens"),
+            }
+            for ck in out.get("evacuated") or []
+        ]
+        self._exit_reason = self._exit_reason or reason
+        self._write_registry(
+            "exited", exit_reason=self._exit_reason, checkpoints=summary
+        )
+        self._exit_recorded = True
         self._enqueue({"op": "evacuated", "reason": reason, **out})
         # let the sender flush before teardown
         deadline = time.monotonic() + 2.0
@@ -1225,6 +1583,13 @@ class WorkerServer:
 
     def shutdown(self) -> None:
         self._stopping.set()
+        if not self._exit_recorded:
+            self._exit_recorded = True
+            self._write_registry(
+                "exited",
+                exit_reason=self._exit_reason or "shutdown",
+                checkpoints=[],
+            )
         self._teardown_conn()
         self._send_q.put(None)
         try:
@@ -1270,6 +1635,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="resolved gateway config, JSON (pod.workers forced to 0)",
     )
     parser.add_argument("--index", type=int, default=0, help="worker slot")
+    parser.add_argument(
+        "--registry-dir", default=None,
+        help="directory for the worker registry record (orphan "
+        "adoption); defaults to the socket's directory for UDS",
+    )
     args = parser.parse_args(argv)
 
     with open(args.config) as fh:
@@ -1304,8 +1674,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         stream=sys.stderr,
     )
 
+    registry_dir = args.registry_dir
+    if registry_dir is None and args.socket:
+        registry_dir = os.path.dirname(os.path.abspath(args.socket))
+    address = args.socket or f"127.0.0.1:{args.port}"
+
     listener = _bind_listener(args)
-    server = WorkerServer(config, epoch=args.epoch, index=args.index)
+    server = WorkerServer(
+        config, epoch=args.epoch, index=args.index,
+        registry_dir=registry_dir, address=address,
+    )
 
     def _on_sigterm(signum, _frame) -> None:
         threading.Thread(

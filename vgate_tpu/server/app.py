@@ -33,6 +33,7 @@ from vgate_tpu.errors import (
     ClientDisconnectError,
     ClientQuotaExceededError,
     DeadlineExceededError,
+    DuplicateRequestError,
     MigrationError,
     MigrationRefusedError,
     PoisonRequestError,
@@ -44,6 +45,10 @@ from vgate_tpu.errors import (
 from vgate_tpu.lifecycle import CancelToken, DrainController
 from vgate_tpu.logging_config import get_logger, setup_logging
 from vgate_tpu.observability.reqtrace import RequestMeta
+from vgate_tpu.runtime.journal import (
+    PENDING as _JOURNAL_PENDING,
+    RequestJournal,
+)
 from vgate_tpu.runtime.scheduler import EngineBusyError
 from vgate_tpu.security import build_security_middleware, extract_api_key
 from vgate_tpu.server.openai_models import (
@@ -233,6 +238,111 @@ def _quota_429(exc: ClientQuotaExceededError) -> web.Response:
     resp = _error(429, str(exc), "rate_limit_error")
     resp.headers["Retry-After"] = _retry_after(exc)
     return resp
+
+
+# ------------------------------------------------ idempotency (journal)
+
+_IDEMPOTENCY_HEADER = "Idempotency-Key"
+# inherited-pending poll cadence: the startup replay (or an adopted
+# worker's done frame) settles the record; sub-second detection is
+# plenty against whole-seconds of decode
+_IDEM_AWAIT_POLL_S = 0.25
+
+
+def _duplicate_409(exc: DuplicateRequestError) -> web.Response:
+    """409 for a retried Idempotency-Key whose original attempt is
+    still in flight in THIS gateway lifetime — two generations must
+    never race under one key.  Retry-After tells well-behaved clients
+    when the original will plausibly have settled."""
+    resp = web.json_response(
+        {
+            "error": {
+                "message": str(exc),
+                "type": "duplicate_request_error",
+                "reason": getattr(exc, "reason", "duplicate_request"),
+            }
+        },
+        status=409,
+    )
+    resp.headers["Retry-After"] = _retry_after(exc)
+    return resp
+
+
+def _replay_response(result: Dict[str, Any]) -> web.Response:
+    """Serve a journaled result body for a retried key: identical
+    payload, zero recompute, marked ``replayed`` so clients can tell."""
+    body = dict(result)
+    body["replayed"] = True
+    return web.json_response(body)
+
+
+async def _idempotency_begin(
+    request: web.Request,
+    endpoint: str,
+    snapshot: Optional[Dict[str, Any]],
+) -> tuple:
+    """Admission decision for a keyed request: ``(key, response)``.
+
+    ``key`` is None when the request is unkeyed/ineligible (no journal
+    configured, no header, or ``snapshot`` is None — fan-out shapes the
+    startup replay cannot reconstruct).  ``response`` short-circuits
+    the handler: a settled key replays its stored body
+    (``vgt_journal_replays{outcome="served"}``), a same-lifetime
+    pending key 409s (``outcome="duplicate"``), and a pending key
+    INHERITED from a crashed predecessor waits here for the startup
+    replay / adopted worker to settle it — never a dead-end 409 for
+    work the crash orphaned."""
+    journal: Optional[RequestJournal] = request.app.get("journal")
+    key = request.headers.get(_IDEMPOTENCY_HEADER)
+    if journal is None or not key or snapshot is None:
+        return None, None
+    engine: VGTEngine = request.app["engine"]
+    deadline = (
+        time.monotonic() + engine.config.server.request_timeout_s
+    )
+    while True:
+        try:
+            outcome, result = journal.begin(
+                key, request["request_id"], endpoint, snapshot
+            )
+        except DuplicateRequestError as exc:
+            metrics.JOURNAL_REPLAYS.labels(outcome="duplicate").inc()
+            return key, _duplicate_409(exc)
+        if outcome == "replay" and result is not None:
+            metrics.JOURNAL_REPLAYS.labels(outcome="served").inc()
+            return key, _replay_response(result)
+        if outcome == "fresh":
+            return key, None
+        # "await": inherited pending — the replay owns it; poll
+        if time.monotonic() >= deadline:
+            metrics.JOURNAL_REPLAYS.labels(outcome="failed").inc()
+            return key, _error(
+                504,
+                f"Idempotency-Key {key!r} was accepted by a previous "
+                "gateway and its replay did not settle in time",
+                "timeout_error",
+            )
+        await asyncio.sleep(_IDEM_AWAIT_POLL_S)
+
+
+def _journal_settle(
+    request: web.Request, key: Optional[str], body: Dict[str, Any]
+) -> None:
+    if not key:
+        return
+    journal: Optional[RequestJournal] = request.app.get("journal")
+    if journal is not None:
+        journal.settle(key, body)
+
+
+def _journal_fail(request: web.Request, key: Optional[str]) -> None:
+    """Release a key after a terminal failure so a retry runs fresh
+    instead of replaying an error or 409ing forever."""
+    if not key:
+        return
+    journal: Optional[RequestJournal] = request.app.get("journal")
+    if journal is not None:
+        journal.fail(key)
 
 
 def _request_api_key(request: web.Request) -> Optional[str]:
@@ -510,6 +620,41 @@ async def _settle_submits(engine: VGTEngine, coros):
         return None, _error(500, f"Inference failed: {exc}", "server_error")
 
 
+def _chat_snapshot(
+    payload: ChatCompletionRequest,
+    prompt: str,
+    logit_bias,
+    timeout_s: float,
+    model: str,
+) -> Optional[Dict[str, Any]]:
+    """Journal snapshot for one chat completion — everything the
+    startup replay needs to push the SAME work back through
+    ``batcher.submit``.  n>1 fan-out returns None (ineligible: the
+    replay reconstructs exactly one generation)."""
+    if payload.n != 1:
+        return None
+    return {
+        "model": model,
+        "prompt": prompt,
+        "submit": {
+            "max_tokens": payload.effective_max_tokens(),
+            "min_tokens": payload.min_tokens,
+            "temperature": payload.temperature,
+            "top_p": payload.top_p,
+            "top_k": payload.top_k,
+            "stop": payload.stop_list(),
+            "stop_token_ids": payload.stop_token_ids,
+            "seed": payload.seed,
+            "timeout_s": timeout_s,
+            "logprobs": payload.logprobs or bool(payload.top_logprobs),
+            "top_logprobs": payload.top_logprobs or 0,
+            "frequency_penalty": payload.frequency_penalty or 0.0,
+            "presence_penalty": payload.presence_penalty or 0.0,
+            "logit_bias": logit_bias,
+        },
+    }
+
+
 async def chat_completions(request: web.Request) -> web.Response:
     """POST /v1/chat/completions (reference: main.py:207-252)."""
     try:
@@ -615,6 +760,21 @@ async def chat_completions(request: web.Request) -> web.Response:
     n_submits, deterministic = _n_plan(
         engine, payload.temperature, payload.seed, payload.n
     )
+    # idempotency gate BEFORE any resource acquisition: a replayed or
+    # duplicate key must not charge admission or burn a fairness slot
+    idem_key, idem_resp = await _idempotency_begin(
+        request,
+        "/v1/chat/completions",
+        _chat_snapshot(
+            payload,
+            prompt,
+            logit_bias,
+            timeout_s,
+            payload.model or engine.config.model.model_id,
+        ),
+    )
+    if idem_resp is not None:
+        return idem_resp
     api_key = _request_api_key(request)
     # the per-key fairness cap charges the CLIENT request once — its n
     # fan-out submits below are one client action, not n.  Watcher
@@ -630,10 +790,12 @@ async def chat_completions(request: web.Request) -> web.Response:
         )
     except ClientQuotaExceededError as exc:
         watcher.cancel()
+        _journal_fail(request, idem_key)
         return _quota_429(exc)
     except BaseException:
         # the polling watcher task must not outlive a failed acquire
         watcher.cancel()
+        _journal_fail(request, idem_key)
         raise
     try:
         settled, err = await _settle_submits(
@@ -673,6 +835,11 @@ async def chat_completions(request: web.Request) -> web.Response:
                 for i in range(n_submits)
             ),
         )
+    except BaseException:
+        # cancellation (or anything _settle_submits lets escape) must
+        # release the key, or every retry 409s for the whole lifetime
+        _journal_fail(request, idem_key)
+        raise
     finally:
         # nested so a raising watcher.cancel cannot leak the slot
         try:
@@ -680,6 +847,7 @@ async def chat_completions(request: web.Request) -> web.Response:
         finally:
             release_slot()
     if err is not None:
+        _journal_fail(request, idem_key)
         return err
     results = (settled * (payload.n if deterministic else 1))[: payload.n]
     result = results[0]
@@ -716,7 +884,9 @@ async def chat_completions(request: web.Request) -> web.Response:
         disaggregated=result.get("disaggregated", False),
         metrics=result.get("metrics", {}),
     )
-    return web.json_response(completion.model_dump())
+    body = completion.model_dump()
+    _journal_settle(request, idem_key, body)
+    return web.json_response(body)
 
 
 async def _stream_chat(
@@ -987,6 +1157,45 @@ def _legacy_logprobs(entries, offset0: int = 0):
     }
 
 
+def _completion_snapshot(
+    payload: CompletionRequest,
+    prompts,
+    logit_bias,
+    timeout_s: float,
+    model: str,
+) -> Optional[Dict[str, Any]]:
+    """Journal snapshot for one legacy completion.  Multi-prompt,
+    n>1/best_of fan-out and echo return None (ineligible shapes: the
+    startup replay reconstructs exactly one plain generation)."""
+    if (
+        len(prompts) != 1
+        or payload.n != 1
+        or (payload.best_of or 1) != 1
+        or payload.echo
+    ):
+        return None
+    return {
+        "model": model,
+        "prompt": prompts[0],
+        "submit": {
+            "max_tokens": payload.max_tokens,
+            "min_tokens": payload.min_tokens,
+            "temperature": payload.temperature,
+            "top_p": payload.top_p,
+            "top_k": payload.top_k,
+            "stop": payload.stop_list(),
+            "stop_token_ids": payload.stop_token_ids,
+            "seed": payload.seed,
+            "timeout_s": timeout_s,
+            "logprobs": payload.logprobs is not None,
+            "top_logprobs": payload.logprobs or 0,
+            "frequency_penalty": payload.frequency_penalty or 0.0,
+            "presence_penalty": payload.presence_penalty or 0.0,
+            "logit_bias": logit_bias,
+        },
+    }
+
+
 async def completions(request: web.Request) -> web.Response:
     """POST /v1/completions — the legacy text-completion surface (no chat
     template; the prompt goes to the engine verbatim).  Supports string or
@@ -1041,6 +1250,21 @@ async def completions(request: web.Request) -> web.Response:
     # logprobs are requested internally even when the client didn't ask
     ranking = not deterministic and best_of > payload.n
 
+    # idempotency gate BEFORE any resource acquisition (same ordering
+    # contract as chat)
+    idem_key, idem_resp = await _idempotency_begin(
+        request,
+        "/v1/completions",
+        _completion_snapshot(
+            payload,
+            prompts,
+            logit_bias,
+            timeout_s,
+            payload.model or engine.config.model.model_id,
+        ),
+    )
+    if idem_resp is not None:
+        return idem_resp
     api_key = _request_api_key(request)
     # per-key cap: one slot per client request, not per fan-out submit.
     # Watcher setup precedes the slot acquisition: nothing may raise
@@ -1055,10 +1279,12 @@ async def completions(request: web.Request) -> web.Response:
         )
     except ClientQuotaExceededError as exc:
         watcher.cancel()
+        _journal_fail(request, idem_key)
         return _quota_429(exc)
     except BaseException:
         # the polling watcher task must not outlive a failed acquire
         watcher.cancel()
+        _journal_fail(request, idem_key)
         raise
     try:
         settled, err = await _settle_submits(
@@ -1101,6 +1327,10 @@ async def completions(request: web.Request) -> web.Response:
                 for i in range(n_submits)
             ),
         )
+    except BaseException:
+        # cancellation must release the key (same contract as chat)
+        _journal_fail(request, idem_key)
+        raise
     finally:
         # nested so a raising watcher.cancel cannot leak the slot
         try:
@@ -1108,6 +1338,7 @@ async def completions(request: web.Request) -> web.Response:
         finally:
             release_slot()
     if err is not None:
+        _journal_fail(request, idem_key)
         return err
 
     def mean_logprob(r) -> float:
@@ -1166,7 +1397,9 @@ async def completions(request: web.Request) -> web.Response:
             total_tokens=prompt_tokens + completion_tokens,
         ),
     )
-    return web.json_response(completion.model_dump())
+    body = completion.model_dump()
+    _journal_settle(request, idem_key, body)
+    return web.json_response(body)
 
 
 async def embeddings(request: web.Request) -> web.Response:
@@ -1184,6 +1417,15 @@ async def embeddings(request: web.Request) -> web.Response:
         timeout_s = _effective_timeout(request, None)
     except ValueError as exc:
         return _error(422, str(exc), "invalid_request_error")
+    # idempotency: embeddings are deterministic, so a settled key's
+    # stored body IS the recompute — replay serves it with zero work.
+    # (An inherited pending embedding is NOT resubmitted at startup —
+    # the retry recomputes fresh; see _replay_journal_pending.)
+    idem_key, idem_resp = await _idempotency_begin(
+        request, "/v1/embeddings", {"inputs": list(inputs)}
+    )
+    if idem_resp is not None:
+        return idem_resp
     # embeddings skip the token-budget path (no decode backlog), but
     # the per-key in-flight fairness cap still applies
     emb_key = _request_api_key(request)
@@ -1199,6 +1441,7 @@ async def embeddings(request: web.Request) -> web.Response:
             ),
         )
     except ClientQuotaExceededError as exc:
+        _journal_fail(request, idem_key)
         return _quota_429(exc)
     try:
         # the encoder pass is a sync executor hop (can't be cancelled
@@ -1213,11 +1456,15 @@ async def embeddings(request: web.Request) -> web.Response:
             timeout_s,
         )
     except asyncio.TimeoutError:
+        _journal_fail(request, idem_key)
         return _error(
             504,
             f"embedding request exceeded its deadline ({timeout_s:.3f}s)",
             "timeout_error",
         )
+    except BaseException:
+        _journal_fail(request, idem_key)
+        raise
     finally:
         release_slot()
     response = EmbeddingResponse(
@@ -1228,7 +1475,9 @@ async def embeddings(request: web.Request) -> web.Response:
         model=result["model"],
         usage=Usage(**result["usage"], completion_tokens=0),
     )
-    return web.json_response(response.model_dump())
+    body = response.model_dump()
+    _journal_settle(request, idem_key, body)
+    return web.json_response(body)
 
 
 async def list_models(request: web.Request) -> web.Response:
@@ -1828,6 +2077,219 @@ def _build_drain_controller(
     )
 
 
+def _journal_body(
+    endpoint: str,
+    model: str,
+    text: str,
+    finish_reason: str,
+    prompt_tokens: int,
+    completion_tokens: int,
+) -> Optional[Dict[str, Any]]:
+    """Compact response body for a journal record settled WITHOUT its
+    original HTTP handler (adopted worker finish, or startup
+    resubmission).  Token identity is the contract — the text and
+    finish_reason are exactly what the original generation produced;
+    envelope fields the gateway mints per-response (id, created) are
+    fresh.  Returns None for endpoints with no replayable shape."""
+    usage = Usage(
+        prompt_tokens=prompt_tokens,
+        completion_tokens=completion_tokens,
+        total_tokens=prompt_tokens + completion_tokens,
+    )
+    if endpoint == "/v1/chat/completions":
+        return ChatCompletion(
+            model=model,
+            choices=[
+                Choice(
+                    index=0,
+                    message=ChatMessage(role="assistant", content=text),
+                    finish_reason=finish_reason,
+                )
+            ],
+            usage=usage,
+        ).model_dump()
+    if endpoint == "/v1/completions":
+        return Completion(
+            model=model,
+            choices=[
+                TextChoice(
+                    index=0, text=text, finish_reason=finish_reason
+                )
+            ],
+            usage=usage,
+        ).model_dump()
+    return None
+
+
+def _wire_survivability(
+    app: web.Application,
+    config: VGTConfig,
+    engine: VGTEngine,
+    batcher: RequestBatcher,
+    loop: asyncio.AbstractEventLoop,
+) -> None:
+    """Gateway-crash survivability wiring (PR-20): build the request
+    journal, reconcile its inherited pending records against the pod's
+    adopted in-flight work, and resubmit the rest.
+
+    Three fates for a record the predecessor accepted but never
+    settled:
+
+    * its generation is STILL RUNNING on an adopted worker — the
+      ``on_adopted_done`` hook settles the record when the done frame
+      lands (a waiting client retry then serves it);
+    * it already FINISHED while the worker was orphaned — the buffered
+      done frame replays during adoption and parks in
+      ``drain_adopted_results``; settled here, synchronously;
+    * nobody holds it (worker died too / no pod) — resubmitted through
+      the normal admission path (``vgt_journal_replays{outcome=
+      "resubmitted"}``), so the promise survives even when the client
+      never retries.
+    """
+    gcfg = config.gateway
+    journal = RequestJournal(
+        gcfg.journal_path or None,
+        fsync=gcfg.journal_fsync,
+        max_bytes=gcfg.journal_max_bytes,
+        retention_s=gcfg.journal_retention_s,
+    )
+    app["journal"] = journal
+    pod = getattr(engine.backend, "core", None)
+    adoption = getattr(pod, "adopted_request_ids", None) is not None
+    inherited = [r for r in journal.pending() if r.inherited]
+    if inherited and not adoption:
+        # pod boots count restarts off the worker registry scan; a
+        # journal-only (non-pod) deployment counts them here
+        metrics.GATEWAY_RESTARTS.inc()
+    if not inherited:
+        return
+    by_rid = {r.request_id: r.key for r in inherited if r.request_id}
+
+    def _on_adopted(
+        request_id: str,
+        result: Optional[Dict[str, Any]],
+        error: Optional[str],
+    ) -> None:
+        # fires on a pod RPC reader thread — the journal carries its
+        # own lock, so settling here is safe
+        key = by_rid.get(str(request_id))
+        if key is None:
+            return
+        rec = journal.lookup(key)
+        if rec is None or rec.state != _JOURNAL_PENDING:
+            return
+        body = None
+        if result is not None:
+            body = _journal_body(
+                rec.endpoint,
+                str(
+                    (rec.snapshot or {}).get("model")
+                    or config.model.model_id
+                ),
+                str(result.get("text") or ""),
+                str(result.get("finish_reason") or "stop"),
+                0,
+                int(result.get("generated_tokens") or 0),
+            )
+        if body is None:
+            journal.fail(key)
+            metrics.JOURNAL_REPLAYS.labels(outcome="failed").inc()
+            logger.warning(
+                "adopted request failed; journal key released",
+                extra={
+                    "extra_data": {
+                        "request_id": request_id, "error": error,
+                    }
+                },
+            )
+            return
+        journal.settle(key, body)
+        logger.info(
+            "adopted request settled into journal",
+            extra={"extra_data": {"request_id": request_id}},
+        )
+
+    adopted_rids: set = set()
+    if adoption:
+        pod.on_adopted_done = _on_adopted
+        adopted_rids = set(pod.adopted_request_ids)
+        for rid, (result, error) in pod.drain_adopted_results().items():
+            adopted_rids.add(rid)
+            _on_adopted(rid, result, error)
+
+    to_resubmit = []
+    for rec in inherited:
+        cur = journal.lookup(rec.key)
+        if cur is None or cur.state != _JOURNAL_PENDING:
+            continue
+        if rec.request_id and rec.request_id in adopted_rids:
+            continue  # the adopted worker finishes it; the hook settles
+        to_resubmit.append(rec)
+    if not to_resubmit:
+        return
+
+    async def _replay_journal_pending() -> None:
+        for rec in to_resubmit:
+            snap = rec.snapshot or {}
+            prompt = snap.get("prompt")
+            kw = dict(snap.get("submit") or {})
+            if rec.endpoint not in (
+                "/v1/chat/completions", "/v1/completions"
+            ) or not isinstance(prompt, str):
+                # no replayable shape (embeddings recompute fresh on
+                # retry; malformed snapshots never crash the boot)
+                journal.fail(rec.key)
+                metrics.JOURNAL_REPLAYS.labels(outcome="failed").inc()
+                continue
+            lb = kw.pop("logit_bias", None)
+            if lb:
+                try:
+                    # JSON round-trip stringified the token-id keys
+                    kw["logit_bias"] = {
+                        int(k): float(v) for k, v in lb.items()
+                    }
+                except (TypeError, ValueError):
+                    pass
+            try:
+                result = await batcher.submit(
+                    prompt,
+                    request_id=(
+                        f"{rec.request_id or rec.key}:journal-replay"
+                    ),
+                    **kw,
+                )
+            except asyncio.CancelledError:
+                raise
+            except BaseException:  # noqa: BLE001 — typed engine errors
+                logger.warning(
+                    "journal replay resubmission failed",
+                    exc_info=True,
+                    extra={"extra_data": {"key": rec.key}},
+                )
+                journal.fail(rec.key)
+                metrics.JOURNAL_REPLAYS.labels(outcome="failed").inc()
+                continue
+            body = _journal_body(
+                rec.endpoint,
+                str(snap.get("model") or config.model.model_id),
+                str(result.get("text") or ""),
+                str(result.get("finish_reason") or "stop"),
+                int(result.get("prompt_tokens") or 0),
+                int(result.get("num_tokens") or 0),
+            )
+            journal.settle(rec.key, body or {})
+            metrics.JOURNAL_REPLAYS.labels(outcome="resubmitted").inc()
+            logger.info(
+                "journal pending record resubmitted and settled",
+                extra={"extra_data": {"key": rec.key}},
+            )
+
+    # runs after startup completes (the batcher is started by then)
+    app["journal_replay_task"] = loop.create_task(
+        _replay_journal_pending()
+    )
+
+
 async def _on_startup(app: web.Application) -> None:
     config: VGTConfig = app["config"]
     app["profile_lock"] = asyncio.Lock()
@@ -1918,6 +2380,16 @@ async def _on_startup(app: web.Application) -> None:
     metrics.init_app_info(
         __version__, config.model.model_id, config.model.engine_type
     )
+    try:
+        _wire_survivability(app, config, engine, batcher, loop)
+    except Exception:
+        # a corrupt journal must never stop the gateway from serving
+        logger.error(
+            "request-journal wiring failed; idempotency replay "
+            "disabled for this lifetime",
+            exc_info=True,
+        )
+        app.pop("journal", None)
     await batcher.start()
 
 
@@ -1932,12 +2404,22 @@ async def _on_cleanup(app: web.Application) -> None:
             asyncio.get_running_loop().remove_signal_handler(signal.SIGUSR1)
         except (NotImplementedError, RuntimeError, ValueError):
             pass
+    replay_task: Optional[asyncio.Task] = app.get("journal_replay_task")
+    if replay_task is not None and not replay_task.done():
+        replay_task.cancel()
+        try:
+            await replay_task
+        except (asyncio.CancelledError, Exception):
+            pass
     batcher: Optional[RequestBatcher] = app.get("batcher")
     if batcher is not None:
         await batcher.stop()
     engine: Optional[VGTEngine] = app.get("engine")
     if engine is not None:
         engine.shutdown()
+    journal: Optional[RequestJournal] = app.get("journal")
+    if journal is not None:
+        journal.close()
     shutdown_tracing()
 
 

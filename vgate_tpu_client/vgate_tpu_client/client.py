@@ -15,6 +15,7 @@ import asyncio
 import json
 import random
 import time
+import uuid
 from typing import Any, AsyncIterator, Dict, Iterator, List, Optional, Union
 
 import httpx
@@ -50,6 +51,24 @@ DEFAULT_MAX_RETRIES = 2
 # compile stretches an engine tick, so the margin must exceed that.
 # Costs nothing on the happy path — responses return when ready.
 DEADLINE_TRANSPORT_MARGIN = 35.0
+
+# Auto-minted on every non-streaming generation POST.  Retry semantics
+# (they are the whole point of the key):
+#
+# * CONNECTION failure → retry with the SAME key.  The server may have
+#   accepted (journaled) the request before the socket died; the same
+#   key turns the retry into a replay of the already-computed result
+#   (``"replayed": true`` in the body) instead of a second generation.
+# * 429 / retryable 5xx → retry with a NEW key.  The server answered,
+#   so the attempt settled terminally under the old key (released as
+#   failed in the gateway journal); a fresh key keeps the re-run from
+#   colliding with that tombstone.
+# * 504 / 4xx → terminal, no retry, key irrelevant.
+IDEMPOTENCY_HEADER = "Idempotency-Key"
+
+
+def _mint_idempotency_key() -> str:
+    return uuid.uuid4().hex
 
 
 def _retry_delay(attempt: int, retry_after: Optional[float] = None) -> float:
@@ -192,7 +211,7 @@ class _ChatResource:
                 "/v1/chat/completions", payload, **_deadline_kwargs(timeout)
             )
         data = self._client._request(
-            "POST", "/v1/chat/completions", payload,
+            "POST", "/v1/chat/completions", payload, idempotent=True,
             **_deadline_kwargs(timeout),
         )
         return ChatCompletion.model_validate(data)
@@ -218,7 +237,8 @@ class _CompletionsResource:
         }
         payload = {k: v for k, v in payload.items() if v is not None}
         return self._client._request(
-            "POST", "/v1/completions", payload, **_deadline_kwargs(timeout)
+            "POST", "/v1/completions", payload, idempotent=True,
+            **_deadline_kwargs(timeout),
         )
 
 
@@ -237,7 +257,8 @@ class _EmbeddingsResource:
             model=model, input=input, priority=priority
         ).model_dump(exclude_none=True)
         data = self._client._request(
-            "POST", "/v1/embeddings", payload, **_deadline_kwargs(timeout)
+            "POST", "/v1/embeddings", payload, idempotent=True,
+            **_deadline_kwargs(timeout),
         )
         return EmbeddingResponse.model_validate(data)
 
@@ -256,6 +277,9 @@ class VGT:
         self.api_key = api_key
         self.max_retries = max_retries
         self.last_rate_limit: Optional[RateLimitInfo] = None
+        # the key the most recent idempotent request went out under
+        # (observability + tests)
+        self.last_idempotency_key: Optional[str] = None
         self._http = httpx.Client(base_url=self.base_url, timeout=timeout)
         self.chat = _ChatResource(self)
         self.completions = _CompletionsResource(self)
@@ -274,19 +298,26 @@ class VGT:
         payload: Optional[Dict] = None,
         headers: Optional[Dict[str, str]] = None,
         timeout: Optional[float] = None,
+        idempotent: bool = False,
     ) -> Any:
         last_exc: Optional[Exception] = None
         extra: Dict[str, Any] = {}
         if timeout is not None:
             extra["timeout"] = timeout
+        idem_key = _mint_idempotency_key() if idempotent else None
+        self.last_idempotency_key = idem_key
         for attempt in range(self.max_retries + 1):
+            hdrs = {**self._headers(), **(headers or {})}
+            if idem_key is not None:
+                hdrs[IDEMPOTENCY_HEADER] = idem_key
             try:
                 response = self._http.request(
-                    method, path, json=payload,
-                    headers={**self._headers(), **(headers or {})},
-                    **extra,
+                    method, path, json=payload, headers=hdrs, **extra,
                 )
             except httpx.HTTPError as exc:
+                # connection failure: the server may have journaled the
+                # request before the socket died — retry with the SAME
+                # key so a finished generation replays, not recomputes
                 last_exc = ConnectionError(f"connection failed: {exc}")
                 if attempt < self.max_retries:
                     time.sleep(_retry_delay(attempt))
@@ -294,6 +325,11 @@ class VGT:
                 raise last_exc from exc
             self.last_rate_limit = RateLimitInfo.from_headers(response.headers)
             if response.status_code == 429 and attempt < self.max_retries:
+                if idem_key is not None:
+                    # the server answered — the old key settled as
+                    # failed; a fresh key avoids its tombstone
+                    idem_key = _mint_idempotency_key()
+                    self.last_idempotency_key = idem_key
                 time.sleep(
                     _retry_delay(attempt, self.last_rate_limit.retry_after)
                 )
@@ -307,6 +343,9 @@ class VGT:
                 # carry a server-suggested Retry-After; honor it (with
                 # jitter on top) like on 429.  504 (deadline) is NOT
                 # retried: the same request would blow the same budget.
+                if idem_key is not None:
+                    idem_key = _mint_idempotency_key()
+                    self.last_idempotency_key = idem_key
                 time.sleep(
                     _retry_delay(attempt, self.last_rate_limit.retry_after)
                 )
@@ -453,7 +492,7 @@ class _AsyncChatResource:
                 "/v1/chat/completions", payload, **_deadline_kwargs(timeout)
             )
         data = await self._client._request(
-            "POST", "/v1/chat/completions", payload,
+            "POST", "/v1/chat/completions", payload, idempotent=True,
             **_deadline_kwargs(timeout),
         )
         return ChatCompletion.model_validate(data)
@@ -477,7 +516,8 @@ class _AsyncCompletionsResource:
         }
         payload = {k: v for k, v in payload.items() if v is not None}
         return await self._client._request(
-            "POST", "/v1/completions", payload, **_deadline_kwargs(timeout)
+            "POST", "/v1/completions", payload, idempotent=True,
+            **_deadline_kwargs(timeout),
         )
 
 
@@ -496,7 +536,8 @@ class _AsyncEmbeddingsResource:
             model=model, input=input, priority=priority
         ).model_dump(exclude_none=True)
         data = await self._client._request(
-            "POST", "/v1/embeddings", payload, **_deadline_kwargs(timeout)
+            "POST", "/v1/embeddings", payload, idempotent=True,
+            **_deadline_kwargs(timeout),
         )
         return EmbeddingResponse.model_validate(data)
 
@@ -515,6 +556,7 @@ class AsyncVGT:
         self.api_key = api_key
         self.max_retries = max_retries
         self.last_rate_limit: Optional[RateLimitInfo] = None
+        self.last_idempotency_key: Optional[str] = None
         self._http = httpx.AsyncClient(base_url=self.base_url, timeout=timeout)
         self.chat = _AsyncChatResource(self)
         self.completions = _AsyncCompletionsResource(self)
@@ -533,19 +575,24 @@ class AsyncVGT:
         payload: Optional[Dict] = None,
         headers: Optional[Dict[str, str]] = None,
         timeout: Optional[float] = None,
+        idempotent: bool = False,
     ) -> Any:
         last_exc: Optional[Exception] = None
         extra: Dict[str, Any] = {}
         if timeout is not None:
             extra["timeout"] = timeout
+        idem_key = _mint_idempotency_key() if idempotent else None
+        self.last_idempotency_key = idem_key
         for attempt in range(self.max_retries + 1):
+            hdrs = {**self._headers(), **(headers or {})}
+            if idem_key is not None:
+                hdrs[IDEMPOTENCY_HEADER] = idem_key
             try:
                 response = await self._http.request(
-                    method, path, json=payload,
-                    headers={**self._headers(), **(headers or {})},
-                    **extra,
+                    method, path, json=payload, headers=hdrs, **extra,
                 )
             except httpx.HTTPError as exc:
+                # same key on connection failure (see IDEMPOTENCY_HEADER)
                 last_exc = ConnectionError(f"connection failed: {exc}")
                 if attempt < self.max_retries:
                     await asyncio.sleep(_retry_delay(attempt))
@@ -553,6 +600,9 @@ class AsyncVGT:
                 raise last_exc from exc
             self.last_rate_limit = RateLimitInfo.from_headers(response.headers)
             if response.status_code == 429 and attempt < self.max_retries:
+                if idem_key is not None:
+                    idem_key = _mint_idempotency_key()
+                    self.last_idempotency_key = idem_key
                 await asyncio.sleep(
                     _retry_delay(attempt, self.last_rate_limit.retry_after)
                 )
@@ -564,6 +614,9 @@ class AsyncVGT:
             ):
                 # honor the server-suggested Retry-After on 5xx too
                 # (jittered); 504 (deadline) is terminal for this budget
+                if idem_key is not None:
+                    idem_key = _mint_idempotency_key()
+                    self.last_idempotency_key = idem_key
                 await asyncio.sleep(
                     _retry_delay(attempt, self.last_rate_limit.retry_after)
                 )

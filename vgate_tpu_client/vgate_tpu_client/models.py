@@ -65,6 +65,10 @@ class ChatCompletion(BaseModel):
     # vgt extension: the generation was live-migrated between dp
     # replicas by a planned drain/rebalance/scale-down
     migrated: bool = False
+    # vgt extension: served verbatim from the gateway's idempotency
+    # journal — a retried key whose generation had already completed
+    # (zero recompute, token-identical body)
+    replayed: bool = False
     metrics: Dict[str, float] = Field(default_factory=dict)
 
 
@@ -79,6 +83,9 @@ class EmbeddingResponse(BaseModel):
     data: List[EmbeddingData] = Field(default_factory=list)
     model: str = ""
     usage: Usage = Field(default_factory=Usage)
+    # vgt extension: replayed from the idempotency journal (see
+    # ChatCompletion.replayed)
+    replayed: bool = False
 
 
 class EmbeddingRequest(BaseModel):
